@@ -1,0 +1,16 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capabilities of
+PaddlePaddle Fluid (reference: TeggyYang/Paddle @ /root/reference).
+
+Compute path: the Fluid-compatible Program IR lowers through a registry of
+JAX rules into single fused XLA modules (jit/pjit over jax.sharding.Mesh);
+hot kernels in paddle_tpu.ops use pallas. Parallelism (dp/tp/sp) is GSPMD
+over the ICI mesh rather than NCCL/pserver.
+"""
+__version__ = '0.14.0+tpu.r1'
+
+from . import fluid  # noqa: F401
+from . import reader  # noqa: F401
+from . import dataset  # noqa: F401
+from .batch import batch  # noqa: F401
+
+__all__ = ['fluid', 'reader', 'dataset', 'batch']

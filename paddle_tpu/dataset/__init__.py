@@ -1,0 +1,26 @@
+"""Datasets. Parity: reference python/paddle/dataset/.
+
+Zero-egress environment: when the real files are absent locally
+(~/.cache/paddle_tpu/dataset), each dataset falls back to a deterministic
+synthetic generator with the same schema/shape/vocab so models and tests
+run anywhere. Drop the official files into the cache dir to train on real
+data.
+"""
+from . import common
+from . import uci_housing
+from . import mnist
+from . import cifar
+from . import imdb
+from . import imikolov
+from . import movielens
+from . import wmt14
+from . import wmt16
+from . import conll05
+from . import sentiment
+from . import flowers
+from . import voc2012
+from . import mq2007
+
+__all__ = ['common', 'uci_housing', 'mnist', 'cifar', 'imdb', 'imikolov',
+           'movielens', 'wmt14', 'wmt16', 'conll05', 'sentiment', 'flowers',
+           'voc2012', 'mq2007']

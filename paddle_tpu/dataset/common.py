@@ -1,0 +1,46 @@
+"""Dataset plumbing. Parity: reference python/paddle/dataset/common.py."""
+import hashlib
+import os
+
+import numpy as np
+
+__all__ = ['DATA_HOME', 'download', 'md5file', 'data_path', 'synthetic_rng']
+
+DATA_HOME = os.path.expanduser('~/.cache/paddle_tpu/dataset')
+
+
+def must_mkdirs(path):
+    os.makedirs(path, exist_ok=True)
+
+
+must_mkdirs(DATA_HOME)
+
+
+def md5file(fname):
+    hash_md5 = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            hash_md5.update(chunk)
+    return hash_md5.hexdigest()
+
+
+def data_path(module_name, filename):
+    return os.path.join(DATA_HOME, module_name, filename)
+
+
+def download(url, module_name, md5sum, save_name=None):
+    """Zero-egress: never fetches. Returns the cache path if the file was
+    pre-seeded, else None (callers fall back to synthetic data)."""
+    dirname = os.path.join(DATA_HOME, module_name)
+    must_mkdirs(dirname)
+    filename = os.path.join(dirname,
+                            save_name or url.split('/')[-1])
+    if os.path.exists(filename):
+        return filename
+    return None
+
+
+def synthetic_rng(tag, seed=1234):
+    """Deterministic per-dataset RNG for synthetic fallbacks."""
+    h = int(hashlib.md5(tag.encode()).hexdigest()[:8], 16)
+    return np.random.RandomState((seed + h) % (2 ** 31))

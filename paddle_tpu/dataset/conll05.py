@@ -1,0 +1,38 @@
+"""CoNLL-2005 SRL. Parity: reference python/paddle/dataset/conll05.py."""
+import numpy as np
+from . import common
+
+__all__ = ['get_dict', 'get_embedding', 'test']
+
+_WORD, _VERB, _LABEL = 44068, 3162, 59
+
+
+def get_dict():
+    word_dict = {('w%d' % i): i for i in range(_WORD)}
+    verb_dict = {('v%d' % i): i for i in range(_VERB)}
+    label_dict = {('l%d' % i): i for i in range(_LABEL)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    rng = common.synthetic_rng('conll05_emb')
+    return rng.uniform(-1, 1, size=(_WORD, 32)).astype('float32')
+
+
+def _synthetic(n, tag):
+    rng = common.synthetic_rng('conll05_' + tag)
+    for _ in range(n):
+        slen = int(rng.randint(5, 40))
+        word = [int(w) for w in rng.randint(0, _WORD, size=slen)]
+        ctx = [int(w) for w in rng.randint(0, _WORD, size=slen)]
+        verb = [int(rng.randint(0, _VERB))] * slen
+        mark = [int(m) for m in rng.randint(0, 2, size=slen)]
+        label = [int(l) for l in rng.randint(0, _LABEL, size=slen)]
+        yield word, ctx, ctx, ctx, ctx, verb, mark, label
+
+
+def test():
+    def reader():
+        for s in _synthetic(256, 'test'):
+            yield s
+    return reader

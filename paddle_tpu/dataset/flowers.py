@@ -1,0 +1,28 @@
+"""Oxford-102 flowers. Parity: reference python/paddle/dataset/flowers.py
+(3x224x224 image, int label)."""
+import numpy as np
+from . import common
+
+__all__ = ['train', 'test', 'valid']
+
+
+def _reader(tag, n, use_xmap=True):
+    def reader():
+        rng = common.synthetic_rng('flowers_' + tag)
+        for _ in range(n):
+            label = int(rng.randint(0, 102))
+            img = rng.rand(3, 224, 224).astype('float32')
+            yield img, label
+    return reader
+
+
+def train(use_xmap=True, mapper=None, buffered_size=1024, cycle=False):
+    return _reader('train', 512)
+
+
+def test(use_xmap=True, mapper=None, buffered_size=1024, cycle=False):
+    return _reader('test', 64)
+
+
+def valid(use_xmap=True, mapper=None, buffered_size=1024):
+    return _reader('valid', 64)

@@ -1,0 +1,38 @@
+"""PTB language model n-grams. Parity: reference python/paddle/dataset/imikolov.py."""
+import numpy as np
+from . import common
+
+__all__ = ['train', 'test', 'build_dict']
+
+_VOCAB = 2073
+
+
+def build_dict(min_word_freq=50):
+    return {('w%d' % i): i for i in range(_VOCAB)}
+
+
+def _synthetic(n, tag, ngram):
+    rng = common.synthetic_rng('imikolov_' + tag)
+    # markov-ish chains so the n-gram task is learnable
+    trans = common.synthetic_rng('imikolov_trans').randint(
+        0, _VOCAB, size=(_VOCAB,))
+    for _ in range(n):
+        w = [int(rng.randint(0, _VOCAB))]
+        for _ in range(ngram - 1):
+            nxt = int(trans[w[-1]]) if rng.rand() < 0.8 else int(rng.randint(0, _VOCAB))
+            w.append(nxt)
+        yield tuple(w)
+
+
+def train(word_idx=None, n=5):
+    def reader():
+        for s in _synthetic(4096, 'train', n):
+            yield s
+    return reader
+
+
+def test(word_idx=None, n=5):
+    def reader():
+        for s in _synthetic(512, 'test', n):
+            yield s
+    return reader

@@ -1,0 +1,60 @@
+"""MNIST. Parity: reference python/paddle/dataset/mnist.py
+(784-float image in [-1,1], int label)."""
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from . import common
+
+__all__ = ['train', 'test']
+
+TRAIN_IMAGE_URL = 'http://yann.lecun.com/exdb/mnist/train-images-idx3-ubyte.gz'
+TRAIN_LABEL_URL = 'http://yann.lecun.com/exdb/mnist/train-labels-idx1-ubyte.gz'
+TEST_IMAGE_URL = 'http://yann.lecun.com/exdb/mnist/t10k-images-idx3-ubyte.gz'
+TEST_LABEL_URL = 'http://yann.lecun.com/exdb/mnist/t10k-labels-idx1-ubyte.gz'
+
+
+def _parse_idx(img_path, lbl_path):
+    with gzip.open(lbl_path, 'rb') as f:
+        magic, n = struct.unpack('>II', f.read(8))
+        labels = np.frombuffer(f.read(), dtype=np.uint8)
+    with gzip.open(img_path, 'rb') as f:
+        magic, n, rows, cols = struct.unpack('>IIII', f.read(16))
+        images = np.frombuffer(f.read(), dtype=np.uint8).reshape(n, rows * cols)
+    return images, labels
+
+
+def _synthetic(n, tag):
+    """Class-conditional blobs: 10 fixed prototype digits + noise, so simple
+    models genuinely learn separable structure."""
+    rng = common.synthetic_rng('mnist_' + tag)
+    protos = common.synthetic_rng('mnist_protos').uniform(
+        -1, 1, size=(10, 784)).astype('float32')
+    labels = rng.randint(0, 10, size=n).astype('int64')
+    images = protos[labels] + 0.35 * rng.randn(n, 784).astype('float32')
+    return np.clip(images, -1, 1).astype('float32'), labels
+
+
+def _reader_creator(image_url, label_url, tag, n_synth):
+    def reader():
+        img_path = common.download(image_url, 'mnist', None)
+        lbl_path = common.download(label_url, 'mnist', None)
+        if img_path and lbl_path:
+            images, labels = _parse_idx(img_path, lbl_path)
+            images = images.astype('float32') / 127.5 - 1.0
+            labels = labels.astype('int64')
+        else:
+            images, labels = _synthetic(n_synth, tag)
+        for i in range(len(labels)):
+            yield images[i], int(labels[i])
+    return reader
+
+
+def train():
+    return _reader_creator(TRAIN_IMAGE_URL, TRAIN_LABEL_URL, 'train', 8192)
+
+
+def test():
+    return _reader_creator(TEST_IMAGE_URL, TEST_LABEL_URL, 'test', 1024)

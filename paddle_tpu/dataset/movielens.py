@@ -1,0 +1,48 @@
+"""MovieLens ratings. Parity: reference python/paddle/dataset/movielens.py."""
+import numpy as np
+from . import common
+
+__all__ = ['train', 'test', 'max_user_id', 'max_movie_id', 'max_job_id',
+           'age_table']
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+
+def max_user_id():
+    return 6040
+
+
+def max_movie_id():
+    return 3952
+
+
+def max_job_id():
+    return 20
+
+
+def _synthetic(n, tag):
+    rng = common.synthetic_rng('movielens_' + tag)
+    for _ in range(n):
+        uid = int(rng.randint(1, 6041))
+        gender = int(rng.randint(0, 2))
+        age = int(rng.randint(0, 7))
+        job = int(rng.randint(0, 21))
+        mid = int(rng.randint(1, 3953))
+        category = [int(rng.randint(0, 19))]
+        title = [int(rng.randint(0, 5175)) for _ in range(3)]
+        score = float(rng.randint(1, 6))
+        yield [uid, gender, age, job, mid, category, title, score]
+
+
+def train():
+    def reader():
+        for s in _synthetic(4096, 'train'):
+            yield s
+    return reader
+
+
+def test():
+    def reader():
+        for s in _synthetic(512, 'test'):
+            yield s
+    return reader

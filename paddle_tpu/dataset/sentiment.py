@@ -1,0 +1,16 @@
+"""Movie-review sentiment. Parity: reference python/paddle/dataset/sentiment.py."""
+from . import imdb
+
+__all__ = ['train', 'test', 'get_word_dict']
+
+
+def get_word_dict():
+    return imdb.word_dict()
+
+
+def train():
+    return imdb.train()
+
+
+def test():
+    return imdb.test()

@@ -1,0 +1,64 @@
+"""UCI housing. Parity: reference python/paddle/dataset/uci_housing.py
+(13 features -> price regression)."""
+import os
+
+import numpy as np
+
+from . import common
+
+__all__ = ['train', 'test', 'feature_range']
+
+URL = 'https://archive.ics.uci.edu/ml/machine-learning-databases/housing/housing.data'
+MD5 = 'd4accdce7a25600298819f8e28e8d593'
+feature_names = ['CRIM', 'ZN', 'INDUS', 'CHAS', 'NOX', 'RM', 'AGE', 'DIS',
+                 'RAD', 'TAX', 'PTRATIO', 'B', 'LSTAT']
+
+UCI_TRAIN_DATA = None
+UCI_TEST_DATA = None
+
+
+def feature_range(maximums, minimums):
+    pass
+
+
+def _load():
+    global UCI_TRAIN_DATA, UCI_TEST_DATA
+    if UCI_TRAIN_DATA is not None:
+        return
+    path = common.download(URL, 'uci_housing', MD5)
+    if path is not None and os.path.exists(path):
+        data = np.fromfile(path, sep=' ')
+        data = data.reshape(data.shape[0] // 14, 14)
+    else:
+        # synthetic: linear ground truth + noise, same shape/scale
+        rng = common.synthetic_rng('uci_housing')
+        n = 506
+        x = rng.uniform(-1, 1, size=(n, 13))
+        w = rng.uniform(-2, 2, size=(13,))
+        y = x @ w + 0.1 * rng.randn(n) + 22.0
+        data = np.concatenate([x, y[:, None]], axis=1)
+    maximums, minimums, avgs = data.max(axis=0), data.min(axis=0), \
+        data.sum(axis=0) / data.shape[0]
+    for i in range(13):
+        data[:, i] = (data[:, i] - avgs[i]) / (maximums[i] - minimums[i])
+    offset = int(data.shape[0] * 0.8)
+    UCI_TRAIN_DATA = data[:offset].astype('float32')
+    UCI_TEST_DATA = data[offset:].astype('float32')
+
+
+def train():
+    _load()
+
+    def reader():
+        for d in UCI_TRAIN_DATA:
+            yield d[:-1], d[-1:]
+    return reader
+
+
+def test():
+    _load()
+
+    def reader():
+        for d in UCI_TEST_DATA:
+            yield d[:-1], d[-1:]
+    return reader

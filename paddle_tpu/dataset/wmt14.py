@@ -1,0 +1,33 @@
+"""WMT14 en-fr. Parity: reference python/paddle/dataset/wmt14.py
+(src ids, trg ids, trg_next ids)."""
+import numpy as np
+from . import common
+
+__all__ = ['train', 'test', 'N']
+
+N = 30000  # vocab size in reference's pruned dict
+
+
+def _synthetic(n, tag, dict_size):
+    rng = common.synthetic_rng('wmt14_' + tag)
+    for _ in range(n):
+        slen = int(rng.randint(4, 30))
+        src = [int(w) for w in rng.randint(3, dict_size, size=slen)]
+        # target = noisy "translation": shifted copy
+        trg = [(w + 7) % dict_size for w in src[:max(2, slen - 2)]]
+        trg = [max(3, w) for w in trg]
+        yield src, [0] + trg, trg + [1]  # <s> trg, trg </s>
+
+
+def train(dict_size=N):
+    def reader():
+        for s in _synthetic(2048, 'train', dict_size):
+            yield s
+    return reader
+
+
+def test(dict_size=N):
+    def reader():
+        for s in _synthetic(256, 'test', dict_size):
+            yield s
+    return reader

@@ -1,0 +1,50 @@
+"""paddle_tpu.fluid — the Fluid-compatible TPU-native API.
+
+Parity: reference python/paddle/fluid/__init__.py.
+"""
+from . import core
+from . import framework
+from .framework import Program, Operator, Parameter, Variable, \
+    default_startup_program, default_main_program, program_guard, \
+    name_scope, get_var
+from . import executor
+from .executor import Executor, global_scope, scope_guard, _switch_scope, Scope
+from . import layers
+from . import initializer
+from . import optimizer
+from . import backward
+from .backward import append_backward
+from . import regularizer
+from . import clip
+from .clip import ErrorClipByValue, GradientClipByValue, GradientClipByNorm, \
+    GradientClipByGlobalNorm
+from . import nets
+from . import io
+from . import evaluator
+from . import metrics
+from . import average
+from .param_attr import ParamAttr, WeightNormParamAttr
+from .data_feeder import DataFeeder
+from .lod_tensor import LoDTensor, create_lod_tensor, create_random_int_lodtensor
+from . import unique_name
+from . import profiler
+from . import debugger
+from .core import CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace
+from .parallel_executor import ParallelExecutor, ExecutionStrategy, BuildStrategy
+from . import transpiler
+from .transpiler import DistributeTranspiler, InferenceTranspiler, \
+    memory_optimize, release_memory
+
+Tensor = LoDTensor
+
+__all__ = framework.__all__ + executor.__all__ + transpiler.__all__ + [
+    'io', 'initializer', 'layers', 'transpiler', 'nets', 'optimizer',
+    'learning_rate_decay', 'backward', 'regularizer', 'LoDTensor',
+    'CPUPlace', 'TPUPlace', 'CUDAPlace', 'CUDAPinnedPlace', 'Tensor',
+    'ParamAttr', 'WeightNormParamAttr', 'DataFeeder', 'clip', 'profiler',
+    'unique_name',
+]
+
+
+def __bootstrap__():
+    return True

@@ -1,0 +1,109 @@
+"""Device places and low-level shims.
+
+Parity: reference paddle/fluid/platform/place.h (CPUPlace/CUDAPlace) and the
+pybind `core` module (python/paddle/fluid/__init__.py imports `core`).
+TPU-first: `TPUPlace` replaces CUDAPlace as the accelerator place; both map to
+a jax.Device. A Place only selects which jax device backs Scope arrays and
+where jitted programs run — kernels themselves are XLA-compiled, not per-op.
+"""
+import numpy as np
+
+import jax
+
+
+class Place(object):
+    _platforms = ()
+
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return "%s(%d)" % (type(self).__name__, self.device_id)
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.device_id == other.device_id
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.device_id))
+
+    def jax_device(self):
+        devs = [d for d in jax.devices() if d.platform in self._platforms]
+        if not devs:
+            devs = jax.devices('cpu')
+        return devs[min(self.device_id, len(devs) - 1)]
+
+
+class CPUPlace(Place):
+    _platforms = ('cpu',)
+
+    def __init__(self):
+        super(CPUPlace, self).__init__(0)
+
+
+class TPUPlace(Place):
+    """The accelerator place (reference: platform::CUDAPlace)."""
+    # 'axon' is the tunneled single-chip TPU platform in this environment.
+    _platforms = ('tpu', 'axon')
+
+
+# Alias so code written against the reference's GPU API keeps working.
+CUDAPlace = TPUPlace
+CUDAPinnedPlace = CPUPlace
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_tpu():
+    return any(d.platform in ('tpu', 'axon') for d in jax.devices())
+
+
+def get_tpu_device_count():
+    return len([d for d in jax.devices() if d.platform in ('tpu', 'axon')])
+
+
+# Fluid VarDesc dtype enum compatibility (reference: framework.proto VarType).
+class VarDesc(object):
+    class VarType(object):
+        BOOL = 0
+        INT16 = 1
+        INT32 = 2
+        INT64 = 3
+        FP16 = 4
+        FP32 = 5
+        FP64 = 6
+        LOD_TENSOR = 7
+        SELECTED_ROWS = 8
+        FEED_MINIBATCH = 9
+        FETCH_LIST = 10
+        STEP_SCOPES = 11
+        LOD_RANK_TABLE = 12
+        LOD_TENSOR_ARRAY = 13
+        PLACE_LIST = 14
+        READER = 15
+        UINT8 = 20
+        BF16 = 22
+        RAW = 17
+
+
+_DTYPE_ENUM_TO_NP = {
+    VarDesc.VarType.BOOL: np.bool_,
+    VarDesc.VarType.INT16: np.int16,
+    VarDesc.VarType.INT32: np.int32,
+    VarDesc.VarType.INT64: np.int64,
+    VarDesc.VarType.FP16: np.float16,
+    VarDesc.VarType.FP32: np.float32,
+    VarDesc.VarType.FP64: np.float64,
+    VarDesc.VarType.UINT8: np.uint8,
+}
+
+
+def convert_dtype(dtype):
+    """Normalize str / np.dtype / VarType enum to a canonical dtype string."""
+    import jax.numpy as jnp
+    if isinstance(dtype, int):
+        dtype = _DTYPE_ENUM_TO_NP[dtype]
+    if dtype == 'bfloat16' or dtype is jnp.bfloat16:
+        return 'bfloat16'
+    return np.dtype(dtype).name

@@ -1,0 +1,109 @@
+"""DataFeeder: minibatch rows -> feed dict.
+
+Parity: reference python/paddle/fluid/data_feeder.py. Sequence slots
+(lod_level>0) are converted to dense-padded SeqValues with power-of-two
+length bucketing so XLA sees few distinct shapes (the reference feeds
+flattened LoDTensors; padding+bucketing is the TPU-native equivalent).
+"""
+import numpy as np
+
+from .framework import Variable, default_main_program
+from .lod_tensor import LoDTensor
+
+__all__ = ['DataFeeder']
+
+
+def _bucket(n, minimum=8):
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+class DataToLoDTensorConverter(object):
+    def __init__(self, place, lod_level, shape, dtype):
+        self.place = place
+        self.lod_level = lod_level
+        self.shape = shape
+        self.dtype = dtype
+        self.data = []
+        self.lod = [[] for _ in range(lod_level)]
+
+    def feed(self, data):
+        self._feed_impl_(data, self.lod, self.lod_level)
+
+    def _feed_impl_(self, data, lod, lod_level):
+        if lod_level == 0:
+            self.data.append(data)
+        else:
+            lod[0].append(len(data))
+            for each_data in data:
+                self._feed_impl_(each_data, lod[1:], lod_level - 1)
+
+    def done(self, pad_bucketing=True):
+        if self.lod_level == 0:
+            arr = np.array(self.data, dtype=self.dtype)
+            if self.shape and len(arr.shape) != len(self.shape) + 1:
+                arr = arr.reshape([-1] + [abs(int(s)) for s in self.shape])
+            return arr
+        # sequence slot: rows are python sequences; build padded SeqValue
+        from .lowering import SeqValue
+        import jax.numpy as jnp
+        seqs = [np.asarray(s, dtype=self.dtype) for s in self.data]
+        seqs = [s[:, None] if s.ndim == 1 else s for s in seqs]
+        lens = np.asarray([s.shape[0] for s in seqs], dtype=np.int32)
+        maxlen = int(lens.max()) if len(lens) else 1
+        if pad_bucketing:
+            maxlen = _bucket(maxlen)
+        trail = seqs[0].shape[1:]
+        padded = np.zeros((len(seqs), maxlen) + trail, dtype=self.dtype)
+        for i, s in enumerate(seqs):
+            padded[i, :s.shape[0]] = s
+        return SeqValue(jnp.asarray(padded), jnp.asarray(lens))
+
+
+class DataFeeder(object):
+    """reference data_feeder.py:DataFeeder."""
+
+    def __init__(self, feed_list, place, program=None):
+        self.feed_dtypes = []
+        self.feed_names = []
+        self.feed_shapes = []
+        self.feed_lod_level = []
+        if program is None:
+            program = default_main_program()
+        for each_var in feed_list:
+            if isinstance(each_var, str):
+                each_var = program.global_block().var(each_var)
+            if not isinstance(each_var, Variable):
+                raise TypeError("Feed list should contain Variables or names")
+            self.feed_dtypes.append(each_var.dtype)
+            self.feed_names.append(each_var.name)
+            self.feed_lod_level.append(each_var.lod_level)
+            shape = each_var.shape
+            self.feed_shapes.append([d for d in shape if d != -1] if shape else None)
+        self.place = place
+
+    def feed(self, iterable):
+        converters = [
+            DataToLoDTensorConverter(self.place, lod_level=lod, shape=shape,
+                                     dtype=dtype)
+            for lod, shape, dtype in zip(self.feed_lod_level,
+                                         self.feed_shapes, self.feed_dtypes)]
+        for each_sample in iterable:
+            assert len(each_sample) == len(converters), (
+                "feed sample has %d slots, expected %d" %
+                (len(each_sample), len(converters)))
+            for each_converter, each_slot in zip(converters, each_sample):
+                each_converter.feed(each_slot)
+        return {name: conv.done()
+                for name, conv in zip(self.feed_names, converters)}
+
+    def feed_parallel(self, iterable, num_places=None):
+        """Split a batch across mesh shards (used with ParallelExecutor);
+        on GSPMD the full batch is fed once and sharded by the mesh, so this
+        just feeds the concatenation."""
+        rows = []
+        for it in iterable:
+            rows.extend(it)
+        return self.feed(rows)

@@ -1,0 +1,34 @@
+"""Program debugging / visualization.
+
+Parity: reference python/paddle/fluid/debugger.py (draw_block_graphviz) +
+graphviz.py. Emits a text dump and a .dot graph of the op DAG.
+"""
+__all__ = ['pprint_program_codes', 'draw_block_graphviz']
+
+
+def pprint_program_codes(program):
+    print(program.to_string())
+
+
+def draw_block_graphviz(block, highlights=None, path="./temp.dot"):
+    """Write a graphviz dot file of the block's op/var DAG."""
+    lines = ["digraph G {", "  rankdir=TB;"]
+    highlights = set(highlights or [])
+    for i, op in enumerate(block.ops):
+        color = 'red' if op.type in highlights else 'lightblue'
+        lines.append('  op%d [label="%s" shape=box style=filled fillcolor=%s];'
+                     % (i, op.type, color))
+        for vs in op.inputs.values():
+            for v in vs:
+                vid = 'var_%s' % v.name.replace('.', '_').replace('@', '_')
+                lines.append('  %s [label="%s" shape=ellipse];' % (vid, v.name))
+                lines.append('  %s -> op%d;' % (vid, i))
+        for vs in op.outputs.values():
+            for v in vs:
+                vid = 'var_%s' % v.name.replace('.', '_').replace('@', '_')
+                lines.append('  %s [label="%s" shape=ellipse];' % (vid, v.name))
+                lines.append('  op%d -> %s;' % (i, vid))
+    lines.append("}")
+    with open(path, 'w') as f:
+        f.write("\n".join(lines))
+    return path

@@ -1,0 +1,278 @@
+"""Executor: lowers a Program into ONE jitted XLA computation.
+
+Parity: reference python/paddle/fluid/executor.py:256 + the C++ interpreter
+(paddle/fluid/framework/executor.cc) that walks the ProgramDesc op-by-op,
+launching a CUDA kernel per op.
+
+TPU-first redesign: Executor.run symbolically evaluates the whole block
+through the lowering registry inside a single jax.jit trace, keyed by
+(program version, feed signature, fetch names). XLA then fuses the entire
+step — forward, backward (one jax.grad over the traced forward, contributed
+by the `autodiff` op that backward.append_backward plants), optimizer
+updates — into one module: one device launch per step vs hundreds.
+Persistable variables (parameters, optimizer state, BN stats) live in the
+Scope as device arrays and are donated to each step, so updates are
+in-place in HBM.
+"""
+import collections
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import core
+from . import lowering
+from . import ops_impl  # noqa: F401  (registers all rules)
+from .framework import default_main_program, Program
+from .lowering import SeqValue, Ctx
+
+__all__ = ['Executor', 'global_scope', 'scope_guard', '_switch_scope', 'Scope']
+
+
+class _VarHolder(object):
+    """Mimics the pybind Variable handle (find_var().get_tensor())."""
+
+    def __init__(self, scope, name):
+        self._scope = scope
+        self._name = name
+
+    def get_tensor(self):
+        v = self._scope.vars[self._name]
+        if isinstance(v, SeqValue):
+            return np.asarray(v.data)
+        return np.asarray(v)
+
+    def set(self, value, place=None):
+        self._scope.vars[self._name] = jnp.asarray(value)
+
+
+class Scope(object):
+    """name -> device array store. Parity: paddle/fluid/framework/scope.h."""
+
+    def __init__(self):
+        self.vars = collections.OrderedDict()
+
+    def find_var(self, name):
+        if name not in self.vars:
+            return None
+        return _VarHolder(self, name)
+
+    def var(self, name):
+        self.vars.setdefault(name, None)
+        return _VarHolder(self, name)
+
+    def new_scope(self):
+        return Scope()
+
+    def __contains__(self, name):
+        return name in self.vars
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+def _switch_scope(scope):
+    global _global_scope
+    prev = _global_scope
+    _global_scope = scope
+    return prev
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    prev = _switch_scope(scope)
+    try:
+        yield
+    finally:
+        _switch_scope(prev)
+
+
+def _as_fetch_name(f):
+    from .framework import Variable
+    if isinstance(f, Variable):
+        return f.name
+    return str(f)
+
+
+def _feed_signature(name, val):
+    if isinstance(val, SeqValue):
+        return (name, 'seq', tuple(val.data.shape), str(val.data.dtype))
+    arr = np.asarray(val) if not hasattr(val, 'shape') else val
+    return (name, tuple(arr.shape), str(arr.dtype))
+
+
+class _CompiledStep(object):
+    """One lowered+jitted (program, feed-sig, fetch) combination."""
+
+    def __init__(self, program, block, feed_names, fetch_names, persist_in,
+                 mesh_sharding=None):
+        self.program = program
+        ops = list(block.ops)
+        self.ops = ops
+        self.fetch_names = list(fetch_names)
+        self.persist_in = list(persist_in)
+        ad_idxs = [i for i, op in enumerate(ops) if op.type == 'autodiff']
+        assert len(ad_idxs) <= 1, "at most one append_backward per program"
+        self.ad_idx = ad_idxs[0] if ad_idxs else None
+        # names that will exist in env and are persistable -> written back
+        produced = set(self.persist_in)
+        persistable = {v.name for v in program.list_vars() if v.persistable}
+        for op in ops:
+            for vs in op.outputs.values():
+                for v in vs:
+                    if v.name in persistable:
+                        produced.add(v.name)
+        self.persist_out = sorted(produced)
+        self.mesh_sharding = mesh_sharding
+
+        def run_range(env, lo, hi, key, grad_mode=False):
+            for i in range(lo, hi):
+                op = ops[i]
+                if op.type == 'autodiff':
+                    continue
+                lowering.run_op(op, env, Ctx(key, i))
+                if grad_mode:
+                    for vs in op.outputs.values():
+                        for v in vs:
+                            if v.stop_gradient and v.name in env and env[v.name] is not None:
+                                env[v.name] = jax.tree_util.tree_map(
+                                    jax.lax.stop_gradient, env[v.name])
+
+        def step(persist, feed, key):
+            env = dict(persist)
+            env.update(feed)
+            if self.ad_idx is None:
+                run_range(env, 0, len(ops), key)
+            else:
+                ad = ops[self.ad_idx]
+                pnames = [n for n in ad.attrs['param_names'] if n in env]
+                gnames = dict(zip(ad.attrs['param_names'], ad.attrs['grad_names']))
+                trainable = {n: env[n] for n in pnames}
+                base = {k: v for k, v in env.items() if k not in trainable}
+
+                def fwd(tr):
+                    e = dict(base)
+                    e.update(tr)
+                    run_range(e, 0, self.ad_idx, key, grad_mode=True)
+                    loss = e[ad.attrs['loss_name']]
+                    return jnp.sum(loss.astype(jnp.float32)), e
+
+                grads, env = jax.grad(fwd, has_aux=True)(trainable)
+                scale = ad.attrs.get('loss_scale', 1.0)
+                for n in pnames:
+                    g = grads[n]
+                    if scale != 1.0:
+                        g = g * scale
+                    env[gnames[n]] = g.astype(env[n].dtype)
+                run_range(env, self.ad_idx + 1, len(ops), key)
+            fetches = [env[n] for n in self.fetch_names]
+            new_persist = {n: env[n] for n in self.persist_out if n in env}
+            return fetches, new_persist
+
+        self._jitted = jax.jit(step, donate_argnums=(0,))
+
+    def __call__(self, persist, feed, key):
+        return self._jitted(persist, feed, key)
+
+
+class Executor(object):
+    """Parity: reference python/paddle/fluid/executor.py:256."""
+
+    def __init__(self, place=None):
+        if place is None:
+            place = core.TPUPlace(0) if core.is_compiled_with_tpu() else core.CPUPlace()
+        self.place = place
+        self._cache = {}
+        self._run_counter = 0
+
+    def _device(self):
+        return self.place.jax_device()
+
+    def _to_device(self, val, var=None):
+        if isinstance(val, SeqValue):
+            return SeqValue(jax.device_put(jnp.asarray(val.data), self._device()),
+                            jax.device_put(jnp.asarray(val.lengths), self._device()),
+                            val.outer_lengths)
+        from .lod_tensor import LoDTensor
+        if isinstance(val, LoDTensor):
+            sv = val.to_seq_value()
+            return self._to_device(sv)
+        arr = np.asarray(val)
+        return jax.device_put(arr, self._device())
+
+    def run(self,
+            program=None,
+            feed=None,
+            fetch_list=None,
+            feed_var_name='feed',
+            fetch_var_name='fetch',
+            scope=None,
+            return_numpy=True,
+            use_program_cache=True):
+        if program is None:
+            program = default_main_program()
+        if feed is None:
+            feed = {}
+        if fetch_list is None:
+            fetch_list = []
+        if scope is None:
+            scope = global_scope()
+
+        feed_vals = {}
+        block = program.global_block()
+        for name, val in feed.items():
+            var = block.vars.get(name)
+            dv = self._to_device(val, var)
+            if var is not None and var.lod_level > 0 and not isinstance(dv, SeqValue):
+                # dense feed for a lod var: treat every row as full-length
+                lens = jnp.full((dv.shape[0],), dv.shape[1], jnp.int32)
+                dv = SeqValue(dv, lens)
+            if var is not None and not isinstance(dv, SeqValue):
+                want = np.dtype(var.dtype) if var.dtype != 'bfloat16' else jnp.bfloat16
+                if dv.dtype != want:
+                    dv = dv.astype(want)
+            feed_vals[name] = dv
+
+        fetch_names = [_as_fetch_name(f) for f in fetch_list]
+        feed_sig = tuple(sorted(_feed_signature(n, v) for n, v in feed_vals.items()))
+        persist_in = tuple(sorted(
+            v.name for v in program.list_vars()
+            if v.persistable and v.name in scope.vars
+            and scope.vars[v.name] is not None and v.name not in feed_vals))
+        key = (id(program), program._version, feed_sig, tuple(fetch_names),
+               persist_in)
+        compiled = self._cache.get(key) if use_program_cache else None
+        if compiled is None:
+            compiled = _CompiledStep(program, block, list(feed_vals), fetch_names,
+                                     persist_in)
+            if use_program_cache:
+                self._cache[key] = compiled
+
+        persist = {n: scope.vars[n] for n in compiled.persist_in}
+        self._run_counter += 1
+        rng = jax.random.key(np.uint32(
+            ((program.random_seed or 0) * 2654435761 + self._run_counter)
+            % (1 << 32)))
+        fetches, new_persist = compiled(persist, feed_vals, rng)
+        scope.vars.update(new_persist)
+
+        out = []
+        for v in fetches:
+            if isinstance(v, SeqValue):
+                from .lod_tensor import LoDTensor
+                lt = LoDTensor.from_seq_value(v)
+                out.append(np.asarray(lt.data) if return_numpy else lt)
+            else:
+                out.append(np.asarray(v) if return_numpy else v)
+        return out
+
+    def close(self):
+        self._cache.clear()

@@ -1,0 +1,514 @@
+"""Program / Block / Operator / Variable graph IR.
+
+Parity: reference python/paddle/fluid/framework.py (Variable:142,
+Operator:431, Block:855, Program:1339, Parameter:1874).
+
+TPU-first redesign: the reference serializes ops into a protobuf ProgramDesc
+interpreted op-by-op by a C++ Executor with per-Place CUDA/CPU kernels. Here
+the Program is a lightweight Python-side op list that the Executor lowers in
+one pass into a single jitted XLA computation (see executor.py) — ops are
+*symbols*, resolved through the lowering registry (ops_impl/) at trace time.
+Shape inference runs at graph-build time through jax.eval_shape over the same
+lowering rules, so there is exactly one definition of every op's semantics.
+"""
+import collections
+import contextlib
+import copy
+
+import numpy as np
+
+from . import core
+from . import unique_name
+
+__all__ = [
+    'Program', 'Operator', 'Parameter', 'Variable', 'Block',
+    'default_startup_program', 'default_main_program', 'program_guard',
+    'name_scope', 'get_var', 'grad_var_name',
+]
+
+GRAD_VAR_SUFFIX = "@GRAD"
+# Mirrors the reference's OpRole attr used to prune backward/optimize ops in
+# Program.clone(for_test=True) (framework.py op_role machinery).
+ROLE_FORWARD = 0
+ROLE_BACKWARD = 1
+ROLE_OPTIMIZE = 2
+ROLE_LRSCHED = 16
+ROLE_METRIC = 32
+
+# A distinctive stand-in for the dynamic batch dim (-1) during build-time
+# abstract evaluation; mapped back to -1 in inferred output shapes.
+DYN_DIM = 1997
+
+
+def grad_var_name(name):
+    return name + GRAD_VAR_SUFFIX
+
+
+class Variable(object):
+    """A named tensor in a Block. Reference framework.py:142.
+
+    Holds static metadata only (shape may contain -1 for the batch dim);
+    values live in a Scope as jax arrays at run time.
+    """
+
+    def __init__(self,
+                 block,
+                 name=None,
+                 shape=None,
+                 dtype='float32',
+                 lod_level=0,
+                 persistable=False,
+                 stop_gradient=False,
+                 is_data=False,
+                 type=None,
+                 initializer=None,
+                 **kwargs):
+        self.block = block
+        if name is None:
+            name = unique_name.generate('_generated_var')
+        self.name = name
+        self.shape = tuple(int(d) for d in shape) if shape is not None else None
+        self.dtype = core.convert_dtype(dtype)
+        self.lod_level = lod_level
+        self.persistable = persistable
+        self.stop_gradient = stop_gradient
+        self.is_data = is_data
+        self.type = type or 'LOD_TENSOR'
+        self.op = None  # producer op (set by append_op)
+        if name not in block.vars:
+            block.vars[name] = self
+
+    def __repr__(self):
+        return "Variable(name=%s, shape=%s, dtype=%s, lod=%d)" % (
+            self.name, self.shape, self.dtype, self.lod_level)
+
+    __str__ = __repr__
+
+    def to_string(self, throw_on_error=False, with_details=False):
+        return repr(self)
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def astype(self, dtype):
+        from .layers import tensor
+        return tensor.cast(self, dtype)
+
+    def _spec(self, batch=DYN_DIM):
+        """jax.ShapeDtypeStruct view with -1 dims replaced by `batch`."""
+        import jax
+        shape = tuple(batch if d == -1 else d for d in self.shape)
+        dt = self.dtype
+        return jax.ShapeDtypeStruct(shape, np.dtype(dt) if dt != 'bfloat16' else 'bfloat16')
+
+    def _to_dict(self):
+        return dict(name=self.name, shape=list(self.shape) if self.shape else None,
+                    dtype=self.dtype, lod_level=self.lod_level,
+                    persistable=self.persistable, stop_gradient=self.stop_gradient,
+                    is_data=self.is_data, type=self.type,
+                    cls=type(self).__name__)
+
+
+class Parameter(Variable):
+    """A persistable, trainable Variable. Reference framework.py:1874."""
+
+    def __init__(self, block, shape, dtype, **kwargs):
+        kwargs['persistable'] = True
+        self.trainable = kwargs.pop('trainable', True)
+        self.optimize_attr = kwargs.pop('optimize_attr', {'learning_rate': 1.0})
+        self.regularizer = kwargs.pop('regularizer', None)
+        self.gradient_clip_attr = kwargs.pop('gradient_clip_attr', None)
+        self.do_model_average = kwargs.pop('do_model_average', None)
+        super(Parameter, self).__init__(block, shape=shape, dtype=dtype, **kwargs)
+
+    def _to_dict(self):
+        d = super(Parameter, self)._to_dict()
+        d['trainable'] = self.trainable
+        d['optimize_attr'] = self.optimize_attr
+        return d
+
+
+class Operator(object):
+    """One op in a Block. Reference framework.py:431.
+
+    inputs/outputs map slot name -> list of Variable. attrs are plain
+    JSON-able python values. The op's semantics are defined solely by the
+    lowering rule registered for `type` in ops_impl/.
+    """
+
+    def __init__(self, block, type, inputs=None, outputs=None, attrs=None):
+        self.block = block
+        self.type = type
+        self.inputs = {}
+        self.outputs = {}
+        self.attrs = dict(attrs or {})
+        self.attrs.setdefault('op_role', ROLE_FORWARD)
+        if inputs:
+            for slot, vs in inputs.items():
+                if vs is None:
+                    continue
+                if not isinstance(vs, (list, tuple)):
+                    vs = [vs]
+                self.inputs[slot] = list(vs)
+        if outputs:
+            for slot, vs in outputs.items():
+                if vs is None:
+                    continue
+                if not isinstance(vs, (list, tuple)):
+                    vs = [vs]
+                self.outputs[slot] = list(vs)
+                for v in vs:
+                    if isinstance(v, Variable):
+                        v.op = self
+
+    def input(self, slot):
+        return [v.name for v in self.inputs.get(slot, [])]
+
+    def output(self, slot):
+        return [v.name for v in self.outputs.get(slot, [])]
+
+    @property
+    def input_arg_names(self):
+        return [v.name for vs in self.inputs.values() for v in vs]
+
+    @property
+    def output_arg_names(self):
+        return [v.name for vs in self.outputs.values() for v in vs]
+
+    def attr(self, name):
+        return self.attrs[name]
+
+    def has_attr(self, name):
+        return name in self.attrs
+
+    def _set_attr(self, name, val):
+        self.attrs[name] = val
+        self.block.program._bump_version()
+
+    set_attr = _set_attr
+
+    def all_attrs(self):
+        return dict(self.attrs)
+
+    def __repr__(self):
+        ins = {k: [v.name for v in vs] for k, vs in self.inputs.items()}
+        outs = {k: [v.name for v in vs] for k, vs in self.outputs.items()}
+        return "{%s: %s -> %s %s}" % (self.type, ins, outs,
+                                      {k: v for k, v in self.attrs.items()
+                                       if k not in ('op_role',)})
+
+    def _to_dict(self):
+        return dict(
+            type=self.type,
+            inputs={k: [v.name for v in vs] for k, vs in self.inputs.items()},
+            outputs={k: [v.name for v in vs] for k, vs in self.outputs.items()},
+            attrs={k: v for k, v in self.attrs.items()},
+        )
+
+
+class Block(object):
+    """An ordered op list + var table. Reference framework.py:855."""
+
+    def __init__(self, program, idx, parent_idx=-1):
+        self.program = program
+        self.idx = idx
+        self.parent_idx = parent_idx
+        self.vars = collections.OrderedDict()
+        self.ops = []
+
+    @property
+    def parent_block(self):
+        if self.parent_idx < 0:
+            return None
+        return self.program.block(self.parent_idx)
+
+    def var(self, name):
+        v = self.vars.get(name)
+        if v is None:
+            raise ValueError("Variable %r not found in block %d" % (name, self.idx))
+        return v
+
+    def _var_recursive(self, name):
+        blk = self
+        while blk is not None:
+            if name in blk.vars:
+                return blk.vars[name]
+            blk = blk.parent_block
+        raise ValueError("Variable %r not found (recursive)" % name)
+
+    def has_var(self, name):
+        return name in self.vars
+
+    def all_parameters(self):
+        return [v for v in self.vars.values() if isinstance(v, Parameter)]
+
+    def create_var(self, *args, **kwargs):
+        return Variable(self, *args, **kwargs)
+
+    def create_variable(self, *args, **kwargs):
+        return Variable(self, *args, **kwargs)
+
+    def create_parameter(self, *args, **kwargs):
+        return Parameter(self, *args, **kwargs)
+
+    def append_op(self, type=None, inputs=None, outputs=None, attrs=None,
+                  infer_shape=True):
+        op = Operator(self, type=type, inputs=inputs, outputs=outputs, attrs=attrs)
+        self.ops.append(op)
+        self.program._bump_version()
+        if infer_shape:
+            try:
+                from . import lowering
+                lowering.infer_op_shapes(op)
+            except lowering.NoRuleError:
+                pass
+        return op
+
+    def _insert_op(self, index, **kwargs):
+        op = Operator(self, **kwargs)
+        self.ops.insert(index, op)
+        self.program._bump_version()
+        return op
+
+    def _remove_op(self, index):
+        del self.ops[index]
+        self.program._bump_version()
+
+    def _to_dict(self):
+        return dict(idx=self.idx, parent_idx=self.parent_idx,
+                    vars=[v._to_dict() for v in self.vars.values()],
+                    ops=[op._to_dict() for op in self.ops])
+
+
+class Program(object):
+    """A list of Blocks; the unit the Executor lowers and jits.
+
+    Reference framework.py:1339. `_version` is a mutation counter used as the
+    jit-cache fingerprint (any append/mutation invalidates compiled code).
+    """
+
+    def __init__(self):
+        self.blocks = [Block(self, 0)]
+        self.current_block_idx = 0
+        self.random_seed = 0
+        self._version = 0
+        self._seed_counter = 0
+
+    def _bump_version(self):
+        self._version += 1
+
+    def global_block(self):
+        return self.blocks[0]
+
+    def current_block(self):
+        return self.blocks[self.current_block_idx]
+
+    def block(self, idx):
+        return self.blocks[idx]
+
+    def create_block(self, parent_idx=None):
+        new_idx = len(self.blocks)
+        parent = self.current_block_idx if parent_idx is None else parent_idx
+        self.blocks.append(Block(self, new_idx, parent))
+        self.current_block_idx = new_idx
+        return self.current_block()
+
+    def rollback(self):
+        self.current_block_idx = self.blocks[self.current_block_idx].parent_idx
+
+    @property
+    def num_blocks(self):
+        return len(self.blocks)
+
+    def list_vars(self):
+        for blk in self.blocks:
+            for v in blk.vars.values():
+                yield v
+
+    def all_parameters(self):
+        return self.global_block().all_parameters()
+
+    def clone(self, for_test=False):
+        """Deep-copy the program. With for_test=True, prune backward/optimize
+        ops and flip is_test on dropout/batch_norm etc. (reference
+        Program.clone + inference_optimize)."""
+        p = Program()
+        p.random_seed = self.random_seed
+        p.blocks = []
+        var_maps = []
+        for blk in self.blocks:
+            nb = Block(p, blk.idx, blk.parent_idx)
+            p.blocks.append(nb)
+            vmap = {}
+            for v in blk.vars.values():
+                d = v._to_dict()
+                cls = d.pop('cls')
+                d.pop('name')
+                if cls == 'Parameter':
+                    d.pop('trainable', None)
+                    d.pop('optimize_attr', None)
+                    nv = Parameter(nb, name=v.name,
+                                   trainable=getattr(v, 'trainable', True),
+                                   optimize_attr=dict(v.optimize_attr),
+                                   regularizer=v.regularizer,
+                                   gradient_clip_attr=v.gradient_clip_attr,
+                                   do_model_average=v.do_model_average, **d)
+                else:
+                    nv = Variable(nb, name=v.name, **d)
+                vmap[v.name] = nv
+            var_maps.append(vmap)
+        for bi, blk in enumerate(self.blocks):
+            nb = p.blocks[bi]
+            vmap = var_maps[bi]
+
+            def lookup(name, bidx=bi):
+                b = p.blocks[bidx]
+                while b is not None:
+                    if name in b.vars:
+                        return b.vars[name]
+                    b = b.parent_block
+                return var_maps[bi][name]
+
+            for op in blk.ops:
+                role = op.attrs.get('op_role', ROLE_FORWARD)
+                if for_test and role in (ROLE_BACKWARD, ROLE_OPTIMIZE, ROLE_LRSCHED):
+                    continue
+                ins = {k: [lookup(v.name) for v in vs] for k, vs in op.inputs.items()}
+                outs = {k: [lookup(v.name) for v in vs] for k, vs in op.outputs.items()}
+                attrs = copy.deepcopy(op.attrs)
+                if for_test and 'is_test' in attrs:
+                    attrs['is_test'] = True
+                nb.append_op(type=op.type, inputs=ins, outputs=outs, attrs=attrs,
+                             infer_shape=False)
+        p.current_block_idx = 0
+        p._bump_version()
+        return p
+
+    def inference_optimize(self):
+        return self.clone(for_test=True)
+
+    def prune(self, targets):
+        """Backward-slice the program to the ops needed to compute
+        `targets` (reference Program.prune / C++ framework/prune.cc)."""
+        if not isinstance(targets, (list, tuple)):
+            targets = [targets]
+        needed = {t.name if isinstance(t, Variable) else str(t)
+                  for t in targets}
+        p = self.clone(for_test=False)
+        blk = p.global_block()
+        keep = []
+        for op in reversed(blk.ops):
+            out_names = set(op.output_arg_names)
+            if out_names & needed:
+                keep.append(op)
+                needed |= set(op.input_arg_names)
+        keep.reverse()
+        blk.ops = keep
+        p._bump_version()
+        return p
+
+    def to_string(self, throw_on_error=False, with_details=False):
+        lines = []
+        for blk in self.blocks:
+            lines.append("-- block %d (parent %d) --" % (blk.idx, blk.parent_idx))
+            for v in blk.vars.values():
+                lines.append("    " + repr(v))
+            for op in blk.ops:
+                lines.append("  " + repr(op))
+        return "\n".join(lines)
+
+    __str__ = to_string
+    __repr__ = to_string
+
+    # -- serialization (reference: ProgramDesc protobuf round-trip) --
+    def _to_dict(self):
+        return dict(random_seed=self.random_seed,
+                    blocks=[b._to_dict() for b in self.blocks])
+
+    @staticmethod
+    def _from_dict(d):
+        p = Program()
+        p.random_seed = d.get('random_seed', 0)
+        p.blocks = []
+        for bd in d['blocks']:
+            blk = Block(p, bd['idx'], bd['parent_idx'])
+            p.blocks.append(blk)
+            for vd in bd['vars']:
+                vd = dict(vd)
+                cls = vd.pop('cls', 'Variable')
+                name = vd.pop('name')
+                if cls == 'Parameter':
+                    vd.pop('optimize_attr', None)
+                    Parameter(blk, name=name, **vd)
+                else:
+                    Variable(blk, name=name, **vd)
+        for bd in d['blocks']:
+            blk = p.blocks[bd['idx']]
+            for od in bd['ops']:
+                ins = {k: [blk._var_recursive(n) for n in vs]
+                       for k, vs in od['inputs'].items()}
+                outs = {k: [blk._var_recursive(n) for n in vs]
+                        for k, vs in od['outputs'].items()}
+                blk.append_op(type=od['type'], inputs=ins, outputs=outs,
+                              attrs=od['attrs'], infer_shape=False)
+        p._bump_version()
+        return p
+
+
+_main_program_ = Program()
+_startup_program_ = Program()
+
+
+def default_startup_program():
+    return _startup_program_
+
+
+def default_main_program():
+    return _main_program_
+
+
+def switch_main_program(program):
+    global _main_program_
+    prev = _main_program_
+    _main_program_ = program
+    return prev
+
+
+def switch_startup_program(program):
+    global _startup_program_
+    prev = _startup_program_
+    _startup_program_ = program
+    return prev
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    prev_main = switch_main_program(main_program)
+    prev_start = None
+    if startup_program is not None:
+        prev_start = switch_startup_program(startup_program)
+    try:
+        yield
+    finally:
+        switch_main_program(prev_main)
+        if prev_start is not None:
+            switch_startup_program(prev_start)
+
+
+_name_scope_stack = []
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    _name_scope_stack.append(prefix or '')
+    try:
+        yield
+    finally:
+        _name_scope_stack.pop()
+
+
+def get_var(name, program=None):
+    if program is None:
+        program = default_main_program()
+    return program.global_block()._var_recursive(name)

@@ -1,0 +1,184 @@
+"""Model persistence. Parity: reference python/paddle/fluid/io.py.
+
+The reference saves each var through C++ save/load ops into separate files
+(or one combined file). Here persistence is host-side: params come out of
+the Scope as numpy arrays into an .npz (portable) and programs serialize to
+JSON (framework.Program._to_dict) — the TPU equivalent of ProgramDesc
+protobuf + LoDTensor files. Orbax-backed sharded checkpointing for large
+multi-host models lives in paddle_tpu.utils.checkpoint.
+"""
+import json
+import os
+
+import numpy as np
+
+from .framework import Program, Parameter, Variable, default_main_program
+from .executor import global_scope
+
+__all__ = [
+    'save_vars', 'save_params', 'save_persistables', 'load_vars',
+    'load_params', 'load_persistables', 'save_inference_model',
+    'load_inference_model', 'get_inference_program',
+    'save_checkpoint', 'load_checkpoint',
+]
+
+_PARAMS_FILE = '__params__.npz'
+_PROGRAM_FILE = '__model__.json'
+
+
+def is_parameter(var):
+    return isinstance(var, Parameter)
+
+
+def is_persistable(var):
+    return var.persistable
+
+
+def _save_var_file(dirname, filename, arrays):
+    os.makedirs(dirname, exist_ok=True)
+    path = os.path.join(dirname, filename or _PARAMS_FILE)
+    np.savez(path, **arrays)
+    if not path.endswith('.npz'):
+        os.replace(path + '.npz', path)
+    return path
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """reference io.py:save_vars."""
+    if vars is None:
+        if main_program is None:
+            main_program = default_main_program()
+        vars = list(filter(predicate, main_program.list_vars()))
+    scope = global_scope()
+    arrays = {}
+    for var in vars:
+        name = var.name if isinstance(var, Variable) else str(var)
+        v = scope.vars.get(name)
+        if v is None:
+            raise RuntimeError("variable %s is not initialized in scope" % name)
+        from .lowering import SeqValue
+        if isinstance(v, SeqValue):
+            arrays[name] = np.asarray(v.data)
+        else:
+            arrays[name] = np.asarray(v)
+    if filename is None:
+        filename = _PARAMS_FILE
+    return _save_var_file(dirname, filename, arrays)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program, None, is_parameter,
+                     filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program, None, is_persistable,
+                     filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """reference io.py:load_vars."""
+    if vars is None:
+        if main_program is None:
+            main_program = default_main_program()
+        vars = list(filter(predicate, main_program.list_vars()))
+    import jax.numpy as jnp
+    path = os.path.join(dirname, filename or _PARAMS_FILE)
+    data = np.load(path)
+    scope = global_scope()
+    for var in vars:
+        name = var.name if isinstance(var, Variable) else str(var)
+        if name not in data:
+            raise RuntimeError("variable %s not found in %s" % (name, path))
+        scope.vars[name] = jnp.asarray(data[name])
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, None, is_parameter, filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, None, is_persistable, filename)
+
+
+def get_inference_program(target_vars, main_program=None):
+    if main_program is None:
+        main_program = default_main_program()
+    if not isinstance(target_vars, list):
+        target_vars = [target_vars]
+    return main_program.clone(for_test=True)
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, export_for_deployment=True):
+    """reference io.py:save_inference_model: prunes to inference graph and
+    saves program + params. Also exports StableHLO when possible
+    (paddle_tpu.inference)."""
+    if isinstance(feeded_var_names, str):
+        feeded_var_names = [feeded_var_names]
+    if not isinstance(target_vars, list):
+        target_vars = [target_vars]
+    if main_program is None:
+        main_program = default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    inference_program = main_program.clone(for_test=True).prune(target_vars)
+    meta = {
+        'program': inference_program._to_dict(),
+        'feed_names': list(feeded_var_names),
+        'fetch_names': [v.name if isinstance(v, Variable) else str(v)
+                        for v in target_vars],
+    }
+    with open(os.path.join(dirname, model_filename or _PROGRAM_FILE), 'w') as f:
+        json.dump(meta, f)
+    save_persistables(executor, dirname, inference_program, params_filename)
+    return None
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    """reference io.py:load_inference_model -> (program, feed_names,
+    fetch_vars)."""
+    with open(os.path.join(dirname, model_filename or _PROGRAM_FILE)) as f:
+        meta = json.load(f)
+    program = Program._from_dict(meta['program'])
+    load_persistables(executor, dirname, program, params_filename)
+    fetch_vars = [program.global_block()._var_recursive(n)
+                  for n in meta['fetch_names']]
+    return [program, meta['feed_names'], fetch_vars]
+
+
+def save_checkpoint(executor, checkpoint_dir, trainer_id=0, main_program=None,
+                    step=0, max_num_checkpoints=3):
+    """Failure-recovery checkpoint: persistables + step counter (reference
+    io.py checkpoint utilities / trainer.py)."""
+    serial_dir = os.path.join(checkpoint_dir, 'checkpoint_%d' % step)
+    save_persistables(executor, serial_dir, main_program)
+    with open(os.path.join(serial_dir, 'meta.json'), 'w') as f:
+        json.dump({'step': step, 'trainer_id': trainer_id}, f)
+    # prune old checkpoints
+    kept = sorted(
+        (d for d in os.listdir(checkpoint_dir) if d.startswith('checkpoint_')),
+        key=lambda d: int(d.split('_')[1]))
+    for d in kept[:-max_num_checkpoints]:
+        import shutil
+        shutil.rmtree(os.path.join(checkpoint_dir, d), ignore_errors=True)
+    return serial_dir
+
+
+def load_checkpoint(executor, checkpoint_dir, serial=None, main_program=None):
+    if serial is None:
+        cands = sorted(
+            (d for d in os.listdir(checkpoint_dir)
+             if d.startswith('checkpoint_')),
+            key=lambda d: int(d.split('_')[1]))
+        if not cands:
+            raise RuntimeError("no checkpoints in %s" % checkpoint_dir)
+        serial_dir = os.path.join(checkpoint_dir, cands[-1])
+    else:
+        serial_dir = os.path.join(checkpoint_dir, 'checkpoint_%d' % serial)
+    load_persistables(executor, serial_dir, main_program)
+    with open(os.path.join(serial_dir, 'meta.json')) as f:
+        return json.load(f)

@@ -1,0 +1,179 @@
+"""Control-flow layers.
+
+Parity: reference layers/control_flow.py (While/Switch/IfElse/StaticRNN/
+DynamicRNN/arrays/Print). The reference runs sub-blocks through C++
+WhileOp/ConditionalBlockOp interpreters; TPU-first these must become
+lax.while_loop / lax.cond / lax.scan. Round 1 ships the leaf primitives
+(increment/compare/array ops/Print) plus scalar helpers; the block-structured
+While/IfElse/StaticRNN/DynamicRNN lower via sub-block tracing in a later
+round (recurrent models use the fused lstm/gru scan ops meanwhile).
+"""
+from ..framework import Variable, default_main_program
+from ..layer_helper import LayerHelper
+from . import tensor as tensor_mod
+
+__all__ = [
+    'While', 'Switch', 'increment', 'array_write', 'create_array',
+    'less_than', 'equal', 'array_read', 'array_length', 'IfElse',
+    'DynamicRNN', 'StaticRNN', 'reorder_lod_tensor_by_rank', 'ParallelDo',
+    'Print', 'is_empty',
+]
+
+
+def increment(x, value=1.0, in_place=True):
+    helper = LayerHelper("increment", **locals())
+    if in_place:
+        out = x
+    else:
+        out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type='increment', inputs={'X': [x]}, outputs={'Out': [out]},
+                     attrs={'step': float(value)}, infer_shape=False)
+    return out
+
+
+def less_than(x, y, force_cpu=None, cond=None):
+    helper = LayerHelper("less_than", **locals())
+    if cond is None:
+        cond = helper.create_variable_for_type_inference(dtype='bool')
+        cond.stop_gradient = True
+    helper.append_op(type='less_than', inputs={'X': [x], 'Y': [y]},
+                     outputs={'Out': [cond]})
+    return cond
+
+
+def equal(x, y, cond=None):
+    helper = LayerHelper("equal", **locals())
+    if cond is None:
+        cond = helper.create_variable_for_type_inference(dtype='bool')
+        cond.stop_gradient = True
+    helper.append_op(type='equal', inputs={'X': [x], 'Y': [y]},
+                     outputs={'Out': [cond]})
+    return cond
+
+
+def min_(x, y):
+    from .ops import elementwise_min
+    return elementwise_min(x, y)
+
+
+def max_(x, y):
+    from .ops import elementwise_max
+    return elementwise_max(x, y)
+
+
+def is_empty(x, cond=None):
+    helper = LayerHelper("is_empty", **locals())
+    if cond is None:
+        cond = helper.create_variable_for_type_inference(dtype='bool')
+        cond.stop_gradient = True
+    helper.append_op(type='is_empty', inputs={'X': [x]}, outputs={'Out': [cond]})
+    return cond
+
+
+def Print(input, first_n=-1, message=None, summarize=-1,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase='both'):
+    helper = LayerHelper('print', **locals())
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type='print', inputs={'In': [input]},
+                     outputs={'Out': [out]},
+                     attrs={'first_n': first_n, 'summarize': summarize,
+                            'message': message or '',
+                            'print_phase': print_phase})
+    return out
+
+
+# ---- LoDTensorArray emulation ------------------------------------------
+# The reference implements arrays as C++ LoDTensorArray vars manipulated by
+# array_write/array_read ops inside While blocks. Python-side list semantics
+# are enough for the graph-building uses (beam search decode etc.): the
+# array var carries a python list of Variables; reads/writes are resolved at
+# build time when the index is a constant, which covers the book usages.
+
+class _ArrayVar(object):
+    def __init__(self, dtype):
+        self.dtype = dtype
+        self.items = []
+
+
+def create_array(dtype):
+    return _ArrayVar(dtype)
+
+
+def array_write(x, i, array=None):
+    if array is None:
+        array = create_array(x.dtype)
+    array.items.append(x)
+    return array
+
+
+def array_read(array, i):
+    # constant-index read (resolved at graph-build time)
+    if isinstance(i, int):
+        return array.items[i]
+    import numpy as np
+    try:
+        idx = int(np.asarray(i))
+    except Exception:
+        raise NotImplementedError(
+            "array_read with a runtime (Variable) index needs the sub-block "
+            "control-flow lowering; only build-time-constant indices are "
+            "supported so far")
+    return array.items[idx]
+
+
+def array_length(array):
+    return tensor_mod.fill_constant(shape=[1], dtype='int64',
+                                    value=len(array.items))
+
+
+class While(object):
+    """Reference layers/control_flow.py:While. Full sub-block lowering to
+    lax.while_loop lands with the control-flow milestone; constructing it
+    today raises with guidance to use the scan-based recurrent layers."""
+
+    def __init__(self, cond, is_test=False, name=None):
+        raise NotImplementedError(
+            "While: structured control flow lowers to lax.while_loop in the "
+            "control-flow milestone; use dynamic_lstm/dynamic_gru (lax.scan) "
+            "for recurrence meanwhile")
+
+    class Block(object):
+        pass
+
+
+class Switch(object):
+    def __init__(self, name=None):
+        raise NotImplementedError("Switch: see While — pending sub-block lowering")
+
+
+class IfElse(object):
+    def __init__(self, cond, name=None):
+        raise NotImplementedError("IfElse: see While — pending sub-block lowering")
+
+
+class StaticRNN(object):
+    def __init__(self, name=None):
+        raise NotImplementedError(
+            "StaticRNN: pending sub-block lowering; use the fused lstm/gru "
+            "scan ops (layers.dynamic_lstm/dynamic_gru)")
+
+
+class DynamicRNN(object):
+    def __init__(self, name=None):
+        raise NotImplementedError(
+            "DynamicRNN: pending sub-block lowering; use the fused lstm/gru "
+            "scan ops (layers.dynamic_lstm/dynamic_gru)")
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    raise NotImplementedError(
+        "reorder_lod_tensor_by_rank: dense-padded sequences don't need rank "
+        "reordering on TPU (no per-sequence batch shrinking)")
+
+
+def ParallelDo(*args, **kwargs):
+    raise NotImplementedError(
+        "ParallelDo was deprecated in the reference; use ParallelExecutor "
+        "(GSPMD data parallelism) instead")

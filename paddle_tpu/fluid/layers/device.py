@@ -1,0 +1,11 @@
+"""Device placement helpers. Parity: reference layers/device.py (get_places).
+On TPU, placement is expressed through the mesh (parallel_executor /
+paddle_tpu.parallel), so this is a thin shim.
+"""
+__all__ = []
+
+
+def get_places(device_count=None, device_type=None):
+    import jax
+    n = device_count or len(jax.devices())
+    return list(range(n))

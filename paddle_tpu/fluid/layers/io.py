@@ -1,0 +1,190 @@
+"""IO layers. Parity: reference layers/io.py."""
+from ..framework import default_main_program, default_startup_program
+from ..layer_helper import LayerHelper
+from ..core import convert_dtype
+
+__all__ = ['data', 'open_recordio_file', 'open_files', 'read_file',
+           'shuffle', 'batch', 'double_buffer', 'random_data_generator',
+           'py_reader', 'Preprocessor', 'load']
+
+
+def data(name, shape, append_batch_size=True, dtype='float32', lod_level=0,
+         type=None, stop_gradient=True):
+    """reference layers/io.py:data."""
+    helper = LayerHelper('data', name=name)
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    else:
+        # reference: interpret explicit -1 dims as dynamic already
+        pass
+    return helper.create_global_variable(
+        name=name, shape=shape, dtype=convert_dtype(dtype),
+        lod_level=lod_level, stop_gradient=stop_gradient, is_data=True)
+
+
+class _PyReader(object):
+    """Host-side python reader bound to feed targets (replaces the
+    reference's C++ reader op chain: open_files -> double_buffer -> read).
+    The heavy lifting (threaded prefetch, device staging) lives in
+    paddle_tpu.reader.pipeline."""
+
+    def __init__(self, feed_list=None, capacity=64, shapes=None, dtypes=None,
+                 lod_levels=None, name=None):
+        self.feed_list = feed_list
+        self.capacity = capacity
+        self.shapes = shapes
+        self.dtypes = dtypes
+        self.lod_levels = lod_levels
+        self._gen = None
+        self._vars = None
+        if shapes is not None:
+            self._vars = []
+            for i, (s, d) in enumerate(zip(shapes, dtypes)):
+                lod = (lod_levels or [0] * len(shapes))[i]
+                self._vars.append(data(
+                    name='%s_slot_%d' % (name or 'py_reader', i),
+                    shape=list(s)[1:], dtype=d, lod_level=lod))
+
+    def decorate_paddle_reader(self, reader):
+        self._gen = reader
+
+    decorate_tensor_provider = decorate_paddle_reader
+    decorate_batch_generator = decorate_paddle_reader
+    decorate_sample_list_generator = decorate_paddle_reader
+
+    def start(self):
+        self._iter = self._gen()
+
+    def reset(self):
+        self._iter = None
+
+    def next(self):
+        return next(self._iter)
+
+    def __call__(self):
+        return self._gen()
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """reference layers/io.py:py_reader."""
+    return _PyReader(capacity=capacity, shapes=shapes, dtypes=dtypes,
+                     lod_levels=lod_levels, name=name)
+
+
+def read_file(reader):
+    if isinstance(reader, _PyReader) and reader._vars is not None:
+        return reader._vars
+    raise TypeError("read_file expects a py_reader with declared shapes")
+
+
+def open_recordio_file(filename, shapes, lod_levels, dtypes,
+                       pass_num=1, for_parallel=True):
+    """Chunked record file reader (reference layers/io.py:open_recordio_file);
+    backed by paddle_tpu.reader.recordio."""
+    from ...reader import recordio as rio
+
+    def gen():
+        for _ in range(pass_num):
+            for sample in rio.read_samples(filename, shapes, dtypes):
+                yield sample
+
+    r = _PyReader(shapes=shapes, dtypes=dtypes, lod_levels=lod_levels)
+    r.decorate_paddle_reader(gen)
+    return r
+
+
+def open_files(filenames, shapes, lod_levels, dtypes, thread_num=1,
+               buffer_size=None, pass_num=1, for_parallel=True):
+    from ...reader import recordio as rio
+
+    def gen():
+        for _ in range(pass_num):
+            for fn in filenames:
+                for sample in rio.read_samples(fn, shapes, dtypes):
+                    yield sample
+
+    r = _PyReader(shapes=shapes, dtypes=dtypes, lod_levels=lod_levels)
+    r.decorate_paddle_reader(gen)
+    return r
+
+
+def shuffle(reader, buffer_size):
+    from ... import reader as reader_mod
+    if isinstance(reader, _PyReader):
+        inner = reader._gen
+        reader._gen = reader_mod.shuffle(inner, buffer_size)
+        return reader
+    return reader_mod.shuffle(reader, buffer_size)
+
+
+def batch(reader, batch_size):
+    from ...batch import batch as _batch
+    if isinstance(reader, _PyReader):
+        inner = reader._gen
+        reader._gen = _batch(inner, batch_size)
+        return reader
+    return _batch(reader, batch_size)
+
+
+def double_buffer(reader, place=None, name=None):
+    """Host->device double buffering; wraps the reader with a background
+    prefetch thread (reference layers/io.py:double_buffer)."""
+    from ...reader.pipeline import prefetch
+    if isinstance(reader, _PyReader):
+        inner = reader._gen
+        reader._gen = prefetch(inner, depth=2)
+        return reader
+    return prefetch(reader, depth=2)
+
+
+def random_data_generator(low, high, shapes, lod_levels, for_parallel=True):
+    import numpy as np
+
+    def gen():
+        while True:
+            yield tuple(
+                np.random.uniform(low, high, size=s).astype('float32')
+                for s in shapes)
+
+    r = _PyReader(shapes=shapes,
+                  dtypes=['float32'] * len(shapes),
+                  lod_levels=lod_levels)
+    r.decorate_paddle_reader(gen)
+    return r
+
+
+class Preprocessor(object):
+    """reference layers/io.py:Preprocessor — user-defined preprocessing over
+    a reader's slots; host-side here."""
+
+    def __init__(self, reader, name=None):
+        self.reader = reader
+        self.sub_program = None
+        self._inputs = None
+        self._outputs = None
+
+    def block(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _blk():
+            yield self
+        return _blk()
+
+    def inputs(self):
+        return read_file(self.reader)
+
+    def outputs(self, *outs):
+        self._outputs = outs
+
+
+def load(out, file_path, load_as_fp16=None):
+    """Load one tensor from file into var (reference layers/io.py:load)."""
+    import numpy as np
+    from ..executor import global_scope
+    import jax.numpy as jnp
+    arr = np.load(file_path + '.npy') if not file_path.endswith('.npy') else np.load(file_path)
+    global_scope().vars[out.name] = jnp.asarray(arr)
+    return out

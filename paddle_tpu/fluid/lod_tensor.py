@@ -1,0 +1,117 @@
+"""LoDTensor: host-side ragged tensor with recursive sequence lengths.
+
+Parity: reference python/paddle/fluid/lod_tensor.py +
+paddle/fluid/framework/lod_tensor.h. The reference stores sequences
+flattened [total_tokens, d] plus a level-of-detail offset table; on device
+we use the TPU-friendly dense-padded SeqValue (see lowering.py) and this
+class converts between the two at the host boundary.
+"""
+import numpy as np
+
+__all__ = ['LoDTensor', 'create_lod_tensor', 'create_random_int_lodtensor']
+
+
+def _lengths_to_offsets(lengths):
+    out = [0]
+    for l in lengths:
+        out.append(out[-1] + l)
+    return out
+
+
+class LoDTensor(object):
+    def __init__(self, data=None, recursive_seq_lens=None):
+        self.data = None if data is None else np.asarray(data)
+        self._lengths = recursive_seq_lens or []
+
+    # -- reference API --
+    def set(self, data, place=None):
+        self.data = np.asarray(data)
+
+    def set_recursive_sequence_lengths(self, lengths):
+        self._lengths = lengths
+
+    def recursive_sequence_lengths(self):
+        return self._lengths
+
+    def set_lod(self, lod):
+        self._lengths = [list(np.diff(level)) for level in lod]
+
+    def lod(self):
+        return [_lengths_to_offsets(level) for level in self._lengths]
+
+    def has_valid_recursive_sequence_lengths(self):
+        if not self._lengths:
+            return True
+        total = sum(self._lengths[-1])
+        return total == (self.data.shape[0] if self.data is not None else 0)
+
+    def __array__(self, dtype=None):
+        a = self.data
+        return a.astype(dtype) if dtype is not None else a
+
+    def shape(self):
+        return list(self.data.shape)
+
+    # -- device conversion: flattened+lod <-> dense padded SeqValue --
+    def to_seq_value(self, pad_to=None):
+        from .lowering import SeqValue
+        import jax.numpy as jnp
+        if not self._lengths:
+            return jnp.asarray(self.data)
+        lens = np.asarray(self._lengths[-1], dtype=np.int32)
+        b = len(lens)
+        maxlen = int(lens.max()) if b else 0
+        if pad_to:
+            maxlen = pad_to
+        trail = self.data.shape[1:]
+        padded = np.zeros((b, maxlen) + tuple(trail), dtype=self.data.dtype)
+        off = 0
+        for i, l in enumerate(lens):
+            padded[i, :l] = self.data[off:off + l]
+            off += l
+        outer = None
+        if len(self._lengths) > 1:
+            outer = jnp.asarray(np.asarray(self._lengths[0], np.int32))
+        return SeqValue(jnp.asarray(padded), jnp.asarray(lens), outer)
+
+    @staticmethod
+    def from_seq_value(sv):
+        data = np.asarray(sv.data)
+        lens = np.asarray(sv.lengths)
+        rows = []
+        for i, l in enumerate(lens):
+            rows.append(data[i, :int(l)])
+        flat = np.concatenate(rows, axis=0) if rows else data.reshape((0,) + data.shape[2:])
+        lengths = [list(int(l) for l in lens)]
+        if sv.outer_lengths is not None:
+            lengths = [list(int(l) for l in np.asarray(sv.outer_lengths))] + lengths
+        return LoDTensor(flat, lengths)
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    """reference python/paddle/fluid/lod_tensor.py:create_lod_tensor."""
+    if isinstance(data, list):
+        # list of sequences (possibly nested); flatten
+        flat = []
+        lens = []
+        for seq in data:
+            seq = np.asarray(seq)
+            if seq.ndim == 1:
+                seq = seq[:, None]
+            lens.append(seq.shape[0])
+            flat.append(seq)
+        arr = np.concatenate(flat, axis=0)
+        return LoDTensor(arr, [lens])
+    arr = np.asarray(data)
+    t = LoDTensor(arr, recursive_seq_lens)
+    if not t.has_valid_recursive_sequence_lengths():
+        raise ValueError("invalid recursive_seq_lens %s for data of %d rows"
+                         % (recursive_seq_lens, arr.shape[0]))
+    return t
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place, low, high):
+    total = sum(recursive_seq_lens[-1])
+    shape = [total] + list(base_shape)
+    data = np.random.randint(low, high + 1, size=shape).astype('int64')
+    return LoDTensor(data, recursive_seq_lens)

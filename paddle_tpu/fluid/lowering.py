@@ -1,0 +1,202 @@
+"""Op lowering registry: Fluid op symbols -> JAX.
+
+TPU-first replacement for the reference's per-op C++/CUDA kernel registry
+(paddle/fluid/framework/op_registry.h + operators/*_op.cu). Instead of a
+kernel per (op, Place, dtype), each op type has ONE pure-JAX rule. The
+Executor symbolically evaluates a whole Program through these rules inside a
+single jax.jit trace, so XLA sees the entire training step as one module and
+fuses across op boundaries (the reference pays a kernel launch per op).
+
+The same rules power build-time shape inference via jax.eval_shape
+(framework.Block.append_op), so op semantics are defined exactly once.
+"""
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .framework import DYN_DIM
+
+_RULES = {}
+
+
+class NoRuleError(KeyError):
+    pass
+
+
+def register(op_type):
+    def deco(fn):
+        _RULES[op_type] = fn
+        return fn
+    return deco
+
+
+def get_rule(op_type):
+    try:
+        return _RULES[op_type]
+    except KeyError:
+        raise NoRuleError("no lowering rule for op %r" % op_type)
+
+
+def has_rule(op_type):
+    return op_type in _RULES
+
+
+class Ctx(object):
+    """Per-op lowering context: PRNG key and run mode."""
+
+    __slots__ = ('key', 'op_index', 'is_test')
+
+    def __init__(self, key, op_index=0, is_test=False):
+        self.key = key
+        self.op_index = op_index
+        self.is_test = is_test
+
+    def rng(self):
+        return jax.random.fold_in(self.key, self.op_index)
+
+
+class SeqValue(object):
+    """Runtime value of a lod_level>0 Variable: dense padded data + lengths.
+
+    TPU-first replacement for LoDTensor's flattened [total_tokens, d] layout
+    (reference paddle/fluid/framework/lod_tensor.h): static shapes
+    [batch, max_len, ...] keep XLA happy; `lengths` int32[batch] carries the
+    ragged structure; masked ops consult it. Nested LoD (level 2) keeps the
+    outer lengths in `outer_lengths`.
+    """
+
+    __slots__ = ('data', 'lengths', 'outer_lengths')
+
+    def __init__(self, data, lengths, outer_lengths=None):
+        self.data = data
+        self.lengths = lengths
+        self.outer_lengths = outer_lengths
+
+    @property
+    def max_len(self):
+        return self.data.shape[1]
+
+    def mask(self, dtype=jnp.float32):
+        """[batch, max_len] validity mask."""
+        t = self.data.shape[1]
+        return (jnp.arange(t)[None, :] < self.lengths[:, None]).astype(dtype)
+
+    def tree_flatten(self):
+        if self.outer_lengths is None:
+            return (self.data, self.lengths), False
+        return (self.data, self.lengths, self.outer_lengths), True
+
+    @classmethod
+    def tree_unflatten(cls, has_outer, children):
+        if has_outer:
+            return cls(children[0], children[1], children[2])
+        return cls(children[0], children[1])
+
+
+jax.tree_util.register_pytree_node(
+    SeqValue,
+    lambda s: s.tree_flatten(),
+    lambda aux, ch: SeqValue.tree_unflatten(aux, ch))
+
+
+def data_of(v):
+    return v.data if isinstance(v, SeqValue) else v
+
+
+def like(template, new_data):
+    """Wrap new_data with template's sequence structure (if any)."""
+    if isinstance(template, SeqValue):
+        return SeqValue(new_data, template.lengths, template.outer_lengths)
+    return new_data
+
+
+def first_seq(*vals):
+    for v in vals:
+        if isinstance(v, SeqValue):
+            return v
+    return None
+
+
+def run_op(op, env, ctx):
+    """Resolve an op's inputs from env, apply its rule, bind outputs."""
+    rule = get_rule(op.type)
+    ins = {slot: [env[v.name] for v in vs] for slot, vs in op.inputs.items()}
+    outs = rule(ins, op.attrs, ctx)
+    _bind_outputs(op, outs, env)
+
+
+def _bind_outputs(op, outs, env):
+    for slot, vs in op.outputs.items():
+        vals = outs.get(slot)
+        if vals is None:
+            continue
+        if not isinstance(vals, (list, tuple)):
+            vals = [vals]
+        for var, val in zip(vs, vals):
+            if val is not None:
+                env[var.name] = val
+
+
+def infer_op_shapes(op):
+    """Build-time shape/dtype inference by abstract-evaluating the rule.
+
+    The dynamic batch dim (-1) is stood in by DYN_DIM and mapped back; this
+    replaces the reference's per-op C++ InferShape functions.
+    """
+    rule = get_rule(op.type)
+
+    def spec_of(var):
+        if var.shape is None:
+            return None
+        s = var._spec()
+        if var.lod_level and var.lod_level > 0:
+            batch = s.shape[0]
+            lens = jax.ShapeDtypeStruct((batch,), np.int32)
+            if var.lod_level > 1:
+                return SeqValue(s, lens, jax.ShapeDtypeStruct((batch,), np.int32))
+            return SeqValue(s, lens)
+        return s
+
+    ins = {slot: [spec_of(v) for v in vs] for slot, vs in op.inputs.items()}
+
+    def f():
+        key = jax.random.key(0)
+        ctx = Ctx(key, op_index=0, is_test=bool(op.attrs.get('is_test', False)))
+        concrete_ins = {
+            slot: [jnp.zeros(s.data.shape, s.data.dtype) if isinstance(s, SeqValue)
+                   else (jnp.zeros(s.shape, s.dtype) if s is not None else None)
+                   for s in vs]
+            for slot, vs in ins.items()}
+        # re-wrap SeqValues
+        for slot, vs in ins.items():
+            for i, s in enumerate(vs):
+                if isinstance(s, SeqValue):
+                    concrete_ins[slot][i] = SeqValue(
+                        concrete_ins[slot][i],
+                        jnp.ones(s.lengths.shape, s.lengths.dtype))
+        return rule(concrete_ins, op.attrs, ctx)
+
+    try:
+        outs = jax.eval_shape(f)
+    except Exception:
+        return  # shape inference is best-effort at build time
+
+    for slot, vs in op.outputs.items():
+        vals = outs.get(slot)
+        if vals is None:
+            continue
+        if not isinstance(vals, (list, tuple)):
+            vals = [vals]
+        for var, val in zip(vs, vals):
+            if val is None:
+                continue
+            spec = val.data if isinstance(val, SeqValue) else val
+            shape = tuple(-1 if d == DYN_DIM else int(d) for d in spec.shape)
+            var.shape = shape
+            from . import core
+            var.dtype = core.convert_dtype(spec.dtype)
+            if isinstance(val, SeqValue) and var.lod_level == 0:
+                var.lod_level = 1

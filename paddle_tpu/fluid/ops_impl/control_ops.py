@@ -1,0 +1,28 @@
+"""Control-flow & debug rules.
+
+Parity: reference paddle/fluid/operators/{while,conditional_block,print,...}_op.cc.
+Structured control flow (While/IfElse/StaticRNN) is handled by the layers in
+layers/control_flow.py which lower their sub-blocks through lax.while_loop /
+lax.cond / lax.scan; the ops here are the leaf primitives.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..lowering import register, data_of, like
+
+
+@register('print')
+def _print(ins, attrs, ctx):
+    x = ins['In'][0]
+    msg = attrs.get('message', '')
+    jax.debug.print(msg + " {x}", x=data_of(x))
+    return {'Out': x}
+
+
+@register('isfinite')
+def _isfinite(ins, attrs, ctx):
+    xs = [data_of(v) for v in ins['X']]
+    ok = jnp.asarray(True)
+    for x in xs:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(x)))
+    return {'Out': ok}

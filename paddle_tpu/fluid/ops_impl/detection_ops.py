@@ -1,0 +1,102 @@
+"""Detection (SSD family) rules.
+
+Parity: reference paddle/fluid/operators/detection/*. Implemented as masked
+dense JAX; the handful that are inherently host-side dynamic (NMS output
+lists) return fixed-size padded results with validity counts.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..lowering import register, data_of
+
+
+@register('prior_box')
+def _prior_box(ins, attrs, ctx):
+    """reference operators/detection/prior_box_op.cc."""
+    feat = data_of(ins['Input'][0])  # NCHW feature map
+    img = data_of(ins['Image'][0])
+    min_sizes = list(attrs['min_sizes'])
+    max_sizes = list(attrs.get('max_sizes', []) or [])
+    ars = list(attrs.get('aspect_ratios', [1.0]))
+    flip = attrs.get('flip', False)
+    variances = list(attrs.get('variances', [0.1, 0.1, 0.2, 0.2]))
+    clip = attrs.get('clip', False)
+    step_w = attrs.get('step_w', 0.0)
+    step_h = attrs.get('step_h', 0.0)
+    offset = attrs.get('offset', 0.5)
+
+    full_ars = [1.0]
+    for ar in ars:
+        if abs(ar - 1.0) < 1e-6:
+            continue
+        full_ars.append(ar)
+        if flip:
+            full_ars.append(1.0 / ar)
+
+    fh, fw = feat.shape[2], feat.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    sw = step_w if step_w > 0 else iw / fw
+    sh = step_h if step_h > 0 else ih / fh
+
+    boxes = []
+    for ms in min_sizes:
+        for ar in full_ars:
+            bw = ms * np.sqrt(ar) / 2.0
+            bh = ms / np.sqrt(ar) / 2.0
+            boxes.append((bw, bh))
+        if max_sizes:
+            mx = max_sizes[min_sizes.index(ms)]
+            s = np.sqrt(ms * mx) / 2.0
+            boxes.append((s, s))
+    num_priors = len(boxes)
+    cx = (jnp.arange(fw) + offset) * sw
+    cy = (jnp.arange(fh) + offset) * sh
+    cxg, cyg = jnp.meshgrid(cx, cy)  # [fh, fw]
+    out = []
+    for bw, bh in boxes:
+        b = jnp.stack([(cxg - bw) / iw, (cyg - bh) / ih,
+                       (cxg + bw) / iw, (cyg + bh) / ih], axis=-1)
+        out.append(b)
+    out = jnp.stack(out, axis=2)  # [fh, fw, np, 4]
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           out.shape[:-1] + (4,))
+    return {'Boxes': out, 'Variances': var}
+
+
+@register('box_coder')
+def _box_coder(ins, attrs, ctx):
+    """reference operators/detection/box_coder_op.cc (decode_center_size)."""
+    prior = data_of(ins['PriorBox'][0])  # [M, 4]
+    pvar = data_of(ins['PriorBoxVar'][0]) if ins.get('PriorBoxVar') else None
+    target = data_of(ins['TargetBox'][0])
+    code_type = attrs.get('code_type', 'decode_center_size')
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = prior[:, 0] + 0.5 * pw
+    pcy = prior[:, 1] + 0.5 * ph
+    if pvar is None:
+        pvar = jnp.ones_like(prior)
+    if 'decode' in code_type:
+        # target: [N, M, 4]
+        tcx = pvar[..., 0] * target[..., 0] * pw + pcx
+        tcy = pvar[..., 1] * target[..., 1] * ph + pcy
+        tw = jnp.exp(pvar[..., 2] * target[..., 2]) * pw
+        th = jnp.exp(pvar[..., 3] * target[..., 3]) * ph
+        out = jnp.stack([tcx - tw / 2, tcy - th / 2,
+                         tcx + tw / 2, tcy + th / 2], axis=-1)
+    else:
+        # encode: target [N, 4] gt boxes vs priors [M, 4] -> [N, M, 4]
+        gw = target[:, None, 2] - target[:, None, 0]
+        gh = target[:, None, 3] - target[:, None, 1]
+        gcx = target[:, None, 0] + 0.5 * gw
+        gcy = target[:, None, 1] + 0.5 * gh
+        out = jnp.stack([
+            (gcx - pcx[None]) / pw[None] / pvar[None, :, 0],
+            (gcy - pcy[None]) / ph[None] / pvar[None, :, 1],
+            jnp.log(gw / pw[None]) / pvar[None, :, 2],
+            jnp.log(gh / ph[None]) / pvar[None, :, 3]], axis=-1)
+    return {'OutputBox': out}

@@ -1,0 +1,200 @@
+"""Elementwise / activation / reduction / matmul rules.
+
+Parity: reference paddle/fluid/operators/{elementwise_*,activation,reduce_*,
+mul,matmul,sum,mean,clip,compare,logical}_op.* — one JAX rule each; XLA fuses
+them into surrounding matmuls (the reference launches a CUDA kernel per op).
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..lowering import register, data_of, like, first_seq
+
+
+def _unary(op_type, fn):
+    @register(op_type)
+    def rule(ins, attrs, ctx, _fn=fn):
+        x = ins['X'][0]
+        return {'Out': like(x, _fn(data_of(x), attrs))}
+    return rule
+
+
+# 26 generated activations (reference python/paddle/fluid/layers/ops.py
+# __activations__) + relu & friends.
+_unary('sigmoid', lambda x, a: jax.nn.sigmoid(x))
+_unary('logsigmoid', lambda x, a: jax.nn.log_sigmoid(x))
+_unary('exp', lambda x, a: jnp.exp(x))
+_unary('tanh', lambda x, a: jnp.tanh(x))
+_unary('tanh_shrink', lambda x, a: x - jnp.tanh(x))
+_unary('softshrink', lambda x, a: jnp.sign(x) * jnp.maximum(jnp.abs(x) - a.get('lambda', 0.5), 0.0))
+_unary('sqrt', lambda x, a: jnp.sqrt(x))
+_unary('abs', lambda x, a: jnp.abs(x))
+_unary('ceil', lambda x, a: jnp.ceil(x))
+_unary('floor', lambda x, a: jnp.floor(x))
+_unary('cos', lambda x, a: jnp.cos(x))
+_unary('sin', lambda x, a: jnp.sin(x))
+_unary('round', lambda x, a: jnp.round(x))
+_unary('reciprocal', lambda x, a: 1.0 / x)
+_unary('square', lambda x, a: jnp.square(x))
+_unary('softplus', lambda x, a: jax.nn.softplus(x))
+_unary('softsign', lambda x, a: x / (1 + jnp.abs(x)))
+_unary('brelu', lambda x, a: jnp.clip(x, a.get('t_min', 0.0), a.get('t_max', 24.0)))
+_unary('leaky_relu', lambda x, a: jnp.where(x >= 0, x, a.get('alpha', 0.02) * x))
+_unary('soft_relu', lambda x, a: jnp.log1p(jnp.exp(jnp.clip(x, -a.get('threshold', 40.0), a.get('threshold', 40.0)))))
+_unary('elu', lambda x, a: jnp.where(x >= 0, x, a.get('alpha', 1.0) * (jnp.exp(x) - 1)))
+_unary('relu6', lambda x, a: jnp.clip(x, 0.0, a.get('threshold', 6.0)))
+_unary('pow', lambda x, a: jnp.power(x, a.get('factor', 1.0)))
+_unary('stanh', lambda x, a: a.get('scale_b', 1.7159) * jnp.tanh(a.get('scale_a', 2.0 / 3.0) * x))
+_unary('hard_sigmoid', lambda x, a: jnp.clip(a.get('slope', 0.2) * x + a.get('offset', 0.5), 0.0, 1.0))
+_unary('swish', lambda x, a: x * jax.nn.sigmoid(a.get('beta', 1.0) * x))
+_unary('relu', lambda x, a: jnp.maximum(x, 0))
+_unary('log', lambda x, a: jnp.log(x))
+_unary('logical_not', lambda x, a: jnp.logical_not(x))
+_unary('clip', lambda x, a: jnp.clip(x, a['min'], a['max']))
+_unary('scale', lambda x, a: (x + a.get('bias', 0.0)) * a['scale']
+       if a.get('bias_after_scale', True) is False
+       else x * a['scale'] + a.get('bias', 0.0))
+
+
+@register('clip_by_norm')
+def _clip_by_norm(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    max_norm = attrs['max_norm']
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    return {'Out': like(ins['X'][0], x * scale)}
+
+
+def _broadcast_y(x, y, axis):
+    """Fluid elementwise broadcast: align y's dims to x starting at `axis`
+    (reference operators/elementwise_op_function.h)."""
+    if x.ndim == y.ndim:
+        return y
+    if axis == -1:
+        axis = x.ndim - y.ndim
+    shape = [1] * x.ndim
+    for i, d in enumerate(y.shape):
+        shape[axis + i] = d
+    return y.reshape(shape)
+
+
+def _binary(op_type, fn):
+    @register(op_type)
+    def rule(ins, attrs, ctx, _fn=fn):
+        xv, yv = ins['X'][0], ins['Y'][0]
+        x, y = data_of(xv), data_of(yv)
+        y = _broadcast_y(x, y, attrs.get('axis', -1))
+        seq = first_seq(xv, yv)
+        out = _fn(x, y)
+        return {'Out': like(seq, out) if seq is not None else out}
+    return rule
+
+
+_binary('elementwise_add', jnp.add)
+_binary('elementwise_sub', jnp.subtract)
+_binary('elementwise_mul', jnp.multiply)
+_binary('elementwise_div', jnp.divide)
+_binary('elementwise_max', jnp.maximum)
+_binary('elementwise_min', jnp.minimum)
+_binary('elementwise_pow', jnp.power)
+_binary('logical_and', jnp.logical_and)
+_binary('logical_or', jnp.logical_or)
+_binary('logical_xor', jnp.logical_xor)
+_binary('less_than', lambda x, y: jnp.less(x, y))
+_binary('less_equal', jnp.less_equal)
+_binary('greater_than', jnp.greater)
+_binary('greater_equal', jnp.greater_equal)
+_binary('equal', jnp.equal)
+_binary('not_equal', jnp.not_equal)
+
+
+@register('mul')
+def _mul(ins, attrs, ctx):
+    """reference operators/mul_op.cc: flatten x to 2-D at x_num_col_dims and
+    y at y_num_col_dims, then matmul. On TPU this IS the MXU op."""
+    x = data_of(ins['X'][0])
+    y = data_of(ins['Y'][0])
+    xn = attrs.get('x_num_col_dims', 1)
+    yn = attrs.get('y_num_col_dims', 1)
+    xs, ys = x.shape, y.shape
+    x2 = x.reshape((int(np.prod(xs[:xn])), int(np.prod(xs[xn:]))))
+    y2 = y.reshape((int(np.prod(ys[:yn])), int(np.prod(ys[yn:]))))
+    out = x2 @ y2
+    out = out.reshape(xs[:xn] + ys[yn:])
+    return {'Out': like(ins['X'][0], out)}
+
+
+@register('matmul')
+def _matmul(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    y = data_of(ins['Y'][0])
+    if attrs.get('transpose_X', False):
+        x = jnp.swapaxes(x, -1, -2)
+    if attrs.get('transpose_Y', False):
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y) * attrs.get('alpha', 1.0)
+    return {'Out': out}
+
+
+def _reduce(op_type, fn):
+    @register(op_type)
+    def rule(ins, attrs, ctx, _fn=fn):
+        x = data_of(ins['X'][0])
+        dim = attrs.get('dim')
+        keep = attrs.get('keep_dim', False)
+        if attrs.get('reduce_all', False) or dim is None:
+            axis = None
+        else:
+            axis = tuple(dim) if isinstance(dim, (list, tuple)) else (dim,)
+        return {'Out': _fn(x, axis=axis, keepdims=keep)}
+    return rule
+
+
+_reduce('reduce_sum', jnp.sum)
+_reduce('reduce_mean', jnp.mean)
+_reduce('reduce_max', jnp.max)
+_reduce('reduce_min', jnp.min)
+_reduce('reduce_prod', jnp.prod)
+
+
+@register('mean')
+def _mean(ins, attrs, ctx):
+    return {'Out': jnp.mean(data_of(ins['X'][0]))}
+
+
+@register('sum')
+def _sum(ins, attrs, ctx):
+    xs = [data_of(v) for v in ins['X']]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {'Out': like(first_seq(*ins['X']), out)}
+
+
+@register('maxout')
+def _maxout(ins, attrs, ctx):
+    x = data_of(ins['X'][0])  # NCHW
+    g = attrs['groups']
+    n, c, h, w = x.shape
+    return {'Out': x.reshape(n, c // g, g, h, w).max(axis=2)}
+
+
+@register('cos_sim')
+def _cos_sim(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    y = data_of(ins['Y'][0])
+    xn = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, axis=-1, keepdims=True))
+    out = jnp.sum(x * y, axis=-1, keepdims=True) / jnp.maximum(xn * yn, 1e-12)
+    return {'Out': out, 'XNorm': xn, 'YNorm': yn}
+
+
+@register('l2_normalize')
+def _l2_normalize(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    axis = attrs.get('axis', -1)
+    eps = attrs.get('epsilon', 1e-12)
+    norm = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True))
+    norm = jnp.maximum(norm, eps)
+    return {'Out': like(ins['X'][0], x / norm), 'Norm': norm}

@@ -1,0 +1,501 @@
+"""NN rules: conv/pool/norm/dropout/softmax/losses/metrics/image.
+
+Parity: reference paddle/fluid/operators/{conv,pool,batch_norm,layer_norm,
+dropout,softmax,cross_entropy,accuracy,auc,lrn,prelu,interpolate,...}_op.* —
+cuDNN descriptors replaced by lax.conv_general_dilated / reduce_window, which
+XLA tiles directly onto the TPU MXU.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..lowering import register, data_of, like
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v,) * n
+
+
+@register('conv2d')
+def _conv2d(ins, attrs, ctx):
+    """NCHW conv. reference operators/conv_op.cc (+conv_cudnn_op.cu).
+    Filter layout OIHW [out_c, in_c/groups, kh, kw]."""
+    x = data_of(ins['Input'][0])
+    w = data_of(ins['Filter'][0])
+    strides = _pair(attrs.get('strides', 1))
+    pads = _pair(attrs.get('paddings', 0))
+    dilations = _pair(attrs.get('dilations', 1))
+    groups = attrs.get('groups', 1) or 1
+    out = lax.conv_general_dilated(
+        x, w.astype(x.dtype),
+        window_strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dilations,
+        feature_group_count=groups,
+        dimension_numbers=('NCHW', 'OIHW', 'NCHW'),
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+    return {'Output': out.astype(x.dtype)}
+
+
+@register('conv3d')
+def _conv3d(ins, attrs, ctx):
+    x = data_of(ins['Input'][0])
+    w = data_of(ins['Filter'][0])
+    strides = _pair(attrs.get('strides', 1), 3)
+    pads = _pair(attrs.get('paddings', 0), 3)
+    dilations = _pair(attrs.get('dilations', 1), 3)
+    groups = attrs.get('groups', 1) or 1
+    out = lax.conv_general_dilated(
+        x, w.astype(x.dtype), strides,
+        [(p, p) for p in pads], rhs_dilation=dilations,
+        feature_group_count=groups,
+        dimension_numbers=('NCDHW', 'OIDHW', 'NCDHW'))
+    return {'Output': out}
+
+
+@register('conv2d_transpose')
+def _conv2d_transpose(ins, attrs, ctx):
+    """reference operators/conv_transpose_op.cc. Filter [in_c, out_c/g, kh, kw].
+    Implemented as lhs-dilated conv (the XLA-native transposed conv)."""
+    x = data_of(ins['Input'][0])
+    w = data_of(ins['Filter'][0])
+    strides = _pair(attrs.get('strides', 1))
+    pads = _pair(attrs.get('paddings', 0))
+    dilations = _pair(attrs.get('dilations', 1))
+    groups = attrs.get('groups', 1) or 1
+    kh = (w.shape[2] - 1) * dilations[0] + 1
+    kw = (w.shape[3] - 1) * dilations[1] + 1
+    # flip spatial dims, swap in/out channel axes -> OIHW for the fwd conv
+    wt = jnp.flip(w, axis=(2, 3))
+    if groups > 1:
+        ci, co_g = w.shape[0], w.shape[1]
+        wt = wt.reshape(groups, ci // groups, co_g, w.shape[2], w.shape[3])
+        wt = jnp.swapaxes(wt, 1, 2).reshape(groups * co_g, ci // groups,
+                                            w.shape[2], w.shape[3])
+    else:
+        wt = jnp.swapaxes(wt, 0, 1)
+    out = lax.conv_general_dilated(
+        x, wt.astype(x.dtype),
+        window_strides=(1, 1),
+        padding=[(kh - 1 - pads[0], kh - 1 - pads[0]),
+                 (kw - 1 - pads[1], kw - 1 - pads[1])],
+        lhs_dilation=strides,
+        rhs_dilation=dilations,
+        feature_group_count=groups,
+        dimension_numbers=('NCHW', 'OIHW', 'NCHW'))
+    return {'Output': out}
+
+
+@register('conv3d_transpose')
+def _conv3d_transpose(ins, attrs, ctx):
+    x = data_of(ins['Input'][0])
+    w = data_of(ins['Filter'][0])
+    strides = _pair(attrs.get('strides', 1), 3)
+    pads = _pair(attrs.get('paddings', 0), 3)
+    dilations = _pair(attrs.get('dilations', 1), 3)
+    ks = [(w.shape[2 + i] - 1) * dilations[i] + 1 for i in range(3)]
+    wt = jnp.flip(w, axis=(2, 3, 4))
+    wt = jnp.swapaxes(wt, 0, 1)
+    out = lax.conv_general_dilated(
+        x, wt.astype(x.dtype), (1, 1, 1),
+        [(k - 1 - p, k - 1 - p) for k, p in zip(ks, pads)],
+        lhs_dilation=strides, rhs_dilation=dilations,
+        dimension_numbers=('NCDHW', 'OIDHW', 'NCDHW'))
+    return {'Output': out}
+
+
+def _pool(x, pool_type, ksize, strides, pads, global_pooling, exclusive=True,
+          ceil_mode=False):
+    nd = len(ksize)
+    if global_pooling:
+        ksize = x.shape[2:]
+        pads = (0,) * nd
+        strides = (1,) * nd
+    window = (1, 1) + tuple(ksize)
+    strides_full = (1, 1) + tuple(strides)
+    pad_full = ((0, 0), (0, 0)) + tuple((p, p) for p in pads)
+    if ceil_mode:
+        pad_full = ((0, 0), (0, 0)) + tuple(
+            (p, p + s - 1) for p, s in zip(pads, strides))
+    if pool_type == 'max':
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, init, lax.max, window, strides_full, pad_full)
+    ssum = lax.reduce_window(x, 0.0, lax.add, window, strides_full, pad_full)
+    if exclusive:
+        ones = jnp.ones(x.shape, dtype=x.dtype)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides_full, pad_full)
+        return ssum / cnt
+    return ssum / float(np.prod(ksize))
+
+
+@register('pool2d')
+def _pool2d(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    out = _pool(x, attrs.get('pooling_type', 'max'),
+                _pair(attrs['ksize']), _pair(attrs.get('strides', 1)),
+                _pair(attrs.get('paddings', 0)),
+                attrs.get('global_pooling', False),
+                attrs.get('exclusive', True), attrs.get('ceil_mode', False))
+    return {'Out': out}
+
+
+@register('pool3d')
+def _pool3d(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    out = _pool(x, attrs.get('pooling_type', 'max'),
+                _pair(attrs['ksize'], 3), _pair(attrs.get('strides', 1), 3),
+                _pair(attrs.get('paddings', 0), 3),
+                attrs.get('global_pooling', False),
+                attrs.get('exclusive', True), attrs.get('ceil_mode', False))
+    return {'Out': out}
+
+
+@register('batch_norm')
+def _batch_norm(ins, attrs, ctx):
+    """reference operators/batch_norm_op.cc. Train: batch stats + running
+    update; test: running stats. NCHW or NHWC via data_layout."""
+    x = data_of(ins['X'][0])
+    scale = data_of(ins['Scale'][0])
+    bias = data_of(ins['Bias'][0])
+    mean = data_of(ins['Mean'][0])
+    var = data_of(ins['Variance'][0])
+    eps = attrs.get('epsilon', 1e-5)
+    momentum = attrs.get('momentum', 0.9)
+    is_test = attrs.get('is_test', False) or ctx.is_test
+    layout = attrs.get('data_layout', 'NCHW')
+    c_axis = 1 if layout == 'NCHW' else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    bshape = [1] * x.ndim
+    bshape[c_axis] = x.shape[c_axis]
+
+    if is_test:
+        use_mean, use_var = mean, var
+        mean_out, var_out = mean, var
+        saved_mean = mean
+        saved_var = var
+    else:
+        xf = x.astype(jnp.float32)
+        use_mean = jnp.mean(xf, axis=axes)
+        use_var = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(use_mean)
+        mean_out = mean * momentum + use_mean * (1 - momentum)
+        var_out = var * momentum + use_var * (1 - momentum)
+        saved_mean = use_mean
+        saved_var = use_var
+    inv = lax.rsqrt(use_var + eps)
+    y = (x - use_mean.reshape(bshape).astype(x.dtype)) * \
+        (inv * scale).reshape(bshape).astype(x.dtype) + \
+        bias.reshape(bshape).astype(x.dtype)
+    return {'Y': y, 'MeanOut': mean_out, 'VarianceOut': var_out,
+            'SavedMean': saved_mean, 'SavedVariance': saved_var}
+
+
+@register('layer_norm')
+def _layer_norm(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    eps = attrs.get('epsilon', 1e-5)
+    axis = attrs.get('begin_norm_axis', 1)
+    red = tuple(range(axis, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=red, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=red, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + eps)
+    if ins.get('Scale'):
+        scale = data_of(ins['Scale'][0]).reshape((1,) * axis + x.shape[axis:])
+        y = y * scale
+    if ins.get('Bias'):
+        bias = data_of(ins['Bias'][0]).reshape((1,) * axis + x.shape[axis:])
+        y = y + bias
+    return {'Y': like(ins['X'][0], y.astype(x.dtype)),
+            'Mean': mean.reshape(x.shape[:axis]),
+            'Variance': var.reshape(x.shape[:axis])}
+
+
+@register('dropout')
+def _dropout(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    p = attrs.get('dropout_prob', 0.5)
+    is_test = attrs.get('is_test', False) or ctx.is_test
+    if is_test:
+        # downgrade_in_infer (default impl in the reference)
+        return {'Out': like(ins['X'][0], x * (1.0 - p)), 'Mask': None}
+    keep = jax.random.bernoulli(ctx.rng(), 1.0 - p, x.shape)
+    mask = keep.astype(x.dtype)
+    return {'Out': like(ins['X'][0], x * mask), 'Mask': mask}
+
+
+@register('softmax')
+def _softmax(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    return {'Out': like(ins['X'][0], jax.nn.softmax(x, axis=-1))}
+
+
+@register('cross_entropy')
+def _cross_entropy(ins, attrs, ctx):
+    """X: probs [N, C]; Label int64 [N, 1] (or probs if soft_label)."""
+    x = data_of(ins['X'][0])
+    label = data_of(ins['Label'][0])
+    eps = 1e-8
+    if attrs.get('soft_label', False):
+        loss = -jnp.sum(label * jnp.log(x + eps), axis=-1, keepdims=True)
+    else:
+        li = label.astype(jnp.int32)
+        if li.ndim == x.ndim:
+            li = jnp.squeeze(li, -1)
+        picked = jnp.take_along_axis(x, li[..., None], axis=-1)
+        loss = -jnp.log(picked + eps)
+    return {'Y': like(ins['X'][0], loss)}
+
+
+@register('softmax_with_cross_entropy')
+def _softmax_with_cross_entropy(ins, attrs, ctx):
+    logits = data_of(ins['Logits'][0])
+    label = data_of(ins['Label'][0])
+    sm = jax.nn.softmax(logits, axis=-1)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    if attrs.get('soft_label', False):
+        loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
+    else:
+        li = label.astype(jnp.int32)
+        if li.ndim == logits.ndim:
+            li = jnp.squeeze(li, -1)
+        loss = -jnp.take_along_axis(logp, li[..., None], axis=-1)
+    return {'Softmax': sm, 'Loss': like(ins['Logits'][0], loss)}
+
+
+@register('sigmoid_cross_entropy_with_logits')
+def _sigmoid_xent(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    label = data_of(ins['Label'][0])
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return {'Out': like(ins['X'][0], loss)}
+
+
+@register('smooth_l1_loss')
+def _smooth_l1(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    y = data_of(ins['Y'][0])
+    sigma = attrs.get('sigma', 1.0)
+    s2 = sigma * sigma
+    d = x - y
+    if ins.get('InsideWeight'):
+        d = d * data_of(ins['InsideWeight'][0])
+    ad = jnp.abs(d)
+    loss = jnp.where(ad < 1.0 / s2, 0.5 * s2 * d * d, ad - 0.5 / s2)
+    if ins.get('OutsideWeight'):
+        loss = loss * data_of(ins['OutsideWeight'][0])
+    out = jnp.sum(loss.reshape(loss.shape[0], -1), axis=1, keepdims=True)
+    return {'Out': out, 'Diff': d}
+
+
+@register('rank_loss')
+def _rank_loss(ins, attrs, ctx):
+    label = data_of(ins['Label'][0])
+    left = data_of(ins['Left'][0])
+    right = data_of(ins['Right'][0])
+    d = left - right
+    out = jnp.log1p(jnp.exp(d)) - label * d
+    return {'Out': out}
+
+
+@register('dice_loss')
+def _dice_loss(ins, attrs, ctx):
+    x = data_of(ins['X'][0])  # probs
+    label = data_of(ins['Label'][0]).astype(x.dtype)
+    eps = attrs.get('epsilon', 1e-5)
+    red = tuple(range(1, x.ndim))
+    inter = 2.0 * jnp.sum(x * label, axis=red)
+    union = jnp.sum(x, axis=red) + jnp.sum(label, axis=red)
+    return {'Out': jnp.mean(1.0 - (inter + eps) / (union + eps))}
+
+
+@register('huber_loss')
+def _huber_loss(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    y = data_of(ins['Y'][0])
+    delta = attrs.get('delta', 1.0)
+    d = jnp.abs(y - x)
+    loss = jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+    return {'Out': loss, 'Residual': y - x}
+
+
+@register('accuracy')
+def _accuracy(ins, attrs, ctx):
+    """inputs: Out (topk values), Indices (topk ids), Label. reference
+    operators/accuracy_op.cu."""
+    idx = data_of(ins['Indices'][0]).astype(jnp.int64)
+    label = data_of(ins['Label'][0]).astype(jnp.int64)
+    if label.ndim < idx.ndim:
+        label = label[..., None]
+    correct = jnp.any(idx == label, axis=-1)
+    num_correct = jnp.sum(correct.astype(jnp.int32))
+    total = correct.size
+    acc = num_correct.astype(jnp.float32) / float(total)
+    return {'Accuracy': acc, 'Correct': num_correct,
+            'Total': jnp.asarray(total, dtype=jnp.int32)}
+
+
+@register('auc')
+def _auc(ins, attrs, ctx):
+    """Streaming AUC over persistable confusion buckets (reference
+    operators/auc_op.cc). States: StatPos/StatNeg histograms."""
+    probs = data_of(ins['Predict'][0])
+    label = data_of(ins['Label'][0]).reshape(-1)
+    stat_pos = data_of(ins['StatPos'][0])
+    stat_neg = data_of(ins['StatNeg'][0])
+    num_t = stat_pos.shape[0]
+    p1 = probs[:, 1] if probs.ndim == 2 and probs.shape[1] >= 2 else probs.reshape(-1)
+    bucket = jnp.clip((p1 * num_t).astype(jnp.int32), 0, num_t - 1)
+    is_pos = (label > 0)
+    pos_hist = jnp.zeros((num_t,), jnp.int64).at[bucket].add(is_pos.astype(jnp.int64))
+    neg_hist = jnp.zeros((num_t,), jnp.int64).at[bucket].add((~is_pos).astype(jnp.int64))
+    new_pos = stat_pos + pos_hist
+    new_neg = stat_neg + neg_hist
+    # AUC = (sum over thresholds of neg_below * pos_at + .5*neg_at*pos_at)/(P*N)
+    pos = new_pos.astype(jnp.float64)
+    neg = new_neg.astype(jnp.float64)
+    tot_pos = jnp.cumsum(pos)
+    tot_neg = jnp.cumsum(neg)
+    area = jnp.sum((tot_neg - neg * 0.5) * pos)
+    denom = jnp.maximum(tot_pos[-1] * tot_neg[-1], 1.0)
+    auc = (area / denom).astype(jnp.float32)
+    return {'AUC': auc, 'StatPosOut': new_pos, 'StatNegOut': new_neg}
+
+
+@register('lrn')
+def _lrn(ins, attrs, ctx):
+    x = data_of(ins['X'][0])  # NCHW
+    n = attrs.get('n', 5)
+    k = attrs.get('k', 2.0)
+    alpha = attrs.get('alpha', 1e-4)
+    beta = attrs.get('beta', 0.75)
+    sq = jnp.square(x)
+    half = n // 2
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    acc = sum(pad[:, i:i + x.shape[1]] for i in range(n))
+    mid = k + alpha * acc
+    return {'Out': x / jnp.power(mid, beta), 'MidOut': mid}
+
+
+@register('prelu')
+def _prelu(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    alpha = data_of(ins['Alpha'][0])
+    mode = attrs.get('mode', 'all')
+    if mode == 'all':
+        a = alpha.reshape(())
+    elif mode == 'channel':
+        a = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    else:
+        a = alpha.reshape((1,) + x.shape[1:])
+    return {'Out': jnp.where(x >= 0, x, a * x)}
+
+
+def _resize(x, out_h, out_w, method):
+    n, c, h, w = x.shape
+    return jax.image.resize(x, (n, c, out_h, out_w), method=method)
+
+
+@register('bilinear_interp')
+def _bilinear_interp(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    if ins.get('OutSize'):
+        raise ValueError(
+            "image_resize with a runtime OutSize tensor is data-dependent "
+            "shape — unsupported under XLA; pass a static out_shape list")
+    out_h, out_w = attrs['out_h'], attrs['out_w']
+    return {'Out': _resize(x, out_h, out_w, 'bilinear')}
+
+
+@register('nearest_interp')
+def _nearest_interp(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    out_h, out_w = attrs['out_h'], attrs['out_w']
+    return {'Out': _resize(x, out_h, out_w, 'nearest')}
+
+
+@register('roi_pool')
+def _roi_pool(ins, attrs, ctx):
+    """reference operators/roi_pool_op.cc. ROIs: [R, 4] (x1,y1,x2,y2) with
+    batch id in RoisLod-free single-image mode; here ROIs carry batch index
+    via first column when 5-wide."""
+    x = data_of(ins['X'][0])
+    rois = data_of(ins['ROIs'][0])
+    ph = attrs['pooled_height']
+    pw = attrs['pooled_width']
+    scale = attrs.get('spatial_scale', 1.0)
+    n, c, h, w = x.shape
+
+    if rois.shape[-1] == 5:
+        batch_ids = rois[:, 0].astype(jnp.int32)
+        boxes = rois[:, 1:]
+    else:
+        batch_ids = jnp.zeros((rois.shape[0],), jnp.int32)
+        boxes = rois
+
+    def pool_one(bid, box):
+        img = x[bid]
+        x1 = jnp.round(box[0] * scale).astype(jnp.int32)
+        y1 = jnp.round(box[1] * scale).astype(jnp.int32)
+        x2 = jnp.round(box[2] * scale).astype(jnp.int32)
+        y2 = jnp.round(box[3] * scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1).astype(jnp.float32)
+        rw = jnp.maximum(x2 - x1 + 1, 1).astype(jnp.float32)
+        ys = jnp.arange(h)
+        xs = jnp.arange(w)
+        # bin index of each pixel, -1 if outside roi
+        ybin = jnp.floor((ys - y1).astype(jnp.float32) / (rh / ph)).astype(jnp.int32)
+        xbin = jnp.floor((xs - x1).astype(jnp.float32) / (rw / pw)).astype(jnp.int32)
+        yvalid = (ys >= y1) & (ys <= y2)
+        xvalid = (xs >= x1) & (xs <= x2)
+        ybin = jnp.clip(ybin, 0, ph - 1)
+        xbin = jnp.clip(xbin, 0, pw - 1)
+        neg = jnp.full(img.shape, -jnp.inf, img.dtype)
+        masked = jnp.where(yvalid[None, :, None] & xvalid[None, None, :], img, neg)
+        out = jnp.full((c, ph, pw), -jnp.inf, img.dtype)
+        out = out.at[:, ybin[:, None], xbin[None, :]].max(masked)
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    out = jax.vmap(pool_one)(batch_ids, boxes)
+    return {'Out': out, 'Argmax': None}
+
+
+@register('mean_iou')
+def _mean_iou(ins, attrs, ctx):
+    pred = data_of(ins['Predictions'][0]).reshape(-1).astype(jnp.int32)
+    label = data_of(ins['Labels'][0]).reshape(-1).astype(jnp.int32)
+    num_classes = attrs['num_classes']
+    idx = label * num_classes + pred
+    cm = jnp.zeros((num_classes * num_classes,), jnp.float32).at[idx].add(1.0)
+    cm = cm.reshape(num_classes, num_classes)
+    inter = jnp.diag(cm)
+    union = cm.sum(0) + cm.sum(1) - inter
+    valid = union > 0
+    iou = jnp.where(valid, inter / jnp.maximum(union, 1.0), 0.0)
+    mean_iou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+    return {'OutMeanIou': mean_iou, 'OutWrong': jnp.sum(cm, axis=1) - inter,
+            'OutCorrect': inter}
+
+
+@register('im2sequence')
+def _im2sequence(ins, attrs, ctx):
+    """reference operators/im2sequence_op.cc: NCHW image -> sequence of
+    flattened patches [N, out_h*out_w, C*kh*kw] (dense-padded layout)."""
+    x = data_of(ins['X'][0])
+    kh, kw = _pair(attrs['kernels'])
+    sh, sw = _pair(attrs.get('strides', 1))
+    p = attrs.get('paddings', [0, 0, 0, 0])
+    n, c, h, w = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, 0), (p[0], p[2] if len(p) > 2 else p[0]),
+                     (p[1] if len(p) > 1 else p[0], p[3] if len(p) > 3 else p[0])))
+    patches = lax.conv_general_dilated_patches(
+        xp, (kh, kw), (sh, sw), 'VALID',
+        dimension_numbers=('NCHW', 'OIHW', 'NCHW'))  # [N, C*kh*kw, oh, ow]
+    ckk = patches.shape[1]
+    seq = patches.reshape(n, ckk, -1).transpose(0, 2, 1)  # [N, oh*ow, C*kh*kw]
+    from ..lowering import SeqValue
+    lengths = jnp.full((n,), seq.shape[1], jnp.int32)
+    return {'Out': SeqValue(seq, lengths)}

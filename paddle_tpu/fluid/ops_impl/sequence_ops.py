@@ -1,0 +1,371 @@
+"""Embedding + variable-length sequence rules.
+
+Parity: reference paddle/fluid/operators/{lookup_table,sequence_pool,
+sequence_softmax,sequence_expand,sequence_conv,sequence_reshape,
+sequence_mask,lod_reset,row_conv,lstm,gru,...}_op.*
+
+TPU-first: the reference stores sequences flattened [total_tokens, d] with a
+LoD offset table and walks it with per-sequence CPU loops / custom CUDA
+kernels. Here sequences are dense-padded [batch, max_len, d] SeqValues with
+an int32 lengths vector; every rule is a masked dense op (static shapes for
+XLA) and recurrences are lax.scan over the time axis — the XLA-native RNN.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..lowering import register, data_of, like, SeqValue
+
+
+@register('lookup_table')
+def _lookup_table(ins, attrs, ctx):
+    w = data_of(ins['W'][0])
+    ids_v = ins['Ids'][0]
+    ids = data_of(ids_v).astype(jnp.int32)
+    if ids.shape and ids.shape[-1] == 1:
+        ids = jnp.squeeze(ids, -1)
+    if attrs.get('padding_idx') is not None and attrs['padding_idx'] >= 0:
+        pad = attrs['padding_idx']
+        w = w.at[pad].set(0.0)
+    out = jnp.take(w, ids, axis=0)
+    return {'Out': like(ids_v, out)}
+
+
+def _seq(v):
+    if not isinstance(v, SeqValue):
+        raise TypeError("expected a sequence (lod) value, got dense array")
+    return v
+
+
+@register('sequence_pool')
+def _sequence_pool(ins, attrs, ctx):
+    x = _seq(ins['X'][0])
+    ptype = attrs.get('pooltype', 'AVERAGE').upper()
+    data = x.data  # [B, T, ...]
+    mask = x.mask(data.dtype)
+    while mask.ndim < data.ndim:
+        mask = mask[..., None]
+    lens = jnp.maximum(x.lengths, 1).astype(data.dtype)
+    lens = lens.reshape((-1,) + (1,) * (data.ndim - 2))
+    if ptype == 'SUM':
+        out = jnp.sum(data * mask, axis=1)
+    elif ptype == 'AVERAGE':
+        out = jnp.sum(data * mask, axis=1) / lens
+    elif ptype == 'SQRT':
+        out = jnp.sum(data * mask, axis=1) / jnp.sqrt(lens)
+    elif ptype == 'MAX':
+        neg = jnp.finfo(data.dtype).min if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        out = jnp.max(jnp.where(mask > 0, data, neg), axis=1)
+    elif ptype == 'FIRST':
+        out = data[:, 0]
+    elif ptype == 'LAST':
+        idx = jnp.maximum(x.lengths - 1, 0)
+        out = jnp.take_along_axis(
+            data, idx.reshape((-1, 1) + (1,) * (data.ndim - 2)), axis=1)
+        out = jnp.squeeze(out, 1)
+    else:
+        raise ValueError("unknown pooltype %r" % ptype)
+    return {'Out': out, 'MaxIndex': None}
+
+
+@register('sequence_softmax')
+def _sequence_softmax(ins, attrs, ctx):
+    x = _seq(ins['X'][0])
+    data = x.data
+    m = x.mask(jnp.float32)
+    while m.ndim < data.ndim:
+        m = m[..., None]
+    neg = jnp.finfo(jnp.float32).min
+    logits = jnp.where(m > 0, data.astype(jnp.float32), neg)
+    sm = jax.nn.softmax(logits, axis=1) * m
+    return {'Out': SeqValue(sm.astype(data.dtype), x.lengths, x.outer_lengths)}
+
+
+@register('sequence_expand')
+def _sequence_expand(ins, attrs, ctx):
+    """Broadcast per-row x over y's time steps (reference
+    operators/sequence_expand_op.cc, ref_level=-1 common case)."""
+    xv = ins['X'][0]
+    y = _seq(ins['Y'][0])
+    x = data_of(xv)
+    t = y.data.shape[1]
+    if isinstance(xv, SeqValue):
+        # expand whole sub-sequences: x [B, Tx, ...] tiled is not
+        # representable densely without ragged repeat; common usage in the
+        # book models is row-expand, so take first step per row.
+        x = x[:, 0]
+    out = jnp.broadcast_to(x[:, None], (x.shape[0], t) + x.shape[1:])
+    return {'Out': SeqValue(out, y.lengths, y.outer_lengths)}
+
+
+@register('sequence_reshape')
+def _sequence_reshape(ins, attrs, ctx):
+    x = _seq(ins['X'][0])
+    new_dim = attrs['new_dim']
+    b, t, d = x.data.shape
+    assert (t * d) % new_dim == 0
+    new_t = t * d // new_dim
+    out = x.data.reshape(b, new_t, new_dim)
+    new_len = (x.lengths * d) // new_dim
+    return {'Out': SeqValue(out, new_len)}
+
+
+@register('sequence_mask')
+def _sequence_mask(ins, attrs, ctx):
+    lens = data_of(ins['X'][0]).reshape(-1)
+    maxlen = attrs.get('maxlen', -1)
+    if maxlen is None or maxlen < 0:
+        maxlen = int(lens.shape[0]) if False else attrs.get('static_maxlen', None)
+        if maxlen is None:
+            raise ValueError(
+                "sequence_mask on TPU needs a static maxlen attr (dynamic "
+                "max length would make the output shape data-dependent)")
+    rng = jnp.arange(maxlen)
+    from .tensor_ops import _np_dtype
+    mask = (rng[None, :] < lens[:, None]).astype(_np_dtype(attrs.get('out_dtype', 'int64')))
+    return {'Y': mask}
+
+
+@register('lod_reset')
+def _lod_reset(ins, attrs, ctx):
+    xv = ins['X'][0]
+    data = data_of(xv)
+    if ins.get('Y') and ins['Y']:
+        y = ins['Y'][0]
+        lens = y.lengths if isinstance(y, SeqValue) else data_of(y).reshape(-1).astype(jnp.int32)
+    else:
+        offsets = attrs['target_lod']
+        lens = jnp.asarray(np.diff(np.asarray(offsets)), dtype=jnp.int32)
+    return {'Out': SeqValue(data, lens)}
+
+
+@register('sequence_conv')
+def _sequence_conv(ins, attrs, ctx):
+    """Context-window projection (reference operators/sequence_conv_op.cc):
+    for each step, concat [t+start, t+start+len) rows then matmul filter
+    [len*d, out]. Dense: gather shifted copies, mask invalid."""
+    x = _seq(ins['X'][0])
+    filt = data_of(ins['Filter'][0])
+    clen = attrs.get('contextLength', 3)
+    cstart = attrs.get('contextStart', -((clen - 1) // 2))
+    b, t, d = x.data.shape
+    m = x.mask(x.data.dtype)[..., None]
+    xm = x.data * m
+    cols = []
+    for i in range(clen):
+        off = cstart + i
+        rolled = jnp.roll(xm, -off, axis=1)
+        step = jnp.arange(t)
+        valid = (step + off >= 0) & (step + off < t)
+        cols.append(jnp.where(valid[None, :, None], rolled, 0.0))
+    ctxmat = jnp.concatenate(cols, axis=-1)  # [B, T, clen*d]
+    out = ctxmat @ filt  # [B, T, out]
+    return {'Out': SeqValue(out, x.lengths)}
+
+
+@register('row_conv')
+def _row_conv(ins, attrs, ctx):
+    """Lookahead conv (reference operators/row_conv_op.cc): out[t] =
+    sum_{i<k} w[i] * x[t+i]."""
+    x = _seq(ins['X'][0])
+    filt = data_of(ins['Filter'][0])  # [future_ctx, d]
+    k = filt.shape[0]
+    b, t, d = x.data.shape
+    m = x.mask(x.data.dtype)[..., None]
+    xm = x.data * m
+    out = jnp.zeros_like(xm)
+    for i in range(k):
+        rolled = jnp.roll(xm, -i, axis=1)
+        step = jnp.arange(t)
+        valid = (step + i < t)
+        out = out + jnp.where(valid[None, :, None], rolled, 0.0) * filt[i][None, None, :]
+    return {'Out': SeqValue(out, x.lengths)}
+
+
+def _lstm_scan(xproj, lengths, w_hid, bias, use_peepholes, cand_act, gate_act,
+               cell_act, is_reverse, h0=None, c0=None, proj=None):
+    """Shared LSTM recurrence. xproj: [B, T, 4D] (input already projected).
+    Gate layout i, f, c, o with hidden weight [D, 4D]
+    (reference operators/math/detail/lstm_kernel.h). lax.scan over time."""
+    b, t, d4 = xproj.shape
+    d = d4 // 4
+    acts = {'sigmoid': jax.nn.sigmoid, 'tanh': jnp.tanh,
+            'relu': lambda v: jnp.maximum(v, 0), 'identity': lambda v: v}
+    ga, ca, cea = acts[gate_act], acts[cand_act], acts[cell_act]
+    if h0 is None:
+        hdim = proj.shape[1] if proj is not None else d
+        h0 = jnp.zeros((b, hdim), xproj.dtype)
+    if c0 is None:
+        c0 = jnp.zeros((b, d), xproj.dtype)
+    if bias is not None:
+        gate_bias = bias[..., :d4].reshape(1, d4)
+    else:
+        gate_bias = 0.0
+    if use_peepholes and bias is not None:
+        w_ic = bias[..., d4:d4 + d].reshape(1, d)
+        w_fc = bias[..., d4 + d:d4 + 2 * d].reshape(1, d)
+        w_oc = bias[..., d4 + 2 * d:d4 + 3 * d].reshape(1, d)
+    else:
+        w_ic = w_fc = w_oc = None
+
+    xs = jnp.swapaxes(xproj, 0, 1)  # [T, B, 4D]
+    steps = jnp.arange(t)
+    if is_reverse:
+        xs = jnp.flip(xs, 0)
+        step_ids = jnp.flip(steps, 0)
+    else:
+        step_ids = steps
+    valid_t = (step_ids[:, None] < lengths[None, :])  # [T, B]
+
+    def step(carry, inp):
+        h, c = carry
+        x_t, valid = inp
+        g = x_t + h @ w_hid + gate_bias
+        gi, gf, gc, go = jnp.split(g, 4, axis=-1)
+        if w_ic is not None:
+            gi = gi + w_ic * c
+            gf = gf + w_fc * c
+        i = ga(gi)
+        f = ga(gf)
+        cand = ca(gc)
+        c_new = f * c + i * cand
+        if w_oc is not None:
+            go = go + w_oc * c_new
+        o = ga(go)
+        h_new = o * cea(c_new)
+        if proj is not None:
+            h_new = h_new @ proj
+        vm = valid[:, None].astype(h_new.dtype)
+        h_out = vm * h_new + (1 - vm) * h
+        c_out = vm * c_new + (1 - vm) * c
+        return (h_out, c_out), (h_out, c_out)
+
+    (_, _), (hs, cs) = lax.scan(step, (h0, c0), (xs, valid_t))
+    if is_reverse:
+        hs = jnp.flip(hs, 0)
+        cs = jnp.flip(cs, 0)
+    return jnp.swapaxes(hs, 0, 1), jnp.swapaxes(cs, 0, 1)
+
+
+@register('lstm')
+def _lstm(ins, attrs, ctx):
+    x = _seq(ins['Input'][0])
+    w = data_of(ins['Weight'][0])  # [D, 4D]
+    bias = data_of(ins['Bias'][0]) if ins.get('Bias') else None
+    h0 = data_of(ins['H0'][0]) if ins.get('H0') else None
+    c0 = data_of(ins['C0'][0]) if ins.get('C0') else None
+    hs, cs = _lstm_scan(
+        x.data, x.lengths, w, bias,
+        attrs.get('use_peepholes', True),
+        attrs.get('candidate_activation', 'tanh'),
+        attrs.get('gate_activation', 'sigmoid'),
+        attrs.get('cell_activation', 'tanh'),
+        attrs.get('is_reverse', False), h0, c0)
+    return {'Hidden': SeqValue(hs, x.lengths), 'Cell': SeqValue(cs, x.lengths),
+            'BatchGate': None, 'BatchCellPreAct': None}
+
+
+@register('lstmp')
+def _lstmp(ins, attrs, ctx):
+    x = _seq(ins['Input'][0])
+    w = data_of(ins['Weight'][0])  # [P, 4D]
+    proj = data_of(ins['ProjWeight'][0])  # [D, P]
+    bias = data_of(ins['Bias'][0]) if ins.get('Bias') else None
+    hs, cs = _lstm_scan(
+        x.data, x.lengths, w, bias,
+        attrs.get('use_peepholes', True),
+        attrs.get('candidate_activation', 'tanh'),
+        attrs.get('gate_activation', 'sigmoid'),
+        attrs.get('cell_activation', 'tanh'),
+        attrs.get('is_reverse', False), None, None, proj=proj)
+    return {'Projection': SeqValue(hs, x.lengths), 'Cell': SeqValue(cs, x.lengths),
+            'BatchGate': None, 'BatchCellPreAct': None,
+            'BatchHidden': None, 'OrderedP0': None}
+
+
+def _gru_gates(x_t, h_prev, w, gate_act, cand_act):
+    """w: [D, 3D] laid out [update, reset | candidate]
+    (reference operators/math/detail/gru_kernel.h)."""
+    d = h_prev.shape[-1]
+    w_rz = w[:, :2 * d]
+    w_c = w[:, 2 * d:]
+    g_rz = x_t[:, :2 * d] + h_prev @ w_rz
+    u = gate_act(g_rz[:, :d])
+    r = gate_act(g_rz[:, d:])
+    c = cand_act(x_t[:, 2 * d:] + (r * h_prev) @ w_c)
+    h_new = u * h_prev + (1 - u) * c
+    return h_new, r, u, c
+
+
+@register('gru')
+def _gru(ins, attrs, ctx):
+    x = _seq(ins['Input'][0])  # [B, T, 3D]
+    w = data_of(ins['Weight'][0])
+    bias = data_of(ins['Bias'][0]) if ins.get('Bias') else 0.0
+    h0 = data_of(ins['H0'][0]) if ins.get('H0') else None
+    acts = {'sigmoid': jax.nn.sigmoid, 'tanh': jnp.tanh,
+            'relu': lambda v: jnp.maximum(v, 0), 'identity': lambda v: v}
+    ga = acts[attrs.get('gate_activation', 'sigmoid')]
+    ca = acts[attrs.get('activation', 'tanh')]
+    b, t, d3 = x.data.shape
+    d = d3 // 3
+    if h0 is None:
+        h0 = jnp.zeros((b, d), x.data.dtype)
+    xdata = x.data if isinstance(bias, float) else x.data + jnp.reshape(bias, (1, 1, -1))
+    xs = jnp.swapaxes(xdata, 0, 1)
+    steps = jnp.arange(t)
+    if attrs.get('is_reverse', False):
+        xs = jnp.flip(xs, 0)
+        steps = jnp.flip(steps, 0)
+    valid_t = (steps[:, None] < x.lengths[None, :])
+
+    def step(h, inp):
+        x_t, valid = inp
+        h_new, _, _, _ = _gru_gates(x_t, h, w, ga, ca)
+        vm = valid[:, None].astype(h_new.dtype)
+        h_out = vm * h_new + (1 - vm) * h
+        return h_out, h_out
+
+    _, hs = lax.scan(step, h0, (xs, valid_t))
+    if attrs.get('is_reverse', False):
+        hs = jnp.flip(hs, 0)
+    return {'Hidden': SeqValue(jnp.swapaxes(hs, 0, 1), x.lengths),
+            'BatchGate': None, 'BatchResetHiddenPrev': None, 'BatchHidden': None}
+
+
+@register('gru_unit')
+def _gru_unit(ins, attrs, ctx):
+    x = data_of(ins['Input'][0])  # [B, 3D]
+    h_prev = data_of(ins['HiddenPrev'][0])
+    w = data_of(ins['Weight'][0])
+    bias = data_of(ins['Bias'][0]).reshape(1, -1) if ins.get('Bias') else 0.0
+    acts = {1: jax.nn.sigmoid, 2: jnp.tanh, 0: lambda v: v,
+            3: lambda v: jnp.maximum(v, 0)}
+    # attr may be int enum (reference) or str
+    def act(a, default):
+        v = attrs.get(a, default)
+        if isinstance(v, str):
+            return {'sigmoid': jax.nn.sigmoid, 'tanh': jnp.tanh,
+                    'identity': lambda u: u,
+                    'relu': lambda u: jnp.maximum(u, 0)}[v]
+        return acts[v]
+    ga = act('gate_activation', 'sigmoid')
+    ca = act('activation', 'tanh')
+    h_new, r, u, c = _gru_gates(x + bias, h_prev, w, ga, ca)
+    return {'Hidden': h_new, 'ResetHiddenPrev': r * h_prev, 'Gate': u}
+
+
+@register('lstm_unit')
+def _lstm_unit(ins, attrs, ctx):
+    x = data_of(ins['X'][0])  # [B, 4D] pre-projected gates
+    c_prev = data_of(ins['C_prev'][0])
+    forget_bias = attrs.get('forget_bias', 0.0)
+    gi, gf, gc, go = jnp.split(x, 4, axis=-1)
+    i = jax.nn.sigmoid(gi)
+    f = jax.nn.sigmoid(gf + forget_bias)
+    o = jax.nn.sigmoid(go)
+    c = f * c_prev + i * jnp.tanh(gc)
+    h = o * jnp.tanh(c)
+    return {'C': c, 'H': h}

@@ -1,0 +1,317 @@
+"""Tensor creation / manipulation rules.
+
+Parity: reference paddle/fluid/operators/{fill_constant,cast,concat,reshape,
+transpose,split,gather,scatter,top_k,arg_min_max,one_hot,assign,
+uniform_random,gaussian_random,...}_op.*
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..lowering import register, data_of, like
+
+
+def _np_dtype(d):
+    return jnp.bfloat16 if d in ('bfloat16', jnp.bfloat16) else np.dtype(d)
+
+
+@register('fill_constant')
+def _fill_constant(ins, attrs, ctx):
+    shape = tuple(attrs['shape'])
+    return {'Out': jnp.full(shape, attrs['value'], dtype=_np_dtype(attrs.get('dtype', 'float32')))}
+
+
+@register('fill_constant_batch_size_like')
+def _fill_constant_bsl(ins, attrs, ctx):
+    ref = data_of(ins['Input'][0])
+    shape = list(attrs['shape'])
+    in_idx = attrs.get('input_dim_idx', 0)
+    out_idx = attrs.get('output_dim_idx', 0)
+    shape[out_idx] = ref.shape[in_idx]
+    return {'Out': jnp.full(tuple(shape), attrs['value'],
+                            dtype=_np_dtype(attrs.get('dtype', 'float32')))}
+
+
+@register('uniform_random')
+def _uniform_random(ins, attrs, ctx):
+    shape = tuple(attrs['shape'])
+    dt = _np_dtype(attrs.get('dtype', 'float32'))
+    return {'Out': jax.random.uniform(ctx.rng(), shape, dtype=jnp.float32,
+                                      minval=attrs.get('min', -1.0),
+                                      maxval=attrs.get('max', 1.0)).astype(dt)}
+
+
+@register('uniform_random_batch_size_like')
+def _uniform_random_bsl(ins, attrs, ctx):
+    ref = data_of(ins['Input'][0])
+    shape = list(attrs['shape'])
+    shape[attrs.get('output_dim_idx', 0)] = ref.shape[attrs.get('input_dim_idx', 0)]
+    dt = _np_dtype(attrs.get('dtype', 'float32'))
+    return {'Out': jax.random.uniform(ctx.rng(), tuple(shape), dtype=jnp.float32,
+                                      minval=attrs.get('min', -1.0),
+                                      maxval=attrs.get('max', 1.0)).astype(dt)}
+
+
+@register('gaussian_random')
+def _gaussian_random(ins, attrs, ctx):
+    shape = tuple(attrs['shape'])
+    dt = _np_dtype(attrs.get('dtype', 'float32'))
+    out = attrs.get('mean', 0.0) + attrs.get('std', 1.0) * jax.random.normal(
+        ctx.rng(), shape, dtype=jnp.float32)
+    return {'Out': out.astype(dt)}
+
+
+@register('gaussian_random_batch_size_like')
+def _gaussian_random_bsl(ins, attrs, ctx):
+    ref = data_of(ins['Input'][0])
+    shape = list(attrs['shape'])
+    shape[attrs.get('output_dim_idx', 0)] = ref.shape[attrs.get('input_dim_idx', 0)]
+    out = attrs.get('mean', 0.0) + attrs.get('std', 1.0) * jax.random.normal(
+        ctx.rng(), tuple(shape), dtype=jnp.float32)
+    return {'Out': out.astype(_np_dtype(attrs.get('dtype', 'float32')))}
+
+
+@register('truncated_gaussian_random')
+def _truncated_gaussian_random(ins, attrs, ctx):
+    shape = tuple(attrs['shape'])
+    out = attrs.get('mean', 0.0) + attrs.get('std', 1.0) * jax.random.truncated_normal(
+        ctx.rng(), -2.0, 2.0, shape, dtype=jnp.float32)
+    return {'Out': out.astype(_np_dtype(attrs.get('dtype', 'float32')))}
+
+
+@register('cast')
+def _cast(ins, attrs, ctx):
+    x = ins['X'][0]
+    return {'Out': like(x, data_of(x).astype(_np_dtype(attrs['out_dtype'])))}
+
+
+@register('concat')
+def _concat(ins, attrs, ctx):
+    xs = [data_of(v) for v in ins['X']]
+    return {'Out': jnp.concatenate(xs, axis=attrs.get('axis', 0))}
+
+
+@register('assign')
+def _assign(ins, attrs, ctx):
+    return {'Out': ins['X'][0]}
+
+
+@register('shape')
+def _shape(ins, attrs, ctx):
+    x = data_of(ins['Input'][0])
+    return {'Out': jnp.asarray(x.shape, dtype=jnp.int32)}
+
+
+@register('reshape')
+def _reshape(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    shape = [int(d) for d in attrs['shape']]
+    # Fluid semantics (operators/reshape_op.cc): 0 means "copy input dim",
+    # one -1 is inferred.
+    out_shape = []
+    for i, d in enumerate(shape):
+        if d == 0:
+            out_shape.append(x.shape[i])
+        else:
+            out_shape.append(d)
+    return {'Out': x.reshape(out_shape)}
+
+
+@register('squeeze')
+def _squeeze(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    axes = attrs.get('axes')
+    return {'Out': jnp.squeeze(x, axis=tuple(axes) if axes else None)}
+
+
+@register('unsqueeze')
+def _unsqueeze(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    out = x
+    for a in sorted(attrs['axes']):
+        out = jnp.expand_dims(out, a)
+    return {'Out': out}
+
+
+@register('transpose')
+def _transpose(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    return {'Out': jnp.transpose(x, attrs['axis'])}
+
+
+@register('split')
+def _split(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    axis = attrs.get('axis', -1)
+    num = attrs.get('num', 0)
+    sections = attrs.get('sections')
+    if sections:
+        idx = np.cumsum(sections)[:-1].tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, num, axis=axis)
+    return {'Out': list(outs)}
+
+
+@register('stack')
+def _stack(ins, attrs, ctx):
+    xs = [data_of(v) for v in ins['X']]
+    return {'Y': jnp.stack(xs, axis=attrs.get('axis', 0))}
+
+
+@register('flatten')
+def _flatten(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    axis = attrs.get('axis', 1)
+    lead = int(np.prod(x.shape[:axis])) if axis > 0 else 1
+    return {'Out': x.reshape(lead, -1)}
+
+
+@register('pad')
+def _pad(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    p = attrs['paddings']
+    pads = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return {'Out': jnp.pad(x, pads, constant_values=attrs.get('pad_value', 0.0))}
+
+
+@register('crop')
+def _crop(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    offsets = attrs.get('offsets')
+    shape = attrs.get('shape')
+    if 'Y' in ins and ins['Y']:
+        shape = data_of(ins['Y'][0]).shape
+    return {'Out': jax.lax.dynamic_slice(x, offsets, shape)}
+
+
+@register('slice')
+def _slice(ins, attrs, ctx):
+    x = data_of(ins['Input'][0])
+    axes = attrs['axes']
+    starts = attrs['starts']
+    ends = attrs['ends']
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s2 = s + dim if s < 0 else min(s, dim)
+        e2 = e + dim if e < 0 else min(e, dim)
+        idx[a] = slice(s2, e2)
+    return {'Out': x[tuple(idx)]}
+
+
+@register('gather')
+def _gather(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    index = data_of(ins['Index'][0]).astype(jnp.int32)
+    return {'Out': jnp.take(x, index, axis=0)}
+
+
+@register('scatter')
+def _scatter(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    ids = data_of(ins['Ids'][0]).astype(jnp.int32)
+    upd = data_of(ins['Updates'][0])
+    return {'Out': x.at[ids].set(upd)}
+
+
+@register('top_k')
+def _top_k(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    k = attrs['k']
+    vals, idx = jax.lax.top_k(x, k)
+    return {'Out': vals, 'Indices': idx.astype(jnp.int64)}
+
+
+@register('arg_min')
+def _arg_min(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    return {'Out': jnp.argmin(x, axis=attrs.get('axis', 0)).astype(jnp.int64)}
+
+
+@register('arg_max')
+def _arg_max(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    return {'Out': jnp.argmax(x, axis=attrs.get('axis', 0)).astype(jnp.int64)}
+
+
+@register('argsort')
+def _argsort(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    axis = attrs.get('axis', -1)
+    idx = jnp.argsort(x, axis=axis)
+    return {'Out': jnp.sort(x, axis=axis), 'Indices': idx.astype(jnp.int64)}
+
+
+@register('one_hot')
+def _one_hot(ins, attrs, ctx):
+    x = data_of(ins['X'][0]).astype(jnp.int32)
+    depth = attrs['depth']
+    if x.ndim >= 2 and x.shape[-1] == 1:
+        x = jnp.squeeze(x, -1)
+    out = jax.nn.one_hot(x, depth, dtype=jnp.float32)
+    return {'Out': like(ins['X'][0], out)}
+
+
+@register('reverse')
+def _reverse(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    axes = attrs['axis']
+    if not isinstance(axes, (list, tuple)):
+        axes = [axes]
+    return {'Out': jnp.flip(x, axis=tuple(axes))}
+
+
+@register('multiplex')
+def _multiplex(ins, attrs, ctx):
+    ids = data_of(ins['Ids'][0]).astype(jnp.int32).reshape(-1)
+    xs = jnp.stack([data_of(v) for v in ins['X']], axis=0)  # [n, B, ...]
+    rows = jnp.arange(ids.shape[0])
+    return {'Out': xs[ids, rows]}
+
+
+@register('increment')
+def _increment(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    return {'Out': x + jnp.asarray(attrs.get('step', 1.0), dtype=x.dtype)}
+
+
+@register('is_empty')
+def _is_empty(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    return {'Out': jnp.asarray(x.size == 0)}
+
+
+@register('label_smooth')
+def _label_smooth(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    eps = attrs.get('epsilon', 0.0)
+    if 'PriorDist' in ins and ins['PriorDist']:
+        prior = data_of(ins['PriorDist'][0])
+        out = (1 - eps) * x + eps * prior
+    else:
+        out = (1 - eps) * x + eps / x.shape[-1]
+    return {'Out': like(ins['X'][0], out)}
+
+
+@register('random_crop')
+def _random_crop(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    shape = attrs['shape']  # crop shape for trailing dims
+    lead = x.ndim - len(shape)
+    key = ctx.rng()
+    starts = []
+    for i, s in enumerate(shape):
+        limit = x.shape[lead + i] - s
+        key, sub = jax.random.split(key)
+        starts.append(jax.random.randint(sub, (), 0, max(limit, 0) + 1))
+    start_idx = [jnp.asarray(0)] * lead + starts
+    sizes = list(x.shape[:lead]) + list(shape)
+    return {'Out': jax.lax.dynamic_slice(x, start_idx, sizes)}
+
+
+@register('assign_value')
+def _assign_value(ins, attrs, ctx):
+    vals = np.asarray(attrs['values'], dtype=_np_dtype(attrs.get('dtype', 'float32')))
+    return {'Out': jnp.asarray(vals.reshape(attrs['shape']))}

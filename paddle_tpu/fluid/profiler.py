@@ -1,0 +1,69 @@
+"""Profiler. Parity: reference python/paddle/fluid/profiler.py.
+
+The reference wraps CUDA profiler + its own C++ event tracer; here the
+device timeline comes from jax.profiler (XLA trace viewable in TensorBoard/
+Perfetto) and the summary table from host wall-clock around Executor.run.
+"""
+import contextlib
+import os
+import time
+
+__all__ = ['cuda_profiler', 'reset_profiler', 'profiler', 'start_profiler',
+           'stop_profiler']
+
+_state = {'active': False, 'trace_dir': None, 't0': None}
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file, output_mode=None, config=None):
+    """Compat shim (no CUDA on TPU): behaves like profiler()."""
+    with profiler('All', 'default', output_file):
+        yield
+
+
+def start_profiler(state='All', trace_dir=None):
+    if _state['active']:
+        return
+    import jax
+    trace_dir = trace_dir or os.environ.get('PADDLE_TPU_TRACE_DIR',
+                                            '/tmp/paddle_tpu_trace')
+    try:
+        jax.profiler.start_trace(trace_dir)
+        _state['trace_dir'] = trace_dir
+    except Exception:
+        _state['trace_dir'] = None
+    _state['active'] = True
+    _state['t0'] = time.time()
+
+
+def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
+    if not _state['active']:
+        return
+    import jax
+    if _state['trace_dir'] is not None:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+    wall = time.time() - _state['t0']
+    report = ("------------- paddle_tpu profiler -------------\n"
+              "wall time: %.3fs\nXLA trace: %s\n" %
+              (wall, _state['trace_dir'] or '(trace unavailable)'))
+    try:
+        with open(profile_path, 'w') as f:
+            f.write(report)
+    except Exception:
+        pass
+    print(report)
+    _state['active'] = False
+
+
+def reset_profiler():
+    _state['t0'] = time.time()
+
+
+@contextlib.contextmanager
+def profiler(state='All', sorted_key='default', profile_path='/tmp/profile'):
+    start_profiler(state)
+    yield
+    stop_profiler(sorted_key, profile_path)

@@ -1,0 +1,71 @@
+"""Distributed training transpiler.
+
+Parity: reference python/paddle/fluid/transpiler/distribute_transpiler.py,
+which splits the program into trainer programs (send/recv gradient ops over
+gRPC) and parameter-server programs (optimizer ops moved server-side).
+
+TPU-first redesign: parameter servers do not exist on a TPU pod — gradients
+ride the ICI mesh as XLA all-reduces (see parallel_executor.py), and
+multi-host scaling is the same GSPMD program over a larger mesh
+(jax.distributed). The transpiler therefore becomes a *configuration*
+object: it validates the topology, annotates the program with the mesh
+geometry, and (for API compatibility) returns the original program from
+get_trainer_program() and a no-op program from get_pserver_program() so
+reference-style training scripts run unmodified. Sharded-optimizer-state
+("pserver-like" memory scaling, i.e. ZeRO) is exposed via
+paddle_tpu.parallel.shard_optimizer_states.
+"""
+from ..framework import Program, default_main_program
+
+__all__ = ['DistributeTranspiler']
+
+
+class DistributeTranspiler(object):
+    def __init__(self, config=None):
+        self._config = config
+        self._trainers = 1
+        self._trainer_id = 0
+        self._program = None
+        self._sync_mode = True
+
+    def transpile(self, trainer_id, program=None, pservers="127.0.0.1:6174",
+                  trainers=1, sync_mode=True, startup_program=None,
+                  slice_var_up=True, split_method=None):
+        """Record the topology and annotate the program with the dp mesh
+        size. trainer_id/trainers map onto mesh coordinates."""
+        if program is None:
+            program = default_main_program()
+        if isinstance(pservers, str):
+            pserver_endpoints = [e for e in pservers.split(",") if e]
+        else:
+            pserver_endpoints = list(pservers)
+        self._trainer_id = trainer_id
+        self._trainers = trainers
+        self._program = program
+        self._sync_mode = sync_mode
+        self._pserver_endpoints = pserver_endpoints
+        program._dist_config = {
+            'mesh_axes': ('dp',),
+            'dp_size': trainers,
+            'trainer_id': trainer_id,
+            'sync_mode': sync_mode,
+        }
+        return self
+
+    def get_trainer_program(self):
+        """The trainer program IS the original program — GSPMD shards it
+        over the mesh at jit time (no send/recv op rewriting)."""
+        return self._program
+
+    def get_pserver_program(self, endpoint):
+        """No parameter server exists on TPU; return an empty program so
+        reference launcher scripts that start pserver processes degrade
+        gracefully."""
+        return Program()
+
+    def get_pserver_programs(self, endpoint):
+        return self.get_pserver_program(endpoint), Program()
+
+    def get_startup_program(self, endpoint=None, pserver_program=None,
+                            startup_program=None):
+        return Program()

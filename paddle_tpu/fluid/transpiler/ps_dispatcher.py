@@ -1,0 +1,43 @@
+"""PS dispatchers. Parity: reference transpiler/ps_dispatcher.py (HashName/
+RoundRobin decide which pserver owns a var). Kept for API compatibility;
+with GSPMD the "dispatch" is the mesh sharding spec."""
+
+__all__ = ['PSDispatcher', 'HashName', 'RoundRobin']
+
+
+class PSDispatcher(object):
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+        self._step = 0
+
+    @property
+    def eps(self):
+        return self._eps
+
+    def reset(self):
+        self._step = 0
+
+    def dispatch(self, varlist):
+        raise NotImplementedError("Interface has not been implemented.")
+
+
+class HashName(PSDispatcher):
+    def _hash_block(self, block_str, total):
+        return hash(block_str) % total
+
+    def dispatch(self, varlist):
+        eplist = []
+        for var in varlist:
+            server_id = self._hash_block(var.name, len(self._eps))
+            eplist.append(self._eps[server_id])
+        return eplist
+
+
+class RoundRobin(PSDispatcher):
+    def dispatch(self, varlist):
+        eplist = []
+        for var in varlist:
+            server = self._eps[self._step]
+            eplist.append(server)
+            self._step = (self._step + 1) % len(self._eps)
+        return eplist

@@ -1,0 +1,219 @@
+"""Reader decorators. Parity: reference python/paddle/reader/decorator.py."""
+import itertools
+import random
+from queue import Queue
+from threading import Thread
+
+__all__ = [
+    'map_readers', 'buffered', 'compose', 'chain', 'shuffle',
+    'ComposeNotAligned', 'firstn', 'xmap_readers', 'Fake', 'cache',
+]
+
+from . import pipeline  # noqa: F401
+from . import recordio  # noqa: F401
+
+
+def map_readers(func, *readers):
+    def reader():
+        rs = [r() for r in readers]
+        for e in map(func, *rs):
+            yield e
+    return reader
+
+
+def shuffle(reader, buf_size):
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        if len(buf) > 0:
+            random.shuffle(buf)
+            for b in buf:
+                yield b
+    return data_reader
+
+
+def chain(*readers):
+    def reader():
+        rs = [r() for r in readers]
+        for e in itertools.chain(*rs):
+            yield e
+    return reader
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def compose(*readers, **kwargs):
+    check_alignment = kwargs.pop('check_alignment', True)
+
+    def make_tuple(x):
+        if isinstance(x, tuple):
+            return x
+        else:
+            return (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum(list(map(make_tuple, outputs)), ())
+        else:
+            for outputs in zip(*rs):
+                lens = set(map(len, outputs)) if all(
+                    isinstance(o, tuple) for o in outputs) else None
+                yield sum(list(map(make_tuple, outputs)), ())
+    return reader
+
+
+def buffered(reader, size):
+    """Prefetch up to `size` samples in a background thread."""
+
+    class EndSignal():
+        pass
+
+    end = EndSignal()
+
+    def read_worker(r, q):
+        for d in r:
+            q.put(d)
+        q.put(end)
+
+    def data_reader():
+        r = reader()
+        q = Queue(maxsize=size)
+        t = Thread(target=read_worker, args=(r, q))
+        t.daemon = True
+        t.start()
+        e = q.get()
+        while e is not end:
+            yield e
+            e = q.get()
+    return data_reader
+
+
+def firstn(reader, n):
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i == n:
+                break
+            yield item
+    return firstn_reader
+
+
+class XmapEndSignal():
+    pass
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over a reader with worker threads (reference
+    decorator.py:xmap_readers)."""
+    end = XmapEndSignal()
+
+    def read_worker(reader, in_queue):
+        for i in reader():
+            in_queue.put(i)
+        in_queue.put(end)
+
+    def order_read_worker(reader, in_queue):
+        in_order = 0
+        for i in reader():
+            in_queue.put((in_order, i))
+            in_order += 1
+        in_queue.put(end)
+
+    def handle_worker(in_queue, out_queue, mapper):
+        sample = in_queue.get()
+        while not isinstance(sample, XmapEndSignal):
+            r = mapper(sample)
+            out_queue.put(r)
+            sample = in_queue.get()
+        in_queue.put(end)
+        out_queue.put(end)
+
+    def order_handle_worker(in_queue, out_queue, mapper, out_order):
+        ins = in_queue.get()
+        while not isinstance(ins, XmapEndSignal):
+            order, sample = ins
+            r = mapper(sample)
+            while order != out_order[0]:
+                pass
+            out_queue.put(r)
+            out_order[0] += 1
+            ins = in_queue.get()
+        in_queue.put(end)
+        out_queue.put(end)
+
+    def xreader():
+        in_queue = Queue(buffer_size)
+        out_queue = Queue(buffer_size)
+        out_order = [0]
+        target = order_read_worker if order else read_worker
+        t = Thread(target=target, args=(reader, in_queue))
+        t.daemon = True
+        t.start()
+        target = order_handle_worker if order else handle_worker
+        args = (in_queue, out_queue, mapper, out_order) if order else (
+            in_queue, out_queue, mapper)
+        workers = []
+        for i in range(process_num):
+            worker = Thread(target=target, args=args)
+            worker.daemon = True
+            workers.append(worker)
+        for w in workers:
+            w.start()
+        sample = out_queue.get()
+        finish = 1
+        while not isinstance(sample, XmapEndSignal):
+            yield sample
+            sample = out_queue.get()
+            while isinstance(sample, XmapEndSignal):
+                finish += 1
+                if finish == process_num:
+                    break
+                sample = out_queue.get()
+            if finish == process_num:
+                break
+    return xreader
+
+
+def cache(reader):
+    all_data = []
+
+    def __impl__():
+        if not all_data:
+            for d in reader():
+                all_data.append(d)
+                yield d
+        else:
+            for d in all_data:
+                yield d
+    return __impl__
+
+
+class Fake(object):
+    """Cache the first sample and replay it n times (reference
+    decorator.py:Fake) — for IO-free benchmarking."""
+
+    def __init__(self):
+        self.data = None
+        self.yield_num = 0
+
+    def _read_into_memory(self, reader):
+        self.data = next(reader())
+
+    def __call__(self, reader, n):
+        def fake_reader():
+            if self.data is None:
+                self._read_into_memory(reader)
+            while self.yield_num < n:
+                yield self.data
+                self.yield_num += 1
+            self.yield_num = 0
+        return fake_reader

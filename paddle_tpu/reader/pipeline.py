@@ -1,0 +1,38 @@
+"""Background host->device prefetch pipeline.
+
+TPU-first equivalent of the reference's C++ double_buffer reader
+(paddle/fluid/operators/reader/create_double_buffer_reader_op.cc): a
+daemon thread stages upcoming batches so device steps never wait on host
+IO. A C++ staged loader (paddle_tpu/csrc) backs the recordio path.
+"""
+from queue import Queue
+from threading import Thread
+
+__all__ = ['prefetch']
+
+_END = object()
+
+
+def prefetch(reader, depth=2):
+    """Wrap a generator-factory with an N-deep background prefetch queue."""
+
+    def wrapped():
+        q = Queue(maxsize=depth)
+
+        def worker():
+            try:
+                for item in reader():
+                    q.put(item)
+            finally:
+                q.put(_END)
+
+        t = Thread(target=worker)
+        t.daemon = True
+        t.start()
+        while True:
+            item = q.get()
+            if item is _END:
+                break
+            yield item
+
+    return wrapped

@@ -1,0 +1,125 @@
+"""Chunked record file format (recordio equivalent).
+
+Parity: reference paddle/fluid/recordio/ (C++ chunked writer/reader with
+per-chunk checksums) + python recordio usage in benchmark/fluid.
+Format: magic | per-record [u32 len | payload] with chunk framing; the
+C++ fast path (paddle_tpu/csrc/recordio.cpp) mmaps and parses chunks; this
+module is the pure-python fallback and the writer.
+"""
+import os
+import struct
+import zlib
+
+import numpy as np
+
+__all__ = ['RecordIOWriter', 'RecordIOReader', 'write_samples', 'read_samples',
+           'convert_reader_to_recordio_file']
+
+_MAGIC = b'PTRIO1\n'
+
+
+class RecordIOWriter(object):
+    def __init__(self, path):
+        self._f = open(path, 'wb')
+        self._f.write(_MAGIC)
+
+    def write(self, payload: bytes):
+        crc = zlib.crc32(payload) & 0xFFFFFFFF
+        self._f.write(struct.pack('<II', len(payload), crc))
+        self._f.write(payload)
+
+    def close(self):
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+class RecordIOReader(object):
+    def __init__(self, path):
+        self.path = path
+
+    def __iter__(self):
+        with open(self.path, 'rb') as f:
+            magic = f.read(len(_MAGIC))
+            if magic != _MAGIC:
+                raise ValueError("%s is not a paddle_tpu recordio file" % self.path)
+            while True:
+                hdr = f.read(8)
+                if len(hdr) < 8:
+                    break
+                ln, crc = struct.unpack('<II', hdr)
+                payload = f.read(ln)
+                if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+                    raise IOError("checksum mismatch in %s" % self.path)
+                yield payload
+
+
+def _pack_sample(arrays):
+    parts = [struct.pack('<I', len(arrays))]
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        dt = a.dtype.str.encode()
+        parts.append(struct.pack('<I', len(dt)))
+        parts.append(dt)
+        parts.append(struct.pack('<I', a.ndim))
+        parts.append(struct.pack('<%dq' % a.ndim, *a.shape))
+        raw = a.tobytes()
+        parts.append(struct.pack('<Q', len(raw)))
+        parts.append(raw)
+    return b''.join(parts)
+
+
+def _unpack_sample(payload):
+    off = 0
+
+    def take(n):
+        nonlocal off
+        out = payload[off:off + n]
+        off += n
+        return out
+
+    n_arr, = struct.unpack('<I', take(4))
+    out = []
+    for _ in range(n_arr):
+        dt_len, = struct.unpack('<I', take(4))
+        dt = take(dt_len).decode()
+        ndim, = struct.unpack('<I', take(4))
+        shape = struct.unpack('<%dq' % ndim, take(8 * ndim))
+        raw_len, = struct.unpack('<Q', take(8))
+        arr = np.frombuffer(take(raw_len), dtype=np.dtype(dt)).reshape(shape)
+        out.append(arr)
+    return tuple(out)
+
+
+def write_samples(path, samples):
+    with RecordIOWriter(path) as w:
+        n = 0
+        for s in samples:
+            if not isinstance(s, (list, tuple)):
+                s = (s,)
+            w.write(_pack_sample([np.asarray(x) for x in s]))
+            n += 1
+    return n
+
+
+def read_samples(path, shapes=None, dtypes=None):
+    # C++ fast path when the native library is built
+    try:
+        from ..utils import native
+        if native.available():
+            for payload in native.recordio_iter(path):
+                yield _unpack_sample(payload)
+            return
+    except Exception:
+        pass
+    for payload in RecordIOReader(path):
+        yield _unpack_sample(payload)
+
+
+def convert_reader_to_recordio_file(filename, reader_creator, feeder=None):
+    """Parity: fluid.recordio_writer.convert_reader_to_recordio_file."""
+    return write_samples(filename, reader_creator())

@@ -1,0 +1,58 @@
+"""ctypes bindings for the C++ runtime (paddle_tpu/csrc).
+
+Gracefully degrades to pure-python when the shared library is not built;
+build with `make -C paddle_tpu/csrc`.
+"""
+import ctypes
+import os
+
+_LIB = None
+_TRIED = False
+
+
+def _lib_path():
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(here, 'csrc', 'libpaddle_tpu_native.so')
+
+
+def _load():
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    p = _lib_path()
+    if os.path.exists(p):
+        try:
+            _LIB = ctypes.CDLL(p)
+        except OSError:
+            _LIB = None
+    return _LIB
+
+
+def available():
+    return _load() is not None
+
+
+def recordio_iter(path):
+    """Iterate raw record payloads via the C++ chunk parser."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library not built")
+    lib.ptrio_open.restype = ctypes.c_void_p
+    lib.ptrio_open.argtypes = [ctypes.c_char_p]
+    lib.ptrio_next.restype = ctypes.c_ssize_t
+    lib.ptrio_next.argtypes = [ctypes.c_void_p,
+                               ctypes.POINTER(ctypes.c_char_p)]
+    lib.ptrio_close.argtypes = [ctypes.c_void_p]
+    h = lib.ptrio_open(path.encode())
+    if not h:
+        raise IOError("cannot open %s" % path)
+    try:
+        while True:
+            buf = ctypes.c_char_p()
+            n = lib.ptrio_next(h, ctypes.byref(buf))
+            if n < 0:
+                break
+            yield ctypes.string_at(buf, n)
+    finally:
+        lib.ptrio_close(h)

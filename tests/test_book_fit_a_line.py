@@ -1,0 +1,53 @@
+"""End-to-end linear regression (reference fluid/tests/book/test_fit_a_line.py)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+
+from util import fresh_program
+
+
+def test_fit_a_line_converges():
+    with fresh_program() as (main, startup):
+        x = fluid.layers.data(name='x', shape=[13], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        y_predict = fluid.layers.fc(input=x, size=1, act=None)
+        cost = fluid.layers.square_error_cost(input=y_predict, label=y)
+        avg_cost = fluid.layers.mean(x=cost)
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(avg_cost)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        feeder = fluid.DataFeeder(place=fluid.CPUPlace(), feed_list=[x, y])
+        reader = paddle.batch(paddle.dataset.uci_housing.train(),
+                              batch_size=23)
+        first = None
+        for epoch in range(10):
+            for batch in reader():
+                loss, = exe.run(main, feed=feeder.feed(batch),
+                                fetch_list=[avg_cost])
+                if first is None:
+                    first = float(loss)
+        assert float(loss) < first * 0.2, (first, float(loss))
+
+
+def test_infer_after_train_and_save_load(tmp_path):
+    with fresh_program() as (main, startup):
+        x = fluid.layers.data(name='x', shape=[13], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        y_predict = fluid.layers.fc(input=x, size=1, act=None)
+        cost = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=y_predict, label=y))
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(cost)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        xin = np.random.rand(4, 13).astype('float32')
+        yin = np.random.rand(4, 1).astype('float32')
+        exe.run(main, feed={'x': xin, 'y': yin}, fetch_list=[cost])
+        fluid.io.save_inference_model(str(tmp_path), ['x'], [y_predict], exe,
+                                      main_program=main)
+        prog2, feed_names, fetch_vars = fluid.io.load_inference_model(
+            str(tmp_path), exe)
+        assert feed_names == ['x']
+        out, = exe.run(prog2, feed={'x': xin}, fetch_list=fetch_vars)
+        assert out.shape == (4, 1)

@@ -1,0 +1,68 @@
+"""MNIST conv net end-to-end (reference fluid/tests/book/test_recognize_digits.py)."""
+import itertools
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+
+from util import fresh_program
+
+
+def _lenet(img, label):
+    conv1 = fluid.nets.simple_img_conv_pool(input=img, filter_size=5,
+                                            num_filters=8, pool_size=2,
+                                            pool_stride=2, act="relu")
+    conv1 = fluid.layers.batch_norm(conv1)
+    conv2 = fluid.nets.simple_img_conv_pool(input=conv1, filter_size=5,
+                                            num_filters=16, pool_size=2,
+                                            pool_stride=2, act="relu")
+    prediction = fluid.layers.fc(input=conv2, size=10, act='softmax')
+    avg_cost = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=prediction, label=label))
+    acc = fluid.layers.accuracy(input=prediction, label=label)
+    return prediction, avg_cost, acc
+
+
+def test_mnist_lenet_trains():
+    with fresh_program() as (main, startup):
+        img = fluid.layers.data(name='img', shape=[1, 28, 28], dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        _, avg_cost, acc = _lenet(img, label)
+        fluid.optimizer.Adam(learning_rate=0.003).minimize(avg_cost)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        reader = paddle.batch(paddle.dataset.mnist.train(), batch_size=32)
+        feeder = fluid.DataFeeder(place=fluid.CPUPlace(),
+                                  feed_list=[img, label])
+        acc_v = 0.0
+        for epoch in range(2):
+            for batch in itertools.islice(reader(), 30):
+                rows = [(b[0].reshape(1, 28, 28), b[1]) for b in batch]
+                loss_v, acc_v = exe.run(main, feed=feeder.feed(rows),
+                                        fetch_list=[avg_cost, acc])
+        assert float(acc_v) > 0.8, float(acc_v)
+
+
+def test_mnist_mlp_momentum():
+    with fresh_program() as (main, startup):
+        img = fluid.layers.data(name='img', shape=[784], dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        h = fluid.layers.fc(input=img, size=64, act='relu')
+        pred = fluid.layers.fc(input=h, size=10, act='softmax')
+        avg_cost = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=pred, label=label))
+        fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9) \
+            .minimize(avg_cost)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        reader = paddle.batch(paddle.dataset.mnist.train(), batch_size=64)
+        feeder = fluid.DataFeeder(place=fluid.CPUPlace(),
+                                  feed_list=[img, label])
+        losses = []
+        for batch in itertools.islice(reader(), 60):
+            loss_v, = exe.run(main, feed=feeder.feed(batch),
+                              fetch_list=[avg_cost])
+            losses.append(float(loss_v))
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])

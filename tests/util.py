@@ -1,0 +1,21 @@
+"""Shared test helpers: fresh programs per test."""
+import contextlib
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework, unique_name
+from paddle_tpu.fluid.executor import Scope, _switch_scope
+
+
+@contextlib.contextmanager
+def fresh_program():
+    """Isolated main/startup program + scope + name generator."""
+    main = framework.Program()
+    startup = framework.Program()
+    scope = Scope()
+    prev_scope = _switch_scope(scope)
+    with unique_name.guard():
+        with framework.program_guard(main, startup):
+            try:
+                yield main, startup
+            finally:
+                _switch_scope(prev_scope)
