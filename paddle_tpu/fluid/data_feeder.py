@@ -46,12 +46,18 @@ class DataToLoDTensorConverter(object):
             if self.shape and len(arr.shape) != len(self.shape) + 1:
                 arr = arr.reshape([-1] + [abs(int(s)) for s in self.shape])
             return arr
-        # sequence slot: rows are python sequences; build padded SeqValue
+        # sequence slot: _feed_impl_ flattened tokens into self.data and
+        # recorded per-sample lengths in self.lod; rebuild padded SeqValue
         from .lowering import SeqValue
         import jax.numpy as jnp
-        seqs = [np.asarray(s, dtype=self.dtype) for s in self.data]
-        seqs = [s[:, None] if s.ndim == 1 else s for s in seqs]
-        lens = np.asarray([s.shape[0] for s in seqs], dtype=np.int32)
+        flat = np.asarray(self.data, dtype=self.dtype)
+        if flat.ndim == 1:
+            flat = flat[:, None]
+        lens = np.asarray(self.lod[-1], dtype=np.int32)
+        outer = (jnp.asarray(np.asarray(self.lod[0], np.int32))
+                 if self.lod_level > 1 else None)
+        offsets = np.concatenate([[0], np.cumsum(lens)])
+        seqs = [flat[offsets[i]:offsets[i + 1]] for i in range(len(lens))]
         maxlen = int(lens.max()) if len(lens) else 1
         if pad_bucketing:
             maxlen = _bucket(maxlen)
@@ -59,7 +65,7 @@ class DataToLoDTensorConverter(object):
         padded = np.zeros((len(seqs), maxlen) + trail, dtype=self.dtype)
         for i, s in enumerate(seqs):
             padded[i, :s.shape[0]] = s
-        return SeqValue(jnp.asarray(padded), jnp.asarray(lens))
+        return SeqValue(jnp.asarray(padded), jnp.asarray(lens), outer)
 
 
 class DataFeeder(object):

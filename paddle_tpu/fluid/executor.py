@@ -112,8 +112,7 @@ def _feed_signature(name, val):
 class _CompiledStep(object):
     """One lowered+jitted (program, feed-sig, fetch) combination."""
 
-    def __init__(self, program, block, feed_names, fetch_names, persist_in,
-                 mesh_sharding=None):
+    def __init__(self, program, block, feed_names, fetch_names, persist_in):
         self.program = program
         ops = list(block.ops)
         self.ops = ops
@@ -131,7 +130,6 @@ class _CompiledStep(object):
                     if v.name in persistable:
                         produced.add(v.name)
         self.persist_out = sorted(produced)
-        self.mesh_sharding = mesh_sharding
 
         def run_range(env, lo, hi, key, grad_mode=False):
             for i in range(lo, hi):
@@ -177,6 +175,7 @@ class _CompiledStep(object):
             new_persist = {n: env[n] for n in self.persist_out if n in env}
             return fetches, new_persist
 
+        self._step = step  # pure, un-jitted (re-jittable with shardings)
         self._jitted = jax.jit(step, donate_argnums=(0,))
 
     def __call__(self, persist, feed, key):
@@ -197,6 +196,13 @@ class Executor(object):
         return self.place.jax_device()
 
     def _to_device(self, val, var=None):
+        if isinstance(val, jax.Array):
+            from jax.sharding import NamedSharding
+            if (isinstance(val.sharding, NamedSharding)
+                    or len(val.sharding.device_set) > 1):
+                # mesh-placed by the caller — don't collapse the sharding
+                return val
+            return jax.device_put(val, self._device())
         if isinstance(val, SeqValue):
             return SeqValue(jax.device_put(jnp.asarray(val.data), self._device()),
                             jax.device_put(jnp.asarray(val.lengths), self._device()),

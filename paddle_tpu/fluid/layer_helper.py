@@ -151,6 +151,11 @@ class LayerHelper(object):
         """Add a bias over dims [dim_start, dim_end) of the input."""
         size = list(input_var.shape[dim_start:dim_end])
         bias_attr = self.bias_attr
+        if bias_attr and any(d == -1 for d in size):
+            raise ValueError(
+                "bias shape %s contains a dynamic dim; pass dim_start/"
+                "dim_end selecting only static dims (e.g. dim_start=-1 for "
+                "the feature axis of a sequence)" % (size,))
         if not bias_attr:
             return input_var
         b = self.create_parameter(attr=bias_attr, shape=size,

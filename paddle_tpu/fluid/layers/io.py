@@ -15,9 +15,10 @@ def data(name, shape, append_batch_size=True, dtype='float32', lod_level=0,
     shape = list(shape)
     if append_batch_size:
         shape = [-1] + shape
-    else:
-        # reference: interpret explicit -1 dims as dynamic already
-        pass
+    if lod_level and lod_level > 0:
+        # TPU-native padded layout: sequences are dense [batch, time, ...],
+        # so the declared fluid shape gains a dynamic time axis.
+        shape = [shape[0], -1] + shape[1:]
     return helper.create_global_variable(
         name=name, shape=shape, dtype=convert_dtype(dtype),
         lod_level=lod_level, stop_gradient=stop_gradient, is_data=True)

@@ -315,7 +315,7 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
                      attrs={'contextStride': filter_stride,
                             'contextStart': -int(filter_size // 2),
                             'contextLength': filter_size})
-    pre_act = helper.append_bias_op(pre_bias)
+    pre_act = helper.append_bias_op(pre_bias, dim_start=-1)
     return helper.append_activation(pre_act)
 
 

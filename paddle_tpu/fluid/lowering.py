@@ -153,6 +153,8 @@ def infer_op_shapes(op):
             return None
         s = var._spec()
         if var.lod_level and var.lod_level > 0:
+            # padded layout [batch, time, ...]; shape already carries both
+            # dynamic dims (see layers/io.py:data)
             batch = s.shape[0]
             lens = jax.ShapeDtypeStruct((batch,), np.int32)
             if var.lod_level > 1:

@@ -369,3 +369,62 @@ def _lstm_unit(ins, attrs, ctx):
     c = f * c_prev + i * jnp.tanh(gc)
     h = o * jnp.tanh(c)
     return {'C': c, 'H': h}
+
+
+@register('attention_lstm_decoder')
+def _attention_lstm_decoder(ins, attrs, ctx):
+    """Fused attention decoder for seq2seq (parity with the reference's
+    per-step ConditionalBlock/StaticRNN decoder in
+    benchmark/fluid/models/machine_translation.py:lstm_step — there the
+    attention+cell is re-dispatched op-by-op every timestep; here it is one
+    lax.scan whose body is three MXU matmuls).
+
+    Inputs:
+      TrgEmb   [B, T, E]   (SeqValue) target-side embeddings (teacher forcing)
+      EncOut   [B, S, D]   (SeqValue) encoder outputs
+      WDec     [E+D, 4H]   input+context -> gates
+      UDec     [H, 4H]     hidden -> gates
+      BDec     [1, 4H]
+      WAttnQ   [H, D]      decoder-state -> attention query
+    Output: Hidden [B, T, H] (SeqValue)
+    """
+    trg = _seq(ins['TrgEmb'][0])
+    enc = _seq(ins['EncOut'][0])
+    w_dec = data_of(ins['WDec'][0])
+    u_dec = data_of(ins['UDec'][0])
+    b_dec = data_of(ins['BDec'][0]) if ins.get('BDec') else 0.0
+    w_q = data_of(ins['WAttnQ'][0])
+    b, t, e = trg.data.shape
+    s = enc.data.shape[1]
+    h = u_dec.shape[0]
+    enc_mask = enc.mask(jnp.float32)  # [B, S]
+    neg = jnp.finfo(jnp.float32).min
+
+    xs = jnp.swapaxes(trg.data, 0, 1)  # [T, B, E]
+    steps = jnp.arange(t)
+    valid_t = (steps[:, None] < trg.lengths[None, :])  # [T, B]
+
+    h0 = jnp.zeros((b, h), trg.data.dtype)
+    c0 = jnp.zeros((b, h), trg.data.dtype)
+
+    def step(carry, inp):
+        hp, cp = carry
+        x_t, valid = inp
+        # dot-product attention over encoder states
+        q = hp @ w_q  # [B, D]
+        scores = jnp.einsum('bd,bsd->bs', q, enc.data)
+        scores = jnp.where(enc_mask > 0, scores, neg)
+        alpha = jax.nn.softmax(scores, axis=-1)
+        ctx_vec = jnp.einsum('bs,bsd->bd', alpha, enc.data)  # [B, D]
+        g = jnp.concatenate([x_t, ctx_vec], axis=-1) @ w_dec + hp @ u_dec + b_dec
+        gi, gf, gc, go = jnp.split(g, 4, axis=-1)
+        i = jax.nn.sigmoid(gi)
+        f = jax.nn.sigmoid(gf)
+        o = jax.nn.sigmoid(go)
+        c_new = f * cp + i * jnp.tanh(gc)
+        h_new = o * jnp.tanh(c_new)
+        vm = valid[:, None].astype(h_new.dtype)
+        return (vm * h_new + (1 - vm) * hp, vm * c_new + (1 - vm) * cp), \
+            vm * h_new
+    _, hs = lax.scan(step, (h0, c0), (xs, valid_t))
+    return {'Hidden': SeqValue(jnp.swapaxes(hs, 0, 1), trg.lengths)}

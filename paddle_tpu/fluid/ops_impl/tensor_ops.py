@@ -88,8 +88,30 @@ def _cast(ins, attrs, ctx):
 
 @register('concat')
 def _concat(ins, attrs, ctx):
-    xs = [data_of(v) for v in ins['X']]
-    return {'Out': jnp.concatenate(xs, axis=attrs.get('axis', 0))}
+    from ..lowering import first_seq, SeqValue
+    vs = ins['X']
+    xs = [data_of(v) for v in vs]
+    axis = attrs.get('axis', 0)
+    out = jnp.concatenate(xs, axis=axis)
+    seq = first_seq(*vs)
+    if seq is None:
+        return {'Out': out}
+    all_seq = all(isinstance(v, SeqValue) for v in vs)
+    if axis == 0 and all_seq:
+        # batch concat: stack lengths too
+        return {'Out': SeqValue(out, jnp.concatenate([v.lengths for v in vs]))}
+    if axis == 1 and all_seq:
+        # time concat: every row's valid length is the sum... only exact when
+        # inputs are right-padded contiguously; true when each input is
+        # full-length, else the padding interleaves — reject to avoid
+        # silently masking wrong tokens.
+        lens = vs[0].lengths
+        for v in vs[1:]:
+            lens = lens + v.lengths
+        return {'Out': SeqValue(out, lens)}
+    if axis in (0, 1):
+        return {'Out': out}
+    return {'Out': like(seq, out)}
 
 
 @register('assign')

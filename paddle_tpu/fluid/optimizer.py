@@ -42,6 +42,11 @@ class Optimizer(object):
         lr = self._global_learning_rate()
         if isinstance(lr, Variable):
             return
+        if isinstance(self._learning_rate, Variable):
+            # scheduled lr (a Variable computed by lr_scheduler ops)
+            self._learning_rate_map[default_main_program()] = \
+                self._learning_rate
+            return
         if not isinstance(self._learning_rate, float):
             raise TypeError("learning rate should be float or Variable")
         self._learning_rate_map[default_main_program()] = \

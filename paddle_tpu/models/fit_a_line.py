@@ -1,0 +1,24 @@
+"""Linear regression on UCI housing (Fluid book ch01).
+
+Parity: reference python/paddle/fluid/tests/book/test_fit_a_line.py.
+"""
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+
+__all__ = ['get_model']
+
+
+def get_model(batch_size=20, learning_rate=0.01):
+    x = fluid.layers.data(name='x', shape=[13], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+    y_predict = fluid.layers.fc(input=x, size=1, act=None)
+    cost = fluid.layers.square_error_cost(input=y_predict, label=y)
+    avg_cost = fluid.layers.mean(x=cost)
+    inference_program = fluid.default_main_program().clone(for_test=True)
+    fluid.optimizer.SGD(learning_rate=learning_rate).minimize(avg_cost)
+    train_reader = paddle.batch(
+        paddle.reader.shuffle(paddle.dataset.uci_housing.train(),
+                              buf_size=500), batch_size=batch_size)
+    test_reader = paddle.batch(paddle.dataset.uci_housing.test(),
+                               batch_size=batch_size)
+    return avg_cost, inference_program, train_reader, test_reader, ['x', 'y']

@@ -1,0 +1,51 @@
+"""MNIST LeNet benchmark model.
+
+Parity: reference benchmark/fluid/models/mnist.py (cnn_model:37,
+get_model:68).
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+
+__all__ = ['cnn_model', 'get_model']
+
+
+def cnn_model(data):
+    conv_pool_1 = fluid.nets.simple_img_conv_pool(
+        input=data, filter_size=5, num_filters=20, pool_size=2,
+        pool_stride=2, act="relu")
+    conv_pool_2 = fluid.nets.simple_img_conv_pool(
+        input=conv_pool_1, filter_size=5, num_filters=50, pool_size=2,
+        pool_stride=2, act="relu")
+    SIZE = 10
+    input_shape = conv_pool_2.shape
+    param_shape = [int(np.prod(input_shape[1:]))] + [SIZE]
+    scale = (2.0 / (param_shape[0] ** 2 * SIZE)) ** 0.5
+    predict = fluid.layers.fc(
+        input=conv_pool_2, size=SIZE, act="softmax",
+        param_attr=fluid.ParamAttr(
+            initializer=fluid.initializer.NormalInitializer(
+                loc=0.0, scale=scale)))
+    return predict
+
+
+def get_model(batch_size=128, learning_rate=0.001):
+    images = fluid.layers.data(name='pixel', shape=[1, 28, 28],
+                               dtype='float32')
+    label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+    predict = cnn_model(images)
+    cost = fluid.layers.cross_entropy(input=predict, label=label)
+    avg_cost = fluid.layers.mean(x=cost)
+    batch_acc = fluid.layers.accuracy(input=predict, label=label)
+
+    inference_program = fluid.default_main_program().clone(for_test=True)
+    opt = fluid.optimizer.AdamOptimizer(learning_rate=learning_rate,
+                                        beta1=0.9, beta2=0.999)
+    opt.minimize(avg_cost)
+
+    train_reader = paddle.batch(paddle.dataset.mnist.train(),
+                                batch_size=batch_size)
+    test_reader = paddle.batch(paddle.dataset.mnist.test(),
+                               batch_size=batch_size)
+    return avg_cost, inference_program, train_reader, test_reader, batch_acc

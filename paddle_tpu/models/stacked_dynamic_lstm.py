@@ -1,0 +1,65 @@
+"""Stacked LSTM for IMDB sentiment.
+
+Parity: reference benchmark/fluid/models/stacked_dynamic_lstm.py
+(get_model:46). The reference hand-rolls the LSTM cell inside a DynamicRNN
+block (one C++ op dispatch per gate per timestep); TPU-first this uses the
+fused dynamic_lstm op — one lax.scan whose body is a single gate matmul on
+the MXU, identical math (sigmoid gates, tanh candidate/output over
+fc(word) + fc(hidden)).
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.dataset import imdb
+
+__all__ = ['get_model']
+
+
+def crop_sentence(reader, crop_size):
+    unk_value = None
+
+    def __impl__():
+        for item in reader():
+            if len(item[0]) < crop_size:
+                yield item
+    return __impl__
+
+
+def lstm_net(data, dict_dim, lstm_size=512, emb_dim=512, stacked_num=1):
+    sentence = fluid.layers.embedding(input=data, size=[dict_dim, emb_dim])
+    sentence = fluid.layers.fc(input=sentence, size=lstm_size, act='tanh')
+    inputs = sentence
+    for _ in range(stacked_num):
+        gates = fluid.layers.fc(input=inputs, size=lstm_size * 4,
+                                bias_attr=True)
+        hidden, cell = fluid.layers.dynamic_lstm(
+            input=gates, size=lstm_size * 4, use_peepholes=False)
+        inputs = hidden
+    last = fluid.layers.sequence_pool(inputs, 'last')
+    logit = fluid.layers.fc(input=last, size=2, act='softmax')
+    return logit
+
+
+def get_model(batch_size=32, lstm_size=512, emb_dim=512, crop_size=1500):
+    word_dict = imdb.word_dict()
+    data = fluid.layers.data(name="words", shape=[1], lod_level=1,
+                             dtype='int64')
+    label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+    logit = lstm_net(data, len(word_dict), lstm_size, emb_dim)
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=logit, label=label))
+    batch_acc = fluid.layers.accuracy(input=logit, label=label)
+
+    inference_program = fluid.default_main_program().clone(for_test=True)
+    adam = fluid.optimizer.Adam()
+    adam.minimize(loss)
+
+    train_reader = paddle.batch(
+        paddle.reader.shuffle(
+            crop_sentence(imdb.train(word_dict), crop_size), buf_size=25000),
+        batch_size=batch_size)
+    test_reader = paddle.batch(
+        crop_sentence(imdb.test(word_dict), crop_size),
+        batch_size=batch_size)
+    return loss, inference_program, train_reader, test_reader, batch_acc

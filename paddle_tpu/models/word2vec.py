@@ -1,0 +1,44 @@
+"""N-gram word embedding model (Fluid book ch04 word2vec).
+
+Parity: reference python/paddle/fluid/tests/book/test_word2vec.py — 4 input
+words -> embeddings -> concat -> fc -> softmax over vocab.
+"""
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+
+__all__ = ['get_model']
+
+EMBED_SIZE = 32
+HIDDEN_SIZE = 256
+N = 5
+
+
+def ngram_net(words, dict_size, embed_size=EMBED_SIZE):
+    embeds = []
+    for w in words[:-1]:
+        embeds.append(fluid.layers.embedding(
+            input=w, size=[dict_size, embed_size],
+            param_attr=fluid.ParamAttr(name='shared_w')))
+    concat = fluid.layers.concat(input=embeds, axis=1)
+    hidden = fluid.layers.fc(input=concat, size=HIDDEN_SIZE, act='sigmoid')
+    predict = fluid.layers.softmax(
+        fluid.layers.fc(input=hidden, size=dict_size))
+    return predict
+
+
+def get_model(batch_size=64, learning_rate=0.001):
+    word_dict = paddle.dataset.imikolov.build_dict()
+    dict_size = len(word_dict)
+    words = [fluid.layers.data(name='word_%d' % i, shape=[1], dtype='int64')
+             for i in range(N)]
+    predict = ngram_net(words, dict_size)
+    cost = fluid.layers.cross_entropy(input=predict, label=words[-1])
+    avg_cost = fluid.layers.mean(x=cost)
+    inference_program = fluid.default_main_program().clone(for_test=True)
+    fluid.optimizer.SGD(learning_rate=learning_rate).minimize(avg_cost)
+    train_reader = paddle.batch(paddle.dataset.imikolov.train(word_dict, N),
+                                batch_size)
+    test_reader = paddle.batch(paddle.dataset.imikolov.test(word_dict, N),
+                               batch_size)
+    feeds = ['word_%d' % i for i in range(N)]
+    return avg_cost, inference_program, train_reader, test_reader, feeds

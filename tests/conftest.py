@@ -1,8 +1,9 @@
-"""Force a deterministic 8-virtual-device CPU platform for all tests."""
-import os
+"""Force a deterministic 8-virtual-device CPU platform for all tests.
 
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
-flags = os.environ.get('XLA_FLAGS', '')
-if '--xla_force_host_platform_device_count' not in flags:
-    os.environ['XLA_FLAGS'] = (
-        flags + ' --xla_force_host_platform_device_count=8').strip()
+Note: this environment bakes in an `axon` TPU plugin that overrides
+JAX_PLATFORMS env vars, so the switch must go through jax.config.
+"""
+import jax
+
+jax.config.update('jax_platforms', 'cpu')
+jax.config.update('jax_num_cpu_devices', 8)

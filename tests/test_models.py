@@ -1,0 +1,127 @@
+"""Every benchmark/book model builds and trains a step on tiny shapes."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+
+from util import fresh_program
+
+
+def _run_steps(main, startup, feeds, reader, fetch, n=3, feed_transform=None):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feeder = fluid.DataFeeder(
+        place=fluid.CPUPlace(),
+        feed_list=[main.global_block().var(f) for f in feeds])
+    out = None
+    for batch in itertools.islice(reader(), n):
+        if feed_transform:
+            batch = feed_transform(batch)
+        out = exe.run(main, feed=feeder.feed(batch), fetch_list=fetch)
+    return out
+
+
+def test_resnet_cifar10_step():
+    from paddle_tpu.models import resnet
+    with fresh_program() as (main, startup):
+        avg_cost, acc, train_reader, _ = resnet.get_model(
+            data_set='cifar10', depth=8, batch_size=8)
+        out = _run_steps(main, startup, ['data', 'label'], train_reader,
+                         [avg_cost, acc],
+                         feed_transform=lambda b: [
+                             (x.reshape(3, 32, 32), y) for x, y in b])
+        assert np.isfinite(out[0]).all()
+
+
+def test_vgg_cifar10_step():
+    from paddle_tpu.models import vgg
+    with fresh_program() as (main, startup):
+        avg_cost, _, train_reader, _, acc = vgg.get_model(
+            data_set='cifar10', batch_size=4)
+        out = _run_steps(main, startup, ['data', 'label'], train_reader,
+                         [avg_cost],
+                         feed_transform=lambda b: [
+                             (x.reshape(3, 32, 32), y) for x, y in b], n=2)
+        assert np.isfinite(out[0]).all()
+
+
+def test_word2vec_steps():
+    from paddle_tpu.models import word2vec
+    with fresh_program() as (main, startup):
+        avg_cost, _, train_reader, _, feeds = word2vec.get_model(
+            batch_size=32)
+        out = _run_steps(main, startup, feeds, train_reader, [avg_cost], n=5)
+        assert np.isfinite(out[0]).all()
+
+
+def test_understand_sentiment_steps():
+    from paddle_tpu.models import understand_sentiment
+    with fresh_program() as (main, startup):
+        avg_cost, acc, train_reader, _, feeds = \
+            understand_sentiment.get_model(batch_size=8)
+        out = _run_steps(main, startup, feeds, train_reader, [avg_cost, acc],
+                         n=2)
+        assert np.isfinite(out[0]).all()
+
+
+def test_deepfm_steps():
+    from paddle_tpu.models import deepfm
+    with fresh_program() as (main, startup):
+        avg_cost, auc, train_reader, _, feeds = deepfm.get_model(
+            batch_size=64)
+        out = _run_steps(main, startup, feeds, train_reader, [avg_cost, auc],
+                         n=4)
+        assert np.isfinite(out[0]).all()
+        assert 0.0 <= float(out[1]) <= 1.0
+
+
+def test_transformer_overfits_batch():
+    from paddle_tpu.models import transformer as T
+    with fresh_program() as (main, startup):
+        avg_cost, tok, train_reader, _, feeds = T.get_model(
+            batch_size=8, max_length=16, n_layer=1, d_model=32, n_head=2,
+            d_inner=64, dict_size=60, warmup_steps=50)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        batch = next(iter(train_reader()))
+        fd = {n: np.stack([r[i] for r in batch])
+              for i, n in enumerate(feeds)}
+        losses = []
+        for _ in range(40):
+            loss, = exe.run(main, feed=fd, fetch_list=[avg_cost])
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+
+
+def test_seq2seq_attention_step():
+    from paddle_tpu.models import machine_translation as mt
+    with fresh_program() as (main, startup):
+        avg_cost, _, train_reader, _, feeds = mt.get_model(
+            batch_size=4, embedding_dim=16, encoder_size=16,
+            decoder_size=16, dict_size=40)
+        out = _run_steps(main, startup, feeds, train_reader, [avg_cost], n=2)
+        assert np.isfinite(out[0]).all()
+
+
+def test_stacked_lstm_step():
+    from paddle_tpu.models import stacked_dynamic_lstm as sl
+    with fresh_program() as (main, startup):
+        data = fluid.layers.data(name="words", shape=[1], lod_level=1,
+                                 dtype='int64')
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        logit = sl.lstm_net(data, 200, lstm_size=16, emb_dim=16)
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=logit, label=label))
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+
+        def reader():
+            rng = np.random.RandomState(7)
+            while True:
+                yield [(list(rng.randint(0, 200, size=rng.randint(3, 9))),
+                        int(rng.randint(0, 2))) for _ in range(4)]
+        out = _run_steps(main, startup, ['words', 'label'], reader, [loss],
+                         n=3)
+        assert np.isfinite(out[0]).all()
