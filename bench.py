@@ -15,7 +15,7 @@ import numpy as np
 REF_IMAGES_PER_SEC = 300.0  # reference CUDA single-device fluid baseline
 
 
-def bench_resnet50(batch_size=64, warmup=3, iters=20):
+def bench_resnet50(batch_size=128, warmup=3, iters=20, use_amp=True):
     import paddle_tpu.fluid as fluid
     from paddle_tpu.fluid import framework, unique_name
     from paddle_tpu.fluid.executor import Scope, _switch_scope, global_scope
@@ -33,6 +33,9 @@ def bench_resnet50(batch_size=64, warmup=3, iters=20):
                 fluid.layers.cross_entropy(input=predict, label=label))
             fluid.optimizer.Momentum(learning_rate=0.01, momentum=0.9) \
                 .minimize(avg_cost)
+            if use_amp:
+                # bf16 matmul/conv on the MXU; fp32 master weights
+                fluid.amp.decorate_program(main)
 
             exe = fluid.Executor()
             exe.run(startup)
@@ -60,10 +63,11 @@ def bench_resnet50(batch_size=64, warmup=3, iters=20):
 
 
 def main():
-    batch = int(os.environ.get('BENCH_BATCH', '64'))
+    batch = int(os.environ.get('BENCH_BATCH', '128'))
     iters = int(os.environ.get('BENCH_ITERS', '20'))
     try:
-        ips = bench_resnet50(batch_size=batch, iters=iters)
+        ips = bench_resnet50(batch_size=batch, iters=iters,
+                             use_amp=os.environ.get('BENCH_AMP', '1') == '1')
     except Exception:
         # fall back to a smaller batch if HBM-constrained
         ips = bench_resnet50(batch_size=max(8, batch // 4), iters=iters)

@@ -27,6 +27,7 @@ from .param_attr import ParamAttr, WeightNormParamAttr
 from .data_feeder import DataFeeder
 from .lod_tensor import LoDTensor, create_lod_tensor, create_random_int_lodtensor
 from . import unique_name
+from . import amp
 from . import profiler
 from . import debugger
 from .core import CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace

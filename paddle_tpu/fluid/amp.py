@@ -1,0 +1,45 @@
+"""Automatic mixed precision (bf16 on the MXU).
+
+Parity: the reference gained fluid.contrib.mixed_precision (fp16 + loss
+scaling) for CUDA tensor cores. On TPU the native fast dtype is bfloat16,
+whose exponent range equals fp32 — so NO loss scaling is needed: matmul/conv
+inputs are cast to bf16 (MXU 2x-8x faster), accumulation stays fp32
+(preferred_element_type), master weights and optimizer state stay fp32.
+
+Usage:
+    fluid.amp.decorate_program(main_program)      # before Executor.run
+or  with fluid.amp.amp_guard(): exe.run(...)
+"""
+import contextlib
+
+from .framework import default_main_program
+
+__all__ = ['decorate_program', 'amp_guard', 'is_amp']
+
+_global_amp = False
+
+
+def decorate_program(program=None, enable=True):
+    if program is None:
+        program = default_main_program()
+    program._amp = bool(enable)
+    program._bump_version()
+    return program
+
+
+@contextlib.contextmanager
+def amp_guard(enable=True):
+    global _global_amp
+    prev = _global_amp
+    _global_amp = enable
+    try:
+        yield
+    finally:
+        _global_amp = prev
+
+
+def is_amp(program=None):
+    if _global_amp:
+        return True
+    return bool(getattr(program, '_amp', False)) if program is not None \
+        else False

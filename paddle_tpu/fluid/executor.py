@@ -112,8 +112,10 @@ def _feed_signature(name, val):
 class _CompiledStep(object):
     """One lowered+jitted (program, feed-sig, fetch) combination."""
 
-    def __init__(self, program, block, feed_names, fetch_names, persist_in):
+    def __init__(self, program, block, feed_names, fetch_names, persist_in,
+                 amp=False):
         self.program = program
+        self.amp = amp
         ops = list(block.ops)
         self.ops = ops
         self.fetch_names = list(fetch_names)
@@ -136,7 +138,7 @@ class _CompiledStep(object):
                 op = ops[i]
                 if op.type == 'autodiff':
                     continue
-                lowering.run_op(op, env, Ctx(key, i))
+                lowering.run_op(op, env, Ctx(key, i, amp=self.amp))
                 if grad_mode:
                     for vs in op.outputs.values():
                         for v in vs:
@@ -253,12 +255,14 @@ class Executor(object):
             v.name for v in program.list_vars()
             if v.persistable and v.name in scope.vars
             and scope.vars[v.name] is not None and v.name not in feed_vals))
+        from . import amp as amp_mod
+        amp = amp_mod.is_amp(program)
         key = (id(program), program._version, feed_sig, tuple(fetch_names),
-               persist_in)
+               persist_in, amp)
         compiled = self._cache.get(key) if use_program_cache else None
         if compiled is None:
             compiled = _CompiledStep(program, block, list(feed_vals), fetch_names,
-                                     persist_in)
+                                     persist_in, amp=amp)
             if use_program_cache:
                 self._cache[key] = compiled
 

@@ -47,15 +47,25 @@ def has_rule(op_type):
 class Ctx(object):
     """Per-op lowering context: PRNG key and run mode."""
 
-    __slots__ = ('key', 'op_index', 'is_test')
+    __slots__ = ('key', 'op_index', 'is_test', 'amp')
 
-    def __init__(self, key, op_index=0, is_test=False):
+    def __init__(self, key, op_index=0, is_test=False, amp=False):
         self.key = key
         self.op_index = op_index
         self.is_test = is_test
+        self.amp = amp
 
     def rng(self):
         return jax.random.fold_in(self.key, self.op_index)
+
+
+def amp_cast(ctx, *xs):
+    """Under AMP, cast fp32 matmul/conv operands to bf16 for the MXU."""
+    if not ctx.amp:
+        return xs if len(xs) > 1 else xs[0]
+    out = tuple(x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x
+                for x in xs)
+    return out if len(out) > 1 else out[0]
 
 
 class SeqValue(object):

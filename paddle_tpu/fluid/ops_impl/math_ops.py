@@ -9,7 +9,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..lowering import register, data_of, like, first_seq
+from ..lowering import register, data_of, like, first_seq, amp_cast
 
 
 def _unary(op_type, fn):
@@ -120,7 +120,9 @@ def _mul(ins, attrs, ctx):
     xs, ys = x.shape, y.shape
     x2 = x.reshape((int(np.prod(xs[:xn])), int(np.prod(xs[xn:]))))
     y2 = y.reshape((int(np.prod(ys[:yn])), int(np.prod(ys[yn:]))))
-    out = x2 @ y2
+    in_dtype = x.dtype
+    x2, y2 = amp_cast(ctx, x2, y2)
+    out = (x2 @ y2).astype(in_dtype)
     out = out.reshape(xs[:xn] + ys[yn:])
     return {'Out': like(ins['X'][0], out)}
 
@@ -133,7 +135,9 @@ def _matmul(ins, attrs, ctx):
         x = jnp.swapaxes(x, -1, -2)
     if attrs.get('transpose_Y', False):
         y = jnp.swapaxes(y, -1, -2)
-    out = jnp.matmul(x, y) * attrs.get('alpha', 1.0)
+    in_dtype = x.dtype
+    x, y = amp_cast(ctx, x, y)
+    out = jnp.matmul(x, y).astype(in_dtype) * attrs.get('alpha', 1.0)
     return {'Out': out}
 
 

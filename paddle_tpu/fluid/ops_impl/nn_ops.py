@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..lowering import register, data_of, like
+from ..lowering import register, data_of, like, amp_cast
 
 
 def _pair(v, n=2):
@@ -30,15 +30,16 @@ def _conv2d(ins, attrs, ctx):
     pads = _pair(attrs.get('paddings', 0))
     dilations = _pair(attrs.get('dilations', 1))
     groups = attrs.get('groups', 1) or 1
+    in_dtype = x.dtype
+    xc, wc = amp_cast(ctx, x, w.astype(x.dtype))
     out = lax.conv_general_dilated(
-        x, w.astype(x.dtype),
+        xc, wc,
         window_strides=strides,
         padding=[(pads[0], pads[0]), (pads[1], pads[1])],
         rhs_dilation=dilations,
         feature_group_count=groups,
-        dimension_numbers=('NCHW', 'OIHW', 'NCHW'),
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
-    return {'Output': out.astype(x.dtype)}
+        dimension_numbers=('NCHW', 'OIHW', 'NCHW'))
+    return {'Output': out.astype(in_dtype)}
 
 
 @register('conv3d')

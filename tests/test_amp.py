@@ -1,0 +1,50 @@
+"""bf16 mixed precision: numerics stay close to fp32, dtype stays fp32."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+from util import fresh_program
+
+
+def _build_and_train(amp, steps=10):
+    with fresh_program() as (main, startup):
+        x = fluid.layers.data(name='x', shape=[16], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        h = fluid.layers.fc(input=x, size=32, act='relu')
+        pred = fluid.layers.fc(input=h, size=1)
+        cost = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(cost)
+        if amp:
+            fluid.amp.decorate_program(main)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(3)
+        xs = rng.rand(32, 16).astype('float32')
+        ys = (xs.sum(axis=1, keepdims=True) * 0.1).astype('float32')
+        losses = []
+        for _ in range(steps):
+            loss, = exe.run(main, feed={'x': xs, 'y': ys},
+                            fetch_list=[cost])
+            losses.append(float(loss))
+        return losses
+
+
+def test_amp_matches_fp32_closely():
+    fp32 = _build_and_train(amp=False)
+    bf16 = _build_and_train(amp=True)
+    assert bf16[-1] < bf16[0], "amp training diverged"
+    # same trajectory within bf16 tolerance
+    np.testing.assert_allclose(fp32, bf16, rtol=0.1, atol=1e-2)
+
+
+def test_amp_output_dtype_stays_fp32():
+    with fresh_program() as (main, startup):
+        x = fluid.layers.data(name='x', shape=[8], dtype='float32')
+        out = fluid.layers.fc(input=x, size=4)
+        fluid.amp.decorate_program(main)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        res, = exe.run(main, feed={'x': np.ones((2, 8), 'float32')},
+                       fetch_list=[out])
+        assert res.dtype == np.float32
