@@ -65,12 +65,13 @@ def bench_resnet50(batch_size=128, warmup=3, iters=20, use_amp=True):
 def main():
     batch = int(os.environ.get('BENCH_BATCH', '128'))
     iters = int(os.environ.get('BENCH_ITERS', '20'))
+    use_amp = os.environ.get('BENCH_AMP', '1') == '1'
     try:
-        ips = bench_resnet50(batch_size=batch, iters=iters,
-                             use_amp=os.environ.get('BENCH_AMP', '1') == '1')
+        ips = bench_resnet50(batch_size=batch, iters=iters, use_amp=use_amp)
     except Exception:
         # fall back to a smaller batch if HBM-constrained
-        ips = bench_resnet50(batch_size=max(8, batch // 4), iters=iters)
+        ips = bench_resnet50(batch_size=max(8, batch // 4), iters=iters,
+                             use_amp=use_amp)
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(ips, 2),

@@ -11,3 +11,4 @@ from . import sequence_ops  # noqa: F401
 from . import optim_ops  # noqa: F401
 from . import control_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
+from . import crf_ctc_ops  # noqa: F401

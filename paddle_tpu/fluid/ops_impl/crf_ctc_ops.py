@@ -1,0 +1,386 @@
+"""CRF / CTC / edit-distance / chunk-eval rules.
+
+Parity: reference paddle/fluid/operators/{linear_chain_crf,crf_decoding,
+ctc_align,edit_distance,warpctc,chunk_eval}_op.* — the reference walks
+LoD-flattened sequences with per-sequence CPU loops (and hands CTC to the
+external warp-ctc CUDA library).
+
+TPU-first: every rule here is a masked dense computation over padded
+[batch, max_len, ...] SeqValues. The CRF forward/Viterbi and the CTC
+forward algorithm are lax.scan recurrences in log-space (stable, static
+shapes, MXU-friendly batched inner steps); edit distance scans DP rows;
+chunk_eval is pure vectorised boundary logic. No host loops, no external
+kernels — the whole family jit-compiles into the training step.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..lowering import register, data_of, like, SeqValue
+
+_NEG = -1e30
+
+
+def _ids2d(v):
+    """SeqValue/array of ids [B,T,1] or [B,T] -> int32 [B,T]."""
+    x = data_of(v).astype(jnp.int32)
+    if x.ndim == 3 and x.shape[-1] == 1:
+        x = jnp.squeeze(x, -1)
+    return x
+
+
+def _lengths(v, T):
+    if isinstance(v, SeqValue):
+        return v.lengths.astype(jnp.int32)
+    return jnp.full((data_of(v).shape[0],), T, jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# linear_chain_crf
+# ---------------------------------------------------------------------------
+
+@register('linear_chain_crf')
+def _linear_chain_crf(ins, attrs, ctx):
+    """Transition layout (reference linear_chain_crf_op.h): row 0 = start
+    weights a, row 1 = stop weights b, rows 2: = pairwise w[prev, cur].
+    Output LogLikelihood is the per-sequence negative log-likelihood
+    (the book models feed it straight into mean() as the cost)."""
+    em_v = ins['Emission'][0]
+    emission = data_of(em_v).astype(jnp.float32)        # [B, T, C]
+    transition = data_of(ins['Transition'][0]).astype(jnp.float32)
+    label = _ids2d(ins['Label'][0])                      # [B, T]
+    B, T, C = emission.shape
+    a, b, w = transition[0], transition[1], transition[2:]
+    lens = _lengths(em_v, T)
+
+    valid = (jnp.arange(T)[None, :] < lens[:, None])     # [B, T]
+
+    # --- log partition: alpha recursion over time -------------------------
+    alpha0 = a[None, :] + emission[:, 0]                 # [B, C]
+
+    def fwd(alpha, xs):
+        em_t, valid_t = xs                               # [B, C], [B]
+        nxt = jax.nn.logsumexp(alpha[:, :, None] + w[None], axis=1) + em_t
+        alpha = jnp.where(valid_t[:, None], nxt, alpha)
+        return alpha, alpha
+
+    alphaT, alphas = lax.scan(
+        fwd, alpha0,
+        (jnp.swapaxes(emission, 0, 1)[1:], jnp.swapaxes(valid, 0, 1)[1:]))
+    log_z = jax.nn.logsumexp(alphaT + b[None, :], axis=-1)          # [B]
+
+    # --- gold path score --------------------------------------------------
+    em_score = jnp.sum(
+        jnp.where(valid,
+                  jnp.take_along_axis(emission, label[:, :, None],
+                                      axis=2)[:, :, 0], 0.0), axis=1)
+    start_score = a[label[:, 0]]
+    last_idx = jnp.maximum(lens - 1, 0)
+    last_lab = jnp.take_along_axis(label, last_idx[:, None], axis=1)[:, 0]
+    stop_score = b[last_lab]
+    trans_pairs = w[label[:, :-1], label[:, 1:]]                    # [B, T-1]
+    pair_valid = valid[:, 1:]
+    trans_score = jnp.sum(jnp.where(pair_valid, trans_pairs, 0.0), axis=1)
+    path = em_score + start_score + stop_score + trans_score
+
+    nll = (log_z - path)[:, None]                                    # [B, 1]
+    alphas_full = jnp.concatenate([alpha0[:, None], jnp.swapaxes(alphas, 0, 1)],
+                                  axis=1)
+    return {'LogLikelihood': nll,
+            'Alpha': like(em_v, alphas_full),
+            'EmissionExps': like(em_v, jnp.exp(emission - jnp.max(
+                emission, axis=-1, keepdims=True))),
+            'TransitionExps': jnp.exp(transition)}
+
+
+@register('crf_decoding')
+def _crf_decoding(ins, attrs, ctx):
+    """Viterbi decode; with Label given, emits per-token correctness
+    (reference crf_decoding_op.h flips the path to a 0/1 mismatch mask)."""
+    em_v = ins['Emission'][0]
+    emission = data_of(em_v).astype(jnp.float32)         # [B, T, C]
+    transition = data_of(ins['Transition'][0]).astype(jnp.float32)
+    B, T, C = emission.shape
+    a, b, w = transition[0], transition[1], transition[2:]
+    lens = _lengths(em_v, T)
+    valid = (jnp.arange(T)[None, :] < lens[:, None])
+
+    delta0 = a[None, :] + emission[:, 0]
+
+    def fwd(delta, xs):
+        em_t, valid_t, t = xs
+        scores = delta[:, :, None] + w[None]             # [B, C, C]
+        best_prev = jnp.argmax(scores, axis=1)           # [B, C]
+        nxt = jnp.max(scores, axis=1) + em_t
+        new_delta = jnp.where(valid_t[:, None], nxt, delta)
+        ptr = jnp.where(valid_t[:, None], best_prev,
+                        jnp.arange(C)[None, :])          # identity when padded
+        return new_delta, ptr
+
+    deltaT, ptrs = lax.scan(
+        fwd, delta0,
+        (jnp.swapaxes(emission, 0, 1)[1:], jnp.swapaxes(valid, 0, 1)[1:],
+         jnp.arange(1, T)))
+    last = jnp.argmax(deltaT + b[None, :], axis=-1)      # [B]
+
+    def back(state, ptr_t):
+        state = jnp.take_along_axis(ptr_t, state[:, None], axis=1)[:, 0]
+        return state, state
+
+    _, rev_path = lax.scan(back, last, ptrs, reverse=True)
+    path = jnp.concatenate([jnp.swapaxes(rev_path, 0, 1), last[:, None]],
+                           axis=1) if T > 1 else last[:, None]
+    path = jnp.where(valid, path, 0).astype(jnp.int64)
+
+    if 'Label' in ins and ins['Label']:
+        label = _ids2d(ins['Label'][0]).astype(jnp.int64)
+        path = jnp.where(valid, (path == label).astype(jnp.int64), 0)
+    return {'ViterbiPath': like(em_v, path[:, :, None])}
+
+
+# ---------------------------------------------------------------------------
+# CTC
+# ---------------------------------------------------------------------------
+
+@register('ctc_align')
+def _ctc_align(ins, attrs, ctx):
+    """Greedy CTC decode: argmax per frame, merge repeats, drop blanks.
+    Compaction keeps static shapes: kept tokens are stably moved left."""
+    x_v = ins['Input'][0]
+    x = data_of(x_v)
+    if x.ndim == 3:                                      # probs [B,T,C]
+        ids = jnp.argmax(x, axis=-1).astype(jnp.int32)
+    else:
+        ids = _ids2d(x_v)
+    B, T = ids.shape
+    blank = int(attrs.get('blank', 0))
+    merge = bool(attrs.get('merge_repeated', True))
+    lens = _lengths(x_v, T)
+    valid = (jnp.arange(T)[None, :] < lens[:, None])
+
+    prev = jnp.concatenate([jnp.full((B, 1), -1, jnp.int32), ids[:, :-1]],
+                           axis=1)
+    keep = valid & (ids != blank)
+    if merge:
+        keep = keep & (ids != prev)
+    # stable left-compaction: sort positions by (dropped, index)
+    order = jnp.argsort(jnp.where(keep, jnp.arange(T)[None, :], T + 1), axis=1)
+    packed = jnp.take_along_axis(ids, order, axis=1)
+    new_lens = jnp.sum(keep, axis=1).astype(jnp.int32)
+    packed = jnp.where(jnp.arange(T)[None, :] < new_lens[:, None], packed, 0)
+    return {'Output': SeqValue(packed[:, :, None].astype(jnp.int64), new_lens)}
+
+
+@register('warpctc')
+def _warpctc(ins, attrs, ctx):
+    """CTC loss, log-space alpha recursion over the blank-interleaved label
+    (Graves 2006) — replaces the external warp-ctc kernel with a lax.scan
+    that XLA fuses into the train step; jax.grad differentiates it directly
+    so the reference's hand-written WarpCTCGrad output is vestigial."""
+    logits_v = ins['Logits'][0]
+    logits = data_of(logits_v).astype(jnp.float32)       # [B, T, C]
+    label = _ids2d(ins['Label'][0])                       # [B, L]
+    B, T, C = logits.shape
+    L = label.shape[1]
+    blank = int(attrs.get('blank', 0))
+    t_lens = _lengths(logits_v, T)
+    l_lens = _lengths(ins['Label'][0], L)
+
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    # extended sequence e[s]: blank, y1, blank, y2, ..., blank — length 2L+1
+    S = 2 * L + 1
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(label)
+    ext_len = 2 * l_lens + 1
+    s_idx = jnp.arange(S)[None, :]
+    in_ext = s_idx < ext_len[:, None]
+
+    # can skip from s-2 to s when e[s] != blank and e[s] != e[s-2]
+    ext_m2 = jnp.concatenate([jnp.full((B, 2), -1, jnp.int32), ext[:, :-2]],
+                             axis=1)
+    can_skip = (ext != blank) & (ext != ext_m2)
+
+    lp_ext0 = jnp.take_along_axis(logp[:, 0], ext, axis=1)  # [B, S]
+    alpha0 = jnp.full((B, S), _NEG)
+    alpha0 = alpha0.at[:, 0].set(lp_ext0[:, 0])
+    alpha0 = alpha0.at[:, 1].set(jnp.where(l_lens >= 1, lp_ext0[:, 1], _NEG))
+
+    def step(alpha, xs):
+        lp_t, valid_t = xs                               # [B, C], [B]
+        lp_ext = jnp.take_along_axis(lp_t, ext, axis=1)  # [B, S]
+        a1 = jnp.concatenate([jnp.full((B, 1), _NEG), alpha[:, :-1]], axis=1)
+        a2 = jnp.concatenate([jnp.full((B, 2), _NEG), alpha[:, :-2]], axis=1)
+        a2 = jnp.where(can_skip, a2, _NEG)
+        nxt = jnp.logaddexp(jnp.logaddexp(alpha, a1), a2) + lp_ext
+        nxt = jnp.where(in_ext, nxt, _NEG)
+        return jnp.where(valid_t[:, None], nxt, alpha), None
+
+    valid_t = (jnp.arange(T)[None, :] < t_lens[:, None])
+    alphaT, _ = lax.scan(step, alpha0,
+                         (jnp.swapaxes(logp, 0, 1)[1:],
+                          jnp.swapaxes(valid_t, 0, 1)[1:]))
+
+    idx_last = jnp.maximum(ext_len - 1, 0)
+    idx_prev = jnp.maximum(ext_len - 2, 0)
+    ll = jnp.logaddexp(
+        jnp.take_along_axis(alphaT, idx_last[:, None], axis=1)[:, 0],
+        jnp.take_along_axis(alphaT, idx_prev[:, None], axis=1)[:, 0])
+    loss = -ll
+    if attrs.get('norm_by_times'):
+        loss = loss / jnp.maximum(t_lens, 1).astype(jnp.float32)
+    return {'Loss': loss[:, None], 'WarpCTCGrad': None}
+
+
+# ---------------------------------------------------------------------------
+# edit_distance
+# ---------------------------------------------------------------------------
+
+def _strip_tokens(ids, lens, ignored):
+    """Remove ignored token ids, compacting left (static shapes)."""
+    T = ids.shape[1]
+    keep = (jnp.arange(T)[None, :] < lens[:, None])
+    for tok in ignored:
+        keep = keep & (ids != int(tok))
+    order = jnp.argsort(jnp.where(keep, jnp.arange(T)[None, :], T + 1), axis=1)
+    packed = jnp.take_along_axis(ids, order, axis=1)
+    new_lens = jnp.sum(keep, axis=1).astype(jnp.int32)
+    return packed, new_lens
+
+
+@register('edit_distance')
+def _edit_distance(ins, attrs, ctx):
+    """Levenshtein DP: scan over hypothesis tokens carrying one DP row
+    (reference edit_distance_op.h runs the quadratic loop per sequence on
+    the host; here all batch rows advance in lockstep on device)."""
+    hyp_v, ref_v = ins['Hyps'][0], ins['Refs'][0]
+    hyp = _ids2d(hyp_v)
+    ref = _ids2d(ref_v)
+    B, Th = hyp.shape
+    Tr = ref.shape[1]
+    h_lens = _lengths(hyp_v, Th)
+    r_lens = _lengths(ref_v, Tr)
+    ignored = attrs.get('ignored_tokens') or []
+    if ignored:
+        hyp, h_lens = _strip_tokens(hyp, h_lens, ignored)
+        ref, r_lens = _strip_tokens(ref, r_lens, ignored)
+
+    row0 = jnp.broadcast_to(jnp.arange(Tr + 1, dtype=jnp.float32)[None, :],
+                            (B, Tr + 1))
+    j = jnp.arange(1, Tr + 1)[None, :]                   # [1, Tr]
+    ref_valid = (j <= r_lens[:, None])
+
+    def step(row, xs):
+        h_t, i = xs                                       # [B], scalar idx
+        sub_cost = (ref != h_t[:, None]).astype(jnp.float32)
+        # new_row computed left-to-right; deletion dependency needs a scan
+        # over columns — use the standard trick: costs without the running
+        # min first, then an associative prefix to fix deletions.
+        ins_del_sub = jnp.minimum(row[:, 1:] + 1.0,       # deletion (from up)
+                                  row[:, :-1] + sub_cost)  # substitution
+        first = row[:, :1] + 1.0                          # new_row[0] = i
+        # prefix pass for insertions: new[j] = min(cand[j], new[j-1] + 1)
+        cand = jnp.concatenate([first, ins_del_sub], axis=1)
+        shift = jnp.cumsum(jnp.ones_like(cand), axis=1)
+        fixed = lax.associative_scan(jnp.minimum, cand - shift, axis=1) + shift
+        active = (i < h_lens)[:, None]
+        new_row = jnp.where(active, fixed, row)
+        return new_row, None
+
+    rowN, _ = lax.scan(step, row0, (jnp.swapaxes(hyp, 0, 1),
+                                    jnp.arange(Th)))
+    dist = jnp.take_along_axis(rowN, r_lens[:, None].astype(jnp.int32),
+                               axis=1)[:, 0]
+    if attrs.get('normalized', True):
+        dist = dist / jnp.maximum(r_lens, 1).astype(jnp.float32)
+    return {'Out': dist[:, None],
+            'SequenceNum': jnp.asarray(B, jnp.int64)}
+
+
+# ---------------------------------------------------------------------------
+# chunk_eval
+# ---------------------------------------------------------------------------
+
+def _chunk_bounds(tags, lens, scheme, num_types, excluded):
+    """Per-position (start, end, type, in_chunk) masks for a tag sequence.
+
+    Tag encoding (reference chunk_eval_op.h): tag = type * tag_num + flag,
+    O tag = num_types * tag_num (or anything beyond)."""
+    B, T = tags.shape
+    tag_num = {'plain': 1, 'IOB': 2, 'IOE': 2, 'IOBES': 4}[scheme]
+    typ = tags // tag_num
+    flag = tags % tag_num
+    valid = (jnp.arange(T)[None, :] < lens[:, None])
+    non_o = valid & (typ < num_types)
+    for ex in excluded:
+        non_o = non_o & (typ != int(ex))
+
+    p_typ = jnp.concatenate([jnp.full((B, 1), -1, tags.dtype), typ[:, :-1]], 1)
+    p_flag = jnp.concatenate([jnp.full((B, 1), -1, tags.dtype), flag[:, :-1]], 1)
+    p_in = jnp.concatenate([jnp.zeros((B, 1), bool), non_o[:, :-1]], 1)
+    n_typ = jnp.concatenate([typ[:, 1:], jnp.full((B, 1), -1, tags.dtype)], 1)
+    n_flag = jnp.concatenate([flag[:, 1:], jnp.full((B, 1), -1, tags.dtype)], 1)
+    n_in = jnp.concatenate([non_o[:, 1:], jnp.zeros((B, 1), bool)], 1)
+    n_valid = jnp.concatenate([valid[:, 1:], jnp.zeros((B, 1), bool)], 1)
+    n_in = n_in & n_valid
+
+    brk_prev = (~p_in) | (p_typ != typ)
+    brk_next = (~n_in) | (n_typ != typ)
+    if scheme == 'plain':
+        start = non_o & brk_prev
+        end = non_o & brk_next
+    elif scheme == 'IOB':                                 # B=0, I=1
+        start = non_o & ((flag == 0) | brk_prev)
+        end = non_o & (brk_next | (n_flag == 0))
+    elif scheme == 'IOE':                                 # I=0, E=1
+        start = non_o & (brk_prev | (p_flag == 1))
+        end = non_o & ((flag == 1) | brk_next)
+    else:                                                 # IOBES: B,I,E,S
+        start = non_o & ((flag == 0) | (flag == 3) | brk_prev
+                         | (p_flag == 2) | (p_flag == 3))
+        end = non_o & ((flag == 2) | (flag == 3) | brk_next
+                       | (n_flag == 0) | (n_flag == 3))
+    return start, end, typ, non_o
+
+
+def _end_of_chunk_at(start, end, T):
+    """e[t] = index of first end >= t (for matching chunk extents)."""
+    idx = jnp.arange(T)[None, :]
+    cand = jnp.where(end, idx, T + 1)
+    rev = jnp.flip(cand, axis=1)
+    e = jnp.flip(lax.associative_scan(jnp.minimum, rev, axis=1), axis=1)
+    return e
+
+
+@register('chunk_eval')
+def _chunk_eval(ins, attrs, ctx):
+    inf_v, lab_v = ins['Inference'][0], ins['Label'][0]
+    inf = _ids2d(inf_v)
+    lab = _ids2d(lab_v)
+    B, T = inf.shape
+    lens = _lengths(lab_v, T)
+    scheme = attrs.get('chunk_scheme', 'IOB')
+    num_types = int(attrs['num_chunk_types'])
+    excluded = attrs.get('excluded_chunk_types') or []
+
+    s_i, e_i, t_i, _ = _chunk_bounds(inf, lens, scheme, num_types, excluded)
+    s_l, e_l, t_l, _ = _chunk_bounds(lab, lens, scheme, num_types, excluded)
+    ee_i = _end_of_chunk_at(s_i, e_i, T)
+    ee_l = _end_of_chunk_at(s_l, e_l, T)
+
+    n_inf = jnp.sum(s_i)
+    n_lab = jnp.sum(s_l)
+    correct = jnp.sum(s_i & s_l & (t_i == t_l) & (ee_i == ee_l))
+
+    nc = correct.astype(jnp.float32)
+    precision = jnp.where(n_inf > 0, nc / jnp.maximum(n_inf, 1), 0.0)
+    recall = jnp.where(n_lab > 0, nc / jnp.maximum(n_lab, 1), 0.0)
+    f1 = jnp.where(nc > 0, 2 * precision * recall
+                   / jnp.maximum(precision + recall, 1e-12), 0.0)
+    return {'Precision': precision.astype(jnp.float32),
+            'Recall': recall.astype(jnp.float32),
+            'F1-Score': f1.astype(jnp.float32),
+            'NumInferChunks': n_inf.astype(jnp.int64),
+            'NumLabelChunks': n_lab.astype(jnp.int64),
+            'NumCorrectChunks': correct.astype(jnp.int64)}

@@ -122,7 +122,10 @@ def _mul(ins, attrs, ctx):
     y2 = y.reshape((int(np.prod(ys[:yn])), int(np.prod(ys[yn:]))))
     in_dtype = x.dtype
     x2, y2 = amp_cast(ctx, x2, y2)
-    out = (x2 @ y2).astype(in_dtype)
+    out = jnp.matmul(
+        x2, y2,
+        preferred_element_type=jnp.float32 if x2.dtype == jnp.bfloat16
+        else None).astype(in_dtype)
     out = out.reshape(xs[:xn] + ys[yn:])
     return {'Out': like(ins['X'][0], out)}
 
@@ -137,7 +140,10 @@ def _matmul(ins, attrs, ctx):
         y = jnp.swapaxes(y, -1, -2)
     in_dtype = x.dtype
     x, y = amp_cast(ctx, x, y)
-    out = jnp.matmul(x, y).astype(in_dtype) * attrs.get('alpha', 1.0)
+    out = jnp.matmul(
+        x, y,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16
+        else None).astype(in_dtype) * attrs.get('alpha', 1.0)
     return {'Out': out}
 
 

@@ -38,7 +38,9 @@ def _conv2d(ins, attrs, ctx):
         padding=[(pads[0], pads[0]), (pads[1], pads[1])],
         rhs_dilation=dilations,
         feature_group_count=groups,
-        dimension_numbers=('NCHW', 'OIHW', 'NCHW'))
+        dimension_numbers=('NCHW', 'OIHW', 'NCHW'),
+        preferred_element_type=jnp.float32 if xc.dtype == jnp.bfloat16
+        else None)
     return {'Output': out.astype(in_dtype)}
 
 

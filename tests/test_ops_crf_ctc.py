@@ -1,0 +1,205 @@
+"""CRF/CTC/edit-distance/chunk_eval numeric checks vs brute force.
+
+Mirrors reference unittests/test_linear_chain_crf_op.py, test_crf_decoding_op,
+test_ctc_align_op, test_edit_distance_op, test_warpctc_op, test_chunk_eval_op.
+"""
+import itertools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.lowering import SeqValue, Ctx
+from paddle_tpu.fluid.ops_impl import crf_ctc_ops as M
+
+from util import fresh_program
+
+rng = np.random.RandomState(7)
+
+
+def ctx():
+    return Ctx(jax.random.key(0))
+
+
+def _seq(arr, lens):
+    return SeqValue(jnp.asarray(arr), jnp.asarray(np.asarray(lens, np.int32)))
+
+
+class TestCRF:
+    B, T, C = 2, 4, 3
+
+    def setup_method(self, _):
+        self.em = rng.randn(self.B, self.T, self.C).astype(np.float32)
+        self.lens = np.array([4, 2], np.int32)
+        self.lab = rng.randint(0, self.C, (self.B, self.T)).astype(np.int64)
+        self.trans = (rng.randn(self.C + 2, self.C) * 0.3).astype(np.float32)
+
+    def _score(self, bi, seq):
+        a, b, w = self.trans[0], self.trans[1], self.trans[2:]
+        s = a[seq[0]] + b[seq[-1]]
+        s += sum(self.em[bi, t, seq[t]] for t in range(len(seq)))
+        s += sum(w[seq[t - 1], seq[t]] for t in range(1, len(seq)))
+        return s
+
+    def test_nll_matches_brute_force(self):
+        ins = {'Emission': [_seq(self.em, self.lens)],
+               'Transition': [jnp.asarray(self.trans)],
+               'Label': [_seq(self.lab[:, :, None], self.lens)]}
+        nll = np.asarray(M._linear_chain_crf(ins, {}, ctx())['LogLikelihood'])[:, 0]
+        for bi in range(self.B):
+            L = self.lens[bi]
+            logZ = np.log(sum(np.exp(self._score(bi, s))
+                              for s in itertools.product(range(self.C), repeat=L)))
+            want = logZ - self._score(bi, self.lab[bi, :L])
+            assert abs(nll[bi] - want) < 1e-3
+
+    def test_viterbi_matches_brute_force(self):
+        ins = {'Emission': [_seq(self.em, self.lens)],
+               'Transition': [jnp.asarray(self.trans)]}
+        vp = np.asarray(M._crf_decoding(ins, {}, ctx())['ViterbiPath'].data)[:, :, 0]
+        for bi in range(self.B):
+            L = self.lens[bi]
+            best = max(itertools.product(range(self.C), repeat=L),
+                       key=lambda s: self._score(bi, s))
+            assert tuple(vp[bi, :L]) == best
+
+    def test_decoding_with_label_marks_correct(self):
+        ins = {'Emission': [_seq(self.em, self.lens)],
+               'Transition': [jnp.asarray(self.trans)],
+               'Label': [_seq(self.lab[:, :, None], self.lens)]}
+        out = np.asarray(M._crf_decoding(ins, {}, ctx())['ViterbiPath'].data)
+        assert set(np.unique(out)) <= {0, 1}
+
+    def test_crf_grad_flows(self):
+        def loss(trans):
+            ins = {'Emission': [_seq(self.em, self.lens)],
+                   'Transition': [trans],
+                   'Label': [_seq(self.lab[:, :, None], self.lens)]}
+            return jnp.sum(M._linear_chain_crf(ins, {}, ctx())['LogLikelihood'])
+        g = jax.grad(loss)(jnp.asarray(self.trans))
+        assert np.all(np.isfinite(np.asarray(g)))
+
+
+def test_edit_distance():
+    def lev(h, r):
+        d = np.zeros((len(h) + 1, len(r) + 1))
+        d[:, 0] = np.arange(len(h) + 1)
+        d[0, :] = np.arange(len(r) + 1)
+        for i in range(1, len(h) + 1):
+            for j in range(1, len(r) + 1):
+                d[i, j] = min(d[i - 1, j] + 1, d[i, j - 1] + 1,
+                              d[i - 1, j - 1] + (h[i - 1] != r[j - 1]))
+        return d[-1, -1]
+
+    hl = np.array([6, 4, 2], np.int32)
+    rl = np.array([5, 6, 3], np.int32)
+    hyp = rng.randint(1, 5, (3, 6)).astype(np.int64)
+    ref = rng.randint(1, 5, (3, 6)).astype(np.int64)
+    ins = {'Hyps': [_seq(hyp[:, :, None], hl)], 'Refs': [_seq(ref[:, :, None], rl)]}
+    got = np.asarray(M._edit_distance(ins, {'normalized': False}, ctx())['Out'])[:, 0]
+    for bi in range(3):
+        assert abs(got[bi] - lev(hyp[bi, :hl[bi]], ref[bi, :rl[bi]])) < 1e-5
+    norm = np.asarray(M._edit_distance(ins, {'normalized': True}, ctx())['Out'])[:, 0]
+    np.testing.assert_allclose(norm, got / np.maximum(rl, 1), rtol=1e-6)
+
+
+def test_ctc_align_merge_and_blank():
+    ids = np.array([[0, 1, 1, 0, 2, 2], [3, 3, 0, 1, 0, 0]])
+    probs = np.zeros((2, 6, 4), np.float32)
+    for b in range(2):
+        for t in range(6):
+            probs[b, t, ids[b, t]] = 5
+    out = M._ctc_align({'Input': [_seq(probs, [6, 4])]},
+                       {'blank': 0, 'merge_repeated': True}, ctx())['Output']
+    o = np.asarray(out.data)[:, :, 0]
+    ol = np.asarray(out.lengths)
+    assert list(o[0, :ol[0]]) == [1, 2]
+    assert list(o[1, :ol[1]]) == [3, 1]
+
+
+def test_warpctc_matches_brute_force():
+    B, T, C = 2, 5, 3
+    logits = rng.randn(B, T, C).astype(np.float32)
+    lab = np.array([[1, 2], [2, 1]], np.int64)
+    tl = np.array([5, 4], np.int32)
+    ll = np.array([2, 1], np.int32)
+    ins = {'Logits': [_seq(logits, tl)], 'Label': [_seq(lab[:, :, None], ll)]}
+    loss = np.asarray(M._warpctc(ins, {'blank': 0}, ctx())['Loss'])[:, 0]
+
+    def brute(lp, lab_):
+        T_, C_ = lp.shape
+        tot = 0.0
+        for path in itertools.product(range(C_), repeat=T_):
+            col, prev = [], -1
+            for p in path:
+                if p != prev and p != 0:
+                    col.append(p)
+                prev = p
+            if col == list(lab_):
+                tot += np.exp(sum(lp[t, path[t]] for t in range(T_)))
+        return -np.log(tot)
+
+    for bi in range(B):
+        lp = np.asarray(jax.nn.log_softmax(jnp.asarray(logits[bi, :tl[bi]]), axis=-1))
+        assert abs(loss[bi] - brute(lp, lab[bi, :ll[bi]])) < 1e-3
+
+
+def test_warpctc_grad_flows():
+    B, T, C = 2, 5, 3
+    logits = rng.randn(B, T, C).astype(np.float32)
+    lab = np.array([[1, 2], [2, 1]], np.int64)
+
+    def loss(lg):
+        ins = {'Logits': [_seq(lg, [5, 4])],
+               'Label': [_seq(lab[:, :, None], [2, 1])]}
+        return jnp.sum(M._warpctc(ins, {'blank': 0}, ctx())['Loss'])
+
+    g = jax.grad(loss)(jnp.asarray(logits))
+    assert np.all(np.isfinite(np.asarray(g)))
+
+
+def test_chunk_eval_iob():
+    # types=2, IOB: B-0=0, I-0=1, B-1=2, I-1=3, O=4
+    inf = np.array([[0, 1, 4, 2, 3, 4]], np.int64)
+    lab = np.array([[0, 1, 4, 2, 1, 4]], np.int64)
+    out = M._chunk_eval(
+        {'Inference': [_seq(inf[:, :, None], [6])],
+         'Label': [_seq(lab[:, :, None], [6])]},
+        {'num_chunk_types': 2, 'chunk_scheme': 'IOB'}, ctx())
+    assert int(out['NumInferChunks']) == 2
+    assert int(out['NumLabelChunks']) == 3
+    assert int(out['NumCorrectChunks']) == 1
+    assert abs(float(out['Precision']) - 0.5) < 1e-6
+
+
+def test_crf_layer_end_to_end():
+    """linear_chain_crf + crf_decoding through the Program/Executor path
+    (reference book chapter label_semantic_roles shape)."""
+    with fresh_program() as (main, startup):
+        feat = fluid.layers.data('feat', shape=[4], dtype='float32',
+                                 lod_level=1)
+        lab = fluid.layers.data('lab', shape=[1], dtype='int64', lod_level=1)
+        emission = fluid.layers.fc(input=feat, size=3)
+        crf_cost = fluid.layers.linear_chain_crf(
+            emission, lab, param_attr=fluid.ParamAttr(name='crfw'))
+        avg = fluid.layers.mean(crf_cost)
+        sgd = fluid.optimizer.SGD(learning_rate=0.05)
+        sgd.minimize(avg)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        from paddle_tpu.fluid.lod_tensor import create_lod_tensor
+        import paddle_tpu.fluid.core as core
+        feats = [rng.randn(4, 4).astype(np.float32),
+                 rng.randn(6, 4).astype(np.float32)]
+        labs = [rng.randint(0, 3, (4, 1)).astype(np.int64),
+                rng.randint(0, 3, (6, 1)).astype(np.int64)]
+        ft = create_lod_tensor(np.concatenate(feats), [[4, 6]], core.CPUPlace())
+        lt = create_lod_tensor(np.concatenate(labs), [[4, 6]], core.CPUPlace())
+        losses = []
+        for _ in range(8):
+            out, = exe.run(main, feed={'feat': ft, 'lab': lt},
+                           fetch_list=[avg])
+            losses.append(float(out))
+        assert losses[-1] < losses[0]  # CRF NLL decreases under SGD
