@@ -118,6 +118,24 @@ class LayerHelper(object):
             name=attr.name, shape=shape, dtype=dtype,
             **{k: v for k, v in attr.to_kwargs().items() if k != 'name'})
 
+    def get_or_create_parameter(self, name, shape, dtype, is_bias=False):
+        """Fetch a named parameter if this program already has it, else
+        create it (used by inference graphs that share weights with the
+        training graph by name)."""
+        main_blk = self.main_program.global_block()
+        var = main_blk.vars.get(name)
+        if var is not None:
+            if not isinstance(var, Parameter):
+                raise ValueError(
+                    "var %r exists but is not a Parameter" % name)
+            if tuple(var.shape) != tuple(int(s) for s in shape):
+                raise ValueError(
+                    "shared parameter %r has shape %s, requested %s"
+                    % (name, var.shape, shape))
+            return var
+        return self.create_parameter(ParamAttr(name=name), shape=shape,
+                                     dtype=dtype, is_bias=is_bias)
+
     def get_parameter(self, name):
         param = self.main_program.global_block().var(name)
         if not isinstance(param, Parameter):

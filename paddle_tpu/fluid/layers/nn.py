@@ -1191,28 +1191,45 @@ def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None):
     return out
 
 
-def beam_search(pre_ids, ids, scores, beam_size, end_id, level=0):
-    """One beam-search step (reference nn.py:beam_search +
-    operators/beam_search_op.cc): dense [batch*beam] layout on TPU."""
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id, level=0,
+                name=None, return_parent_idx=False):
+    """One beam-search step (reference nn.py:2658 +
+    operators/beam_search_op.cc): dense [batch*beam] layout on TPU with
+    explicit parent pointers instead of LoD lineage."""
     helper = LayerHelper('beam_search', **locals())
     selected_scores = helper.create_variable_for_type_inference('float32')
     selected_ids = helper.create_variable_for_type_inference('int64')
+    parent_idx = helper.create_variable_for_type_inference('int64')
     helper.append_op(type='beam_search',
-                     inputs={'pre_ids': [pre_ids], 'ids': [ids],
-                             'scores': [scores]},
+                     inputs={'pre_ids': [pre_ids],
+                             'pre_scores': [pre_scores],
+                             'ids': [ids], 'scores': [scores]},
                      outputs={'selected_ids': [selected_ids],
-                              'selected_scores': [selected_scores]},
+                              'selected_scores': [selected_scores],
+                              'parent_idx': [parent_idx]},
                      attrs={'level': level, 'beam_size': beam_size,
                             'end_id': end_id})
+    if return_parent_idx:
+        return selected_ids, selected_scores, parent_idx
     return selected_ids, selected_scores
 
 
-def beam_search_decode(ids, scores, name=None):
+def beam_search_decode(ids, scores, beam_size=None, end_id=0, parents=None,
+                       name=None):
+    """reference nn.py:2770. Dense contract: ids/scores are stacked
+    [T, batch, beam] tensors (use layers.stack over per-step outputs);
+    `parents` carries the beam lineage emitted by beam_search. Tokens past
+    each sentence's first end_id come out as end_id (padding). beam_size is
+    taken from the tensor shape; the arg is accepted for API parity."""
     helper = LayerHelper('beam_search_decode', **locals())
     sentence_ids = helper.create_variable_for_type_inference('int64')
     sentence_scores = helper.create_variable_for_type_inference('float32')
+    inputs = {"Ids": [ids], "Scores": [scores]}
+    if parents is not None:
+        inputs["Parents"] = [parents]
     helper.append_op(type="beam_search_decode",
-                     inputs={"Ids": [ids], "Scores": [scores]},
+                     inputs=inputs,
                      outputs={"SentenceIds": [sentence_ids],
-                              "SentenceScores": [sentence_scores]})
+                              "SentenceScores": [sentence_scores]},
+                     attrs={'end_id': end_id})
     return sentence_ids, sentence_scores

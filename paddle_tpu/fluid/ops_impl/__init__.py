@@ -12,3 +12,4 @@ from . import optim_ops  # noqa: F401
 from . import control_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import crf_ctc_ops  # noqa: F401
+from . import sampled_ops  # noqa: F401

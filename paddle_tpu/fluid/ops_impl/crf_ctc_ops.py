@@ -165,10 +165,7 @@ def _ctc_align(ins, attrs, ctx):
     keep = valid & (ids != blank)
     if merge:
         keep = keep & (ids != prev)
-    # stable left-compaction: sort positions by (dropped, index)
-    order = jnp.argsort(jnp.where(keep, jnp.arange(T)[None, :], T + 1), axis=1)
-    packed = jnp.take_along_axis(ids, order, axis=1)
-    new_lens = jnp.sum(keep, axis=1).astype(jnp.int32)
+    packed, new_lens = _compact(ids, keep)
     packed = jnp.where(jnp.arange(T)[None, :] < new_lens[:, None], packed, 0)
     return {'Output': SeqValue(packed[:, :, None].astype(jnp.int64), new_lens)}
 
@@ -224,9 +221,10 @@ def _warpctc(ins, attrs, ctx):
 
     idx_last = jnp.maximum(ext_len - 1, 0)
     idx_prev = jnp.maximum(ext_len - 2, 0)
-    ll = jnp.logaddexp(
-        jnp.take_along_axis(alphaT, idx_last[:, None], axis=1)[:, 0],
-        jnp.take_along_axis(alphaT, idx_prev[:, None], axis=1)[:, 0])
+    a_last = jnp.take_along_axis(alphaT, idx_last[:, None], axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(alphaT, idx_prev[:, None], axis=1)[:, 0]
+    # empty label (ext_len == 1): only the all-blank path exists
+    ll = jnp.logaddexp(a_last, jnp.where(ext_len >= 2, a_prev, _NEG))
     loss = -ll
     if attrs.get('norm_by_times'):
         loss = loss / jnp.maximum(t_lens, 1).astype(jnp.float32)
@@ -237,16 +235,23 @@ def _warpctc(ins, attrs, ctx):
 # edit_distance
 # ---------------------------------------------------------------------------
 
-def _strip_tokens(ids, lens, ignored):
-    """Remove ignored token ids, compacting left (static shapes)."""
+def _compact(ids, keep):
+    """Stable left-compaction of kept tokens (static shapes): sort positions
+    by (dropped, index), recount lengths."""
     T = ids.shape[1]
-    keep = (jnp.arange(T)[None, :] < lens[:, None])
-    for tok in ignored:
-        keep = keep & (ids != int(tok))
     order = jnp.argsort(jnp.where(keep, jnp.arange(T)[None, :], T + 1), axis=1)
     packed = jnp.take_along_axis(ids, order, axis=1)
     new_lens = jnp.sum(keep, axis=1).astype(jnp.int32)
     return packed, new_lens
+
+
+def _strip_tokens(ids, lens, ignored):
+    """Remove ignored token ids, compacting left."""
+    T = ids.shape[1]
+    keep = (jnp.arange(T)[None, :] < lens[:, None])
+    for tok in ignored:
+        keep = keep & (ids != int(tok))
+    return _compact(ids, keep)
 
 
 @register('edit_distance')
@@ -268,8 +273,6 @@ def _edit_distance(ins, attrs, ctx):
 
     row0 = jnp.broadcast_to(jnp.arange(Tr + 1, dtype=jnp.float32)[None, :],
                             (B, Tr + 1))
-    j = jnp.arange(1, Tr + 1)[None, :]                   # [1, Tr]
-    ref_valid = (j <= r_lens[:, None])
 
     def step(row, xs):
         h_t, i = xs                                       # [B], scalar idx

@@ -16,20 +16,19 @@ from paddle_tpu.fluid.layer_helper import LayerHelper
 __all__ = ['seq_to_seq_net', 'get_model']
 
 
-def _attention_decoder(trg_emb, enc_out, hidden_dim):
+def _attention_decoder(trg_emb, enc_out, hidden_dim, name='mt'):
     helper = LayerHelper('attention_lstm_decoder')
     dtype = trg_emb.dtype
     e = trg_emb.shape[-1]
     d = enc_out.shape[-1]
-    w_dec = helper.create_parameter(attr=helper.param_attr,
-                                    shape=[e + d, 4 * hidden_dim], dtype=dtype)
-    u_dec = helper.create_parameter(attr=fluid.ParamAttr(),
-                                    shape=[hidden_dim, 4 * hidden_dim],
-                                    dtype=dtype)
-    b_dec = helper.create_parameter(attr=fluid.ParamAttr(), is_bias=True,
-                                    shape=[1, 4 * hidden_dim], dtype=dtype)
-    w_q = helper.create_parameter(attr=fluid.ParamAttr(),
-                                  shape=[hidden_dim, d], dtype=dtype)
+    w_dec = helper.get_or_create_parameter(
+        name + '_w_dec', shape=[e + d, 4 * hidden_dim], dtype=dtype)
+    u_dec = helper.get_or_create_parameter(
+        name + '_u_dec', shape=[hidden_dim, 4 * hidden_dim], dtype=dtype)
+    b_dec = helper.get_or_create_parameter(
+        name + '_b_dec', shape=[1, 4 * hidden_dim], dtype=dtype, is_bias=True)
+    w_q = helper.get_or_create_parameter(
+        name + '_w_attnq', shape=[hidden_dim, d], dtype=dtype)
     out = helper.create_variable_for_type_inference(dtype)
     helper.append_op(type='attention_lstm_decoder',
                      inputs={'TrgEmb': [trg_emb], 'EncOut': [enc_out],
@@ -39,9 +38,49 @@ def _attention_decoder(trg_emb, enc_out, hidden_dim):
     return out
 
 
+def _beam_decode(enc_out, decoder_size, target_dict_dim, embedding_dim,
+                 beam_size, max_length, start_id=0, end_id=1, name='mt'):
+    """Fused whole-decode beam search (one lax.scan — see
+    ops_impl/sampled_ops.py:attention_lstm_beam_decode). Reuses the
+    training decoder's parameters by name, plus the target embedding and
+    output projection, so generation follows training with no re-plumbing.
+    Parity: reference book test_machine_translation.py:decode() (While-loop
+    beam search over LoD beams)."""
+    helper = LayerHelper('attention_lstm_beam_decode')
+    dtype = enc_out.dtype
+    d = enc_out.shape[-1]
+    e = embedding_dim
+    h = decoder_size
+    w_dec = helper.get_or_create_parameter(
+        name + '_w_dec', shape=[e + d, 4 * h], dtype=dtype)
+    u_dec = helper.get_or_create_parameter(
+        name + '_u_dec', shape=[h, 4 * h], dtype=dtype)
+    b_dec = helper.get_or_create_parameter(
+        name + '_b_dec', shape=[1, 4 * h], dtype=dtype, is_bias=True)
+    w_q = helper.get_or_create_parameter(
+        name + '_w_attnq', shape=[h, d], dtype=dtype)
+    w_emb = helper.get_or_create_parameter(
+        name + '_trg_emb', shape=[target_dict_dim, e], dtype=dtype)
+    w_out = helper.get_or_create_parameter(
+        name + '_w_out', shape=[h, target_dict_dim], dtype=dtype)
+    b_out = helper.get_or_create_parameter(
+        name + '_b_out', shape=[1, target_dict_dim], dtype=dtype, is_bias=True)
+    sent_ids = helper.create_variable_for_type_inference('int64')
+    sent_scores = helper.create_variable_for_type_inference('float32')
+    helper.append_op(
+        type='attention_lstm_beam_decode',
+        inputs={'EncOut': [enc_out], 'WDec': [w_dec], 'UDec': [u_dec],
+                'BDec': [b_dec], 'WAttnQ': [w_q], 'WEmb': [w_emb],
+                'WOut': [w_out], 'BOut': [b_out]},
+        outputs={'SentenceIds': [sent_ids], 'SentenceScores': [sent_scores]},
+        attrs={'beam_size': beam_size, 'max_len': max_length,
+               'start_id': start_id, 'end_id': end_id})
+    return sent_ids, sent_scores
+
+
 def seq_to_seq_net(embedding_dim, encoder_size, decoder_size, source_dict_dim,
                    target_dict_dim, is_generating=False, beam_size=3,
-                   max_length=50):
+                   max_length=50, name='mt'):
     """reference machine_translation.py:seq_to_seq_net."""
     src_word_idx = fluid.layers.data(name='source_sequence', shape=[1],
                                      dtype='int64', lod_level=1)
@@ -60,15 +99,22 @@ def seq_to_seq_net(embedding_dim, encoder_size, decoder_size, source_dict_dim,
                                            is_reverse=True)
     encoded_vector = fluid.layers.concat(input=[enc_fwd, enc_bwd], axis=2)
 
+    if is_generating:
+        return _beam_decode(encoded_vector, decoder_size, target_dict_dim,
+                            embedding_dim, beam_size, max_length, name=name)
+
     trg_word_idx = fluid.layers.data(name='target_sequence', shape=[1],
                                      dtype='int64', lod_level=1)
     trg_embedding = fluid.layers.embedding(
-        input=trg_word_idx, size=[target_dict_dim, embedding_dim])
+        input=trg_word_idx, size=[target_dict_dim, embedding_dim],
+        param_attr=fluid.ParamAttr(name=name + '_trg_emb'))
 
     dec_hidden = _attention_decoder(trg_embedding, encoded_vector,
-                                    decoder_size)
-    prediction = fluid.layers.fc(input=dec_hidden, size=target_dict_dim,
-                                 act='softmax', num_flatten_dims=2)
+                                    decoder_size, name=name)
+    prediction = fluid.layers.fc(
+        input=dec_hidden, size=target_dict_dim, act='softmax',
+        num_flatten_dims=2, param_attr=fluid.ParamAttr(name=name + '_w_out'),
+        bias_attr=fluid.ParamAttr(name=name + '_b_out'))
 
     label = fluid.layers.data(name='label_sequence', shape=[1],
                               dtype='int64', lod_level=1)
