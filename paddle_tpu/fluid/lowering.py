@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from .framework import DYN_DIM
 
 _RULES = {}
+_BLOCK_RULES = {}
 
 
 class NoRuleError(KeyError):
@@ -29,6 +30,22 @@ class NoRuleError(KeyError):
 def register(op_type):
     def deco(fn):
         _RULES[op_type] = fn
+        return fn
+    return deco
+
+
+def register_block_op(op_type):
+    """Register a structured-control-flow rule.
+
+    Unlike plain rules (ins, attrs, ctx) -> outs, a block rule receives
+    (op, env, ctx) and mutates env: it must execute its sub-block(s) itself
+    (via run_block) under lax.while_loop / lax.scan / predicated select.
+    This replaces the reference's C++ WhileOp/ConditionalBlockOp sub-scope
+    interpreters (paddle/fluid/operators/while_op.cc,
+    conditional_block_op.cc) with XLA-native structured control flow.
+    """
+    def deco(fn):
+        _BLOCK_RULES[op_type] = fn
         return fn
     return deco
 
@@ -132,10 +149,55 @@ def first_seq(*vals):
 
 def run_op(op, env, ctx):
     """Resolve an op's inputs from env, apply its rule, bind outputs."""
+    if op.type in _BLOCK_RULES:
+        _BLOCK_RULES[op.type](op, env, ctx)
+        return
     rule = get_rule(op.type)
     ins = {slot: [env[v.name] for v in vs] for slot, vs in op.inputs.items()}
     outs = rule(ins, op.attrs, ctx)
     _bind_outputs(op, outs, env)
+
+
+def run_block(block, env, ctx):
+    """Execute every op of a (sub-)block against env, in place.
+
+    The PRNG stream stays distinct per (block, op) position so dropout etc.
+    inside loop bodies doesn't collide with the outer ops' streams.
+    """
+    base = block.idx * 4096
+    for i, op in enumerate(block.ops):
+        run_op(op, env, Ctx(ctx.key, base + i, is_test=ctx.is_test,
+                            amp=ctx.amp))
+
+
+# Default slot count for LoDTensorArray buffers (see ArrayValue). Layers
+# read layers/control_flow.py:ARRAY_CAPACITY (initialized from this) at
+# call time; this is the single fallback for ops lacking a capacity attr.
+DEFAULT_ARRAY_CAPACITY = 128
+
+
+class ArrayValue(object):
+    """Runtime value of a LOD_TENSOR_ARRAY variable.
+
+    The reference's LoDTensorArray is a C++ vector<LoDTensor> grown by
+    array_write ops inside While loops (operators/array_write_op.cc). Under
+    XLA everything must be statically shaped, so an array is a preallocated
+    ring of `capacity` slots [capacity, *elem] plus a live-length scalar;
+    writes are lax.dynamic_update_slice, reads dynamic_index_in_dim. This
+    makes arrays legal lax.while_loop carries.
+    """
+
+    __slots__ = ('buffer', 'length')
+
+    def __init__(self, buffer, length):
+        self.buffer = buffer
+        self.length = length
+
+
+jax.tree_util.register_pytree_node(
+    ArrayValue,
+    lambda a: ((a.buffer, a.length), None),
+    lambda aux, ch: ArrayValue(ch[0], ch[1]))
 
 
 def _bind_outputs(op, outs, env):

@@ -10,6 +10,7 @@ from . import nn_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401
 from . import optim_ops  # noqa: F401
 from . import control_ops  # noqa: F401
+from . import block_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import crf_ctc_ops  # noqa: F401
 from . import sampled_ops  # noqa: F401

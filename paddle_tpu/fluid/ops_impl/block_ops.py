@@ -1,0 +1,305 @@
+"""Structured control flow: sub-block ops lowered to XLA control flow.
+
+Parity: reference paddle/fluid/operators/{while_op.cc, conditional_block_op.cc,
+recurrent_op.cc, array_write_op.cc (LoDTensorArray)} and the Python-side
+layers/control_flow.py While/Switch/IfElse/StaticRNN/DynamicRNN.
+
+TPU-first redesign: the reference interprets sub-blocks in fresh C++ scopes
+(one scope per loop iteration, kept alive for the backward pass). Under XLA
+everything is one traced computation, so:
+  - `while`      -> lax.while_loop over an explicit carry dict (or a bounded
+                    lax.scan with predicated updates when max_iters is given,
+                    which keeps the loop differentiable);
+  - `static_rnn` -> lax.scan over the leading (time) axis;
+  - `dynamic_rnn`-> lax.scan over padded [batch, T, ...] sequences with
+                    per-sequence length masking of memory updates;
+  - `ifelse`/`switch` -> both branches execute, outputs merged by predicated
+                    select (XLA's branch-free equivalent; cheap on TPU where
+                    divergent control flow would stall the vector units).
+LoDTensorArray becomes a fixed-capacity buffer + live length (ArrayValue),
+making arrays legal loop carries.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..lowering import (register, register_block_op, run_block, data_of,
+                        ArrayValue, SeqValue, Ctx, DEFAULT_ARRAY_CAPACITY)
+
+
+def _scalar_bool(c):
+    c = data_of(c)
+    return jnp.reshape(c, (-1,))[0].astype(bool)
+
+
+def _iter_ctx(ctx, t):
+    """Fold the loop-iteration counter into the PRNG key so random ops
+    (dropout etc.) inside loop bodies draw fresh bits every step."""
+    return Ctx(jax.random.fold_in(ctx.key, t), is_test=ctx.is_test,
+               amp=ctx.amp)
+
+
+def _pred_where(cond, a, b):
+    """Predicated merge with ndim alignment (cond may be [N,1] vs val [N,D])."""
+    def one(x, y):
+        c = cond
+        while c.ndim > x.ndim:
+            c = jnp.squeeze(c, -1)
+        while c.ndim < x.ndim:
+            c = c[..., None]
+        return jnp.where(c, x, y)
+    return jax.tree_util.tree_map(one, a, b)
+
+
+# ---------------------------------------------------------------------------
+# LoDTensorArray ops
+# ---------------------------------------------------------------------------
+
+@register('array_write')
+def _array_write(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    i = jnp.reshape(data_of(ins['I'][0]), (-1,))[0].astype(jnp.int32)
+    arrs = ins.get('Array', [])
+    if arrs and isinstance(arrs[0], ArrayValue):
+        arr = arrs[0]
+        buf, length = arr.buffer, arr.length
+    else:
+        cap = int(attrs.get('capacity', DEFAULT_ARRAY_CAPACITY))
+        buf = jnp.zeros((cap,) + tuple(x.shape), x.dtype)
+        length = jnp.asarray(0, jnp.int32)
+    # Writes past capacity clamp to the last slot (dynamic_update_index
+    # semantics); length is clamped too so reads stay in range. Size the
+    # array via create_array/array_write(capacity=) for longer loops.
+    buf = lax.dynamic_update_index_in_dim(buf, x.astype(buf.dtype), i, axis=0)
+    length = jnp.minimum(jnp.maximum(length, i + 1), buf.shape[0])
+    return {'Out': ArrayValue(buf, length)}
+
+
+@register('array_read')
+def _array_read(ins, attrs, ctx):
+    arr = ins['Array'][0]
+    i = jnp.reshape(data_of(ins['I'][0]), (-1,))[0].astype(jnp.int32)
+    return {'Out': lax.dynamic_index_in_dim(arr.buffer, i, axis=0,
+                                            keepdims=False)}
+
+
+@register('array_length')
+def _array_length(ins, attrs, ctx):
+    arr = ins['Array'][0]
+    return {'Out': jnp.reshape(arr.length, (1,)).astype(jnp.int64)}
+
+
+# ---------------------------------------------------------------------------
+# while
+# ---------------------------------------------------------------------------
+
+@register_block_op('while')
+def _while(op, env, ctx):
+    prog = op.block.program
+    sub = prog.block(op.attrs['sub_block'])
+    cond_name = op.inputs['Condition'][0].name
+    carry_names = [v.name for v in op.outputs['Out']]
+    missing = [n for n in carry_names if n not in env]
+    if missing:
+        raise ValueError(
+            "While: loop-carried vars %s must be written (e.g. array_write / "
+            "fill_constant) before the loop so their shapes are known" % missing)
+
+    outer = dict(env)
+    ITER = '__while_iter__'
+    init = {n: env[n] for n in carry_names}
+    init[ITER] = jnp.asarray(0, jnp.int32)
+
+    def get_cond(carry):
+        # The body may not update cond (bounded loops); fall back to the
+        # loop-invariant outer value then.
+        return carry[cond_name] if cond_name in carry else outer[cond_name]
+
+    def body_env(carry):
+        t = carry[ITER]
+        e = dict(outer)
+        e.update({n: carry[n] for n in carry_names})
+        run_block(sub, e, _iter_ctx(ctx, t))
+        new = {n: e[n] for n in carry_names}
+        new[ITER] = t + 1
+        return new
+
+    max_iters = op.attrs.get('max_iters')
+    if max_iters:
+        # Differentiable bounded form: run max_iters steps, predicate every
+        # update on the (pre-step) condition. Grad flows via lax.scan.
+        def step(carry, _):
+            alive = _scalar_bool(get_cond(carry))
+            new = body_env(carry)
+            merged = {n: _pred_where(alive, new[n], carry[n])
+                      for n in carry_names}
+            merged[ITER] = new[ITER]
+            return merged, None
+        final, _ = lax.scan(step, init, None, length=int(max_iters))
+    else:
+        final = lax.while_loop(
+            lambda c: _scalar_bool(get_cond(c)), body_env, init)
+    final.pop(ITER)
+    env.update(final)
+
+
+# ---------------------------------------------------------------------------
+# ifelse / switch  (predicated select)
+# ---------------------------------------------------------------------------
+
+@register_block_op('ifelse')
+def _ifelse(op, env, ctx):
+    prog = op.block.program
+    t_idx, f_idx = op.attrs['sub_blocks']
+    cond = data_of(env[op.inputs['Cond'][0].name])
+    te = dict(env)
+    run_block(prog.block(t_idx), te, ctx)
+    fe = dict(env)
+    run_block(prog.block(f_idx), fe, ctx)
+    for out_var, tn, fn in zip(op.outputs['Out'], op.attrs['true_outs'],
+                               op.attrs['false_outs']):
+        env[out_var.name] = _pred_where(cond, data_of(te[tn]),
+                                        data_of(fe[fn]))
+    # Branch writes to outer-scope vars (e.g. assign(output=outer)) merge
+    # too, same as Switch; a var untouched by a branch keeps its pre-if
+    # value on that side.
+    for v in op.outputs.get('OuterOut', []):
+        n = v.name
+        env[n] = _pred_where(cond, data_of(te.get(n, env[n])),
+                             data_of(fe.get(n, env[n])))
+
+
+@register_block_op('switch')
+def _switch(op, env, ctx):
+    prog = op.block.program
+    sub_blocks = op.attrs['sub_blocks']
+    cond_names = op.attrs['cond_names']   # '' marks the default case
+    case_writes = op.attrs['case_writes']
+    case_envs = []
+    for bidx in sub_blocks:
+        e = dict(env)
+        run_block(prog.block(bidx), e, ctx)
+        case_envs.append(e)
+    has_default = '' in cond_names
+    for out_var in op.outputs['Out']:
+        n = out_var.name
+        val = env.get(n)
+        if val is None and not (has_default and
+                                n in case_writes[cond_names.index('')]):
+            # No prior value and no default writing it: when every condition
+            # is false the var would be undefined — the reference's runtime
+            # error, surfaced here at trace time.
+            raise ValueError(
+                "Switch: %r is only written in conditional cases and has no "
+                "prior value or default-case write to fall back to" % n)
+        # Fold cases in reverse: the first true condition wins, default (last
+        # declared) is the base.
+        for cn, writes, e in reversed(list(zip(cond_names, case_writes,
+                                               case_envs))):
+            if n not in writes:
+                continue
+            if cn == '':
+                val = e[n]
+            else:
+                c = data_of(env[cn])
+                val = _pred_where(c, e[n], val)
+        env[n] = val
+
+
+# ---------------------------------------------------------------------------
+# static_rnn  (scan over leading/time axis)
+# ---------------------------------------------------------------------------
+
+@register_block_op('static_rnn')
+def _static_rnn(op, env, ctx):
+    prog = op.block.program
+    sub = prog.block(op.attrs['sub_block'])
+    step_ins = op.attrs['step_ins']     # [(outer, inner)]
+    mems = op.attrs['mems']             # [{'pre','init','upd'}]
+    outs = op.attrs['outs']             # [(inner, outer)]
+
+    xs = tuple(data_of(env[o]) for o, _ in step_ins)
+    init = tuple(env[m['init']] for m in mems)
+    outer = dict(env)
+    T = xs[0].shape[0]
+
+    def body(carry, t_xs):
+        t, xt = t_xs
+        e = dict(outer)
+        for (_, inner), x in zip(step_ins, xt):
+            e[inner] = x
+        for m, c in zip(mems, carry):
+            e[m['pre']] = c
+        run_block(sub, e, _iter_ctx(ctx, t))
+        new = tuple(e[m['upd']] for m in mems)
+        ys = tuple(data_of(e[inner]) for inner, _ in outs)
+        return new, ys
+
+    _, ys = lax.scan(body, init, (jnp.arange(T), xs))
+    for (inner, outer_name), y in zip(outs, ys):
+        env[outer_name] = y
+
+
+# ---------------------------------------------------------------------------
+# dynamic_rnn  (scan over padded [batch, T, ...] with length masking)
+# ---------------------------------------------------------------------------
+
+@register_block_op('dynamic_rnn')
+def _dynamic_rnn(op, env, ctx):
+    prog = op.block.program
+    sub = prog.block(op.attrs['sub_block'])
+    step_ins = op.attrs['step_ins']
+    static_ins = op.attrs['static_ins']
+    mems = op.attrs['mems']             # [{'pre','init','value','shape','upd'}]
+    outs = op.attrs['outs']
+
+    seq0 = env[step_ins[0][0]]
+    if not isinstance(seq0, SeqValue):
+        raise ValueError("DynamicRNN.step_input expects a lod_level>0 "
+                         "sequence var (padded dense + lengths)")
+    lengths = seq0.lengths
+    B, T = seq0.data.shape[0], seq0.data.shape[1]
+
+    def seq_steps(o):
+        v = env[o]
+        d = data_of(v)
+        return jnp.moveaxis(d, 1, 0)    # [T, B, ...]
+
+    xs = tuple(seq_steps(o) for o, _ in step_ins)
+    init = []
+    for m in mems:
+        if m.get('init'):
+            init.append(data_of(env[m['init']]))
+        else:
+            shape = (B,) + tuple(m.get('shape') or ())
+            import numpy as np
+            dt = m.get('dtype', 'float32')
+            init.append(jnp.full(shape, float(m.get('value', 0.0)),
+                                 np.dtype(dt) if dt != 'bfloat16'
+                                 else jnp.bfloat16))
+    init = tuple(init)
+    outer = dict(env)
+
+    def body(carry, t_xs):
+        t, xt = t_xs
+        e = dict(outer)
+        for (_, inner), x in zip(step_ins, xt):
+            e[inner] = x
+        for o, inner in static_ins:
+            e[inner] = outer[o]
+        for m, c in zip(mems, carry):
+            e[m['pre']] = c
+        run_block(sub, e, _iter_ctx(ctx, t))
+        active = (t < lengths)          # [B]
+        new = tuple(_pred_where(active, data_of(e[m['upd']]), c)
+                    for m, c in zip(mems, carry))
+        ys = tuple(data_of(e[inner]) for inner, _ in outs)
+        return new, ys
+
+    _, ys = lax.scan(body, init, (jnp.arange(T), xs))
+    for (inner, outer_name), y in zip(outs, ys):
+        y = jnp.moveaxis(y, 0, 1)       # [B, T, ...]
+        if jnp.issubdtype(y.dtype, jnp.floating):
+            mask = (jnp.arange(T)[None, :] < lengths[:, None])
+            y = y * mask.reshape(mask.shape + (1,) * (y.ndim - 2)).astype(y.dtype)
+        env[outer_name] = SeqValue(y, lengths)

@@ -1,0 +1,268 @@
+"""Structured control flow: While / IfElse / Switch / StaticRNN / DynamicRNN
++ LoDTensorArray ops. Mirrors reference unittests test_while_op.py,
+test_recurrent_op.py, test_dyn_rnn.py, test_switch.py, test_array_read_write_op.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.fluid.layers as layers
+
+from util import fresh_program
+
+
+def _run(main, startup, feed, fetch):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def test_while_scalar_accumulation():
+    with fresh_program() as (main, startup):
+        limit = layers.fill_constant(shape=[1], dtype='int64', value=10)
+        i = layers.zeros(shape=[1], dtype='int64')
+        acc = layers.zeros(shape=[1], dtype='float32')
+        cond = layers.less_than(x=i, y=limit)
+        w = layers.While(cond=cond)
+        with w.block():
+            fi = layers.cast(i, 'float32')
+            new_acc = layers.elementwise_add(acc, fi)
+            layers.assign(new_acc, output=acc)
+            layers.increment(x=i, in_place=True)
+            layers.less_than(x=i, y=limit, cond=cond)
+        out, iters = _run(main, startup, {}, [acc, i])
+    assert float(out[0]) == sum(range(10))
+    assert int(iters[0]) == 10
+
+
+def test_while_array_read_write():
+    # the classic test_while_op shape: mem[t+1] = mem[t] + data[t]
+    np.random.seed(0)
+    d = np.random.rand(6, 8).astype('float32')
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[6, 8], append_batch_size=False)
+        zero = layers.zeros(shape=[1], dtype='int64')
+        arr = layers.create_array('float32')
+        # preload data rows into an array
+        i = layers.zeros(shape=[1], dtype='int64')
+        n = layers.fill_constant(shape=[1], dtype='int64', value=6)
+        cond = layers.less_than(x=i, y=n)
+        w0 = layers.While(cond=cond)
+        # seed the array so it's a legal carry
+        row0 = layers.slice(x, axes=[0], starts=[0], ends=[1])
+        row0 = layers.reshape(row0, shape=[8])
+        layers.array_write(row0, i=zero, array=arr)
+        with w0.block():
+            # arr[i] = x[i] via gather
+            row = layers.reshape(layers.gather(x, layers.cast(i, 'int32')),
+                                 shape=[8])
+            layers.array_write(row, i=i, array=arr)
+            layers.increment(x=i, in_place=True)
+            layers.less_than(x=i, y=n, cond=cond)
+        # now sum the array with a second while
+        j = layers.zeros(shape=[1], dtype='int64')
+        total = layers.zeros(shape=[8], dtype='float32')
+        cond2 = layers.less_than(x=j, y=n)
+        w1 = layers.While(cond=cond2)
+        with w1.block():
+            v = layers.array_read(arr, i=j)
+            s = layers.elementwise_add(total, v)
+            layers.assign(s, output=total)
+            layers.increment(x=j, in_place=True)
+            layers.less_than(x=j, y=n, cond=cond2)
+        length = layers.array_length(arr)
+        out, ln = _run(main, startup, {'x': d}, [total, length])
+    np.testing.assert_allclose(out, d.sum(0), rtol=1e-5)
+    assert int(ln[0]) == 6
+
+
+def test_while_max_iters_backward():
+    # bounded (differentiable) while on the loss path: y = x * w^3
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[4], append_batch_size=False,
+                        stop_gradient=False)
+        w = layers.create_parameter(shape=[4], dtype='float32',
+                                    default_initializer=fluid.initializer.Constant(2.0))
+        limit = layers.fill_constant(shape=[1], dtype='int64', value=3)
+        i = layers.zeros(shape=[1], dtype='int64')
+        acc = layers.ones(shape=[4], dtype='float32')
+        acc.stop_gradient = False
+        cond = layers.less_than(x=i, y=limit)
+        loop = layers.While(cond=cond, max_iters=8)
+        with loop.block():
+            nxt = layers.elementwise_mul(acc, w)
+            layers.assign(nxt, output=acc)
+            layers.increment(x=i, in_place=True)
+            layers.less_than(x=i, y=limit, cond=cond)
+        y = layers.elementwise_mul(acc, x)
+        loss = layers.reduce_mean(y)
+        opt = fluid.optimizer.SGD(learning_rate=0.0)
+        opt.minimize(loss)
+        xv = np.arange(4).astype('float32')
+        out, g = _run(main, startup, {'x': xv},
+                      [loss, w.name + '@GRAD'])
+    # loss = mean(x * w^3); dloss/dw = 3 w^2 x / 4
+    np.testing.assert_allclose(out[()], np.mean(xv * 8.0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(g), 3 * 4.0 * xv / 4, rtol=1e-5)
+
+
+def test_ifelse_merge():
+    np.random.seed(1)
+    xv = np.random.randn(6, 1).astype('float32')
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[1])
+        zero = layers.fill_constant_batch_size_like(x, shape=[-1, 1],
+                                                    dtype='float32', value=0.0)
+        cond = layers.less_than(x=zero, y=x)   # x > 0
+        ie = layers.IfElse(cond)
+        with ie.true_block():
+            t = ie.input(x)
+            ie.output(layers.scale(t, scale=2.0))
+        with ie.false_block():
+            f = ie.input(x)
+            ie.output(layers.scale(f, scale=-1.0))
+        merged = ie()[0]
+        out, = _run(main, startup, {'x': xv}, [merged])
+    np.testing.assert_allclose(out, np.where(xv > 0, 2 * xv, -xv), rtol=1e-5)
+
+
+@pytest.mark.parametrize('step_val,expect', [(3, 1.0), (7, 0.1)])
+def test_switch(step_val, expect):
+    with fresh_program() as (main, startup):
+        step = layers.data(name='step', shape=[1], append_batch_size=False,
+                           dtype='int64')
+        five = layers.fill_constant(shape=[1], dtype='int64', value=5)
+        lr = layers.fill_constant(shape=[1], dtype='float32', value=0.0)
+        cond = layers.less_than(x=step, y=five)
+        with layers.Switch() as switch:
+            with switch.case(cond):
+                layers.assign(np.array([1.0], dtype='float32'), output=lr)
+            with switch.default():
+                layers.assign(np.array([0.1], dtype='float32'), output=lr)
+        out, = _run(main, startup,
+                    {'step': np.array([step_val], dtype='int64')}, [lr])
+    assert abs(float(out[0]) - expect) < 1e-6
+
+
+def test_static_rnn_cumsum():
+    np.random.seed(2)
+    T, B, D = 5, 3, 4
+    xv = np.random.randn(T, B, D).astype('float32')
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[T, B, D], append_batch_size=False)
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)
+            prev = rnn.memory(shape=[D], batch_ref=x_t)
+            h = layers.elementwise_add(x_t, prev)
+            rnn.update_memory(prev, h)
+            rnn.step_output(h)
+        out_seq = rnn()
+        out, = _run(main, startup, {'x': xv}, [out_seq])
+    np.testing.assert_allclose(out, np.cumsum(xv, axis=0), rtol=1e-5)
+
+
+def test_static_rnn_fc_backward():
+    T, B, D, H = 4, 2, 3, 5
+    np.random.seed(3)
+    xv = np.random.randn(T, B, D).astype('float32')
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[T, B, D], append_batch_size=False)
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)
+            prev = rnn.memory(shape=[H], batch_ref=x_t)
+            h = layers.fc(input=[x_t, prev], size=H, act='tanh')
+            rnn.update_memory(prev, h)
+            rnn.step_output(h)
+        out_seq = rnn()
+        loss = layers.reduce_mean(out_seq)
+        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        losses = [float(exe.run(main, feed={'x': xv},
+                                fetch_list=[loss])[0][()])
+                  for _ in range(3)]
+    assert np.all(np.isfinite(losses))
+
+
+def test_dynamic_rnn_masked_cumsum():
+    B, T, D = 3, 5, 2
+    lengths = [5, 3, 1]
+    np.random.seed(4)
+    flat = np.random.randn(sum(lengths), D).astype('float32')
+    lt = fluid.create_lod_tensor(flat, [lengths])
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[D], lod_level=1)
+        drnn = layers.DynamicRNN()
+        with drnn.block():
+            x_t = drnn.step_input(x)
+            mem = drnn.memory(shape=[D], value=0.0)
+            h = layers.elementwise_add(x_t, mem)
+            drnn.update_memory(mem, h)
+            drnn.output(h)
+        out_var = drnn()
+        last = layers.sequence_last_step(out_var)
+        out, = _run(main, startup, {'x': lt}, [last])
+    # last step of the masked cumsum == per-sequence sum
+    off = np.cumsum([0] + lengths)
+    want = np.stack([flat[off[i]:off[i + 1]].sum(0) for i in range(B)])
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def test_array_ops_outside_loop():
+    with fresh_program() as (main, startup):
+        v1 = layers.fill_constant(shape=[3], dtype='float32', value=1.0)
+        v2 = layers.fill_constant(shape=[3], dtype='float32', value=2.0)
+        i0 = layers.zeros(shape=[1], dtype='int64')
+        i1 = layers.fill_constant(shape=[1], dtype='int64', value=1)
+        arr = layers.array_write(v1, i=i0)
+        layers.array_write(v2, i=i1, array=arr)
+        r = layers.array_read(arr, i=i1)
+        n = layers.array_length(arr)
+        out, ln = _run(main, startup, {}, [r, n])
+    np.testing.assert_allclose(out, np.full(3, 2.0))
+    assert int(ln[0]) == 2
+
+
+def test_ifelse_outer_write_merged():
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[1], append_batch_size=False)
+        zero = layers.fill_constant(shape=[1], dtype='float32', value=0.0)
+        flag = layers.fill_constant(shape=[1], dtype='float32', value=-1.0)
+        cond = layers.less_than(x=zero, y=x)
+        ie = layers.IfElse(cond)
+        with ie.true_block():
+            t = ie.input(x)
+            layers.assign(layers.scale(t, scale=10.0), output=flag)
+            ie.output(t)
+        with ie.false_block():
+            f = ie.input(x)
+            ie.output(f)
+        ie()
+        pos, = _run(main, startup, {'x': np.array([2.0], 'float32')}, [flag])
+        exe = fluid.Executor(fluid.CPUPlace())
+        neg = exe.run(main, feed={'x': np.array([-2.0], 'float32')},
+                      fetch_list=[flag])[0]
+    assert float(pos[0]) == 20.0     # true branch's outer write applied
+    assert float(neg[0]) == -1.0     # false branch keeps prior value
+
+
+def test_loop_dropout_varies_per_step():
+    T, B, D = 6, 2, 64
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[T, B, D], append_batch_size=False)
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)
+            prev = rnn.memory(shape=[D], batch_ref=x_t)
+            h = layers.dropout(x_t, dropout_prob=0.5)
+            rnn.update_memory(prev, h)
+            rnn.step_output(h)
+        out_seq = rnn()
+        out, = _run(main, startup, {'x': np.ones((T, B, D), 'float32')},
+                    [out_seq])
+    masks = (out != 0).reshape(T, -1)
+    # distinct iterations must draw distinct dropout masks
+    assert any(not np.array_equal(masks[0], masks[t]) for t in range(1, T))
