@@ -113,9 +113,10 @@ class _CompiledStep(object):
     """One lowered+jitted (program, feed-sig, fetch) combination."""
 
     def __init__(self, program, block, feed_names, fetch_names, persist_in,
-                 amp=False):
+                 amp=False, platform='cpu'):
         self.program = program
         self.amp = amp
+        self.platform = platform
         ops = list(block.ops)
         self.ops = ops
         self.fetch_names = list(fetch_names)
@@ -138,7 +139,8 @@ class _CompiledStep(object):
                 op = ops[i]
                 if op.type == 'autodiff':
                     continue
-                lowering.run_op(op, env, Ctx(key, i, amp=self.amp))
+                lowering.run_op(op, env, Ctx(key, i, amp=self.amp,
+                                             platform=self.platform))
                 if grad_mode:
                     for vs in op.outputs.values():
                         for v in vs:
@@ -261,8 +263,12 @@ class Executor(object):
                persist_in, amp)
         compiled = self._cache.get(key) if use_program_cache else None
         if compiled is None:
+            # place is None under ParallelExecutor (mesh placement via
+            # shardings); the mesh devices set the platform then
+            plat = (self._device().platform if self.place is not None
+                    else jax.devices()[0].platform)
             compiled = _CompiledStep(program, block, list(feed_vals), fetch_names,
-                                     persist_in, amp=amp)
+                                     persist_in, amp=amp, platform=plat)
             if use_program_cache:
                 self._cache[key] = compiled
 

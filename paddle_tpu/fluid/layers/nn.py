@@ -28,7 +28,7 @@ __all__ = [
     'label_smooth', 'roi_pool', 'dice_loss', 'image_resize',
     'image_resize_short', 'resize_bilinear', 'gather', 'scatter',
     'random_crop', 'mean_iou', 'relu', 'log', 'crop', 'rank_loss', 'prelu',
-    'flatten', 'sequence_mask', 'stack',
+    'flatten', 'sequence_mask', 'stack', 'fused_attention',
 ]
 
 
@@ -738,6 +738,30 @@ def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
                      attrs={'transpose_X': transpose_x,
                             'transpose_Y': transpose_y,
                             'alpha': float(alpha)})
+    return out
+
+
+def fused_attention(q, k, v, key_bias=None, causal=False, scale=None,
+                    name=None):
+    """Whole-attention fused op: softmax(q k^T * scale + bias) v in ONE op.
+
+    q/k/v: [B, H, T, D]. key_bias: optional [B, Tk] (or [B,1,1,Tk]) additive
+    bias for padded keys; causal adds lower-triangular masking. On TPU this
+    lowers to the pallas flash-attention kernel (paddle_tpu.ops), which
+    never materializes the [B,H,Tq,Tk] score matrix in HBM; elsewhere it
+    falls back to the XLA chain. Replaces the reference's matmul->softmax->
+    matmul op sequence (nets.py scaled_dot_product_attention).
+    """
+    helper = LayerHelper('fused_attention', **locals())
+    out = helper.create_variable_for_type_inference(dtype=q.dtype)
+    inputs = {'Q': [q], 'K': [k], 'V': [v]}
+    if key_bias is not None:
+        inputs['KeyBias'] = [key_bias]
+    helper.append_op(type='flash_attention', inputs=inputs,
+                     outputs={'Out': [out]},
+                     attrs={'causal': bool(causal),
+                            'scale': (float(scale) if scale is not None
+                                      else -1.0)})
     return out
 
 

@@ -62,15 +62,19 @@ def has_rule(op_type):
 
 
 class Ctx(object):
-    """Per-op lowering context: PRNG key and run mode."""
+    """Per-op lowering context: PRNG key, run mode, and target platform
+    (the Executor's Place decides this — jax.default_backend() lies when a
+    TPU plugin is present but the computation is placed on CPU)."""
 
-    __slots__ = ('key', 'op_index', 'is_test', 'amp')
+    __slots__ = ('key', 'op_index', 'is_test', 'amp', 'platform')
 
-    def __init__(self, key, op_index=0, is_test=False, amp=False):
+    def __init__(self, key, op_index=0, is_test=False, amp=False,
+                 platform='cpu'):
         self.key = key
         self.op_index = op_index
         self.is_test = is_test
         self.amp = amp
+        self.platform = platform
 
     def rng(self):
         return jax.random.fold_in(self.key, self.op_index)
@@ -167,7 +171,7 @@ def run_block(block, env, ctx):
     base = block.idx * 4096
     for i, op in enumerate(block.ops):
         run_op(op, env, Ctx(ctx.key, base + i, is_test=ctx.is_test,
-                            amp=ctx.amp))
+                            amp=ctx.amp, platform=ctx.platform))
 
 
 # Default slot count for LoDTensorArray buffers (see ArrayValue). Layers

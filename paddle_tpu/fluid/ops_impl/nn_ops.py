@@ -502,3 +502,29 @@ def _im2sequence(ins, attrs, ctx):
     from ..lowering import SeqValue
     lengths = jnp.full((n,), seq.shape[1], jnp.int32)
     return {'Out': SeqValue(seq, lengths)}
+
+
+@register('flash_attention')
+def _flash_attention(ins, attrs, ctx):
+    """Fused attention: pallas flash kernel on TPU, XLA chain elsewhere.
+    Replaces the reference's matmul+softmax+matmul op sequence — see
+    paddle_tpu/ops/flash_attention.py for the kernel."""
+    from ... import ops as tpu_ops
+    q = data_of(ins['Q'][0])
+    k = data_of(ins['K'][0])
+    v = data_of(ins['V'][0])
+    kb = ins.get('KeyBias')
+    kb = data_of(kb[0]) if kb else None
+    if kb is not None:
+        kb = kb.reshape(kb.shape[0], kb.shape[-1])
+    scale = attrs.get('scale', -1.0)
+    scale = None if scale is None or scale < 0 else float(scale)
+    causal = bool(attrs.get('causal', False))
+    q, k, v = amp_cast(ctx, q, k, v)
+    if ctx.platform in ('tpu', 'axon'):
+        out = tpu_ops.flash_attention(q, k, v, key_bias=kb, causal=causal,
+                                      sm_scale=scale, interpret=False)
+    else:
+        out = tpu_ops.reference_attention(q, k, v, key_bias=kb,
+                                          causal=causal, sm_scale=scale)
+    return {'Out': out}

@@ -40,8 +40,16 @@ def _pre_post_process(prev, out, dropout_rate, mode='da'):
     return out
 
 
-def multi_head_attention(queries, keys, values, attn_bias, d_model, n_head,
-                         dropout_rate, cache=None):
+def multi_head_attention(queries, keys, values, key_bias, d_model, n_head,
+                         dropout_rate, causal=False, cache=None):
+    """q/k/v projections + ONE fused flash-attention op + output projection.
+
+    key_bias is the [B, S] pad bias; causal adds the decoder's triangular
+    mask inside the kernel — no [B,H,T,T] bias tensor is ever built.
+    Deviation from the reference: softmax-weight dropout is omitted (the
+    flash kernel never materializes the weights); the sublayer's output
+    dropout in _pre_post_process provides the regularization, as in most
+    flash-attention trainers."""
     d_key = d_model // n_head
     q = layers.fc(input=queries, size=d_model, num_flatten_dims=2,
                   bias_attr=False)
@@ -57,13 +65,8 @@ def multi_head_attention(queries, keys, values, attn_bias, d_model, n_head,
     q = split_heads(q)
     k = split_heads(k)
     v = split_heads(v)
-    product = layers.matmul(q, k, transpose_y=True, alpha=d_key ** -0.5)
-    if attn_bias is not None:
-        product = layers.elementwise_add(product, attn_bias)
-    weights = layers.softmax(product)
-    if dropout_rate:
-        weights = layers.dropout(weights, dropout_prob=dropout_rate)
-    ctx = layers.matmul(weights, v)
+    ctx = layers.fused_attention(q, k, v, key_bias=key_bias, causal=causal,
+                                 scale=d_key ** -0.5)
     ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
     ctx = layers.reshape(ctx, shape=[0, 0, d_model])
     return layers.fc(input=ctx, size=d_model, num_flatten_dims=2,
@@ -77,39 +80,34 @@ def ffn(x, d_inner, d_model, dropout_rate):
     return layers.fc(input=hidden, size=d_model, num_flatten_dims=2)
 
 
-def encoder_layer(x, attn_bias, d_model, n_head, d_inner, dropout_rate):
-    attn = multi_head_attention(x, x, x, attn_bias, d_model, n_head,
+def encoder_layer(x, key_bias, d_model, n_head, d_inner, dropout_rate):
+    attn = multi_head_attention(x, x, x, key_bias, d_model, n_head,
                                 dropout_rate)
     x = _pre_post_process(x, attn, dropout_rate, 'dan')
     f = ffn(x, d_inner, d_model, dropout_rate)
     return _pre_post_process(x, f, dropout_rate, 'dan')
 
 
-def decoder_layer(x, enc_out, self_bias, cross_bias, d_model, n_head,
+def decoder_layer(x, enc_out, self_key_bias, cross_key_bias, d_model, n_head,
                   d_inner, dropout_rate):
-    attn = multi_head_attention(x, x, x, self_bias, d_model, n_head,
-                                dropout_rate)
+    attn = multi_head_attention(x, x, x, self_key_bias, d_model, n_head,
+                                dropout_rate, causal=True)
     x = _pre_post_process(x, attn, dropout_rate, 'dan')
-    cross = multi_head_attention(x, enc_out, enc_out, cross_bias, d_model,
-                                 n_head, dropout_rate)
+    cross = multi_head_attention(x, enc_out, enc_out, cross_key_bias,
+                                 d_model, n_head, dropout_rate)
     x = _pre_post_process(x, cross, dropout_rate, 'dan')
     f = ffn(x, d_inner, d_model, dropout_rate)
     return _pre_post_process(x, f, dropout_rate, 'dan')
 
 
 def _pad_mask_bias(word, name):
-    """[B, 1, 1, S] additive bias: -1e9 on pad (id 0) positions."""
+    """[B, S] additive key bias: -1e9 on pad (id 0) positions. The fused
+    attention op broadcasts it over heads/queries; the decoder's causal
+    mask is applied inside the kernel (causal=True), so no [B,H,T,T] bias
+    tensor exists anywhere."""
     w = layers.cast(word, 'float32')
     nonpad = layers.clip(w, 0.0, 1.0)  # id 0 -> 0, others -> 1
-    bias = layers.scale(nonpad, scale=1e9, bias=-1e9)  # 0 -> -1e9, 1 -> 0
-    return layers.reshape(bias, shape=[0, 1, 1, bias.shape[-1]])
-
-
-def _causal_bias(seq_len):
-    m = np.triu(np.full((seq_len, seq_len), -1e9, dtype='float32'), k=1)
-    bias = layers.assign(m.reshape(1, 1, seq_len, seq_len))
-    bias.stop_gradient = True
-    return bias
+    return layers.scale(nonpad, scale=1e9, bias=-1e9)  # 0 -> -1e9, 1 -> 0
 
 
 def _embed(word, vocab_size, d_model, max_len, dropout_rate, name_prefix):
@@ -139,9 +137,7 @@ def transformer(src_vocab_size, trg_vocab_size, max_length, n_layer=6,
                            dtype='int64')
 
     src_bias = _pad_mask_bias(src_word, 'src')
-    trg_pad_bias = _pad_mask_bias(trg_word, 'trg')
-    causal = _causal_bias(max_length)
-    self_bias = layers.elementwise_add(trg_pad_bias, causal)
+    self_bias = _pad_mask_bias(trg_word, 'trg')
 
     enc = _embed(src_word, src_vocab_size, d_model, max_length,
                  dropout_rate, 'src')

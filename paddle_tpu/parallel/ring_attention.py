@@ -1,0 +1,89 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+TPU-first answer to long-context scaling (the reference caps sequence
+length by single-GPU memory; see machine_translation.py max_length): shard
+the sequence axis of q/k/v over a mesh axis, keep q local, and rotate the
+k/v shards around the ring with ppermute while accumulating the online
+softmax — each device only ever holds O(T/n) keys, so max context scales
+linearly with the ring size, and the ppermute rides the ICI torus
+concurrently with the local attention block (compute hides comm).
+
+Use inside shard_map (ring_attention) or via the pjit-level wrapper
+(ring_self_attention) which sets up the shard_map over a Mesh axis.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+NEG_BIG = -1e9
+
+
+def ring_attention(q, k, v, axis_name, key_bias=None, causal=False,
+                   sm_scale=None):
+    """Per-shard body (call inside shard_map).
+
+    q, k, v: [B, H, T_local, D] — the sequence axis sharded over axis_name.
+    key_bias: [B, T_local] additive bias for the local keys (or None).
+    """
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    B, H, Tl, D = q.shape
+    if sm_scale is None:
+        sm_scale = D ** -0.5
+    qf = q.astype(jnp.float32) * sm_scale
+    if key_bias is None:
+        key_bias = jnp.zeros((B, Tl), jnp.float32)
+
+    m = jnp.full((B, H, Tl), -1e30, jnp.float32)
+    l = jnp.zeros((B, H, Tl), jnp.float32)
+    acc = jnp.zeros((B, H, Tl, D), jnp.float32)
+    kc, vc, kbc = k, v, key_bias
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    qpos = idx * Tl + jnp.arange(Tl)
+
+    def one_step(s, m, l, acc, kc, vc, kbc):
+        src = (idx - s) % n           # whose kv shard we currently hold
+        sc = jnp.einsum('bhqd,bhkd->bhqk', qf, kc.astype(jnp.float32))
+        sc = sc + kbc[:, None, None, :].astype(jnp.float32)
+        if causal:
+            kpos = src * Tl + jnp.arange(Tl)
+            sc = jnp.where(qpos[:, None] >= kpos[None, :], sc, NEG_BIG)
+        m_new = jnp.maximum(m, sc.max(axis=-1))
+        p = jnp.exp(sc - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + p.sum(axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum(
+            'bhqk,bhkd->bhqd', p, vc.astype(jnp.float32))
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        kbc = lax.ppermute(kbc, axis_name, perm)
+        return m_new, l, acc, kc, vc, kbc
+
+    # ring size = mesh axis size is static, so the loop unrolls at trace time
+    for s in range(int(n)):
+        m, l, acc, kc, vc, kbc = one_step(s, m, l, acc, kc, vc, kbc)
+
+    l = jnp.maximum(l, 1e-30)
+    return (acc / l[..., None]).astype(q.dtype)
+
+
+def ring_self_attention(mesh, q, k, v, axis='sp', key_bias=None,
+                        causal=False, sm_scale=None):
+    """pjit-level entry: q/k/v [B, H, T, D] with T sharded over mesh axis."""
+    from jax import shard_map  # jax >= 0.8 location
+
+    qkv_spec = P(None, None, axis, None)
+    kb_spec = P(None, axis)
+
+    def body(q, k, v, kb):
+        return ring_attention(q, k, v, axis, key_bias=kb, causal=causal,
+                              sm_scale=sm_scale)
+
+    if key_bias is None:
+        key_bias = jnp.zeros((q.shape[0], k.shape[2]), jnp.float32)
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(qkv_spec, qkv_spec, qkv_spec, kb_spec),
+                   out_specs=qkv_spec)
+    return fn(q, k, v, key_bias)

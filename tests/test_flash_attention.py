@@ -1,0 +1,133 @@
+"""Flash-attention kernel numerics (pallas interpret mode on CPU) and the
+fused_attention fluid op, vs the plain-XLA oracle."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import ops
+import paddle_tpu.fluid as fluid
+import paddle_tpu.fluid.layers as layers
+
+from util import fresh_program
+
+
+def _rand_qkv(B=2, H=2, Tq=20, Tk=20, D=16, seed=0):
+    r = np.random.RandomState(seed)
+    q = r.randn(B, H, Tq, D).astype('float32')
+    k = r.randn(B, H, Tk, D).astype('float32')
+    v = r.randn(B, H, Tk, D).astype('float32')
+    kb = np.where(r.rand(B, Tk) < 0.25, -1e9, 0.0).astype('float32')
+    kb[:, 0] = 0.0   # keep at least one live key per row
+    return q, k, v, kb
+
+
+@pytest.mark.parametrize('causal', [False, True])
+@pytest.mark.parametrize('with_bias', [False, True])
+def test_forward_matches_reference(causal, with_bias):
+    q, k, v, kb = _rand_qkv()
+    bias = kb if with_bias else None
+    got = ops.flash_attention(q, k, v, key_bias=bias, causal=causal,
+                              interpret=True)
+    want = ops.reference_attention(q, k, v, key_bias=bias, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_forward_uneven_lengths():
+    # Tq != Tk and non-multiple-of-block sizes exercise the padding path
+    q, k, v, kb = _rand_qkv(Tq=9, Tk=33)
+    got = ops.flash_attention(q, k, v, key_bias=kb, interpret=True)
+    want = ops.reference_attention(q, k, v, key_bias=kb)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize('causal', [False, True])
+def test_gradients_match_reference(causal):
+    q, k, v, kb = _rand_qkv(B=1, H=2, Tq=12, Tk=12, D=8, seed=1)
+
+    def loss_flash(q, k, v):
+        o = ops.flash_attention(q, k, v, key_bias=kb, causal=causal,
+                                interpret=True)
+        return jnp.sum(o * jnp.cos(o))
+
+    def loss_ref(q, k, v):
+        o = ops.reference_attention(q, k, v, key_bias=kb, causal=causal)
+        return jnp.sum(o * jnp.cos(o))
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g1, g2, 'qkv'):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-4, err_msg=name)
+
+
+def test_fused_attention_layer():
+    B, H, T, D = 2, 2, 6, 4
+    r = np.random.RandomState(3)
+    qv = r.randn(B, H, T, D).astype('float32')
+    kv = r.randn(B, H, T, D).astype('float32')
+    vv = r.randn(B, H, T, D).astype('float32')
+    with fresh_program() as (main, startup):
+        q = layers.data(name='q', shape=[H, T, D], dtype='float32')
+        k = layers.data(name='k', shape=[H, T, D], dtype='float32')
+        v = layers.data(name='v', shape=[H, T, D], dtype='float32')
+        out = layers.fused_attention(q, k, v, causal=True)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        got, = exe.run(main, feed={'q': qv, 'k': kv, 'v': vv},
+                       fetch_list=[out])
+    want = ops.reference_attention(qv, kv, vv, causal=True)
+    np.testing.assert_allclose(got, np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_ring_attention_matches_full():
+    from paddle_tpu import parallel
+    from paddle_tpu.parallel.ring_attention import ring_self_attention
+    mesh = parallel.make_mesh({'sp': 8})
+    B, H, T, D = 2, 2, 16, 4
+    r = np.random.RandomState(4)
+    q = r.randn(B, H, T, D).astype('float32')
+    k = r.randn(B, H, T, D).astype('float32')
+    v = r.randn(B, H, T, D).astype('float32')
+    kb = np.where(r.rand(B, T) < 0.25, -1e9, 0.0).astype('float32')
+    kb[:, 0] = 0.0
+    for causal in (False, True):
+        got = ring_self_attention(mesh, jnp.asarray(q), jnp.asarray(k),
+                                  jnp.asarray(v), axis='sp',
+                                  key_bias=jnp.asarray(kb), causal=causal)
+        want = ops.reference_attention(q, k, v, key_bias=kb, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5,
+                                   err_msg='causal=%s' % causal)
+
+
+def test_forward_multiblock_grids():
+    # multi-block q AND k grids (2x2) — exercises the scratch accumulation
+    # across the innermost grid dim and the revisited output block
+    q, k, v, kb = _rand_qkv(B=2, H=2, Tq=256, Tk=256, D=32, seed=7)
+    for causal in (False, True):
+        got = ops.flash_attention(q, k, v, key_bias=kb, causal=causal,
+                                  interpret=True)
+        want = ops.reference_attention(q, k, v, key_bias=kb, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5,
+                                   err_msg='causal=%s' % causal)
+
+
+def test_gradients_multiblock():
+    q, k, v, kb = _rand_qkv(B=1, H=1, Tq=256, Tk=256, D=16, seed=8)
+
+    def mk(fn):
+        def g(q, k, v):
+            o = fn(q, k, v, key_bias=kb, causal=True)
+            return jnp.sum(o * jnp.sin(o))
+        return jax.grad(g, argnums=(0, 1, 2))
+
+    g1 = mk(lambda *a, **kw: ops.flash_attention(*a, interpret=True, **kw))(q, k, v)
+    g2 = mk(ops.reference_attention)(q, k, v)
+    for a, b, name in zip(g1, g2, 'qkv'):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-4, err_msg=name)
