@@ -1,0 +1,221 @@
+// Chunked record IO + threaded prefetch — the native data-path runtime.
+//
+// Parity: reference paddle/fluid/recordio/{chunk,scanner,writer}.cc (C++
+// chunked record storage with per-record checksums) and the reader op
+// chain's double-buffer thread (operators/reader/
+// create_double_buffer_reader_op.cc). TPU-first the device side is JAX, so
+// the native runtime owns what stays on the host: zero-copy mmap record
+// scanning and a background producer thread that stages decoded records in
+// a bounded ring so the train loop never blocks on disk.
+//
+// Exposed as a C ABI consumed via ctypes (paddle_tpu/utils/native.py);
+// format matches the pure-python fallback (reader/recordio.py):
+//   magic "PTRIO1\n" | per record: u32 payload_len | u32 crc32 | payload
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr char kMagic[] = "PTRIO1\n";
+constexpr size_t kMagicLen = 7;
+
+// crc32 (IEEE, zlib-compatible) — table generated on first use
+uint32_t crc32_ieee(const uint8_t* data, size_t n) {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    init = true;
+  }
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; ++i) c = table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+struct Scanner {
+  int fd = -1;
+  const uint8_t* base = nullptr;
+  size_t size = 0;
+  size_t off = 0;
+  bool check_crc = true;
+};
+
+Scanner* open_scanner(const char* path) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < (off_t)kMagicLen) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  if (base == MAP_FAILED) {
+    ::close(fd);
+    return nullptr;
+  }
+  madvise(base, st.st_size, MADV_SEQUENTIAL);
+  if (memcmp(base, kMagic, kMagicLen) != 0) {
+    munmap(base, st.st_size);
+    ::close(fd);
+    return nullptr;
+  }
+  auto* s = new Scanner();
+  s->fd = fd;
+  s->base = static_cast<const uint8_t*>(base);
+  s->size = st.st_size;
+  s->off = kMagicLen;
+  return s;
+}
+
+// returns payload length, sets *out to a pointer INTO the mapping (valid
+// until close); -1 on EOF, -2 on corruption
+ssize_t scanner_next(Scanner* s, const uint8_t** out) {
+  if (s->off + 8 > s->size) return -1;
+  uint32_t len, crc;
+  memcpy(&len, s->base + s->off, 4);
+  memcpy(&crc, s->base + s->off + 4, 4);
+  s->off += 8;
+  if (s->off + len > s->size) return -2;
+  const uint8_t* payload = s->base + s->off;
+  s->off += len;
+  if (s->check_crc && crc32_ieee(payload, len) != crc) return -2;
+  *out = payload;
+  return (ssize_t)len;
+}
+
+void close_scanner(Scanner* s) {
+  if (!s) return;
+  if (s->base) munmap(const_cast<uint8_t*>(s->base), s->size);
+  if (s->fd >= 0) ::close(s->fd);
+  delete s;
+}
+
+// ---------------------------------------------------------------------------
+// threaded prefetch: producer thread scans records into a bounded deque
+// ---------------------------------------------------------------------------
+
+struct Prefetcher {
+  Scanner* scanner = nullptr;
+  size_t depth = 4;
+  std::deque<std::vector<uint8_t>> queue;
+  std::vector<uint8_t> current;  // last record handed to the consumer
+  std::mutex mu;
+  std::condition_variable cv_put, cv_get;
+  bool done = false, error = false, stop = false;
+  std::thread worker;
+
+  void run() {
+    for (;;) {
+      const uint8_t* p = nullptr;
+      ssize_t n = scanner_next(scanner, &p);
+      std::unique_lock<std::mutex> lk(mu);
+      if (n == -1 || n == -2 || stop) {
+        error = (n == -2);
+        done = true;
+        cv_get.notify_all();
+        return;
+      }
+      cv_put.wait(lk, [&] { return queue.size() < depth || stop; });
+      if (stop) {
+        done = true;
+        cv_get.notify_all();
+        return;
+      }
+      queue.emplace_back(p, p + n);
+      cv_get.notify_one();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// -- plain scanner ABI (see utils/native.py recordio_iter) --
+void* ptrio_open(const char* path) { return open_scanner(path); }
+
+// returns payload length; -1 on clean EOF, -2 on corruption
+ssize_t ptrio_next(void* h, const char** out) {
+  const uint8_t* p = nullptr;
+  ssize_t n = scanner_next(static_cast<Scanner*>(h), &p);
+  *out = reinterpret_cast<const char*>(p);
+  return n;
+}
+
+void ptrio_close(void* h) { close_scanner(static_cast<Scanner*>(h)); }
+
+// -- record writer (streaming append) --
+void* ptrio_writer_open(const char* path) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return nullptr;
+  fwrite(kMagic, 1, kMagicLen, f);
+  return f;
+}
+
+int ptrio_writer_write(void* h, const char* data, uint64_t len) {
+  FILE* f = static_cast<FILE*>(h);
+  uint32_t l = (uint32_t)len;
+  uint32_t crc = crc32_ieee(reinterpret_cast<const uint8_t*>(data), len);
+  if (fwrite(&l, 4, 1, f) != 1) return -1;
+  if (fwrite(&crc, 4, 1, f) != 1) return -1;
+  if (len && fwrite(data, 1, len, f) != len) return -1;
+  return 0;
+}
+
+void ptrio_writer_close(void* h) { fclose(static_cast<FILE*>(h)); }
+
+// -- threaded prefetch ABI --
+void* ptrio_prefetch_open(const char* path, uint64_t depth) {
+  Scanner* s = open_scanner(path);
+  if (!s) return nullptr;
+  auto* p = new Prefetcher();
+  p->scanner = s;
+  p->depth = depth ? depth : 4;
+  p->worker = std::thread([p] { p->run(); });
+  return p;
+}
+
+// pops the next record; returns length (pointer valid until the next call
+// or close), -1 on clean EOF, -2 on corruption
+ssize_t ptrio_prefetch_next(void* h, const char** out) {
+  auto* p = static_cast<Prefetcher*>(h);
+  std::unique_lock<std::mutex> lk(p->mu);
+  p->cv_get.wait(lk, [&] { return !p->queue.empty() || p->done; });
+  if (p->queue.empty()) return p->error ? -2 : -1;
+  p->current = std::move(p->queue.front());
+  p->queue.pop_front();
+  p->cv_put.notify_one();
+  *out = reinterpret_cast<const char*>(p->current.data());
+  return (ssize_t)p->current.size();
+}
+
+void ptrio_prefetch_close(void* h) {
+  auto* p = static_cast<Prefetcher*>(h);
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->stop = true;
+    p->cv_put.notify_all();
+  }
+  p->worker.join();
+  close_scanner(p->scanner);
+  delete p;
+}
+
+}  // extern "C"

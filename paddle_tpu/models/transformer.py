@@ -41,15 +41,15 @@ def _pre_post_process(prev, out, dropout_rate, mode='da'):
 
 
 def multi_head_attention(queries, keys, values, key_bias, d_model, n_head,
-                         dropout_rate, causal=False, cache=None):
+                         causal=False, cache=None):
     """q/k/v projections + ONE fused flash-attention op + output projection.
 
     key_bias is the [B, S] pad bias; causal adds the decoder's triangular
     mask inside the kernel — no [B,H,T,T] bias tensor is ever built.
     Deviation from the reference: softmax-weight dropout is omitted (the
-    flash kernel never materializes the weights); the sublayer's output
-    dropout in _pre_post_process provides the regularization, as in most
-    flash-attention trainers."""
+    flash kernel never materializes the weights) — hence no dropout_rate
+    parameter; the sublayer's output dropout in _pre_post_process provides
+    the regularization, as in most flash-attention trainers."""
     d_key = d_model // n_head
     q = layers.fc(input=queries, size=d_model, num_flatten_dims=2,
                   bias_attr=False)
@@ -81,8 +81,7 @@ def ffn(x, d_inner, d_model, dropout_rate):
 
 
 def encoder_layer(x, key_bias, d_model, n_head, d_inner, dropout_rate):
-    attn = multi_head_attention(x, x, x, key_bias, d_model, n_head,
-                                dropout_rate)
+    attn = multi_head_attention(x, x, x, key_bias, d_model, n_head)
     x = _pre_post_process(x, attn, dropout_rate, 'dan')
     f = ffn(x, d_inner, d_model, dropout_rate)
     return _pre_post_process(x, f, dropout_rate, 'dan')
@@ -91,10 +90,10 @@ def encoder_layer(x, key_bias, d_model, n_head, d_inner, dropout_rate):
 def decoder_layer(x, enc_out, self_key_bias, cross_key_bias, d_model, n_head,
                   d_inner, dropout_rate):
     attn = multi_head_attention(x, x, x, self_key_bias, d_model, n_head,
-                                dropout_rate, causal=True)
+                                causal=True)
     x = _pre_post_process(x, attn, dropout_rate, 'dan')
     cross = multi_head_attention(x, enc_out, enc_out, cross_key_bias,
-                                 d_model, n_head, dropout_rate)
+                                 d_model, n_head)
     x = _pre_post_process(x, cross, dropout_rate, 'dan')
     f = ffn(x, d_inner, d_model, dropout_rate)
     return _pre_post_process(x, f, dropout_rate, 'dan')

@@ -56,9 +56,10 @@ def ring_attention(q, k, v, axis_name, key_bias=None, causal=False,
         l = l * alpha + p.sum(axis=-1)
         acc = acc * alpha[..., None] + jnp.einsum(
             'bhqk,bhkd->bhqd', p, vc.astype(jnp.float32))
-        kc = lax.ppermute(kc, axis_name, perm)
-        vc = lax.ppermute(vc, axis_name, perm)
-        kbc = lax.ppermute(kbc, axis_name, perm)
+        if s != n - 1:   # the last shard needs no further rotation
+            kc = lax.ppermute(kc, axis_name, perm)
+            vc = lax.ppermute(vc, axis_name, perm)
+            kbc = lax.ppermute(kbc, axis_name, perm)
         return m_new, l, acc, kc, vc, kbc
 
     # ring size = mesh axis size is static, so the loop unrolls at trace time

@@ -106,17 +106,23 @@ def write_samples(path, samples):
     return n
 
 
-def read_samples(path, shapes=None, dtypes=None):
-    # C++ fast path when the native library is built
+def read_samples(path, shapes=None, dtypes=None, prefetch_depth=4):
+    # C++ fast path when the native library is built: a background thread
+    # scans+checksums records while Python decodes the previous one. The
+    # fallback decision happens BEFORE the first yield — mid-stream errors
+    # (corruption etc.) propagate rather than silently re-reading.
+    use_native = False
     try:
         from ..utils import native
-        if native.available():
-            for payload in native.recordio_iter(path):
-                yield _unpack_sample(payload)
-            return
+        use_native = native.available()
     except Exception:
         pass
-    for payload in RecordIOReader(path):
+    if use_native:
+        it = (native.recordio_prefetch_iter(path, prefetch_depth)
+              if prefetch_depth else native.recordio_iter(path))
+    else:
+        it = iter(RecordIOReader(path))
+    for payload in it:
         yield _unpack_sample(payload)
 
 

@@ -1,18 +1,45 @@
 """ctypes bindings for the C++ runtime (paddle_tpu/csrc).
 
-Gracefully degrades to pure-python when the shared library is not built;
-build with `make -C paddle_tpu/csrc`.
+The native library owns the host data path: mmap'd recordio scanning, a
+streaming record writer, and a background-thread prefetcher (the
+reference's double_buffer reader thread, reference paddle/fluid/operators/
+reader/create_double_buffer_reader_op.cc, lives in C++ there too).
+
+Built lazily with `make -C paddle_tpu/csrc` on first use; everything
+degrades to the pure-python implementations in reader/recordio.py when no
+toolchain is available.
 """
 import ctypes
 import os
+import subprocess
 
 _LIB = None
 _TRIED = False
 
 
-def _lib_path():
+def _csrc_dir():
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    return os.path.join(here, 'csrc', 'libpaddle_tpu_native.so')
+    return os.path.join(here, 'csrc')
+
+
+def _lib_path():
+    return os.path.join(_csrc_dir(), 'libpaddle_tpu_native.so')
+
+
+def ensure_built():
+    """(Re)build the shared library if a toolchain is present. Best-effort.
+
+    make is invoked even when the .so exists — it no-ops when up to date
+    and rebuilds a stale library after a csrc update. The Makefile
+    publishes via atomic rename, so concurrent builders are safe.
+    """
+    try:
+        subprocess.run(['make', '-C', _csrc_dir()], check=True,
+                       stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                       timeout=120)
+    except Exception:
+        pass  # fall through: a pre-built .so may still exist
+    return os.path.exists(_lib_path())
 
 
 def _load():
@@ -20,13 +47,36 @@ def _load():
     if _TRIED:
         return _LIB
     _TRIED = True
-    p = _lib_path()
-    if os.path.exists(p):
-        try:
-            _LIB = ctypes.CDLL(p)
-        except OSError:
-            _LIB = None
+    if not ensure_built():
+        return None
+    try:
+        lib = ctypes.CDLL(_lib_path())
+        _bind(lib)
+    except (OSError, AttributeError):
+        # missing file or a stale .so lacking newer symbols: degrade
+        return None
+    _LIB = lib
     return _LIB
+
+
+def _bind(lib):
+    lib.ptrio_open.restype = ctypes.c_void_p
+    lib.ptrio_open.argtypes = [ctypes.c_char_p]
+    lib.ptrio_next.restype = ctypes.c_ssize_t
+    lib.ptrio_next.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p)]
+    lib.ptrio_close.argtypes = [ctypes.c_void_p]
+    lib.ptrio_writer_open.restype = ctypes.c_void_p
+    lib.ptrio_writer_open.argtypes = [ctypes.c_char_p]
+    lib.ptrio_writer_write.restype = ctypes.c_int
+    lib.ptrio_writer_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_uint64]
+    lib.ptrio_writer_close.argtypes = [ctypes.c_void_p]
+    lib.ptrio_prefetch_open.restype = ctypes.c_void_p
+    lib.ptrio_prefetch_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.ptrio_prefetch_next.restype = ctypes.c_ssize_t
+    lib.ptrio_prefetch_next.argtypes = [ctypes.c_void_p,
+                                        ctypes.POINTER(ctypes.c_char_p)]
+    lib.ptrio_prefetch_close.argtypes = [ctypes.c_void_p]
 
 
 def available():
@@ -34,16 +84,10 @@ def available():
 
 
 def recordio_iter(path):
-    """Iterate raw record payloads via the C++ chunk parser."""
+    """Iterate raw record payloads via the mmap'd C++ chunk parser."""
     lib = _load()
     if lib is None:
         raise RuntimeError("native library not built")
-    lib.ptrio_open.restype = ctypes.c_void_p
-    lib.ptrio_open.argtypes = [ctypes.c_char_p]
-    lib.ptrio_next.restype = ctypes.c_ssize_t
-    lib.ptrio_next.argtypes = [ctypes.c_void_p,
-                               ctypes.POINTER(ctypes.c_char_p)]
-    lib.ptrio_close.argtypes = [ctypes.c_void_p]
     h = lib.ptrio_open(path.encode())
     if not h:
         raise IOError("cannot open %s" % path)
@@ -51,8 +95,59 @@ def recordio_iter(path):
         while True:
             buf = ctypes.c_char_p()
             n = lib.ptrio_next(h, ctypes.byref(buf))
+            if n == -2:
+                raise IOError("checksum mismatch in %s" % path)
             if n < 0:
                 break
             yield ctypes.string_at(buf, n)
     finally:
         lib.ptrio_close(h)
+
+
+def recordio_prefetch_iter(path, depth=4):
+    """Iterate record payloads staged by the C++ background thread."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library not built")
+    h = lib.ptrio_prefetch_open(path.encode(), depth)
+    if not h:
+        raise IOError("cannot open %s" % path)
+    try:
+        while True:
+            buf = ctypes.c_char_p()
+            n = lib.ptrio_prefetch_next(h, ctypes.byref(buf))
+            if n == -2:
+                raise IOError("checksum mismatch in %s" % path)
+            if n < 0:
+                break
+            yield ctypes.string_at(buf, n)
+    finally:
+        lib.ptrio_prefetch_close(h)
+
+
+class NativeRecordWriter(object):
+    """Streaming writer through the C ABI (crc computed in C++)."""
+
+    def __init__(self, path):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library not built")
+        self._lib = lib
+        self._h = lib.ptrio_writer_open(path.encode())
+        if not self._h:
+            raise IOError("cannot open %s for writing" % path)
+
+    def write(self, payload):
+        if self._lib.ptrio_writer_write(self._h, payload, len(payload)) != 0:
+            raise IOError("short write")
+
+    def close(self):
+        if self._h:
+            self._lib.ptrio_writer_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
