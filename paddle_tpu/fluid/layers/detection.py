@@ -10,7 +10,7 @@ from . import tensor as tensor_mod
 __all__ = [
     'prior_box', 'multi_box_head', 'bipartite_match', 'target_assign',
     'detection_output', 'ssd_loss', 'detection_map', 'rpn_target_assign',
-    'anchor_generator', 'box_coder',
+    'anchor_generator', 'box_coder', 'iou_similarity',
 ]
 
 
@@ -77,7 +77,10 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
         assert num_layer >= 2
         min_sizes = []
         max_sizes = []
-        step = int(np.floor((max_ratio - min_ratio) / (num_layer - 2)))
+        # with 2 maps there is no interpolation range (the reference
+        # derivation divides by num_layer-2); one ratio step covers it
+        step = (int(np.floor((max_ratio - min_ratio) / (num_layer - 2)))
+                if num_layer > 2 else (max_ratio - min_ratio + 1))
         for ratio in range(min_ratio, max_ratio + 1, step):
             min_sizes.append(base_size * ratio / 100.)
             max_sizes.append(base_size * (ratio + step) / 100.)
@@ -182,30 +185,106 @@ def detection_output(loc, scores, prior_box, prior_box_var,
     return nmsed_outs
 
 
+def iou_similarity(x, y, name=None):
+    """reference layers/detection.py:iou_similarity."""
+    helper = LayerHelper("iou_similarity", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="iou_similarity", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
+
+
 def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
              prior_box_var=None, background_label=0, overlap_threshold=0.5,
              neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
              conf_loss_weight=1.0, match_type='per_prediction',
              mining_type='max_negative', normalize=True,
              sample_size=None):
-    raise NotImplementedError(
-        "ssd_loss: lands with the detection milestone (bipartite match + "
-        "hard negative mining as masked dense ops)")
+    """reference layers/detection.py:ssd_loss:562.
+
+    TPU-first: the reference composes 13 ops (iou_similarity,
+    bipartite_match, target_assign x3, mine_hard_examples, ...); here ONE
+    fused dense op does matching, smooth-L1 localization loss, softmax
+    confidence loss and max-negative mining (ops_impl/detection_ops.py).
+    Returns the per-prior weighted loss [B, P, 1].
+    """
+    if mining_type != 'max_negative':
+        raise ValueError("only mining_type='max_negative' is supported "
+                         "(the reference's default)")
+    helper = LayerHelper('ssd_loss', **locals())
+    loss = helper.create_variable_for_type_inference('float32')
+    inputs = {'Loc': [location], 'Conf': [confidence], 'GtBox': [gt_box],
+              'GtLabel': [gt_label], 'PriorBox': [prior_box]}
+    if prior_box_var is not None:
+        inputs['PriorBoxVar'] = [prior_box_var]
+    helper.append_op(
+        type='ssd_loss', inputs=inputs, outputs={'Loss': [loss]},
+        attrs={'background_label': background_label,
+               'overlap_threshold': overlap_threshold,
+               'neg_pos_ratio': neg_pos_ratio,
+               'neg_overlap': neg_overlap,
+               'loc_loss_weight': loc_loss_weight,
+               'conf_loss_weight': conf_loss_weight,
+               'match_type': match_type, 'normalize': normalize},
+        infer_shape=False)
+    loss.shape = (location.shape[0], location.shape[1], 1)
+    return loss
 
 
 def detection_map(detect_res, label, class_num, background_label=0,
                   overlap_threshold=0.3, evaluate_difficult=True,
                   has_state=None, input_states=None, out_states=None,
                   ap_version='integral'):
-    raise NotImplementedError(
-        "detection_map: lands with the detection milestone")
+    """reference layers/detection.py:detection_map:299 (integral AP).
+    Stateless per-batch mAP over the dense NMS output."""
+    helper = LayerHelper('detection_map', **locals())
+    map_out = helper.create_variable_for_type_inference('float32')
+    helper.append_op(
+        type='detection_map',
+        inputs={'DetectRes': [detect_res], 'Label': [label]},
+        outputs={'MAP': [map_out]},
+        attrs={'class_num': class_num,
+               'background_label': background_label,
+               'overlap_threshold': overlap_threshold,
+               'evaluate_difficult': evaluate_difficult,
+               'ap_type': ap_version},
+        infer_shape=False)
+    map_out.shape = ()
+    map_out.stop_gradient = True
+    return map_out
 
 
 def rpn_target_assign(loc, scores, anchor_box, gt_box,
                       rpn_batch_size_per_im=256, fg_fraction=0.25,
                       rpn_positive_overlap=0.7, rpn_negative_overlap=0.3):
-    raise NotImplementedError(
-        "rpn_target_assign: lands with the detection milestone")
+    """reference layers/detection.py:rpn_target_assign:56.
+
+    Dense TPU form: exactly rpn_batch_size_per_im samples per image
+    (target label -1 marks unused slots) instead of the reference's
+    variable-length gathered index lists.
+    """
+    helper = LayerHelper('rpn_target_assign', **locals())
+    pred_score = helper.create_variable_for_type_inference(scores.dtype)
+    pred_loc = helper.create_variable_for_type_inference(loc.dtype)
+    tgt_lbl = helper.create_variable_for_type_inference('int32')
+    tgt_box = helper.create_variable_for_type_inference(loc.dtype)
+    helper.append_op(
+        type='rpn_target_assign',
+        inputs={'Loc': [loc], 'Score': [scores], 'AnchorBox': [anchor_box],
+                'GtBox': [gt_box]},
+        outputs={'PredScore': [pred_score], 'PredLoc': [pred_loc],
+                 'TargetLabel': [tgt_lbl], 'TargetBox': [tgt_box]},
+        attrs={'rpn_batch_size_per_im': rpn_batch_size_per_im,
+               'fg_fraction': fg_fraction,
+               'rpn_positive_overlap': rpn_positive_overlap,
+               'rpn_negative_overlap': rpn_negative_overlap},
+        infer_shape=False)
+    S = int(rpn_batch_size_per_im)
+    pred_score.shape = (loc.shape[0], S, 1)
+    pred_loc.shape = (loc.shape[0], S, 4)
+    tgt_lbl.shape = (loc.shape[0], S, 1)
+    tgt_box.shape = (loc.shape[0], S, 4)
+    return pred_score, pred_loc, tgt_lbl, tgt_box
 
 
 def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,

@@ -100,3 +100,414 @@ def _box_coder(ins, attrs, ctx):
             jnp.log(gw / pw[None]) / pvar[None, :, 2],
             jnp.log(gh / ph[None]) / pvar[None, :, 3]], axis=-1)
     return {'OutputBox': out}
+
+
+def _iou(a, b):
+    """IoU matrix between a [..., N, 4] and b [..., M, 4] -> [..., N, M]."""
+    ax1, ay1, ax2, ay2 = (a[..., :, None, i] for i in range(4))
+    bx1, by1, bx2, by2 = (b[..., None, :, i] for i in range(4))
+    iw = jnp.maximum(jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1), 0.0)
+    ih = jnp.maximum(jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1), 0.0)
+    inter = iw * ih
+    area_a = jnp.maximum(ax2 - ax1, 0.0) * jnp.maximum(ay2 - ay1, 0.0)
+    area_b = jnp.maximum(bx2 - bx1, 0.0) * jnp.maximum(by2 - by1, 0.0)
+    union = area_a + area_b - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-10), 0.0)
+
+
+_BIG_NEG = -1e9
+
+
+def _bipartite_greedy(dist):
+    """Greedy bipartite matching on dist [N, M] (rows=gt, cols=priors).
+
+    Returns (col_to_row [M] int32, col_dist [M]); -1 where unmatched.
+    Reference operators/detection/bipartite_match_op.cc — the sequential
+    global-argmax loop becomes a lax.fori_loop of masked argmaxes.
+    """
+    N, M = dist.shape
+    steps = min(N, M)
+
+    def body(_, carry):
+        d, col_match, col_dist = carry
+        flat = jnp.argmax(d)
+        r, c = flat // M, flat % M
+        val = d[r, c]
+        ok = val > _BIG_NEG / 2
+        col_match = jnp.where(ok, col_match.at[c].set(r.astype(jnp.int32)),
+                              col_match)
+        col_dist = jnp.where(ok, col_dist.at[c].set(val), col_dist)
+        d = jnp.where(ok, d.at[r, :].set(_BIG_NEG).at[:, c].set(_BIG_NEG), d)
+        return d, col_match, col_dist
+
+    init = (dist, jnp.full((M,), -1, jnp.int32), jnp.zeros((M,), dist.dtype))
+    _, col_match, col_dist = jax.lax.fori_loop(0, steps, body, init)
+    return col_match, col_dist
+
+
+def _match(dist, match_type, threshold):
+    """bipartite (+ optional per-prediction threshold fill)."""
+    col_match, col_dist = _bipartite_greedy(dist)
+    if match_type == 'per_prediction':
+        best_row = jnp.argmax(dist, axis=0).astype(jnp.int32)
+        best_val = jnp.max(dist, axis=0)
+        fill = (col_match < 0) & (best_val > threshold)
+        col_match = jnp.where(fill, best_row, col_match)
+        col_dist = jnp.where(fill, best_val, col_dist)
+    return col_match, col_dist
+
+
+@register('iou_similarity')
+def _iou_similarity(ins, attrs, ctx):
+    """reference operators/detection/iou_similarity_op.cc."""
+    x = data_of(ins['X'][0])
+    y = data_of(ins['Y'][0])
+    return {'Out': _iou(x, y)}
+
+
+@register('bipartite_match')
+def _bipartite_match(ins, attrs, ctx):
+    dist = data_of(ins['DistMat'][0])
+    mt = attrs.get('match_type', 'bipartite')
+    thr = float(attrs.get('dist_threshold', 0.5))
+    if dist.ndim == 2:
+        m, d = _match(dist, mt, thr)
+        return {'ColToRowMatchIndices': m[None], 'ColToRowMatchDist': d[None]}
+    m, d = jax.vmap(lambda x: _match(x, mt, thr))(dist)
+    return {'ColToRowMatchIndices': m, 'ColToRowMatchDist': d}
+
+
+@register('target_assign')
+def _target_assign(ins, attrs, ctx):
+    """Gather per-prior targets by match index (reference
+    operators/detection/target_assign_op.cc); mismatch rows get
+    mismatch_value with weight 0."""
+    x = ins['X'][0]
+    xd = data_of(x)                       # [B, N, K]
+    match = data_of(ins['MatchIndices'][0])   # [B, M]
+    mval = attrs.get('mismatch_value', 0)
+
+    def one(xb, mb):
+        safe = jnp.maximum(mb, 0)
+        out = xb[safe]                    # [M, K]
+        ok = (mb >= 0)[:, None]
+        return jnp.where(ok, out, mval), ok.astype(jnp.float32)
+
+    out, w = jax.vmap(one)(xd, match)
+    return {'Out': out, 'OutWeight': w}
+
+
+def _nms_class(iou_all, scores, nms_threshold, score_threshold, nms_top_k,
+               nms_eta=1.0):
+    """Single-class NMS: returns keep mask [M] (top nms_top_k by score,
+    greedy IoU suppression). iou_all is the class-shared [M, M] IoU matrix
+    (computed once per image); the sequential suppression runs as a
+    fori_loop over the score-sorted candidates. The adaptive threshold
+    (nms_eta < 1) decays only after a kept box while thr > 0.5, matching
+    the reference multiclass_nms_op."""
+    M = scores.shape[0]
+    k = min(nms_top_k, M) if nms_top_k > 0 else M
+    order = jnp.argsort(-scores)
+    ss = scores[order]
+    iou = iou_all[order][:, order]
+    valid = ss > score_threshold
+
+    def body(i, carry):
+        keep, suppressed, thr = carry
+        cur = valid[i] & ~suppressed[i]
+        keep = keep.at[i].set(cur)
+        later = jnp.arange(M) > i
+        suppressed = suppressed | (cur & later & (iou[i] > thr))
+        thr = jnp.where((nms_eta < 1.0) & cur & (thr > 0.5), thr * nms_eta,
+                        thr)
+        return keep, suppressed, thr
+
+    keep, _, _ = jax.lax.fori_loop(
+        0, k, body, (jnp.zeros((M,), bool), jnp.zeros((M,), bool),
+                     jnp.asarray(nms_threshold, jnp.float32)))
+    # un-sort the keep mask
+    inv = jnp.zeros((M,), jnp.int32).at[order].set(jnp.arange(M, dtype=jnp.int32))
+    return keep[inv]
+
+
+@register('multiclass_nms')
+def _multiclass_nms(ins, attrs, ctx):
+    """reference operators/detection/multiclass_nms_op.cc.
+
+    TPU redesign: output is DENSE [B, keep_top_k, 6] (label, score, box),
+    padded with label=-1 rows — the reference emits a variable-length
+    LoDTensor, a dynamic shape XLA can't compile.
+    """
+    bboxes = data_of(ins['BBoxes'][0])    # [B, M, 4]
+    scores = data_of(ins['Scores'][0])    # [B, C, M] or [B, M, C]
+    M = bboxes.shape[1]
+    if scores.shape[-1] == M and scores.shape[1] != M:
+        pass                              # [B, C, M]
+    else:
+        scores = jnp.swapaxes(scores, 1, 2)   # -> [B, C, M]
+    C = scores.shape[1]
+    bg = int(attrs.get('background_label', 0))
+    nms_thr = float(attrs.get('nms_threshold', 0.3))
+    score_thr = float(attrs.get('score_threshold', 0.01))
+    nms_top_k = int(attrs.get('nms_top_k', 400))
+    keep_top_k = int(attrs.get('keep_top_k', 200))
+    nms_eta = float(attrs.get('nms_eta', 1.0))
+
+    def one(boxes, sc):
+        iou_all = _iou(boxes, boxes)     # shared across classes
+        cand_scores, cand_labels = [], []
+        for c in range(C):
+            if c == bg:
+                continue
+            keep = _nms_class(iou_all, sc[c], nms_thr, score_thr, nms_top_k,
+                              nms_eta)
+            cand_scores.append(jnp.where(keep, sc[c], -1.0))
+            cand_labels.append(jnp.full((M,), c, jnp.float32))
+        all_scores = jnp.concatenate(cand_scores)    # [(C-1)*M]
+        all_labels = jnp.concatenate(cand_labels)
+        all_boxes = jnp.tile(boxes, (len(cand_scores), 1))
+        k = min(keep_top_k, all_scores.shape[0])
+        top = jnp.argsort(-all_scores)[:k]
+        ts, tl, tb = all_scores[top], all_labels[top], all_boxes[top]
+        ok = ts > 0
+        row = jnp.concatenate([jnp.where(ok, tl, -1.0)[:, None],
+                               jnp.where(ok, ts, 0.0)[:, None],
+                               jnp.where(ok[:, None], tb, 0.0)], axis=1)
+        if k < keep_top_k:
+            row = jnp.pad(row, ((0, keep_top_k - k), (0, 0)),
+                          constant_values=-1.0)
+        return row
+
+    return {'Out': jax.vmap(one)(bboxes, scores)}
+
+
+@register('anchor_generator')
+def _anchor_generator(ins, attrs, ctx):
+    """reference operators/detection/anchor_generator_op.cc."""
+    feat = data_of(ins['Input'][0])       # NCHW
+    sizes = list(attrs.get('anchor_sizes', [64.0]))
+    ars = list(attrs.get('aspect_ratios', [1.0]))
+    variances = list(attrs.get('variances', [0.1, 0.1, 0.2, 0.2]))
+    stride = list(attrs.get('stride', [16.0, 16.0]))
+    offset = float(attrs.get('offset', 0.5))
+    fh, fw = feat.shape[2], feat.shape[3]
+    cx = (jnp.arange(fw) + offset) * stride[0]
+    cy = (jnp.arange(fh) + offset) * stride[1]
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    shapes = []
+    for ar in ars:
+        for s in sizes:
+            w = s * np.sqrt(ar)
+            h = s / np.sqrt(ar)
+            shapes.append((w / 2.0, h / 2.0))
+    out = jnp.stack([jnp.stack([cxg - w, cyg - h, cxg + w, cyg + h], axis=-1)
+                     for w, h in shapes], axis=2)   # [fh, fw, A, 4]
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           out.shape[:-1] + (4,))
+    return {'Anchors': out, 'Variances': var}
+
+
+def _encode_boxes(gt, priors, pvar):
+    """center-size encode gt [*, 4] against priors [*, 4]."""
+    pw = priors[..., 2] - priors[..., 0]
+    ph = priors[..., 3] - priors[..., 1]
+    pcx = priors[..., 0] + 0.5 * pw
+    pcy = priors[..., 1] + 0.5 * ph
+    gw = jnp.maximum(gt[..., 2] - gt[..., 0], 1e-6)
+    gh = jnp.maximum(gt[..., 3] - gt[..., 1], 1e-6)
+    gcx = gt[..., 0] + 0.5 * gw
+    gcy = gt[..., 1] + 0.5 * gh
+    return jnp.stack([(gcx - pcx) / pw / pvar[..., 0],
+                      (gcy - pcy) / ph / pvar[..., 1],
+                      jnp.log(gw / pw) / pvar[..., 2],
+                      jnp.log(gh / ph) / pvar[..., 3]], axis=-1)
+
+
+@register('ssd_loss')
+def _ssd_loss(ins, attrs, ctx):
+    """Fused SSD loss (reference layers/detection.py:ssd_loss:562 — there a
+    13-op chain of iou_similarity/bipartite_match/target_assign/
+    mine_hard_examples; here ONE dense rule, XLA fuses the lot).
+
+    Per image: per-prediction matching, smooth-L1 on matched localizations,
+    softmax CE on class scores, max-negative hard mining at neg_pos_ratio.
+    Out: per-prior weighted loss [B, P] (normalized by positive count).
+    """
+    from ..lowering import SeqValue
+    loc = data_of(ins['Loc'][0])          # [B, P, 4]
+    conf = data_of(ins['Conf'][0])        # [B, P, C]
+    gt_box_v = ins['GtBox'][0]
+    gt_lbl_v = ins['GtLabel'][0]
+    gt_box = data_of(gt_box_v)            # [B, G, 4]
+    gt_lbl = data_of(gt_lbl_v).reshape(gt_box.shape[0], -1)  # [B, G]
+    lengths = (gt_box_v.lengths if isinstance(gt_box_v, SeqValue)
+               else jnp.full((gt_box.shape[0],), gt_box.shape[1], jnp.int32))
+    priors = data_of(ins['PriorBox'][0]).reshape(-1, 4)       # [P, 4]
+    pvar = (data_of(ins['PriorBoxVar'][0]).reshape(-1, 4)
+            if ins.get('PriorBoxVar') else jnp.ones_like(priors))
+    bg = int(attrs.get('background_label', 0))
+    overlap_t = float(attrs.get('overlap_threshold', 0.5))
+    neg_ratio = float(attrs.get('neg_pos_ratio', 3.0))
+    neg_overlap = float(attrs.get('neg_overlap', 0.5))
+    loc_w = float(attrs.get('loc_loss_weight', 1.0))
+    conf_w = float(attrs.get('conf_loss_weight', 1.0))
+    match_type = attrs.get('match_type', 'per_prediction')
+    normalize = bool(attrs.get('normalize', True))
+    G = gt_box.shape[1]
+
+    def one(loc_b, conf_b, gtb, gtl, n_gt):
+        valid_gt = jnp.arange(G) < n_gt
+        raw_iou = _iou(gtb, priors)                   # [G, P]
+        dist = jnp.where(valid_gt[:, None], raw_iou, _BIG_NEG)
+        match, _ = _match(dist, match_type, overlap_t)   # [P]
+        pos = match >= 0
+        n_pos = pos.sum()
+        safe = jnp.maximum(match, 0)
+        matched_gt = gtb[safe]                        # [P, 4]
+        loc_target = _encode_boxes(matched_gt, priors, pvar)
+        diff = loc_b - loc_target
+        ad = jnp.abs(diff)
+        smooth = jnp.where(ad < 1.0, 0.5 * ad * ad, ad - 0.5).sum(-1)
+        loc_loss = smooth * pos
+
+        labels = jnp.where(pos, gtl[safe].astype(jnp.int32), bg)
+        logp = jax.nn.log_softmax(conf_b, axis=-1)
+        ce = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+        # max-negative mining: only priors whose best overlap is below
+        # neg_overlap are eligible (the reference excludes ambiguous
+        # [neg_overlap, overlap_threshold) priors); rank by conf loss
+        best_iou = jnp.max(jnp.where(valid_gt[:, None], raw_iou, 0.0), axis=0)
+        neg_cand = (~pos) & (best_iou < neg_overlap)
+        neg_loss = jnp.where(neg_cand, ce, -jnp.inf)
+        n_neg = jnp.minimum((neg_ratio * n_pos).astype(jnp.int32),
+                            neg_cand.sum())
+        rank = jnp.argsort(jnp.argsort(-neg_loss))
+        neg_sel = neg_cand & (rank < n_neg)
+        conf_loss = ce * (pos | neg_sel)
+        total = loc_w * loc_loss + conf_w * conf_loss
+        if normalize:
+            total = total / jnp.maximum(n_pos.astype(total.dtype), 1.0)
+        return total
+
+    loss = jax.vmap(one)(loc, conf, gt_box, gt_lbl, lengths)
+    return {'Loss': loss[..., None]}    # [B, P, 1], the declared shape
+
+
+@register('rpn_target_assign')
+def _rpn_target_assign(ins, attrs, ctx):
+    """reference layers/detection.py:rpn_target_assign:56. Dense form:
+    fixed rpn_batch_size_per_im samples per image, label -1 marks unused
+    slots (the reference gathers variable-size sampled index lists)."""
+    from ..lowering import SeqValue
+    loc = data_of(ins['Loc'][0])          # [B, A, 4]
+    scores = data_of(ins['Score'][0])     # [B, A, 1]
+    anchors = data_of(ins['AnchorBox'][0]).reshape(-1, 4)     # [A, 4]
+    gt_v = ins['GtBox'][0]
+    gt = data_of(gt_v)                    # [B, G, 4]
+    lengths = (gt_v.lengths if isinstance(gt_v, SeqValue)
+               else jnp.full((gt.shape[0],), gt.shape[1], jnp.int32))
+    S = int(attrs.get('rpn_batch_size_per_im', 256))
+    fg_frac = float(attrs.get('fg_fraction', 0.25))
+    pos_t = float(attrs.get('rpn_positive_overlap', 0.7))
+    neg_t = float(attrs.get('rpn_negative_overlap', 0.3))
+    G = gt.shape[1]
+    n_fg = int(S * fg_frac)
+
+    def one(loc_b, sc_b, gtb, n_gt):
+        valid_gt = jnp.arange(G) < n_gt
+        iou = _iou(gtb, anchors)                     # [G, A]
+        iou = jnp.where(valid_gt[:, None], iou, 0.0)
+        best_gt = jnp.argmax(iou, axis=0)            # per anchor
+        best_iou = jnp.max(iou, axis=0)
+        # positives: iou > pos_t, plus the best anchor of every gt
+        pos = best_iou > pos_t
+        best_anchor = jnp.argmax(iou, axis=1)        # [G]
+        # duplicate indices (padded gt rows all argmax to 0) must OR, not
+        # race: .max() is the deterministic scatter-or
+        pos = pos.at[best_anchor].max(valid_gt)
+        neg = (best_iou < neg_t) & ~pos
+        # deterministic sampling: top-iou positives, lowest-iou negatives
+        pos_rank = jnp.argsort(jnp.argsort(-jnp.where(pos, best_iou, -1.0)))
+        pos_sel = pos & (pos_rank < n_fg)
+        n_pos_sel = pos_sel.sum()
+        n_neg = S - n_pos_sel
+        neg_rank = jnp.argsort(jnp.argsort(jnp.where(neg, best_iou, 2.0)))
+        neg_sel = neg & (neg_rank < n_neg)
+        sel = pos_sel | neg_sel
+        idx = jnp.argsort(~sel)[:S]              # selected slots first
+        tgt_box = _encode_boxes(gtb[best_gt], anchors, jnp.ones_like(anchors))
+        lbl = jnp.where(pos_sel, 1, jnp.where(neg_sel, 0, -1))
+        return (sc_b[idx], loc_b[idx], lbl[idx][:, None],
+                tgt_box[idx])
+
+    ps, pl, tl, tb = jax.vmap(one)(loc, scores, gt, lengths)
+    return {'PredScore': ps, 'PredLoc': pl, 'TargetLabel': tl,
+            'TargetBox': tb}
+
+
+@register('detection_map')
+def _detection_map(ins, attrs, ctx):
+    """Integral-AP mAP metric (reference operators/detection/
+    detection_map_op.cc), stateless per batch. DetectRes is the dense
+    multiclass_nms output [B, K, 6]; Label is [B, G, 5] (label, box) padded
+    SeqValue."""
+    from ..lowering import SeqValue
+    det = data_of(ins['DetectRes'][0])    # [B, K, 6]
+    lab_v = ins['Label'][0]
+    lab = data_of(lab_v)                  # [B, G, >=5]
+    B, G = lab.shape[0], lab.shape[1]
+    lengths = (lab_v.lengths if isinstance(lab_v, SeqValue)
+               else jnp.full((B,), G, jnp.int32))
+    C = int(attrs['class_num'])
+    bg = int(attrs.get('background_label', 0))
+    thr = float(attrs.get('overlap_threshold', 0.3))
+    if attrs.get('ap_type', 'integral') != 'integral':
+        raise ValueError("detection_map: only ap_version='integral' is "
+                         "implemented")
+    K = det.shape[1]
+
+    gt_valid = jnp.arange(G)[None, :] < lengths[:, None]      # [B, G]
+    gt_label = lab[..., 0]
+    gt_box = lab[..., 1:5]
+
+    aps = []
+    for c in range(C):
+        if c == bg:
+            continue
+        det_ok = det[..., 0] == c                              # [B, K]
+        scores = jnp.where(det_ok, det[..., 1], -1.0)
+        gt_c = gt_valid & (gt_label == c)                      # [B, G]
+        n_gt = gt_c.sum()
+
+        flat_scores = scores.reshape(-1)                       # [B*K]
+        order = jnp.argsort(-flat_scores)
+
+        def body(i, carry):
+            used, tp, fp = carry
+            fi = order[i]
+            b, k = fi // K, fi % K
+            valid = flat_scores[fi] > 0
+            iou = _iou(det[b, k, 2:6][None], gt_box[b])[0]     # [G]
+            iou = jnp.where(gt_c[b] & ~used[b], iou, -1.0)
+            j = jnp.argmax(iou)
+            hit = valid & (iou[j] >= thr)
+            used = jnp.where(hit, used.at[b, j].set(True), used)
+            tp = tp.at[i].set(jnp.where(valid & hit, 1.0, 0.0))
+            fp = fp.at[i].set(jnp.where(valid & ~hit, 1.0, 0.0))
+            return used, tp, fp
+
+        used0 = jnp.zeros((B, G), bool)
+        n = B * K
+        _, tp, fp = jax.lax.fori_loop(
+            0, n, body, (used0, jnp.zeros((n,)), jnp.zeros((n,))))
+        ctp = jnp.cumsum(tp)
+        cfp = jnp.cumsum(fp)
+        precision = ctp / jnp.maximum(ctp + cfp, 1e-10)
+        # integral AP: sum precision * delta-recall over detections
+        ap = jnp.sum(precision * tp) / jnp.maximum(n_gt, 1)
+        aps.append(jnp.where(n_gt > 0, ap, jnp.nan))
+
+    aps = jnp.stack(aps)
+    valid = ~jnp.isnan(aps)
+    mean_ap = jnp.where(valid, aps, 0.0).sum() / jnp.maximum(valid.sum(), 1)
+    return {'MAP': mean_ap}

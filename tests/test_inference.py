@@ -1,0 +1,68 @@
+"""Inference deployment: Predictor (program bundle) + compiled StableHLO
+artifact (jax.export). Parity: reference inference/api tests + capi."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.fluid.layers as layers
+from paddle_tpu import inference
+
+from util import fresh_program
+
+
+def _build_and_save(tmpdir, compiled=False):
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[8])
+        y = layers.data(name='y', shape=[1])
+        h = layers.fc(input=x, size=16, act='relu')
+        pred = layers.fc(input=h, size=1)
+        loss = layers.mean(layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        xv = np.random.RandomState(0).rand(4, 8).astype('float32')
+        yv = xv.sum(1, keepdims=True).astype('float32')
+        exe.run(main, feed={'x': xv, 'y': yv}, fetch_list=[loss])
+        fluid.io.save_inference_model(str(tmpdir), ['x'], [pred], exe,
+                                      main_program=main)
+        if compiled:
+            inference.export_compiled(str(tmpdir), {'x': xv}, [pred], exe,
+                                      main_program=main)
+        want, = exe.run(main.clone(for_test=True).prune([pred]),
+                        feed={'x': xv}, fetch_list=[pred])
+        return xv, want
+
+
+def test_predictor_matches_training_graph(tmp_path):
+    xv, want = _build_and_save(tmp_path)
+    p = inference.Predictor(str(tmp_path), place=fluid.CPUPlace())
+    assert p.feed_names == ['x']
+    got, = p.run({'x': xv})
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_compiled_artifact_round_trip(tmp_path):
+    xv, want = _build_and_save(tmp_path, compiled=True)
+    run = inference.load_compiled(str(tmp_path))
+    assert run.feed_names == ['x']
+    got, = run({'x': xv})
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_compiled_artifact_sequence_model(tmp_path):
+    # lod (sequence) input path through export_compiled
+    with fresh_program() as (main, startup):
+        words = layers.data(name='words', shape=[1], dtype='int64',
+                            lod_level=1)
+        emb = layers.embedding(input=words, size=[30, 8])
+        pooled = layers.sequence_pool(input=emb, pool_type='average')
+        pred = layers.fc(input=pooled, size=3, act='softmax')
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        ids = np.random.RandomState(1).randint(0, 30, size=(2, 5, 1)).astype('int64')
+        inference.export_compiled(str(tmp_path), {'words': ids}, [pred], exe,
+                                  main_program=main)
+        want, = exe.run(main.clone(for_test=True).prune([pred]),
+                        feed={'words': ids}, fetch_list=[pred])
+    run = inference.load_compiled(str(tmp_path))
+    got, = run({'words': ids})
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)

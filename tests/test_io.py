@@ -1,0 +1,65 @@
+"""io: persistables save/load, checkpoint/resume. Mirrors reference
+test_io_save_load / checkpoint utilities."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.fluid.layers as layers
+
+from util import fresh_program
+
+
+def _small_net():
+    x = layers.data(name='x', shape=[4])
+    y = layers.data(name='y', shape=[1])
+    pred = layers.fc(input=x, size=1)
+    loss = layers.mean(layers.square_error_cost(input=pred, label=y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return pred, loss
+
+
+def test_save_load_persistables_round_trip(tmp_path):
+    r = np.random.RandomState(0)
+    xv = r.rand(8, 4).astype('float32')
+    yv = r.rand(8, 1).astype('float32')
+    with fresh_program() as (main, startup):
+        pred, loss = _small_net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        exe.run(main, feed={'x': xv, 'y': yv}, fetch_list=[loss])
+        fluid.io.save_persistables(exe, str(tmp_path), main_program=main)
+        want, = exe.run(main.clone(for_test=True).prune([pred]), feed={'x': xv},
+                        fetch_list=[pred])
+    with fresh_program() as (main2, startup2):
+        pred2, loss2 = _small_net()
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        exe2.run(startup2)
+        fluid.io.load_persistables(exe2, str(tmp_path), main_program=main2)
+        got, = exe2.run(main2.clone(for_test=True).prune([pred2]), feed={'x': xv},
+                        fetch_list=[pred2])
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_checkpoint_resume(tmp_path):
+    r = np.random.RandomState(1)
+    xv = r.rand(8, 4).astype('float32')
+    yv = r.rand(8, 1).astype('float32')
+    with fresh_program() as (main, startup):
+        pred, loss = _small_net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for _ in range(3):
+            exe.run(main, feed={'x': xv, 'y': yv}, fetch_list=[loss])
+        fluid.io.save_checkpoint(exe, str(tmp_path), main_program=main,
+                                 step=3)
+        want, = exe.run(main.clone(for_test=True).prune([pred]), feed={'x': xv},
+                        fetch_list=[pred])
+    with fresh_program() as (main2, startup2):
+        pred2, loss2 = _small_net()
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        exe2.run(startup2)
+        meta = fluid.io.load_checkpoint(exe2, str(tmp_path),
+                                        main_program=main2)
+        assert meta['step'] == 3
+        got, = exe2.run(main2.clone(for_test=True).prune([pred2]), feed={'x': xv},
+                        fetch_list=[pred2])
+    np.testing.assert_allclose(got, want, rtol=1e-6)

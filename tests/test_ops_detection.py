@@ -1,0 +1,174 @@
+"""Detection op family: matching, NMS, fused SSD loss, RPN targets, mAP.
+Mirrors reference unittests test_bipartite_match_op / test_multiclass_nms_op
+/ test_ssd_loss / test_rpn_target_assign_op / test_detection_map_op."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.fluid.layers as layers
+from paddle_tpu.fluid.layers import detection
+
+from util import fresh_program
+
+
+def _run(main, startup, feed, fetch):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def _np_iou(a, b):
+    inter_w = np.maximum(np.minimum(a[:, None, 2], b[None, :, 2]) -
+                         np.maximum(a[:, None, 0], b[None, :, 0]), 0)
+    inter_h = np.maximum(np.minimum(a[:, None, 3], b[None, :, 3]) -
+                         np.maximum(a[:, None, 1], b[None, :, 1]), 0)
+    inter = inter_w * inter_h
+    aa = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    bb = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    union = aa[:, None] + bb[None, :] - inter
+    return np.where(union > 0, inter / np.maximum(union, 1e-10), 0)
+
+
+def test_iou_similarity():
+    r = np.random.RandomState(0)
+    x = np.sort(r.rand(5, 4).astype('float32'), -1)
+    y = np.sort(r.rand(7, 4).astype('float32'), -1)
+    with fresh_program() as (main, startup):
+        xv = layers.data(name='x', shape=[5, 4], append_batch_size=False)
+        yv = layers.data(name='y', shape=[7, 4], append_batch_size=False)
+        out = detection.iou_similarity(xv, yv)
+        got, = _run(main, startup, {'x': x, 'y': y}, [out])
+    np.testing.assert_allclose(got, _np_iou(x, y), rtol=1e-5, atol=1e-6)
+
+
+def test_bipartite_match_greedy():
+    # hand-checkable matrix: global greedy picks (1,0)=0.9 then (0,2)=0.8
+    dist = np.array([[0.5, 0.1, 0.8],
+                     [0.9, 0.2, 0.7]], dtype='float32')
+    with fresh_program() as (main, startup):
+        d = layers.data(name='d', shape=[2, 3], append_batch_size=False)
+        idx, md = detection.bipartite_match(d)
+        got_i, got_d = _run(main, startup, {'d': dist}, [idx, md])
+    np.testing.assert_array_equal(got_i[0], [1, -1, 0])
+    np.testing.assert_allclose(got_d[0], [0.9, 0.0, 0.8], rtol=1e-6)
+
+
+def test_bipartite_match_per_prediction():
+    dist = np.array([[0.5, 0.6, 0.8],
+                     [0.9, 0.2, 0.7]], dtype='float32')
+    with fresh_program() as (main, startup):
+        d = layers.data(name='d', shape=[2, 3], append_batch_size=False)
+        idx, md = detection.bipartite_match(d, match_type='per_prediction',
+                                            dist_threshold=0.55)
+        got_i, _ = _run(main, startup, {'d': dist}, [idx, md])
+    # col1 unmatched by bipartite, filled since max(0.6, 0.2) > 0.55
+    np.testing.assert_array_equal(got_i[0], [1, 0, 0])
+
+
+def test_multiclass_nms_dense():
+    # two overlapping boxes + one distinct; NMS keeps the high-score of the
+    # overlapping pair and the distinct box
+    boxes = np.array([[[0.0, 0.0, 0.4, 0.4],
+                       [0.01, 0.01, 0.41, 0.41],
+                       [0.6, 0.6, 0.9, 0.9]]], dtype='float32')
+    scores = np.zeros((1, 2, 3), dtype='float32')   # [B, C, M], class 0 = bg
+    scores[0, 1] = [0.9, 0.8, 0.7]
+    with fresh_program() as (main, startup):
+        b = layers.data(name='b', shape=[1, 3, 4], append_batch_size=False)
+        s = layers.data(name='s', shape=[1, 2, 3], append_batch_size=False)
+        out_var = main.global_block().create_var(name='nms_out',
+                                                 shape=[1, 4, 6],
+                                                 dtype='float32')
+        main.global_block().append_op(
+            type='multiclass_nms', inputs={'BBoxes': [b], 'Scores': [s]},
+            outputs={'Out': [out_var]},
+            attrs={'background_label': 0, 'nms_threshold': 0.5,
+                   'nms_top_k': 3, 'keep_top_k': 4, 'score_threshold': 0.01,
+                   'nms_eta': 1.0}, infer_shape=False)
+        got, = _run(main, startup, {'b': boxes, 's': scores}, [out_var])
+    kept = got[0][got[0][:, 0] >= 0]
+    assert len(kept) == 2
+    np.testing.assert_allclose(sorted(kept[:, 1]), [0.7, 0.9], rtol=1e-6)
+
+
+def test_ssd_loss_decreases():
+    r = np.random.RandomState(1)
+    B, P, C, G = 2, 16, 4, 3
+    priors = np.sort(r.rand(P, 4).astype('float32') * 0.8, -1)
+    priors[:, 2:] += 0.2
+    gt_flat = np.sort(r.rand(B * G, 4).astype('float32') * 0.8, -1)
+    gt_flat[:, 2:] += 0.2
+    lbl_flat = r.randint(1, C, size=(B * G, 1)).astype('int64')
+    gt_lt = fluid.create_lod_tensor(gt_flat, [[G, G]])
+    lbl_lt = fluid.create_lod_tensor(lbl_flat, [[G, G]])
+    with fresh_program() as (main, startup):
+        feat = layers.data(name='feat', shape=[8])
+        loc = layers.reshape(layers.fc(input=feat, size=P * 4),
+                             shape=[-1, P, 4])
+        conf = layers.reshape(layers.fc(input=feat, size=P * C),
+                              shape=[-1, P, C])
+        gt_box = layers.data(name='gt', shape=[4], lod_level=1)
+        gt_lbl = layers.data(name='lbl', shape=[1], lod_level=1,
+                             dtype='int64')
+        pb = layers.assign(priors)
+        loss = detection.ssd_loss(loc, conf, gt_box, gt_lbl, pb)
+        avg = layers.reduce_mean(layers.reduce_sum(loss, dim=1))
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(avg)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        x = r.rand(B, 8).astype('float32')
+        losses = [float(np.asarray(
+            exe.run(main, feed={'feat': x, 'gt': gt_lt, 'lbl': lbl_lt},
+                    fetch_list=[avg])[0]))
+            for _ in range(25)]
+    assert np.all(np.isfinite(losses))
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_rpn_target_assign_shapes_and_labels():
+    r = np.random.RandomState(2)
+    B, A, G, S = 2, 32, 4, 8
+    anchors = np.sort(r.rand(A, 4).astype('float32') * 0.8, -1)
+    anchors[:, 2:] += 0.2
+    # ground truth = a few anchors exactly (guaranteed positives)
+    gt_flat = np.concatenate([anchors[:G], anchors[:G]], 0).copy()
+    gt_lt = fluid.create_lod_tensor(gt_flat, [[G, G]])
+    with fresh_program() as (main, startup):
+        loc = layers.data(name='loc', shape=[A, 4])
+        score = layers.data(name='score', shape=[A, 1])
+        anc = layers.assign(anchors)
+        gt = layers.data(name='gt', shape=[4], lod_level=1)
+        ps, pl, tl, tb = detection.rpn_target_assign(
+            loc, score, anc, gt, rpn_batch_size_per_im=S,
+            rpn_positive_overlap=0.7, rpn_negative_overlap=0.3)
+        got = _run(main, startup,
+                   {'loc': r.rand(B, A, 4).astype('float32'),
+                    'score': r.rand(B, A, 1).astype('float32'),
+                    'gt': gt_lt}, [ps, pl, tl, tb])
+    ps_v, pl_v, tl_v, tb_v = got
+    assert ps_v.shape == (B, S, 1) and pl_v.shape == (B, S, 4)
+    assert tl_v.shape == (B, S, 1) and tb_v.shape == (B, S, 4)
+    # positives capped at fg_fraction * S per image; exact-match anchors
+    # guarantee that many exist
+    n_fg = int(S * 0.25)
+    assert (tl_v == 1).sum() == B * n_fg
+    assert set(np.unique(tl_v)) <= {-1, 0, 1}
+
+
+def test_detection_map_perfect_and_empty():
+    # one gt box per image, detection == gt -> mAP 1; no detection -> 0
+    gt_flat = np.array([[1, 0.1, 0.1, 0.4, 0.4],
+                        [1, 0.5, 0.5, 0.8, 0.8]], dtype='float32')
+    lab_lt = fluid.create_lod_tensor(gt_flat, [[1, 1]])
+    perfect = np.full((2, 3, 6), -1.0, dtype='float32')
+    perfect[0, 0] = [1, 0.9, 0.1, 0.1, 0.4, 0.4]
+    perfect[1, 0] = [1, 0.8, 0.5, 0.5, 0.8, 0.8]
+    empty = np.full((2, 3, 6), -1.0, dtype='float32')
+    for det, want in ((perfect, 1.0), (empty, 0.0)):
+        with fresh_program() as (main, startup):
+            d = layers.data(name='d', shape=[2, 3, 6],
+                            append_batch_size=False)
+            lab = layers.data(name='lab', shape=[5], lod_level=1)
+            m = detection.detection_map(d, lab, class_num=2)
+            got, = _run(main, startup, {'d': det, 'lab': lab_lt}, [m])
+        assert abs(float(got) - want) < 1e-6, (float(got), want)
