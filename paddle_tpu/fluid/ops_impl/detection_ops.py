@@ -90,15 +90,8 @@ def _box_coder(ins, attrs, ctx):
                          tcx + tw / 2, tcy + th / 2], axis=-1)
     else:
         # encode: target [N, 4] gt boxes vs priors [M, 4] -> [N, M, 4]
-        gw = target[:, None, 2] - target[:, None, 0]
-        gh = target[:, None, 3] - target[:, None, 1]
-        gcx = target[:, None, 0] + 0.5 * gw
-        gcy = target[:, None, 1] + 0.5 * gh
-        out = jnp.stack([
-            (gcx - pcx[None]) / pw[None] / pvar[None, :, 0],
-            (gcy - pcy[None]) / ph[None] / pvar[None, :, 1],
-            jnp.log(gw / pw[None]) / pvar[None, :, 2],
-            jnp.log(gh / ph[None]) / pvar[None, :, 3]], axis=-1)
+        out = _encode_boxes(target[:, None, :], prior[None, :, :],
+                            pvar[None, :, :])
     return {'OutputBox': out}
 
 
@@ -239,11 +232,12 @@ def _multiclass_nms(ins, attrs, ctx):
     LoDTensor, a dynamic shape XLA can't compile.
     """
     bboxes = data_of(ins['BBoxes'][0])    # [B, M, 4]
-    scores = data_of(ins['Scores'][0])    # [B, C, M] or [B, M, C]
+    scores = data_of(ins['Scores'][0])
     M = bboxes.shape[1]
-    if scores.shape[-1] == M and scores.shape[1] != M:
-        pass                              # [B, C, M]
-    else:
+    # layout is declared by the caller ('BCM' is the reference canonical;
+    # detection_output passes 'BMC') — no shape sniffing, which would
+    # misread canonical input whenever C == M
+    if attrs.get('scores_layout', 'BCM') == 'BMC':
         scores = jnp.swapaxes(scores, 1, 2)   # -> [B, C, M]
     C = scores.shape[1]
     bg = int(attrs.get('background_label', 0))
@@ -253,19 +247,17 @@ def _multiclass_nms(ins, attrs, ctx):
     keep_top_k = int(attrs.get('keep_top_k', 200))
     nms_eta = float(attrs.get('nms_eta', 1.0))
 
+    classes = [c for c in range(C) if c != bg]
+
     def one(boxes, sc):
         iou_all = _iou(boxes, boxes)     # shared across classes
-        cand_scores, cand_labels = [], []
-        for c in range(C):
-            if c == bg:
-                continue
-            keep = _nms_class(iou_all, sc[c], nms_thr, score_thr, nms_top_k,
-                              nms_eta)
-            cand_scores.append(jnp.where(keep, sc[c], -1.0))
-            cand_labels.append(jnp.full((M,), c, jnp.float32))
-        all_scores = jnp.concatenate(cand_scores)    # [(C-1)*M]
-        all_labels = jnp.concatenate(cand_labels)
-        all_boxes = jnp.tile(boxes, (len(cand_scores), 1))
+        cls_scores = sc[jnp.asarray(classes)]        # [C', M]
+        keep = jax.vmap(lambda s_c: _nms_class(
+            iou_all, s_c, nms_thr, score_thr, nms_top_k,
+            nms_eta))(cls_scores)                    # [C', M]
+        all_scores = jnp.where(keep, cls_scores, -1.0).reshape(-1)
+        all_labels = jnp.repeat(jnp.asarray(classes, jnp.float32), M)
+        all_boxes = jnp.tile(boxes, (len(classes), 1))
         k = min(keep_top_k, all_scores.shape[0])
         top = jnp.argsort(-all_scores)[:k]
         ts, tl, tb = all_scores[top], all_labels[top], all_boxes[top]

@@ -32,15 +32,17 @@ def _conv2d(ins, attrs, ctx):
     groups = attrs.get('groups', 1) or 1
     in_dtype = x.dtype
     xc, wc = amp_cast(ctx, x, w.astype(x.dtype))
+    # no preferred_element_type here: conv_general_dilated's transpose
+    # (grad) rule feeds the f32 cotangent straight back into a bf16 conv
+    # and trips a dtype mismatch; XLA:TPU accumulates bf16 convs in f32
+    # internally regardless, so a plain bf16 conv + cast is equivalent
     out = lax.conv_general_dilated(
         xc, wc,
         window_strides=strides,
         padding=[(pads[0], pads[0]), (pads[1], pads[1])],
         rhs_dilation=dilations,
         feature_group_count=groups,
-        dimension_numbers=('NCHW', 'OIHW', 'NCHW'),
-        preferred_element_type=jnp.float32 if xc.dtype == jnp.bfloat16
-        else None)
+        dimension_numbers=('NCHW', 'OIHW', 'NCHW'))
     return {'Output': out.astype(in_dtype)}
 
 
