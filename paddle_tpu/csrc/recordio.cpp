@@ -87,7 +87,10 @@ Scanner* open_scanner(const char* path) {
 // returns payload length, sets *out to a pointer INTO the mapping (valid
 // until close); -1 on EOF, -2 on corruption
 ssize_t scanner_next(Scanner* s, const uint8_t** out) {
-  if (s->off + 8 > s->size) return -1;
+  if (s->off + 8 > s->size) {
+    // 1-7 trailing bytes = a header truncated mid-write: corruption, not EOF
+    return s->off == s->size ? -1 : -2;
+  }
   uint32_t len, crc;
   memcpy(&len, s->base + s->off, 4);
   memcpy(&crc, s->base + s->off + 4, 4);
@@ -171,6 +174,7 @@ void* ptrio_writer_open(const char* path) {
 
 int ptrio_writer_write(void* h, const char* data, uint64_t len) {
   FILE* f = static_cast<FILE*>(h);
+  if (len > UINT32_MAX) return -1;  // u32 length field; don't truncate
   uint32_t l = (uint32_t)len;
   uint32_t crc = crc32_ieee(reinterpret_cast<const uint8_t*>(data), len);
   if (fwrite(&l, 4, 1, f) != 1) return -1;

@@ -1,9 +1,42 @@
 """Program debugging / visualization.
 
 Parity: reference python/paddle/fluid/debugger.py (draw_block_graphviz) +
-graphviz.py. Emits a text dump and a .dot graph of the op DAG.
+graphviz.py + the C++ FLAGS_check_nan_inf runtime guard
+(paddle/fluid/framework/operator.cc CheckNanInf / operators/isfinite_op).
+Emits a text dump, a .dot graph of the op DAG, and a debug-mode executor
+switch that runs the step op-by-op checking every float output.
 """
-__all__ = ['pprint_program_codes', 'draw_block_graphviz']
+import contextlib
+
+__all__ = ['pprint_program_codes', 'draw_block_graphviz',
+           'enable_check_nan_inf', 'disable_check_nan_inf', 'check_nan_inf']
+
+_check_nan_inf = {'active': False}
+
+
+def enable_check_nan_inf():
+    """Run subsequent Executor.run calls op-by-op (un-jitted), raising
+    FloatingPointError naming the first op whose output is NaN/Inf."""
+    _check_nan_inf['active'] = True
+
+
+def disable_check_nan_inf():
+    _check_nan_inf['active'] = False
+
+
+def nan_inf_check_active():
+    return _check_nan_inf['active']
+
+
+@contextlib.contextmanager
+def check_nan_inf():
+    """Scoped debug mode: with debugger.check_nan_inf(): exe.run(...)"""
+    prev = _check_nan_inf['active']
+    _check_nan_inf['active'] = True
+    try:
+        yield
+    finally:
+        _check_nan_inf['active'] = prev
 
 
 def pprint_program_codes(program):

@@ -117,6 +117,7 @@ class _CompiledStep(object):
         self.program = program
         self.amp = amp
         self.platform = platform
+        self.use_remat = bool(getattr(program, '_use_remat', False))
         ops = list(block.ops)
         self.ops = ops
         self.fetch_names = list(fetch_names)
@@ -134,19 +135,7 @@ class _CompiledStep(object):
                         produced.add(v.name)
         self.persist_out = sorted(produced)
 
-        def run_range(env, lo, hi, key, grad_mode=False):
-            for i in range(lo, hi):
-                op = ops[i]
-                if op.type == 'autodiff':
-                    continue
-                lowering.run_op(op, env, Ctx(key, i, amp=self.amp,
-                                             platform=self.platform))
-                if grad_mode:
-                    for vs in op.outputs.values():
-                        for v in vs:
-                            if v.stop_gradient and v.name in env and env[v.name] is not None:
-                                env[v.name] = jax.tree_util.tree_map(
-                                    jax.lax.stop_gradient, env[v.name])
+        run_range = self._run_ops
 
         def step(persist, feed, key):
             env = dict(persist)
@@ -155,25 +144,15 @@ class _CompiledStep(object):
                 run_range(env, 0, len(ops), key)
             else:
                 ad = ops[self.ad_idx]
-                pnames = [n for n in ad.attrs['param_names'] if n in env]
-                gnames = dict(zip(ad.attrs['param_names'], ad.attrs['grad_names']))
-                trainable = {n: env[n] for n in pnames}
-                base = {k: v for k, v in env.items() if k not in trainable}
-
-                def fwd(tr):
-                    e = dict(base)
-                    e.update(tr)
-                    run_range(e, 0, self.ad_idx, key, grad_mode=True)
-                    loss = e[ad.attrs['loss_name']]
-                    return jnp.sum(loss.astype(jnp.float32)), e
-
+                pnames, gnames, trainable, base = self._grad_setup(env, ad)
+                fwd = self._make_fwd(base, ad, key)
+                if self.use_remat:
+                    # memory_optimize(): recompute forward activations in
+                    # the backward pass instead of saving them (the TPU
+                    # lever matching the reference's liveness buffer reuse).
+                    fwd = jax.checkpoint(fwd)
                 grads, env = jax.grad(fwd, has_aux=True)(trainable)
-                scale = ad.attrs.get('loss_scale', 1.0)
-                for n in pnames:
-                    g = grads[n]
-                    if scale != 1.0:
-                        g = g * scale
-                    env[gnames[n]] = g.astype(env[n].dtype)
+                self._apply_grads(grads, env, ad, pnames, gnames)
                 run_range(env, self.ad_idx + 1, len(ops), key)
             fetches = [env[n] for n in self.fetch_names]
             new_persist = {n: env[n] for n in self.persist_out if n in env}
@@ -182,8 +161,116 @@ class _CompiledStep(object):
         self._step = step  # pure, un-jitted (re-jittable with shardings)
         self._jitted = jax.jit(step, donate_argnums=(0,))
 
+    def _grad_setup(self, env, ad):
+        """Split env into trainable params vs everything else for jax.grad."""
+        pnames = [n for n in ad.attrs['param_names'] if n in env]
+        gnames = dict(zip(ad.attrs['param_names'], ad.attrs['grad_names']))
+        trainable = {n: env[n] for n in pnames}
+        base = {k: v for k, v in env.items() if k not in trainable}
+        return pnames, gnames, trainable, base
+
+    def _make_fwd(self, base, ad, key):
+        """The differentiable forward closure: trainable -> (loss, env)."""
+        def fwd(tr):
+            e = dict(base)
+            e.update(tr)
+            self._run_ops(e, 0, self.ad_idx, key, grad_mode=True)
+            loss = e[ad.attrs['loss_name']]
+            return jnp.sum(loss.astype(jnp.float32)), e
+        return fwd
+
+    def _apply_grads(self, grads, env, ad, pnames, gnames,
+                     check_nan_inf=False):
+        """Scale/cast gradients into env under their @GRAD names. Shared by
+        the jitted step and debug_step so both paths compute identically."""
+        scale = ad.attrs.get('loss_scale', 1.0)
+        for n in pnames:
+            g = grads[n]
+            if scale != 1.0:
+                g = g * scale
+            g = g.astype(env[n].dtype)
+            if check_nan_inf and not bool(jnp.isfinite(g).all()):
+                raise FloatingPointError(
+                    "NaN/Inf in gradient %r (of parameter %r)"
+                    % (gnames[n], n))
+            env[gnames[n]] = g
+
+    def _run_ops(self, env, lo, hi, key, grad_mode=False, on_op=None):
+        """Execute ops [lo, hi); on_op(i, op, seconds, env) — when set, each
+        op is synchronized and timed (debug/profiling path, eager only)."""
+        for i in range(lo, hi):
+            op = self.ops[i]
+            if op.type == 'autodiff':
+                continue
+            if on_op is None:
+                lowering.run_op(op, env, Ctx(key, i, amp=self.amp,
+                                             platform=self.platform))
+            else:
+                import time
+                t0 = time.perf_counter()
+                lowering.run_op(op, env, Ctx(key, i, amp=self.amp,
+                                             platform=self.platform))
+                outs = [env[v.name] for vs in op.outputs.values()
+                        for v in vs if env.get(v.name) is not None]
+                jax.block_until_ready(outs)
+                on_op(i, op, time.perf_counter() - t0, env)
+            if grad_mode:
+                for vs in op.outputs.values():
+                    for v in vs:
+                        if v.stop_gradient and v.name in env and env[v.name] is not None:
+                            env[v.name] = jax.tree_util.tree_map(
+                                jax.lax.stop_gradient, env[v.name])
+
+    def debug_step(self, persist, feed, key, check_nan_inf=False, on_op=None):
+        """Eager op-by-op execution: per-op NaN/Inf checks (reference C++
+        check_nan_inf, operators/isfinite_op) and per-op wall times for the
+        profiler table. Slower than the jitted step by design."""
+        hooks = []
+        if on_op is not None:
+            hooks.append(on_op)
+        if check_nan_inf:
+            hooks.append(_nan_inf_hook)
+
+        def hook(i, op, dt, env):
+            for h in hooks:
+                h(i, op, dt, env)
+
+        ops = self.ops
+        env = dict(persist)
+        env.update(feed)
+        if self.ad_idx is None:
+            self._run_ops(env, 0, len(ops), key, on_op=hook)
+        else:
+            ad = ops[self.ad_idx]
+            pnames, gnames, trainable, base = self._grad_setup(env, ad)
+            # eager, hooked forward pass (this is the per-op signal)
+            self._run_ops(env, 0, self.ad_idx, key, on_op=hook)
+            grads, _ = jax.grad(self._make_fwd(base, ad, key),
+                                has_aux=True)(trainable)
+            self._apply_grads(grads, env, ad, pnames, gnames,
+                              check_nan_inf=check_nan_inf)
+            self._run_ops(env, self.ad_idx + 1, len(ops), key, on_op=hook)
+        fetches = [env[n] for n in self.fetch_names]
+        new_persist = {n: env[n] for n in self.persist_out if n in env}
+        return fetches, new_persist
+
     def __call__(self, persist, feed, key):
         return self._jitted(persist, feed, key)
+
+
+def _nan_inf_hook(i, op, dt, env):
+    for slot, vs in op.outputs.items():
+        for v in vs:
+            val = env.get(v.name)
+            if val is None:
+                continue
+            for leaf in jax.tree_util.tree_leaves(val):
+                if (hasattr(leaf, 'dtype')
+                        and jnp.issubdtype(leaf.dtype, jnp.floating)
+                        and not bool(jnp.isfinite(leaf).all())):
+                    raise FloatingPointError(
+                        "NaN/Inf in output %r of op #%d %r" %
+                        (v.name, i, op.type))
 
 
 class Executor(object):
@@ -259,8 +346,8 @@ class Executor(object):
             and scope.vars[v.name] is not None and v.name not in feed_vals))
         from . import amp as amp_mod
         amp = amp_mod.is_amp(program)
-        key = (id(program), program._version, feed_sig, tuple(fetch_names),
-               persist_in, amp)
+        key = (program._uid, program._version, feed_sig, tuple(fetch_names),
+               persist_in, amp, bool(getattr(program, '_use_remat', False)))
         compiled = self._cache.get(key) if use_program_cache else None
         if compiled is None:
             # place is None under ParallelExecutor (mesh placement via
@@ -277,7 +364,15 @@ class Executor(object):
         rng = jax.random.key(np.uint32(
             ((program.random_seed or 0) * 2654435761 + self._run_counter)
             % (1 << 32)))
-        fetches, new_persist = compiled(persist, feed_vals, rng)
+        from . import debugger as _dbg
+        from . import profiler as _prof
+        check = _dbg.nan_inf_check_active()
+        op_hook = _prof.op_event_hook()
+        if check or op_hook is not None:
+            fetches, new_persist = compiled.debug_step(
+                persist, feed_vals, rng, check_nan_inf=check, on_op=op_hook)
+        else:
+            fetches, new_persist = compiled(persist, feed_vals, rng)
         scope.vars.update(new_persist)
 
         out = []

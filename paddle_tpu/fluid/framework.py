@@ -294,6 +294,12 @@ class Program(object):
         self.random_seed = 0
         self._version = 0
         self._seed_counter = 0
+        # id(program) can be recycled after GC, colliding in the Executor's
+        # jit cache; a monotonically unique uid cannot.
+        self._uid = Program._next_uid
+        Program._next_uid += 1
+
+    _next_uid = 0
 
     def _bump_version(self):
         self._version += 1

@@ -206,7 +206,8 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
     bipartite_match, target_assign x3, mine_hard_examples, ...); here ONE
     fused dense op does matching, smooth-L1 localization loss, softmax
     confidence loss and max-negative mining (ops_impl/detection_ops.py).
-    Returns the per-prior weighted loss [B, P, 1].
+    Returns the per-image loss [N, 1] (prior-summed, normalized by the
+    batch-global positive count) matching the reference's output shape.
     """
     if mining_type != 'max_negative':
         raise ValueError("only mining_type='max_negative' is supported "
@@ -227,7 +228,7 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
                'conf_loss_weight': conf_loss_weight,
                'match_type': match_type, 'normalize': normalize},
         infer_shape=False)
-    loss.shape = (location.shape[0], location.shape[1], 1)
+    loss.shape = (location.shape[0], 1)
     return loss
 
 

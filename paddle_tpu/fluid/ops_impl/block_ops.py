@@ -70,8 +70,15 @@ def _array_write(ins, attrs, ctx):
     # Writes past capacity clamp to the last slot (dynamic_update_index
     # semantics); length is clamped too so reads stay in range. Size the
     # array via create_array/array_write(capacity=) for longer loops.
+    cap = buf.shape[0]
+    lax.cond(i >= cap,
+             lambda: jax.debug.print(
+                 'WARNING: array_write index {i} >= capacity {c}; write '
+                 'clamped to the last slot — pass capacity= to '
+                 'create_array/array_write for longer loops', i=i, c=cap),
+             lambda: None)
     buf = lax.dynamic_update_index_in_dim(buf, x.astype(buf.dtype), i, axis=0)
-    length = jnp.minimum(jnp.maximum(length, i + 1), buf.shape[0])
+    length = jnp.minimum(jnp.maximum(length, i + 1), cap)
     return {'Out': ArrayValue(buf, length)}
 
 
@@ -149,6 +156,16 @@ def _while(op, env, ctx):
 
 @register_block_op('ifelse')
 def _ifelse(op, env, ctx):
+    """Both branches execute; outputs merge via predicated select.
+
+    Gradient hazard (standard JAX where-pitfall): if the UNTAKEN branch
+    computes NaN/Inf from inputs the condition was guarding (log/sqrt/div),
+    the 0*NaN in its cotangent poisons gradients of shared inputs even
+    though the forward value is discarded. Clamp the guarded input inside
+    the branch (the double-where trick, e.g. log(where(cond, x, 1.0)))
+    so the untaken side stays finite; the reference runs only the taken
+    branch and never hits this.
+    """
     prog = op.block.program
     t_idx, f_idx = op.attrs['sub_blocks']
     cond = data_of(env[op.inputs['Cond'][0].name])

@@ -126,7 +126,10 @@ def _bipartite_greedy(dist):
         flat = jnp.argmax(d)
         r, c = flat // M, flat % M
         val = d[r, c]
-        ok = val > _BIG_NEG / 2
+        # reference bipartite_match_op.cc only matches when dist > kEPS
+        # (1e-6): a gt box overlapping nothing must stay unmatched, not be
+        # assigned to prior 0 as a garbage positive.
+        ok = val > 1e-6
         col_match = jnp.where(ok, col_match.at[c].set(r.astype(jnp.int32)),
                               col_match)
         col_dist = jnp.where(ok, col_dist.at[c].set(val), col_dist)
@@ -323,7 +326,9 @@ def _ssd_loss(ins, attrs, ctx):
 
     Per image: per-prediction matching, smooth-L1 on matched localizations,
     softmax CE on class scores, max-negative hard mining at neg_pos_ratio.
-    Out: per-prior weighted loss [B, P] (normalized by positive count).
+    Out: per-image loss [B, 1] summed over priors and normalized by the
+    batch-global positive count (reference divides by
+    reduce_sum(target_loc_weight), i.e. total positives across the batch).
     """
     from ..lowering import SeqValue
     loc = data_of(ins['Loc'][0])          # [B, P, 4]
@@ -377,12 +382,14 @@ def _ssd_loss(ins, attrs, ctx):
         neg_sel = neg_cand & (rank < n_neg)
         conf_loss = ce * (pos | neg_sel)
         total = loc_w * loc_loss + conf_w * conf_loss
-        if normalize:
-            total = total / jnp.maximum(n_pos.astype(total.dtype), 1.0)
-        return total
+        return total, n_pos
 
-    loss = jax.vmap(one)(loc, conf, gt_box, gt_lbl, lengths)
-    return {'Loss': loss[..., None]}    # [B, P, 1], the declared shape
+    loss, n_pos = jax.vmap(one)(loc, conf, gt_box, gt_lbl, lengths)
+    loss_img = loss.sum(axis=1)                       # [B]
+    if normalize:
+        total_pos = n_pos.sum().astype(loss_img.dtype)
+        loss_img = loss_img / jnp.maximum(total_pos, 1.0)
+    return {'Loss': loss_img[:, None]}  # [B, 1], the declared shape
 
 
 @register('rpn_target_assign')

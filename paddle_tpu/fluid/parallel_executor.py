@@ -81,12 +81,15 @@ class ParallelExecutor(object):
 
     def _shard_batch(self, val):
         def put(x, spec_dims):
-            pad = 0
             n = x.shape[0]
             if n % self._ndev:
-                pad = self._ndev - n % self._ndev
-                rep = np.repeat(np.asarray(x[-1:]), pad, axis=0)
-                x = np.concatenate([np.asarray(x), rep], axis=0)
+                # Padding by duplicating rows would silently change the
+                # loss/gradients (duplicated examples get double weight).
+                raise ValueError(
+                    "ParallelExecutor feed batch size %d is not divisible "
+                    "by the %d mesh devices; drop the remainder (e.g. wrap "
+                    "the reader in paddle.batch(..., drop_last=True)) or "
+                    "pad+mask it yourself" % (n, self._ndev))
             sh = NamedSharding(self._mesh, P('dp', *([None] * (x.ndim - 1))))
             return jax.device_put(jnp_asarray(x), sh)
 

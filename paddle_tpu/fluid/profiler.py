@@ -1,8 +1,13 @@
 """Profiler. Parity: reference python/paddle/fluid/profiler.py.
 
-The reference wraps CUDA profiler + its own C++ event tracer; here the
-device timeline comes from jax.profiler (XLA trace viewable in TensorBoard/
-Perfetto) and the summary table from host wall-clock around Executor.run.
+The reference wraps the CUDA profiler + its own C++ event tracer and prints
+a sorted per-op event table (reference profiler.py:81-130). Here:
+  - the device timeline comes from jax.profiler (XLA trace viewable in
+    TensorBoard/Perfetto) — that is the "fast" profile of the fused step;
+  - the per-op table requires running ops one by one, so when op_detail is
+    on, Executor.run switches to the eager op-by-op path and records per-op
+    wall times (synchronized via block_until_ready), printed at
+    stop_profiler sorted by sorted_key, reference-style.
 """
 import contextlib
 import os
@@ -11,7 +16,8 @@ import time
 __all__ = ['cuda_profiler', 'reset_profiler', 'profiler', 'start_profiler',
            'stop_profiler']
 
-_state = {'active': False, 'trace_dir': None, 't0': None}
+_state = {'active': False, 'trace_dir': None, 't0': None,
+          'op_detail': False, 'events': None}
 
 
 @contextlib.contextmanager
@@ -21,7 +27,23 @@ def cuda_profiler(output_file, output_mode=None, config=None):
         yield
 
 
-def start_profiler(state='All', trace_dir=None):
+def op_event_hook():
+    """The executor's per-op timing callback, or None when off."""
+    if not (_state['active'] and _state['op_detail']):
+        return None
+    events = _state['events']
+
+    def hook(i, op, dt, env):
+        ev = events.setdefault(op.type, [0, 0.0, 0.0, float('inf')])
+        ev[0] += 1
+        ev[1] += dt
+        ev[2] = max(ev[2], dt)
+        ev[3] = min(ev[3], dt)
+
+    return hook
+
+
+def start_profiler(state='All', trace_dir=None, op_detail=False):
     if _state['active']:
         return
     import jax
@@ -33,7 +55,28 @@ def start_profiler(state='All', trace_dir=None):
     except Exception:
         _state['trace_dir'] = None
     _state['active'] = True
+    _state['op_detail'] = bool(op_detail)
+    _state['events'] = {}
     _state['t0'] = time.time()
+
+
+def _event_table(events, sorted_key):
+    keyfn = {'calls': lambda kv: kv[1][0],
+             'total': lambda kv: kv[1][1],
+             'max': lambda kv: kv[1][2],
+             'min': lambda kv: kv[1][3],
+             'ave': lambda kv: kv[1][1] / kv[1][0]}.get(
+                 sorted_key, lambda kv: kv[1][1])
+    rows = sorted(events.items(), key=keyfn, reverse=True)
+    total_all = sum(ev[1] for _, ev in rows) or 1.0
+    lines = ["%-28s %8s %12s %12s %12s %12s %8s" %
+             ('Event', 'Calls', 'Total(ms)', 'Min(ms)', 'Max(ms)',
+              'Ave(ms)', 'Ratio')]
+    for name, (calls, tot, mx, mn) in rows:
+        lines.append("%-28s %8d %12.4f %12.4f %12.4f %12.4f %7.2f%%" %
+                     (name, calls, tot * 1e3, mn * 1e3, mx * 1e3,
+                      tot / calls * 1e3, 100.0 * tot / total_all))
+    return "\n".join(lines)
 
 
 def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
@@ -49,6 +92,10 @@ def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
     report = ("------------- paddle_tpu profiler -------------\n"
               "wall time: %.3fs\nXLA trace: %s\n" %
               (wall, _state['trace_dir'] or '(trace unavailable)'))
+    if _state['events']:
+        report += ("\n-------------  op event summary  -------------\n"
+                   + _event_table(_state['events'], sorted_key or 'total')
+                   + "\n")
     try:
         with open(profile_path, 'w') as f:
             f.write(report)
@@ -56,14 +103,24 @@ def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
         pass
     print(report)
     _state['active'] = False
+    _state['op_detail'] = False
+    _state['events'] = None
 
 
 def reset_profiler():
     _state['t0'] = time.time()
+    if _state['events'] is not None:
+        _state['events'] = {}
 
 
 @contextlib.contextmanager
-def profiler(state='All', sorted_key='default', profile_path='/tmp/profile'):
-    start_profiler(state)
+def profiler(state='All', sorted_key='default', profile_path='/tmp/profile',
+             op_detail=False):
+    """Reference fluid.profiler.profiler context manager. The default
+    profiles the production fused-jitted step (XLA trace). op_detail=True
+    additionally collects the reference-style per-op table — that switches
+    Executor.run to eager op-by-op dispatch, which is much slower and is a
+    different program than the fused step."""
+    start_profiler(state, op_detail=op_detail)
     yield
     stop_profiler(sorted_key, profile_path)

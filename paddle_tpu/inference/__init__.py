@@ -82,8 +82,8 @@ def export_compiled(dirname, feed_example, target_vars, executor,
     # run once through the executor to build+cache the pure step fn
     executor.run(infer, feed=dict(feed_example), fetch_list=fetch_names)
     compiled = None
-    for (pid, _, _, fetches, _, _), c in executor._cache.items():
-        if pid == id(infer) and tuple(fetches) == tuple(fetch_names):
+    for (pid, _, _, fetches, _, _, _), c in executor._cache.items():
+        if pid == infer._uid and tuple(fetches) == tuple(fetch_names):
             compiled = c
     assert compiled is not None
     scope = global_scope()

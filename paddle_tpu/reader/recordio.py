@@ -49,10 +49,15 @@ class RecordIOReader(object):
                 raise ValueError("%s is not a paddle_tpu recordio file" % self.path)
             while True:
                 hdr = f.read(8)
-                if len(hdr) < 8:
+                if not hdr:
                     break
+                if len(hdr) < 8:
+                    raise IOError("truncated record header in %s (file cut "
+                                  "mid-write?)" % self.path)
                 ln, crc = struct.unpack('<II', hdr)
                 payload = f.read(ln)
+                if len(payload) < ln:
+                    raise IOError("truncated record payload in %s" % self.path)
                 if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
                     raise IOError("checksum mismatch in %s" % self.path)
                 yield payload

@@ -96,7 +96,7 @@ def recordio_iter(path):
             buf = ctypes.c_char_p()
             n = lib.ptrio_next(h, ctypes.byref(buf))
             if n == -2:
-                raise IOError("checksum mismatch in %s" % path)
+                raise IOError("corrupt record file (checksum mismatch or truncation) in %s" % path)
             if n < 0:
                 break
             yield ctypes.string_at(buf, n)
@@ -117,7 +117,7 @@ def recordio_prefetch_iter(path, depth=4):
             buf = ctypes.c_char_p()
             n = lib.ptrio_prefetch_next(h, ctypes.byref(buf))
             if n == -2:
-                raise IOError("checksum mismatch in %s" % path)
+                raise IOError("corrupt record file (checksum mismatch or truncation) in %s" % path)
             if n < 0:
                 break
             yield ctypes.string_at(buf, n)

@@ -1,0 +1,119 @@
+"""NaN/Inf debug mode + per-op profiler table.
+
+Parity: reference FLAGS_check_nan_inf (framework/operator.cc) and the
+profiler's sorted per-op event table (python/paddle/fluid/profiler.py:81).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import debugger, profiler
+
+from util import fresh_program
+
+
+def _mlp(x_name='x'):
+    x = fluid.layers.data(name=x_name, shape=[4], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+    h = fluid.layers.fc(input=x, size=8, act='relu')
+    pred = fluid.layers.fc(input=h, size=1)
+    cost = fluid.layers.mean(
+        fluid.layers.square_error_cost(input=pred, label=y))
+    return cost
+
+
+def test_nan_inf_check_names_offending_op():
+    with fresh_program() as (main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        lg = fluid.layers.log(x)          # log of a negative input -> NaN
+        out = fluid.layers.mean(lg)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        bad = -np.ones((2, 4), 'float32')
+        with debugger.check_nan_inf():
+            with pytest.raises(FloatingPointError) as ei:
+                exe.run(main, feed={'x': bad}, fetch_list=[out])
+        assert 'log' in str(ei.value)
+        assert lg.name in str(ei.value)
+        # same feed passes with the check off (NaN flows through silently)
+        res = exe.run(main, feed={'x': bad}, fetch_list=[out])
+        assert np.isnan(res[0]).all()
+
+
+def test_nan_inf_check_clean_run_matches_jitted():
+    with fresh_program() as (main, startup):
+        cost = _mlp()
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(cost)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        feed = {'x': np.random.RandomState(0).rand(4, 4).astype('float32'),
+                'y': np.random.RandomState(1).rand(4, 1).astype('float32')}
+        with debugger.check_nan_inf():
+            a = float(exe.run(main, feed=feed, fetch_list=[cost])[0])
+    with fresh_program() as (main, startup):
+        cost = _mlp()
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(cost)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        feed = {'x': np.random.RandomState(0).rand(4, 4).astype('float32'),
+                'y': np.random.RandomState(1).rand(4, 1).astype('float32')}
+        b = float(exe.run(main, feed=feed, fetch_list=[cost])[0])
+    np.testing.assert_allclose(a, b, rtol=1e-5)
+
+
+def test_nan_inf_check_catches_bad_gradient():
+    with fresh_program() as (main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        w = fluid.layers.create_parameter(shape=[4, 1], dtype='float32')
+        # sqrt'(0) = inf: forward is finite (sqrt(0)=0) but the gradient
+        # of the parameter blows up
+        z = fluid.layers.sqrt(fluid.layers.abs(fluid.layers.matmul(x, w)))
+        cost = fluid.layers.mean(z)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(cost)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        from paddle_tpu.fluid.executor import global_scope
+        import jax.numpy as jnp
+        global_scope().vars[w.name] = jnp.zeros((4, 1), jnp.float32)
+        with debugger.check_nan_inf():
+            with pytest.raises(FloatingPointError) as ei:
+                exe.run(main, feed={'x': np.ones((2, 4), 'float32')},
+                        fetch_list=[cost])
+        assert 'gradient' in str(ei.value)
+
+
+def test_profiler_op_table(capsys, tmp_path):
+    with fresh_program() as (main, startup):
+        cost = _mlp()
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(cost)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        feed = {'x': np.zeros((4, 4), 'float32'),
+                'y': np.zeros((4, 1), 'float32')}
+        path = str(tmp_path / 'profile')
+        with profiler.profiler('All', 'total', path, op_detail=True):
+            for _ in range(3):
+                exe.run(main, feed=feed, fetch_list=[cost])
+    out = capsys.readouterr().out
+    assert 'op event summary' in out
+    assert 'mul' in out or 'matmul' in out
+    assert 'Calls' in out and 'Ave(ms)' in out
+    report = open(path).read()
+    assert 'op event summary' in report
+    # table rows carry real counts: 3 runs -> every op type seen 3x
+    for line in report.splitlines():
+        if line.startswith('mean '):
+            assert int(line.split()[1]) % 3 == 0
+
+
+def test_profiler_without_op_detail_keeps_jitted_path(capsys):
+    with fresh_program() as (main, startup):
+        cost = _mlp()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        feed = {'x': np.zeros((2, 4), 'float32'),
+                'y': np.zeros((2, 1), 'float32')}
+        with profiler.profiler('All', op_detail=False):
+            exe.run(main, feed=feed, fetch_list=[cost])
+    out = capsys.readouterr().out
+    assert 'op event summary' not in out

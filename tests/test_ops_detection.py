@@ -112,7 +112,7 @@ def test_ssd_loss_decreases():
                              dtype='int64')
         pb = layers.assign(priors)
         loss = detection.ssd_loss(loc, conf, gt_box, gt_lbl, pb)
-        avg = layers.reduce_mean(layers.reduce_sum(loss, dim=1))
+        avg = layers.reduce_mean(loss)
         fluid.optimizer.Adam(learning_rate=0.05).minimize(avg)
         exe = fluid.Executor(fluid.CPUPlace())
         exe.run(startup)

@@ -49,6 +49,27 @@ def test_parallel_executor_matches_single_device():
     np.testing.assert_allclose(single, par, rtol=2e-4)
 
 
+def test_parallel_executor_rejects_non_divisible_batch():
+    """A batch not divisible by the mesh must raise, not silently pad
+    (duplicated rows would double-weight examples in the loss)."""
+    import pytest
+    with fresh_program() as (main, startup):
+        x = fluid.layers.data(name='x', shape=[13], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        pred = fluid.layers.fc(input=x, size=1)
+        cost = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(cost)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        pe = fluid.ParallelExecutor(use_cuda=False, loss_name=cost.name,
+                                    main_program=main)
+        xs = np.zeros((13, 13), 'float32')  # 13 % 8 != 0
+        ys = np.zeros((13, 1), 'float32')
+        with pytest.raises(ValueError, match='not divisible'):
+            pe.run([cost.name], feed={'x': xs, 'y': ys})
+
+
 def test_dryrun_multichip():
     import importlib.util
     spec = importlib.util.spec_from_file_location(

@@ -87,6 +87,20 @@ def test_corruption_detected(tmp_path):
             list(recordio.read_samples(p))
 
 
+def test_truncated_header_detected(tmp_path):
+    """A file cut mid-header (1-7 trailing bytes) is corruption, not EOF."""
+    p = str(tmp_path / 'f.ptrio')
+    recordio.write_samples(p, iter(_samples(n=5, seed=4)))
+    data = open(p, 'rb').read()
+    open(p, 'wb').write(data + b'\x07\x00\x00')  # 3 stray header bytes
+    with pytest.raises(IOError):
+        list(recordio.RecordIOReader(p))
+    if not native.available():
+        pytest.skip("native library unavailable")
+    with pytest.raises(IOError):
+        list(native.recordio_iter(p))
+
+
 def test_prefetch_pipeline_wrapper():
     from paddle_tpu.reader.pipeline import prefetch
 
