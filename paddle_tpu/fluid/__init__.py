@@ -35,10 +35,16 @@ from .parallel_executor import ParallelExecutor, ExecutionStrategy, BuildStrateg
 from . import transpiler
 from .transpiler import DistributeTranspiler, InferenceTranspiler, \
     memory_optimize, release_memory
+from . import trainer
+from .trainer import Trainer, BeginEpochEvent, EndEpochEvent, \
+    BeginStepEvent, EndStepEvent, CheckpointConfig
+from . import inferencer
+from .inferencer import Inferencer
 
 Tensor = LoDTensor
 
-__all__ = framework.__all__ + executor.__all__ + transpiler.__all__ + [
+__all__ = framework.__all__ + executor.__all__ + transpiler.__all__ + \
+    trainer.__all__ + inferencer.__all__ + [
     'io', 'initializer', 'layers', 'transpiler', 'nets', 'optimizer',
     'learning_rate_decay', 'backward', 'regularizer', 'LoDTensor',
     'CPUPlace', 'TPUPlace', 'CUDAPlace', 'CUDAPinnedPlace', 'Tensor',

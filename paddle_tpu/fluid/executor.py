@@ -113,11 +113,15 @@ class _CompiledStep(object):
     """One lowered+jitted (program, feed-sig, fetch) combination."""
 
     def __init__(self, program, block, feed_names, fetch_names, persist_in,
-                 amp=False, platform='cpu'):
+                 amp=False, platform='cpu', persist_shardings=None):
         self.program = program
         self.amp = amp
         self.platform = platform
         self.use_remat = bool(getattr(program, '_use_remat', False))
+        # name -> NamedSharding: enforced on the step's outputs so
+        # mesh-placed state (ZeRO accumulators, tp weights) STAYS sharded
+        # inside the compiled module instead of relying on propagation
+        self.persist_shardings = dict(persist_shardings or {})
         ops = list(block.ops)
         self.ops = ops
         self.fetch_names = list(fetch_names)
@@ -156,6 +160,10 @@ class _CompiledStep(object):
                 run_range(env, self.ad_idx + 1, len(ops), key)
             fetches = [env[n] for n in self.fetch_names]
             new_persist = {n: env[n] for n in self.persist_out if n in env}
+            for n, sh in self.persist_shardings.items():
+                if n in new_persist and not isinstance(new_persist[n], SeqValue):
+                    new_persist[n] = jax.lax.with_sharding_constraint(
+                        new_persist[n], sh)
             return fetches, new_persist
 
         self._step = step  # pure, un-jitted (re-jittable with shardings)
@@ -305,6 +313,56 @@ class Executor(object):
         arr = np.asarray(val)
         return jax.device_put(arr, self._device())
 
+    def _ensure_dist_placement(self, program, scope):
+        """Consume DistributeTranspiler's `_dist_config` annotation: build
+        the dp mesh (capped at the locally visible devices; multi-host
+        grows it via parallel.init_multihost), replicate parameters, and
+        ZeRO-shard optimizer accumulators over dp (the reference's
+        slice_var_up pserver memory scaling). Returns the mesh or None."""
+        dist = getattr(program, '_dist_config', None)
+        if dist is None:
+            return None
+        mesh = getattr(program, '_dist_mesh', None)
+        if mesh is not None:
+            return mesh or None  # False sentinel -> single device, no-op
+        from .. import parallel
+        dp = min(int(dist.get('dp_size') or 1), len(jax.devices()))
+        if dp <= 1:
+            program._dist_mesh = False
+            return None
+        mesh = parallel.make_mesh({'dp': dp})
+        program._dist_mesh = mesh
+        acc_names = {v.name for v in program.list_vars()
+                     if getattr(v, '_is_optimizer_accumulator', False)}
+        persistable = {v.name for v in program.list_vars() if v.persistable}
+        zero = dist.get('shard_optimizer_states', False)
+        for name in persistable:
+            v = scope.vars.get(name)
+            if v is None or isinstance(v, SeqValue):
+                continue
+            if zero and name in acc_names:
+                scope.vars.update(parallel.shard_optimizer_states(
+                    {name: v}, mesh))
+            else:
+                scope.vars[name] = parallel.replicate(mesh, v)
+        return mesh
+
+    def _dist_shard_feed(self, name, dv, mesh):
+        from .. import parallel
+        if isinstance(dv, SeqValue):
+            return SeqValue(self._dist_shard_feed(name, dv.data, mesh),
+                            self._dist_shard_feed(name, dv.lengths, mesh),
+                            dv.outer_lengths)
+        dp = mesh.shape['dp']
+        if dv.ndim == 0:
+            return parallel.replicate(mesh, dv)
+        if dv.shape[0] % dp:
+            raise ValueError(
+                "distributed feed %r batch size %d is not divisible by the "
+                "dp mesh size %d; drop the remainder (e.g. "
+                "paddle.batch(..., drop_last=True))" % (name, dv.shape[0], dp))
+        return jax.device_put(dv, parallel.data_sharding(mesh, 'dp', dv.ndim))
+
     def run(self,
             program=None,
             feed=None,
@@ -323,6 +381,8 @@ class Executor(object):
         if scope is None:
             scope = global_scope()
 
+        dist_mesh = self._ensure_dist_placement(program, scope)
+
         feed_vals = {}
         block = program.global_block()
         for name, val in feed.items():
@@ -336,6 +396,8 @@ class Executor(object):
                 want = np.dtype(var.dtype) if var.dtype != 'bfloat16' else jnp.bfloat16
                 if dv.dtype != want:
                     dv = dv.astype(want)
+            if dist_mesh is not None:
+                dv = self._dist_shard_feed(name, dv, dist_mesh)
             feed_vals[name] = dv
 
         fetch_names = [_as_fetch_name(f) for f in fetch_list]
@@ -346,8 +408,18 @@ class Executor(object):
             and scope.vars[v.name] is not None and v.name not in feed_vals))
         from . import amp as amp_mod
         amp = amp_mod.is_amp(program)
+        from jax.sharding import NamedSharding
+        persist_shardings = {}
+        for n in persist_in:
+            v = scope.vars[n]
+            if isinstance(v, jax.Array) and isinstance(v.sharding,
+                                                       NamedSharding):
+                persist_shardings[n] = v.sharding
+        shard_sig = tuple(sorted((n, str(s.spec), s.mesh)
+                                 for n, s in persist_shardings.items()))
         key = (program._uid, program._version, feed_sig, tuple(fetch_names),
-               persist_in, amp, bool(getattr(program, '_use_remat', False)))
+               persist_in, amp, bool(getattr(program, '_use_remat', False)),
+               shard_sig)
         compiled = self._cache.get(key) if use_program_cache else None
         if compiled is None:
             # place is None under ParallelExecutor (mesh placement via
@@ -355,7 +427,8 @@ class Executor(object):
             plat = (self._device().platform if self.place is not None
                     else jax.devices()[0].platform)
             compiled = _CompiledStep(program, block, list(feed_vals), fetch_names,
-                                     persist_in, amp=amp, platform=plat)
+                                     persist_in, amp=amp, platform=plat,
+                                     persist_shardings=persist_shardings)
             if use_program_cache:
                 self._cache[key] = compiled
 

@@ -1,0 +1,62 @@
+"""High-level Inferencer API. Parity: reference python/paddle/fluid/
+inferencer.py:31 — builds the inference program from infer_func, loads
+params saved by Trainer.save_params, and runs feeds through the Executor
+(one jitted XLA module per feed signature)."""
+import contextlib
+
+from . import framework
+from . import io
+from . import parallel_executor
+from . import unique_name
+from .executor import Executor, Scope, scope_guard
+from .trainer import check_and_get_place
+
+__all__ = ['Inferencer']
+
+
+class Inferencer(object):
+    """reference inferencer.py:31."""
+
+    def __init__(self, infer_func, param_path, place=None, parallel=False):
+        self.param_path = param_path
+        self.scope = Scope()
+        self.parallel = parallel
+        self.place = check_and_get_place(place)
+
+        self.inference_program = framework.Program()
+        with framework.program_guard(self.inference_program):
+            with unique_name.guard():
+                self.predict_var = infer_func()
+
+        with self._prog_and_scope_guard():
+            io.load_params(Executor(self.place), param_path,
+                           main_program=self.inference_program)
+
+        self.inference_program = self.inference_program.clone(for_test=True)
+
+        if parallel:
+            with self._prog_and_scope_guard():
+                self.exe = parallel_executor.ParallelExecutor(
+                    use_cuda=False, loss_name=self.predict_var.name,
+                    main_program=self.inference_program, scope=self.scope)
+        else:
+            self.exe = Executor(self.place)
+
+    def infer(self, inputs, return_numpy=True):
+        """reference inferencer.py:79."""
+        if not isinstance(inputs, dict):
+            raise ValueError(
+                "inputs should be a map of {'input_name': input_var}")
+        with scope_guard(self.scope):
+            if self.parallel:
+                return self.exe.run([self.predict_var.name], feed=inputs,
+                                    return_numpy=return_numpy)
+            return self.exe.run(self.inference_program, feed=inputs,
+                                fetch_list=[self.predict_var],
+                                return_numpy=return_numpy)
+
+    @contextlib.contextmanager
+    def _prog_and_scope_guard(self):
+        with framework.program_guard(main_program=self.inference_program):
+            with scope_guard(self.scope):
+                yield

@@ -19,7 +19,7 @@ __all__ = [
     'save_vars', 'save_params', 'save_persistables', 'load_vars',
     'load_params', 'load_persistables', 'save_inference_model',
     'load_inference_model', 'get_inference_program',
-    'save_checkpoint', 'load_checkpoint',
+    'save_checkpoint', 'load_checkpoint', 'list_checkpoint_serials',
 ]
 
 _PARAMS_FILE = '__params__.npz'
@@ -151,34 +151,48 @@ def load_inference_model(dirname, executor, model_filename=None,
 
 
 def save_checkpoint(executor, checkpoint_dir, trainer_id=0, main_program=None,
-                    step=0, max_num_checkpoints=3):
-    """Failure-recovery checkpoint: persistables + step counter (reference
-    io.py checkpoint utilities / trainer.py)."""
+                    step=0, max_num_checkpoints=3, trainer_args=None):
+    """Failure-recovery checkpoint: persistables + step counter + optional
+    trainer args like {'epoch_id', 'step_id'} (reference io.py checkpoint
+    utilities / trainer.py:641 save_checkpoint)."""
     serial_dir = os.path.join(checkpoint_dir, 'checkpoint_%d' % step)
     save_persistables(executor, serial_dir, main_program)
-    with open(os.path.join(serial_dir, 'meta.json'), 'w') as f:
-        json.dump({'step': step, 'trainer_id': trainer_id}, f)
+    # meta written atomically and LAST: its presence marks a complete
+    # snapshot (reference writes a _SUCCESS marker, trainer.py:1190)
+    tmp = os.path.join(serial_dir, 'meta.json.tmp')
+    with open(tmp, 'w') as f:
+        json.dump({'step': step, 'trainer_id': trainer_id,
+                   'trainer_args': trainer_args or {}}, f)
+    os.replace(tmp, os.path.join(serial_dir, 'meta.json'))
     # prune old checkpoints
-    kept = sorted(
-        (d for d in os.listdir(checkpoint_dir) if d.startswith('checkpoint_')),
-        key=lambda d: int(d.split('_')[1]))
-    for d in kept[:-max_num_checkpoints]:
+    for s in list_checkpoint_serials(checkpoint_dir)[:-max_num_checkpoints]:
         import shutil
-        shutil.rmtree(os.path.join(checkpoint_dir, d), ignore_errors=True)
+        shutil.rmtree(os.path.join(checkpoint_dir, 'checkpoint_%d' % s),
+                      ignore_errors=True)
     return serial_dir
+
+
+def list_checkpoint_serials(checkpoint_dir):
+    """Sorted serial numbers of checkpoint_<n> subdirs (may be torn)."""
+    import re
+    if not os.path.isdir(checkpoint_dir):
+        return []
+    out = []
+    for d in os.listdir(checkpoint_dir):
+        m = re.fullmatch(r'checkpoint_(\d+)', d)
+        if m:
+            out.append(int(m.group(1)))
+    return sorted(out)
 
 
 def load_checkpoint(executor, checkpoint_dir, serial=None, main_program=None):
     if serial is None:
-        cands = sorted(
-            (d for d in os.listdir(checkpoint_dir)
-             if d.startswith('checkpoint_')),
-            key=lambda d: int(d.split('_')[1]))
+        cands = list_checkpoint_serials(checkpoint_dir)
         if not cands:
             raise RuntimeError("no checkpoints in %s" % checkpoint_dir)
-        serial_dir = os.path.join(checkpoint_dir, cands[-1])
-    else:
-        serial_dir = os.path.join(checkpoint_dir, 'checkpoint_%d' % serial)
-    load_persistables(executor, serial_dir, main_program)
+        serial = cands[-1]
+    serial_dir = os.path.join(checkpoint_dir, 'checkpoint_%d' % serial)
     with open(os.path.join(serial_dir, 'meta.json')) as f:
-        return json.load(f)
+        meta = json.load(f)
+    load_persistables(executor, serial_dir, main_program)
+    return meta

@@ -1,0 +1,303 @@
+"""High-level Trainer API.
+
+Parity: reference python/paddle/fluid/trainer.py (Trainer:169,
+CheckpointConfig:100, the Begin/End Epoch/Step events, build_feed_var_list:608)
+— the train_func/optimizer_func loop used by every book chapter.
+
+TPU-first notes: the reference's distribute-transpile-from-env branch
+(pserver/NCCL2) is replaced by the mesh path — parallel=True runs the same
+program GSPMD-sharded through ParallelExecutor (XLA inserts the ICI
+collectives); multi-host setup goes through paddle_tpu.parallel.init_multihost.
+Checkpoint/resume keeps the reference's crash-recovery semantics: periodic
+persistable snapshots + (epoch, step) trainer args, auto-resumed when a
+Trainer is constructed over a checkpoint dir, cleaned on successful finish.
+"""
+import contextlib
+import os
+import re
+
+from . import core
+from . import framework
+from . import io
+from . import optimizer as opt_module
+from . import parallel_executor
+from . import unique_name
+from .data_feeder import DataFeeder
+from .executor import Executor, Scope, scope_guard
+
+__all__ = [
+    'Trainer', 'BeginEpochEvent', 'EndEpochEvent', 'BeginStepEvent',
+    'EndStepEvent', 'CheckpointConfig',
+]
+
+
+class BeginEpochEvent(object):
+    """reference trainer.py:40."""
+
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent(object):
+    """reference trainer.py:52."""
+
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent(object):
+    """reference trainer.py:64. Set self.fetch_metrics=False in the handler
+    to skip fetching the train_func outputs this step."""
+
+    def __init__(self, epoch_id, step_id):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.fetch_metrics = True
+
+
+class EndStepEvent(object):
+    """reference trainer.py:83."""
+
+    def __init__(self, epoch_id, step_id, metrics):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+class CheckpointConfig(object):
+    """reference trainer.py:100."""
+
+    def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
+                 epoch_interval=1, step_interval=10):
+        assert epoch_interval >= 1
+        assert step_interval >= 1
+        self.checkpoint_dir = (checkpoint_dir if checkpoint_dir is not None
+                               else os.getcwd())
+        self.max_num_checkpoints = max_num_checkpoints
+        self.epoch_interval = epoch_interval
+        self.step_interval = step_interval
+        self.epoch_id = 0
+        self.step_id = 0
+        self.load_serial = None
+
+
+def check_and_get_place(place):
+    """reference trainer.py:143 — default to the TPU when present."""
+    if place is None:
+        return (core.TPUPlace(0) if core.is_compiled_with_tpu()
+                else core.CPUPlace())
+    return place
+
+
+def build_feed_var_list(program, feed_order=None):
+    """reference trainer.py:608; feed_order None follows the program's
+    data-var definition order."""
+    if not isinstance(program, framework.Program):
+        raise TypeError("The 'program' should be an object of Program")
+    block = program.global_block()
+    if feed_order is None:
+        return [v for v in block.vars.values()
+                if getattr(v, 'is_data', False)]
+    if isinstance(feed_order, list):
+        return [block.var(name) for name in feed_order]
+    if not isinstance(feed_order, dict):
+        raise TypeError("The 'feed_order' should be either None, list or dict.")
+    if sorted(feed_order.values()) != list(range(len(feed_order))):
+        raise ValueError("The values of 'feed_order' should be a permutation "
+                         "of [0, len(feed_order))")
+    return [block.var(name)
+            for name, _ in sorted(feed_order.items(), key=lambda kv: kv[1])]
+
+
+class Trainer(object):
+    """reference trainer.py:169."""
+
+    def __init__(self, train_func, optimizer_func, param_path=None,
+                 place=None, parallel=False, checkpoint_config=None):
+        self.__stop = False
+        self.parallel = parallel
+        self.trainer_id = 0
+        self.checkpoint_cfg = checkpoint_config
+        if self.checkpoint_cfg:
+            assert isinstance(self.checkpoint_cfg, CheckpointConfig)
+
+        self.scope = Scope()
+        self.startup_program = framework.Program()
+        self.train_program = framework.Program()
+
+        with self._prog_and_scope_guard():
+            with unique_name.guard():
+                outs = train_func()
+                self.train_func_outputs = (outs if isinstance(outs, list)
+                                           else [outs])
+                self.test_program = self.train_program.clone(for_test=True)
+                loss = self.train_func_outputs[0]
+                optimizer = optimizer_func()
+                if not isinstance(optimizer, opt_module.Optimizer):
+                    raise TypeError(
+                        "The optimizer should be an instance of Optimizer")
+                optimizer.minimize(loss)
+
+        self.place = check_and_get_place(place)
+        self.exe = Executor(self.place)
+        with self._prog_and_scope_guard():
+            self.exe.run(self.startup_program)
+
+        self._serial = 0
+        if self.checkpoint_cfg:
+            self._maybe_resume_from_checkpoint()
+
+        if param_path and os.path.isdir(param_path):
+            with self._prog_and_scope_guard():
+                io.load_params(self.exe, param_path,
+                               main_program=self.train_program)
+
+    # -- checkpoint/resume ------------------------------------------------
+
+    def _maybe_resume_from_checkpoint(self):
+        cfg = self.checkpoint_cfg
+        if not os.path.isdir(cfg.checkpoint_dir):
+            return
+        # Newest first; a serial with a torn meta.json / missing shard
+        # (crash mid-save) falls back to the previous intact one.
+        for serial in io.list_checkpoint_serials(cfg.checkpoint_dir)[::-1]:
+            try:
+                with self._prog_and_scope_guard():
+                    meta = io.load_checkpoint(self.exe, cfg.checkpoint_dir,
+                                              serial=serial,
+                                              main_program=self.train_program)
+            except (RuntimeError, OSError, ValueError, KeyError):
+                continue
+            args = meta.get('trainer_args') or {}
+            cfg.load_serial = meta.get('step', 0)
+            cfg.epoch_id = int(args.get('epoch_id', 0))
+            cfg.step_id = int(args.get('step_id', 0))
+            self._serial = int(meta.get('step', 0))
+            return
+
+    def _save_checkpoint(self, epoch_id, step_id):
+        cfg = self.checkpoint_cfg
+        if epoch_id % cfg.epoch_interval == 0 \
+                and step_id % cfg.step_interval == 0:
+            self._serial += 1
+            with self._prog_and_scope_guard():
+                io.save_checkpoint(
+                    self.exe, cfg.checkpoint_dir,
+                    trainer_id=self.trainer_id,
+                    main_program=self.train_program,
+                    step=self._serial,
+                    trainer_args={'epoch_id': epoch_id, 'step_id': step_id},
+                    max_num_checkpoints=cfg.max_num_checkpoints)
+
+    def _clean_checkpoint(self):
+        # Remove only the checkpoint_<n> serial subdirs we created — the
+        # configured dir may be (and defaults to) the user's cwd.
+        import shutil
+        d = self.checkpoint_cfg.checkpoint_dir
+        if not os.path.isdir(d):
+            return
+        for sub in os.listdir(d):
+            if re.fullmatch(r'checkpoint_\d+', sub):
+                shutil.rmtree(os.path.join(d, sub), ignore_errors=True)
+
+    # -- public API -------------------------------------------------------
+
+    def stop(self):
+        """reference trainer.py:373 — stop training at the next step."""
+        self.__stop = True
+
+    def train(self, num_epochs, event_handler, reader=None, feed_order=None):
+        """reference trainer.py:379."""
+        if self.parallel:
+            with self._prog_and_scope_guard():
+                pe = self._get_or_create_parallel_executor()
+            self._train_loop(pe, num_epochs, event_handler, reader, feed_order)
+        else:
+            self._train_loop(self.exe, num_epochs, event_handler, reader,
+                             feed_order)
+
+    def test(self, reader, feed_order=None):
+        """reference trainer.py:409 — mean of train_func outputs over the
+        test reader, on the for_test clone."""
+        with scope_guard(self.scope):
+            feed_vars = build_feed_var_list(self.test_program, feed_order)
+            feeder = DataFeeder(feed_list=feed_vars, place=self.place)
+            fetch = [v.name for v in self.train_func_outputs]
+            import numpy as np
+            accumulated = [0.0] * len(fetch)
+            count = 0
+            for data in reader():
+                outs = self.exe.run(program=self.test_program,
+                                    feed=feeder.feed(data), fetch_list=fetch)
+                accumulated = [a + float(np.asarray(o).reshape(-1)[0])
+                               for a, o in zip(accumulated, outs)]
+                count += 1
+            return [a / max(count, 1) for a in accumulated]
+
+    def save_params(self, param_path):
+        """reference trainer.py:421."""
+        with self._prog_and_scope_guard():
+            io.save_params(self.exe, dirname=param_path,
+                           main_program=self.train_program)
+
+    def save_inference_model(self, param_path, feeded_var_names,
+                             target_var_indexes):
+        """Persist the pruned inference graph + params (reference
+        trainer.py save_inference_model variant)."""
+        with self._prog_and_scope_guard():
+            io.save_inference_model(
+                param_path, feeded_var_names,
+                [self.train_func_outputs[i] for i in target_var_indexes],
+                self.exe, main_program=self.train_program)
+
+    # -- internals --------------------------------------------------------
+
+    @contextlib.contextmanager
+    def _prog_and_scope_guard(self):
+        with framework.program_guard(main_program=self.train_program,
+                                     startup_program=self.startup_program):
+            with scope_guard(self.scope):
+                yield
+
+    def _get_or_create_parallel_executor(self):
+        if getattr(self, 'parallel_executor', None) is None:
+            self.parallel_executor = parallel_executor.ParallelExecutor(
+                use_cuda=False,
+                loss_name=self.train_func_outputs[0].name,
+                main_program=self.train_program, scope=self.scope)
+        return self.parallel_executor
+
+    def _train_loop(self, exe, num_epochs, event_handler, reader, feed_order):
+        with self._prog_and_scope_guard():
+            feed_vars = build_feed_var_list(self.train_program, feed_order)
+            feeder = DataFeeder(feed_list=feed_vars, place=self.place)
+            is_pe = isinstance(exe, parallel_executor.ParallelExecutor)
+            fetch = [v.name for v in self.train_func_outputs]
+            cfg = self.checkpoint_cfg
+            start_epoch = cfg.epoch_id if cfg and cfg.load_serial else 0
+            for epoch_id in range(start_epoch, num_epochs):
+                event_handler(BeginEpochEvent(epoch_id))
+                for step_id, data in enumerate(reader()):
+                    if self.__stop:
+                        if cfg:
+                            self._clean_checkpoint()
+                        return
+                    if (cfg and cfg.load_serial
+                            and epoch_id == cfg.epoch_id
+                            and step_id <= cfg.step_id):
+                        continue  # already done before the crash
+                    begin = BeginStepEvent(epoch_id, step_id)
+                    event_handler(begin)
+                    want = fetch if begin.fetch_metrics else []
+                    if is_pe:
+                        metrics = exe.run(want, feed=feeder.feed(data))
+                    else:
+                        metrics = exe.run(program=self.train_program,
+                                          feed=feeder.feed(data),
+                                          fetch_list=want)
+                    if cfg:
+                        self._save_checkpoint(epoch_id, step_id)
+                    event_handler(EndStepEvent(epoch_id, step_id, metrics))
+                event_handler(EndEpochEvent(epoch_id))
+            if cfg:
+                self._clean_checkpoint()

@@ -5,15 +5,16 @@ which splits the program into trainer programs (send/recv gradient ops over
 gRPC) and parameter-server programs (optimizer ops moved server-side).
 
 TPU-first redesign: parameter servers do not exist on a TPU pod — gradients
-ride the ICI mesh as XLA all-reduces (see parallel_executor.py), and
-multi-host scaling is the same GSPMD program over a larger mesh
-(jax.distributed). The transpiler therefore becomes a *configuration*
-object: it validates the topology, annotates the program with the mesh
-geometry, and (for API compatibility) returns the original program from
-get_trainer_program() and a no-op program from get_pserver_program() so
-reference-style training scripts run unmodified. Sharded-optimizer-state
-("pserver-like" memory scaling, i.e. ZeRO) is exposed via
-paddle_tpu.parallel.shard_optimizer_states.
+ride the ICI mesh as XLA all-reduces, and multi-host scaling is the same
+GSPMD program over a larger mesh (paddle_tpu.parallel.init_multihost →
+jax.distributed). transpile() annotates the program with the mesh geometry
+(`_dist_config`); the Executor CONSUMES that annotation: it builds the dp
+mesh, replicates parameters, shards feed batches, and — the pserver memory
+story — ZeRO-shards optimizer accumulators over dp with the shardings
+enforced inside the compiled step (slice_var_up=True maps to the
+reference's splitting of large vars across pservers). get_trainer_program()
+returns the annotated program; get_pserver_program() returns a no-op
+program so reference launcher scripts degrade gracefully.
 """
 from ..framework import Program, default_main_program
 
@@ -49,6 +50,9 @@ class DistributeTranspiler(object):
             'dp_size': trainers,
             'trainer_id': trainer_id,
             'sync_mode': sync_mode,
+            # reference slice_var_up split big vars across pservers; the
+            # TPU equivalent is ZeRO-sharding optimizer state over dp
+            'shard_optimizer_states': bool(slice_var_up),
         }
         return self
 

@@ -82,7 +82,8 @@ def export_compiled(dirname, feed_example, target_vars, executor,
     # run once through the executor to build+cache the pure step fn
     executor.run(infer, feed=dict(feed_example), fetch_list=fetch_names)
     compiled = None
-    for (pid, _, _, fetches, _, _, _), c in executor._cache.items():
+    for k, c in executor._cache.items():
+        pid, fetches = k[0], k[3]  # (uid, version, feed_sig, fetches, ...)
         if pid == infer._uid and tuple(fetches) == tuple(fetch_names):
             compiled = c
     assert compiled is not None

@@ -18,7 +18,39 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = ['make_mesh', 'data_sharding', 'replicated', 'shard_batch',
            'replicate', 'shard_params_by_rules', 'psum', 'all_gather',
            'reduce_scatter', 'ppermute', 'shard_optimizer_states',
-           'Mesh', 'NamedSharding', 'P']
+           'init_multihost', 'Mesh', 'NamedSharding', 'P']
+
+
+def init_multihost(coordinator_address=None, num_processes=None,
+                   process_id=None, local_device_ids=None):
+    """Join a multi-host mesh: wraps jax.distributed.initialize so every
+    host sees the global device set, then the SAME GSPMD program spans
+    ICI+DCN (the reference instead spawned pserver processes and connected
+    trainers over gRPC, transpiler/distribute_transpiler.py:167).
+
+    Arguments default from the reference's launcher environment
+    (PADDLE_TRAINER_ENDPOINTS/PADDLE_TRAINERS/PADDLE_TRAINER_ID) so
+    reference-style cluster scripts work unchanged; returns False (no-op)
+    when neither args nor env describe a cluster — single-host dev keeps
+    working without any setup.
+    """
+    import os
+    if coordinator_address is None:
+        eps = os.environ.get('PADDLE_TRAINER_ENDPOINTS', '')
+        if eps:
+            coordinator_address = eps.split(',')[0].strip()
+    if num_processes is None and os.environ.get('PADDLE_TRAINERS'):
+        num_processes = int(os.environ['PADDLE_TRAINERS'])
+    if process_id is None and os.environ.get('PADDLE_TRAINER_ID'):
+        process_id = int(os.environ['PADDLE_TRAINER_ID'])
+    if (coordinator_address is None or process_id is None
+            or num_processes in (None, 0, 1)):
+        return False  # incomplete cluster description: single-host no-op
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes, process_id=process_id,
+        local_device_ids=local_device_ids)
+    return True
 
 
 def make_mesh(axes=None, devices=None):

@@ -1,0 +1,3 @@
+"""Host-side utilities: native runtime bindings (utils.native) and
+mesh-sharded checkpointing (utils.checkpoint)."""
+from . import checkpoint  # noqa: F401

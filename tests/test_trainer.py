@@ -1,0 +1,192 @@
+"""High-level Trainer/Inferencer API.
+
+Parity: reference python/paddle/fluid/trainer.py:169 + inferencer.py:31
+(the book-chapter train_func/optimizer_func loop, events, CheckpointConfig
+crash-resume, save_params -> Inferencer round trip).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def _linear_train_func():
+    x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+    pred = fluid.layers.fc(input=x, size=1, act=None)
+    return fluid.layers.mean(
+        fluid.layers.square_error_cost(input=pred, label=y))
+
+
+def _infer_func():
+    x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    return fluid.layers.fc(input=x, size=1, act=None)
+
+
+_W = np.array([[1.5], [-2.0], [0.5], [3.0]], 'float32')
+
+
+def _reader(n=64, batch=8, seed=0):
+    def r():
+        rng = np.random.RandomState(seed)
+        for _ in range(n // batch):
+            xs = rng.rand(batch, 4).astype('float32')
+            ys = xs @ _W
+            yield [(xs[i], ys[i]) for i in range(batch)]
+    return r
+
+
+def _sgd():
+    return fluid.optimizer.SGD(learning_rate=0.1)
+
+
+def test_trainer_converges_and_fires_events(tmp_path):
+    events = []
+    losses = []
+
+    def handler(ev):
+        events.append(type(ev).__name__)
+        if isinstance(ev, fluid.EndStepEvent):
+            losses.append(float(np.asarray(ev.metrics[0])))
+
+    trainer = fluid.Trainer(train_func=_linear_train_func,
+                            optimizer_func=_sgd, place=fluid.CPUPlace())
+    trainer.train(num_epochs=30, event_handler=handler,
+                  reader=_reader(), feed_order=['x', 'y'])
+    assert losses[0] > 1.0 and losses[-1] < 0.01, (losses[0], losses[-1])
+    assert events[0] == 'BeginEpochEvent'
+    assert events.count('BeginEpochEvent') == 30
+    assert events.count('EndEpochEvent') == 30
+    assert events.count('EndStepEvent') == 30 * 8
+    # test() averages metrics on the for_test clone
+    test_loss = trainer.test(reader=_reader(seed=1), feed_order=['x', 'y'])
+    assert test_loss[0] < 0.01
+
+    # save_params -> Inferencer round trip
+    trainer.save_params(str(tmp_path / 'model'))
+    inf = fluid.Inferencer(infer_func=_infer_func,
+                           param_path=str(tmp_path / 'model'),
+                           place=fluid.CPUPlace())
+    xs = np.random.RandomState(2).rand(8, 4).astype('float32')
+    out = inf.infer({'x': xs})[0]
+    np.testing.assert_allclose(out, xs @ _W, atol=0.1)
+
+
+def test_trainer_stop():
+    seen = []
+
+    def handler(ev):
+        if isinstance(ev, fluid.EndStepEvent):
+            seen.append(ev.step)
+            if len(seen) >= 3:
+                trainer.stop()
+
+    trainer = fluid.Trainer(train_func=_linear_train_func,
+                            optimizer_func=_sgd, place=fluid.CPUPlace())
+    trainer.train(num_epochs=10, event_handler=handler, reader=_reader(),
+                  feed_order=['x', 'y'])
+    assert len(seen) == 3
+
+
+def test_trainer_checkpoint_resume(tmp_path):
+    """Simulated crash mid-training: a fresh Trainer over the same
+    checkpoint dir resumes from the last snapshot instead of cold-starting,
+    and skips the already-done steps of the crash epoch."""
+    ckpt = str(tmp_path / 'ckpt')
+    cfg = fluid.CheckpointConfig(checkpoint_dir=ckpt, max_num_checkpoints=2,
+                                 epoch_interval=1, step_interval=1)
+
+    class Crash(Exception):
+        pass
+
+    steps_a = []
+
+    def crash_handler(ev):
+        if isinstance(ev, fluid.EndStepEvent):
+            steps_a.append((ev.epoch, ev.step))
+            if ev.epoch == 1 and ev.step == 3:
+                raise Crash()  # hard kill: no cleanup runs
+
+    t1 = fluid.Trainer(train_func=_linear_train_func, optimizer_func=_sgd,
+                       place=fluid.CPUPlace(), checkpoint_config=cfg)
+    with pytest.raises(Crash):
+        t1.train(num_epochs=4, event_handler=crash_handler,
+                 reader=_reader(), feed_order=['x', 'y'])
+    import os
+    assert os.path.isdir(ckpt) and os.listdir(ckpt)
+    w_at_crash = np.asarray(
+        t1.scope.vars[[n for n in t1.scope.vars if n.endswith('.w_0')][0]])
+
+    steps_b = []
+
+    def handler(ev):
+        if isinstance(ev, fluid.EndStepEvent):
+            steps_b.append((ev.epoch, ev.step))
+
+    cfg2 = fluid.CheckpointConfig(checkpoint_dir=ckpt, max_num_checkpoints=2,
+                                  epoch_interval=1, step_interval=1)
+    t2 = fluid.Trainer(train_func=_linear_train_func, optimizer_func=_sgd,
+                       place=fluid.CPUPlace(), checkpoint_config=cfg2)
+    # resumed params match the crash-time params (last checkpoint = step 3)
+    w_resumed = np.asarray(
+        t2.scope.vars[[n for n in t2.scope.vars if n.endswith('.w_0')][0]])
+    np.testing.assert_allclose(w_resumed, w_at_crash, rtol=1e-6)
+    stray = os.path.join(ckpt, 'user_notes.txt')
+    open(stray, 'w').write('not a checkpoint')
+    t2.train(num_epochs=4, event_handler=handler, reader=_reader(),
+             feed_order=['x', 'y'])
+    # epoch 0 fully skipped; epoch 1 resumes after step 3
+    assert (1, 3) not in steps_b
+    assert (1, 4) in steps_b
+    assert min(e for e, s in steps_b) == 1
+    assert steps_b[-1] == (3, 7)
+    # successful finish removes the checkpoint_<n> serials but ONLY them
+    assert not [d for d in os.listdir(ckpt) if d.startswith('checkpoint_')]
+    assert os.path.exists(stray)
+
+
+def test_trainer_resume_skips_torn_checkpoint(tmp_path):
+    """A meta.json torn by a crash mid-save must fall back to the previous
+    intact serial instead of crashing Trainer construction forever."""
+    import os
+    ckpt = str(tmp_path / 'ckpt')
+    cfg = fluid.CheckpointConfig(checkpoint_dir=ckpt, max_num_checkpoints=5,
+                                 epoch_interval=1, step_interval=1)
+
+    class Crash(Exception):
+        pass
+
+    def crash_handler(ev):
+        if isinstance(ev, fluid.EndStepEvent) and ev.step == 4:
+            raise Crash()
+
+    t1 = fluid.Trainer(train_func=_linear_train_func, optimizer_func=_sgd,
+                       place=fluid.CPUPlace(), checkpoint_config=cfg)
+    with pytest.raises(Crash):
+        t1.train(num_epochs=1, event_handler=crash_handler,
+                 reader=_reader(), feed_order=['x', 'y'])
+    serials = sorted(int(d.split('_')[1]) for d in os.listdir(ckpt))
+    # tear the newest checkpoint's meta
+    with open(os.path.join(ckpt, 'checkpoint_%d' % serials[-1],
+                           'meta.json'), 'w') as f:
+        f.write('{"step": 5, "trainer_')
+    cfg2 = fluid.CheckpointConfig(checkpoint_dir=ckpt)
+    t2 = fluid.Trainer(train_func=_linear_train_func, optimizer_func=_sgd,
+                       place=fluid.CPUPlace(), checkpoint_config=cfg2)
+    assert cfg2.load_serial == serials[-2]  # previous intact snapshot
+
+
+def test_trainer_parallel_path():
+    """parallel=True routes through ParallelExecutor (GSPMD dp mesh)."""
+    losses = []
+
+    def handler(ev):
+        if isinstance(ev, fluid.EndStepEvent):
+            losses.append(float(np.asarray(ev.metrics[0])))
+
+    trainer = fluid.Trainer(train_func=_linear_train_func,
+                            optimizer_func=_sgd, place=fluid.CPUPlace(),
+                            parallel=True)
+    trainer.train(num_epochs=10, event_handler=handler, reader=_reader(),
+                  feed_order=['x', 'y'])
+    assert losses[-1] < losses[0]
