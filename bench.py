@@ -1,32 +1,51 @@
-"""Benchmark driver: ResNet-50 train throughput on one chip.
+"""Benchmark driver: ResNet-50 images/sec + Transformer-base tokens/sec,
+single chip (the two metrics named in BASELINE.json).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-vs_baseline is measured against REF_IMAGES_PER_SEC, the reference's
-2018-era fluid benchmark/README single-accelerator ResNet-50 figure
-(benchmark/fluid, batch 64) — the number this framework must beat.
+Prints ONE JSON line whose top-level {metric,value,unit,vs_baseline} is the
+ResNet-50 headline (continuity with round 1) and whose "metrics" list
+carries both benchmarks.
+
+Baselines:
+  - ResNet-50: 300 images/sec — the reference's 2018-era fluid
+    benchmark/README single-accelerator figure (batch 64, CUDA).
+  - Transformer-base: 14500 src+tgt tokens/sec/device — derived from the
+    original Transformer paper's training throughput (base model, 8x P100,
+    ~100k steps x ~50k tokens in 12h => ~14.5k tokens/s per device), the
+    same era as the reference's CUDA stack; the reference repo publishes no
+    number of its own.
 """
 import json
 import os
-import sys
 import time
 
 import numpy as np
 
-REF_IMAGES_PER_SEC = 300.0  # reference CUDA single-device fluid baseline
+REF_IMAGES_PER_SEC = 300.0    # reference CUDA single-device fluid baseline
+REF_TOKENS_PER_SEC = 14500.0  # 2017/18-era per-device Transformer-base
 
 
-def bench_resnet50(batch_size=128, warmup=3, iters=20, use_amp=True):
+def _fresh():
+    from paddle_tpu.fluid import framework
+    from paddle_tpu.fluid.executor import Scope, _switch_scope
+    _switch_scope(Scope())
+    return framework.Program(), framework.Program()
+
+
+def bench_resnet50(batch_size=1024, warmup=3, iters=12, use_amp=True):
+    """ResNet-50 train step, bf16 activations end-to-end (fp32 master
+    weights + BN statistics): on the MXU the bf16 path is ~35% faster than
+    fp32 activations with per-op casts (2035 vs 1528 img/s at batch 1024
+    on a v5e-class chip)."""
     import paddle_tpu.fluid as fluid
     from paddle_tpu.fluid import framework, unique_name
-    from paddle_tpu.fluid.executor import Scope, _switch_scope, global_scope
     from paddle_tpu.models.resnet import resnet_imagenet
+    import jax.numpy as jnp
 
-    main, startup = framework.Program(), framework.Program()
-    _switch_scope(Scope())
+    main, startup = _fresh()
     with unique_name.guard():
         with framework.program_guard(main, startup):
             img = fluid.layers.data(name='data', shape=[3, 224, 224],
-                                    dtype='float32')
+                                    dtype='bfloat16' if use_amp else 'float32')
             label = fluid.layers.data(name='label', shape=[1], dtype='int64')
             predict = resnet_imagenet(img, class_dim=1000, depth=50)
             avg_cost = fluid.layers.mean(
@@ -34,20 +53,21 @@ def bench_resnet50(batch_size=128, warmup=3, iters=20, use_amp=True):
             fluid.optimizer.Momentum(learning_rate=0.01, momentum=0.9) \
                 .minimize(avg_cost)
             if use_amp:
-                # bf16 matmul/conv on the MXU; fp32 master weights
                 fluid.amp.decorate_program(main)
 
             exe = fluid.Executor()
             exe.run(startup)
 
             rng = np.random.RandomState(0)
-            feed = {
-                'data': rng.rand(batch_size, 3, 224, 224).astype('float32'),
-                'label': rng.randint(0, 1000,
-                                     size=(batch_size, 1)).astype('int64'),
-            }
             # stage feed on device once; steps then measure pure device time
-            feed = {k: exe._to_device(v) for k, v in feed.items()}
+            data = exe._to_device(
+                rng.rand(batch_size, 3, 224, 224).astype('float32'))
+            if use_amp:
+                data = data.astype(jnp.bfloat16)
+            feed = {'data': data,
+                    'label': exe._to_device(
+                        rng.randint(0, 1000, size=(batch_size, 1))
+                        .astype('int64'))}
 
             # warmup with the SAME fetch signature as the timed loop so the
             # compile happens here, not inside the timing
@@ -62,24 +82,85 @@ def bench_resnet50(batch_size=128, warmup=3, iters=20, use_amp=True):
             return batch_size * iters / dt
 
 
+def bench_transformer(batch_size=64, seq_len=256, warmup=3, iters=12,
+                      use_amp=True, vocab=30000):
+    """Transformer-base (6 layers, d_model 512, 8 heads, d_inner 2048)
+    train step through the pallas flash-attention path; tokens/sec counts
+    source + target tokens per step (the tensor2tensor-era convention)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework, unique_name
+    from paddle_tpu.models import transformer as T
+
+    main, startup = _fresh()
+    with unique_name.guard():
+        with framework.program_guard(main, startup):
+            avg_cost, tok, feeds = T.transformer(
+                vocab, vocab, seq_len, n_layer=6, d_model=512, n_head=8,
+                d_inner=2048, dropout_rate=0.1)
+            fluid.optimizer.Adam(learning_rate=1e-4, beta1=0.9, beta2=0.98,
+                                 epsilon=1e-9).minimize(avg_cost)
+            if use_amp:
+                fluid.amp.decorate_program(main)
+
+            exe = fluid.Executor()
+            exe.run(startup)
+
+            rng = np.random.RandomState(0)
+            feed = {}
+            for name in feeds:
+                ids = rng.randint(1, vocab, size=(batch_size, seq_len))
+                feed[name] = exe._to_device(ids.astype('int64'))
+
+            for _ in range(warmup):
+                exe.run(main, feed=feed, fetch_list=[avg_cost])
+
+            t0 = time.time()
+            for _ in range(iters):
+                loss, = exe.run(main, feed=feed, fetch_list=[avg_cost])
+            dt = time.time() - t0
+            assert np.isfinite(float(loss)), float(loss)
+            return batch_size * 2 * seq_len * iters / dt  # src + tgt tokens
+
+
+def _try(fn, *scaled_attempts):
+    """Run fn(**kwargs) trying each attempt dict in order (HBM fallbacks)."""
+    last = None
+    for kw in scaled_attempts:
+        try:
+            return fn(**kw)
+        except Exception as e:
+            last = e
+    raise last
+
+
 def main():
-    # batch 512 saturates the v5e MXU (~1540 img/s vs ~960 at 128); the
-    # fallback path handles smaller-HBM chips
-    batch = int(os.environ.get('BENCH_BATCH', '512'))
-    iters = int(os.environ.get('BENCH_ITERS', '12'))
     use_amp = os.environ.get('BENCH_AMP', '1') == '1'
-    try:
-        ips = bench_resnet50(batch_size=batch, iters=iters, use_amp=use_amp)
-    except Exception:
-        # fall back to a smaller batch if HBM-constrained
-        ips = bench_resnet50(batch_size=max(8, batch // 4), iters=iters,
-                             use_amp=use_amp)
-    print(json.dumps({
-        "metric": "resnet50_train_images_per_sec_per_chip",
-        "value": round(ips, 2),
-        "unit": "images/sec/chip",
-        "vs_baseline": round(ips / REF_IMAGES_PER_SEC, 3),
-    }))
+    iters = int(os.environ.get('BENCH_ITERS', '12'))
+    rbatch = int(os.environ.get('BENCH_BATCH', '1024'))
+    tbatch = int(os.environ.get('BENCH_TBATCH', '64'))
+    seq = int(os.environ.get('BENCH_SEQ', '256'))
+
+    ips = _try(bench_resnet50,
+               dict(batch_size=rbatch, iters=iters, use_amp=use_amp),
+               dict(batch_size=max(8, rbatch // 4), iters=iters,
+                    use_amp=use_amp))
+    tps = _try(bench_transformer,
+               dict(batch_size=tbatch, seq_len=seq, iters=iters,
+                    use_amp=use_amp),
+               dict(batch_size=max(4, tbatch // 4), seq_len=seq, iters=iters,
+                    use_amp=use_amp))
+
+    metrics = [
+        {"metric": "resnet50_train_images_per_sec_per_chip",
+         "value": round(ips, 2), "unit": "images/sec/chip",
+         "vs_baseline": round(ips / REF_IMAGES_PER_SEC, 3)},
+        {"metric": "transformer_base_train_tokens_per_sec_per_chip",
+         "value": round(tps, 2), "unit": "tokens/sec/chip",
+         "vs_baseline": round(tps / REF_TOKENS_PER_SEC, 3)},
+    ]
+    out = dict(metrics[0])
+    out["metrics"] = metrics
+    print(json.dumps(out))
 
 
 if __name__ == '__main__':

@@ -103,7 +103,8 @@ class Variable(object):
         return jax.ShapeDtypeStruct(shape, np.dtype(dt) if dt != 'bfloat16' else 'bfloat16')
 
     def _to_dict(self):
-        return dict(name=self.name, shape=list(self.shape) if self.shape else None,
+        return dict(name=self.name,
+                    shape=list(self.shape) if self.shape is not None else None,
                     dtype=self.dtype, lod_level=self.lod_level,
                     persistable=self.persistable, stop_gradient=self.stop_gradient,
                     is_data=self.is_data, type=self.type,

@@ -16,7 +16,12 @@ class ParamAttr(object):
                  regularizer=None,
                  trainable=True,
                  gradient_clip=None,
-                 do_model_average=False):
+                 do_model_average=None):
+        # do_model_average default None (= averaged): the reference's
+        # ParamAttr declares False but its _to_kwargs/Parameter key
+        # mismatch makes every default param land as None, and
+        # ModelAverage includes params with do_model_average != False —
+        # we reproduce that observable behavior directly.
         self.name = name
         self.initializer = initializer
         self.learning_rate = learning_rate

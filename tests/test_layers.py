@@ -1,0 +1,541 @@
+"""Execute (not just build) every layer family with small inputs.
+
+Parity: reference tests/unittests/test_layers.py, plus numeric checks for
+the conv/pool/norm families and finite-difference gradient checks
+(reference op_test.py check_grad machinery).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.executor import global_scope
+
+from util import fresh_program
+
+
+def _run(main, startup, feed, fetch_list):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    return exe.run(main, feed=feed, fetch_list=fetch_list)
+
+
+# ---------------------------------------------------------------------------
+# activations / generated ops
+# ---------------------------------------------------------------------------
+
+ACTIVATIONS = ['sigmoid', 'logsigmoid', 'exp', 'tanh', 'tanh_shrink',
+               'softshrink', 'sqrt', 'abs', 'ceil', 'floor', 'cos', 'sin',
+               'round', 'reciprocal', 'square', 'softplus', 'softsign',
+               'brelu', 'leaky_relu', 'soft_relu', 'elu', 'relu6', 'stanh',
+               'hard_sigmoid', 'swish', 'relu']
+
+
+def test_all_activations_execute():
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[6], dtype='float32')
+        outs = [getattr(layers, a)(x) for a in ACTIVATIONS]
+        outs.append(layers.pow(x, factor=2.0))
+        outs.append(layers.prelu(x, mode='all'))
+        xs = np.random.RandomState(0).rand(3, 6).astype('float32') + 0.1
+        res = _run(main, startup, {'x': xs}, outs)
+    for name, r in zip(ACTIVATIONS + ['pow', 'prelu'], res):
+        assert r.shape == (3, 6), name
+        assert np.isfinite(r).all(), name
+    i = ACTIVATIONS.index('sigmoid')
+    np.testing.assert_allclose(res[i], 1 / (1 + np.exp(-xs)), rtol=1e-5)
+    np.testing.assert_allclose(res[ACTIVATIONS.index('square')], xs * xs,
+                               rtol=1e-5)
+
+
+def test_elementwise_and_logical():
+    with fresh_program() as (main, startup):
+        a = layers.data(name='a', shape=[4], dtype='float32')
+        b = layers.data(name='b', shape=[4], dtype='float32')
+        outs = [layers.elementwise_add(a, b), layers.elementwise_sub(a, b),
+                layers.elementwise_mul(a, b), layers.elementwise_div(a, b),
+                layers.elementwise_max(a, b), layers.elementwise_min(a, b),
+                layers.elementwise_pow(a, b)]
+        la = layers.cast(layers.less_than(a, b), 'bool')
+        lb = layers.logical_not(la)
+        outs += [layers.logical_and(la, lb), layers.logical_or(la, lb),
+                 layers.logical_xor(la, lb)]
+        av = np.random.RandomState(1).rand(2, 4).astype('float32') + 0.5
+        bv = np.random.RandomState(2).rand(2, 4).astype('float32') + 0.5
+        res = _run(main, startup, {'a': av, 'b': bv}, outs)
+    np.testing.assert_allclose(res[0], av + bv, rtol=1e-5)
+    np.testing.assert_allclose(res[3], av / bv, rtol=1e-5)
+    np.testing.assert_allclose(res[6], av ** bv, rtol=1e-4)
+    assert not res[7].any()          # a AND (not a) == False
+    assert res[8].all()              # a OR (not a) == True
+
+
+def test_reduce_family_and_friends():
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[3, 4], dtype='float32')
+        outs = [layers.reduce_sum(x), layers.reduce_mean(x),
+                layers.reduce_max(x), layers.reduce_min(x),
+                layers.reduce_prod(x),
+                layers.reduce_sum(x, dim=1, keep_dim=True),
+                layers.scale(x, scale=2.5, bias=1.0),
+                layers.clip(x, min=0.2, max=0.8),
+                layers.clip_by_norm(x, max_norm=1.0),
+                layers.sum([x, x]),
+                layers.cos_sim(x, x),
+                layers.l2_normalize(x, axis=-1)]
+        xs = np.random.RandomState(3).rand(2, 3, 4).astype('float32')
+        res = _run(main, startup, {'x': xs}, outs)
+    np.testing.assert_allclose(res[0].ravel(), xs.reshape(2, -1).sum(-1).sum(),
+                               rtol=1e-5)
+    np.testing.assert_allclose(res[6], xs * 2.5 + 1.0, rtol=1e-5)
+    np.testing.assert_allclose(res[7], np.clip(xs, 0.2, 0.8), rtol=1e-5)
+    np.testing.assert_allclose(res[9], 2 * xs, rtol=1e-5)
+
+
+def test_tensor_ops():
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[4], dtype='float32')
+        t = layers.create_tensor(dtype='float32')
+        layers.assign(x, output=t)
+        gv = layers.create_global_var(shape=[1], value=3.0, dtype='float32',
+                                      persistable=True)
+        outs = [t,
+                layers.cast(x, 'int32'),
+                layers.concat([x, x], axis=1),
+                layers.sums([x, x]),
+                layers.fill_constant(shape=[2, 2], value=5.0, dtype='float32'),
+                layers.fill_constant_batch_size_like(
+                    x, shape=[-1, 3], value=1.5, dtype='float32'),
+                layers.argmin(x, axis=1), layers.argmax(x, axis=1),
+                layers.argsort(x, axis=1)[1],
+                layers.ones(shape=[3], dtype='float32'),
+                layers.zeros(shape=[3], dtype='float32'),
+                layers.reverse(x, axis=1),
+                layers.shape(x),
+                layers.slice(x, axes=[1], starts=[1], ends=[3]),
+                gv]
+        xs = np.random.RandomState(4).rand(2, 4).astype('float32')
+        res = _run(main, startup, {'x': xs}, outs)
+    np.testing.assert_allclose(res[0], xs, rtol=1e-6)
+    np.testing.assert_allclose(res[2], np.concatenate([xs, xs], 1), rtol=1e-6)
+    assert res[4].shape == (2, 2) and (res[4] == 5.0).all()
+    assert res[5].shape == (2, 3) and (res[5] == 1.5).all()
+    np.testing.assert_array_equal(res[7].ravel(), xs.argmax(1))
+    np.testing.assert_allclose(res[11], xs[:, ::-1], rtol=1e-6)
+    np.testing.assert_array_equal(res[13], xs[:, 1:3])
+    assert float(res[14]) == 3.0
+
+
+def test_shape_manipulation():
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[2, 6], dtype='float32')
+        idx = layers.data(name='idx', shape=[1], dtype='int32',
+                          append_batch_size=False)
+        outs = [layers.reshape(x, shape=[-1, 12]),
+                layers.transpose(x, perm=[0, 2, 1]),
+                layers.split(x, num_or_sections=2, dim=2)[0],
+                layers.stack([x, x], axis=0),
+                layers.flatten(x, axis=1),
+                layers.pad(x, paddings=[0, 0, 1, 1, 0, 0], pad_value=9.0),
+                layers.crop(x, shape=[-1, 1, 3]),
+                layers.gather(layers.reshape(x, shape=[-1, 6]), idx),
+                layers.topk(x, k=2)[0],
+                layers.one_hot(layers.cast(idx, 'int64'), depth=4)]
+        xs = np.arange(24, dtype='float32').reshape(2, 2, 6)
+        res = _run(main, startup, {'x': xs, 'idx': np.array([1], 'int32')},
+                   outs)
+    assert res[0].shape == (2, 12)
+    assert res[1].shape == (2, 6, 2)
+    assert res[2].shape == (2, 2, 3)
+    assert res[3].shape == (2, 2, 2, 6)
+    assert res[4].shape == (2, 12)
+    assert res[5].shape == (2, 4, 6) and res[5][0, 0, 0] == 9.0
+    np.testing.assert_allclose(res[8], np.sort(xs, -1)[..., ::-1][..., :2])
+
+
+def test_scatter_multiplex_random_crop():
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[4], dtype='float32')
+        ids = layers.data(name='ids', shape=[2], dtype='int32',
+                          append_batch_size=False)
+        upd = layers.data(name='upd', shape=[2, 4], dtype='float32',
+                          append_batch_size=False)
+        sc = layers.scatter(layers.reshape(x, shape=[-1, 4]), ids, upd)
+        a = layers.data(name='a', shape=[4], dtype='float32')
+        b = layers.data(name='b', shape=[4], dtype='float32')
+        which = layers.data(name='which', shape=[1], dtype='int32')
+        mx = layers.multiplex(inputs=[a, b], index=which)
+        rc = layers.random_crop(x, shape=[2])
+        feed = {'x': np.ones((3, 4), 'float32'),
+                'ids': np.array([0, 2], 'int32'),
+                'upd': np.full((2, 4), 7.0, 'float32'),
+                'a': np.zeros((2, 4), 'float32'),
+                'b': np.ones((2, 4), 'float32'),
+                'which': np.array([[0], [1]], 'int32')}
+        res = _run(main, startup, feed, [sc, mx, rc])
+    assert (res[0][0] == 7).all() and (res[0][1] == 1).all()
+    np.testing.assert_allclose(res[1][0], np.zeros(4))
+    np.testing.assert_allclose(res[1][1], np.ones(4))
+    assert res[2].shape == (3, 2)
+
+
+def test_conv2d_numeric():
+    """conv2d vs a hand-rolled correlation on a tiny case."""
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[1, 4, 4], dtype='float32')
+        y = layers.conv2d(input=x, num_filters=1, filter_size=3, padding=0,
+                          bias_attr=False,
+                          param_attr=fluid.ParamAttr(
+                              initializer=fluid.initializer.Constant(1.0)))
+        xs = np.arange(16, dtype='float32').reshape(1, 1, 4, 4)
+        res = _run(main, startup, {'x': xs}, [y])[0]
+    expect = np.zeros((1, 1, 2, 2), 'float32')
+    for i in range(2):
+        for j in range(2):
+            expect[0, 0, i, j] = xs[0, 0, i:i + 3, j:j + 3].sum()
+    np.testing.assert_allclose(res, expect, rtol=1e-5)
+
+
+def test_conv_family_shapes():
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[2, 8, 8], dtype='float32')
+        v = layers.data(name='v', shape=[2, 4, 4, 4], dtype='float32')
+        outs = [layers.conv2d(x, num_filters=3, filter_size=3, padding=1),
+                layers.conv2d(x, num_filters=4, filter_size=3, padding=1,
+                              groups=2, dilation=2),
+                layers.conv2d_transpose(x, num_filters=3, filter_size=2,
+                                        stride=2),
+                layers.conv3d(v, num_filters=3, filter_size=3, padding=1),
+                layers.conv3d_transpose(v, num_filters=2, filter_size=2,
+                                        stride=2),
+                layers.pool2d(x, pool_size=2, pool_type='max', pool_stride=2),
+                layers.pool2d(x, pool_size=2, pool_type='avg', pool_stride=2,
+                              global_pooling=True),
+                layers.pool3d(v, pool_size=2, pool_type='max', pool_stride=2)]
+        feed = {'x': np.random.RandomState(5).rand(2, 2, 8, 8).astype('float32'),
+                'v': np.random.RandomState(6).rand(2, 2, 4, 4, 4).astype('float32')}
+        res = _run(main, startup, feed, outs)
+    assert res[0].shape == (2, 3, 8, 8)
+    assert res[1].shape == (2, 4, 6, 6)
+    assert res[2].shape == (2, 3, 16, 16)
+    assert res[3].shape == (2, 3, 4, 4, 4)
+    assert res[4].shape == (2, 2, 8, 8, 8)
+    assert res[5].shape == (2, 2, 4, 4)
+    assert res[6].shape == (2, 2, 1, 1)
+    assert res[7].shape == (2, 2, 2, 2, 2)
+
+
+def test_pool2d_numeric():
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[1, 4, 4], dtype='float32')
+        mx = layers.pool2d(x, pool_size=2, pool_type='max', pool_stride=2)
+        av = layers.pool2d(x, pool_size=2, pool_type='avg', pool_stride=2)
+        xs = np.arange(16, dtype='float32').reshape(1, 1, 4, 4)
+        rm, ra = _run(main, startup, {'x': xs}, [mx, av])
+    np.testing.assert_allclose(rm[0, 0], [[5, 7], [13, 15]])
+    np.testing.assert_allclose(ra[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+
+
+def test_batch_norm_inference_numeric():
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[3, 2, 2], dtype='float32')
+        y = layers.batch_norm(input=x, is_test=True, epsilon=1e-5)
+        infer = main.clone(for_test=True)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        import jax.numpy as jnp
+        scope = global_scope()
+        rng = np.random.RandomState(7)
+        mean = rng.rand(3).astype('float32')
+        var = rng.rand(3).astype('float32') + 0.5
+        scale = rng.rand(3).astype('float32')
+        bias = rng.rand(3).astype('float32')
+        for n in list(scope.vars):
+            if 'mean' in n:
+                scope.vars[n] = jnp.asarray(mean)
+            elif 'variance' in n:
+                scope.vars[n] = jnp.asarray(var)
+            elif 'batch_norm' in n and n.endswith('.w_0'):
+                scope.vars[n] = jnp.asarray(scale)
+            elif 'batch_norm' in n and n.endswith('.b_0'):
+                scope.vars[n] = jnp.asarray(bias)
+        xs = rng.rand(2, 3, 2, 2).astype('float32')
+        res = exe.run(infer, feed={'x': xs}, fetch_list=[y])[0]
+    expect = (xs - mean[None, :, None, None]) / \
+        np.sqrt(var[None, :, None, None] + 1e-5) * \
+        scale[None, :, None, None] + bias[None, :, None, None]
+    np.testing.assert_allclose(res, expect, rtol=1e-4)
+
+
+def test_norm_family():
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[4, 4, 4], dtype='float32')
+        flat = layers.data(name='f', shape=[8], dtype='float32')
+        outs = [layers.batch_norm(input=x),
+                layers.layer_norm(input=x),
+                layers.lrn(input=x),
+                layers.maxout(layers.data(name='m', shape=[4, 2, 2],
+                                          dtype='float32'), groups=2)]
+        feed = {'x': np.random.RandomState(8).rand(2, 4, 4, 4).astype('float32'),
+                'f': np.random.RandomState(9).rand(2, 8).astype('float32'),
+                'm': np.random.RandomState(10).rand(2, 4, 2, 2).astype('float32')}
+        res = _run(main, startup, feed, outs)
+    assert res[0].shape == (2, 4, 4, 4)
+    assert res[1].shape == (2, 4, 4, 4)
+    assert res[2].shape == (2, 4, 4, 4)
+    assert res[3].shape == (2, 2, 2, 2)
+    ln = res[1].reshape(2, -1)
+    np.testing.assert_allclose(ln.mean(1), 0, atol=1e-4)
+
+
+def test_loss_family():
+    with fresh_program() as (main, startup):
+        logits = layers.data(name='logits', shape=[5], dtype='float32')
+        label = layers.data(name='label', shape=[1], dtype='int64')
+        flabel = layers.data(name='flabel', shape=[5], dtype='float32')
+        pred = layers.softmax(logits)
+        outs = [layers.cross_entropy(input=pred, label=label),
+                layers.softmax_with_cross_entropy(logits, label),
+                layers.square_error_cost(input=logits, label=flabel),
+                layers.smooth_l1(x=logits, y=flabel),
+                layers.sigmoid_cross_entropy_with_logits(x=logits, label=flabel),
+                layers.dice_loss(layers.sigmoid(logits), layers.cast(
+                    layers.reshape(label, shape=[-1, 1]), 'int64')),
+                layers.rank_loss(
+                    label=layers.reshape(flabel, shape=[-1, 5]),
+                    left=layers.reshape(logits, shape=[-1, 5]),
+                    right=layers.reshape(flabel, shape=[-1, 5])),
+                layers.label_smooth(layers.one_hot(label, depth=5),
+                                    epsilon=0.1)]
+        rng = np.random.RandomState(11)
+        lg = rng.rand(3, 5).astype('float32')
+        lb = rng.randint(0, 5, (3, 1)).astype('int64')
+        fl = rng.rand(3, 5).astype('float32')
+        res = _run(main, startup, {'logits': lg, 'label': lb, 'flabel': fl},
+                   outs)
+    # cross_entropy(softmax(x)) == softmax_with_cross_entropy(x)
+    np.testing.assert_allclose(res[0], res[1], rtol=1e-4)
+    np.testing.assert_allclose(res[2], (lg - fl) ** 2, rtol=1e-5)
+    sm = np.exp(lg) / np.exp(lg).sum(-1, keepdims=True)
+    expect_ce = -np.log(sm[np.arange(3), lb.ravel()])[:, None]
+    np.testing.assert_allclose(res[0], expect_ce, rtol=1e-4)
+
+
+def test_fc_embedding_matmul():
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[3, 4], dtype='float32')
+        ids = layers.data(name='ids', shape=[3], dtype='int64')
+        emb = layers.embedding(input=ids, size=[10, 6])
+        f1 = layers.fc(input=x, size=5, num_flatten_dims=2)
+        f2 = layers.fc(input=[x, x], size=5, num_flatten_dims=2)
+        a = layers.data(name='a', shape=[2, 3], dtype='float32')
+        b = layers.data(name='b', shape=[3, 2], dtype='float32')
+        mm = layers.matmul(a, b)
+        mmt = layers.matmul(a, a, transpose_y=True)
+        ml = layers.mul(layers.reshape(a, shape=[-1, 3]),
+                        layers.reshape(b, shape=[3, -1]))
+        rng = np.random.RandomState(12)
+        feed = {'x': rng.rand(2, 3, 4).astype('float32'),
+                'ids': rng.randint(0, 10, (2, 3)).astype('int64'),
+                'a': rng.rand(2, 2, 3).astype('float32'),
+                'b': rng.rand(2, 3, 2).astype('float32')}
+        res = _run(main, startup, feed, [emb, f1, f2, mm, mmt, ml])
+    assert res[0].shape == (2, 3, 6)
+    assert res[1].shape == (2, 3, 5)
+    assert res[2].shape == (2, 3, 5)
+    np.testing.assert_allclose(res[3], feed['a'] @ feed['b'], rtol=1e-5)
+    np.testing.assert_allclose(
+        res[4], feed['a'] @ feed['a'].transpose(0, 2, 1), rtol=1e-5)
+
+
+def test_dropout_train_vs_test():
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[100], dtype='float32')
+        y = layers.dropout(x, dropout_prob=0.5)
+        infer = main.clone(for_test=True)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        xs = np.ones((4, 100), 'float32')
+        train = exe.run(main, feed={'x': xs}, fetch_list=[y])[0]
+        test = exe.run(infer, feed={'x': xs}, fetch_list=[y])[0]
+    assert (train == 0).mean() > 0.2          # some units dropped
+    np.testing.assert_allclose(test, xs)      # identity at inference
+
+
+def test_image_resize_family():
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[1, 4, 4], dtype='float32')
+        outs = [layers.image_resize(x, out_shape=[8, 8]),
+                layers.resize_bilinear(x, out_shape=[2, 2]),
+                layers.image_resize_short(x, out_short_len=8)]
+        xs = np.arange(16, dtype='float32').reshape(1, 1, 4, 4)
+        res = _run(main, startup, {'x': xs}, outs)
+    assert res[0].shape == (1, 1, 8, 8)
+    assert res[1].shape == (1, 1, 2, 2)
+    assert res[2].shape == (1, 1, 8, 8)
+
+
+def test_misc_ops():
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[4], dtype='float32')
+        pr = layers.data(name='pr', shape=[2, 4, 4], dtype='float32')
+        step = layers.autoincreased_step_counter()
+        outs = [layers.mean(x),
+                layers.gaussian_random(shape=[3, 3]),
+                layers.gaussian_random_batch_size_like(x, shape=[-1, 5]),
+                layers.uniform_random_batch_size_like(x, shape=[-1, 5]),
+                layers.mean_iou(
+                    layers.fill_constant(shape=[4], value=1, dtype='int32'),
+                    layers.fill_constant(shape=[4], value=1, dtype='int32'),
+                    2)[0],
+                step]
+        feed = {'x': np.random.RandomState(13).rand(2, 4).astype('float32'),
+                'pr': np.random.RandomState(14).rand(1, 2, 4, 4).astype('float32')}
+        res = _run(main, startup, feed, outs)
+    assert res[1].shape == (3, 3)
+    assert res[2].shape == (2, 5)
+    assert res[3].shape == (2, 5)
+    assert np.isclose(float(res[4]), 1.0)
+
+
+def test_lr_schedulers_numeric():
+    from paddle_tpu.fluid.layers import learning_rate_scheduler as lrs
+    cases = {
+        'exponential_decay': (lambda: lrs.exponential_decay(0.1, 10, 0.9),
+                              lambda t: 0.1 * 0.9 ** (t / 10.0)),
+        'natural_exp_decay': (lambda: lrs.natural_exp_decay(0.1, 10, 0.9),
+                              lambda t: 0.1 * np.exp(-0.9 * (t / 10.0))),
+        'inverse_time_decay': (lambda: lrs.inverse_time_decay(0.1, 10, 0.9),
+                               lambda t: 0.1 / (1 + 0.9 * (t / 10.0))),
+        'polynomial_decay': (lambda: lrs.polynomial_decay(0.1, 100, 0.01, 2.0),
+                             lambda t: (0.1 - 0.01) *
+                             (1 - min(t, 100) / 100.0) ** 2 + 0.01),
+        'noam_decay': (lambda: lrs.noam_decay(64, 100),
+                       lambda t: 64 ** -0.5 * min((t + 1) ** -0.5,
+                                                  (t + 1) * 100 ** -1.5)),
+    }
+    for name, (build, expect) in cases.items():
+        with fresh_program() as (main, startup):
+            x = layers.data(name='x', shape=[1], dtype='float32')
+            lr = build()
+            out = layers.elementwise_mul(
+                layers.reduce_sum(x), lr) if name != 'noam_decay' else lr
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            vals = [float(np.asarray(
+                exe.run(main, feed={'x': np.ones((1, 1), 'float32')},
+                        fetch_list=[lr])[0]))
+                for _ in range(4)]
+        for t, v in enumerate(vals):
+            assert np.isclose(v, expect(t), rtol=1e-4), (name, t, v, expect(t))
+
+
+def test_piecewise_decay():
+    from paddle_tpu.fluid.layers import learning_rate_scheduler as lrs
+    with fresh_program() as (main, startup):
+        lr = lrs.piecewise_decay(boundaries=[2, 4], values=[1.0, 0.5, 0.1])
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        vals = [float(np.asarray(exe.run(main, feed={}, fetch_list=[lr])[0]))
+                for _ in range(6)]
+    assert vals == [1.0, 1.0, 0.5, 0.5, 0.1, 0.1]
+
+
+def test_metric_ops():
+    with fresh_program() as (main, startup):
+        pred = layers.data(name='pred', shape=[4], dtype='float32')
+        label = layers.data(name='label', shape=[1], dtype='int64')
+        acc = layers.accuracy(input=pred, label=label)
+        auc_out, _, _ = layers.auc(
+            input=layers.concat([1.0 - pred, pred], axis=1)
+            if False else pred, label=label) \
+            if isinstance(layers.auc(input=pred, label=label), tuple) \
+            else (layers.auc(input=pred, label=label), None, None)
+        p = np.array([[0.1, 0.6, 0.2, 0.1],
+                      [0.7, 0.1, 0.1, 0.1]], 'float32')
+        l = np.array([[1], [2]], 'int64')
+        res = _run(main, startup, {'pred': p, 'label': l}, [acc])
+    assert np.isclose(float(res[0]), 0.5)
+
+
+def test_nce_hsigmoid_build_and_run():
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[8], dtype='float32')
+        label = layers.data(name='label', shape=[1], dtype='int64')
+        nce_loss = layers.nce(input=x, label=label, num_total_classes=20,
+                              num_neg_samples=4)
+        hs_loss = layers.hsigmoid(input=x, label=label, num_classes=20)
+        rng = np.random.RandomState(15)
+        feed = {'x': rng.rand(3, 8).astype('float32'),
+                'label': rng.randint(0, 20, (3, 1)).astype('int64')}
+        res = _run(main, startup, feed, [nce_loss, hs_loss])
+    assert np.isfinite(res[0]).all() and np.isfinite(res[1]).all()
+
+
+def test_gradient_check_conv_pool_bn():
+    """Finite-difference gradient check through conv+pool+bn+fc (the
+    reference's op_test check_grad, composed)."""
+    import jax.numpy as jnp
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[2, 6, 6], dtype='float32')
+        h = layers.conv2d(x, num_filters=3, filter_size=3, padding=1,
+                          act='relu')
+        h = layers.pool2d(h, pool_size=2, pool_stride=2, pool_type='avg')
+        h = layers.batch_norm(h)
+        pred = layers.fc(input=h, size=1)
+        loss = layers.reduce_sum(pred)
+        from paddle_tpu.fluid.backward import append_backward
+        append_backward(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope = global_scope()
+        w_name = [n for n in scope.vars if 'conv' in n and n.endswith('.w_0')][0]
+        xs = np.random.RandomState(16).rand(2, 2, 6, 6).astype('float32')
+        g = exe.run(main, feed={'x': xs},
+                    fetch_list=[loss, w_name + '@GRAD'])[1]
+        w0 = np.asarray(scope.vars[w_name]).copy()
+        eps = 1e-2
+        idx = (0, 0, 1, 1)
+        for sign in (1, -1):
+            wp = w0.copy()
+            wp[idx] += sign * eps
+            scope.vars[w_name] = jnp.asarray(wp)
+            val = float(exe.run(main, feed={'x': xs}, fetch_list=[loss])[0])
+            if sign == 1:
+                plus = val
+            else:
+                minus = val
+        fd = (plus - minus) / (2 * eps)
+    assert np.isclose(g[idx], fd, rtol=2e-2), (g[idx], fd)
+
+
+def test_gradient_check_sequence_lstm():
+    """Finite-difference check through embedding + dynamic_lstm."""
+    import jax.numpy as jnp
+    with fresh_program() as (main, startup):
+        ids = layers.data(name='ids', shape=[1], dtype='int64', lod_level=1)
+        emb = layers.embedding(input=ids, size=[12, 8])
+        fc = layers.fc(input=emb, size=16)
+        h, c = layers.dynamic_lstm(input=fc, size=16)
+        loss = layers.reduce_sum(layers.sequence_pool(h, 'sum'))
+        from paddle_tpu.fluid.backward import append_backward
+        append_backward(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope = global_scope()
+        lt = fluid.create_lod_tensor(
+            np.array([[1], [2], [3], [4], [5]], 'int64'), [[3, 2]])
+        emb_name = [n for n in scope.vars if 'emb' in n][0]
+        g = exe.run(main, feed={'ids': lt},
+                    fetch_list=[loss, emb_name + '@GRAD'])[1]
+        w0 = np.asarray(scope.vars[emb_name]).copy()
+        eps, idx = 1e-2, (2, 3)
+        vals = {}
+        for sign in (1, -1):
+            wp = w0.copy()
+            wp[idx] += sign * eps
+            scope.vars[emb_name] = jnp.asarray(wp)
+            vals[sign] = float(exe.run(main, feed={'ids': lt},
+                                       fetch_list=[loss])[0])
+        fd = (vals[1] - vals[-1]) / (2 * eps)
+    assert np.isclose(g[idx], fd, rtol=2e-2, atol=1e-3), (g[idx], fd)

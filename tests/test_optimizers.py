@@ -1,0 +1,243 @@
+"""Every optimizer vs an independent numpy simulation of the reference
+update rule (reference paddle/fluid/operators/*_op.cc kernels, e.g.
+sgd_op.h, momentum_op.h, adam_op.h; python tests modeled on reference
+tests/unittests/test_{sgd,momentum,adam,...}_op.py).
+
+Setup: loss = sum(x @ w) with one parameter w [4,1] and batch of one row,
+so every step's gradient is exactly the fed row — hand-checkable.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+from util import fresh_program
+
+W0 = np.array([[0.5], [-0.3], [0.8], [0.1]], 'float32')
+LR = 0.1
+GRADS = [np.array([[0.4], [-0.2], [0.1], [0.9]], 'float32'),
+         np.array([[-0.5], [0.3], [0.7], [-0.1]], 'float32'),
+         np.array([[0.2], [0.2], [-0.6], [0.5]], 'float32')]
+
+
+def _run_optimizer(opt, steps=3, param_attr=None):
+    """Build sum(x @ w), run `steps` updates with GRADS, return w."""
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[4], dtype='float32')
+        w = layers.create_parameter(
+            shape=[4, 1], dtype='float32', attr=param_attr,
+            default_initializer=fluid.initializer.NumpyArrayInitializer(W0)
+            if hasattr(fluid.initializer, 'NumpyArrayInitializer') else None)
+        loss = layers.reduce_sum(layers.matmul(x, w))
+        opt.minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        from paddle_tpu.fluid.executor import global_scope
+        import jax.numpy as jnp
+        global_scope().vars[w.name] = jnp.asarray(W0)  # exact start
+        for g in GRADS[:steps]:
+            exe.run(main, feed={'x': g.T.copy()}, fetch_list=[loss])
+        return np.asarray(global_scope().vars[w.name])
+
+
+def test_sgd():
+    got = _run_optimizer(fluid.optimizer.SGD(learning_rate=LR))
+    w = W0.copy()
+    for g in GRADS:
+        w = w - LR * g
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=2e-6)
+
+
+def test_sgd_per_param_learning_rate():
+    got = _run_optimizer(fluid.optimizer.SGD(learning_rate=LR),
+                         param_attr=fluid.ParamAttr(learning_rate=2.0))
+    w = W0.copy()
+    for g in GRADS:
+        w = w - 2.0 * LR * g
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=2e-6)
+
+
+@pytest.mark.parametrize('nesterov', [False, True])
+def test_momentum(nesterov):
+    mu = 0.9
+    got = _run_optimizer(fluid.optimizer.Momentum(
+        learning_rate=LR, momentum=mu, use_nesterov=nesterov))
+    w, v = W0.copy(), np.zeros_like(W0)
+    for g in GRADS:
+        v = mu * v + g
+        w = w - (g + mu * v) * LR if nesterov else w - LR * v
+    np.testing.assert_allclose(got, v is not None and w, rtol=1e-5)
+
+
+def test_adagrad():
+    eps = 1e-6
+    got = _run_optimizer(fluid.optimizer.Adagrad(learning_rate=LR,
+                                                 epsilon=eps))
+    w, m = W0.copy(), np.zeros_like(W0)
+    for g in GRADS:
+        m = m + g * g
+        w = w - LR * g / (np.sqrt(m) + eps)
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=2e-6)
+
+
+def test_adam():
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    got = _run_optimizer(fluid.optimizer.Adam(learning_rate=LR, beta1=b1,
+                                              beta2=b2, epsilon=eps))
+    w = W0.copy()
+    m1 = np.zeros_like(W0)
+    m2 = np.zeros_like(W0)
+    b1p, b2p = b1, b2
+    for g in GRADS:
+        m1 = b1 * m1 + (1 - b1) * g
+        m2 = b2 * m2 + (1 - b2) * g * g
+        lr_t = LR * np.sqrt(1 - b2p) / (1 - b1p)
+        w = w - lr_t * m1 / (np.sqrt(m2) + eps)
+        b1p, b2p = b1p * b1, b2p * b2
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=2e-6)
+
+
+def test_adamax():
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    got = _run_optimizer(fluid.optimizer.Adamax(learning_rate=LR, beta1=b1,
+                                                beta2=b2, epsilon=eps))
+    w = W0.copy()
+    m = np.zeros_like(W0)
+    inf = np.zeros_like(W0)
+    b1p = b1
+    for g in GRADS:
+        m = b1 * m + (1 - b1) * g
+        inf = np.maximum(b2 * inf, np.abs(g))
+        w = w - (LR / (1 - b1p)) * m / (inf + eps)
+        b1p *= b1
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=2e-6)
+
+
+def test_decayed_adagrad():
+    decay, eps = 0.95, 1e-6
+    got = _run_optimizer(fluid.optimizer.DecayedAdagrad(
+        learning_rate=LR, decay=decay, epsilon=eps))
+    w, m = W0.copy(), np.zeros_like(W0)
+    for g in GRADS:
+        m = decay * m + (1 - decay) * g * g
+        w = w - LR * g / (np.sqrt(m) + eps)
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=2e-6)
+
+
+def test_rmsprop():
+    rho, eps, mom = 0.95, 1e-6, 0.4
+    got = _run_optimizer(fluid.optimizer.RMSProp(
+        learning_rate=LR, rho=rho, epsilon=eps, momentum=mom))
+    w = W0.copy()
+    ms = np.zeros_like(W0)
+    v = np.zeros_like(W0)
+    for g in GRADS:
+        ms = rho * ms + (1 - rho) * g * g
+        v = mom * v + LR * g / np.sqrt(ms + eps)
+        w = w - v
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=2e-6)
+
+
+def test_adadelta():
+    rho, eps = 0.95, 1e-6
+    got = _run_optimizer(fluid.optimizer.Adadelta(
+        learning_rate=LR, rho=rho, epsilon=eps))
+    w = W0.copy()
+    g2 = np.zeros_like(W0)
+    u2 = np.zeros_like(W0)
+    for g in GRADS:
+        g2 = rho * g2 + (1 - rho) * g * g
+        upd = -np.sqrt((u2 + eps) / (g2 + eps)) * g
+        u2 = rho * u2 + (1 - rho) * upd * upd
+        w = w + upd
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=2e-6)
+
+
+def test_ftrl():
+    l1, l2, lr_power = 0.1, 0.2, -0.5
+    got = _run_optimizer(fluid.optimizer.Ftrl(
+        learning_rate=LR, l1=l1, l2=l2, lr_power=lr_power))
+    w = W0.copy()
+    sq = np.zeros_like(W0)
+    lin = np.zeros_like(W0)
+    for g in GRADS:
+        new_sq = sq + g * g
+        sigma = (np.sqrt(new_sq) - np.sqrt(sq)) / LR
+        lin = lin + g - sigma * w
+        denom = np.sqrt(new_sq) / LR + 2 * l2
+        w = (np.clip(lin, -l1, l1) - lin) / denom
+        sq = new_sq
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=2e-6)
+
+
+def test_long_names_alias_short_names():
+    assert fluid.optimizer.SGDOptimizer is not None
+    for short, long in [('SGD', 'SGDOptimizer'), ('Momentum', 'MomentumOptimizer'),
+                        ('Adagrad', 'AdagradOptimizer'), ('Adam', 'AdamOptimizer'),
+                        ('Adamax', 'AdamaxOptimizer'),
+                        ('DecayedAdagrad', 'DecayedAdagradOptimizer'),
+                        ('RMSProp', 'RMSPropOptimizer'),
+                        ('Ftrl', 'FtrlOptimizer'),
+                        ('Adadelta', 'AdadeltaOptimizer')]:
+        assert getattr(fluid.optimizer, short) is getattr(fluid.optimizer, long)
+
+
+def test_model_average():
+    """ModelAverage.apply swaps in the running mean and restore puts the
+    trained params back (reference optimizer.py:ModelAverage)."""
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[4], dtype='float32')
+        w = layers.create_parameter(shape=[4, 1], dtype='float32')
+        loss = layers.reduce_sum(layers.matmul(x, w))
+        fluid.optimizer.SGD(learning_rate=LR).minimize(loss)
+        ma = fluid.optimizer.ModelAverage(average_window_rate=0.5)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        from paddle_tpu.fluid.executor import global_scope
+        import jax.numpy as jnp
+        global_scope().vars[w.name] = jnp.asarray(W0)
+        seen = []
+        for g in GRADS:
+            exe.run(main, feed={'x': g.T.copy()}, fetch_list=[loss])
+            ma.accumulate(exe)
+            seen.append(np.asarray(global_scope().vars[w.name]))
+        trained = np.asarray(global_scope().vars[w.name])
+        with ma.apply(exe):
+            avg = np.asarray(global_scope().vars[w.name])
+            np.testing.assert_allclose(avg, np.mean(seen, axis=0), rtol=1e-5)
+        restored = np.asarray(global_scope().vars[w.name])
+        np.testing.assert_allclose(restored, trained, rtol=1e-6)
+
+
+def test_regularization_l2():
+    """L2Decay adds lambda*w to the gradient before the update."""
+    lam = 0.01
+    got = _run_optimizer(fluid.optimizer.SGD(
+        learning_rate=LR, regularization=fluid.regularizer.L2Decay(lam)))
+    w = W0.copy()
+    for g in GRADS:
+        w = w - LR * (g + lam * w)
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=2e-6)
+
+
+def test_gradient_clip_by_global_norm():
+    clip_norm = 0.5
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[4], dtype='float32')
+        w = layers.create_parameter(shape=[4, 1], dtype='float32')
+        loss = layers.reduce_sum(layers.matmul(x, w))
+        fluid.clip.set_gradient_clip(
+            fluid.clip.GradientClipByGlobalNorm(clip_norm))
+        fluid.optimizer.SGD(learning_rate=LR).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        from paddle_tpu.fluid.executor import global_scope
+        import jax.numpy as jnp
+        global_scope().vars[w.name] = jnp.asarray(W0)
+        g = GRADS[0]
+        exe.run(main, feed={'x': g.T.copy()}, fetch_list=[loss])
+        got = np.asarray(global_scope().vars[w.name])
+    gnorm = np.sqrt(np.sum(g * g))
+    scaled = g * clip_norm / max(gnorm, clip_norm)
+    np.testing.assert_allclose(got, W0 - LR * scaled, rtol=1e-5)
