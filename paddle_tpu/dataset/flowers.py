@@ -1,28 +1,57 @@
 """Oxford-102 flowers. Parity: reference python/paddle/dataset/flowers.py
-(3x224x224 image, int label)."""
+(readers yield (3x224x224 float32 CHW image, int label); train applies the
+random-crop/flip augmentation, test/valid the center-crop path, optionally
+through the multiprocess-style xmap pipeline). Synthetic offline fallback:
+raw samples are deterministic uint8 HWC 'photos' sized like real inputs so
+the image.simple_transform augmentation is genuinely exercised."""
+import functools
+
 import numpy as np
-from . import common
+
+from . import common, image
+from .. import reader as paddle_reader
 
 __all__ = ['train', 'test', 'valid']
 
+_RAW_H, _RAW_W = 256, 320  # larger than crop so resize/crop paths do work
 
-def _reader(tag, n, use_xmap=True):
+
+def default_mapper(is_train, sample):
+    img, label = sample
+    img = image.simple_transform(
+        img, 256, 224, is_train, mean=[103.94, 116.78, 123.68])
+    return img.flatten().astype('float32'), label
+
+
+train_mapper = functools.partial(default_mapper, True)
+test_mapper = functools.partial(default_mapper, False)
+
+
+def _raw_reader(tag, n):
     def reader():
         rng = common.synthetic_rng('flowers_' + tag)
         for _ in range(n):
             label = int(rng.randint(0, 102))
-            img = rng.rand(3, 224, 224).astype('float32')
+            img = (rng.rand(_RAW_H, _RAW_W, 3) * 255).astype('uint8')
             yield img, label
     return reader
 
 
-def train(use_xmap=True, mapper=None, buffered_size=1024, cycle=False):
-    return _reader('train', 512)
+def _reader_creator(tag, n, mapper, use_xmap, buffered_size):
+    raw = _raw_reader(tag, n)
+    if use_xmap:
+        return paddle_reader.xmap_readers(mapper, raw, 4, buffered_size)
+    return paddle_reader.map_readers(mapper, raw)
 
 
-def test(use_xmap=True, mapper=None, buffered_size=1024, cycle=False):
-    return _reader('test', 64)
+def train(use_xmap=True, mapper=train_mapper, buffered_size=1024,
+          cycle=False):
+    return _reader_creator('train', 512, mapper, use_xmap, buffered_size)
 
 
-def valid(use_xmap=True, mapper=None, buffered_size=1024):
-    return _reader('valid', 64)
+def test(use_xmap=True, mapper=test_mapper, buffered_size=1024, cycle=False):
+    return _reader_creator('test', 64, mapper, use_xmap, buffered_size)
+
+
+def valid(use_xmap=True, mapper=test_mapper, buffered_size=1024):
+    return _reader_creator('valid', 64, mapper, use_xmap, buffered_size)

@@ -1,0 +1,195 @@
+"""Image loading + augmentation pipeline (resize / crop / flip / normalize).
+
+Parity: reference ``python/paddle/dataset/image.py`` (load_image,
+resize_short, to_chw, center_crop, random_crop, left_right_flip,
+simple_transform, load_and_transform, batch_images_from_tar). The reference
+is cv2-backed; this build decodes with PIL when present and performs all
+array transforms in pure vectorized numpy, so the augmentation path has no
+hard native-image dependency and a fixed output dtype/layout suitable for
+feeding the TPU input pipeline (CHW float32, optionally mean-subtracted).
+"""
+import os
+import tarfile
+
+import numpy as np
+
+try:  # decode-only dependency; array math below never needs it
+    from PIL import Image as _PILImage
+except Exception:  # pragma: no cover - PIL is present in this image
+    _PILImage = None
+
+__all__ = [
+    'load_image_bytes', 'load_image', 'resize_short', 'to_chw', 'center_crop',
+    'random_crop', 'left_right_flip', 'simple_transform', 'load_and_transform',
+    'batch_images_from_tar'
+]
+
+
+def _require_pil():
+    if _PILImage is None:
+        raise ImportError(
+            'PIL is required to decode image files; array-based transforms '
+            '(resize_short/center_crop/...) work without it.')
+
+
+def load_image_bytes(data, is_color=True):
+    """Decode an encoded image byte string to an HWC (color) or HW (gray)
+    uint8 ndarray."""
+    import io
+    _require_pil()
+    img = _PILImage.open(io.BytesIO(data))
+    img = img.convert('RGB' if is_color else 'L')
+    return np.asarray(img)
+
+
+def load_image(file, is_color=True):
+    """Load an image file into an HWC uint8 ndarray (HW when gray)."""
+    with open(file, 'rb') as f:
+        return load_image_bytes(f.read(), is_color=is_color)
+
+
+def _bilinear_resize(im, out_h, out_w):
+    """Vectorized numpy bilinear resize of an HW[C] array (align_corners
+    false / half-pixel centers, matching common image-library semantics)."""
+    h, w = im.shape[:2]
+    if (h, w) == (out_h, out_w):
+        return im
+    squeeze = im.ndim == 2
+    arr = im[:, :, None].astype(np.float32) if squeeze else im.astype(np.float32)
+
+    ys = (np.arange(out_h, dtype=np.float32) + 0.5) * (h / out_h) - 0.5
+    xs = (np.arange(out_w, dtype=np.float32) + 0.5) * (w / out_w) - 0.5
+    y0 = np.clip(np.floor(ys), 0, h - 1).astype(np.int64)
+    x0 = np.clip(np.floor(xs), 0, w - 1).astype(np.int64)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = np.clip(ys - y0, 0.0, 1.0)[:, None, None]
+    wx = np.clip(xs - x0, 0.0, 1.0)[None, :, None]
+
+    top = arr[y0][:, x0] * (1 - wx) + arr[y0][:, x1] * wx
+    bot = arr[y1][:, x0] * (1 - wx) + arr[y1][:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    if np.issubdtype(im.dtype, np.integer):
+        out = np.clip(np.rint(out), np.iinfo(im.dtype).min,
+                      np.iinfo(im.dtype).max)
+    out = out.astype(im.dtype)
+    return out[:, :, 0] if squeeze else out
+
+
+def resize_short(im, size):
+    """Resize so the shorter edge equals ``size``, preserving aspect."""
+    h, w = im.shape[:2]
+    if h > w:
+        out_h, out_w = int(round(h * size / float(w))), size
+    else:
+        out_h, out_w = size, int(round(w * size / float(h)))
+    return _bilinear_resize(im, out_h, out_w)
+
+
+def to_chw(im, order=(2, 0, 1)):
+    """Transpose an HWC image to CHW (or any given axis order)."""
+    assert len(im.shape) == len(order)
+    return im.transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    """Crop a ``size x size`` window from the image center."""
+    h, w = im.shape[:2]
+    h0 = (h - size) // 2
+    w0 = (w - size) // 2
+    if len(im.shape) == 3 and is_color:
+        return im[h0:h0 + size, w0:w0 + size, :]
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def random_crop(im, size, is_color=True):
+    """Crop a ``size x size`` window at a uniformly random offset."""
+    h, w = im.shape[:2]
+    h0 = np.random.randint(0, h - size + 1)
+    w0 = np.random.randint(0, w - size + 1)
+    if len(im.shape) == 3 and is_color:
+        return im[h0:h0 + size, w0:w0 + size, :]
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def left_right_flip(im, is_color=True):
+    """Mirror the image horizontally."""
+    if len(im.shape) == 3 and is_color:
+        return im[:, ::-1, :]
+    return im[:, ::-1]
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None):
+    """The standard train/eval augmentation: shorter-edge resize, then
+    random crop + 50% flip (train) or center crop (eval), CHW float32,
+    optional per-channel or elementwise mean subtraction."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, is_color=is_color)
+        if np.random.randint(2) == 0:
+            im = left_right_flip(im, is_color)
+    else:
+        im = center_crop(im, crop_size, is_color=is_color)
+    if len(im.shape) == 3:
+        im = to_chw(im)
+
+    im = im.astype('float32')
+    if mean is not None:
+        mean = np.array(mean, dtype=np.float32)
+        if mean.ndim == 1 and is_color:
+            mean = mean[:, np.newaxis, np.newaxis]
+        im -= mean
+    return im
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    """load_image + simple_transform in one call (reader mapper helper)."""
+    im = load_image(filename, is_color)
+    return simple_transform(im, resize_size, crop_size, is_train, is_color,
+                            mean)
+
+
+def batch_images_from_tar(data_file, dataset_name, img2label,
+                          num_per_batch=1024):
+    """Pre-decode a tar of images into .npz batch files + a meta list.
+
+    Reference writes pickled {data, label} blobs; here each batch is a
+    compressed npz (data: [N] object array of encoded bytes, label: [N]
+    int64) which round-trips without pickle. Returns the meta file path.
+    """
+    out_path = "%s/%s_%s" % (os.path.dirname(data_file), dataset_name, 'batch')
+    if os.path.exists(out_path):
+        return out_path + "/batch_file_list.txt"
+    os.makedirs(out_path)
+
+    tf = tarfile.open(data_file)
+    names = [n for n in tf.getnames() if n in img2label]
+    data, labels, file_id = [], [], 0
+    names_written = []
+
+    def _flush():
+        nonlocal data, labels, file_id
+        if not data:
+            return
+        fname = "%s/batch_%d.npz" % (out_path, file_id)
+        np.savez_compressed(
+            fname,
+            data=np.array(data, dtype=object),
+            label=np.array(labels, dtype=np.int64))
+        names_written.append(fname)
+        data, labels = [], []
+        file_id += 1
+
+    for name in names:
+        data.append(tf.extractfile(name).read())
+        labels.append(img2label[name])
+        if len(data) == num_per_batch:
+            _flush()
+    _flush()
+
+    meta = out_path + "/batch_file_list.txt"
+    with open(meta, 'w') as f:
+        f.write('\n'.join(names_written))
+    return meta

@@ -69,7 +69,9 @@ def _clip_by_norm(ins, attrs, ctx):
 def _broadcast_y(x, y, axis):
     """Fluid elementwise broadcast: align y's dims to x starting at `axis`
     (reference operators/elementwise_op_function.h)."""
-    if x.ndim == y.ndim:
+    if x.ndim <= y.ndim:
+        # same rank, or x is lower-rank (e.g. scalar op [1]-vector): plain
+        # numpy broadcasting applies and there is no trailing-dim alignment
         return y
     if axis == -1:
         axis = x.ndim - y.ndim

@@ -205,6 +205,9 @@ def _crop(ins, attrs, ctx):
     shape = attrs.get('shape')
     if 'Y' in ins and ins['Y']:
         shape = data_of(ins['Y'][0]).shape
+    # a -1 entry means "from the offset to the end of that dim"
+    shape = [x.shape[i] - offsets[i] if s == -1 else s
+             for i, s in enumerate(shape)]
     return {'Out': jax.lax.dynamic_slice(x, offsets, shape)}
 
 

@@ -1,0 +1,113 @@
+"""dataset.image augmentation pipeline (parity: reference
+python/paddle/dataset/image.py + tests/test_image.py behavior)."""
+import numpy as np
+
+from paddle_tpu.dataset import image, flowers
+
+
+def test_resize_short_aspect():
+    im = (np.random.rand(100, 200, 3) * 255).astype('uint8')
+    out = image.resize_short(im, 50)
+    assert out.shape == (50, 100, 3)
+    tall = image.resize_short(im.transpose(1, 0, 2), 50)
+    assert tall.shape == (100, 50, 3)
+    assert out.dtype == np.uint8
+
+
+def test_resize_identity_and_values():
+    im = np.arange(16, dtype='float32').reshape(4, 4)
+    assert np.array_equal(image.resize_short(im, 4), im)
+    # upscaling a constant image stays constant
+    const = np.full((10, 12, 3), 7, dtype='uint8')
+    assert (image.resize_short(const, 20) == 7).all()
+
+
+def test_crops_and_flip():
+    im = (np.random.rand(60, 80, 3) * 255).astype('uint8')
+    cc = image.center_crop(im, 32)
+    assert cc.shape == (32, 32, 3)
+    assert np.array_equal(cc, im[14:46, 24:56])
+    rc = image.random_crop(im, 32)
+    assert rc.shape == (32, 32, 3)
+    fl = image.left_right_flip(im)
+    assert np.array_equal(fl, im[:, ::-1, :])
+    gray = im[:, :, 0]
+    assert image.center_crop(gray, 32, is_color=False).shape == (32, 32)
+    assert np.array_equal(image.left_right_flip(gray, is_color=False),
+                          gray[:, ::-1])
+
+
+def test_to_chw():
+    im = np.random.rand(8, 9, 3).astype('float32')
+    assert image.to_chw(im).shape == (3, 8, 9)
+
+
+def test_simple_transform_train_and_eval():
+    im = (np.random.rand(300, 400, 3) * 255).astype('uint8')
+    tr = image.simple_transform(im, 256, 224, True,
+                                mean=[103.94, 116.78, 123.68])
+    assert tr.shape == (3, 224, 224) and tr.dtype == np.float32
+    ev = image.simple_transform(im, 256, 224, False, mean=127.5)
+    assert ev.shape == (3, 224, 224)
+    # eval path is deterministic
+    ev2 = image.simple_transform(im, 256, 224, False, mean=127.5)
+    assert np.array_equal(ev, ev2)
+    # per-channel mean actually subtracted
+    raw = image.simple_transform(im, 256, 224, False)
+    m = image.simple_transform(im, 256, 224, False, mean=[10., 20., 30.])
+    np.testing.assert_allclose(raw[0] - m[0], 10.0, atol=1e-5)
+    np.testing.assert_allclose(raw[2] - m[2], 30.0, atol=1e-5)
+
+
+def test_load_image_bytes_roundtrip(tmp_path):
+    import io
+    from PIL import Image as PILImage
+    arr = (np.random.rand(20, 30, 3) * 255).astype('uint8')
+    buf = io.BytesIO()
+    PILImage.fromarray(arr).save(buf, format='PNG')
+    out = image.load_image_bytes(buf.getvalue())
+    assert np.array_equal(out, arr)
+    p = tmp_path / 'x.png'
+    p.write_bytes(buf.getvalue())
+    assert np.array_equal(image.load_image(str(p)), arr)
+    gray = image.load_image(str(p), is_color=False)
+    assert gray.shape == (20, 30)
+
+
+def test_flowers_reader_feeds_augmented_samples():
+    r = flowers.train(use_xmap=False)
+    img, label = next(r())
+    assert img.shape == (3 * 224 * 224,) and img.dtype == np.float32
+    assert 0 <= label < 102
+    ev = flowers.test(use_xmap=True, buffered_size=8)
+    imgs = [s for _, s in zip(range(4), ev())]
+    assert all(i[0].shape == (3 * 224 * 224,) for i in imgs)
+
+
+def test_batch_images_from_tar(tmp_path):
+    import tarfile, io
+    from PIL import Image as PILImage
+    tar_path = tmp_path / 'data.tar'
+    img2label = {}
+    with tarfile.open(tar_path, 'w') as tf:
+        for i in range(5):
+            arr = (np.random.rand(8, 8, 3) * 255).astype('uint8')
+            buf = io.BytesIO()
+            PILImage.fromarray(arr).save(buf, format='PNG')
+            data = buf.getvalue()
+            info = tarfile.TarInfo('img_%d.png' % i)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+            img2label['img_%d.png' % i] = i % 3
+    meta = image.batch_images_from_tar(str(tar_path), 'toy', img2label,
+                                       num_per_batch=2)
+    files = open(meta).read().splitlines()
+    assert len(files) == 3  # 5 images, 2 per batch
+    total = 0
+    for f in files:
+        z = np.load(f, allow_pickle=True)
+        assert len(z['data']) == len(z['label'])
+        total += len(z['label'])
+        decoded = image.load_image_bytes(z['data'][0])
+        assert decoded.shape == (8, 8, 3)
+    assert total == 5
