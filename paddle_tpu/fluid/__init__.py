@@ -28,6 +28,10 @@ from .data_feeder import DataFeeder
 from .lod_tensor import LoDTensor, create_lod_tensor, create_random_int_lodtensor
 from . import unique_name
 from . import amp
+from . import concurrency
+from .concurrency import (Go, make_channel, channel_send, channel_recv,
+                          channel_close, Select)
+from . import contrib
 from . import profiler
 from . import debugger
 from .core import CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace

@@ -1,0 +1,7 @@
+"""fluid.contrib — parity with reference python/paddle/fluid/contrib
+(memory_usage_calc + decoder helper library)."""
+from . import decoder
+from . import memory_usage_calc
+from .memory_usage_calc import memory_usage
+
+__all__ = ['decoder', 'memory_usage_calc', 'memory_usage']

@@ -1,0 +1,55 @@
+"""Estimate a Program's device-memory footprint before running it.
+
+Parity: reference python/paddle/fluid/contrib/memory_usage_calc.py
+(memory_usage(program, batch_size) -> (lower, upper, unit)). On TPU this
+estimates the HBM working set from the Program's static var shapes — the
+useful pre-flight check before committing to a batch size, since XLA
+allocates the whole arena at compile time. The reference sums vars of the
+global block only; so do we (intermediate fusion temporaries are XLA's
+concern and typically net out below the var-sum on TPU because of fusion,
+hence the same 5-10% headroom band)."""
+from ..framework import Program
+
+__all__ = ['memory_usage']
+
+DEBUG = False
+
+dtype_to_size = {
+    'float16': 2, 'bfloat16': 2, 'float32': 4, 'float64': 8,
+    'int8': 1, 'int16': 2, 'int32': 4, 'int64': 8, 'uint8': 1, 'bool': 1,
+}
+
+
+def memory_usage(program, batch_size):
+    """Return (lower_bound, upper_bound, unit) estimated memory usage of
+    running `program` with the given batch size substituted for -1 dims."""
+    if not isinstance(program, Program):
+        raise TypeError(
+            "Calculating Memory Usage requires Program as its Parameter. "
+            "But you passed in %s" % type(program))
+    if batch_size <= 0:
+        raise ValueError("The batch size need to be positive.")
+
+    total_memory = 0.0
+    for var in program.global_block().vars.values():
+        shape = var.shape
+        if shape is None:
+            continue
+        data_count = 1
+        for x in shape:
+            data_count *= batch_size if x == -1 else x
+        var_memory = data_count * dtype_to_size.get(str(var.dtype), 4)
+        if DEBUG:
+            print("%s memory usage: %d" % (var.name, var_memory))
+        total_memory += var_memory
+
+    unit_str = "B"
+    if total_memory > 1024:
+        total_memory /= 1024
+        unit_str = "KB"
+        if total_memory > 1024:
+            total_memory /= 1024
+            unit_str = "MB"
+
+    # headroom band for runtime temporaries (5% - 10%)
+    return total_memory * 1.05, total_memory * 1.1, unit_str

@@ -459,4 +459,13 @@ class Executor(object):
         return out
 
     def close(self):
+        """Release compiled executables and drop cached jit state
+        (reference executor.py:close tears down the C++ scope/comm; here
+        the compiled-step cache holds the device buffers XLA pinned)."""
+        for step in self._cache.values():
+            fn = getattr(step, '_jitted', None)
+            if hasattr(fn, 'clear_cache'):
+                fn.clear_cache()
         self._cache.clear()
+        import gc
+        gc.collect()

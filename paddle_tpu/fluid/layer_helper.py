@@ -92,7 +92,11 @@ class LayerHelper(object):
         """Creates the Parameter in the main program's global block AND a
         same-named var + init op in the startup program (reference
         layer_helper.py:create_parameter)."""
-        assert isinstance(attr, ParamAttr)
+        assert isinstance(attr, ParamAttr), (
+            "expected a ParamAttr, got %r — note param_attr/bias_attr=False "
+            "suppresses the parameter only in layers that support it "
+            "(fc/conv bias via append_bias_op), matching the reference"
+            % (attr,))
         suffix = 'b' if is_bias else 'w'
         if attr.name is None:
             attr.name = unique_name.generate(".".join([self.name, suffix]))

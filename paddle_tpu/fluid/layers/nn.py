@@ -26,7 +26,7 @@ __all__ = [
     'softmax_with_cross_entropy', 'smooth_l1', 'one_hot',
     'autoincreased_step_counter', 'reshape', 'lod_reset', 'lrn', 'pad',
     'label_smooth', 'roi_pool', 'dice_loss', 'image_resize',
-    'image_resize_short', 'resize_bilinear', 'gather', 'scatter',
+    'image_resize_short', 'resize_bilinear', 'gather', 'scatter', 'expand',
     'random_crop', 'mean_iou', 'relu', 'log', 'crop', 'rank_loss', 'prelu',
     'flatten', 'sequence_mask', 'stack', 'fused_attention',
 ]
@@ -1037,6 +1037,17 @@ def gather(input, index):
     helper.append_op(type="gather",
                      inputs={"X": [input], "Index": [index]},
                      outputs={"Out": [out]})
+    return out
+
+
+def expand(x, expand_times, name=None):
+    """Tile each dim of x by expand_times (reference
+    operators/expand_op.cc; the Python layer landed just after v0.14)."""
+    helper = LayerHelper('expand', **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='expand', inputs={'X': [x]},
+                     outputs={'Out': [out]},
+                     attrs={'expand_times': list(expand_times)})
     return out
 
 

@@ -272,7 +272,10 @@ def infer_op_shapes(op):
             if val is None:
                 continue
             spec = val.data if isinstance(val, SeqValue) else val
-            shape = tuple(-1 if d == DYN_DIM else int(d) for d in spec.shape)
+            # DYN_DIM is prime, so any multiple of it can only have come
+            # from the dynamic batch dim (tiled/merged by expand/reshape)
+            shape = tuple(-1 if d % DYN_DIM == 0 and d > 0 else int(d)
+                          for d in spec.shape)
             var.shape = shape
             from . import core
             var.dtype = core.convert_dtype(spec.dtype)

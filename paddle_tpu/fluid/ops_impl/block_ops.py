@@ -96,6 +96,16 @@ def _array_length(ins, attrs, ctx):
     return {'Out': jnp.reshape(arr.length, (1,)).astype(jnp.int64)}
 
 
+@register('array_stack')
+def _array_stack(ins, attrs, ctx):
+    """Materialize a LoDTensorArray as one [capacity, ...] stacked tensor
+    (extension backing contrib's BeamSearchDecoder; the reference walks the
+    LoDTensorArray on the host instead). Slots never written are zeros —
+    size the array's capacity to the loop trip count."""
+    arr = ins['Array'][0]
+    return {'Out': arr.buffer}
+
+
 # ---------------------------------------------------------------------------
 # while
 # ---------------------------------------------------------------------------

@@ -9,7 +9,15 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..lowering import register, data_of, like, first_seq, amp_cast
+from ..lowering import register, data_of, like, first_seq, amp_cast, SeqValue
+
+
+def _seq_pad_mask(v):
+    """Broadcastable [batch, max_len, 1...] validity mask for a SeqValue."""
+    m = v.mask()
+    while m.ndim < v.data.ndim:
+        m = m[..., None]
+    return m
 
 
 def _unary(op_type, fn):
@@ -149,17 +157,46 @@ def _matmul(ins, attrs, ctx):
     return {'Out': out}
 
 
+def _reduce_pad_fill(op_type, dtype):
+    if op_type in ('reduce_sum', 'reduce_mean'):
+        return jnp.asarray(0, dtype)
+    if op_type == 'reduce_prod':
+        return jnp.asarray(1, dtype)
+    lo_hi = (jnp.iinfo(dtype) if jnp.issubdtype(dtype, jnp.integer)
+             else jnp.finfo(dtype))
+    return jnp.asarray(lo_hi.min if op_type == 'reduce_max' else lo_hi.max,
+                       dtype)
+
+
 def _reduce(op_type, fn):
     @register(op_type)
-    def rule(ins, attrs, ctx, _fn=fn):
-        x = data_of(ins['X'][0])
+    def rule(ins, attrs, ctx, _fn=fn, _op=op_type):
+        xv = ins['X'][0]
+        x = data_of(xv)
         dim = attrs.get('dim')
         keep = attrs.get('keep_dim', False)
         if attrs.get('reduce_all', False) or dim is None:
             axis = None
         else:
             axis = tuple(dim) if isinstance(dim, (list, tuple)) else (dim,)
-        return {'Out': _fn(x, axis=axis, keepdims=keep)}
+        # padded positions must not contaminate a reduction that crosses
+        # the time axis (axis 1 of the dense [B, T, ...] layout); a
+        # reduction over other axes keeps the sequence layout, where pads
+        # stay pads and must NOT be replaced by ±extremes
+        reduces_time = axis is None or any(a % x.ndim == 1 for a in axis)
+        if isinstance(xv, SeqValue) and reduces_time:
+            mask = _seq_pad_mask(xv)
+            x = jnp.where(mask > 0, x, _reduce_pad_fill(_op, x.dtype))
+            if _op == 'reduce_mean':
+                n = jnp.sum(jnp.broadcast_to(mask, x.shape).astype(x.dtype),
+                            axis=axis, keepdims=keep)
+                return {'Out': jnp.sum(x, axis=axis, keepdims=keep)
+                        / jnp.maximum(n, 1)}
+        out = _fn(x, axis=axis, keepdims=keep)
+        if isinstance(xv, SeqValue) and not reduces_time \
+                and out.ndim >= 2 and out.shape[:2] == x.shape[:2]:
+            return {'Out': like(xv, out)}   # still [B, T, ...]: keep lengths
+        return {'Out': out}
     return rule
 
 
@@ -172,7 +209,14 @@ _reduce('reduce_prod', jnp.prod)
 
 @register('mean')
 def _mean(ins, attrs, ctx):
-    return {'Out': jnp.mean(data_of(ins['X'][0]))}
+    xv = ins['X'][0]
+    x = data_of(xv)
+    if isinstance(xv, SeqValue):
+        # average over VALID tokens only (reference mean sees the flattened
+        # LoDTensor, which has no pad rows at all — lod_tensor.h)
+        mask = jnp.broadcast_to(_seq_pad_mask(xv), x.shape)
+        return {'Out': jnp.sum(x * mask) / jnp.maximum(jnp.sum(mask), 1)}
+    return {'Out': jnp.mean(x)}
 
 
 @register('sum')

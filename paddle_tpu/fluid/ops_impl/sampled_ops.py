@@ -127,7 +127,9 @@ def _beam_search(ins, attrs, ctx):
 
     flat_scores = cand_scores.reshape(B, beam * K)
     top_scores, top_pos = lax.top_k(flat_scores, beam)   # [B, beam]
-    parent = top_pos // K                                # beam index within B
+    # global flat row index into [B*beam]: directly gatherable for
+    # dense beam-state reordering (contrib BeamSearchDecoder)
+    parent = top_pos // K + jnp.arange(B)[:, None] * beam
     sel_ids = jnp.take_along_axis(cand_ids.reshape(B, beam * K), top_pos,
                                   axis=1)
     return {'selected_ids': sel_ids.reshape(Bb, 1).astype(jnp.int64),
@@ -246,7 +248,8 @@ def _beam_search_decode(ins, attrs, ctx):
     scores = data_of(ins['Scores'][0]).astype(jnp.float32)
     T, B, beam = ids.shape
     if ins.get('Parents'):
-        parents = data_of(ins['Parents'][0]).astype(jnp.int32)
+        # beam_search emits global [B*beam] rows; lineage here is per-source
+        parents = data_of(ins['Parents'][0]).astype(jnp.int32) % beam
     else:
         parents = jnp.broadcast_to(jnp.arange(beam)[None, None, :],
                                    (T, B, beam))

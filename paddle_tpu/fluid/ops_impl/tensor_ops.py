@@ -233,6 +233,13 @@ def _gather(ins, attrs, ctx):
     return {'Out': jnp.take(x, index, axis=0)}
 
 
+@register('expand')
+def _expand(ins, attrs, ctx):
+    """reference operators/expand_op.cc: tile each dim by expand_times."""
+    x = data_of(ins['X'][0])
+    return {'Out': jnp.tile(x, tuple(attrs['expand_times']))}
+
+
 @register('scatter')
 def _scatter(ins, attrs, ctx):
     x = data_of(ins['X'][0])

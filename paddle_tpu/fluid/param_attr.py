@@ -60,7 +60,9 @@ class ParamAttr(object):
         elif isinstance(arg, WeightDecayRegularizer):
             return ParamAttr(regularizer=arg)
         elif isinstance(arg, bool):
-            return ParamAttr.to_attr(None) if arg else ParamAttr(trainable=False)
+            # False suppresses the parameter entirely (reference
+            # param_attr.py:_to_attr returns False -> append_bias_op skips)
+            return ParamAttr.to_attr(None) if arg else False
         else:
             raise TypeError("cannot convert %r to ParamAttr" % (arg,))
 

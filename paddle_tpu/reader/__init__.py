@@ -168,18 +168,16 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
             workers.append(worker)
         for w in workers:
             w.start()
-        sample = out_queue.get()
-        finish = 1
-        while not isinstance(sample, XmapEndSignal):
-            yield sample
+        # drain until EVERY worker has signalled end — each worker enqueues
+        # all of its samples before its end signal, so counting all
+        # process_num ends guarantees no tail sample is dropped
+        finished = 0
+        while finished < process_num:
             sample = out_queue.get()
-            while isinstance(sample, XmapEndSignal):
-                finish += 1
-                if finish == process_num:
-                    break
-                sample = out_queue.get()
-            if finish == process_num:
-                break
+            if isinstance(sample, XmapEndSignal):
+                finished += 1
+            else:
+                yield sample
     return xreader
 
 

@@ -1,0 +1,47 @@
+"""End-to-end sentiment conv net (reference
+fluid/tests/book/test_understand_sentiment.py, convolution_net variant)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+
+from util import fresh_program
+
+
+def test_understand_sentiment_conv_converges():
+    with fresh_program() as (main, startup):
+        word_dict = paddle.dataset.imdb.word_dict()
+        CLASS_DIM, EMB_DIM, HID_DIM = 2, 32, 32
+        data = fluid.layers.data(name='words', shape=[1], dtype='int64',
+                                 lod_level=1)
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        emb = fluid.layers.embedding(input=data,
+                                     size=[len(word_dict), EMB_DIM])
+        conv_3 = fluid.nets.sequence_conv_pool(
+            input=emb, num_filters=HID_DIM, filter_size=3, act='tanh',
+            pool_type='sqrt')
+        conv_4 = fluid.nets.sequence_conv_pool(
+            input=emb, num_filters=HID_DIM, filter_size=4, act='tanh',
+            pool_type='sqrt')
+        prediction = fluid.layers.fc(input=[conv_3, conv_4], size=CLASS_DIM,
+                                     act='softmax')
+        cost = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=prediction, label=label))
+        acc = fluid.layers.accuracy(input=prediction, label=label)
+        fluid.optimizer.Adagrad(learning_rate=0.05).minimize(cost)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        feeder = fluid.DataFeeder(place=fluid.CPUPlace(),
+                                  feed_list=[data, label])
+        reader = paddle.batch(
+            paddle.dataset.imdb.train(word_dict), batch_size=64)
+        accs = []
+        for batch in reader():
+            _, a = exe.run(main, feed=feeder.feed(batch),
+                           fetch_list=[cost, acc])
+            accs.append(float(np.asarray(a).squeeze()))
+        # synthetic imdb is a separable word-pool task: late-training
+        # accuracy must clear chance by a wide margin
+        late = np.mean(accs[-5:])
+        assert late > 0.8, (accs[:3], late)

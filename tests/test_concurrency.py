@@ -1,0 +1,93 @@
+"""CSP channel/select primitives (parity: reference
+python/paddle/fluid/tests/notest_concurrency.py + concurrency.py API)."""
+import time
+
+import paddle_tpu.fluid as fluid
+
+
+def test_buffered_channel_send_recv():
+    ch = fluid.make_channel(dtype='int64', capacity=10)
+    for i in range(5):
+        assert fluid.channel_send(ch, i)
+    got = [fluid.channel_recv(ch)[0] for _ in range(5)]
+    assert got == [0, 1, 2, 3, 4]
+
+
+def test_channel_close_semantics():
+    ch = fluid.make_channel(dtype='int64', capacity=4)
+    fluid.channel_send(ch, 7)
+    fluid.channel_close(ch)
+    v, ok = fluid.channel_recv(ch)
+    assert ok and v == 7          # buffered values drain after close
+    v, ok = fluid.channel_recv(ch)
+    assert not ok and v is None   # then recv reports closed
+    assert not fluid.channel_send(ch, 1)
+
+
+def test_goroutine_pipeline_unbuffered():
+    """Producer goroutine -> unbuffered channel -> consumer (the reference's
+    fibonacci Go/channel demo shape)."""
+    ch = fluid.make_channel(dtype='int64')  # capacity 0: rendezvous
+    result = []
+
+    def producer():
+        a, b = 0, 1
+        for _ in range(10):
+            fluid.channel_send(ch, a)
+            a, b = b, a + b
+        fluid.channel_close(ch)
+
+    with fluid.Go() as g:
+        g.run(producer)
+        while True:
+            v, ok = fluid.channel_recv(ch)
+            if not ok:
+                break
+            result.append(v)
+        g.join(timeout=5)
+    assert result == [0, 1, 1, 2, 3, 5, 8, 13, 21, 34]
+
+
+def test_select_recv_and_default():
+    a = fluid.make_channel(dtype='int64', capacity=1)
+    b = fluid.make_channel(dtype='int64', capacity=1)
+    got = {}
+    sel = fluid.Select()
+    sel.case(a, 'recv', lambda v: got.setdefault('a', v))
+    sel.case(b, 'recv', lambda v: got.setdefault('b', v))
+    fluid.channel_send(b, 99)
+    idx = sel(timeout=5)
+    assert idx == 1 and got == {'b': 99}
+
+    empty = fluid.Select()
+    empty.case(a, 'recv', lambda v: None)
+    empty.default(lambda: got.setdefault('idle', True))
+    assert empty() == -1 and got.get('idle')
+
+
+def test_select_send_case():
+    ch = fluid.make_channel(dtype='int64', capacity=1)
+    fired = []
+    sel = fluid.Select()
+    sel.case(ch, 'send', 5, lambda: fired.append(True))
+    assert sel(timeout=5) == 0
+    assert fired == [True]
+    assert fluid.channel_recv(ch) == (5, True)
+
+
+def test_executor_close_and_reuse():
+    import numpy as np
+    from paddle_tpu.fluid import layers
+    from util import fresh_program
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[4], dtype='float32')
+        y = layers.scale(x, scale=3.0)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        xs = np.ones((2, 4), 'float32')
+        out1, = exe.run(main, feed={'x': xs}, fetch_list=[y])
+        exe.close()
+        assert not exe._cache
+        # run after close recompiles transparently
+        out2, = exe.run(main, feed={'x': xs}, fetch_list=[y])
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2))

@@ -1,0 +1,134 @@
+"""LoDTensor semantics (parity: reference python/paddle/fluid/lod_tensor.py
++ tests/unittests/test_lod_tensor.py): lengths<->offsets, validation,
+SeqValue round-trip, and feeding LoD data through the Executor."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.lod_tensor import (LoDTensor, create_lod_tensor,
+                                         create_random_int_lodtensor)
+
+from util import fresh_program
+
+
+def test_lengths_offsets_roundtrip():
+    t = LoDTensor(np.zeros((6, 2), 'float32'), [[2, 1, 3]])
+    assert t.recursive_sequence_lengths() == [[2, 1, 3]]
+    assert t.lod() == [[0, 2, 3, 6]]
+    t.set_lod([[0, 1, 4, 6]])
+    assert t.recursive_sequence_lengths() == [[1, 3, 2]]
+
+
+def test_validity_check():
+    good = LoDTensor(np.zeros((6, 1)), [[2, 4]])
+    assert good.has_valid_recursive_sequence_lengths()
+    bad = LoDTensor(np.zeros((6, 1)), [[2, 5]])
+    assert not bad.has_valid_recursive_sequence_lengths()
+    with pytest.raises(ValueError):
+        create_lod_tensor(np.zeros((6, 1)), [[2, 5]])
+
+
+def test_create_lod_tensor_from_list():
+    t = create_lod_tensor([[1, 2, 3], [4], [5, 6]], None)
+    assert t.recursive_sequence_lengths() == [[3, 1, 2]]
+    assert t.data.shape == (6, 1)
+    np.testing.assert_array_equal(t.data.squeeze(-1), [1, 2, 3, 4, 5, 6])
+
+
+def test_create_random_int_lodtensor():
+    t = create_random_int_lodtensor([[2, 3]], base_shape=[1], place=None,
+                                    low=0, high=9)
+    assert t.data.shape == (5, 1)
+    assert t.data.dtype == np.int64
+    assert (t.data >= 0).all() and (t.data <= 9).all()
+
+
+def test_seq_value_roundtrip_level1():
+    t = create_lod_tensor(np.arange(12, dtype='float32').reshape(6, 2),
+                          [[2, 1, 3]])
+    sv = t.to_seq_value()
+    assert sv.data.shape == (3, 3, 2)          # [batch, max_len, d]
+    assert list(np.asarray(sv.lengths)) == [2, 1, 3]
+    # pads are zero
+    assert float(np.asarray(sv.data)[1, 1:].sum()) == 0.0
+    back = LoDTensor.from_seq_value(sv)
+    np.testing.assert_array_equal(back.data, t.data)
+    assert back.recursive_sequence_lengths() == [[2, 1, 3]]
+
+
+def test_seq_value_roundtrip_level2():
+    # 2 'documents' of 2 and 1 sentences; 3 sentences total
+    t = create_lod_tensor(np.arange(8, dtype='float32').reshape(8, 1),
+                          [[2, 1], [3, 2, 3]])
+    sv = t.to_seq_value()
+    assert sv.outer_lengths is not None
+    assert list(np.asarray(sv.outer_lengths)) == [2, 1]
+    back = LoDTensor.from_seq_value(sv)
+    np.testing.assert_array_equal(back.data, t.data)
+    assert back.recursive_sequence_lengths() == [[2, 1], [3, 2, 3]]
+
+
+def test_executor_feed_lod_tensor_sequence_pool():
+    """Feeding a LoDTensor runs masked sequence ops with true lengths."""
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[1], dtype='float32', lod_level=1)
+        pooled = layers.sequence_pool(input=x, pool_type='sum')
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        t = create_lod_tensor(
+            np.array([[1.], [2.], [3.], [10.], [20.]], 'float32'),
+            [[3, 2]])
+        out, = exe.run(main, feed={'x': t}, fetch_list=[pooled])
+    np.testing.assert_allclose(np.asarray(out).squeeze(-1), [6., 30.])
+
+
+def test_executor_feed_lod_tensor_mean_ignores_pads():
+    """mean over a sequence var averages valid tokens only (the padded
+    layout must not leak pad garbage into losses)."""
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[1], dtype='float32', lod_level=1)
+        m = layers.mean(x)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        t = create_lod_tensor(
+            np.array([[3.], [5.], [100.]], 'float32'), [[2, 1]])
+        out, = exe.run(main, feed={'x': t}, fetch_list=[m])
+    np.testing.assert_allclose(float(np.asarray(out).squeeze()), 36.0)
+
+
+def test_reduce_on_seq_var_time_vs_feature_axis():
+    """Reductions crossing the time axis mask pads; reductions over other
+    axes keep the sequence layout without poisoning pads with ±inf."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.fluid.lowering import SeqValue, get_rule, Ctx
+    ctx = Ctx(jax.random.key(0))
+    sv = SeqValue(jnp.ones((2, 3, 4)), jnp.asarray([3, 1], jnp.int32))
+    # over last dim: stays a sequence, finite everywhere
+    out = get_rule('reduce_max')({'X': [sv]}, {'dim': [-1]}, ctx)['Out']
+    assert isinstance(out, SeqValue)
+    assert np.isfinite(np.asarray(out.data)).all()
+    # over everything: pads excluded (here all data is 1.0)
+    tot = get_rule('reduce_sum')({'X': [sv]}, {}, ctx)['Out']
+    assert float(np.asarray(tot)) == (3 + 1) * 4
+    # integer dtype must not overflow on min/max fill
+    iv = SeqValue(jnp.full((2, 3), 5, jnp.int32), jnp.asarray([3, 1],
+                                                              jnp.int32))
+    assert int(np.asarray(get_rule('reduce_max')({'X': [iv]}, {},
+                                                 ctx)['Out'])) == 5
+    assert int(np.asarray(get_rule('reduce_min')({'X': [iv]}, {},
+                                                 ctx)['Out'])) == 5
+
+
+def test_fetch_lod_output_returns_unpadded():
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[2], dtype='float32', lod_level=1)
+        y = layers.scale(x, scale=2.0)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        t = create_lod_tensor(np.ones((5, 2), 'float32'), [[2, 3]])
+        out, = exe.run(main, feed={'x': t}, fetch_list=[y])
+    # flattened [total_tokens, d] like the reference LoDTensor
+    assert np.asarray(out).shape == (5, 2)
+    np.testing.assert_allclose(np.asarray(out), 2.0)

@@ -1,0 +1,277 @@
+"""Numeric forward + gradient checks for the conv/pool/norm op families
+against torch-cpu (parity with reference tests/unittests/test_conv2d_op.py,
+test_pool2d_op.py, test_batch_norm_op.py, ... which check against their own
+numpy refs; torch is an independent oracle here)."""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import jax.numpy as jnp
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.backward import append_backward
+from paddle_tpu.fluid.executor import global_scope
+
+from util import fresh_program
+
+RTOL = 2e-4
+ATOL = 2e-4
+
+
+def _run_with_weights(build, feed=None, fetch_extra=(), weight_map=None):
+    """Build a program, overwrite weights, run, return fetches as numpy.
+
+    `build` receives no args and returns the output var(s); inputs that
+    need gradients should be created with layers.create_parameter (grads
+    exist only for Parameters — data vars are stop_gradient like the
+    reference) and their values passed via weight_map.
+    """
+    with fresh_program() as (main, startup):
+        outs = build()
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        loss = layers.reduce_sum(outs[0])
+        append_backward(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope = global_scope()
+        if weight_map:
+            for pat, w in weight_map.items():
+                names = [n for n in scope.vars if pat in n]
+                assert names, (pat, list(scope.vars))
+                scope.vars[names[0]] = jnp.asarray(w)
+        res = exe.run(main, feed=feed or {},
+                      fetch_list=list(outs) + [loss] + list(fetch_extra))
+    return [np.asarray(r) for r in res]
+
+
+def _param_input(name, value):
+    return layers.create_parameter(shape=list(value.shape), dtype='float32',
+                                   name=name)
+
+
+# ---------------------------------------------------------------------------
+# conv family
+# ---------------------------------------------------------------------------
+
+def test_conv2d_forward_and_grads_vs_torch():
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 3, 8, 8).astype('float32')
+    w = (rng.rand(4, 3, 3, 3) * 0.2 - 0.1).astype('float32')
+
+    def build():
+        xv = _param_input('xin', x)
+        return layers.conv2d(xv, num_filters=4, filter_size=3, stride=2,
+                             padding=1, bias_attr=False)
+    out, _, gx, gw = _run_with_weights(
+        build, fetch_extra=['xin@GRAD', 'conv2d_0.w_0@GRAD'],
+        weight_map={'xin': x, 'conv2d_0.w_0': w})
+
+    tx = torch.tensor(x, requires_grad=True)
+    tw = torch.tensor(w, requires_grad=True)
+    ty = F.conv2d(tx, tw, stride=2, padding=1)
+    ty.sum().backward()
+    np.testing.assert_allclose(out, ty.detach().numpy(), rtol=RTOL, atol=ATOL)
+    np.testing.assert_allclose(gx, tx.grad.numpy(), rtol=RTOL, atol=ATOL)
+    np.testing.assert_allclose(gw, tw.grad.numpy(), rtol=RTOL, atol=ATOL)
+
+
+def test_conv2d_groups_dilation_vs_torch():
+    rng = np.random.RandomState(1)
+    x = rng.rand(1, 4, 9, 9).astype('float32')
+    w = (rng.rand(6, 2, 3, 3) * 0.2 - 0.1).astype('float32')  # groups=2
+
+    def build():
+        xv = layers.data(name='x', shape=[4, 9, 9], dtype='float32')
+        return layers.conv2d(xv, num_filters=6, filter_size=3, groups=2,
+                             dilation=2, bias_attr=False)
+    out, _, gw = _run_with_weights(
+        build, {'x': x}, fetch_extra=['conv2d_0.w_0@GRAD'],
+        weight_map={'conv2d_0.w_0': w})
+    tx = torch.tensor(x)
+    tw = torch.tensor(w, requires_grad=True)
+    ty = F.conv2d(tx, tw, groups=2, dilation=2)
+    ty.sum().backward()
+    np.testing.assert_allclose(out, ty.detach().numpy(), rtol=RTOL, atol=ATOL)
+    np.testing.assert_allclose(gw, tw.grad.numpy(), rtol=RTOL, atol=ATOL)
+
+
+def test_conv3d_forward_vs_torch():
+    rng = np.random.RandomState(2)
+    x = rng.rand(1, 2, 5, 6, 6).astype('float32')
+    w = (rng.rand(3, 2, 3, 3, 3) * 0.2 - 0.1).astype('float32')
+
+    def build():
+        xv = layers.data(name='x', shape=[2, 5, 6, 6], dtype='float32')
+        return layers.conv3d(xv, num_filters=3, filter_size=3, padding=1,
+                             bias_attr=False)
+    out = _run_with_weights(build, {'x': x},
+                            weight_map={'conv3d_0.w_0': w})[0]
+    ty = F.conv3d(torch.tensor(x), torch.tensor(w), padding=1)
+    np.testing.assert_allclose(out, ty.numpy(), rtol=RTOL, atol=ATOL)
+
+
+def test_conv2d_transpose_forward_and_grad_vs_torch():
+    rng = np.random.RandomState(3)
+    x = rng.rand(2, 3, 5, 5).astype('float32')
+    w = (rng.rand(3, 4, 3, 3) * 0.2 - 0.1).astype('float32')  # [in, out, kh, kw]
+
+    def build():
+        xv = _param_input('xin', x)
+        return layers.conv2d_transpose(xv, num_filters=4, filter_size=3,
+                                       stride=2, padding=1, bias_attr=False)
+    out, _, gx = _run_with_weights(
+        build, fetch_extra=['xin@GRAD'],
+        weight_map={'xin': x, 'conv2d_transpose_0.w_0': w})
+    tx = torch.tensor(x, requires_grad=True)
+    ty = F.conv_transpose2d(tx, torch.tensor(w), stride=2, padding=1)
+    ty.sum().backward()
+    np.testing.assert_allclose(out, ty.detach().numpy(), rtol=RTOL, atol=ATOL)
+    np.testing.assert_allclose(gx, tx.grad.numpy(), rtol=RTOL, atol=ATOL)
+
+
+def test_conv3d_transpose_forward_vs_torch():
+    rng = np.random.RandomState(4)
+    x = rng.rand(1, 2, 4, 4, 4).astype('float32')
+    w = (rng.rand(2, 3, 3, 3, 3) * 0.2 - 0.1).astype('float32')
+
+    def build():
+        xv = layers.data(name='x', shape=[2, 4, 4, 4], dtype='float32')
+        return layers.conv3d_transpose(xv, num_filters=3, filter_size=3,
+                                       stride=1, padding=0, bias_attr=False)
+    out = _run_with_weights(build, {'x': x},
+                            weight_map={'conv3d_transpose_0.w_0': w})[0]
+    ty = F.conv_transpose3d(torch.tensor(x), torch.tensor(w))
+    np.testing.assert_allclose(out, ty.numpy(), rtol=RTOL, atol=ATOL)
+
+
+# ---------------------------------------------------------------------------
+# pool family
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize('ptype', ['max', 'avg'])
+def test_pool2d_forward_and_grad_vs_torch(ptype):
+    rng = np.random.RandomState(5)
+    x = rng.rand(2, 3, 8, 8).astype('float32')
+
+    def build():
+        xv = _param_input('xin', x)
+        return layers.pool2d(xv, pool_size=2, pool_type=ptype, pool_stride=2)
+    out, _, gx = _run_with_weights(build, fetch_extra=['xin@GRAD'],
+                                   weight_map={'xin': x})
+    tx = torch.tensor(x, requires_grad=True)
+    ty = (F.max_pool2d(tx, 2, 2) if ptype == 'max'
+          else F.avg_pool2d(tx, 2, 2))
+    ty.sum().backward()
+    np.testing.assert_allclose(out, ty.detach().numpy(), rtol=RTOL, atol=ATOL)
+    np.testing.assert_allclose(gx, tx.grad.numpy(), rtol=RTOL, atol=ATOL)
+
+
+def test_pool2d_padding_and_global():
+    rng = np.random.RandomState(6)
+    x = rng.rand(1, 2, 6, 6).astype('float32')
+
+    def build():
+        xv = layers.data(name='x', shape=[2, 6, 6], dtype='float32')
+        a = layers.pool2d(xv, pool_size=3, pool_type='avg', pool_stride=3,
+                          pool_padding=0)
+        g = layers.pool2d(xv, pool_size=1, pool_type='max',
+                          global_pooling=True)
+        return [a, g]
+    with fresh_program() as (main, startup):
+        xv = layers.data(name='x', shape=[2, 6, 6], dtype='float32')
+        a = layers.pool2d(xv, pool_size=3, pool_type='avg', pool_stride=3)
+        g = layers.pool2d(xv, pool_size=1, pool_type='max',
+                          global_pooling=True)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        ra, rg = exe.run(main, feed={'x': x}, fetch_list=[a, g])
+    np.testing.assert_allclose(np.asarray(ra),
+                               F.avg_pool2d(torch.tensor(x), 3, 3).numpy(),
+                               rtol=RTOL, atol=ATOL)
+    np.testing.assert_allclose(np.asarray(rg).reshape(1, 2),
+                               x.max(axis=(2, 3)), rtol=RTOL, atol=ATOL)
+
+
+def test_pool3d_forward_vs_torch():
+    rng = np.random.RandomState(7)
+    x = rng.rand(1, 2, 4, 6, 6).astype('float32')
+    with fresh_program() as (main, startup):
+        xv = layers.data(name='x', shape=[2, 4, 6, 6], dtype='float32')
+        y = layers.pool3d(xv, pool_size=2, pool_type='max', pool_stride=2)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        out, = exe.run(main, feed={'x': x}, fetch_list=[y])
+    np.testing.assert_allclose(np.asarray(out),
+                               F.max_pool3d(torch.tensor(x), 2, 2).numpy(),
+                               rtol=RTOL, atol=ATOL)
+
+
+# ---------------------------------------------------------------------------
+# norm family
+# ---------------------------------------------------------------------------
+
+def test_batch_norm_train_stats_vs_torch():
+    rng = np.random.RandomState(8)
+    x = rng.rand(4, 3, 5, 5).astype('float32')
+    with fresh_program() as (main, startup):
+        xv = layers.data(name='x', shape=[3, 5, 5], dtype='float32')
+        y = layers.batch_norm(xv, epsilon=1e-5, momentum=0.9,
+                              moving_mean_name='mm', moving_variance_name='mv')
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        out, = exe.run(main, feed={'x': x}, fetch_list=[y])
+        scope = global_scope()
+        mm = np.asarray(scope.vars['mm'])
+        mv = np.asarray(scope.vars['mv'])
+    tb = torch.nn.BatchNorm2d(3, eps=1e-5, momentum=0.1)
+    tb.train()
+    ty = tb(torch.tensor(x))
+    np.testing.assert_allclose(out, ty.detach().numpy(), rtol=1e-3, atol=1e-3)
+    # running stats: ours new = old*momentum + batch*(1-momentum); torch
+    # running_mean uses the same update with its momentum=1-ours
+    np.testing.assert_allclose(mm, tb.running_mean.numpy(), rtol=1e-3,
+                               atol=1e-4)
+
+
+def test_batch_norm_grad_vs_torch():
+    rng = np.random.RandomState(9)
+    x = rng.rand(4, 3, 4, 4).astype('float32')
+    with fresh_program() as (main, startup):
+        xv = layers.create_parameter(shape=[4, 3, 4, 4], dtype='float32',
+                                     name='xin')
+        y = layers.batch_norm(xv)
+        loss = layers.reduce_sum(layers.square(y))
+        append_backward(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        global_scope().vars['xin'] = jnp.asarray(x)
+        gx, = exe.run(main, feed={}, fetch_list=['xin@GRAD'])
+    tx = torch.tensor(x, requires_grad=True)
+    tb = torch.nn.BatchNorm2d(3)
+    (tb(tx) ** 2).sum().backward()
+    np.testing.assert_allclose(np.asarray(gx), tx.grad.numpy(), rtol=1e-3,
+                               atol=1e-3)
+
+
+def test_layer_norm_forward_and_grad_vs_torch():
+    rng = np.random.RandomState(10)
+    x = rng.rand(4, 12).astype('float32')
+    with fresh_program() as (main, startup):
+        xv = layers.create_parameter(shape=[4, 12], dtype='float32',
+                                     name='xin')
+        y = layers.layer_norm(xv, begin_norm_axis=1)
+        loss = layers.reduce_sum(layers.square(y))
+        append_backward(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        global_scope().vars['xin'] = jnp.asarray(x)
+        out, gx = exe.run(main, feed={}, fetch_list=[y, 'xin@GRAD'])
+    tx = torch.tensor(x, requires_grad=True)
+    tl = torch.nn.LayerNorm(12)
+    ty = tl(tx)
+    (ty ** 2).sum().backward()
+    np.testing.assert_allclose(np.asarray(out), ty.detach().numpy(),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(gx), tx.grad.numpy(), rtol=1e-3,
+                               atol=1e-3)

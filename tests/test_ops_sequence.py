@@ -1,0 +1,320 @@
+"""Numeric forward + gradient checks for the sequence op family against
+independent numpy references (parity: reference
+tests/unittests/test_seq_pool.py, test_sequence_softmax_op.py,
+test_sequence_expand.py, test_sequence_conv.py, test_row_conv_op.py,
+test_gru_op.py, test_lstm_op.py)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.backward import append_backward
+from paddle_tpu.fluid.executor import global_scope
+
+from util import fresh_program
+
+LENS = [3, 1, 4]
+D = 2
+
+
+def _lod_feed(rng, d=D, lens=LENS):
+    total = sum(lens)
+    data = rng.rand(total, d).astype('float32')
+    return fluid.create_lod_tensor(data, [list(lens)]), data
+
+
+def _split(data, lens=LENS):
+    out, off = [], 0
+    for l in lens:
+        out.append(data[off:off + l])
+        off += l
+    return out
+
+
+def _run(build, feed):
+    with fresh_program() as (main, startup):
+        outs = build()
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        res = exe.run(main, feed=feed, fetch_list=list(outs))
+    return [np.asarray(r) for r in res]
+
+
+# ---------------------------------------------------------------------------
+# pooling / softmax / expand / first / last
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize('ptype,ref', [
+    ('sum', lambda s: s.sum(0)),
+    ('average', lambda s: s.mean(0)),
+    ('sqrt', lambda s: s.sum(0) / np.sqrt(len(s))),
+    ('max', lambda s: s.max(0)),
+    ('first', lambda s: s[0]),
+    ('last', lambda s: s[-1]),
+])
+def test_sequence_pool_types(ptype, ref):
+    rng = np.random.RandomState(0)
+    t, data = _lod_feed(rng)
+
+    def build():
+        x = layers.data(name='x', shape=[D], dtype='float32', lod_level=1)
+        return layers.sequence_pool(input=x, pool_type=ptype)
+    out, = _run(build, {'x': t})
+    expect = np.stack([ref(s) for s in _split(data)])
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_sequence_first_last_step():
+    rng = np.random.RandomState(1)
+    t, data = _lod_feed(rng)
+
+    def build():
+        x = layers.data(name='x', shape=[D], dtype='float32', lod_level=1)
+        return [layers.sequence_first_step(input=x),
+                layers.sequence_last_step(input=x)]
+    first, last = _run(build, {'x': t})
+    np.testing.assert_allclose(first, np.stack([s[0] for s in _split(data)]),
+                               rtol=1e-6)
+    np.testing.assert_allclose(last, np.stack([s[-1] for s in _split(data)]),
+                               rtol=1e-6)
+
+
+def test_sequence_softmax():
+    rng = np.random.RandomState(2)
+    total = sum(LENS)
+    data = rng.rand(total, 1).astype('float32')
+    t = fluid.create_lod_tensor(data, [list(LENS)])
+
+    def build():
+        x = layers.data(name='x', shape=[1], dtype='float32', lod_level=1)
+        return layers.sequence_softmax(input=x)
+    out, = _run(build, {'x': t})
+    ref = []
+    for s in _split(data):
+        e = np.exp(s - s.max())
+        ref.append(e / e.sum())
+    np.testing.assert_allclose(out, np.concatenate(ref), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_sequence_expand_rows():
+    rng = np.random.RandomState(3)
+    x_data = rng.rand(3, D).astype('float32')           # one row per seq
+    y_t, _ = _lod_feed(rng)
+
+    def build():
+        x = layers.data(name='xrow', shape=[D], dtype='float32')
+        y = layers.data(name='y', shape=[D], dtype='float32', lod_level=1)
+        return layers.sequence_expand(x=x, y=y)
+    out, = _run(build, {'xrow': x_data, 'y': y_t})
+    expect = np.concatenate(
+        [np.repeat(x_data[i:i + 1], l, axis=0) for i, l in enumerate(LENS)])
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+
+def test_sequence_reshape_and_mask():
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[2], dtype='float32', lod_level=1)
+        m = layers.sequence_mask(
+            layers.data(name='lens', shape=[1], dtype='int64'), maxlen=5)
+        r = layers.sequence_reshape(input=x, new_dim=4)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        t = fluid.create_lod_tensor(
+            np.arange(12, dtype='float32').reshape(6, 2), [[2, 4]])
+        mv, rv = exe.run(main, feed={
+            'x': t, 'lens': np.array([[2], [4]], 'int64')},
+            fetch_list=[m, r])
+    mv = np.asarray(mv)
+    np.testing.assert_array_equal(
+        mv.reshape(2, 5), [[1, 1, 0, 0, 0], [1, 1, 1, 1, 0]])
+    # 2 cols -> 4 cols halves each sequence's steps
+    rv = np.asarray(rv)
+    assert rv.shape[-1] == 4
+
+
+# ---------------------------------------------------------------------------
+# context convs
+# ---------------------------------------------------------------------------
+
+def test_sequence_conv_numeric():
+    rng = np.random.RandomState(4)
+    t, data = _lod_feed(rng)
+    n_filt, clen = 3, 3
+    w = (rng.rand(clen * D, n_filt) - 0.5).astype('float32')
+
+    def conv_ref(seq):
+        T = len(seq)
+        out = np.zeros((T, n_filt), 'float32')
+        for i in range(T):
+            ctx = []
+            for off in range(-(clen - 1) // 2, (clen - 1) // 2 + 1):
+                j = i + off
+                ctx.append(seq[j] if 0 <= j < T else np.zeros(D, 'float32'))
+            out[i] = np.concatenate(ctx) @ w
+        return out
+
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[D], dtype='float32', lod_level=1)
+        y = layers.sequence_conv(input=x, num_filters=n_filt,
+                                 filter_size=clen, bias_attr=False)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope = global_scope()
+        wname = [n for n in scope.vars if 'sequence_conv' in n][0]
+        scope.vars[wname] = jnp.asarray(w)
+        out, = exe.run(main, feed={'x': t}, fetch_list=[y])
+    expect = np.concatenate([conv_ref(s) for s in _split(data)])
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-4, atol=1e-5)
+
+
+def test_row_conv_numeric():
+    rng = np.random.RandomState(5)
+    t, data = _lod_feed(rng)
+    k = 2  # future context
+    w = (rng.rand(k + 1, D) - 0.5).astype('float32')
+
+    def ref(seq):
+        T = len(seq)
+        out = np.zeros_like(seq)
+        for i in range(T):
+            for j in range(k + 1):
+                if i + j < T:
+                    out[i] += w[j] * seq[i + j]
+        return out
+
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[D], dtype='float32', lod_level=1)
+        y = layers.row_conv(input=x, future_context_size=k)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope = global_scope()
+        wname = [n for n in scope.vars if 'row_conv' in n][0]
+        scope.vars[wname] = jnp.asarray(w)
+        out, = exe.run(main, feed={'x': t}, fetch_list=[y])
+    expect = np.concatenate([ref(s) for s in _split(data)])
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# recurrent: gru / lstm numerics vs independent numpy scans
+# ---------------------------------------------------------------------------
+
+def _sigmoid(v):
+    return 1.0 / (1.0 + np.exp(-v))
+
+
+def test_dynamic_gru_numeric():
+    rng = np.random.RandomState(6)
+    DH = 3
+    lens = [2, 4]
+    total = sum(lens)
+    xin = (rng.rand(total, 3 * DH) - 0.5).astype('float32')
+    t = fluid.create_lod_tensor(xin, [lens])
+    w = (rng.rand(DH, 3 * DH) - 0.5).astype('float32')
+
+    def gru_ref(seq):
+        h = np.zeros(DH, 'float32')
+        out = []
+        for x_t in seq:
+            g = x_t[:2 * DH] + h @ w[:, :2 * DH]
+            u = _sigmoid(g[:DH])
+            r = _sigmoid(g[DH:])
+            c = np.tanh(x_t[2 * DH:] + (r * h) @ w[:, 2 * DH:])
+            h = u * h + (1 - u) * c
+            out.append(h.copy())
+        return np.stack(out)
+
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[3 * DH], dtype='float32',
+                        lod_level=1)
+        y = layers.dynamic_gru(input=x, size=DH)   # bias default-init to 0
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope = global_scope()
+        wname = [n for n in scope.vars if 'gru' in n and '.w_' in n][0]
+        scope.vars[wname] = jnp.asarray(w)
+        out, = exe.run(main, feed={'x': t}, fetch_list=[y])
+    off = 0
+    expect = []
+    for l in lens:
+        expect.append(gru_ref(xin[off:off + l]))
+        off += l
+    np.testing.assert_allclose(np.asarray(out), np.concatenate(expect),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_dynamic_lstm_numeric_no_peepholes():
+    rng = np.random.RandomState(7)
+    DH = 3
+    lens = [3, 2]
+    total = sum(lens)
+    xin = (rng.rand(total, 4 * DH) - 0.5).astype('float32')
+    t = fluid.create_lod_tensor(xin, [lens])
+    w = (rng.rand(DH, 4 * DH) - 0.5).astype('float32')
+
+    def lstm_ref(seq):
+        h = np.zeros(DH, 'float32')
+        c = np.zeros(DH, 'float32')
+        out = []
+        for x_t in seq:
+            g = x_t + h @ w
+            gi, gf, gc, go = np.split(g, 4)
+            i, f, o = _sigmoid(gi), _sigmoid(gf), _sigmoid(go)
+            cand = np.tanh(gc)
+            c = f * c + i * cand
+            h = o * np.tanh(c)
+            out.append(h.copy())
+        return np.stack(out)
+
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[4 * DH], dtype='float32',
+                        lod_level=1)
+        h, _ = layers.dynamic_lstm(input=x, size=4 * DH,
+                                   use_peepholes=False)  # zero-init bias
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope = global_scope()
+        wname = [n for n in scope.vars if 'lstm' in n and '.w_' in n][0]
+        scope.vars[wname] = jnp.asarray(w)
+        out, = exe.run(main, feed={'x': t}, fetch_list=[h])
+    off = 0
+    expect = []
+    for l in lens:
+        expect.append(lstm_ref(xin[off:off + l]))
+        off += l
+    np.testing.assert_allclose(np.asarray(out), np.concatenate(expect),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_dynamic_gru_grad_finite_diff():
+    rng = np.random.RandomState(8)
+    DH = 3
+    lens = [2, 3]
+    xin = (rng.rand(sum(lens), 3 * DH) - 0.5).astype('float32')
+    t = fluid.create_lod_tensor(xin, [lens])
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[3 * DH], dtype='float32',
+                        lod_level=1)
+        h = layers.dynamic_gru(input=x, size=DH)
+        loss = layers.reduce_sum(layers.sequence_pool(h, 'sum'))
+        append_backward(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope = global_scope()
+        wname = [n for n in scope.vars if 'gru' in n and '.w_' in n][0]
+        g, = exe.run(main, feed={'x': t}, fetch_list=[wname + '@GRAD'])
+        g = np.asarray(g)
+        w0 = np.asarray(scope.vars[wname]).copy()
+        eps, idx = 1e-3, (1, 2)
+        vals = {}
+        for sign in (1, -1):
+            wp = w0.copy()
+            wp[idx] += sign * eps
+            scope.vars[wname] = jnp.asarray(wp)
+            vals[sign] = float(np.asarray(
+                exe.run(main, feed={'x': t}, fetch_list=[loss])[0]).squeeze())
+        fd = (vals[1] - vals[-1]) / (2 * eps)
+    assert np.isclose(g[idx], fd, rtol=2e-2, atol=1e-4), (g[idx], fd)

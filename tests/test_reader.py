@@ -1,0 +1,93 @@
+"""paddle.reader decorators (parity: reference
+python/paddle/reader/tests/decorator_test.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.reader as reader
+
+
+def _ints(n, start=0):
+    def r():
+        for i in range(start, start + n):
+            yield i
+    return r
+
+
+def test_map_readers():
+    out = list(reader.map_readers(lambda a, b: a + b, _ints(5), _ints(5, 10))())
+    assert out == [10, 12, 14, 16, 18]
+
+
+def test_shuffle_preserves_multiset():
+    out = list(reader.shuffle(_ints(100), buf_size=10)())
+    assert sorted(out) == list(range(100))
+    big = list(reader.shuffle(_ints(100), buf_size=1000)())
+    assert sorted(big) == list(range(100))
+
+
+def test_chain():
+    out = list(reader.chain(_ints(3), _ints(3, 10))())
+    assert out == [0, 1, 2, 10, 11, 12]
+
+
+def test_compose_and_alignment():
+    c = reader.compose(_ints(3), _ints(3, 10))
+    assert list(c()) == [(0, 10), (1, 11), (2, 12)]
+
+    def tup(n):
+        def r():
+            for i in range(n):
+                yield (i, i * 2)
+        return r
+    c2 = reader.compose(tup(2), _ints(2, 5))
+    assert list(c2()) == [(0, 0, 5), (1, 2, 6)]
+
+
+def test_buffered_yields_everything():
+    out = list(reader.buffered(_ints(50), size=4)())
+    assert out == list(range(50))
+
+
+def test_firstn():
+    assert list(reader.firstn(_ints(100), 7)()) == list(range(7))
+    assert list(reader.firstn(_ints(3), 10)()) == [0, 1, 2]
+
+
+def test_xmap_readers_unordered_and_ordered():
+    got = sorted(reader.xmap_readers(lambda x: x * 2, _ints(40), 4, 8)())
+    assert got == [2 * i for i in range(40)]
+    ordered = list(reader.xmap_readers(lambda x: x + 1, _ints(20), 3, 8,
+                                       order=True)())
+    assert ordered == [i + 1 for i in range(20)]
+
+
+def test_cache_replays_without_source():
+    calls = []
+
+    def src():
+        calls.append(1)
+        for i in range(4):
+            yield i
+    c = reader.cache(src)
+    assert list(c()) == [0, 1, 2, 3]
+    assert list(c()) == [0, 1, 2, 3]
+    assert len(calls) == 1  # second pass served from cache
+
+
+def test_fake():
+    fake = reader.Fake()
+    f = fake(_ints(100), 5)
+    assert list(f()) == [0] * 5
+    assert list(f()) == [0] * 5  # resets after exhaustion
+
+
+def test_batch():
+    bs = list(paddle.batch(_ints(7), batch_size=3)())
+    assert [len(b) for b in bs] == [3, 3, 1]
+    assert bs[2] == [6]
+
+
+def test_batch_drop_last():
+    bs = list(paddle.batch(_ints(7), batch_size=3, drop_last=True)())
+    assert [len(b) for b in bs] == [3, 3]
