@@ -20,8 +20,8 @@ except Exception:  # pragma: no cover - PIL is present in this image
 
 __all__ = [
     'load_image_bytes', 'load_image', 'resize_short', 'to_chw', 'center_crop',
-    'random_crop', 'left_right_flip', 'simple_transform', 'load_and_transform',
-    'batch_images_from_tar'
+    'random_crop', 'left_right_flip', 'simple_transform',
+    'simple_transform_batch', 'load_and_transform', 'batch_images_from_tar'
 ]
 
 
@@ -149,6 +149,45 @@ def load_and_transform(filename, resize_size, crop_size, is_train,
     im = load_image(filename, is_color)
     return simple_transform(im, resize_size, crop_size, is_train, is_color,
                             mean)
+
+
+def simple_transform_batch(images, resize_size, crop_size, is_train,
+                           mean=None, seed=0):
+    """simple_transform over a whole same-sized [n, h, w, c] uint8 batch.
+
+    Uses the multithreaded C++ kernel (csrc/image_aug.cpp) when built —
+    the host-side hot loop of the imagenet-style input pipeline — and
+    falls back to the per-image numpy path otherwise. Train-mode crops and
+    flips draw from `seed` deterministically per image."""
+    from ..utils import native
+    out = native.image_transform_batch(images, resize_size, crop_size,
+                                       is_train, mean=mean, seed=seed)
+    if out is not None:
+        return out
+    # numpy fallback: deterministic per (seed, i) like the kernel (crop
+    # positions differ between backends; determinism holds within each)
+    outs = []
+    for i, im in enumerate(np.asarray(images)):
+        rng = np.random.RandomState((int(seed) * 1000003 + i) % (2 ** 31))
+        im = resize_short(im, resize_size)
+        h, w = im.shape[:2]
+        if is_train:
+            y0 = int(rng.randint(0, h - crop_size + 1))
+            x0 = int(rng.randint(0, w - crop_size + 1))
+            im = im[y0:y0 + crop_size, x0:x0 + crop_size]
+            if rng.randint(2) == 0:
+                im = left_right_flip(im)
+        else:
+            im = center_crop(im, crop_size)
+        im = to_chw(im).astype('float32') if im.ndim == 3 \
+            else im.astype('float32')
+        if mean is not None:
+            m = np.array(mean, dtype=np.float32)
+            if m.ndim == 1 and m.shape[0] == im.shape[0]:
+                m = m[:, np.newaxis, np.newaxis]
+            im = im - m
+        outs.append(im)
+    return np.stack(outs)
 
 
 def batch_images_from_tar(data_file, dataset_name, img2label,

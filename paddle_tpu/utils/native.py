@@ -77,10 +77,48 @@ def _bind(lib):
     lib.ptrio_prefetch_next.argtypes = [ctypes.c_void_p,
                                         ctypes.POINTER(ctypes.c_char_p)]
     lib.ptrio_prefetch_close.argtypes = [ctypes.c_void_p]
+    lib.ptim_transform_batch.restype = ctypes.c_int
+    lib.ptim_transform_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64, ctypes.c_void_p]
 
 
 def available():
     return _load() is not None
+
+
+def image_transform_batch(images, resize_size, crop_size, is_train,
+                          mean=None, seed=0):
+    """Multithreaded C++ simple_transform over a same-sized uint8 HWC batch
+    (csrc/image_aug.cpp). Returns [n, c, crop, crop] float32, or None when
+    the native library is unavailable (caller falls back to numpy)."""
+    import numpy as np
+    lib = _load()
+    if lib is None:
+        return None
+    images = np.ascontiguousarray(images, dtype=np.uint8)
+    if images.ndim != 4:
+        raise ValueError("expected [n, h, w, c] uint8 batch, got %s"
+                         % (images.shape,))
+    n, h, w, c = images.shape
+    out = np.empty((n, c, crop_size, crop_size), np.float32)
+    mean_arr, mean_len = None, 0
+    if mean is not None:
+        mean_arr = np.ascontiguousarray(mean, dtype=np.float32).reshape(-1)
+        mean_len = mean_arr.shape[0]
+        if mean_len not in (1, c, c * crop_size * crop_size):
+            return None  # shape the kernel can't apply: numpy fallback
+    rc = lib.ptim_transform_batch(
+        images.ctypes.data_as(ctypes.c_void_p), n, h, w, c,
+        int(resize_size), int(crop_size), int(bool(is_train)),
+        mean_arr.ctypes.data_as(ctypes.c_void_p) if mean_len else None,
+        mean_len, int(seed) & 0xFFFFFFFFFFFFFFFF,
+        out.ctypes.data_as(ctypes.c_void_p))
+    if rc != 0:
+        raise ValueError("ptim_transform_batch rejected arguments "
+                         "(resize %d < crop %d?)" % (resize_size, crop_size))
+    return out
 
 
 def recordio_iter(path):

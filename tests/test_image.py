@@ -84,6 +84,46 @@ def test_flowers_reader_feeds_augmented_samples():
     assert all(i[0].shape == (3 * 224 * 224,) for i in imgs)
 
 
+def test_simple_transform_batch_matches_per_image():
+    """Native C++ batch kernel (csrc/image_aug.cpp) vs numpy per-image:
+    same crop geometry and mean handling; values within 1 uint8 level
+    (bilinear tie-rounding may differ by 1 ulp on real resizes)."""
+    rng = np.random.RandomState(3)
+    batch = (rng.rand(4, 300, 400, 3) * 255).astype('uint8')
+    mean = [10., 20., 30.]
+    out = image.simple_transform_batch(batch, 256, 224, False, mean=mean)
+    ref = np.stack([image.simple_transform(im, 256, 224, False, mean=mean)
+                    for im in batch])
+    assert out.shape == (4, 3, 224, 224) and out.dtype == np.float32
+    assert np.abs(out - ref).max() <= 1.0
+    # train path: deterministic per seed, varies across seeds
+    a = image.simple_transform_batch(batch, 256, 224, True, seed=5)
+    b = image.simple_transform_batch(batch, 256, 224, True, seed=5)
+    c = image.simple_transform_batch(batch, 256, 224, True, seed=6)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+
+
+def test_simple_transform_batch_fallback_deterministic(monkeypatch):
+    """The numpy fallback honors `seed` (and a full CHW mean image works
+    on both paths)."""
+    from paddle_tpu.utils import native
+    rng = np.random.RandomState(4)
+    batch = (rng.rand(3, 260, 340, 3) * 255).astype('uint8')
+    mimg = (rng.rand(3, 224, 224) * 50).astype('float32')
+    nat = image.simple_transform_batch(batch, 256, 224, False, mean=mimg)
+    monkeypatch.setattr(native, 'image_transform_batch',
+                        lambda *a, **k: None)
+    a = image.simple_transform_batch(batch, 256, 224, True, seed=5)
+    b = image.simple_transform_batch(batch, 256, 224, True, seed=5)
+    c = image.simple_transform_batch(batch, 256, 224, True, seed=6)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+    fb = image.simple_transform_batch(batch, 256, 224, False, mean=mimg)
+    if nat is not None:
+        assert np.abs(np.asarray(nat) - fb).max() <= 1.0
+
+
 def test_batch_images_from_tar(tmp_path):
     import tarfile, io
     from PIL import Image as PILImage
