@@ -28,7 +28,12 @@ from .data_feeder import DataFeeder
 from .lod_tensor import LoDTensor, create_lod_tensor, create_random_int_lodtensor
 from . import unique_name
 from . import amp
+from . import annotations
 from . import concurrency
+from . import default_scope_funcs
+from . import graphviz
+from . import net_drawer
+from . import recordio_writer
 from .concurrency import (Go, make_channel, channel_send, channel_recv,
                           channel_close, Select)
 from . import contrib

@@ -48,25 +48,52 @@ class _VarHolder(object):
 
 
 class Scope(object):
-    """name -> device array store. Parity: paddle/fluid/framework/scope.h."""
+    """name -> device array store, optionally chained to a parent scope
+    (reference paddle/fluid/framework/scope.h: kid scopes fall back to
+    the parent on lookup; writes stay local)."""
 
-    def __init__(self):
+    def __init__(self, parent=None):
         self.vars = collections.OrderedDict()
+        self.parent = parent
 
     def find_var(self, name):
-        if name not in self.vars:
-            return None
-        return _VarHolder(self, name)
+        if name in self.vars:
+            return _VarHolder(self, name)
+        if self.parent is not None:
+            return self.parent.find_var(name)
+        return None
 
     def var(self, name):
         self.vars.setdefault(name, None)
         return _VarHolder(self, name)
 
     def new_scope(self):
-        return Scope()
+        return Scope(parent=self)
+
+    def _chain_get(self, name, default=None):
+        s = self
+        while s is not None:
+            if name in s.vars:
+                return s.vars[name]
+            s = s.parent
+        return default
+
+    def _chain_set(self, name, value):
+        """Update the scope that OWNS `name` (so persistable updates made
+        while running under a kid scope land where the var lives); new
+        names are created locally."""
+        s = self
+        while s is not None:
+            if name in s.vars:
+                s.vars[name] = value
+                return
+            s = s.parent
+        self.vars[name] = value
 
     def __contains__(self, name):
-        return name in self.vars
+        if name in self.vars:
+            return True
+        return self.parent is not None and name in self.parent
 
 
 _global_scope = Scope()
@@ -404,14 +431,14 @@ class Executor(object):
         feed_sig = tuple(sorted(_feed_signature(n, v) for n, v in feed_vals.items()))
         persist_in = tuple(sorted(
             v.name for v in program.list_vars()
-            if v.persistable and v.name in scope.vars
-            and scope.vars[v.name] is not None and v.name not in feed_vals))
+            if v.persistable and scope._chain_get(v.name) is not None
+            and v.name not in feed_vals))
         from . import amp as amp_mod
         amp = amp_mod.is_amp(program)
         from jax.sharding import NamedSharding
         persist_shardings = {}
         for n in persist_in:
-            v = scope.vars[n]
+            v = scope._chain_get(n)
             if isinstance(v, jax.Array) and isinstance(v.sharding,
                                                        NamedSharding):
                 persist_shardings[n] = v.sharding
@@ -432,7 +459,7 @@ class Executor(object):
             if use_program_cache:
                 self._cache[key] = compiled
 
-        persist = {n: scope.vars[n] for n in compiled.persist_in}
+        persist = {n: scope._chain_get(n) for n in compiled.persist_in}
         self._run_counter += 1
         rng = jax.random.key(np.uint32(
             ((program.random_seed or 0) * 2654435761 + self._run_counter)
@@ -446,7 +473,8 @@ class Executor(object):
                 persist, feed_vals, rng, check_nan_inf=check, on_op=op_hook)
         else:
             fetches, new_persist = compiled(persist, feed_vals, rng)
-        scope.vars.update(new_persist)
+        for n, v in new_persist.items():
+            scope._chain_set(n, v)
 
         out = []
         for v in fetches:

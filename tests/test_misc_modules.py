@@ -1,0 +1,163 @@
+"""Small parity modules: annotations, default_scope_funcs, graphviz,
+net_drawer, recordio_writer (reference python/paddle/fluid/<same>.py)."""
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import (annotations, default_scope_funcs, graphviz,
+                              net_drawer, recordio_writer)
+
+from util import fresh_program
+
+
+def test_deprecated_decorator(capsys):
+    @annotations.deprecated(since='0.14', instead='new_api')
+    def old_api(x):
+        return x + 1
+    assert old_api(1) == 2
+    err = capsys.readouterr().err
+    assert 'deprecated' in err and 'new_api' in err
+    assert 'Warning' in old_api.__doc__
+
+
+def test_default_scope_funcs():
+    d = default_scope_funcs
+    root = d.get_cur_scope()
+    d.var('x').set(42)
+    assert d.find_var('x').get() == 42
+    d.enter_local_scope()
+    assert d.get_cur_scope() is not root
+    assert d.find_var('x').get() == 42      # falls back to parent
+    d.var('y').set(7)
+    d.leave_local_scope()
+    assert d.get_cur_scope() is root
+    assert d.find_var('y') is None          # local var gone with its scope
+
+    seen = []
+    d.scoped_function(lambda: seen.append(d.var('tmp').set(1)))
+    assert d.find_var('tmp') is None
+
+
+def test_executor_runs_under_child_scope():
+    """Params initialized in a parent scope resolve (and update in place)
+    when running under a kid scope — the reference's local-scope pattern."""
+    from paddle_tpu.fluid.executor import global_scope
+    with fresh_program() as (main, startup):
+        x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        pred = fluid.layers.fc(input=x, size=1)
+        cost = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.5).minimize(cost)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        parent = global_scope()
+        wname = [n for n in parent.vars if n.endswith('.w_0')][0]
+        w_before = np.asarray(parent.vars[wname]).copy()
+        child = parent.new_scope()
+        assert wname in child                 # __contains__ chains
+        feed = {'x': np.ones((4, 3), 'float32'),
+                'y': np.zeros((4, 1), 'float32')}
+        exe.run(main, feed=feed, fetch_list=[cost], scope=child)
+    # the SGD update landed on the parent-owned param, not a shadow copy
+    w_after = np.asarray(parent.vars[wname])
+    assert not np.allclose(w_before, w_after)
+    assert wname not in child.vars            # no local shadow created
+
+
+def test_graphviz_graph_builds_dot(tmp_path):
+    g = graphviz.Graph('T', rankdir='TB')
+    a = g.add_node('A', shape='rect')
+    b = g.add_node('B')
+    g.add_edge(a, b, label='ab')
+    dot = str(g)
+    assert 'digraph G' in dot and '->' in dot and 'label="ab"' in dot
+    p = str(tmp_path / 'g.dot')
+    g.compile(p)
+    assert os.path.exists(p)
+
+    gen = graphviz.GraphPreviewGenerator('prev')
+    pn = gen.add_param('w', 'float32')
+    on = gen.add_op('matmul')
+    gen.add_edge(pn, on)
+    out = gen(str(tmp_path / 'prev.dot'))
+    assert os.path.exists(str(tmp_path / 'prev.dot'))
+
+
+def test_net_drawer_draws_program(tmp_path):
+    with fresh_program() as (main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.fc(input=x, size=2)
+        path = str(tmp_path / 'net.dot')
+        net_drawer.draw_graph(startup, main, path=path)
+    txt = open(path).read()
+    assert 'mul' in txt or 'fc' in txt
+    assert 'x' in txt
+
+
+def test_recordio_writer_roundtrip(tmp_path):
+    from paddle_tpu.reader import recordio as rio
+    with fresh_program() as (main, startup):
+        img = fluid.layers.data(name='img', shape=[4], dtype='float32')
+        lbl = fluid.layers.data(name='lbl', shape=[1], dtype='int64')
+        feeder = fluid.DataFeeder(feed_list=[img, lbl],
+                                  place=fluid.CPUPlace())
+
+        def reader():
+            rng = np.random.RandomState(0)
+            for i in range(7):
+                yield [(rng.rand(4).astype('float32'), [i])]
+
+        path = str(tmp_path / 'data.recordio')
+        n = recordio_writer.convert_reader_to_recordio_file(
+            path, reader, feeder)
+        assert n == 7
+        payloads = list(rio.RecordIOReader(path))
+        assert len(payloads) == 7
+        slots = recordio_writer.unpack_feed_record(payloads[3])
+        assert len(slots) == 2
+        assert slots[0].shape[-1] == 4
+        assert int(np.asarray(slots[1]).reshape(-1)[0]) == 3
+
+
+def test_recordio_writer_preserves_lod(tmp_path):
+    from paddle_tpu.reader import recordio as rio
+    with fresh_program() as (main, startup):
+        seq = fluid.layers.data(name='seq', shape=[1], dtype='int64',
+                                lod_level=1)
+        feeder = fluid.DataFeeder(feed_list=[seq], place=fluid.CPUPlace())
+
+        def reader():
+            yield [(np.array([[1], [2], [3]], 'int64'),),
+                   (np.array([[9]], 'int64'),)]
+
+        path = str(tmp_path / 'seq.recordio')
+        n = recordio_writer.convert_reader_to_recordio_file(
+            path, reader, feeder)
+        assert n == 1
+        slot, = recordio_writer.unpack_feed_record(
+            next(iter(rio.RecordIOReader(path))))
+    # sequence structure survives: flat tokens + per-sample lengths
+    assert slot.recursive_sequence_lengths() == [[3, 1]]
+    np.testing.assert_array_equal(
+        np.asarray(slot.data).reshape(-1), [1, 2, 3, 9])
+
+
+def test_recordio_writer_multi_files(tmp_path):
+    with fresh_program() as (main, startup):
+        img = fluid.layers.data(name='img', shape=[2], dtype='float32')
+        feeder = fluid.DataFeeder(feed_list=[img], place=fluid.CPUPlace())
+
+        def reader():
+            for i in range(5):
+                yield [(np.full(2, i, 'float32'),)]
+
+        base = str(tmp_path / 'part.recordio')
+        n = recordio_writer.convert_reader_to_recordio_files(
+            base, 2, reader, feeder)
+        assert n == 5
+        files = sorted(os.listdir(str(tmp_path)))
+        assert files == ['part-00000.recordio', 'part-00001.recordio',
+                         'part-00002.recordio']
