@@ -2,7 +2,7 @@
 import numpy as np
 from . import common
 
-__all__ = ['get_dict', 'get_embedding', 'test']
+__all__ = ['get_dict', 'get_embedding', 'train', 'test']
 
 _WORD, _VERB, _LABEL = 44068, 3162, 59
 
@@ -20,15 +20,31 @@ def get_embedding():
 
 
 def _synthetic(n, tag):
+    """9 slots like the real corpus sample layout (word, ctx_n2, ctx_n1,
+    ctx_0, ctx_p1, ctx_p2, verb, mark, target). The target is a noisy
+    function of (word, mark) so the SRL tagger has signal to learn."""
     rng = common.synthetic_rng('conll05_' + tag)
     for _ in range(n):
         slen = int(rng.randint(5, 40))
-        word = [int(w) for w in rng.randint(0, _WORD, size=slen)]
-        ctx = [int(w) for w in rng.randint(0, _WORD, size=slen)]
+        word = rng.randint(0, _WORD, size=slen)
+        ctxs = [np.roll(word, k) for k in (2, 1, 0, -1, -2)]
         verb = [int(rng.randint(0, _VERB))] * slen
-        mark = [int(m) for m in rng.randint(0, 2, size=slen)]
-        label = [int(l) for l in rng.randint(0, _LABEL, size=slen)]
-        yield word, ctx, ctx, ctx, ctx, verb, mark, label
+        mark = rng.randint(0, 2, size=slen)
+        noise = rng.randint(0, _LABEL, size=slen)
+        label = np.where(rng.rand(slen) < 0.8,
+                         (word % (_LABEL // 2)) + mark * (_LABEL // 2),
+                         noise)
+        yield tuple([[int(v) for v in word]]
+                    + [[int(v) for v in c] for c in ctxs]
+                    + [verb, [int(v) for v in mark],
+                       [int(v) for v in label]])
+
+
+def train():
+    def reader():
+        for s in _synthetic(1024, 'train'):
+            yield s
+    return reader
 
 
 def test():

@@ -3,9 +3,23 @@ import numpy as np
 from . import common
 
 __all__ = ['train', 'test', 'max_user_id', 'max_movie_id', 'max_job_id',
-           'age_table']
+           'age_table', 'movie_categories', 'get_movie_title_dict']
 
 age_table = [1, 18, 25, 35, 45, 50, 56]
+
+_CATEGORIES = ['Action', 'Adventure', 'Animation', "Children's", 'Comedy',
+               'Crime', 'Documentary', 'Drama', 'Fantasy', 'Film-Noir',
+               'Horror', 'Musical', 'Mystery', 'Romance', 'Sci-Fi',
+               'Thriller', 'War', 'Western']
+_TITLE_WORDS = 5175
+
+
+def movie_categories():
+    return list(_CATEGORIES)
+
+
+def get_movie_title_dict():
+    return {('t%d' % i): i for i in range(_TITLE_WORDS)}
 
 
 def max_user_id():
@@ -28,9 +42,11 @@ def _synthetic(n, tag):
         age = int(rng.randint(0, 7))
         job = int(rng.randint(0, 21))
         mid = int(rng.randint(1, 3953))
-        category = [int(rng.randint(0, 19))]
-        title = [int(rng.randint(0, 5175)) for _ in range(3)]
-        score = float(rng.randint(1, 6))
+        category = [int(rng.randint(0, len(_CATEGORIES)))]
+        title = [int(rng.randint(0, _TITLE_WORDS)) for _ in range(3)]
+        # learnable: rating is a (noisy) user-movie affinity, not pure noise
+        base = 1 + (uid * 7 + mid * 13 + gender * 3) % 5
+        score = float(np.clip(base + rng.randint(-1, 2), 1, 5))
         yield [uid, gender, age, job, mid, category, title, score]
 
 

@@ -1,0 +1,130 @@
+"""SRL deep bidirectional LSTM + CRF (Fluid book ch07).
+
+Parity: reference python/paddle/fluid/tests/book/test_label_semantic_roles.py
+(db_lstm: 8 feature embeddings -> summed fc -> stacked alternating-direction
+dynamic_lstm with direct edges -> linear_chain_crf loss / crf_decoding
+inference). Sizes are parameters so tests can run a small instance.
+"""
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+
+__all__ = ['db_lstm', 'get_model', 'load_pretrained_embedding', 'FEED_ORDER']
+
+FEED_ORDER = ['word_data', 'ctx_n2_data', 'ctx_n1_data', 'ctx_0_data',
+              'ctx_p1_data', 'ctx_p2_data', 'verb_data', 'mark_data',
+              'target']
+
+MARK_DICT_LEN = 2
+
+
+def db_lstm(word, predicate, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, mark,
+            word_dict_len, label_dict_len, pred_dict_len,
+            word_dim=32, mark_dim=5, hidden_dim=512, depth=8,
+            embedding_name='emb'):
+    predicate_embedding = fluid.layers.embedding(
+        input=predicate, size=[pred_dict_len, word_dim], dtype='float32',
+        param_attr='vemb')
+    mark_embedding = fluid.layers.embedding(
+        input=mark, size=[MARK_DICT_LEN, mark_dim], dtype='float32')
+
+    word_input = [word, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2]
+    emb_layers = [
+        fluid.layers.embedding(
+            size=[word_dict_len, word_dim], input=x,
+            param_attr=fluid.ParamAttr(name=embedding_name, trainable=False))
+        for x in word_input
+    ]
+    emb_layers.append(predicate_embedding)
+    emb_layers.append(mark_embedding)
+
+    hidden_0 = fluid.layers.sums(input=[
+        fluid.layers.fc(input=emb, size=hidden_dim) for emb in emb_layers])
+
+    lstm_0, _ = fluid.layers.dynamic_lstm(
+        input=hidden_0, size=hidden_dim, candidate_activation='relu',
+        gate_activation='sigmoid', cell_activation='sigmoid')
+
+    # stacked L/R LSTMs with direct edges (alternating direction per depth)
+    input_tmp = [hidden_0, lstm_0]
+    for i in range(1, depth):
+        mix_hidden = fluid.layers.sums(input=[
+            fluid.layers.fc(input=input_tmp[0], size=hidden_dim),
+            fluid.layers.fc(input=input_tmp[1], size=hidden_dim),
+        ])
+        lstm, _ = fluid.layers.dynamic_lstm(
+            input=mix_hidden, size=hidden_dim,
+            candidate_activation='relu', gate_activation='sigmoid',
+            cell_activation='sigmoid', is_reverse=((i % 2) == 1))
+        input_tmp = [mix_hidden, lstm]
+
+    feature_out = fluid.layers.sums(input=[
+        fluid.layers.fc(input=input_tmp[0], size=label_dict_len, act='tanh'),
+        fluid.layers.fc(input=input_tmp[1], size=label_dict_len, act='tanh'),
+    ])
+    return feature_out
+
+
+def get_model(word_dim=32, mark_dim=5, hidden_dim=128, depth=4,
+              mix_hidden_lr=1e-3, batch_size=10):
+    """Build train net + crf decode; returns (avg_cost, crf_decode,
+    train_reader, feed_order)."""
+    word_dict, verb_dict, label_dict = paddle.dataset.conll05.get_dict()
+    word_dict_len = len(word_dict)
+    label_dict_len = len(label_dict)
+    pred_dict_len = len(verb_dict)
+
+    def seq_data(name):
+        return fluid.layers.data(name=name, shape=[1], dtype='int64',
+                                 lod_level=1)
+
+    word = seq_data('word_data')
+    ctx_n2 = seq_data('ctx_n2_data')
+    ctx_n1 = seq_data('ctx_n1_data')
+    ctx_0 = seq_data('ctx_0_data')
+    ctx_p1 = seq_data('ctx_p1_data')
+    ctx_p2 = seq_data('ctx_p2_data')
+    predicate = seq_data('verb_data')
+    mark = seq_data('mark_data')
+    target = seq_data('target')
+
+    feature_out = db_lstm(word, predicate, ctx_n2, ctx_n1, ctx_0, ctx_p1,
+                          ctx_p2, mark, word_dict_len, label_dict_len,
+                          pred_dict_len, word_dim=word_dim,
+                          mark_dim=mark_dim, hidden_dim=hidden_dim,
+                          depth=depth)
+    crf_cost = fluid.layers.linear_chain_crf(
+        input=feature_out, label=target,
+        param_attr=fluid.ParamAttr(name='crfw', learning_rate=mix_hidden_lr))
+    avg_cost = fluid.layers.mean(crf_cost)
+
+    crf_decode = fluid.layers.crf_decoding(
+        input=feature_out, param_attr=fluid.ParamAttr(name='crfw'))
+
+    train_reader = paddle.batch(
+        paddle.reader.shuffle(paddle.dataset.conll05.train(), buf_size=1024),
+        batch_size=batch_size)
+    return avg_cost, crf_decode, train_reader, list(FEED_ORDER)
+
+
+def load_pretrained_embedding(scope=None, embedding_name='emb'):
+    """Install the conll05 pretrained word embedding into the frozen
+    `emb` table AFTER the startup program ran (the reference book's
+    load_parameter(embedding_param) step — the table is trainable=False,
+    so without this it would stay at random init forever). Columns are
+    sliced/tiled if the model was built with word_dim != the pretrained
+    width."""
+    import numpy as np
+    import jax.numpy as jnp
+    from ..fluid.executor import global_scope
+    scope = scope or global_scope()
+    if embedding_name not in scope.vars or scope.vars[embedding_name] is None:
+        raise ValueError('run the startup program before loading the '
+                         'pretrained embedding')
+    cur = np.asarray(scope.vars[embedding_name])
+    emb = paddle.dataset.conll05.get_embedding()
+    if emb.shape[1] < cur.shape[1]:
+        reps = -(-cur.shape[1] // emb.shape[1])
+        emb = np.tile(emb, (1, reps))
+    emb = emb[:cur.shape[0], :cur.shape[1]].astype(cur.dtype)
+    scope.vars[embedding_name] = jnp.asarray(emb)
+    return emb.shape

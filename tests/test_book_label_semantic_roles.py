@@ -1,0 +1,43 @@
+"""End-to-end SRL db_lstm + CRF (reference
+fluid/tests/book/test_label_semantic_roles.py) on synthetic conll05."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.models import label_semantic_roles as M
+
+from util import fresh_program
+
+
+def test_label_semantic_roles_trains_and_decodes():
+    with fresh_program() as (main, startup):
+        avg_cost, crf_decode, train_reader, feed_order = M.get_model(
+            word_dim=16, mark_dim=4, hidden_dim=32, depth=2, batch_size=16)
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(avg_cost)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        # the frozen word/ctx table must come from the pretrained embedding
+        shape = M.load_pretrained_embedding()
+        assert shape[1] == 16  # sliced to the model's word_dim
+        feed_list = [main.global_block().var(n) for n in feed_order]
+        feeder = fluid.DataFeeder(feed_list=feed_list,
+                                  place=fluid.CPUPlace())
+        # fixed batch: per-batch CRF normalizers vary with sequence
+        # lengths, so convergence is asserted on one batch re-fed
+        batch0 = next(train_reader())
+        feed0 = feeder.feed(batch0)
+        losses = []
+        for _ in range(40):
+            loss, = exe.run(main, feed=feed0, fetch_list=[avg_cost])
+            losses.append(float(np.asarray(loss).squeeze()))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+
+        # decode path: valid label ids for every token
+        batch = next(train_reader())
+        dec, = exe.run(main, feed=feeder.feed(batch),
+                       fetch_list=[crf_decode])
+        dec = np.asarray(dec)
+        word_dict, _, label_dict = paddle.dataset.conll05.get_dict()
+        assert ((dec >= 0) & (dec < len(label_dict))).all()

@@ -1,0 +1,38 @@
+"""End-to-end MovieLens recommender (reference
+fluid/tests/book/test_recommender_system.py) on synthetic movielens."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.models import recommender_system as M
+
+from util import fresh_program
+
+
+def test_recommender_system_converges():
+    with fresh_program() as (main, startup):
+        (avg_cost, scale_infer, infer_prog, train_reader, test_reader,
+         feed_order) = M.get_model(batch_size=128, learning_rate=0.2,
+                                   emb_dim=16, tower_dim=32)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        feed_list = [main.global_block().var(n) for n in feed_order]
+        feeder = fluid.DataFeeder(feed_list=feed_list,
+                                  place=fluid.CPUPlace())
+        losses = []
+        for epoch in range(3):
+            for batch in train_reader():
+                loss, = exe.run(main, feed=feeder.feed(batch),
+                                fetch_list=[avg_cost])
+                losses.append(float(np.asarray(loss).squeeze()))
+        # mean squared rating error must fall well below score variance
+        assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+        # inference program predicts in the scaled [.,5] range
+        batch = next(test_reader())
+        pred, = exe.run(infer_prog,
+                        feed=feeder.feed(batch),
+                        fetch_list=[scale_infer])
+        pred = np.asarray(pred)
+        assert pred.shape[-1] == 1 and np.isfinite(pred).all()
+        assert (np.abs(pred) <= 5.0 + 1e-5).all()

@@ -1,0 +1,104 @@
+"""fluid.metrics accumulators vs hand-computed references (parity:
+reference python/paddle/fluid/tests/unittests/test_metrics.py +
+per-metric semantics in metrics.py)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.fluid import metrics
+from paddle_tpu.fluid.average import WeightedAverage
+
+
+def test_precision_recall():
+    p = metrics.Precision()
+    r = metrics.Recall()
+    preds = np.array([[0.9], [0.8], [0.2], [0.7], [0.1]], 'float32')
+    labels = np.array([[1], [0], [1], [1], [0]], 'int64')
+    p.update(preds, labels)
+    r.update(preds, labels)
+    # predicted positive: 0.9, 0.8, 0.7 -> tp=2 (idx 0,3), fp=1 (idx 1)
+    assert p.eval() == pytest.approx(2.0 / 3.0)
+    # actual positive: idx 0,2,3 -> tp=2, fn=1 (idx 2)
+    assert r.eval() == pytest.approx(2.0 / 3.0)
+    # streaming: a second batch accumulates
+    p.update(np.array([[0.99]], 'float32'), np.array([[1]], 'int64'))
+    assert p.eval() == pytest.approx(3.0 / 4.0)
+
+
+def test_accuracy_weighted():
+    a = metrics.Accuracy()
+    a.update(value=0.5, weight=10)
+    a.update(value=1.0, weight=30)
+    assert a.eval() == pytest.approx((0.5 * 10 + 1.0 * 30) / 40)
+    a.reset()
+    with pytest.raises(ValueError):
+        a.eval()
+
+
+def test_chunk_evaluator_f1():
+    c = metrics.ChunkEvaluator()
+    c.update(num_infer_chunks=10, num_label_chunks=8, num_correct_chunks=4)
+    precision, recall, f1 = c.eval()
+    assert precision == pytest.approx(0.4)
+    assert recall == pytest.approx(0.5)
+    assert f1 == pytest.approx(2 * 0.4 * 0.5 / 0.9)
+    c.update(num_infer_chunks=2, num_label_chunks=4, num_correct_chunks=2)
+    precision, _, _ = c.eval()
+    assert precision == pytest.approx(6.0 / 12.0)
+
+
+def test_edit_distance():
+    e = metrics.EditDistance()
+    e.update(np.array([2.0, 0.0, 5.0]), seq_num=3)
+    avg, err = e.eval()
+    assert avg == pytest.approx(7.0 / 3.0)
+    assert err == pytest.approx(2.0 / 3.0)
+
+
+def test_detection_map():
+    d = metrics.DetectionMAP()
+    d.update(np.array([0.7]), weight=1)
+    d.update(np.array([0.9]), weight=1)
+    assert d.eval() == pytest.approx(0.8)
+
+
+def test_auc_separable():
+    auc = metrics.Auc(num_thresholds=200)
+    rng = np.random.RandomState(0)
+    # perfectly separable scores -> AUC ~ 1
+    labels = rng.randint(0, 2, size=400)
+    preds = labels * 0.5 + 0.25 + rng.rand(400) * 0.2  # pos in [.75,.95]
+    auc.update(preds, labels)
+    assert auc.eval() > 0.95
+    # random scores -> AUC ~ 0.5
+    auc2 = metrics.Auc(num_thresholds=200)
+    auc2.update(rng.rand(2000), rng.randint(0, 2, size=2000))
+    assert 0.4 < auc2.eval() < 0.6
+
+
+def test_composite_and_reset_and_config():
+    comp = metrics.CompositeMetric()
+    p = metrics.Precision()
+    r = metrics.Recall()
+    comp.add_metric(p)
+    comp.add_metric(r)
+    preds = np.array([[0.9], [0.1]], 'float32')
+    labels = np.array([[1], [1]], 'int64')
+    comp.update(preds, labels)
+    pe, re = comp.eval()
+    assert pe == pytest.approx(1.0) and re == pytest.approx(0.5)
+    with pytest.raises(ValueError):
+        comp.add_metric("not a metric")
+    cfg = p.get_config()
+    assert cfg['name'] == 'Precision' and cfg['states']['tp'] == 1
+    p.reset()
+    assert p.tp == 0 and p.fp == 0
+
+
+def test_weighted_average():
+    w = WeightedAverage()
+    w.add(value=2.0, weight=1)
+    w.add(value=4.0, weight=3)
+    assert w.eval() == pytest.approx((2.0 + 12.0) / 4)
+    w.reset()
+    with pytest.raises(ValueError):
+        w.eval()
