@@ -13,8 +13,11 @@ mesh, replicates parameters, shards feed batches, and — the pserver memory
 story — ZeRO-shards optimizer accumulators over dp with the shardings
 enforced inside the compiled step (slice_var_up=True maps to the
 reference's splitting of large vars across pservers). get_trainer_program()
-returns the annotated program; get_pserver_program() returns a no-op
-program so reference launcher scripts degrade gracefully.
+returns the annotated program; get_pserver_program(endpoint) returns the
+SAME annotated program with that endpoint's shard coordinate recorded —
+on TPU every process is both trainer and owner of its optimizer shard, so
+reference launcher scripts that spawn one pserver per endpoint end up
+launching mesh participants.
 """
 from ..framework import Program, default_main_program
 
@@ -43,6 +46,7 @@ class DistributeTranspiler(object):
         self._trainer_id = trainer_id
         self._trainers = trainers
         self._program = program
+        self._startup_program = startup_program
         self._sync_mode = sync_mode
         self._pserver_endpoints = pserver_endpoints
         program._dist_config = {
@@ -62,14 +66,38 @@ class DistributeTranspiler(object):
         return self._program
 
     def get_pserver_program(self, endpoint):
-        """No parameter server exists on TPU; return an empty program so
-        reference launcher scripts that start pserver processes degrade
-        gracefully."""
-        return Program()
+        """On a TPU mesh every process is simultaneously a trainer and the
+        'parameter server' of its own ZeRO optimizer-state shard. Launcher
+        scripts that start one pserver process per endpoint therefore get
+        the SAME mesh-annotated program back, with this endpoint's shard
+        coordinate recorded — running it joins the mesh as the owner of
+        that optimizer shard (reference instead rewrites the program into
+        recv/optimize/send blocks, distribute_transpiler.py:471)."""
+        if self._program is None:
+            raise RuntimeError('call transpile() before get_pserver_program')
+        try:
+            idx = self._pserver_endpoints.index(endpoint)
+        except ValueError:
+            raise ValueError('unknown pserver endpoint %r (transpiled with '
+                             '%r)' % (endpoint, self._pserver_endpoints))
+        prog = self._program.clone()
+        prog._dist_config = dict(self._program._dist_config,
+                                 shard_owner=idx,
+                                 n_shard_owners=len(self._pserver_endpoints))
+        return prog
 
     def get_pserver_programs(self, endpoint):
-        return self.get_pserver_program(endpoint), Program()
+        return self.get_pserver_program(endpoint), self.get_startup_program(
+            endpoint)
 
     def get_startup_program(self, endpoint=None, pserver_program=None,
                             startup_program=None):
-        return Program()
+        """The mesh participant runs the ordinary startup program (params
+        replicate at first use): the one passed here, else the one recorded
+        at transpile() time, else the thread default."""
+        if startup_program is not None:
+            return startup_program
+        if getattr(self, '_startup_program', None) is not None:
+            return self._startup_program
+        from ..framework import default_startup_program
+        return default_startup_program()

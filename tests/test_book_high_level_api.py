@@ -1,0 +1,68 @@
+"""High-level-api book flow: Trainer trains a conv MNIST net, saves
+params, Inferencer serves them (reference
+fluid/tests/book/high-level-api/recognize_digits/
+test_recognize_digits_conv.py)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+
+
+def _conv_net():
+    img = fluid.layers.data(name='img', shape=[1, 28, 28], dtype='float32')
+    predict = fluid.nets.simple_img_conv_pool(
+        input=img, filter_size=5, num_filters=8, pool_size=2, pool_stride=2,
+        act='relu')
+    return fluid.layers.fc(input=predict, size=10, act='softmax')
+
+
+def _train_func():
+    predict = _conv_net()
+    label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+    cost = fluid.layers.cross_entropy(input=predict, label=label)
+    avg_cost = fluid.layers.mean(cost)
+    acc = fluid.layers.accuracy(input=predict, label=label)
+    return [avg_cost, acc]
+
+
+def _infer_func():
+    return _conv_net()
+
+
+def test_recognize_digits_conv_high_level_api(tmp_path):
+    trainer = fluid.Trainer(
+        train_func=_train_func,
+        optimizer_func=lambda: fluid.optimizer.Adam(learning_rate=0.005),
+        place=fluid.CPUPlace())
+
+    accs = []
+
+    def event_handler(event):
+        if isinstance(event, fluid.EndStepEvent):
+            accs.append(float(np.asarray(event.metrics[1]).squeeze()))
+        if isinstance(event, fluid.EndEpochEvent):
+            trainer.stop()
+
+    reader = paddle.batch(
+        paddle.reader.shuffle(paddle.dataset.mnist.train(), buf_size=500),
+        batch_size=64)
+    trainer.train(num_epochs=1, event_handler=event_handler, reader=reader,
+                  feed_order=['img', 'label'])
+    assert np.mean(accs[-5:]) > 0.9, accs[-5:]
+
+    param_path = str(tmp_path / 'params')
+    trainer.save_params(param_path)
+
+    inferencer = fluid.Inferencer(infer_func=_infer_func,
+                                  param_path=param_path,
+                                  place=fluid.CPUPlace())
+    batch = next(paddle.batch(paddle.dataset.mnist.test(), 16)())
+    imgs = np.stack([np.asarray(s[0], 'float32').reshape(1, 28, 28)
+                     for s in batch])
+    labels = np.array([s[1] for s in batch])
+    probs, = inferencer.infer({'img': imgs})
+    probs = np.asarray(probs)
+    assert probs.shape == (16, 10)
+    np.testing.assert_allclose(probs.sum(-1), 1.0, rtol=1e-4)
+    # the served model is the trained one: it should mostly agree
+    assert (probs.argmax(-1) == labels).mean() > 0.8

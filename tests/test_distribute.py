@@ -120,13 +120,25 @@ def test_non_divisible_distributed_feed_raises():
 
 
 def test_pserver_compat_shims():
+    """On TPU each 'pserver' endpoint is a mesh participant owning a ZeRO
+    shard: get_pserver_program returns the SAME annotated program with the
+    endpoint's shard coordinate recorded."""
     with fresh_program() as (main, startup):
         _build()
         t = fluid.DistributeTranspiler()
-        t.transpile(trainer_id=0, trainers=4)
-        ps = t.get_pserver_program('127.0.0.1:6174')
+        t.transpile(trainer_id=0, trainers=4,
+                    pservers='10.0.0.1:6174,10.0.0.2:6174')
+        ps = t.get_pserver_program('10.0.0.2:6174')
         assert isinstance(ps, fluid.Program)
-        assert not ps.global_block().ops
+        # same ops as the trainer program; shard ownership annotated
+        assert len(ps.global_block().ops) == len(main.global_block().ops)
+        assert ps._dist_config['shard_owner'] == 1
+        assert ps._dist_config['n_shard_owners'] == 2
+        assert ps._dist_config['dp_size'] == 4
+        with pytest.raises(ValueError, match='unknown pserver endpoint'):
+            t.get_pserver_program('not-an-endpoint')
+        sp = t.get_startup_program('10.0.0.2:6174')
+        assert isinstance(sp, fluid.Program)
 
 
 def test_init_multihost_noop_without_cluster_env(monkeypatch):
