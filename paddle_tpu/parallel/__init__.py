@@ -18,7 +18,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = ['make_mesh', 'data_sharding', 'replicated', 'shard_batch',
            'replicate', 'shard_params_by_rules', 'psum', 'all_gather',
            'reduce_scatter', 'ppermute', 'shard_optimizer_states',
-           'init_multihost', 'Mesh', 'NamedSharding', 'P']
+           'init_multihost', 'Mesh', 'NamedSharding', 'P',
+           'ring_attention', 'ring_self_attention',
+           'ulysses_attention', 'ulysses_self_attention']
+
+from .ring_attention import ring_attention, ring_self_attention  # noqa: E402
+from .ulysses import ulysses_attention, ulysses_self_attention  # noqa: E402
 
 
 def init_multihost(coordinator_address=None, num_processes=None,

@@ -1,0 +1,22 @@
+"""Shared plumbing for the sequence-parallel attention strategies
+(ring_attention.py, ulysses.py): both take [B, H, T, D] q/k/v with T
+sharded over one mesh axis and an optional [B, T] additive key bias."""
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def sp_shard_map(body, mesh, q, k, v, axis, key_bias):
+    """Wrap a per-shard attention body in shard_map with the sequence
+    sharding contract; defaults a zero key bias."""
+    from jax import shard_map
+
+    qkv_spec = P(None, None, axis, None)
+    kb_spec = P(None, axis)
+    if key_bias is None:
+        key_bias = jnp.zeros((q.shape[0], k.shape[2]), jnp.float32)
+    # check_vma=False: the pallas flash kernel's ShapeDtypeStructs carry
+    # no varying-mesh-axes info, which the default vma check rejects
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(qkv_spec, qkv_spec, qkv_spec, kb_spec),
+                   out_specs=qkv_spec, check_vma=False)
+    return fn(q, k, v, key_bias)

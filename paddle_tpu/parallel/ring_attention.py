@@ -34,6 +34,8 @@ def ring_attention(q, k, v, axis_name, key_bias=None, causal=False,
     qf = q.astype(jnp.float32) * sm_scale
     if key_bias is None:
         key_bias = jnp.zeros((B, Tl), jnp.float32)
+    # non-differentiable mask, matching ops.flash_attention / ulysses
+    key_bias = lax.stop_gradient(key_bias)
 
     m = jnp.full((B, H, Tl), -1e30, jnp.float32)
     l = jnp.zeros((B, H, Tl), jnp.float32)
@@ -73,18 +75,10 @@ def ring_attention(q, k, v, axis_name, key_bias=None, causal=False,
 def ring_self_attention(mesh, q, k, v, axis='sp', key_bias=None,
                         causal=False, sm_scale=None):
     """pjit-level entry: q/k/v [B, H, T, D] with T sharded over mesh axis."""
-    from jax import shard_map  # jax >= 0.8 location
-
-    qkv_spec = P(None, None, axis, None)
-    kb_spec = P(None, axis)
+    from ._sp import sp_shard_map
 
     def body(q, k, v, kb):
         return ring_attention(q, k, v, axis, key_bias=kb, causal=causal,
                               sm_scale=sm_scale)
 
-    if key_bias is None:
-        key_bias = jnp.zeros((q.shape[0], k.shape[2]), jnp.float32)
-    fn = shard_map(body, mesh=mesh,
-                   in_specs=(qkv_spec, qkv_spec, qkv_spec, kb_spec),
-                   out_specs=qkv_spec)
-    return fn(q, k, v, key_bias)
+    return sp_shard_map(body, mesh, q, k, v, axis, key_bias)

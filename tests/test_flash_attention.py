@@ -104,6 +104,43 @@ def test_ring_attention_matches_full():
                                    err_msg='causal=%s' % causal)
 
 
+def test_ulysses_attention_matches_full_and_ring():
+    from paddle_tpu import parallel
+    from paddle_tpu.parallel.ring_attention import ring_self_attention
+    from paddle_tpu.parallel.ulysses import ulysses_self_attention
+    mesh = parallel.make_mesh({'sp': 8})
+    B, H, T, D = 2, 8, 16, 4       # H divisible by sp=8
+    r = np.random.RandomState(5)
+    q = r.randn(B, H, T, D).astype('float32')
+    k = r.randn(B, H, T, D).astype('float32')
+    v = r.randn(B, H, T, D).astype('float32')
+    kb = np.where(r.rand(B, T) < 0.25, -1e9, 0.0).astype('float32')
+    kb[:, 0] = 0.0
+    for causal in (False, True):
+        got = ulysses_self_attention(mesh, jnp.asarray(q), jnp.asarray(k),
+                                     jnp.asarray(v), axis='sp',
+                                     key_bias=jnp.asarray(kb), causal=causal)
+        want = ops.reference_attention(q, k, v, key_bias=kb, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5,
+                                   err_msg='causal=%s' % causal)
+        ring = ring_self_attention(mesh, jnp.asarray(q), jnp.asarray(k),
+                                   jnp.asarray(v), axis='sp',
+                                   key_bias=jnp.asarray(kb), causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ring),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    import pytest
+    from paddle_tpu import parallel
+    from paddle_tpu.parallel.ulysses import ulysses_self_attention
+    mesh = parallel.make_mesh({'sp': 8})
+    q = jnp.zeros((1, 3, 16, 4), jnp.float32)   # 3 heads, sp=8
+    with pytest.raises(ValueError, match='ring_self_attention'):
+        ulysses_self_attention(mesh, q, q, q, axis='sp')
+
+
 def test_forward_multiblock_grids():
     # multi-block q AND k grids (2x2) — exercises the scratch accumulation
     # across the innermost grid dim and the revisited output block
