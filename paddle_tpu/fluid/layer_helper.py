@@ -10,7 +10,7 @@ from . import unique_name
 from .framework import Variable, Parameter, default_main_program, \
     default_startup_program
 from .initializer import Constant, Xavier
-from .param_attr import ParamAttr
+from .param_attr import ParamAttr, WeightNormParamAttr
 
 __all__ = ['LayerHelper']
 
@@ -109,6 +109,10 @@ class LayerHelper(object):
             attr.set_default_initializer(default_initializer)
 
         shape = [int(s) for s in shape]
+        if isinstance(attr, WeightNormParamAttr):
+            # weight-norm reparameterization w = v * g / ||v|| (reference
+            # layer_helper.py:_create_weight_normalize, arXiv:1602.07868)
+            return self._create_weight_normalize(attr, shape, dtype)
         startup_blk = self.startup_program.global_block()
         sp_var = startup_blk.create_parameter(
             name=attr.name, shape=shape, dtype=dtype,
@@ -121,6 +125,72 @@ class LayerHelper(object):
         return main_blk.create_parameter(
             name=attr.name, shape=shape, dtype=dtype,
             **{k: v for k, v in attr.to_kwargs().items() if k != 'name'})
+
+    def _append_norm_except_dim(self, block, v, dim, out):
+        """Append ops computing ||v|| over every axis except `dim` (all
+        axes when dim is None), keepdims, into var `out`."""
+        sq = block.create_var(
+            name=unique_name.generate(self.name + '.wn_sq'),
+            shape=None, dtype=v.dtype)
+        block.append_op(type='square', inputs={'X': [v]},
+                        outputs={'Out': [sq]}, infer_shape=False)
+        red = block.create_var(
+            name=unique_name.generate(self.name + '.wn_red'),
+            shape=None, dtype=v.dtype)
+        ndim = len(v.shape)
+        axes = [i for i in range(ndim) if dim is None or i != dim]
+        block.append_op(type='reduce_sum', inputs={'X': [sq]},
+                        outputs={'Out': [red]},
+                        attrs={'dim': axes, 'keep_dim': True},
+                        infer_shape=False)
+        block.append_op(type='sqrt', inputs={'X': [red]},
+                        outputs={'Out': [out]}, infer_shape=False)
+        return out
+
+    def _create_weight_normalize(self, attr, shape, dtype):
+        """w = v * (g / ||v||_except_dim): v carries the direction with the
+        user's initializer, g the magnitude, initialized in the startup
+        program to ||v_init|| so the initial w equals v_init (reference
+        layer_helper.py:232)."""
+        dim = attr.dim
+        g_shape = [1] * len(shape)
+        if dim is not None:
+            g_shape[dim] = shape[dim]
+
+        v_attr = copy.deepcopy(attr)
+        v_attr.__class__ = ParamAttr
+        v_attr.name = attr.name + '_v'
+        v = self.create_parameter(v_attr, shape, dtype)
+
+        g_attr = copy.deepcopy(attr)
+        g_attr.__class__ = ParamAttr
+        g_attr.name = attr.name + '_g'
+        g_attr.initializer = Constant(0.0)  # overwritten by startup ops
+        g = self.create_parameter(g_attr, g_shape, dtype)
+
+        # startup: g <- ||v_init||
+        startup_blk = self.startup_program.global_block()
+        self._append_norm_except_dim(startup_blk,
+                                     startup_blk.vars[v.name], dim,
+                                     startup_blk.vars[g.name])
+
+        # main: w = v * (g / ||v||), recomputed each step inside the jit
+        blk = self.main_program.current_block()
+        norm = blk.create_var(
+            name=unique_name.generate(self.name + '.wn_norm'),
+            shape=None, dtype=dtype)
+        self._append_norm_except_dim(blk, v, dim, norm)
+        scale = blk.create_var(
+            name=unique_name.generate(self.name + '.wn_scale'),
+            shape=None, dtype=dtype)
+        blk.append_op(type='elementwise_div', inputs={'X': [g], 'Y': [norm]},
+                      outputs={'Out': [scale]}, attrs={'axis': -1},
+                      infer_shape=False)
+        w = blk.create_var(name=attr.name, shape=shape, dtype=dtype)
+        blk.append_op(type='elementwise_mul', inputs={'X': [v], 'Y': [scale]},
+                      outputs={'Out': [w]}, attrs={'axis': -1},
+                      infer_shape=False)
+        return w
 
     def get_or_create_parameter(self, name, shape, dtype, is_bias=False):
         """Fetch a named parameter if this program already has it, else

@@ -20,10 +20,14 @@ __all__ = ['make_mesh', 'data_sharding', 'replicated', 'shard_batch',
            'reduce_scatter', 'ppermute', 'shard_optimizer_states',
            'init_multihost', 'Mesh', 'NamedSharding', 'P',
            'ring_attention', 'ring_self_attention',
-           'ulysses_attention', 'ulysses_self_attention']
+           'ulysses_attention', 'ulysses_self_attention',
+           'pipeline_apply', 'stack_stage_params',
+           'moe_apply', 'stack_expert_params']
 
 from .ring_attention import ring_attention, ring_self_attention  # noqa: E402
 from .ulysses import ulysses_attention, ulysses_self_attention  # noqa: E402
+from .pipeline import pipeline_apply, stack_stage_params  # noqa: E402
+from .moe import moe_apply, stack_expert_params  # noqa: E402
 
 
 def init_multihost(coordinator_address=None, num_processes=None,

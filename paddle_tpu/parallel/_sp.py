@@ -5,18 +5,40 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 
-def sp_shard_map(body, mesh, q, k, v, axis, key_bias):
+def sp_shard_map(body, mesh, q, k, v, axis, key_bias, check_vma=True):
     """Wrap a per-shard attention body in shard_map with the sequence
-    sharding contract; defaults a zero key bias."""
+    sharding contract; defaults a zero key bias. check_vma=False only for
+    bodies containing pallas calls, whose ShapeDtypeStructs carry no
+    varying-mesh-axes info (the default check rejects them)."""
     from jax import shard_map
 
     qkv_spec = P(None, None, axis, None)
     kb_spec = P(None, axis)
     if key_bias is None:
         key_bias = jnp.zeros((q.shape[0], k.shape[2]), jnp.float32)
-    # check_vma=False: the pallas flash kernel's ShapeDtypeStructs carry
-    # no varying-mesh-axes info, which the default vma check rejects
     fn = shard_map(body, mesh=mesh,
                    in_specs=(qkv_spec, qkv_spec, qkv_spec, kb_spec),
-                   out_specs=qkv_spec, check_vma=False)
+                   out_specs=qkv_spec, check_vma=check_vma)
     return fn(q, k, v, key_bias)
+
+
+def stack_unit_params(per_unit_params):
+    """[{param pytree} per stage/expert] -> one pytree with a leading unit
+    axis (shard it over the pp/ep mesh axis)."""
+    import jax
+    import jax.numpy as jnp
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *per_unit_params)
+
+
+def check_units_match_axis(stacked, mesh, axis, what):
+    """Every leaf's leading dim must EQUAL the mesh axis size — a multiple
+    would shard silently and drop units (each device applies only its
+    shard's first unit)."""
+    import jax
+    n = mesh.shape[axis]
+    for leaf in jax.tree_util.tree_leaves(stacked):
+        if leaf.shape[0] != n:
+            raise ValueError(
+                '%s: stacked leading dim %d must equal mesh axis %r size %d '
+                '(one %s per device)' % (what, leaf.shape[0], axis, n, what))

@@ -1,0 +1,82 @@
+"""Expert parallelism: top-1 gated mixture-of-experts with all_to_all
+dispatch over a mesh axis.
+
+TPU-first design (no reference counterpart — the reference predates MoE
+layers): experts live one-per-device along the `ep` mesh axis (expert
+weights stacked [n_experts, ...] and sharded like pipeline stages). Tokens
+are gated top-1, packed into fixed per-expert capacity slots (static
+shapes — overflow tokens are dropped, the standard TPU MoE trade), sent to
+their expert with ONE all_to_all, transformed, and returned with a second
+all_to_all; dropped tokens pass through the residual unchanged.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ._sp import stack_unit_params, check_units_match_axis
+
+__all__ = ['moe_apply', 'stack_expert_params']
+
+# [{param pytree} per expert] -> pytree with leading [n_experts, ...] axis
+stack_expert_params = stack_unit_params
+
+
+def moe_apply(expert_fn, stacked_params, x, gate_logits, mesh, axis='ep',
+              capacity_factor=2.0):
+    """Dispatch tokens to experts and combine.
+
+    expert_fn(params, x) -> y        applied per expert on [cap, d]
+    stacked_params: leaves [n_experts, ...], sharded over `axis`
+    x:           [n_tokens, d] tokens, sharded over `axis` (token shards)
+    gate_logits: [n_tokens, n_experts], sharded like x
+    Returns [n_tokens, d]: gate-weighted expert outputs (0 for dropped).
+    """
+    n_exp = mesh.shape[axis]
+    check_units_match_axis(stacked_params, mesh, axis, 'expert')
+    if gate_logits.shape[-1] != n_exp:
+        raise ValueError(
+            'gate_logits last dim %d must equal mesh axis %r size %d (one '
+            'expert per device)' % (gate_logits.shape[-1], axis, n_exp))
+    from jax import shard_map
+
+    def body(params, xs, logits):
+        p_local = jax.tree_util.tree_map(lambda p: p[0], params)
+        nt, d = xs.shape
+        cap = int(max(1, capacity_factor * nt / n_exp))
+
+        expert = jnp.argmax(logits, axis=-1)                   # [nt]
+        gate = jax.nn.softmax(logits.astype(jnp.float32),
+                              axis=-1)[jnp.arange(nt), expert]  # [nt]
+
+        # position of each token within its expert's capacity buffer
+        onehot = jax.nn.one_hot(expert, n_exp, dtype=jnp.int32)  # [nt, E]
+        pos = jnp.cumsum(onehot, axis=0) * onehot                # 1-based
+        slot = jnp.sum(pos, axis=-1) - 1                         # [nt]
+        keep = slot < cap
+
+        # pack: [E, cap, d] send buffer (local tokens destined per expert)
+        send = jnp.zeros((n_exp, cap, d), xs.dtype)
+        send = send.at[jnp.where(keep, expert, 0),
+                       jnp.where(keep, slot, 0)].add(
+            jnp.where(keep[:, None], xs, 0.0))
+
+        # exchange: device e receives every shard's buffer for expert e
+        recv = lax.all_to_all(send, axis, split_axis=0, concat_axis=0,
+                              tiled=True)                        # [E*cap, d]
+        out = expert_fn(p_local, recv.reshape(-1, d))
+        out = out.reshape(n_exp, cap, d)
+        back = lax.all_to_all(out, axis, split_axis=0, concat_axis=0,
+                              tiled=True).reshape(n_exp, cap, d)
+
+        # unpack + gate-weight; dropped tokens get zeros
+        y = back[jnp.where(keep, expert, 0), jnp.where(keep, slot, 0)]
+        y = jnp.where(keep[:, None], y, 0.0)
+        return (y.astype(jnp.float32) * gate[:, None]).astype(xs.dtype)
+
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P(axis), stacked_params),
+                  P(axis), P(axis)),
+        out_specs=P(axis), check_vma=False)
+    return fn(stacked_params, x, gate_logits)

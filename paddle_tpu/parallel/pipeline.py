@@ -1,0 +1,84 @@
+"""Pipeline parallelism: GPipe-style microbatch streaming over a mesh axis.
+
+TPU-first design (the reference's closest notion is device placement of
+ops; it has no pipeline engine): stage parameters are STACKED on a leading
+[n_stages, ...] axis sharded over the `pp` mesh axis, so each device holds
+exactly its stage's weights. Inside shard_map, a lax.scan runs the classic
+collective-permute pipeline: every tick each device applies its stage to
+the activation it holds, then the ring `ppermute` hands the result to the
+next stage while the first stage ingests the next microbatch. After
+n_micro + n_stages - 1 ticks the last stage has emitted every microbatch.
+Bubble fraction is (n_stages-1)/(n_micro+n_stages-1) — the standard GPipe
+trade; raise n_micro to amortize.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ._sp import stack_unit_params, check_units_match_axis
+
+__all__ = ['pipeline_apply', 'stack_stage_params']
+
+# [{param pytree} per stage] -> pytree with leading [n_stages, ...] axis
+stack_stage_params = stack_unit_params
+
+
+def pipeline_apply(stage_fn, stacked_params, microbatches, mesh, axis='pp'):
+    """Run the pipeline.
+
+    stage_fn(params, x) -> y        same signature for every stage; all
+                                    stages must map [mb, d] -> [mb, d]
+                                    (equal widths — pad if needed)
+    stacked_params: pytree, leaves [n_stages, ...], sharded over `axis`
+    microbatches:   [n_micro, mb, d] (replicated or batch-sharded on dp)
+    Returns [n_micro, mb, d]: the last stage's output per microbatch.
+    """
+    n_stages = mesh.shape[axis]
+    n_micro = microbatches.shape[0]
+    check_units_match_axis(stacked_params, mesh, axis, 'pipeline stage')
+    from jax import shard_map
+
+    def body(params, mbs):
+        # params leaves arrive as [1, ...] (this device's stage); unstack
+        p_local = jax.tree_util.tree_map(lambda x: x[0], params)
+        idx = lax.axis_index(axis)
+        is_first = idx == 0
+        is_last = idx == n_stages - 1
+        T = n_micro + n_stages - 1
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def tick(carry, t):
+            held = carry  # [mb, d] activation each device currently holds
+            # first stage ingests microbatch t (or zeros past the end)
+            mb_idx = jnp.minimum(t, n_micro - 1)
+            fresh = lax.dynamic_index_in_dim(mbs, mb_idx, axis=0,
+                                             keepdims=False)
+            x = jnp.where(is_first, fresh, held)
+            y = stage_fn(p_local, x)
+            # last stage emits y at tick t when t - (n_stages-1) >= 0
+            emit_idx = t - (n_stages - 1)
+            # everyone passes its output to the next stage; the wraparound
+            # (last -> first) is ignored by the first stage's ingest above
+            handed = lax.ppermute(y, axis, perm)
+            return handed, (y, emit_idx)
+
+        init = jnp.zeros(mbs.shape[1:], mbs.dtype)
+        _, (ys, emit_idxs) = lax.scan(tick, init, jnp.arange(T))
+        # gather the last stage's outputs in microbatch order
+        out = jnp.zeros((n_micro,) + mbs.shape[1:], mbs.dtype)
+        valid = emit_idxs >= 0
+        out = out.at[jnp.where(valid, emit_idxs, 0)].add(
+            jnp.where(valid[:, None, None], ys, 0.0))
+        # only the last stage holds real outputs; broadcast them to all
+        # shards so the result is replicated over the pp axis
+        out = jnp.where(is_last, out, 0.0)
+        out = lax.psum(out, axis)
+        return out
+
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P(axis), stacked_params),
+                  P()),
+        out_specs=P(), check_vma=False)
+    return fn(stacked_params, microbatches)

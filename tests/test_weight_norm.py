@@ -1,0 +1,102 @@
+"""WeightNormParamAttr reparameterization (reference
+layer_helper.py:_create_weight_normalize + tests/unittests/
+test_weight_normalization.py): w = v * g / ||v||."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.backward import append_backward
+from paddle_tpu.fluid.executor import global_scope
+
+from util import fresh_program
+
+
+def test_weight_norm_params_and_init():
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[4], dtype='float32')
+        y = layers.fc(input=x, size=3,
+                      param_attr=fluid.WeightNormParamAttr(dim=1, name='wn'),
+                      bias_attr=False)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        sc = global_scope()
+        # the parameter is split into direction v + magnitude g
+        assert 'wn_v' in sc.vars and 'wn_g' in sc.vars and 'wn' not in sc.vars
+        v = np.asarray(sc.vars['wn_v'])
+        g = np.asarray(sc.vars['wn_g'])
+        assert v.shape == (4, 3) and g.shape == (1, 3)
+        # g initialized to ||v|| along the kept dim -> initial w == v
+        np.testing.assert_allclose(g.reshape(-1), np.linalg.norm(v, axis=0),
+                                   rtol=1e-5)
+        xs = np.random.RandomState(0).rand(2, 4).astype('float32')
+        out, = exe.run(main, feed={'x': xs}, fetch_list=[y])
+        np.testing.assert_allclose(np.asarray(out), xs @ v, rtol=1e-5)
+
+
+def test_weight_norm_effective_weight_and_grads():
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[4], dtype='float32')
+        y = layers.fc(input=x, size=3,
+                      param_attr=fluid.WeightNormParamAttr(dim=1, name='wn'),
+                      bias_attr=False)
+        loss = layers.reduce_sum(y)
+        append_backward(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        sc = global_scope()
+        import jax.numpy as jnp
+        rng = np.random.RandomState(1)
+        v = rng.randn(4, 3).astype('float32')
+        g = rng.rand(1, 3).astype('float32') + 0.5
+        sc.vars['wn_v'] = jnp.asarray(v)
+        sc.vars['wn_g'] = jnp.asarray(g)
+        xs = rng.rand(2, 4).astype('float32')
+        out, gv, gg = exe.run(main, feed={'x': xs},
+                              fetch_list=[y, 'wn_v@GRAD', 'wn_g@GRAD'])
+        w = v * (g / np.linalg.norm(v, axis=0, keepdims=True))
+        np.testing.assert_allclose(np.asarray(out), xs @ w, rtol=1e-5)
+        # gradient of sum(x@w) w.r.t. g: column sums of x @ (v/||v||)
+        expect_gg = (xs @ (v / np.linalg.norm(v, axis=0,
+                                              keepdims=True))).sum(0)
+        np.testing.assert_allclose(np.asarray(gg).reshape(-1), expect_gg,
+                                   rtol=1e-4)
+        assert np.isfinite(np.asarray(gv)).all()
+
+
+def test_weight_norm_dim_none_global_norm():
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[4], dtype='float32')
+        layers.fc(input=x, size=3,
+                  param_attr=fluid.WeightNormParamAttr(name='wn2'),
+                  bias_attr=False)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        sc = global_scope()
+        v = np.asarray(sc.vars['wn2_v'])
+        g = np.asarray(sc.vars['wn2_g'])
+        assert g.shape == (1, 1)
+        np.testing.assert_allclose(float(g.squeeze()),
+                                   np.linalg.norm(v), rtol=1e-5)
+
+
+def test_weight_norm_trains():
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[4], dtype='float32')
+        lbl = layers.data(name='y', shape=[1], dtype='float32')
+        pred = layers.fc(input=x, size=1,
+                         param_attr=fluid.WeightNormParamAttr(dim=1),
+                         bias_attr=False)
+        cost = layers.mean(layers.square_error_cost(input=pred, label=lbl))
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(cost)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(2)
+        xs = rng.rand(32, 4).astype('float32')
+        ys = (xs @ np.array([[1.], [-2.], [3.], [0.5]], 'float32'))
+        first = last = None
+        for _ in range(60):
+            l, = exe.run(main, feed={'x': xs, 'y': ys}, fetch_list=[cost])
+            if first is None:
+                first = float(np.asarray(l).squeeze())
+            last = float(np.asarray(l).squeeze())
+        assert last < first * 0.1, (first, last)
