@@ -82,6 +82,53 @@ def test_fake():
     assert list(f()) == [0] * 5  # resets after exhaustion
 
 
+def test_pipe_reader_plain_and_gzip(tmp_path):
+    import gzip
+    lines = ['alpha 1', 'beta 2', 'gamma 3']
+    p = tmp_path / 'data.txt'
+    p.write_text('\n'.join(lines) + '\n')
+    pr = reader.PipeReader('cat %s' % p, bufsize=4)  # tiny buffer: splits
+    got = [l for l in pr.get_line() if l]
+    assert got == lines
+
+    gz = tmp_path / 'data.gz'
+    with gzip.open(gz, 'wt') as f:
+        f.write('\n'.join(lines) + '\n')
+    pr2 = reader.PipeReader('cat %s' % gz, file_type='gzip')
+    got2 = [l for l in pr2.get_line() if l]
+    assert got2 == lines
+
+    import pytest
+    with pytest.raises(TypeError):
+        reader.PipeReader(['not', 'a', 'string'])
+    with pytest.raises(TypeError):
+        reader.PipeReader('cat x', file_type='bz2')
+
+
+def test_pipe_reader_robustness(tmp_path):
+    import pytest
+    # multi-byte chars straddling a tiny buffer boundary
+    p = tmp_path / 'utf8.txt'
+    p.write_text('αβγδ\nεζηθ\n', encoding='utf-8')
+    got = [l for l in reader.PipeReader('cat %s' % p, bufsize=3).get_line()
+           if l]
+    assert got == ['αβγδ', 'εζηθ']
+    # quoted path with a space
+    sp = tmp_path / 'my file.txt'
+    sp.write_text('hello\n')
+    got = [l for l in reader.PipeReader('cat "%s"' % sp).get_line() if l]
+    assert got == ['hello']
+    # failing command raises instead of yielding a truncated dataset
+    with pytest.raises(IOError, match='exited with'):
+        list(reader.PipeReader('cat %s' % (tmp_path / 'missing')).get_line())
+    # abandoning the stream reaps the child
+    pr = reader.PipeReader('cat %s' % p)
+    gen = pr.get_line()
+    next(gen)
+    gen.close()
+    assert pr.process.poll() is not None  # no zombie left running
+
+
 def test_batch():
     bs = list(paddle.batch(_ints(7), batch_size=3)())
     assert [len(b) for b in bs] == [3, 3, 1]
