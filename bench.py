@@ -31,23 +31,30 @@ def _fresh():
     return framework.Program(), framework.Program()
 
 
-def bench_resnet50(batch_size=1024, warmup=3, iters=12, use_amp=True):
+def bench_resnet50(batch_size=1024, warmup=3, iters=12, use_amp=True,
+                   data_format=None):
     """ResNet-50 train step, bf16 activations end-to-end (fp32 master
     weights + BN statistics): on the MXU the bf16 path is ~35% faster than
     fp32 activations with per-op casts (2035 vs 1528 img/s at batch 1024
-    on a v5e-class chip)."""
+    on a v5e-class chip). data_format NHWC (the default on TPU; override
+    with BENCH_LAYOUT) runs the tower channels-last — XLA:TPU's native
+    layout — skipping the compiler's NCHW transposes."""
     import paddle_tpu.fluid as fluid
     from paddle_tpu.fluid import framework, unique_name
     from paddle_tpu.models.resnet import resnet_imagenet
     import jax.numpy as jnp
 
+    if data_format is None:
+        data_format = os.environ.get('BENCH_LAYOUT', 'NHWC')
+    dshape = [224, 224, 3] if data_format == 'NHWC' else [3, 224, 224]
     main, startup = _fresh()
     with unique_name.guard():
         with framework.program_guard(main, startup):
-            img = fluid.layers.data(name='data', shape=[3, 224, 224],
+            img = fluid.layers.data(name='data', shape=dshape,
                                     dtype='bfloat16' if use_amp else 'float32')
             label = fluid.layers.data(name='label', shape=[1], dtype='int64')
-            predict = resnet_imagenet(img, class_dim=1000, depth=50)
+            predict = resnet_imagenet(img, class_dim=1000, depth=50,
+                                      data_format=data_format)
             avg_cost = fluid.layers.mean(
                 fluid.layers.cross_entropy(input=predict, label=label))
             fluid.optimizer.Momentum(learning_rate=0.01, momentum=0.9) \
@@ -61,7 +68,7 @@ def bench_resnet50(batch_size=1024, warmup=3, iters=12, use_amp=True):
             rng = np.random.RandomState(0)
             # stage feed on device once; steps then measure pure device time
             data = exe._to_device(
-                rng.rand(batch_size, 3, 224, 224).astype('float32'))
+                rng.rand(batch_size, *dshape).astype('float32'))
             if use_amp:
                 data = data.astype(jnp.bfloat16)
             feed = {'data': data,

@@ -321,9 +321,15 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
 
 def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
            groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
-           use_mkldnn=False, act=None, name=None):
-    """reference nn.py:conv2d. NCHW."""
-    num_channels = input.shape[1]
+           use_mkldnn=False, act=None, name=None, data_format='NCHW'):
+    """reference nn.py:conv2d (NCHW); data_format='NHWC' runs
+    channels-last — the native XLA:TPU layout — with the SAME OIHW filter
+    params, so a model switches layout without touching checkpoints."""
+    if data_format not in ('NCHW', 'NHWC'):
+        raise ValueError("data_format must be 'NCHW' or 'NHWC', got %r"
+                         % (data_format,))
+    num_channels = (input.shape[-1] if data_format == 'NHWC'
+                    else input.shape[1])
     helper = LayerHelper('conv2d', **locals())
     dtype = helper.input_dtype()
     groups = groups or 1
@@ -353,8 +359,12 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
         inputs={'Input': [input], 'Filter': [filter_param]},
         outputs={"Output": [pre_bias]},
         attrs={'strides': stride, 'paddings': padding, 'dilations': dilation,
-               'groups': groups, 'use_cudnn': use_cudnn})
-    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+               'groups': groups, 'use_cudnn': use_cudnn,
+               'data_format': data_format})
+    if data_format == 'NHWC':
+        pre_act = helper.append_bias_op(pre_bias, dim_start=-1)
+    else:
+        pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
     return helper.append_activation(pre_act)
 
 
@@ -430,9 +440,13 @@ def softmax(input, param_attr=None, bias_attr=None, use_cudnn=True,
 
 def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
            pool_padding=0, global_pooling=False, use_cudnn=True,
-           ceil_mode=False, use_mkldnn=False, name=None):
+           ceil_mode=False, use_mkldnn=False, name=None,
+           data_format='NCHW'):
     if pool_type not in ["max", "avg"]:
         raise ValueError("pool_type must be 'max' or 'avg'")
+    if data_format not in ('NCHW', 'NHWC'):
+        raise ValueError("data_format must be 'NCHW' or 'NHWC', got %r"
+                         % (data_format,))
     if global_pooling is False and pool_size == -1:
         raise ValueError("pool_size must be set without global pooling")
 
@@ -447,7 +461,8 @@ def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
         attrs={"pooling_type": pool_type, "ksize": _pair(pool_size),
                "global_pooling": global_pooling,
                "strides": _pair(pool_stride),
-               "paddings": _pair(pool_padding), "ceil_mode": ceil_mode})
+               "paddings": _pair(pool_padding), "ceil_mode": ceil_mode,
+               "data_format": data_format})
     return pool_out
 
 

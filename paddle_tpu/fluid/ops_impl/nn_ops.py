@@ -22,14 +22,17 @@ def _pair(v, n=2):
 
 @register('conv2d')
 def _conv2d(ins, attrs, ctx):
-    """NCHW conv. reference operators/conv_op.cc (+conv_cudnn_op.cu).
-    Filter layout OIHW [out_c, in_c/groups, kh, kw]."""
+    """Conv in NCHW (reference operators/conv_op.cc) or NHWC
+    (`data_format` attr — the layout XLA:TPU lays out natively, so NHWC
+    feeds skip the compiler's transposes). Filter is always OIHW
+    [out_c, in_c/groups, kh, kw] so weights are layout-portable."""
     x = data_of(ins['Input'][0])
     w = data_of(ins['Filter'][0])
     strides = _pair(attrs.get('strides', 1))
     pads = _pair(attrs.get('paddings', 0))
     dilations = _pair(attrs.get('dilations', 1))
     groups = attrs.get('groups', 1) or 1
+    fmt = attrs.get('data_format', 'NCHW')
     in_dtype = x.dtype
     xc, wc = amp_cast(ctx, x, w.astype(x.dtype))
     # no preferred_element_type here: conv_general_dilated's transpose
@@ -42,7 +45,7 @@ def _conv2d(ins, attrs, ctx):
         padding=[(pads[0], pads[0]), (pads[1], pads[1])],
         rhs_dilation=dilations,
         feature_group_count=groups,
-        dimension_numbers=('NCHW', 'OIHW', 'NCHW'))
+        dimension_numbers=(fmt, 'OIHW', fmt))
     return {'Output': out.astype(in_dtype)}
 
 
@@ -114,18 +117,24 @@ def _conv3d_transpose(ins, attrs, ctx):
 
 
 def _pool(x, pool_type, ksize, strides, pads, global_pooling, exclusive=True,
-          ceil_mode=False):
+          ceil_mode=False, channels_last=False):
     nd = len(ksize)
     if global_pooling:
-        ksize = x.shape[2:]
+        ksize = x.shape[1:1 + nd] if channels_last else x.shape[2:]
         pads = (0,) * nd
         strides = (1,) * nd
-    window = (1, 1) + tuple(ksize)
-    strides_full = (1, 1) + tuple(strides)
-    pad_full = ((0, 0), (0, 0)) + tuple((p, p) for p in pads)
+
+    def full(spatial, fill):
+        # spatial window dims sit at [1..nd] for NHWC, [2..nd+1] for NCHW
+        return ((fill,) + tuple(spatial) + (fill,)) if channels_last \
+            else ((fill, fill) + tuple(spatial))
+
+    window = full(ksize, 1)
+    strides_full = full(strides, 1)
+    pad_full = full(((p, p) for p in pads), (0, 0))
     if ceil_mode:
-        pad_full = ((0, 0), (0, 0)) + tuple(
-            (p, p + s - 1) for p, s in zip(pads, strides))
+        pad_full = full(((p, p + s - 1) for p, s in zip(pads, strides)),
+                        (0, 0))
     if pool_type == 'max':
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
         return lax.reduce_window(x, init, lax.max, window, strides_full, pad_full)
@@ -144,7 +153,8 @@ def _pool2d(ins, attrs, ctx):
                 _pair(attrs['ksize']), _pair(attrs.get('strides', 1)),
                 _pair(attrs.get('paddings', 0)),
                 attrs.get('global_pooling', False),
-                attrs.get('exclusive', True), attrs.get('ceil_mode', False))
+                attrs.get('exclusive', True), attrs.get('ceil_mode', False),
+                channels_last=attrs.get('data_format', 'NCHW') == 'NHWC')
     return {'Out': out}
 
 

@@ -55,11 +55,14 @@ class InferenceTranspiler(object):
                 block.ops[i + 1] = block.ops[i + 1]
                 # replace bn op with add op
                 from ..framework import Operator
+                # channel axis follows the conv's layout
+                ch_axis = (-1 if op.attrs.get('data_format',
+                                              'NCHW') == 'NHWC' else 1)
                 add_op = Operator(block, type='elementwise_add',
                                   inputs={'X': op.outputs['Output'],
                                           'Y': [bias_var]},
                                   outputs={'Out': [bn_out]},
-                                  attrs={'axis': 1})
+                                  attrs={'axis': ch_axis})
                 block.ops[i + 1] = add_op
                 program._bump_version()
             i += 1

@@ -13,43 +13,53 @@ import paddle_tpu.fluid as fluid
 __all__ = ['resnet_cifar10', 'resnet_imagenet', 'get_model']
 
 
-def conv_bn_layer(input, ch_out, filter_size, stride, padding, act='relu'):
+def conv_bn_layer(input, ch_out, filter_size, stride, padding, act='relu',
+                  data_format='NCHW'):
     conv1 = fluid.layers.conv2d(
         input=input, filter_size=filter_size, num_filters=ch_out,
-        stride=stride, padding=padding, act=None, bias_attr=False)
-    return fluid.layers.batch_norm(input=conv1, act=act)
+        stride=stride, padding=padding, act=None, bias_attr=False,
+        data_format=data_format)
+    return fluid.layers.batch_norm(input=conv1, act=act,
+                                   data_layout=data_format)
 
 
-def shortcut(input, ch_out, stride):
-    ch_in = input.shape[1]
+def shortcut(input, ch_out, stride, data_format='NCHW'):
+    ch_in = input.shape[-1 if data_format == 'NHWC' else 1]
     if ch_in != ch_out:
-        return conv_bn_layer(input, ch_out, 1, stride, 0, None)
+        return conv_bn_layer(input, ch_out, 1, stride, 0, None,
+                             data_format=data_format)
     return input
 
 
-def basicblock(input, ch_out, stride):
-    short = shortcut(input, ch_out, stride)
-    conv1 = conv_bn_layer(input, ch_out, 3, stride, 1)
-    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, act=None)
+def basicblock(input, ch_out, stride, data_format='NCHW'):
+    short = shortcut(input, ch_out, stride, data_format)
+    conv1 = conv_bn_layer(input, ch_out, 3, stride, 1,
+                          data_format=data_format)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, act=None,
+                          data_format=data_format)
     return fluid.layers.elementwise_add(x=short, y=conv2, act='relu')
 
 
-def bottleneck(input, ch_out, stride):
-    short = shortcut(input, ch_out * 4, stride)
-    conv1 = conv_bn_layer(input, ch_out, 1, stride, 0)
-    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1)
-    conv3 = conv_bn_layer(conv2, ch_out * 4, 1, 1, 0, act=None)
+def bottleneck(input, ch_out, stride, data_format='NCHW'):
+    short = shortcut(input, ch_out * 4, stride, data_format)
+    conv1 = conv_bn_layer(input, ch_out, 1, stride, 0,
+                          data_format=data_format)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, data_format=data_format)
+    conv3 = conv_bn_layer(conv2, ch_out * 4, 1, 1, 0, act=None,
+                          data_format=data_format)
     return fluid.layers.elementwise_add(x=short, y=conv3, act='relu')
 
 
-def layer_warp(block_func, input, ch_out, count, stride):
-    res_out = block_func(input, ch_out, stride)
+def layer_warp(block_func, input, ch_out, count, stride, data_format='NCHW'):
+    res_out = block_func(input, ch_out, stride, data_format)
     for i in range(1, count):
-        res_out = block_func(res_out, ch_out, 1)
+        res_out = block_func(res_out, ch_out, 1, data_format)
     return res_out
 
 
-def resnet_imagenet(input, class_dim, depth=50):
+def resnet_imagenet(input, class_dim, depth=50, data_format='NCHW'):
+    """data_format='NHWC' runs the whole tower channels-last (the native
+    XLA:TPU layout; feed [N, H, W, 3]) with layout-portable OIHW weights."""
     cfg = {
         18: ([2, 2, 2, 1], basicblock),
         34: ([3, 4, 6, 3], basicblock),
@@ -58,15 +68,17 @@ def resnet_imagenet(input, class_dim, depth=50):
         152: ([3, 8, 36, 3], bottleneck),
     }
     stages, block_func = cfg[depth]
-    conv1 = conv_bn_layer(input, ch_out=64, filter_size=7, stride=2, padding=3)
+    conv1 = conv_bn_layer(input, ch_out=64, filter_size=7, stride=2,
+                          padding=3, data_format=data_format)
     pool1 = fluid.layers.pool2d(input=conv1, pool_type='avg', pool_size=3,
-                                pool_stride=2)
-    res1 = layer_warp(block_func, pool1, 64, stages[0], 1)
-    res2 = layer_warp(block_func, res1, 128, stages[1], 2)
-    res3 = layer_warp(block_func, res2, 256, stages[2], 2)
-    res4 = layer_warp(block_func, res3, 512, stages[3], 2)
+                                pool_stride=2, data_format=data_format)
+    res1 = layer_warp(block_func, pool1, 64, stages[0], 1, data_format)
+    res2 = layer_warp(block_func, res1, 128, stages[1], 2, data_format)
+    res3 = layer_warp(block_func, res2, 256, stages[2], 2, data_format)
+    res4 = layer_warp(block_func, res3, 512, stages[3], 2, data_format)
     pool2 = fluid.layers.pool2d(input=res4, pool_size=7, pool_type='avg',
-                                pool_stride=1, global_pooling=True)
+                                pool_stride=1, global_pooling=True,
+                                data_format=data_format)
     out = fluid.layers.fc(input=pool2, size=class_dim, act='softmax')
     return out
 

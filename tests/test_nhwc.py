@@ -1,0 +1,123 @@
+"""NHWC (channels-last) conv path: numerics must match NCHW with the
+SAME OIHW weights — the layout switch is a pure performance knob."""
+import numpy as np
+
+import jax.numpy as jnp
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.executor import global_scope
+from paddle_tpu.models import resnet
+
+from util import fresh_program
+
+
+def _run_layout(data_format, x_nchw, build):
+    with fresh_program() as (main, startup):
+        out = build(data_format)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        params = {n: np.asarray(v) for n, v in global_scope().vars.items()}
+        feed = x_nchw if data_format == 'NCHW' \
+            else np.ascontiguousarray(x_nchw.transpose(0, 2, 3, 1))
+        res, = exe.run(main, feed={'img': feed}, fetch_list=[out])
+    return np.asarray(res), params
+
+
+def test_conv_pool_bn_nhwc_matches_nchw():
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 3, 16, 16).astype('float32')
+
+    def build(fmt):
+        shape = [3, 16, 16] if fmt == 'NCHW' else [16, 16, 3]
+        img = layers.data(name='img', shape=shape, dtype='float32')
+        h = layers.conv2d(img, num_filters=4, filter_size=3, padding=1,
+                          stride=2, data_format=fmt)
+        h = layers.batch_norm(h, data_layout=fmt)
+        h = layers.pool2d(h, pool_size=2, pool_type='max', pool_stride=2,
+                          data_format=fmt)
+        return h
+
+    got_nchw, p1 = _run_layout('NCHW', x, build)
+    got_nhwc, p2 = _run_layout('NHWC', x, build)
+    # same param shapes (OIHW filters + per-channel bn) in both layouts
+    assert {n: v.shape for n, v in p1.items()} == \
+           {n: v.shape for n, v in p2.items()}
+    # align params: re-run NHWC with NCHW's initialized weights
+    with fresh_program() as (main, startup):
+        out = build('NHWC')
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        sc = global_scope()
+        for n, v in p1.items():
+            sc.vars[n] = jnp.asarray(v)
+        res, = exe.run(main, feed={
+            'img': np.ascontiguousarray(x.transpose(0, 2, 3, 1))},
+            fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(res).transpose(0, 3, 1, 2),
+                               got_nchw, rtol=1e-4, atol=1e-5)
+
+
+def test_nhwc_validation_and_bn_fold():
+    import pytest
+    with fresh_program() as (main, startup):
+        img = layers.data(name='img', shape=[8, 8, 3], dtype='float32')
+        with pytest.raises(ValueError, match='data_format'):
+            layers.conv2d(img, num_filters=2, filter_size=3,
+                          data_format='nhwc')
+        with pytest.raises(ValueError, match='data_format'):
+            layers.pool2d(img, pool_size=2, data_format='NWHC')
+
+    # BN fold after an NHWC conv broadcasts the bias on the channel axis
+    rng = np.random.RandomState(2)
+    x = rng.rand(2, 3, 10, 10).astype('float32')
+    with fresh_program() as (main, startup):
+        img = layers.data(name='img', shape=[10, 10, 3], dtype='float32')
+        h = layers.conv2d(img, num_filters=4, filter_size=3,
+                          data_format='NHWC', bias_attr=False)
+        h = layers.batch_norm(h, data_layout='NHWC', is_test=True)
+        infer = main.clone(for_test=True)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        sc = global_scope()
+        for n in list(sc.vars):  # non-trivial BN stats so the fold matters
+            if n.endswith('.w_1'):
+                sc.vars[n] = jnp.asarray(rng.rand(4).astype('float32'))
+            elif n.endswith('.w_2'):
+                sc.vars[n] = jnp.asarray(rng.rand(4).astype('float32') + .5)
+        feed = {'img': np.ascontiguousarray(x.transpose(0, 2, 3, 1))}
+        want, = exe.run(infer, feed=feed, fetch_list=[h])
+        t = fluid.InferenceTranspiler()
+        folded = t.transpile(infer, fluid.CPUPlace())
+        got, = exe.run(folded, feed=feed, fetch_list=[h])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_resnet18_nhwc_matches_nchw():
+    rng = np.random.RandomState(1)
+    x = rng.rand(2, 3, 32, 32).astype('float32')
+
+    def run(fmt, params=None):
+        with fresh_program() as (main, startup):
+            shape = [3, 32, 32] if fmt == 'NCHW' else [32, 32, 3]
+            img = layers.data(name='img', shape=shape, dtype='float32')
+            out = resnet.resnet_imagenet(img, class_dim=10, depth=18,
+                                         data_format=fmt)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            sc = global_scope()
+            if params is not None:
+                for n, v in params.items():
+                    sc.vars[n] = jnp.asarray(v)
+            snap = {n: np.asarray(v) for n, v in sc.vars.items()}
+            feed = x if fmt == 'NCHW' \
+                else np.ascontiguousarray(x.transpose(0, 2, 3, 1))
+            res, = exe.run(main, feed={'img': feed}, fetch_list=[out])
+        return np.asarray(res), snap
+
+    want, params = run('NCHW')
+    got, _ = run('NHWC', params=params)
+    # fp32 accumulation order differs per layout; over 18 conv layers the
+    # softmax outputs drift ~1e-4 — identical math, different reductions
+    np.testing.assert_allclose(got, want, rtol=5e-3, atol=2e-4)
+    assert got.argmax(-1).tolist() == want.argmax(-1).tolist()
