@@ -490,6 +490,9 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-05,
                moving_mean_name=None, moving_variance_name=None,
                do_model_average_for_mean_and_var=False):
     """reference nn.py:batch_norm."""
+    if data_layout not in ('NCHW', 'NHWC'):
+        raise ValueError("data_layout must be 'NCHW' or 'NHWC', got %r"
+                         % (data_layout,))
     helper = LayerHelper('batch_norm', **locals())
     dtype = helper.input_dtype()
     input_shape = input.shape

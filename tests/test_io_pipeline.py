@@ -1,0 +1,96 @@
+"""layers.io reader pipeline: py_reader / open_recordio_file /
+double_buffer / shuffle / batch feeding training (parity: reference
+layers/io.py reader-op chain + tests/unittests/test_py_reader*)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.reader import recordio as rio
+
+from util import fresh_program
+
+
+def test_py_reader_feeds_training():
+    with fresh_program() as (main, startup):
+        reader = layers.py_reader(capacity=8, shapes=[[-1, 4], [-1, 1]],
+                                  dtypes=['float32', 'float32'],
+                                  name='train_reader')
+        x, y = layers.read_file(reader)
+        pred = layers.fc(input=x, size=1)
+        cost = layers.mean(layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.Adam(learning_rate=0.1).minimize(cost)
+
+        rng = np.random.RandomState(0)
+        W = np.array([[1.], [-2.], [3.], [0.5]], 'float32')
+
+        def gen():
+            for _ in range(16):
+                xs = rng.rand(32, 4).astype('float32')
+                yield xs, xs @ W
+
+        reader.decorate_paddle_reader(gen)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        losses = []
+        for epoch in range(6):
+            reader.start()
+            while True:
+                try:
+                    xs, ys = reader.next()
+                except StopIteration:
+                    break
+                l, = exe.run(main, feed={x.name: xs, y.name: ys},
+                             fetch_list=[cost])
+                losses.append(float(np.asarray(l).squeeze()))
+            reader.reset()
+        assert losses[-1] < losses[0] * 0.1, (losses[0], losses[-1])
+
+
+def test_open_recordio_file_chain(tmp_path):
+    # write samples, then read through the full chain:
+    # open_recordio_file -> shuffle -> batch -> double_buffer
+    path = str(tmp_path / 'train.ptrio')
+    rng = np.random.RandomState(1)
+    samples = [(rng.rand(4).astype('float32'),
+                np.array([i % 3], 'int64')) for i in range(64)]
+    rio.write_samples(path, samples)
+
+    with fresh_program() as (main, startup):
+        reader = layers.open_recordio_file(
+            path, shapes=[[-1, 4], [-1, 1]], lod_levels=[0, 0],
+            dtypes=['float32', 'int64'], pass_num=2)
+        reader = layers.shuffle(reader, buffer_size=16)
+        reader = layers.batch(reader, batch_size=8)
+        reader = layers.double_buffer(reader)
+        seen = 0
+        xs_all = []
+        for batch in reader():
+            xs = np.stack([s[0] for s in batch])
+            assert xs.shape == (8, 4)
+            xs_all.append(xs)
+            seen += len(batch)
+        assert seen == 128  # 64 samples x 2 passes
+    # shuffle actually permuted the stream
+    flat = np.concatenate(xs_all)[:64]
+    orig = np.stack([s[0] for s in samples])
+    assert not np.allclose(flat, orig)
+
+
+def test_double_buffer_preserves_order_and_content():
+    def gen():
+        for i in range(50):
+            yield (np.full((2,), i, 'float32'),)
+
+    buffered = layers.double_buffer(gen)
+    got = [int(s[0][0]) for s in buffered()]
+    assert got == list(range(50))
+
+
+def test_random_data_generator_shapes():
+    gen = layers.random_data_generator(low=-1.0, high=1.0,
+                                       shapes=[[8, 3], [8, 1]],
+                                       lod_levels=[0, 0])
+    it = gen() if callable(gen) else gen
+    sample = next(it() if callable(it) else it)
+    assert sample[0].shape == (8, 3) and sample[1].shape == (8, 1)
+    assert (np.abs(sample[0]) <= 1.0).all()

@@ -39,6 +39,8 @@ def test_conv_pool_bn_nhwc_matches_nchw():
 
     got_nchw, p1 = _run_layout('NCHW', x, build)
     got_nhwc, p2 = _run_layout('NHWC', x, build)
+    assert got_nhwc.shape == (2, 4, 4, 4) and \
+        np.isfinite(got_nhwc).all()
     # same param shapes (OIHW filters + per-channel bn) in both layouts
     assert {n: v.shape for n, v in p1.items()} == \
            {n: v.shape for n, v in p2.items()}
