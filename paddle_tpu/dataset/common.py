@@ -4,7 +4,8 @@ import os
 
 import numpy as np
 
-__all__ = ['DATA_HOME', 'download', 'md5file', 'data_path', 'synthetic_rng']
+__all__ = ['DATA_HOME', 'download', 'md5file', 'data_path', 'synthetic_rng',
+           'split', 'cluster_files_reader', 'convert']
 
 DATA_HOME = os.path.expanduser('~/.cache/paddle_tpu/dataset')
 
@@ -44,3 +45,80 @@ def synthetic_rng(tag, seed=1234):
     """Deterministic per-dataset RNG for synthetic fallbacks."""
     h = int(hashlib.md5(tag.encode()).hexdigest()[:8], 16)
     return np.random.RandomState((seed + h) % (2 ** 31))
+
+
+def split(reader, line_count, suffix="%05d.pickle", dumper=None):
+    """Shard a reader's samples into files of line_count samples each
+    (reference common.py:split; binary pickle by default)."""
+    import pickle
+    if dumper is None:
+        dumper = pickle.dump
+    if not callable(dumper):
+        raise TypeError("dumper should be callable.")
+    lines = []
+    indx_f = 0
+    for d in reader():
+        lines.append(d)
+        if len(lines) == line_count:
+            with open(suffix % indx_f, "wb") as f:
+                dumper(lines, f)
+            lines = []
+            indx_f += 1
+    if lines:
+        with open(suffix % indx_f, "wb") as f:
+            dumper(lines, f)
+    return indx_f + (1 if lines else 0)
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=None):
+    """Round-robin the files produced by split() across trainers
+    (reference common.py:cluster_files_reader)."""
+    import glob
+    import pickle
+    if loader is None:
+        loader = pickle.load
+
+    def reader():
+        if not callable(loader):
+            raise TypeError("loader should be callable.")
+        file_list = sorted(glob.glob(files_pattern))
+        for idx, fn in enumerate(file_list):
+            if idx % trainer_count == trainer_id:
+                with open(fn, "rb") as f:
+                    for line in loader(f):
+                        yield line
+
+    return reader
+
+
+def convert(output_path, reader, line_count, name_prefix):
+    """Serialize a reader into sharded recordio files
+    `<output_path>/<name_prefix>-00000...` of line_count samples each
+    (reference common.py:convert; backed by our C++-format recordio
+    writer, one pickled sample per record)."""
+    import pickle
+    from ..reader.recordio import RecordIOWriter
+    if line_count < 1:
+        raise ValueError("line_count must be >= 1, got %r" % (line_count,))
+    indx_f = 0
+    written = 0
+
+    def write_shard(idx, lines):
+        filename = "%s/%s-%05d" % (output_path, name_prefix, idx)
+        with RecordIOWriter(filename) as w:
+            for l in lines:
+                w.write(pickle.dumps(l, pickle.HIGHEST_PROTOCOL))
+
+    lines = []
+    for d in reader():
+        lines.append(d)
+        if len(lines) == line_count:
+            write_shard(indx_f, lines)
+            written += len(lines)
+            lines = []
+            indx_f += 1
+    if lines:
+        write_shard(indx_f, lines)
+        written += len(lines)
+    return written

@@ -3,7 +3,7 @@
 import numpy as np
 from . import common
 
-__all__ = ['train', 'test', 'word_dict']
+__all__ = ['train', 'test', 'word_dict', 'build_dict', 'convert']
 
 _VOCAB = 5147
 
@@ -39,3 +39,15 @@ def test(word_idx=None):
         for s in _synthetic(256, 'test'):
             yield s
     return reader
+
+
+def build_dict(pattern=None, cutoff=None):
+    """reference imdb.py:build_dict (word -> id); synthetic vocab here."""
+    return word_dict()
+
+
+def convert(path):
+    """Serialize train/test to recordio (reference imdb.py:convert)."""
+    w = word_dict()
+    common.convert(path, train(w), 1000, "imdb_train")
+    common.convert(path, test(w), 1000, "imdb_test")

@@ -8,7 +8,7 @@ import numpy as np
 
 from . import common
 
-__all__ = ['train', 'test']
+__all__ = ['train', 'test', 'convert']
 
 TRAIN_IMAGE_URL = 'http://yann.lecun.com/exdb/mnist/train-images-idx3-ubyte.gz'
 TRAIN_LABEL_URL = 'http://yann.lecun.com/exdb/mnist/train-labels-idx1-ubyte.gz'
@@ -58,3 +58,9 @@ def train():
 
 def test():
     return _reader_creator(TEST_IMAGE_URL, TEST_LABEL_URL, 'test', 1024)
+
+
+def convert(path):
+    """Serialize train/test to recordio (reference mnist.py:convert)."""
+    common.convert(path, train(), 1000, "minist_train")
+    common.convert(path, test(), 1000, "minist_test")

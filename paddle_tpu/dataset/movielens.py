@@ -3,7 +3,8 @@ import numpy as np
 from . import common
 
 __all__ = ['train', 'test', 'max_user_id', 'max_movie_id', 'max_job_id',
-           'age_table', 'movie_categories', 'get_movie_title_dict']
+           'age_table', 'movie_categories', 'get_movie_title_dict',
+           'movie_info', 'user_info', 'MovieInfo', 'UserInfo', 'convert']
 
 age_table = [1, 18, 25, 35, 45, 50, 56]
 
@@ -62,3 +63,73 @@ def test():
         for s in _synthetic(512, 'test'):
             yield s
     return reader
+
+
+class MovieInfo(object):
+    """reference movielens.py:MovieInfo."""
+
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self):
+        return [self.index, [CATEGORIES_DICT[c] for c in self.categories],
+                [MOVIE_TITLE_DICT[w.lower()] for w in self.title.split()]]
+
+    def __repr__(self):
+        return "<MovieInfo id(%d), title(%s), categories(%s)>" % (
+            self.index, self.title, self.categories)
+
+
+class UserInfo(object):
+    """reference movielens.py:UserInfo."""
+
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == 'M'
+        self.age = age_table.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [self.index, 0 if self.is_male else 1, self.age, self.job_id]
+
+    def __repr__(self):
+        return "<UserInfo id(%d), gender(%s), age(%d), job(%d)>" % (
+            self.index, "M" if self.is_male else "F",
+            age_table[self.age], self.job_id)
+
+
+CATEGORIES_DICT = {c: i for i, c in enumerate(movie_categories())}
+MOVIE_TITLE_DICT = get_movie_title_dict()
+
+
+def movie_info():
+    """id -> MovieInfo for the synthetic catalog (reference
+    movielens.py:movie_info)."""
+    rng = common.synthetic_rng('movielens_catalog')
+    out = {}
+    for mid in range(1, max_movie_id() + 1):
+        cats = [_CATEGORIES[int(rng.randint(0, len(_CATEGORIES)))]]
+        title = ' '.join('t%d' % int(t)
+                         for t in rng.randint(0, _TITLE_WORDS, size=3))
+        out[mid] = MovieInfo(mid, cats, title)
+    return out
+
+
+def user_info():
+    """id -> UserInfo for the synthetic users (reference
+    movielens.py:user_info)."""
+    rng = common.synthetic_rng('movielens_users')
+    out = {}
+    for uid in range(1, max_user_id() + 1):
+        out[uid] = UserInfo(uid, 'M' if rng.rand() < 0.5 else 'F',
+                            age_table[int(rng.randint(0, len(age_table)))],
+                            int(rng.randint(0, max_job_id() + 1)))
+    return out
+
+
+def convert(path):
+    """Serialize train/test to recordio (reference movielens.py:convert)."""
+    common.convert(path, train(), 1000, "movielens_train")
+    common.convert(path, test(), 1000, "movielens_test")

@@ -1,7 +1,7 @@
 """Movie-review sentiment. Parity: reference python/paddle/dataset/sentiment.py."""
 from . import imdb
 
-__all__ = ['train', 'test', 'get_word_dict']
+__all__ = ['train', 'test', 'get_word_dict', 'convert']
 
 
 def get_word_dict():
@@ -14,3 +14,10 @@ def train():
 
 def test():
     return imdb.test()
+
+
+def convert(path):
+    """Serialize train/test to recordio (reference sentiment.py:convert)."""
+    from . import common  # sentiment has no top-level common import
+    common.convert(path, train(), 1000, "sentiment_train")
+    common.convert(path, test(), 1000, "sentiment_test")

@@ -6,7 +6,7 @@ import numpy as np
 
 from . import common
 
-__all__ = ['train', 'test', 'feature_range']
+__all__ = ['train', 'test', 'feature_range', 'convert']
 
 URL = 'https://archive.ics.uci.edu/ml/machine-learning-databases/housing/housing.data'
 MD5 = 'd4accdce7a25600298819f8e28e8d593'
@@ -62,3 +62,10 @@ def test():
         for d in UCI_TEST_DATA:
             yield d[:-1], d[-1:]
     return reader
+
+
+def convert(path):
+    """Serialize train/test to recordio (reference uci_housing.py:convert,
+    including its 'uci_houseing_test' prefix typo for name parity)."""
+    common.convert(path, train(), 1000, "uci_housing_train")
+    common.convert(path, test(), 1000, "uci_houseing_test")

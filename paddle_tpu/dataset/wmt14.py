@@ -3,7 +3,7 @@
 import numpy as np
 from . import common
 
-__all__ = ['train', 'test', 'N']
+__all__ = ['train', 'test', 'get_dict', 'convert', 'N']
 
 N = 30000  # vocab size in reference's pruned dict
 
@@ -31,3 +31,19 @@ def test(dict_size=N):
         for s in _synthetic(256, 'test', dict_size):
             yield s
     return reader
+
+
+def get_dict(dict_size, reverse=True):
+    """reference wmt14.py:get_dict -> (src_dict, trg_dict); id->word when
+    reverse (the reference default)."""
+    d = {('w%d' % i): i for i in range(dict_size)}
+    if reverse:
+        d = {v: k for k, v in d.items()}
+    return d, dict(d)
+
+
+def convert(path):
+    """Serialize train/test to recordio (reference wmt14.py:convert)."""
+    dict_size = 30000
+    common.convert(path, train(dict_size), 1000, "wmt14_train")
+    common.convert(path, test(dict_size), 1000, "wmt14_test")

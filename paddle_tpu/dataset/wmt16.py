@@ -2,7 +2,8 @@
 import numpy as np
 from . import common
 
-__all__ = ['train', 'test', 'get_dict']
+__all__ = ['train', 'test', 'validation', 'get_dict', 'fetch',
+           'convert']
 
 
 def get_dict(lang, dict_size, reverse=False):
@@ -33,3 +34,27 @@ def test(src_dict_size=10000, trg_dict_size=10000, src_lang='en'):
         for s in _synthetic(256, 'test', src_dict_size, trg_dict_size):
             yield s
     return reader
+
+
+def validation(src_dict_size=10000, trg_dict_size=10000, src_lang='en'):
+    """reference wmt16.py:validation (held-out split)."""
+    def reader():
+        for s in _synthetic(256, 'valid', src_dict_size, trg_dict_size):
+            yield s
+    return reader
+
+
+def fetch():
+    """Zero-egress environment: nothing to download; synthetic data is
+    generated on the fly (reference wmt16.py:fetch pre-downloads)."""
+    return None
+
+
+def convert(path, src_dict_size=10000, trg_dict_size=10000, src_lang='en'):
+    """Serialize splits to recordio (reference wmt16.py:convert)."""
+    common.convert(path, train(src_dict_size, trg_dict_size, src_lang),
+                   1000, "wmt16_train")
+    common.convert(path, test(src_dict_size, trg_dict_size, src_lang),
+                   1000, "wmt16_test")
+    common.convert(path, validation(src_dict_size, trg_dict_size, src_lang),
+                   1000, "wmt16_validation")

@@ -1,0 +1,87 @@
+"""Dataset utility APIs: common.split/cluster_files_reader/convert and
+the per-dataset convert/info helpers (reference python/paddle/dataset/
+common.py + tests/common_test.py)."""
+import pickle
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.dataset import common, movielens
+from paddle_tpu.reader.recordio import RecordIOReader
+
+
+def _ints(n):
+    def r():
+        for i in range(n):
+            yield (i, i * i)
+    return r
+
+
+def test_split_and_cluster_files_reader(tmp_path):
+    suffix = str(tmp_path / 'part-%05d.pickle')
+    n_files = common.split(_ints(25), line_count=10, suffix=suffix)
+    assert n_files == 3
+    # every trainer sees a disjoint round-robin subset; union == all
+    seen = []
+    for tid in range(2):
+        r = common.cluster_files_reader(str(tmp_path / 'part-*.pickle'),
+                                        trainer_count=2, trainer_id=tid)
+        seen.append(sorted(s[0] for s in r()))
+    assert sorted(seen[0] + seen[1]) == list(range(25))
+    assert not set(seen[0]) & set(seen[1])
+    with pytest.raises(TypeError):
+        common.split(_ints(3), 2, suffix, dumper="not callable")
+
+
+def test_convert_writes_recordio_shards(tmp_path):
+    n = common.convert(str(tmp_path), _ints(23), 10, "toy")
+    assert n == 23
+    files = sorted(p.name for p in tmp_path.iterdir())
+    assert files == ['toy-00000', 'toy-00001', 'toy-00002']
+    samples = []
+    for f in files:
+        for payload in RecordIOReader(str(tmp_path / f)):
+            samples.append(pickle.loads(payload))
+    assert sorted(s[0] for s in samples) == list(range(23))
+
+
+def test_dataset_convert_wrappers(tmp_path):
+    # smoke one light wrapper end-to-end (uci-free: mnist is big; use
+    # imikolov which is 4096+512 small tuples)
+    paddle.dataset.imikolov.convert(str(tmp_path))
+    names = sorted(p.name for p in tmp_path.iterdir())
+    assert any(n.startswith('imikolov_train-') for n in names)
+    assert any(n.startswith('imikolov_test-') for n in names)
+    payload = next(iter(RecordIOReader(
+        str(tmp_path / [n for n in names if 'train' in n][0]))))
+    sample = pickle.loads(payload)
+    assert len(sample) == 5  # 5-gram
+
+
+def test_movielens_info():
+    movies = movielens.movie_info()
+    users = movielens.user_info()
+    assert len(movies) == movielens.max_movie_id()
+    assert len(users) == movielens.max_user_id()
+    m = movies[1]
+    idx, cats, title = m.value()
+    assert idx == 1 and len(cats) == 1 and len(title) == 3
+    assert 'MovieInfo' in repr(m)
+    u = users[1]
+    uv = u.value()
+    assert uv[0] == 1 and uv[1] in (0, 1)
+    assert 0 <= uv[2] < len(movielens.age_table)
+    assert 'UserInfo' in repr(u)
+
+
+def test_wmt_dict_helpers():
+    src, trg = paddle.dataset.wmt14.get_dict(100)
+    assert src[5] == 'w5'  # reversed: id -> word
+    d = paddle.dataset.wmt16.get_dict('en', 50)
+    assert d['w7'] == 7
+    assert paddle.dataset.wmt16.fetch() is None
+    val = paddle.dataset.wmt16.validation(100, 100)
+    s = next(val())
+    assert len(s) == 3
+    assert paddle.dataset.imdb.build_dict() == paddle.dataset.imdb.word_dict()
