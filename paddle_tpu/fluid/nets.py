@@ -121,11 +121,11 @@ def scaled_dot_product_attention(queries, keys, values, num_heads=1,
     key_dim_per_head = keys.shape[-1] // num_heads
     scaled_q = layers.scale(x=q, scale=key_dim_per_head ** -0.5)
     product = layers.matmul(x=scaled_q, y=k, transpose_y=True)
-    weights = layers.reshape(
-        x=product, shape=[-1, product.shape[-1]], act='softmax')
-    weights = layers.reshape(x=weights, shape=[0, 0, -1, product.shape[-1]]
-                             if len(product.shape) == 4
-                             else [0, -1, product.shape[-1]])
+    # the reference flattens to 2-D because its softmax op was 2-D-only
+    # (nets.py:scaled_dot_product_attention); ours normalizes the last
+    # axis at any rank, so softmax applies directly — fewer reshapes for
+    # XLA to fuse away
+    weights = layers.softmax(product)
     if dropout_rate:
         weights = layers.dropout(weights, dropout_prob=dropout_rate,
                                  is_test=False)

@@ -1,0 +1,91 @@
+"""fluid.nets composites vs numpy references (parity: reference
+nets.py + tests/unittests coverage of the composites)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers, nets
+
+from util import fresh_program
+
+
+def _run(build, feed):
+    with fresh_program() as (main, startup):
+        outs = build()
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        res = exe.run(main, feed=feed, fetch_list=list(outs))
+    return [np.asarray(r) for r in res]
+
+
+def test_glu_numeric():
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 8).astype('float32')
+
+    def build():
+        xv = layers.data(name='x', shape=[8], dtype='float32')
+        return nets.glu(xv, dim=-1)
+    out, = _run(build, {'x': x})
+    a, b = x[:, :4], x[:, 4:]
+    expect = a * (1.0 / (1.0 + np.exp(-b)))
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_simple_img_conv_pool_shapes():
+    rng = np.random.RandomState(1)
+    x = rng.rand(2, 1, 12, 12).astype('float32')
+
+    def build():
+        xv = layers.data(name='x', shape=[1, 12, 12], dtype='float32')
+        return nets.simple_img_conv_pool(
+            input=xv, num_filters=4, filter_size=3, pool_size=2,
+            pool_stride=2, act='relu')
+    out, = _run(build, {'x': x})
+    assert out.shape[0] == 2 and out.shape[1] == 4
+    assert (out >= 0).all()  # relu
+
+
+def test_img_conv_group_vgg_block():
+    rng = np.random.RandomState(2)
+    x = rng.rand(2, 3, 8, 8).astype('float32')
+
+    def build():
+        xv = layers.data(name='x', shape=[3, 8, 8], dtype='float32')
+        return nets.img_conv_group(
+            input=xv, conv_num_filter=[4, 4], pool_size=2, pool_stride=2,
+            conv_with_batchnorm=True, conv_batchnorm_drop_rate=0.0,
+            pool_type='max')
+    out, = _run(build, {'x': x})
+    assert out.shape == (2, 4, 4, 4)  # two 3x3 convs + 2x2/s2 pool
+    assert np.isfinite(out).all()
+
+
+def test_scaled_dot_product_attention_single_head():
+    rng = np.random.RandomState(3)
+    q = rng.rand(2, 5, 8).astype('float32')
+    k = rng.rand(2, 7, 8).astype('float32')
+    v = rng.rand(2, 7, 8).astype('float32')
+
+    def build():
+        qv = layers.data(name='q', shape=[5, 8], dtype='float32')
+        kv = layers.data(name='k', shape=[7, 8], dtype='float32')
+        vv = layers.data(name='v', shape=[7, 8], dtype='float32')
+        return nets.scaled_dot_product_attention(qv, kv, vv, num_heads=1)
+    out, = _run(build, {'q': q, 'k': k, 'v': v})
+    s = np.einsum('bqd,bkd->bqk', q * (8 ** -0.5), k)
+    w = np.exp(s - s.max(-1, keepdims=True))
+    w /= w.sum(-1, keepdims=True)
+    expect = np.einsum('bqk,bkd->bqd', w, v)
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_scaled_dot_product_attention_multi_head():
+    rng = np.random.RandomState(4)
+    q = rng.rand(2, 5, 8).astype('float32')
+
+    def build():
+        qv = layers.data(name='q', shape=[5, 8], dtype='float32')
+        return nets.scaled_dot_product_attention(qv, qv, qv, num_heads=2)
+    out, = _run(build, {'q': q})
+    assert out.shape == (2, 5, 8)
+    assert np.isfinite(out).all()
