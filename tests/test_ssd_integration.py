@@ -1,0 +1,64 @@
+"""SSD-style detection pipeline composed end-to-end: conv features ->
+multi_box_head -> ssd_loss training step, then detection_output inference
+(the reference exercises this composition in its object_detection book
+chapter; op-level tests live in test_ops_detection.py)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+from util import fresh_program
+
+
+def _tiny_ssd(img_shape=(3, 32, 32), num_classes=4):
+    img = layers.data(name='img', shape=list(img_shape), dtype='float32')
+    gt_box = layers.data(name='gt_box', shape=[4], dtype='float32',
+                         lod_level=1)
+    gt_label = layers.data(name='gt_label', shape=[1], dtype='int64',
+                           lod_level=1)
+    c1 = layers.conv2d(img, num_filters=8, filter_size=3, stride=2,
+                       padding=1, act='relu')
+    c2 = layers.conv2d(c1, num_filters=8, filter_size=3, stride=2,
+                       padding=1, act='relu')
+    locs, confs, prior, var = layers.multi_box_head(
+        inputs=[c1, c2], image=img, base_size=32,
+        num_classes=num_classes, aspect_ratios=[[1.], [1., 2.]],
+        min_ratio=20, max_ratio=90)
+    loss = layers.ssd_loss(locs, confs, gt_box, gt_label, prior, var)
+    loss = layers.reduce_sum(loss)
+    return img, gt_box, gt_label, locs, confs, prior, var, loss
+
+
+def test_ssd_trains_and_infers():
+    rng = np.random.RandomState(0)
+    with fresh_program() as (main, startup):
+        (img, gt_box, gt_label, locs, confs, prior, var,
+         loss) = _tiny_ssd()
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+        nmsed = layers.detection_output(locs, confs, prior, var,
+                                        nms_threshold=0.45)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+
+        imgs = rng.rand(2, 3, 32, 32).astype('float32')
+        # one gt box per image, normalized ltrb
+        boxes = fluid.create_lod_tensor(
+            np.array([[0.1, 0.1, 0.5, 0.5],
+                      [0.3, 0.3, 0.8, 0.8]], 'float32'), [[1, 1]])
+        lbls = fluid.create_lod_tensor(
+            np.array([[1], [2]], 'int64'), [[1, 1]])
+        feed = {'img': imgs, 'gt_box': boxes, 'gt_label': lbls}
+
+        losses = []
+        for _ in range(8):
+            l, = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(l).squeeze()))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0], losses  # optimizing the ssd loss
+
+        out, = exe.run(main, feed=feed, fetch_list=[nmsed])
+        out = np.asarray(out)
+        # [N, 6] rows: label, score, ltrb — scores within [0,1]
+        assert out.shape[-1] == 6
+        if out.size:
+            assert (out[..., 1] >= 0).all() and (out[..., 1] <= 1.0001).all()
