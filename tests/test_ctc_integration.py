@@ -1,0 +1,78 @@
+"""CTC pipeline composed end-to-end: per-frame classifier -> warpctc
+training -> ctc_greedy_decoder + edit_distance evaluation (the
+reference's OCR/CRNN recipe; op-level CTC tests live in
+test_ops_crf_ctc.py)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+from util import fresh_program
+
+VOCAB = 5        # 0 = blank, classes 1..4
+FRAME_DIM = 8
+
+
+def _make_batch(rng, n, t=10):
+    """Frames carry a (noisy) one-hot of the class emitted at that step;
+    labels are the deduplicated non-blank sequence — learnable alignment."""
+    xs, labels, lens = [], [], []
+    for _ in range(n):
+        cls = rng.randint(1, VOCAB, size=3)
+        # each class occupies a few frames, blanks between
+        frames = []
+        emit = []
+        for c in cls:
+            for _ in range(rng.randint(2, 4)):
+                frames.append(c)
+            emit.append(c)
+            frames.append(0)  # blank separator
+        frames = frames[:t] + [0] * max(0, t - len(frames))
+        x = np.zeros((t, FRAME_DIM), 'float32')
+        for i, c in enumerate(frames[:t]):
+            x[i, c] = 1.0
+        x += rng.rand(t, FRAME_DIM).astype('float32') * 0.1
+        xs.append(x)
+        labels.append(np.array(emit, 'int64')[:, None])
+        lens.append(len(emit))
+    return xs, labels, lens
+
+
+def test_ctc_trains_and_decodes():
+    rng = np.random.RandomState(0)
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[FRAME_DIM], dtype='float32',
+                        lod_level=1)
+        label = layers.data(name='label', shape=[1], dtype='int64',
+                            lod_level=1)
+        logits = layers.fc(input=x, size=VOCAB)
+        loss = layers.mean(layers.warpctc(input=logits, label=label,
+                                          blank=0))
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+        decoded = layers.ctc_greedy_decoder(
+            layers.softmax(logits), blank=0)
+        dist, seq_num = layers.edit_distance(decoded, label,
+                                             normalized=False)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+
+        xs, labels, lens = _make_batch(rng, 16)
+        x_feed = fluid.create_lod_tensor(
+            np.concatenate(xs), [[len(s) for s in xs]])
+        l_feed = fluid.create_lod_tensor(
+            np.concatenate(labels), [lens])
+        feed = {'x': x_feed, 'label': l_feed}
+
+        losses = []
+        for _ in range(60):
+            l, = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(l).squeeze()))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+        d, n = exe.run(main, feed=feed, fetch_list=[dist, seq_num])
+        d = np.asarray(d)
+        # after training, the greedy decode is close to the labels:
+        # average edit distance well below the ~3-token label length
+        assert float(d.mean()) < 1.5, d.squeeze()
+        assert int(np.asarray(n).reshape(-1)[0]) == 16
