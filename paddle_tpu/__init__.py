@@ -11,6 +11,7 @@ __version__ = '0.14.0+tpu.r1'
 from . import fluid  # noqa: F401
 from . import reader  # noqa: F401
 from . import dataset  # noqa: F401
+from . import compat  # noqa: F401
 from .batch import batch  # noqa: F401
 
-__all__ = ['fluid', 'reader', 'dataset', 'batch']
+__all__ = ['fluid', 'reader', 'dataset', 'compat', 'batch']

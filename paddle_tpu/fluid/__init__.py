@@ -42,8 +42,8 @@ from . import debugger
 from .core import CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace
 from .parallel_executor import ParallelExecutor, ExecutionStrategy, BuildStrategy
 from . import transpiler
-from .transpiler import DistributeTranspiler, InferenceTranspiler, \
-    memory_optimize, release_memory
+from .transpiler import DistributeTranspiler, DistributeTranspilerConfig, \
+    InferenceTranspiler, memory_optimize, release_memory
 from . import trainer
 from .trainer import Trainer, BeginEpochEvent, EndEpochEvent, \
     BeginStepEvent, EndStepEvent, CheckpointConfig

@@ -4,10 +4,12 @@ Parity: reference python/paddle/fluid/transpiler/ — distribute (pserver/
 gRPC), inference, memory optimization. See each module for the TPU-first
 redesign.
 """
-from .distribute_transpiler import DistributeTranspiler
+from .distribute_transpiler import (DistributeTranspiler,
+                                    DistributeTranspilerConfig)
 from .inference_transpiler import InferenceTranspiler
 from .memory_optimization_transpiler import memory_optimize, release_memory
 from .ps_dispatcher import HashName, RoundRobin
 
-__all__ = ['DistributeTranspiler', 'InferenceTranspiler', 'memory_optimize',
+__all__ = ['DistributeTranspiler', 'DistributeTranspilerConfig',
+           'InferenceTranspiler', 'memory_optimize',
            'release_memory', 'HashName', 'RoundRobin']

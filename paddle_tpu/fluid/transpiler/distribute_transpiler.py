@@ -21,12 +21,28 @@ launching mesh participants.
 """
 from ..framework import Program, default_main_program
 
-__all__ = ['DistributeTranspiler']
+__all__ = ['DistributeTranspiler', 'DistributeTranspilerConfig']
+
+
+class DistributeTranspilerConfig(object):
+    """Transpile knobs (reference distribute_transpiler.py:116).
+
+    slice_var_up: reference splits large vars across pservers; here it maps
+        to ZeRO-sharding optimizer state over the dp mesh axis.
+    split_method: pserver load-balancing dispatcher (RoundRobin/HashName) —
+        kept for API compat; shard placement on TPU is GSPMD's job.
+    min_block_size: minimum split block size — advisory only here.
+    """
+
+    slice_var_up = True
+    split_method = None
+    min_block_size = 8192
 
 
 class DistributeTranspiler(object):
     def __init__(self, config=None):
-        self._config = config
+        self._config = config if config is not None \
+            else DistributeTranspilerConfig()
         self._trainers = 1
         self._trainer_id = 0
         self._program = None
@@ -56,7 +72,8 @@ class DistributeTranspiler(object):
             'sync_mode': sync_mode,
             # reference slice_var_up split big vars across pservers; the
             # TPU equivalent is ZeRO-sharding optimizer state over dp
-            'shard_optimizer_states': bool(slice_var_up),
+            'shard_optimizer_states': bool(
+                slice_var_up and getattr(self._config, 'slice_var_up', True)),
         }
         return self
 

@@ -161,3 +161,78 @@ def test_recordio_writer_multi_files(tmp_path):
         files = sorted(os.listdir(str(tmp_path)))
         assert files == ['part-00000.recordio', 'part-00001.recordio',
                          'part-00002.recordio']
+
+
+def test_layer_function_generator():
+    from paddle_tpu.fluid.layers import layer_function_generator as lfg
+    import pytest
+    relu = lfg.generate_layer_fn('relu')
+    add = lfg.generate_layer_fn('elementwise_add')
+    with pytest.raises(ValueError):
+        lfg.generate_layer_fn('no_such_op_xyz')
+    with fresh_program() as (main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        z = add(relu(x), relu(x))
+        # act= must fuse an activation like the reference generator does
+        za = add(x, x, act='relu')
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        out, outa = exe.run(
+            main, feed={'x': np.array([[-1., 2., -3., 4.]], 'float32')},
+            fetch_list=[z.name, za.name])
+    np.testing.assert_allclose(out, [[0., 4., 0., 8.]])
+    np.testing.assert_allclose(outa, [[0., 4., 0., 8.]])
+
+    @lfg.templatedoc('relu')
+    def docfn():
+        """${comment} takes ${x_comment} of ${x_type}."""
+    assert docfn.__doc__ == 'The relu operator. takes x of Variable.'
+
+    import warnings
+
+    @lfg.deprecated
+    def oldfn():
+        return 7
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter('always')
+        assert oldfn() == 7
+        assert any(issubclass(x.category, DeprecationWarning) for x in w)
+
+
+def test_distribute_transpiler_config():
+    # reference-level spelling: importable straight off fluid
+    assert fluid.DistributeTranspilerConfig is \
+        fluid.transpiler.DistributeTranspilerConfig
+    cfg = fluid.transpiler.DistributeTranspilerConfig()
+    assert cfg.slice_var_up is True and cfg.min_block_size == 8192
+    cfg.slice_var_up = False
+    t = fluid.transpiler.DistributeTranspiler(config=cfg)
+    with fresh_program() as (main, startup):
+        x = fluid.layers.data(name='x', shape=[2], dtype='float32')
+        y = fluid.layers.fc(input=x, size=2)
+        loss = fluid.layers.mean(y)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        t.transpile(trainer_id=0, program=main, trainers=2,
+                    startup_program=startup)
+        assert main._dist_config['shard_optimizer_states'] is False
+
+
+def test_compat():
+    c = paddle.compat
+    assert c.to_text(b'abc') == 'abc'
+    assert c.to_text(['a', b'b', None]) == ['a', 'b', None]
+    # non-string objects pass through unchanged (no repr coercion)
+    assert c.to_text([1, b'a']) == [1, 'a']
+    assert c.to_bytes([2, 'a']) == [2, b'a']
+    s = {b'x', 'y'}
+    assert c.to_text(s, inplace=True) is s and s == {'x', 'y'}
+    assert c.to_bytes('abc') == b'abc'
+    lst = ['a', b'b']
+    assert c.to_bytes(lst, inplace=True) is lst and lst == [b'a', b'b']
+    # half-away-from-zero, unlike python3's half-to-even
+    assert c.round(0.5) == 1.0 and c.round(-0.5) == -1.0
+    assert c.round(2.675, 2) == 2.68
+    assert c.round(0.0) == 0.0
+    assert c.floor_division(7, 2) == 3
+    assert c.get_exception_message(ValueError('boom')) == 'boom'
+    assert c.long_type is int
