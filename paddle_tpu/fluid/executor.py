@@ -140,10 +140,12 @@ class _CompiledStep(object):
     """One lowered+jitted (program, feed-sig, fetch) combination."""
 
     def __init__(self, program, block, feed_names, fetch_names, persist_in,
-                 amp=False, platform='cpu', persist_shardings=None):
+                 amp=False, platform='cpu', persist_shardings=None,
+                 mesh=None):
         self.program = program
         self.amp = amp
         self.platform = platform
+        self.mesh = mesh
         self.use_remat = bool(getattr(program, '_use_remat', False))
         # name -> NamedSharding: enforced on the step's outputs so
         # mesh-placed state (ZeRO accumulators, tp weights) STAYS sharded
@@ -239,12 +241,14 @@ class _CompiledStep(object):
                 continue
             if on_op is None:
                 lowering.run_op(op, env, Ctx(key, i, amp=self.amp,
-                                             platform=self.platform))
+                                             platform=self.platform,
+                                             mesh=self.mesh))
             else:
                 import time
                 t0 = time.perf_counter()
                 lowering.run_op(op, env, Ctx(key, i, amp=self.amp,
-                                             platform=self.platform))
+                                             platform=self.platform,
+                                             mesh=self.mesh))
                 outs = [env[v.name] for vs in op.outputs.values()
                         for v in vs if env.get(v.name) is not None]
                 jax.block_until_ready(outs)
@@ -346,12 +350,14 @@ class Executor(object):
         grows it via parallel.init_multihost), replicate parameters, and
         ZeRO-shard optimizer accumulators over dp (the reference's
         slice_var_up pserver memory scaling). Returns the mesh or None."""
+        mesh = getattr(program, '_dist_mesh', None)
+        if mesh is not None:
+            # Already built from _dist_config, or placed directly by
+            # ParallelExecutor. False sentinel -> single device, no-op.
+            return mesh or None
         dist = getattr(program, '_dist_config', None)
         if dist is None:
             return None
-        mesh = getattr(program, '_dist_mesh', None)
-        if mesh is not None:
-            return mesh or None  # False sentinel -> single device, no-op
         from .. import parallel
         dp = min(int(dist.get('dp_size') or 1), len(jax.devices()))
         if dp <= 1:
@@ -446,7 +452,7 @@ class Executor(object):
                                  for n, s in persist_shardings.items()))
         key = (program._uid, program._version, feed_sig, tuple(fetch_names),
                persist_in, amp, bool(getattr(program, '_use_remat', False)),
-               shard_sig)
+               shard_sig, dist_mesh)
         compiled = self._cache.get(key) if use_program_cache else None
         if compiled is None:
             # place is None under ParallelExecutor (mesh placement via
@@ -455,7 +461,8 @@ class Executor(object):
                     else jax.devices()[0].platform)
             compiled = _CompiledStep(program, block, list(feed_vals), fetch_names,
                                      persist_in, amp=amp, platform=plat,
-                                     persist_shardings=persist_shardings)
+                                     persist_shardings=persist_shardings,
+                                     mesh=dist_mesh)
             if use_program_cache:
                 self._cache[key] = compiled
 

@@ -12,7 +12,7 @@ from .. import unique_name
 from . import tensor as tensor_mod
 
 __all__ = [
-    'fc', 'embedding', 'dynamic_lstm', 'dynamic_lstmp', 'dynamic_gru',
+    'fc', 'embedding', 'moe_mlp', 'dynamic_lstm', 'dynamic_lstmp', 'dynamic_gru',
     'gru_unit', 'linear_chain_crf', 'crf_decoding', 'cos_sim',
     'cross_entropy', 'square_error_cost', 'chunk_eval', 'sequence_conv',
     'conv2d', 'conv3d', 'sequence_pool', 'sequence_softmax', 'softmax',
@@ -1286,3 +1286,58 @@ def beam_search_decode(ids, scores, beam_size=None, end_id=0, parents=None,
                               "SentenceScores": [sentence_scores]},
                      attrs={'end_id': end_id})
     return sentence_ids, sentence_scores
+
+
+def moe_mlp(input, num_experts, hidden_size, size=None, act='relu',
+            capacity_factor=2.0, gate_param_attr=None, param_attr=None,
+            bias_attr=None, name=None):
+    """Top-1 gated mixture-of-experts FFN (TPU extension; the reference
+    predates MoE — its conditional-computation ancestor is layers.Switch).
+
+    Each of `num_experts` experts is a two-layer MLP
+    ``act(x @ w1 + b1) @ w2 + b2`` with hidden width `hidden_size`; tokens
+    are routed top-1 by a learned linear gate with Switch-style fixed
+    capacity (capacity_factor * tokens / experts; overflow dropped). Under
+    ParallelExecutor or a DistributeTranspiler mesh whose dp size equals
+    num_experts, experts are sharded one-per-device and dispatch rides two
+    all_to_alls (paddle_tpu.parallel.moe); otherwise experts run locally
+    with identical semantics.
+
+    input: [N, d] tokens or [B, T, d] sequence activations.
+    Returns the same shape with the last dim `size` (default d).
+    """
+    from ..ops_impl.moe_ops import supported_acts
+    if (act or None) is not None and act not in supported_acts():
+        raise ValueError(
+            "moe_mlp act=%r is not supported; pick one of %s"
+            % (act, sorted(a for a in supported_acts() if a)))
+    helper = LayerHelper('moe_mlp', **locals())
+    dtype = helper.input_dtype()
+    d = int(input.shape[-1])
+    out_d = int(size) if size is not None else d
+    from ..param_attr import ParamAttr
+    gate_w = helper.create_parameter(attr=ParamAttr.to_attr(gate_param_attr),
+                                     shape=[d, num_experts], dtype=dtype,
+                                     is_bias=False)
+    w1 = helper.create_parameter(attr=ParamAttr.to_attr(param_attr),
+                                 shape=[num_experts, d, hidden_size],
+                                 dtype=dtype, is_bias=False)
+    b1 = helper.create_parameter(attr=ParamAttr.to_attr(bias_attr),
+                                 shape=[num_experts, hidden_size],
+                                 dtype=dtype, is_bias=True)
+    w2 = helper.create_parameter(attr=ParamAttr.to_attr(param_attr),
+                                 shape=[num_experts, hidden_size, out_d],
+                                 dtype=dtype, is_bias=False)
+    b2 = helper.create_parameter(attr=ParamAttr.to_attr(bias_attr),
+                                 shape=[num_experts, out_d], dtype=dtype,
+                                 is_bias=True)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type='moe_mlp',
+        inputs={'X': [input], 'GateW': [gate_w], 'W1': [w1], 'B1': [b1],
+                'W2': [w2], 'B2': [b2]},
+        outputs={'Out': [out]},
+        attrs={'num_experts': int(num_experts),
+               'capacity_factor': float(capacity_factor),
+               'act': act or ''})
+    return out

@@ -62,19 +62,22 @@ def has_rule(op_type):
 
 
 class Ctx(object):
-    """Per-op lowering context: PRNG key, run mode, and target platform
+    """Per-op lowering context: PRNG key, run mode, target platform
     (the Executor's Place decides this — jax.default_backend() lies when a
-    TPU plugin is present but the computation is placed on CPU)."""
+    TPU plugin is present but the computation is placed on CPU), and the
+    device mesh the step is compiled against (None = single device) so
+    mesh-aware rules (moe_mlp) can shard_map over it."""
 
-    __slots__ = ('key', 'op_index', 'is_test', 'amp', 'platform')
+    __slots__ = ('key', 'op_index', 'is_test', 'amp', 'platform', 'mesh')
 
     def __init__(self, key, op_index=0, is_test=False, amp=False,
-                 platform='cpu'):
+                 platform='cpu', mesh=None):
         self.key = key
         self.op_index = op_index
         self.is_test = is_test
         self.amp = amp
         self.platform = platform
+        self.mesh = mesh
 
     def rng(self):
         return jax.random.fold_in(self.key, self.op_index)
@@ -171,7 +174,8 @@ def run_block(block, env, ctx):
     base = block.idx * 4096
     for i, op in enumerate(block.ops):
         run_op(op, env, Ctx(ctx.key, base + i, is_test=ctx.is_test,
-                            amp=ctx.amp, platform=ctx.platform))
+                            amp=ctx.amp, platform=ctx.platform,
+                            mesh=ctx.mesh))
 
 
 # Default slot count for LoDTensorArray buffers (see ArrayValue). Layers

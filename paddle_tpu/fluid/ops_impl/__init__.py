@@ -14,3 +14,4 @@ from . import block_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import crf_ctc_ops  # noqa: F401
 from . import sampled_ops  # noqa: F401
+from . import moe_ops  # noqa: F401

@@ -1,0 +1,99 @@
+"""Mixture-of-experts op lowering.
+
+TPU-first extension (no reference counterpart — the reference predates MoE
+layers; closest ancestor is its conditional-computation machinery,
+fluid/layers/control_flow.py Switch). The `moe_mlp` op is a top-1 gated
+two-layer expert FFN:
+
+  gate_logits = x @ gate_w                       [N, E]
+  expert e:  y = act(x @ w1[e] + b1[e]) @ w2[e] + b2[e]
+
+Dispatch uses the Switch-Transformer fixed-capacity packing semantics of
+paddle_tpu.parallel.moe: tokens are routed top-1, packed into
+[E, capacity] slots (overflow dropped — static shapes for XLA), gate-
+weighted on return. Two execution paths, same math:
+
+- mesh path: when the step is compiled against a mesh (DistributeTranspiler
+  or ParallelExecutor) whose dp axis size equals num_experts, experts are
+  sharded one-per-device over dp and tokens ride TWO all_to_alls
+  (parallel/moe.py moe_apply) — true expert parallelism on the ICI.
+- dense path: identical pack/transform/unpack with the experts vmapped
+  locally (single device, or expert count != mesh size).
+
+The two paths agree exactly when capacity is not exceeded; under overflow
+the drop PATTERN differs (per-shard vs global cumsum order) — the standard
+TPU MoE trade, tested in tests/test_pipeline_moe.py.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..lowering import register, data_of, amp_cast
+
+_ACTS = {
+    'relu': jax.nn.relu,
+    'gelu': jax.nn.gelu,
+    'tanh': jnp.tanh,
+    'sigmoid': jax.nn.sigmoid,
+    'swish': jax.nn.silu,
+    None: lambda x: x,
+    '': lambda x: x,
+}
+
+
+def supported_acts():
+    """Expert activations the rule can lower (layers.moe_mlp validates
+    against this at construction time)."""
+    return set(_ACTS)
+
+
+def _expert_mlp(p, t, act):
+    h = _ACTS[act](t @ p['w1'] + p['b1'])
+    return h @ p['w2'] + p['b2']
+
+
+def _dense_moe(params, x, logits, capacity_factor, act):
+    """Local pack/transform/unpack with the same fixed-capacity semantics
+    as parallel.moe.moe_apply (minus the all_to_all exchanges) — routing
+    math is shared via pack_top1/combine_top1 so the paths cannot drift."""
+    from ...parallel.moe import pack_top1, combine_top1
+    nt = x.shape[0]
+    n_exp = logits.shape[-1]
+    cap = int(max(1, capacity_factor * nt / n_exp))
+    send, route = pack_top1(x, logits, n_exp, cap)
+    out = jax.vmap(lambda p, t: _expert_mlp(p, t, act))(params, send)
+    return combine_top1(out, route, x.dtype)
+
+
+@register('moe_mlp')
+def _moe_mlp(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    gate_w = data_of(ins['GateW'][0])
+    params = {
+        'w1': data_of(ins['W1'][0]), 'b1': data_of(ins['B1'][0]),
+        'w2': data_of(ins['W2'][0]), 'b2': data_of(ins['B2'][0]),
+    }
+    act = attrs.get('act') or None
+    cf = float(attrs.get('capacity_factor', 2.0))
+    n_exp = int(attrs.get('num_experts'))
+
+    shape_in = x.shape
+    if x.ndim > 2:
+        x = x.reshape(-1, x.shape[-1])
+    x, gate_w = amp_cast(ctx, x, gate_w)
+    params = dict(zip(params, amp_cast(ctx, *params.values())))
+    logits = (x @ gate_w).astype(jnp.float32)
+
+    mesh = ctx.mesh
+    if (mesh is not None and 'dp' in getattr(mesh, 'shape', {})
+            and mesh.shape['dp'] == n_exp):
+        from ...parallel.moe import moe_apply
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        # one expert per dp device; tokens already batch-sharded over dp
+        params = jax.tree_util.tree_map(
+            lambda p: jax.lax.with_sharding_constraint(
+                p, NamedSharding(mesh, P('dp'))), params)
+        y = moe_apply(lambda p, t: _expert_mlp(p, t, act), params, x,
+                      logits, mesh, axis='dp', capacity_factor=cf)
+    else:
+        y = _dense_moe(params, x, logits, cf, act)
+    return {'Out': y.reshape(shape_in[:-1] + y.shape[-1:])}

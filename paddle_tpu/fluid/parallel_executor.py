@@ -59,11 +59,16 @@ class ParallelExecutor(object):
     def __init__(self, use_cuda=None, loss_name=None, main_program=None,
                  share_vars_from=None, exec_strategy=None, build_strategy=None,
                  num_trainers=1, trainer_id=0, scope=None, devices=None,
-                 use_tpu=None, **kwargs):
+                 num_devices=None, use_tpu=None, **kwargs):
         self._program = main_program or default_main_program()
         self._loss_name = loss_name
         self._scope = scope or global_scope()
         devs = devices or jax.devices()
+        if num_devices is not None:
+            if num_devices > len(devs):
+                raise ValueError("num_devices=%d > %d visible devices"
+                                 % (num_devices, len(devs)))
+            devs = devs[:num_devices]
         self._mesh = Mesh(np.asarray(devs), ('dp',))
         self._ndev = len(devs)
         self._exe = Executor(core.TPUPlace(0) if core.is_compiled_with_tpu()
@@ -124,12 +129,18 @@ class ParallelExecutor(object):
         dev_feed = {k: self._shard_batch(v) for k, v in feed.items()}
         prev = self._exe._to_device
         self._exe._to_device = lambda v, var=None: v  # already placed
+        # expose the dp mesh to mesh-aware op lowerings (moe_mlp dispatches
+        # experts over this axis) for THIS run only — a later plain
+        # Executor.run on the same program must stay single-device
+        prev_mesh = getattr(self._program, '_dist_mesh', None)
+        self._program._dist_mesh = self._mesh
         try:
             return self._exe.run(self._program, feed=dev_feed,
                                  fetch_list=fetch_list, scope=self._scope,
                                  return_numpy=return_numpy)
         finally:
             self._exe._to_device = prev
+            self._program._dist_mesh = prev_mesh
 
     def bcast_params(self):
         """Parity shim: with GSPMD-replicated params there is nothing to

@@ -290,14 +290,22 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, key_bias=None, causal=False, sm_scale=None,
-                    block_q=128, block_k=128, interpret=None):
+                    block_q=None, block_k=None, interpret=None):
     """Flash attention over [B, H, T, D] tensors.
 
     key_bias: optional additive [B, Tk] bias (e.g. -1e9 on padded keys);
               treated as a non-differentiable mask.
     causal:   lower-triangular masking (decoder self-attention).
+    block_q/block_k: kernel tile sizes (default 128/128, overridable with
+              PADDLE_TPU_FLASH_BQ / PADDLE_TPU_FLASH_BK — see
+              tools/tune_flash.py for the on-chip sweep).
     Returns [B, H, Tq, D] in q's dtype; differentiable w.r.t. q/k/v.
     """
+    import os
+    if block_q is None:
+        block_q = int(os.environ.get('PADDLE_TPU_FLASH_BQ', 128))
+    if block_k is None:
+        block_k = int(os.environ.get('PADDLE_TPU_FLASH_BK', 128))
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     if sm_scale is None:

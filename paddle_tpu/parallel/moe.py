@@ -16,10 +16,42 @@ from jax.sharding import PartitionSpec as P
 
 from ._sp import stack_unit_params, check_units_match_axis
 
-__all__ = ['moe_apply', 'stack_expert_params']
+__all__ = ['moe_apply', 'stack_expert_params', 'pack_top1', 'combine_top1']
 
 # [{param pytree} per expert] -> pytree with leading [n_experts, ...] axis
 stack_expert_params = stack_unit_params
+
+
+def pack_top1(xs, logits, n_exp, cap):
+    """Top-1 routing + fixed-capacity packing (shared by the sharded
+    all_to_all path below and ops_impl/moe_ops.py's dense fallback, so the
+    two stay numerically identical).
+
+    Returns (send [n_exp, cap, d], route) where route carries the
+    (expert, slot, keep, gate) needed to combine."""
+    nt, d = xs.shape
+    expert = jnp.argmax(logits, axis=-1)                     # [nt]
+    gate = jax.nn.softmax(logits.astype(jnp.float32),
+                          axis=-1)[jnp.arange(nt), expert]   # [nt]
+    # position of each token within its expert's capacity buffer
+    onehot = jax.nn.one_hot(expert, n_exp, dtype=jnp.int32)  # [nt, E]
+    pos = jnp.cumsum(onehot, axis=0) * onehot                # 1-based
+    slot = jnp.sum(pos, axis=-1) - 1                         # [nt]
+    keep = slot < cap
+    send = jnp.zeros((n_exp, cap, d), xs.dtype)
+    send = send.at[jnp.where(keep, expert, 0),
+                   jnp.where(keep, slot, 0)].add(
+        jnp.where(keep[:, None], xs, 0.0))
+    return send, (expert, slot, keep, gate)
+
+
+def combine_top1(back, route, dtype):
+    """Unpack expert outputs [n_exp, cap, d] by route and gate-weight;
+    dropped tokens get zeros."""
+    expert, slot, keep, gate = route
+    y = back[jnp.where(keep, expert, 0), jnp.where(keep, slot, 0)]
+    y = jnp.where(keep[:, None], y, 0.0)
+    return (y.astype(jnp.float32) * gate[:, None]).astype(dtype)
 
 
 def moe_apply(expert_fn, stacked_params, x, gate_logits, mesh, axis='ep',
@@ -45,21 +77,8 @@ def moe_apply(expert_fn, stacked_params, x, gate_logits, mesh, axis='ep',
         nt, d = xs.shape
         cap = int(max(1, capacity_factor * nt / n_exp))
 
-        expert = jnp.argmax(logits, axis=-1)                   # [nt]
-        gate = jax.nn.softmax(logits.astype(jnp.float32),
-                              axis=-1)[jnp.arange(nt), expert]  # [nt]
-
-        # position of each token within its expert's capacity buffer
-        onehot = jax.nn.one_hot(expert, n_exp, dtype=jnp.int32)  # [nt, E]
-        pos = jnp.cumsum(onehot, axis=0) * onehot                # 1-based
-        slot = jnp.sum(pos, axis=-1) - 1                         # [nt]
-        keep = slot < cap
-
         # pack: [E, cap, d] send buffer (local tokens destined per expert)
-        send = jnp.zeros((n_exp, cap, d), xs.dtype)
-        send = send.at[jnp.where(keep, expert, 0),
-                       jnp.where(keep, slot, 0)].add(
-            jnp.where(keep[:, None], xs, 0.0))
+        send, route = pack_top1(xs, logits, n_exp, cap)
 
         # exchange: device e receives every shard's buffer for expert e
         recv = lax.all_to_all(send, axis, split_axis=0, concat_axis=0,
@@ -69,10 +88,7 @@ def moe_apply(expert_fn, stacked_params, x, gate_logits, mesh, axis='ep',
         back = lax.all_to_all(out, axis, split_axis=0, concat_axis=0,
                               tiled=True).reshape(n_exp, cap, d)
 
-        # unpack + gate-weight; dropped tokens get zeros
-        y = back[jnp.where(keep, expert, 0), jnp.where(keep, slot, 0)]
-        y = jnp.where(keep[:, None], y, 0.0)
-        return (y.astype(jnp.float32) * gate[:, None]).astype(xs.dtype)
+        return combine_top1(back, route, xs.dtype)
 
     fn = shard_map(
         body, mesh=mesh,

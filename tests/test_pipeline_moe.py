@@ -118,3 +118,126 @@ def test_moe_capacity_drops_overflow():
     gate0 = float(np.asarray(jax.nn.softmax(logits[0]))[0])
     np.testing.assert_allclose(got[kept], np.asarray(x)[kept] * gate0,
                                rtol=1e-5)
+
+
+class TestMoeMlpLayer:
+    """fluid.layers.moe_mlp: the Fluid-level MoE surface (nn.py:moe_mlp,
+    lowered by ops_impl/moe_ops.py)."""
+
+    def _build(self, capacity_factor=8.0):
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.fluid import framework, unique_name
+        from paddle_tpu.fluid.executor import Scope, _switch_scope
+        _switch_scope(Scope())
+        main, startup = framework.Program(), framework.Program()
+        with unique_name.guard(), framework.program_guard(main, startup):
+            x = fluid.layers.data(name='x', shape=[16], dtype='float32')
+            y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+            h = fluid.layers.moe_mlp(x, num_experts=4, hidden_size=32,
+                                     act='relu',
+                                     capacity_factor=capacity_factor)
+            pred = fluid.layers.fc(input=h, size=1)
+            cost = fluid.layers.mean(
+                fluid.layers.square_error_cost(input=pred, label=y))
+        return main, startup, cost
+
+    def test_trains_dense(self):
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.fluid import framework, unique_name
+        from paddle_tpu.fluid.executor import Scope, _switch_scope
+        _switch_scope(Scope())
+        main, startup = framework.Program(), framework.Program()
+        rng = np.random.RandomState(0)
+        X = rng.randn(64, 16).astype('float32')
+        Y = X @ rng.randn(16, 1).astype('float32')
+        with unique_name.guard(), framework.program_guard(main, startup):
+            x = fluid.layers.data(name='x', shape=[16], dtype='float32')
+            y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+            h = fluid.layers.moe_mlp(x, num_experts=4, hidden_size=32)
+            pred = fluid.layers.fc(input=h, size=1)
+            cost = fluid.layers.mean(
+                fluid.layers.square_error_cost(input=pred, label=y))
+            fluid.optimizer.Adam(learning_rate=3e-3).minimize(cost)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            first = last = None
+            for _ in range(100):
+                loss, = exe.run(main, feed={'x': X, 'y': Y},
+                                fetch_list=[cost])
+                first = first if first is not None else float(loss)
+                last = float(loss)
+        assert last < first * 0.2, (first, last)
+
+    def test_mesh_path_matches_dense(self):
+        """ParallelExecutor dp=4 == num_experts routes through moe_apply
+        (all_to_all expert parallelism) and must match the single-device
+        forward when capacity has headroom."""
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.fluid import ops_impl
+        from paddle_tpu.fluid.executor import Scope, _switch_scope
+        rng = np.random.RandomState(1)
+        X = rng.randn(64, 16).astype('float32')
+        Y = X @ rng.randn(16, 1).astype('float32')
+        main, startup, cost = self._build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        from paddle_tpu.fluid import framework
+        import paddle_tpu.parallel.moe as moe_mod
+        from paddle_tpu.fluid.ops_impl import moe_ops
+        calls = {'mesh': 0}
+        real = moe_mod.moe_apply
+
+        def spy(*a, **kw):
+            calls['mesh'] += 1
+            return real(*a, **kw)
+
+        with framework.program_guard(main, startup):
+            exe.run(startup)
+            single, = exe.run(main, feed={'x': X, 'y': Y},
+                              fetch_list=[cost])
+            assert calls['mesh'] == 0
+            pe = fluid.ParallelExecutor(use_cuda=False, main_program=main,
+                                        loss_name=cost.name, num_devices=4)
+            moe_mod.moe_apply = spy
+            try:
+                par, = pe.run(fetch_list=[cost.name], feed={'x': X, 'y': Y})
+            finally:
+                moe_mod.moe_apply = real
+        # the sharded all_to_all path must actually have been traced
+        assert calls['mesh'] >= 1
+        np.testing.assert_allclose(float(single),
+                                   float(np.asarray(par).mean()), rtol=2e-4)
+        # and the program is NOT left mesh-bound after the PE run: a later
+        # plain Executor.run must not see a forced dp mesh (the scope's
+        # mesh-REPLICATED params are a separate, documented GSPMD property)
+        assert getattr(main, '_dist_mesh', None) is None
+
+    def test_bad_act_rejected_at_layer_time(self):
+        import pytest
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.fluid import framework, unique_name
+        from paddle_tpu.fluid.executor import Scope, _switch_scope
+        _switch_scope(Scope())
+        main, startup = framework.Program(), framework.Program()
+        with unique_name.guard(), framework.program_guard(main, startup):
+            x = fluid.layers.data(name='x', shape=[8], dtype='float32')
+            with pytest.raises(ValueError, match='leaky_relu'):
+                fluid.layers.moe_mlp(x, num_experts=2, hidden_size=4,
+                                     act='leaky_relu')
+
+    def test_3d_input(self):
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.fluid import framework, unique_name
+        from paddle_tpu.fluid.executor import Scope, _switch_scope
+        _switch_scope(Scope())
+        main, startup = framework.Program(), framework.Program()
+        with unique_name.guard(), framework.program_guard(main, startup):
+            x = fluid.layers.data(name='x', shape=[6, 16], dtype='float32')
+            h = fluid.layers.moe_mlp(x, num_experts=2, hidden_size=8,
+                                     size=4)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            out, = exe.run(main,
+                           feed={'x': np.random.randn(3, 6, 16)
+                                 .astype('float32')},
+                           fetch_list=[h.name])
+        assert np.asarray(out).shape == (3, 6, 4)
