@@ -15,7 +15,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ['make_mesh', 'data_sharding', 'replicated', 'shard_batch',
+__all__ = ['auto_tp_rules',
+           'make_mesh', 'data_sharding', 'replicated', 'shard_batch',
            'replicate', 'shard_params_by_rules', 'psum', 'all_gather',
            'reduce_scatter', 'ppermute', 'shard_optimizer_states',
            'init_multihost', 'Mesh', 'NamedSharding', 'P',
@@ -26,6 +27,7 @@ __all__ = ['make_mesh', 'data_sharding', 'replicated', 'shard_batch',
 
 from .ring_attention import ring_attention, ring_self_attention  # noqa: E402
 from .ulysses import ulysses_attention, ulysses_self_attention  # noqa: E402
+from .tp import auto_tp_rules  # noqa: E402
 from .pipeline import pipeline_apply, stack_stage_params  # noqa: E402
 from .moe import moe_apply, stack_expert_params  # noqa: E402
 

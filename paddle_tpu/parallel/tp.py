@@ -1,0 +1,94 @@
+"""Per-layer tensor-parallel sharding rules derived from a Program graph.
+
+Replaces hand-written Megatron-style model parallelism (the reference has
+none — its model-parallel story was pserver sharding of large embeddings,
+transpiler/distribute_transpiler.py slice_var_up): walk the Program, find
+the fc/embedding (and thereby attention-projection) parameters, and emit
+(name-pattern, PartitionSpec) rules for shard_params_by_rules. GSPMD then
+partitions every matmul touching a sharded weight and inserts the
+collectives, so the rules decide LAYOUT (where the all-reduces land), not
+numerics — any rule set computes the same result.
+
+The layout heuristic is the Megatron alternation: an fc whose input is
+already hidden-sharded becomes ROW-parallel ([tp, None] — its matmul
+reduces over the sharded dim, one psum at the output); otherwise it is
+COLUMN-parallel ([None, tp] — output stays hidden-sharded, bias shards
+with it). Elementwise/activation ops propagate hidden-sharding; ops that
+mix the last dim (softmax over features, layer_norm) consume it. Embedding
+tables shard the hidden dim so lookups need no gather.
+"""
+import re
+
+from jax.sharding import PartitionSpec as P
+
+__all__ = ['auto_tp_rules']
+
+# ops through which a tp-sharded last (hidden) dim propagates unchanged
+_PASSTHRU = {
+    'relu', 'gelu', 'tanh', 'sigmoid', 'swish', 'leaky_relu', 'elu',
+    'relu6', 'soft_relu', 'brelu', 'softplus', 'softsign', 'square',
+    'sqrt', 'abs', 'exp', 'scale', 'dropout', 'cast', 'clip',
+    'elementwise_sub', 'elementwise_mul',
+    'elementwise_div', 'elementwise_max', 'elementwise_min', 'sum',
+    'reshape',  # common [B,T,d]<->[B*T,d] flattens keep the last dim
+}
+
+
+def _is_param(var):
+    from ..fluid.framework import Parameter
+    return isinstance(var, Parameter) or getattr(var, 'persistable', False)
+
+
+def auto_tp_rules(program, axis='tp'):
+    """Return [(regex, PartitionSpec)] tensor-parallel rules for every
+    fc/embedding parameter in `program`, Megatron column/row alternation.
+
+    Feed the result to shard_params_by_rules (or merge with your own rules;
+    earlier entries win there, so prepend overrides).
+    """
+    rules = []
+    sharded = set()   # var names whose last dim is tp-sharded
+
+    for op in program.global_block().ops:
+        ins = op.inputs
+        outs = [v for vs in op.outputs.values() for v in vs]
+
+        if op.type == 'mul' and 'Y' in ins and ins['Y'] \
+                and _is_param(ins['Y'][0]):
+            x = ins['X'][0] if ins.get('X') else None
+            w = ins['Y'][0]
+            if x is not None and x.name in sharded:
+                # row-parallel: contraction dim sharded; output is full
+                # after GSPMD's psum
+                rules.append(('^' + re.escape(w.name) + '$', P(axis, None)))
+            else:
+                rules.append(('^' + re.escape(w.name) + '$', P(None, axis)))
+                for o in outs:
+                    sharded.add(o.name)
+        elif op.type == 'lookup_table' and ins.get('W') \
+                and _is_param(ins['W'][0]):
+            w = ins['W'][0]
+            rules.append(('^' + re.escape(w.name) + '$', P(None, axis)))
+            for o in outs:
+                sharded.add(o.name)
+        elif op.type == 'elementwise_add':
+            x = ins.get('X', [None])[0]
+            y = ins.get('Y', [None])[0]
+            if x is not None and x.name in sharded:
+                # bias of a column-parallel fc shards with the output
+                if y is not None and _is_param(y) and len(y.shape) == 1:
+                    rules.append(('^' + re.escape(y.name) + '$', P(axis)))
+                for o in outs:
+                    sharded.add(o.name)
+            elif y is not None and y.name in sharded:
+                for o in outs:
+                    sharded.add(o.name)
+        elif op.type in _PASSTHRU:
+            if any(v.name in sharded for vs in op.inputs.values()
+                   for v in vs):
+                for o in outs:
+                    sharded.add(o.name)
+        # every other op (softmax/layer_norm/matmul/reduce/...) consumes
+        # the hidden sharding: its outputs are treated as full
+
+    return rules
