@@ -1,0 +1,224 @@
+"""Fluid benchmark driver CLI.
+
+Parity: reference benchmark/fluid/fluid_benchmark.py + args.py — same model
+set (mnist / resnet / vgg / machine_translation / stacked_dynamic_lstm) and
+flag surface, retargeted at TPU:
+
+- --device TPU replaces GPU; --chips (alias --gpus) > 1 runs the GSPMD
+  data-parallel ParallelExecutor instead of the NCCL SSA-graph executor.
+- --update_method pserver routes through DistributeTranspiler, whose
+  output here is a mesh-annotated program (ICI/DCN collectives), not a
+  gRPC pserver pair; nccl2 maps to the same collective path.
+- --memory_optimize wires jax.checkpoint rematerialisation;
+  --use_inference_transpiler folds BN for the test program;
+  --profile wraps the timed passes in the fluid profiler (per-op table).
+
+Run:  python benchmark/fluid_benchmark.py --model mnist --iterations 20
+"""
+import argparse
+import time
+
+import numpy as np
+
+BENCHMARK_MODELS = [
+    'machine_translation', 'resnet', 'vgg', 'mnist', 'stacked_dynamic_lstm'
+]
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser('Fluid model benchmarks (TPU).')
+    p.add_argument('--model', type=str, choices=BENCHMARK_MODELS,
+                   default='resnet')
+    p.add_argument('--batch_size', type=int, default=32)
+    p.add_argument('--learning_rate', type=float, default=0.001)
+    p.add_argument('--skip_batch_num', type=int, default=5,
+                   help='minibatches to skip before timing starts')
+    p.add_argument('--iterations', type=int, default=80,
+                   help='timed minibatches per pass (0 = whole reader)')
+    p.add_argument('--pass_num', type=int, default=1)
+    p.add_argument('--data_format', type=str, default='NCHW',
+                   choices=['NCHW', 'NHWC'])
+    p.add_argument('--device', type=str, default='TPU',
+                   choices=['CPU', 'TPU', 'GPU'],
+                   help='GPU is accepted for script compat and means TPU')
+    p.add_argument('--chips', '--gpus', dest='chips', type=int, default=1,
+                   help='>1 uses the GSPMD data-parallel ParallelExecutor')
+    p.add_argument('--data_set', type=str, default='cifar10',
+                   choices=['cifar10', 'flowers'])
+    p.add_argument('--infer_only', action='store_true')
+    p.add_argument('--no_test', action='store_true')
+    p.add_argument('--memory_optimize', action='store_true')
+    p.add_argument('--use_fake_data', action='store_true')
+    p.add_argument('--profile', action='store_true')
+    p.add_argument('--update_method', type=str, default='local',
+                   choices=['local', 'pserver', 'nccl2'])
+    p.add_argument('--no_random', action='store_true')
+    p.add_argument('--use_inference_transpiler', action='store_true')
+    return p.parse_args(argv)
+
+
+def _build(args):
+    """Build the chosen model in fresh programs; normalize the per-model
+    get_model() return tuples to (loss, infer_prog, train_r, test_r, acc)."""
+    from paddle_tpu.models import (machine_translation, mnist, resnet,
+                                   stacked_dynamic_lstm, vgg)
+    import paddle_tpu.fluid as fluid
+
+    if args.model == 'mnist':
+        loss, infer, train_r, test_r, acc = mnist.get_model(
+            args.batch_size, args.learning_rate)
+    elif args.model == 'resnet':
+        loss, acc, train_r, test_r = resnet.get_model(
+            args.data_set, batch_size=args.batch_size,
+            learning_rate=args.learning_rate)
+        infer = None
+    elif args.model == 'vgg':
+        loss, infer, train_r, test_r, acc = vgg.get_model(
+            args.data_set, args.batch_size, args.learning_rate)
+    elif args.model == 'machine_translation':
+        loss, infer, train_r, test_r, feeding = machine_translation.get_model(
+            batch_size=args.batch_size)
+        acc = None
+    else:
+        loss, infer, train_r, test_r, acc = stacked_dynamic_lstm.get_model(
+            batch_size=args.batch_size)
+    return loss, infer, train_r, test_r, acc
+
+
+def _feed_vars(program):
+    """Data vars in declaration order (layers.data marks is_data)."""
+    return [v for v in program.global_block().vars.values()
+            if getattr(v, 'is_data', False)]
+
+
+def _fake_batch(feed_vars, batch_size):
+    """Synthesize one batch (reference --use_fake_data semantics: no real
+    dataset read). Only for lod-0 models — sequence models need real token
+    structure, so they fall back to caching one real batch."""
+    if any(v.lod_level > 0 for v in feed_vars):
+        return None
+    samples = []
+    rng = np.random.RandomState(0)
+    for _ in range(batch_size):
+        row = []
+        for v in feed_vars:
+            shape = [int(s) for s in v.shape[1:]]
+            if 'int' in str(v.dtype):
+                row.append(np.zeros(shape or [1], dtype='int64'))
+            else:
+                row.append(rng.rand(*shape).astype('float32'))
+        samples.append(tuple(row))
+    return samples
+
+
+def run_benchmark(args):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import profiler
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    if args.no_random:
+        main.random_seed = startup.random_seed = 90
+    from paddle_tpu.fluid import framework, unique_name
+    with unique_name.guard(), framework.program_guard(main, startup):
+        loss, infer_prog, train_reader, test_reader, acc = _build(args)
+
+        if args.update_method in ('pserver', 'nccl2'):
+            t = fluid.DistributeTranspiler()
+            t.transpile(trainer_id=0, program=main, trainers=args.chips,
+                        startup_program=startup)
+            main = t.get_trainer_program()
+        if args.memory_optimize:
+            fluid.memory_optimize(main)
+        if args.use_inference_transpiler and infer_prog is not None:
+            fluid.InferenceTranspiler().transpile(
+                infer_prog, fluid.CPUPlace())
+
+        if args.infer_only and infer_prog is None:
+            raise ValueError(
+                "--infer_only: model %r builds no inference program; "
+                "pick one of mnist/vgg/machine_translation/"
+                "stacked_dynamic_lstm" % args.model)
+
+        place = (fluid.CPUPlace() if args.device == 'CPU'
+                 else fluid.TPUPlace(0))
+        exe = fluid.Executor(place)
+        exe.run(startup)
+
+        fvars = _feed_vars(main)
+        feeder = fluid.DataFeeder(feed_list=fvars, place=place)
+
+        pe = None
+        if args.chips > 1 and args.update_method == 'local':
+            pe = fluid.ParallelExecutor(main_program=main,
+                                        loss_name=loss.name,
+                                        num_devices=args.chips)
+
+        fetch = [loss.name] + ([acc.name] if acc is not None else [])
+        batches = None
+        if args.use_fake_data:
+            fake = _fake_batch(fvars, args.batch_size)
+            batches = [fake if fake is not None
+                       else next(iter(train_reader()))]
+
+        total_ex, total_s, outs = 0, 0.0, None
+        for pass_id in range(args.pass_num):
+            it, t0 = 0, None
+            reader = (iter(batches * max(1, args.skip_batch_num +
+                                         (args.iterations or 1)))
+                      if batches else train_reader())
+            if args.profile and pass_id == 0:
+                profiler.start_profiler('All')
+            for data in reader:
+                if args.iterations and it >= args.skip_batch_num + \
+                        args.iterations:
+                    break
+                if it == args.skip_batch_num:
+                    t0 = time.time()
+                feedd = feeder.feed(data)
+                if pe is not None:
+                    outs = pe.run(fetch, feed=feedd)
+                elif args.infer_only and infer_prog is not None:
+                    outs = exe.run(infer_prog, feed=feedd, fetch_list=fetch)
+                else:
+                    outs = exe.run(main, feed=feedd, fetch_list=fetch)
+                it += 1
+                if t0 is not None:
+                    total_ex += len(data)
+            if args.profile and pass_id == 0:
+                profiler.stop_profiler('total', None)
+            dt = time.time() - (t0 or time.time())
+            total_s += dt
+            if outs is None:
+                raise RuntimeError(
+                    'no batches ran: the train reader yielded nothing '
+                    '(dataset smaller than one batch?) or pass_num is 0')
+            lv = float(np.asarray(outs[0]).mean())
+            msg = 'Pass: %d, Loss: %f' % (pass_id, lv)
+            if acc is not None and not args.no_test and test_reader and \
+                    infer_prog is not None:
+                accs = []
+                for td in test_reader():
+                    a = exe.run(infer_prog, feed=feeder.feed(td),
+                                fetch_list=[acc.name])
+                    accs.append(float(np.asarray(a[0]).mean()))
+                msg += ', Test Accuracy: %f' % float(np.mean(accs))
+            print(msg)
+        if total_s > 0:
+            print('Avg throughput: %.2f examples/sec'
+                  % (total_ex / total_s))
+        if outs is None:
+            raise RuntimeError('no batches ran (pass_num=0?)')
+        return float(np.asarray(outs[0]).mean())
+
+
+if __name__ == '__main__':
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), '..'))
+    a = parse_args()
+    if a.device == 'CPU':
+        import jax
+        jax.config.update('jax_platforms', 'cpu')
+    run_benchmark(a)

@@ -1,0 +1,80 @@
+"""Convert benchmark datasets to recordio chunk files.
+
+Parity: reference benchmark/fluid/recordio_converter.py (mnist / cifar10 /
+flowers -> recordio for the reader-op input path). Writes through
+paddle_tpu.fluid.recordio_writer onto the C++ chunked record format
+(csrc/recordio.cpp), which layers.open_recordio_file / the threaded
+prefetcher consume.
+
+Run:  python benchmark/recordio_converter.py --dataset mnist --out /tmp/m
+"""
+import argparse
+import os
+
+
+def _feeder(shapes, dtypes, lod_levels):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework, unique_name
+    main, startup = fluid.Program(), fluid.Program()
+    # scoped guards: the feed vars stay usable after exit (DataFeeder only
+    # reads their shapes/dtypes), and the process-global default programs
+    # are left untouched
+    with unique_name.guard(), framework.program_guard(main, startup):
+        feed_vars = [
+            fluid.layers.data(name='f%d' % i, shape=list(shp), dtype=dt,
+                              lod_level=ll)
+            for i, (shp, dt, ll) in enumerate(
+                zip(shapes, dtypes, lod_levels))
+        ]
+    return fluid.DataFeeder(feed_list=feed_vars, place=fluid.CPUPlace())
+
+
+def convert_2_recordio(py_reader, outfilepath, batch_size, shape_data,
+                       shape_label):
+    import paddle_tpu as paddle
+    from paddle_tpu.fluid import recordio_writer
+    feeder = _feeder([shape_data, shape_label], ['float32', 'int64'], [0, 0])
+    reader = paddle.batch(py_reader, batch_size=batch_size)
+    return recordio_writer.convert_reader_to_recordio_file(
+        outfilepath, reader, feeder)
+
+
+def prepare_mnist(outpath, batch_size):
+    import paddle_tpu.dataset.mnist as mnist
+    outfilepath = os.path.join(outpath, 'mnist.recordio')
+    return convert_2_recordio(mnist.train(), outfilepath, batch_size,
+                              [784], [1])
+
+
+def prepare_cifar10(outpath, batch_size):
+    import paddle_tpu.dataset.cifar as cifar
+    outfilepath = os.path.join(outpath, 'cifar.recordio')
+    return convert_2_recordio(cifar.train10(), outfilepath, batch_size,
+                              [3, 32, 32], [1])
+
+
+def prepare_flowers(outpath, batch_size):
+    import paddle_tpu.dataset.flowers as flowers
+    outfilepath = os.path.join(outpath, 'flowers.recordio')
+    return convert_2_recordio(flowers.train(), outfilepath, batch_size,
+                              [3, 224, 224], [1])
+
+
+def main():
+    p = argparse.ArgumentParser('recordio converter (TPU).')
+    p.add_argument('--dataset', choices=['mnist', 'cifar10', 'flowers'],
+                   default='mnist')
+    p.add_argument('--out', type=str, required=True)
+    p.add_argument('--batch_size', type=int, default=32)
+    args = p.parse_args()
+    os.makedirs(args.out, exist_ok=True)
+    n = {'mnist': prepare_mnist, 'cifar10': prepare_cifar10,
+         'flowers': prepare_flowers}[args.dataset](args.out, args.batch_size)
+    print('wrote %d batches' % n)
+
+
+if __name__ == '__main__':
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), '..'))
+    main()

@@ -1,0 +1,74 @@
+"""Benchmark driver CLI (benchmark/fluid_benchmark.py — parity with
+reference benchmark/fluid/fluid_benchmark.py + args.py)."""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..',
+                                'benchmark'))
+
+from fluid_benchmark import BENCHMARK_MODELS, parse_args, run_benchmark
+
+
+def test_arg_surface_matches_reference():
+    a = parse_args(['--model', 'mnist', '--gpus', '2', '--batch_size', '16',
+                    '--update_method', 'pserver', '--no_random'])
+    assert a.model == 'mnist' and a.chips == 2 and a.batch_size == 16
+    assert a.update_method == 'pserver' and a.no_random
+    assert set(BENCHMARK_MODELS) == {
+        'machine_translation', 'resnet', 'vgg', 'mnist',
+        'stacked_dynamic_lstm'}
+
+
+def test_mnist_local_runs_and_learns():
+    a = parse_args(['--model', 'mnist', '--iterations', '8',
+                    '--skip_batch_num', '1', '--batch_size', '32',
+                    '--device', 'CPU', '--no_test', '--no_random'])
+    loss = run_benchmark(a)
+    assert np.isfinite(loss)
+
+
+def test_mnist_parallel_chips():
+    a = parse_args(['--model', 'mnist', '--iterations', '2',
+                    '--skip_batch_num', '1', '--batch_size', '32',
+                    '--device', 'CPU', '--no_test', '--chips', '2',
+                    '--use_fake_data'])
+    assert np.isfinite(run_benchmark(a))
+
+
+def test_mnist_pserver_transpiled():
+    a = parse_args(['--model', 'mnist', '--iterations', '2',
+                    '--skip_batch_num', '1', '--batch_size', '32',
+                    '--device', 'CPU', '--no_test', '--chips', '2',
+                    '--update_method', 'pserver', '--use_fake_data'])
+    assert np.isfinite(run_benchmark(a))
+
+
+def test_recordio_converter_round_trip(tmp_path):
+    import recordio_converter as rc
+    from paddle_tpu.reader.recordio import RecordIOReader
+    from paddle_tpu.fluid.recordio_writer import unpack_feed_record
+    n = rc.prepare_mnist(str(tmp_path), 32)
+    assert n > 0
+    rec = next(iter(RecordIOReader(str(tmp_path / 'mnist.recordio'))))
+    img, lbl = unpack_feed_record(rec)
+    assert np.asarray(img.data).shape == (32, 784)
+    assert np.asarray(lbl.data).shape == (32, 1)
+
+
+def test_infer_only_without_infer_prog_rejected():
+    import pytest
+    a = parse_args(['--model', 'resnet', '--iterations', '1', '--device',
+                    'CPU', '--infer_only', '--use_fake_data', '--no_test',
+                    '--batch_size', '4'])
+    with pytest.raises(ValueError, match='infer_only'):
+        run_benchmark(a)
+
+
+def test_converter_leaves_default_program_untouched(tmp_path):
+    import paddle_tpu.fluid as fluid
+    import recordio_converter as rc
+    before = fluid.default_main_program()
+    rc.prepare_mnist(str(tmp_path), 8)
+    assert fluid.default_main_program() is before
