@@ -130,10 +130,6 @@ def run_benchmark(args):
             main = t.get_trainer_program()
         if args.memory_optimize:
             fluid.memory_optimize(main)
-        if args.use_inference_transpiler and infer_prog is not None:
-            fluid.InferenceTranspiler().transpile(
-                infer_prog, fluid.CPUPlace())
-
         if args.infer_only and infer_prog is None:
             raise ValueError(
                 "--infer_only: model %r builds no inference program; "
@@ -145,11 +141,16 @@ def run_benchmark(args):
         exe = fluid.Executor(place)
         exe.run(startup)
 
+        if args.use_inference_transpiler and infer_prog is not None:
+            # after startup: the fold needs initialized weights in scope
+            fluid.InferenceTranspiler().transpile(infer_prog, place)
+
         fvars = _feed_vars(main)
         feeder = fluid.DataFeeder(feed_list=fvars, place=place)
 
         pe = None
-        if args.chips > 1 and args.update_method == 'local':
+        if args.chips > 1 and args.update_method == 'local' \
+                and not args.infer_only:
             pe = fluid.ParallelExecutor(main_program=main,
                                         loss_name=loss.name,
                                         num_devices=args.chips)
@@ -164,11 +165,13 @@ def run_benchmark(args):
         total_ex, total_s, outs = 0, 0.0, None
         for pass_id in range(args.pass_num):
             it, t0 = 0, None
-            reader = (iter(batches * max(1, args.skip_batch_num +
-                                         (args.iterations or 1)))
+            # iterations=0 means 'whole reader'; for fake data that is
+            # unbounded, so run a sustained 100-timed-batch pass
+            fake_iters = args.skip_batch_num + (args.iterations or 100)
+            reader = (iter(batches * max(1, fake_iters))
                       if batches else train_reader())
             if args.profile and pass_id == 0:
-                profiler.start_profiler('All')
+                profiler.start_profiler('All', op_detail=True)
             for data in reader:
                 if args.iterations and it >= args.skip_batch_num + \
                         args.iterations:
@@ -186,7 +189,8 @@ def run_benchmark(args):
                 if t0 is not None:
                     total_ex += len(data)
             if args.profile and pass_id == 0:
-                profiler.stop_profiler('total', None)
+                profiler.stop_profiler('total',
+                                       '/tmp/fluid_benchmark.profile')
             dt = time.time() - (t0 or time.time())
             total_s += dt
             if outs is None:

@@ -3,5 +3,8 @@
 from . import decoder
 from . import memory_usage_calc
 from .memory_usage_calc import memory_usage
+from . import float16_transpiler
+from .float16_transpiler import Float16Transpiler, BF16Transpiler
 
-__all__ = ['decoder', 'memory_usage_calc', 'memory_usage']
+__all__ = ['decoder', 'memory_usage_calc', 'memory_usage',
+           'float16_transpiler', 'Float16Transpiler', 'BF16Transpiler']

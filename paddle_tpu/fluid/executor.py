@@ -483,13 +483,23 @@ class Executor(object):
         for n, v in new_persist.items():
             scope._chain_set(n, v)
 
+        fetch_f32 = bool(getattr(program, '_fetch_f32', False))
+
+        def _cast_back(x):
+            # Float16Transpiler contract: users keep fetching float32
+            if fetch_f32 and hasattr(x, 'dtype') and str(x.dtype) == 'bfloat16':
+                return x.astype(jnp.float32)
+            return x
+
         out = []
         for v in fetches:
             if isinstance(v, SeqValue):
                 from .lod_tensor import LoDTensor
-                lt = LoDTensor.from_seq_value(v)
+                lt = LoDTensor.from_seq_value(
+                    SeqValue(_cast_back(v.data), v.lengths, v.outer_lengths))
                 out.append(np.asarray(lt.data) if return_numpy else lt)
             else:
+                v = _cast_back(v)
                 out.append(np.asarray(v) if return_numpy else v)
         return out
 

@@ -342,6 +342,11 @@ class Program(object):
         Program.clone + inference_optimize)."""
         p = Program()
         p.random_seed = self.random_seed
+        # execution flags travel with the program: amp mode, the
+        # Float16Transpiler fetch contract, rematerialisation
+        for flag in ('_amp', '_fetch_f32', '_use_remat'):
+            if hasattr(self, flag):
+                setattr(p, flag, getattr(self, flag))
         p.blocks = []
         var_maps = []
         for blk in self.blocks:

@@ -216,3 +216,59 @@ def test_beam_search_decoder_respects_end_id(fresh):
     ids, = exe.run(main, feed=feed, fetch_list=[translation_ids])
     # a beam whose previous token is end_id must keep emitting end_id
     assert (np.asarray(ids) == 1).all()
+
+
+def test_float16_transpiler_bf16_inference():
+    """contrib.Float16Transpiler (reference paddle/contrib/float16/
+    float16_transpiler.py): scope weights -> bf16, program dtypes patched,
+    user keeps feeding/fetching float32."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.executor import global_scope
+    from util import fresh_program
+
+    with fresh_program() as (main, startup):
+        x = fluid.layers.data(name='x', shape=[8], dtype='float32')
+        h = fluid.layers.fc(input=x, size=32, act='relu')
+        pred = fluid.layers.fc(input=h, size=4, act='softmax')
+        infer = main.clone(for_test=True)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        X = np.random.RandomState(0).randn(16, 8).astype('float32')
+        ref, = exe.run(infer, feed={'x': X}, fetch_list=[pred.name])
+
+        t = fluid.contrib.Float16Transpiler()
+        converted = t.transpile(infer, fluid.CPUPlace())
+        assert set(converted) == {'fc_0.w_0', 'fc_0.b_0',
+                                  'fc_1.w_0', 'fc_1.b_0'}
+        half, = exe.run(infer, feed={'x': X}, fetch_list=[pred.name])
+        # fetch comes back float32 (reference appends fetch-side casts)
+        assert half.dtype == np.float32
+        np.testing.assert_allclose(ref, half, atol=0.02)
+        # weights in the scope are genuinely half precision
+        w = global_scope()._chain_get('fc_0.w_0')
+        assert str(w.dtype) == 'bfloat16'
+        # program var dtype patched like the reference's desc rewrite
+        assert str(infer.global_block().vars['fc_0.w_0'].dtype) == 'bfloat16'
+
+    import pytest
+    with pytest.raises(TypeError):
+        fluid.contrib.Float16Transpiler().transpile('not a program')
+
+
+def test_float16_transpiled_program_survives_clone():
+    import paddle_tpu.fluid as fluid
+    from util import fresh_program
+    with fresh_program() as (main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        pred = fluid.layers.fc(input=x, size=2)
+        infer = main.clone(for_test=True)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        fluid.contrib.Float16Transpiler().transpile(infer)
+        clone = infer.clone(for_test=True)
+        out, = exe.run(clone,
+                       feed={'x': np.ones((2, 4), 'float32')},
+                       fetch_list=[pred.name])
+        # the fetch-f32 contract and amp mode survive cloning
+        assert out.dtype == np.float32
+        assert getattr(clone, '_fetch_f32', False)
