@@ -119,6 +119,15 @@ def scaled_dot_product_attention(queries, keys, values, num_heads=1,
     v = __split_heads(v, num_heads)
 
     key_dim_per_head = keys.shape[-1] // num_heads
+
+    if not dropout_rate and num_heads > 1:
+        # no attention-weight dropout -> ONE fused op (pallas flash
+        # attention on TPU, never materializing the [B,H,T,T] weights);
+        # with dropout the unfused chain below keeps reference semantics
+        ctx = layers.fused_attention(q, k, v,
+                                     scale=key_dim_per_head ** -0.5)
+        return __combine_heads(ctx)
+
     scaled_q = layers.scale(x=q, scale=key_dim_per_head ** -0.5)
     product = layers.matmul(x=scaled_q, y=k, transpose_y=True)
     # the reference flattens to 2-D because its softmax op was 2-D-only
