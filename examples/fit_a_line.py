@@ -1,0 +1,52 @@
+"""Fluid book ch01: linear regression on UCI housing.
+
+Parity: reference python/paddle/fluid/tests/book/test_fit_a_line.py as a
+runnable user script — train, save an inference model, reload it, infer.
+
+    python examples/fit_a_line.py [--epochs 10] [--device CPU|TPU]
+"""
+from common import fresh_session, example_args, force_platform
+
+
+def main():
+    args = example_args(epochs=10)
+    force_platform(args)
+    fresh_session()
+
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.fluid as fluid
+
+    x = fluid.layers.data(name='x', shape=[13], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+    y_predict = fluid.layers.fc(input=x, size=1)
+    cost = fluid.layers.mean(
+        fluid.layers.square_error_cost(input=y_predict, label=y))
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(cost)
+
+    place = fluid.CPUPlace() if args.device == 'CPU' else fluid.TPUPlace(0)
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    feeder = fluid.DataFeeder(place=place, feed_list=[x, y])
+    reader = paddle.batch(
+        paddle.reader.shuffle(paddle.dataset.uci_housing.train(),
+                              buf_size=500), batch_size=20)
+
+    for epoch in range(args.epochs):
+        for batch in reader():
+            loss, = exe.run(feed=feeder.feed(batch), fetch_list=[cost])
+        print('epoch %d, loss %.4f' % (epoch, float(loss)))
+
+    fluid.io.save_inference_model(args.save_dir, ['x'], [y_predict], exe)
+    prog, feed_names, fetch_vars = fluid.io.load_inference_model(
+        args.save_dir, exe)
+    sample = np.array([next(iter(paddle.dataset.uci_housing.test()()))[0]],
+                      dtype='float32')
+    pred, = exe.run(prog, feed={feed_names[0]: sample},
+                    fetch_list=fetch_vars)
+    print('predicted price:', float(np.asarray(pred)[0, 0]))
+    return float(loss)
+
+
+if __name__ == '__main__':
+    main()

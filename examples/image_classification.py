@@ -1,0 +1,63 @@
+"""Fluid book ch03: CIFAR-10 image classification (VGG or ResNet).
+
+Parity: reference book/test_image_classification.py as a runnable script.
+
+    python examples/image_classification.py --net vgg [--epochs 1]
+"""
+from common import fresh_session, capped, example_args, force_platform
+
+
+def main():
+    args = example_args(
+        epochs=1, batch_size=32,
+        extra=lambda p: p.add_argument('--net', default='vgg',
+                                       choices=['vgg', 'resnet']))
+    net = args.net
+    force_platform(args)
+    fresh_session()
+
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models.resnet import resnet_cifar10
+    from paddle_tpu.models.vgg import vgg16_bn_drop
+
+    images = fluid.layers.data(name='pixel', shape=[3, 32, 32],
+                               dtype='float32')
+    label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+    if net == 'vgg':
+        feat = vgg16_bn_drop(images)
+        predict = fluid.layers.fc(input=feat, size=10, act='softmax')
+    else:
+        predict = resnet_cifar10(images, 10)
+    cost = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=predict, label=label))
+    acc = fluid.layers.accuracy(input=predict, label=label)
+    test_prog = fluid.default_main_program().clone(for_test=True)
+    fluid.optimizer.Adam(learning_rate=0.001).minimize(cost)
+
+    place = fluid.CPUPlace() if args.device == 'CPU' else fluid.TPUPlace(0)
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    feeder = fluid.DataFeeder(place=place, feed_list=[images, label])
+    train = capped(paddle.batch(paddle.dataset.cifar.train10(),
+                                args.batch_size), args.steps)
+    test = capped(paddle.batch(paddle.dataset.cifar.test10(),
+                               args.batch_size), args.steps or 8)
+
+    for epoch in range(args.epochs):
+        for batch in train():
+            loss, = exe.run(feed=feeder.feed(batch), fetch_list=[cost])
+        accs = [float(np.asarray(exe.run(test_prog, feed=feeder.feed(b),
+                                         fetch_list=[acc])[0]))
+                for b in test()]
+        print('epoch %d (%s), loss %.4f, test acc %.3f'
+              % (epoch, net, float(loss), float(np.mean(accs))))
+
+    fluid.io.save_inference_model(args.save_dir, ['pixel'], [predict], exe)
+    print('saved inference model to', args.save_dir)
+    return float(loss)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,45 @@
+"""Fluid book ch07: semantic role labeling with db_lstm + CRF.
+
+Parity: reference book/test_label_semantic_roles.py as a runnable script.
+
+    python examples/label_semantic_roles.py [--epochs 1 --steps 20]
+"""
+from common import fresh_session, capped, example_args, force_platform
+
+
+def main():
+    args = example_args(epochs=1, batch_size=16)
+    force_platform(args)
+    fresh_session()
+
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import label_semantic_roles as srl
+
+    avg_cost, crf_decode, train_reader, feeds = srl.get_model(
+        batch_size=args.batch_size)
+    fluid.optimizer.Adam(learning_rate=0.05).minimize(avg_cost)
+
+    place = fluid.CPUPlace() if args.device == 'CPU' else fluid.TPUPlace(0)
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    srl.load_pretrained_embedding()
+    vars_ = fluid.default_main_program().global_block().vars
+    feeder = fluid.DataFeeder(place=place,
+                              feed_list=[vars_[n] for n in feeds])
+
+    for epoch in range(args.epochs):
+        for batch in capped(train_reader, 20 if args.steps is None else args.steps)():
+            loss, = exe.run(feed=feeder.feed(batch), fetch_list=[avg_cost])
+        print('epoch %d, loss %.4f' % (epoch, float(loss)))
+
+    # viterbi-decode one batch with the trained CRF
+    batch = next(iter(train_reader()))
+    path, = exe.run(feed=feeder.feed(batch), fetch_list=[crf_decode])
+    print('decoded tag path (first tokens):',
+          np.asarray(path).reshape(-1)[:10])
+    return float(loss)
+
+
+if __name__ == '__main__':
+    main()

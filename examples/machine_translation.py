@@ -1,0 +1,60 @@
+"""Fluid book ch08: WMT14 seq2seq translation with attention + beam infer.
+
+Parity: reference book/test_machine_translation.py as a runnable script
+(also covers ch07 rnn_encoder_decoder — same encoder-decoder family).
+
+    python examples/machine_translation.py [--epochs 1 --steps 30]
+"""
+from common import fresh_session, capped, example_args, force_platform
+
+
+def main():
+    args = example_args(epochs=1, batch_size=8)
+    force_platform(args)
+    fresh_session()
+
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import machine_translation as mt
+
+    dict_size = 1000
+    avg_cost, infer_prog, train_reader, test_reader, feeds = mt.get_model(
+        batch_size=args.batch_size, embedding_dim=64, encoder_size=64,
+        decoder_size=64, dict_size=dict_size)
+
+    place = fluid.CPUPlace() if args.device == 'CPU' else fluid.TPUPlace(0)
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    vars_ = fluid.default_main_program().global_block().vars
+    feeder = fluid.DataFeeder(place=place,
+                              feed_list=[vars_[n] for n in feeds])
+
+    for epoch in range(args.epochs):
+        for batch in capped(train_reader, 30 if args.steps is None else args.steps)():
+            loss, = exe.run(feed=feeder.feed(batch), fetch_list=[avg_cost])
+        print('epoch %d, loss %.4f' % (epoch, float(loss)))
+
+    # beam-search decode one source sentence: save the trained params,
+    # build the generating program (same layer names), restore into it
+    fluid.io.save_params(exe, args.save_dir)
+    decode_main, decode_startup = fluid.Program(), fluid.Program()
+    from paddle_tpu.fluid import framework, unique_name
+    with unique_name.guard(), framework.program_guard(decode_main,
+                                                      decode_startup):
+        ids, scores = mt.seq_to_seq_net(64, 64, 64, dict_size, dict_size,
+                                        True, beam_size=4, max_length=12)
+        src = next(iter(test_reader()))[0][0]
+        dfeeder = fluid.DataFeeder(
+            place=place,
+            feed_list=[decode_main.global_block().vars['source_sequence']])
+        exe.run(decode_startup)
+        fluid.io.load_params(exe, args.save_dir, main_program=decode_main)
+        out, = exe.run(decode_main, feed=dfeeder.feed([(src,)]),
+                       fetch_list=[ids])
+        print('decoded token ids:', np.asarray(out).reshape(-1)[:10])
+    return float(loss)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,54 @@
+"""Fluid book ch02: digit recognition with the LeNet conv net.
+
+Parity: reference book/test_recognize_digits.py as a runnable script.
+
+    python examples/recognize_digits.py [--epochs 3]
+"""
+from common import fresh_session, capped, example_args, force_platform
+
+
+def main():
+    args = example_args(epochs=3, batch_size=64)
+    force_platform(args)
+    fresh_session()
+
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models.mnist import cnn_model
+
+    images = fluid.layers.data(name='pixel', shape=[1, 28, 28],
+                               dtype='float32')
+    label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+    predict = cnn_model(images)
+    cost = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=predict, label=label))
+    acc = fluid.layers.accuracy(input=predict, label=label)
+    test_prog = fluid.default_main_program().clone(for_test=True)
+    fluid.optimizer.Adam(learning_rate=0.001).minimize(cost)
+
+    place = fluid.CPUPlace() if args.device == 'CPU' else fluid.TPUPlace(0)
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    feeder = fluid.DataFeeder(place=place, feed_list=[images, label])
+    train = capped(paddle.batch(paddle.dataset.mnist.train(),
+                                args.batch_size), args.steps)
+    test = capped(paddle.batch(paddle.dataset.mnist.test(),
+                               args.batch_size), args.steps)
+
+    for epoch in range(args.epochs):
+        for batch in train():
+            loss, = exe.run(feed=feeder.feed(batch), fetch_list=[cost])
+        accs = [float(np.asarray(exe.run(test_prog, feed=feeder.feed(b),
+                                         fetch_list=[acc])[0]))
+                for b in test()]
+        print('epoch %d, loss %.4f, test acc %.3f'
+              % (epoch, float(loss), float(np.mean(accs))))
+
+    fluid.io.save_inference_model(args.save_dir, ['pixel'], [predict], exe)
+    print('saved inference model to', args.save_dir)
+    return float(np.mean(accs))
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,45 @@
+"""Fluid book ch05: MovieLens two-tower recommender.
+
+Parity: reference book/test_recommender_system.py as a runnable script.
+
+    python examples/recommender_system.py [--epochs 1]
+"""
+from common import fresh_session, capped, example_args, force_platform
+
+
+def main():
+    args = example_args(epochs=1, batch_size=256)
+    force_platform(args)
+    fresh_session()
+
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import recommender_system as rs
+
+    (avg_cost, scale_infer, infer_prog, train_reader, test_reader,
+     feeds) = rs.get_model(batch_size=args.batch_size)
+
+    place = fluid.CPUPlace() if args.device == 'CPU' else fluid.TPUPlace(0)
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    vars_ = fluid.default_main_program().global_block().vars
+    feeder = fluid.DataFeeder(place=place,
+                              feed_list=[vars_[n] for n in feeds])
+
+    for epoch in range(args.epochs):
+        for batch in capped(train_reader, args.steps)():
+            loss, = exe.run(feed=feeder.feed(batch), fetch_list=[avg_cost])
+        print('epoch %d, loss %.4f' % (epoch, float(loss)))
+
+    # score one user/movie pair with the inference clone
+    sample = next(iter(test_reader()))[:1]
+    rating, = exe.run(infer_prog, feed=feeder.feed(sample),
+                      fetch_list=[scale_infer])
+    print('predicted rating %.2f (label %.1f)'
+          % (float(np.asarray(rating)[0, 0]),
+             float(np.asarray(sample[0][-1]).reshape(-1)[0])))
+    return float(loss)
+
+
+if __name__ == '__main__':
+    main()

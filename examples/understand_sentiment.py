@@ -1,0 +1,39 @@
+"""Fluid book ch06: IMDB sentiment classification (conv net).
+
+Parity: reference book/notest_understand_sentiment.py as a runnable script.
+
+    python examples/understand_sentiment.py [--epochs 2]
+"""
+from common import fresh_session, capped, example_args, force_platform
+
+
+def main():
+    args = example_args(epochs=2, batch_size=32)
+    force_platform(args)
+    fresh_session()
+
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import understand_sentiment as us
+
+    avg_cost, accuracy, train_reader, test_reader, feeds = us.get_model(
+        batch_size=args.batch_size)
+
+    place = fluid.CPUPlace() if args.device == 'CPU' else fluid.TPUPlace(0)
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    vars_ = fluid.default_main_program().global_block().vars
+    feeder = fluid.DataFeeder(place=place,
+                              feed_list=[vars_[n] for n in feeds])
+
+    for epoch in range(args.epochs):
+        for batch in capped(train_reader, args.steps)():
+            loss, acc = exe.run(feed=feeder.feed(batch),
+                                fetch_list=[avg_cost, accuracy])
+        print('epoch %d, loss %.4f, train acc %.3f'
+              % (epoch, float(loss), float(np.asarray(acc).mean())))
+    return float(loss)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,49 @@
+"""Fluid book ch04: word2vec N-gram language model on imikolov.
+
+Parity: reference book/test_word2vec.py as a runnable script.
+
+    python examples/word2vec.py [--epochs 2]
+"""
+from common import fresh_session, capped, example_args, force_platform
+
+
+def main():
+    args = example_args(epochs=2, batch_size=64)
+    force_platform(args)
+    fresh_session()
+
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models.word2vec import N, ngram_net
+
+    word_dict = paddle.dataset.imikolov.build_dict()
+    dict_size = len(word_dict)
+    words = [fluid.layers.data(name='w%d' % i, shape=[1], dtype='int64')
+             for i in range(N - 1)]
+    target = fluid.layers.data(name='target', shape=[1], dtype='int64')
+    predict = ngram_net(words, dict_size)
+    cost = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=predict, label=target))
+    fluid.optimizer.Adagrad(learning_rate=3e-3).minimize(cost)
+
+    place = fluid.CPUPlace() if args.device == 'CPU' else fluid.TPUPlace(0)
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    feeder = fluid.DataFeeder(place=place, feed_list=words + [target])
+    train = capped(paddle.batch(paddle.dataset.imikolov.train(word_dict, N),
+                                args.batch_size), args.steps)
+
+    for epoch in range(args.epochs):
+        for batch in train():
+            loss, = exe.run(feed=feeder.feed(batch), fetch_list=[cost])
+        print('epoch %d, loss %.4f' % (epoch, float(loss)))
+
+    fluid.io.save_inference_model(args.save_dir,
+                                  [w.name for w in words], [predict], exe)
+    print('saved inference model to', args.save_dir)
+    return float(loss)
+
+
+if __name__ == '__main__':
+    main()

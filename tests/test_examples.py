@@ -1,0 +1,41 @@
+"""The runnable book examples (examples/) execute end-to-end with tiny
+step caps. Heavier chapters are exercised by their test_book_* siblings;
+here the user-facing script surface itself is driven."""
+import os
+import sys
+
+import numpy as np
+
+EX = os.path.join(os.path.dirname(__file__), '..', 'examples')
+sys.path.insert(0, EX)
+
+
+def _run_example(mod_name, argv):
+    import importlib
+    old_argv = sys.argv
+    sys.argv = [mod_name] + argv
+    try:
+        mod = importlib.import_module(mod_name)
+        return mod.main()
+    finally:
+        sys.argv = old_argv
+
+
+def test_fit_a_line_example(tmp_path):
+    loss = _run_example('fit_a_line',
+                        ['--epochs', '4', '--save_dir', str(tmp_path)])
+    assert np.isfinite(loss) and loss < 100.0
+
+
+def test_recognize_digits_example(tmp_path):
+    acc = _run_example('recognize_digits',
+                       ['--epochs', '1', '--steps', '20',
+                        '--save_dir', str(tmp_path)])
+    assert acc > 0.5
+
+
+def test_word2vec_example(tmp_path):
+    loss = _run_example('word2vec',
+                        ['--epochs', '1', '--steps', '20',
+                         '--save_dir', str(tmp_path)])
+    assert np.isfinite(loss)
