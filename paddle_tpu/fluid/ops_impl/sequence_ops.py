@@ -130,15 +130,60 @@ def _sequence_mask(ins, attrs, ctx):
 
 @register('lod_reset')
 def _lod_reset(ins, attrs, ctx):
+    """Reinterpret the token buffer under a new LoD (reference
+    operators/lod_reset_op.cc works on the flat buffer + offsets).
+
+    With a static target_lod whose sequence count differs from the input
+    batch, the padded-dense layout is genuinely regrouped: valid tokens
+    are flattened and re-padded to [n_seqs, max_new_len, ...]. With a
+    dynamic Y length source the batch dim must stay (static shapes), so
+    only the per-row lengths are replaced."""
     xv = ins['X'][0]
     data = data_of(xv)
     if ins.get('Y') and ins['Y']:
         y = ins['Y'][0]
         lens = y.lengths if isinstance(y, SeqValue) else data_of(y).reshape(-1).astype(jnp.int32)
+        return {'Out': SeqValue(data, lens)}
+    offsets = np.asarray(attrs['target_lod'])
+    if offsets.size == 0 or offsets[0] != 0:
+        raise ValueError(
+            'lod_reset: target_lod must be a level-0 offsets list starting '
+            'at 0 (reference lod_reset_op.cc), got %r' % (list(offsets),))
+    new_lens = np.diff(offsets)
+    lens = jnp.asarray(new_lens, dtype=jnp.int32)
+    # Regroup under jit regardless of whether the sequence COUNT changed —
+    # the partition may differ even at equal counts. New lengths are
+    # static (attr); old ones may be traced, so token j of the flat
+    # valid-token stream is fetched with a computed (row, col) gather and
+    # re-padded via a static index/mask matrix. If target_lod over-covers
+    # the valid tokens, the clamped reads yield repeated edge tokens (the
+    # reference errors at runtime; one fused XLA step cannot).
+    if isinstance(xv, SeqValue):
+        old_lens = xv.lengths.astype(jnp.int32)
+        cum = jnp.cumsum(old_lens)
+        prev = jnp.concatenate([jnp.zeros((1,), jnp.int32), cum[:-1]])
+        n_new = int(new_lens.sum())
+        j = jnp.arange(n_new)
+        row = jnp.searchsorted(cum, j, side='right').astype(jnp.int32)
+        row = jnp.clip(row, 0, data.shape[0] - 1)
+        col = jnp.clip(j - prev[row], 0, data.shape[1] - 1)
+        flat = data[row, col]                       # [n_new, ...]
     else:
-        offsets = attrs['target_lod']
-        lens = jnp.asarray(np.diff(np.asarray(offsets)), dtype=jnp.int32)
-    return {'Out': SeqValue(data, lens)}
+        # dense input: every row IS a token (reference attaches a LoD to
+        # a flat [N, d] buffer)
+        n_new = int(new_lens.sum())
+        flat = data[:n_new]
+    maxlen = int(new_lens.max()) if len(new_lens) else 1
+    idx = np.zeros((len(new_lens), maxlen), np.int32)
+    mask = np.zeros((len(new_lens), maxlen), bool)
+    off = 0
+    for i, l in enumerate(new_lens):
+        idx[i, :int(l)] = np.arange(off, off + int(l))
+        mask[i, :int(l)] = True
+        off += int(l)
+    out = flat[idx]                                 # [B', maxlen, ...]
+    m = jnp.asarray(mask).reshape(mask.shape + (1,) * (out.ndim - 2))
+    return {'Out': SeqValue(jnp.where(m, out, 0), lens)}
 
 
 @register('sequence_conv')
