@@ -157,8 +157,18 @@ def random_data_generator(low, high, shapes, lod_levels, for_parallel=True):
 
 
 class Preprocessor(object):
-    """reference layers/io.py:Preprocessor — user-defined preprocessing over
-    a reader's slots; host-side here."""
+    """reference layers/io.py:Preprocessor — a reader-to-reader transform
+    written with graph ops.
+
+    The reference builds a sub-block of ops consuming the reader's slots
+    and re-emits a transformed reader (preprocessor op, reader_op.h).
+    Here the ops appended inside `block()` are captured from the main
+    program and evaluated per sample batch through the lowering registry,
+    so the SAME op set that would run on device transforms the host
+    stream; the wrapped reader's __call__/_gen yields transformed slots.
+    """
+
+    _instance_counter = 0
 
     def __init__(self, reader, name=None):
         self.reader = reader
@@ -171,14 +181,111 @@ class Preprocessor(object):
 
         @contextlib.contextmanager
         def _blk():
-            yield self
+            from ..framework import (default_main_program,
+                                     default_startup_program)
+            blk = default_main_program().global_block()
+            sblk = default_startup_program().global_block()
+            start = len(blk.ops)
+            s_start = len(sblk.ops)
+            pre_vars = set(blk.vars)
+            pre_svars = set(sblk.vars)
+            try:
+                yield self
+            except BaseException:
+                # failed block: remove everything it created — main ops,
+                # main vars, and any startup initializers/params
+                del blk.ops[start:]
+                for n in [n for n in blk.vars if n not in pre_vars]:
+                    del blk.vars[n]
+                del sblk.ops[s_start:]
+                for n in [n for n in sblk.vars if n not in pre_svars]:
+                    del sblk.vars[n]
+                raise
+            self._captured_ops = list(blk.ops[start:])
+            # host-side transform ops never stay in the main program;
+            # temp vars they produced go too. Parameters (and their
+            # startup initializers) STAY — the stream reads their scope
+            # values, which the startup program populates.
+            del blk.ops[start:]
+            for n in [n for n in blk.vars if n not in pre_vars
+                      and not getattr(blk.vars[n], 'persistable', False)]:
+                del blk.vars[n]
+            self._install()
         return _blk()
 
     def inputs(self):
-        return read_file(self.reader)
+        self._inputs = read_file(self.reader)
+        return self._inputs
 
     def outputs(self, *outs):
         self._outputs = outs
+
+    def _install(self):
+        if self._inputs is None:
+            raise ValueError('Preprocessor.block must call inputs()')
+        if not self._outputs:
+            raise ValueError('Preprocessor.block must call outputs(...)')
+        import numpy as np
+
+        import jax
+
+        from .. import lowering
+        from ..executor import global_scope
+        from ..lowering import Ctx
+
+        in_names = [v.name for v in self._inputs]
+        out_names = [v.name for v in self._outputs]
+        ops = self._captured_ops
+        inner = self.reader._gen
+
+        # names read by the block but produced neither by the reader nor
+        # by an earlier block op: parameters / pre-existing vars, resolved
+        # from the scope at stream time
+        produced = set(in_names)
+        external, seen_ext = [], set()
+        for op in ops:
+            for vs in op.inputs.values():
+                for v in vs:
+                    if v.name not in produced and v.name not in seen_ext:
+                        external.append((op.type, v.name))
+                        seen_ext.add(v.name)
+            for vs in op.outputs.values():
+                for v in vs:
+                    produced.add(v.name)
+
+        in_ranks = [len(v.shape) for v in self._inputs]
+        inst = Preprocessor._instance_counter
+        Preprocessor._instance_counter += 1
+        epoch = [0]
+
+        def gen():
+            # distinct stream per epoch (each reader() call) and per
+            # Preprocessor instance, deterministic across runs
+            base = jax.random.fold_in(jax.random.key(inst), epoch[0])
+            epoch[0] += 1
+            for s_idx, sample in enumerate(inner()):
+                env = {}
+                for n, s, rank in zip(in_names, sample, in_ranks):
+                    a = np.asarray(s)
+                    if a.ndim == rank - 1:
+                        a = a[None]  # per-sample slot: add the batch axis
+                    env[n] = lowering.jnp.asarray(a)
+                for op_type, name in external:
+                    val = global_scope()._chain_get(name)
+                    if val is None:
+                        raise NameError(
+                            'Preprocessor op %r reads %r, which is neither '
+                            'a reader slot, a block-produced var, nor in '
+                            'the scope (run the startup program first?)'
+                            % (op_type, name))
+                    env[name] = val
+                # distinct randomness per sample (augmentation), train mode
+                key = jax.random.fold_in(base, s_idx)
+                for i, op in enumerate(ops):
+                    lowering.run_op(op, env, Ctx(key, i, is_test=False))
+                yield tuple(np.asarray(env[n]) for n in out_names)
+
+        self.reader._gen = gen
 
 
 def load(out, file_path, load_as_fp16=None):

@@ -143,12 +143,18 @@ def _lod_reset(ins, attrs, ctx):
     if ins.get('Y') and ins['Y']:
         y = ins['Y'][0]
         lens = y.lengths if isinstance(y, SeqValue) else data_of(y).reshape(-1).astype(jnp.int32)
+        if lens.shape[0] != data.shape[0]:
+            raise ValueError(
+                'lod_reset with a dynamic Y length source cannot regroup '
+                'the batch (%d rows -> %d sequences needs static lengths; '
+                'pass target_lod instead)' % (data.shape[0], lens.shape[0]))
         return {'Out': SeqValue(data, lens)}
     offsets = np.asarray(attrs['target_lod'])
-    if offsets.size == 0 or offsets[0] != 0:
+    if offsets.size == 0 or offsets[0] != 0 or (np.diff(offsets) < 0).any():
         raise ValueError(
-            'lod_reset: target_lod must be a level-0 offsets list starting '
-            'at 0 (reference lod_reset_op.cc), got %r' % (list(offsets),))
+            'lod_reset: target_lod must be a non-decreasing level-0 '
+            'offsets list starting at 0 (reference lod_reset_op.cc), '
+            'got %r' % (list(offsets),))
     new_lens = np.diff(offsets)
     lens = jnp.asarray(new_lens, dtype=jnp.int32)
     # Regroup under jit regardless of whether the sequence COUNT changed —

@@ -208,7 +208,7 @@ def test_preprocessor_api(tmp_path):
     path = str(tmp_path / 'g.recordio')
     rio.write_samples(path, [(np.full((4,), i, 'float32'),)
                              for i in range(4)])
-    with fresh_program():
+    with fresh_program() as (main, startup):
         reader = layers.open_files([path], shapes=[[-1, 4]],
                                    lod_levels=[0], dtypes=['float32'])
         pre = layers.Preprocessor(reader)
@@ -217,7 +217,14 @@ def test_preprocessor_api(tmp_path):
             pre.outputs(*[v * 2.0 for v in
                           (ins if isinstance(ins, (list, tuple))
                            else [ins])])
-        assert pre._outputs is not None
+        # the transform ops run host-side, not in the main program
+        assert not any(op.type == 'scale' or op.type == 'elementwise_mul'
+                       for op in main.global_block().ops)
+        vals = [s for s in reader()]
+    assert len(vals) == 4
+    # x*2 actually applied to the streamed slots
+    np.testing.assert_allclose(np.asarray(vals[1][0]).reshape(-1),
+                               np.full((4,), 2.0, 'float32'))
 
 
 def test_append_LARS():
@@ -272,3 +279,46 @@ def test_lod_reset_dense_rows_are_tokens():
     out, = _run(build, {'d': src})
     assert out.shape[-1] == 3
     np.testing.assert_allclose(out.reshape(4, 3), src)
+
+
+def test_lod_reset_rejects_bad_offsets():
+    with fresh_program() as (main, startup):
+        x = fluid.layers.data(name='s', shape=[1], dtype='float32',
+                              lod_level=1)
+        out = layers.lod_reset(x, target_lod=[0, 3, 2])
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        with pytest.raises(Exception, match='non-decreasing'):
+            exe.run(main, feed={'s': np.zeros((1, 4, 1), 'float32')},
+                    fetch_list=[out])
+
+
+def test_preprocessor_uses_scope_params_and_cleans_on_error(tmp_path):
+    from paddle_tpu.reader import recordio as rio
+    path = str(tmp_path / 'h.recordio')
+    rio.write_samples(path, [(np.ones((4,), 'float32'),)
+                             for _ in range(2)])
+    with fresh_program() as (main, startup):
+        reader = layers.open_files([path], shapes=[[-1, 4]],
+                                   lod_levels=[0], dtypes=['float32'])
+        pre = layers.Preprocessor(reader)
+        with pre.block():
+            x, = pre.inputs()
+            # fc inside the block: its weight lives in the scope
+            pre.outputs(layers.fc(input=x, size=3))
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        vals = [s for s in reader()]
+        assert len(vals) == 2 and vals[0][0].shape == (1, 3)
+
+        # a failing block leaves no transform ops behind
+        n_ops = len(main.global_block().ops)
+        reader2 = layers.open_files([path], shapes=[[-1, 4]],
+                                    lod_levels=[0], dtypes=['float32'])
+        pre2 = layers.Preprocessor(reader2)
+        with pytest.raises(NameError):
+            with pre2.block():
+                x2, = pre2.inputs()
+                y2 = x2 * 2.0
+                raise NameError('user bug')
+        assert len(main.global_block().ops) == n_ops
