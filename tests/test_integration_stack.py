@@ -1,0 +1,101 @@
+"""Cross-cutting integration: the round-2 features composed in one flow —
+Trainer + moe_mlp layer + amp (bf16) + CheckpointConfig crash-resume.
+Each piece has its own unit tests; this guards their interplay."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def _train_func():
+    x = fluid.layers.data(name='x', shape=[16], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+    h = fluid.layers.moe_mlp(x, num_experts=2, hidden_size=16, act='relu',
+                             capacity_factor=8.0)
+    pred = fluid.layers.fc(input=h, size=1)
+    cost = fluid.layers.mean(
+        fluid.layers.square_error_cost(input=pred, label=y))
+    fluid.amp.decorate_program()
+    return cost
+
+
+def _optimizer_func():
+    return fluid.optimizer.Adam(learning_rate=1e-2)
+
+
+def _reader():
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 16).astype('float32')
+    W = rng.randn(16, 1).astype('float32')
+    for i in range(0, 64, 16):
+        yield [(X[j], X[j] @ W) for j in range(i, i + 16)]
+
+
+def test_trainer_moe_amp_checkpoint_resume(tmp_path):
+    ckpt = str(tmp_path / 'ckpt')
+    losses = []
+
+    def handler(event):
+        if isinstance(event, fluid.EndStepEvent):
+            losses.append(float(np.asarray(event.metrics[0]).mean()))
+
+    class _SimulatedCrash(Exception):
+        pass
+
+    def crashing_handler(event):
+        handler(event)
+        # die mid-epoch-8: a real crash, not a graceful stop() (which
+        # would rightly clean the checkpoints like the reference)
+        if isinstance(event, fluid.EndStepEvent) and len(losses) >= 30:
+            raise _SimulatedCrash()
+
+    cfg = fluid.CheckpointConfig(checkpoint_dir=ckpt, epoch_interval=1,
+                                 step_interval=1)
+    trainer = fluid.Trainer(train_func=_train_func,
+                            optimizer_func=_optimizer_func,
+                            place=fluid.CPUPlace(), checkpoint_config=cfg)
+    # amp genuinely decorates the trainer's program (not a vacuous guard)
+    assert fluid.amp.is_amp(trainer.train_program)
+    import pytest
+    with pytest.raises(_SimulatedCrash):
+        trainer.train(num_epochs=10, event_handler=crashing_handler,
+                      reader=_reader, feed_order=['x', 'y'])
+    first_epoch = float(np.mean(losses[:4]))
+    last_epoch = float(np.mean(losses[-4:]))
+    assert last_epoch < first_epoch * 0.2, (first_epoch, last_epoch)
+
+    # simulated crash: a NEW Trainer on the same checkpoint dir resumes
+    # from the persisted epoch/step instead of restarting
+    losses2 = []
+
+    def handler2(event):
+        if isinstance(event, fluid.EndStepEvent):
+            losses2.append(float(np.asarray(event.metrics[0]).mean()))
+
+    cfg2 = fluid.CheckpointConfig(checkpoint_dir=ckpt, epoch_interval=1,
+                                  step_interval=1)
+    trainer2 = fluid.Trainer(train_func=_train_func,
+                             optimizer_func=_optimizer_func,
+                             place=fluid.CPUPlace(),
+                             checkpoint_config=cfg2)
+    epochs_seen = []
+
+    def handler2_with_epochs(event):
+        handler2(event)
+        if isinstance(event, fluid.EndStepEvent):
+            epochs_seen.append(event.epoch)
+
+    trainer2.train(num_epochs=11, event_handler=handler2_with_epochs,
+                   reader=_reader, feed_order=['x', 'y'])
+    # resumed training continues from the persisted EPOCH/STEP, not from
+    # scratch: crash was at epoch 7 step 1 (31 steps in), so the resumed
+    # run starts at epoch 7 and re-runs only steps 2.. of it
+    assert losses2, 'resumed run produced no steps'
+    assert epochs_seen[0] == 7, epochs_seen[:3]
+    assert len(losses2) == (4 - 2) + 4 * (11 - 8), len(losses2)
+    # and from the trained state: far below the cold-start first epoch
+    resumed_first = float(np.mean(losses2[:4]))
+    assert resumed_first < first_epoch * 0.2, (first_epoch, resumed_first)
+
+    # inference through the Trainer's test program matches training state
+    t_loss = trainer2.test(reader=_reader, feed_order=['x', 'y'])
+    assert np.isfinite(float(np.asarray(t_loss[0]).mean()))
