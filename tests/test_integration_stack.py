@@ -87,7 +87,7 @@ def test_trainer_moe_amp_checkpoint_resume(tmp_path):
     trainer2.train(num_epochs=11, event_handler=handler2_with_epochs,
                    reader=_reader, feed_order=['x', 'y'])
     # resumed training continues from the persisted EPOCH/STEP, not from
-    # scratch: crash was at epoch 7 step 1 (31 steps in), so the resumed
+    # scratch: crash was at epoch 7 step 1 (30 steps in), so the resumed
     # run starts at epoch 7 and re-runs only steps 2.. of it
     assert losses2, 'resumed run produced no steps'
     assert epochs_seen[0] == 7, epochs_seen[:3]

@@ -1,0 +1,51 @@
+#!/bin/bash
+# One-shot on-chip perf sweep: run when the TPU tunnel is up.
+# Logs everything to tools/perf_sweep.log for later tuning decisions.
+#   bash tools/perf_sweep.sh [quick]
+set -u
+cd "$(dirname "$0")/.."
+LOG=tools/perf_sweep.log
+: > "$LOG"
+
+probe() {
+  # must see a real accelerator — jax silently falls back to CPU when the
+  # axon tunnel is absent, which would make the sweep log CPU numbers
+  timeout 60 python -c "
+import jax
+devs = jax.devices()
+print(devs)
+assert devs and devs[0].platform not in ('cpu',), devs
+" >> "$LOG" 2>&1
+}
+
+run() {
+  echo "=== $* ===" | tee -a "$LOG"
+  timeout "${T:-600}" "$@" >> "$LOG" 2>&1
+  echo "rc=$?" | tee -a "$LOG"
+}
+
+echo "== tunnel probe ==" | tee -a "$LOG"
+if ! probe; then
+  echo "TUNNEL DOWN — aborting" | tee -a "$LOG"
+  exit 1
+fi
+
+# 1. headline bench as the driver runs it
+run python bench.py
+
+if [ "${1:-}" = quick ]; then exit 0; fi
+
+# 2. layout / batch sensitivity for ResNet
+run env BENCH_LAYOUT=NCHW python bench.py
+run env BENCH_BATCH=512 python bench.py
+run env BENCH_BATCH=2048 python bench.py
+
+# 3. flash-attention block sweep at bench shapes (fwd+bwd)
+run python tools/tune_flash.py --seq 256 --batch 64 --heads 8 --dim 64
+run python tools/tune_flash.py --seq 1024 --batch 16 --heads 8 --dim 64 \
+    --causal
+
+# 4. transformer seq-length scaling
+run env BENCH_SEQ=512 BENCH_TBATCH=32 python bench.py
+
+echo "sweep complete; see $LOG" | tee -a "$LOG"
