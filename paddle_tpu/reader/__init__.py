@@ -12,6 +12,7 @@ __all__ = [
 
 from . import pipeline  # noqa: F401
 from . import recordio  # noqa: F401
+from . import creator  # noqa: F401
 
 
 def map_readers(func, *readers):

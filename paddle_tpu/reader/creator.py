@@ -1,0 +1,50 @@
+"""Reader creators.
+
+Parity: reference python/paddle/reader/creator.py — build sample readers
+from in-memory arrays, text files, and recordio chunk files.
+"""
+__all__ = ['np_array', 'text_file', 'recordio']
+
+
+def np_array(x):
+    """Reader yielding the rows of a numpy array (reference
+    creator.py:np_array)."""
+    import numpy as np
+    arr = np.asarray(x)
+
+    def reader():
+        for row in arr:
+            yield row
+
+    return reader
+
+
+def text_file(path):
+    """Reader yielding lines of a text file without the trailing newline
+    (reference creator.py:text_file)."""
+
+    def reader():
+        with open(path, 'r') as f:
+            for line in f:
+                yield line.rstrip('\n')
+
+    return reader
+
+
+def recordio(paths, buf_size=100):
+    """Reader yielding raw records from recordio chunk file(s); paths is a
+    path or comma-separated list (reference creator.py:recordio, minus the
+    cloud-reader branch which served the retired pserver infrastructure)."""
+    from . import recordio as rio
+
+    if isinstance(paths, str):
+        path_list = paths.split(',')
+    else:
+        path_list = list(paths)
+
+    def reader():
+        for p in path_list:
+            for rec in rio.RecordIOReader(p):
+                yield rec
+
+    return reader

@@ -138,3 +138,36 @@ def test_batch():
 def test_batch_drop_last():
     bs = list(paddle.batch(_ints(7), batch_size=3, drop_last=True)())
     assert [len(b) for b in bs] == [3, 3]
+
+
+def test_creator_module(tmp_path):
+    """paddle.reader.creator parity (reference reader/creator.py)."""
+    import numpy as np
+    from paddle_tpu.reader import creator
+    from paddle_tpu.reader.recordio import RecordIOWriter
+
+    r = creator.np_array(np.arange(6).reshape(3, 2))
+    assert [list(x) for x in r()] == [[0, 1], [2, 3], [4, 5]]
+
+    p = str(tmp_path / 't.txt')
+    with open(p, 'w') as f:
+        f.write('a\nbb\n')
+    assert list(creator.text_file(p)()) == ['a', 'bb']
+
+    rp = str(tmp_path / 'r.recordio')
+    w = RecordIOWriter(rp)
+    w.write(b'x1')
+    w.write(b'y22')
+    w.close()
+    assert list(creator.recordio(rp)()) == [b'x1', b'y22']
+    # comma-separated multi-file form
+    assert list(creator.recordio('%s,%s' % (rp, rp))()) == \
+        [b'x1', b'y22', b'x1', b'y22']
+
+
+def test_decorator_module_alias():
+    """from paddle.reader.decorator import shuffle ports verbatim."""
+    import paddle_tpu as paddle
+    from paddle_tpu.reader import decorator
+    for name in decorator.__all__:
+        assert getattr(decorator, name) is getattr(paddle.reader, name)
