@@ -347,8 +347,9 @@ class Executor(object):
     def _ensure_dist_placement(self, program, scope):
         """Consume DistributeTranspiler's `_dist_config` annotation: build
         the dp mesh (capped at the locally visible devices; multi-host
-        grows it via parallel.init_multihost), replicate parameters, and
-        ZeRO-shard optimizer accumulators over dp (the reference's
+        grows it via parallel.init_multihost), place parameters (replicated
+        by default; dp-sharded ZeRO-3/FSDP when shard_parameters is set),
+        and ZeRO-shard optimizer accumulators over dp (the reference's
         slice_var_up pserver memory scaling). Returns the mesh or None."""
         mesh = getattr(program, '_dist_mesh', None)
         if mesh is not None:
@@ -369,12 +370,19 @@ class Executor(object):
                      if getattr(v, '_is_optimizer_accumulator', False)}
         persistable = {v.name for v in program.list_vars() if v.persistable}
         zero = dist.get('shard_optimizer_states', False)
+        fsdp = dist.get('shard_parameters', False)
         for name in persistable:
             v = scope.vars.get(name)
             if v is None or isinstance(v, SeqValue):
                 continue
             if zero and name in acc_names:
                 scope.vars.update(parallel.shard_optimizer_states(
+                    {name: v}, mesh))
+            elif fsdp and name not in acc_names:
+                # ZeRO-3: the parameters themselves shard over dp (the
+                # reference's slice_var_up split param blocks across
+                # pservers; this is its GSPMD equivalent)
+                scope.vars.update(parallel.fsdp_shard_params(
                     {name: v}, mesh))
             else:
                 scope.vars[name] = parallel.replicate(mesh, v)

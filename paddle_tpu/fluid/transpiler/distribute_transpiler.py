@@ -29,12 +29,17 @@ class DistributeTranspilerConfig(object):
 
     slice_var_up: reference splits large vars across pservers; here it maps
         to ZeRO-sharding optimizer state over the dp mesh axis.
+    shard_parameters: ZeRO-3/FSDP — shard the parameters THEMSELVES over
+        dp (parallel.fsdp_shard_params; GSPMD gathers at use). The closest
+        analogue of the reference actually splitting parameter blocks
+        across pservers. Off by default (replicated params).
     split_method: pserver load-balancing dispatcher (RoundRobin/HashName) —
         kept for API compat; shard placement on TPU is GSPMD's job.
     min_block_size: minimum split block size — advisory only here.
     """
 
     slice_var_up = True
+    shard_parameters = False
     split_method = None
     min_block_size = 8192
 
@@ -74,6 +79,8 @@ class DistributeTranspiler(object):
             # TPU equivalent is ZeRO-sharding optimizer state over dp
             'shard_optimizer_states': bool(
                 slice_var_up and getattr(self._config, 'slice_var_up', True)),
+            'shard_parameters': bool(
+                getattr(self._config, 'shard_parameters', False)),
         }
         return self
 

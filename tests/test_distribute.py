@@ -2,8 +2,9 @@
 
 Parity: reference transpiler/distribute_transpiler.py:167-300 (program
 split across trainers/pservers). Here transpile() annotates the program and
-the Executor consumes it: dp mesh, replicated params, ZeRO-sharded
-optimizer accumulators enforced inside the compiled step.
+the Executor consumes it: dp mesh, params replicated (or dp-sharded ZeRO-3
+when shard_parameters is set), ZeRO-sharded optimizer accumulators — all
+enforced inside the compiled step.
 """
 import numpy as np
 import pytest
@@ -146,3 +147,49 @@ def test_init_multihost_noop_without_cluster_env(monkeypatch):
               'PADDLE_TRAINER_ID'):
         monkeypatch.delenv(k, raising=False)
     assert parallel.init_multihost() is False
+
+
+def test_transpile_shard_parameters_fsdp():
+    """DistributeTranspilerConfig.shard_parameters=True: params shard over
+    dp inside the executor's dist placement (ZeRO-3), same losses."""
+    from paddle_tpu.fluid.executor import global_scope
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(16, 32).astype('float32')
+    Y = rng.rand(16, 1).astype('float32')
+
+    def build():
+        x = fluid.layers.data(name='x', shape=[32], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        h = fluid.layers.fc(input=x, size=64, act='relu')
+        pred = fluid.layers.fc(input=h, size=1)
+        cost = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.Momentum(learning_rate=0.05,
+                                 momentum=0.9).minimize(cost)
+        return cost
+
+    with fresh_program() as (main, startup):
+        cost = build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        single = [float(np.asarray(
+            exe.run(main, feed={'x': X, 'y': Y}, fetch_list=[cost])[0]))
+            for _ in range(3)]
+
+    with fresh_program() as (main, startup):
+        cost = build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        cfg = fluid.DistributeTranspilerConfig()
+        cfg.shard_parameters = True
+        t = fluid.DistributeTranspiler(config=cfg)
+        t.transpile(trainer_id=0, program=main, trainers=8,
+                    startup_program=startup)
+        sharded = [float(np.asarray(
+            exe.run(main, feed={'x': X, 'y': Y}, fetch_list=[cost])[0]))
+            for _ in range(3)]
+        w = global_scope().vars['fc_0.w_0']
+        assert isinstance(w.sharding, NamedSharding)
+        assert 'dp' in str(w.sharding.spec)
+    np.testing.assert_allclose(single, sharded, rtol=2e-4)
