@@ -369,8 +369,11 @@ class Executor(object):
         acc_names = {v.name for v in program.list_vars()
                      if getattr(v, '_is_optimizer_accumulator', False)}
         persistable = {v.name for v in program.list_vars() if v.persistable}
-        zero = dist.get('shard_optimizer_states', False)
         fsdp = dist.get('shard_parameters', False)
+        # ZeRO-3 subsumes the lower levels: sharding the parameters while
+        # replicating Adam state (2x the params) would silently forfeit
+        # the memory scaling just asked for
+        zero = dist.get('shard_optimizer_states', False) or fsdp
         for name in persistable:
             v = scope.vars.get(name)
             if v is None or isinstance(v, SeqValue):

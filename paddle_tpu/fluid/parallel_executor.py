@@ -63,6 +63,7 @@ class ParallelExecutor(object):
         self._program = main_program or default_main_program()
         self._loss_name = loss_name
         self._scope = scope or global_scope()
+        self._build_strategy = build_strategy
         devs = devices or jax.devices()
         if num_devices is not None:
             if num_devices > len(devs):
@@ -113,6 +114,20 @@ class ParallelExecutor(object):
 
     def _replicate_persistables(self):
         import jax.numpy as jnp
+        bs = self._build_strategy
+        # reference BuildStrategy.ReduceStrategy.Reduce partitioned each
+        # parameter's update onto one device; the GSPMD equivalent is
+        # ZeRO-3 — shard the parameters themselves over dp
+        fsdp = (bs is not None and bs.reduce_strategy ==
+                BuildStrategy.ReduceStrategy.Reduce)
+        if fsdp:
+            from .. import parallel
+            dense = {n: v for n, v in self._scope.vars.items()
+                     if v is not None and not isinstance(v, SeqValue)}
+            self._scope.vars.update(
+                parallel.fsdp_shard_params(dense, self._mesh))
+            self._placed = True
+            return
         for name, v in list(self._scope.vars.items()):
             if v is None or isinstance(v, SeqValue):
                 continue

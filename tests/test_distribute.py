@@ -193,3 +193,33 @@ def test_transpile_shard_parameters_fsdp():
         assert isinstance(w.sharding, NamedSharding)
         assert 'dp' in str(w.sharding.spec)
     np.testing.assert_allclose(single, sharded, rtol=2e-4)
+
+
+def test_shard_parameters_implies_sharded_optimizer_state():
+    """ZeRO-3 subsumes ZeRO-1: shard_parameters=True shards accumulators
+    even with slice_var_up=False (replicated Adam state would cost 2x the
+    memory the user just sharded away)."""
+    from jax.sharding import NamedSharding
+    from paddle_tpu.fluid.executor import global_scope
+    with fresh_program() as (main, startup):
+        x = fluid.layers.data(name='x', shape=[32], dtype='float32')
+        pred = fluid.layers.fc(input=x, size=64)
+        cost = fluid.layers.mean(pred)
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(cost)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        cfg = fluid.DistributeTranspilerConfig()
+        cfg.shard_parameters = True
+        cfg.slice_var_up = False
+        fluid.DistributeTranspiler(config=cfg).transpile(
+            trainer_id=0, program=main, trainers=8,
+            startup_program=startup, slice_var_up=False)
+        X = np.random.rand(8, 32).astype('float32')
+        exe.run(main, feed={'x': X}, fetch_list=[cost])
+        moments = [n for n in global_scope().vars
+                   if 'moment' in n and 'fc_0.w_0' in n]
+        assert moments, list(global_scope().vars)[:20]
+        for n in moments:
+            v = global_scope().vars[n]
+            assert isinstance(v.sharding, NamedSharding) and \
+                'dp' in str(v.sharding.spec), (n, v.sharding)

@@ -249,3 +249,51 @@ def test_sharding_passes_compose():
         parallel.shard_optimizer_states(vals, mesh), mesh, min_size=128)
     assert str(c['w'].sharding.spec) == "PartitionSpec(None, 'dp')"
     assert str(c['acc'].sharding.spec) == "PartitionSpec('dp', None)"
+
+
+def test_build_strategy_reduce_is_fsdp():
+    """BuildStrategy.ReduceStrategy.Reduce (the reference's partitioned
+    parameter updates) maps to ZeRO-3 parameter sharding: same losses as
+    AllReduce, params dp-sharded."""
+    from jax.sharding import NamedSharding
+
+    def build():
+        x = fluid.layers.data(name='x', shape=[32], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        h = fluid.layers.fc(input=x, size=64, act='relu')
+        pred = fluid.layers.fc(input=h, size=1)
+        cost = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(cost)
+        return cost
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(16, 32).astype('float32')
+    Y = rng.rand(16, 1).astype('float32')
+
+    with fresh_program() as (main, startup):
+        cost = build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        pe = fluid.ParallelExecutor(use_cuda=False, loss_name=cost.name,
+                                    main_program=main)
+        allreduce = [float(np.asarray(pe.run([cost.name],
+                                             feed={'x': X, 'y': Y})[0])
+                           .mean()) for _ in range(3)]
+
+    with fresh_program() as (main, startup):
+        cost = build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        bs = fluid.BuildStrategy()
+        bs.reduce_strategy = fluid.BuildStrategy.ReduceStrategy.Reduce
+        pe = fluid.ParallelExecutor(use_cuda=False, loss_name=cost.name,
+                                    main_program=main, build_strategy=bs)
+        reduced = [float(np.asarray(pe.run([cost.name],
+                                           feed={'x': X, 'y': Y})[0])
+                         .mean()) for _ in range(3)]
+        from paddle_tpu.fluid.executor import global_scope
+        w = global_scope().vars['fc_0.w_0']
+        assert isinstance(w.sharding, NamedSharding)
+        assert 'dp' in str(w.sharding.spec)
+    np.testing.assert_allclose(allreduce, reduced, rtol=2e-4)
