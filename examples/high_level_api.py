@@ -1,0 +1,64 @@
+"""High-level Trainer/Inferencer API (reference book high-level-api
+chapters: fluid.Trainer event loop + CheckpointConfig + fluid.Inferencer).
+
+    python examples/high_level_api.py [--epochs 5]
+"""
+from common import fresh_session, capped, example_args, force_platform
+
+
+def main():
+    args = example_args(epochs=5, batch_size=20)
+    force_platform(args)
+    fresh_session()
+
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.fluid as fluid
+
+    def train_func():
+        x = fluid.layers.data(name='x', shape=[13], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        pred = fluid.layers.fc(input=x, size=1)
+        return fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+
+    def optimizer_func():
+        return fluid.optimizer.SGD(learning_rate=0.01)
+
+    def infer_func():
+        x = fluid.layers.data(name='x', shape=[13], dtype='float32')
+        return fluid.layers.fc(input=x, size=1)
+
+    place = fluid.CPUPlace() if args.device == 'CPU' else fluid.TPUPlace(0)
+    trainer = fluid.Trainer(train_func=train_func,
+                            optimizer_func=optimizer_func, place=place)
+
+    def reader():
+        return capped(paddle.batch(paddle.dataset.uci_housing.train(),
+                                   args.batch_size), args.steps)()
+
+    def event_handler(event):
+        if isinstance(event, fluid.EndEpochEvent):
+            t_loss = trainer.test(
+                reader=paddle.batch(paddle.dataset.uci_housing.test(),
+                                    args.batch_size),
+                feed_order=['x', 'y'])
+            print('epoch %d, test loss %.4f'
+                  % (event.epoch, float(np.asarray(t_loss[0]).mean())))
+
+    trainer.train(num_epochs=args.epochs, event_handler=event_handler,
+                  reader=reader, feed_order=['x', 'y'])
+    trainer.save_params(args.save_dir)
+
+    inferencer = fluid.Inferencer(infer_func=infer_func,
+                                  param_path=args.save_dir, place=place)
+    sample = np.array([next(iter(
+        paddle.dataset.uci_housing.test()()))[0]], dtype='float32')
+    pred = inferencer.infer({'x': sample})
+    price = float(np.asarray(pred[0]).reshape(-1)[0])
+    print('predicted price:', price)
+    return price
+
+
+if __name__ == '__main__':
+    main()

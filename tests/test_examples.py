@@ -39,3 +39,9 @@ def test_word2vec_example(tmp_path):
                         ['--epochs', '1', '--steps', '20',
                          '--save_dir', str(tmp_path)])
     assert np.isfinite(loss)
+
+
+def test_high_level_api_example(tmp_path):
+    pred = _run_example('high_level_api',
+                        ['--epochs', '4', '--save_dir', str(tmp_path)])
+    assert np.isfinite(pred)
