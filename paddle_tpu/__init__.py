@@ -6,7 +6,8 @@ JAX rules into single fused XLA modules (jit/pjit over jax.sharding.Mesh);
 hot kernels in paddle_tpu.ops use pallas. Parallelism (dp/tp/sp) is GSPMD
 over the ICI mesh rather than NCCL/pserver.
 """
-__version__ = '0.14.0+tpu.r1'
+from .version import full_version as __version__  # noqa: E402
+from .version import commit as __git_commit__  # noqa: E402
 
 from . import fluid  # noqa: F401
 from . import reader  # noqa: F401

@@ -1,0 +1,31 @@
+"""Version metadata.
+
+Parity: the reference generates python/paddle/version.py at build time
+(python/setup.py.in) with full_version / major / minor / patch / rc /
+istaged / commit / with_mkl; paddle/__init__.py imports full_version and
+commit from it. Static here — there is no cmake build stamping.
+"""
+major = 0
+minor = 14
+patch = '0'
+rc = 0
+version = '0.14.0'
+full_version = '0.14.0+tpu.r2'
+commit = 'tpu-native-rebuild'
+istaged = True
+with_mkl = 'OFF'  # XLA:TPU is the backend; MKL-DNN paths do not exist
+
+
+def show():
+    if istaged:
+        print('full_version:', full_version)
+        print('major:', major)
+        print('minor:', minor)
+        print('patch:', patch)
+        print('rc:', rc)
+    else:
+        print('commit:', commit)
+
+
+def mkl():
+    return with_mkl

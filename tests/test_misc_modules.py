@@ -236,3 +236,13 @@ def test_compat():
     assert c.floor_division(7, 2) == 3
     assert c.get_exception_message(ValueError('boom')) == 'boom'
     assert c.long_type is int
+
+
+def test_version_module():
+    import paddle_tpu.version as v
+    assert paddle.__version__ == v.full_version
+    assert paddle.__git_commit__ == v.commit
+    assert v.full_version.startswith('%d.%d.%s' % (v.major, v.minor,
+                                                   v.patch))
+    assert v.mkl() == 'OFF'
+    v.show()  # must not raise
