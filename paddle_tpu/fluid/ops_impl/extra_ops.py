@@ -1,0 +1,165 @@
+"""Long-tail op rules: reference operators that exist only at the C++
+level (each has a reference unittest test_<op>_op.py but no v0.14 python
+layer). Registered here so `layer_function_generator.generate_layer_fn`
+— the reference's own mechanism for exposing registered ops — reaches
+them, plus the handful of layers/ops.py wrappers.
+
+Parity: paddle/fluid/operators/{sign,cum,l1_norm,squared_l2_norm,
+squared_l2_distance,minus,fill,fill_zeros_like,norm,log_loss,hinge_loss,
+margin_rank_loss,modified_huber_loss,sampling_id,conv_shift}_op.*
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..lowering import register, data_of, like
+
+
+@register('sign')
+def _sign(ins, attrs, ctx):
+    x = ins['X'][0]
+    return {'Out': like(x, jnp.sign(data_of(x)))}
+
+
+@register('cumsum')
+def _cumsum(ins, attrs, ctx):
+    xv = ins['X'][0]
+    x = data_of(xv)
+    axis = int(attrs.get('axis', -1))
+    exclusive = bool(attrs.get('exclusive', False))
+    reverse = bool(attrs.get('reverse', False))
+    if reverse:
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if exclusive:
+        out = out - x
+    if reverse:
+        out = jnp.flip(out, axis)
+    return {'Out': like(xv, out)}
+
+
+@register('l1_norm')
+def _l1_norm(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    return {'Out': jnp.sum(jnp.abs(x)).reshape(1)}
+
+
+@register('squared_l2_norm')
+def _squared_l2_norm(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    return {'Out': jnp.sum(jnp.square(x)).reshape(1)}
+
+
+@register('squared_l2_distance')
+def _squared_l2_distance(ins, attrs, ctx):
+    x = data_of(ins['X'][0])
+    y = data_of(ins['Y'][0])
+    sub = x - y            # y broadcasts when it has one row
+    n = sub.shape[0]
+    return {'Out': jnp.sum(jnp.square(sub).reshape(n, -1), axis=1,
+                           keepdims=True),
+            'sub_result': sub}
+
+
+@register('minus')
+def _minus(ins, attrs, ctx):
+    from ..lowering import first_seq
+    x, y = ins['X'][0], ins['Y'][0]
+    return {'Out': like(first_seq(x, y), data_of(x) - data_of(y))}
+
+
+@register('fill')
+def _fill(ins, attrs, ctx):
+    from .tensor_ops import _np_dtype
+    shape = [int(s) for s in attrs['shape']]
+    vals = jnp.asarray(np.asarray(attrs['value'], dtype='float64'))
+    return {'Out': vals.reshape(shape).astype(
+        _np_dtype(attrs.get('dtype', 'float32')))}
+
+
+@register('fill_zeros_like')
+def _fill_zeros_like(ins, attrs, ctx):
+    x = ins['X'][0]
+    return {'Out': like(x, jnp.zeros_like(data_of(x)))}
+
+
+@register('norm')
+def _norm(ins, attrs, ctx):
+    """L2-normalize along `axis` (reference norm_op.cc): Out = X / norm,
+    norm = sqrt(sum(x^2, axis) + epsilon)."""
+    x = data_of(ins['X'][0])
+    axis = int(attrs.get('axis', 1))
+    eps = float(attrs.get('epsilon', 1e-10))
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return {'Out': x / norm, 'Norm': norm}
+
+
+@register('log_loss')
+def _log_loss(ins, attrs, ctx):
+    p = data_of(ins['Predicted'][0])
+    y = data_of(ins['Labels'][0])
+    eps = float(attrs.get('epsilon', 1e-4))
+    out = -y * jnp.log(p + eps) - (1.0 - y) * jnp.log(1.0 - p + eps)
+    return {'Loss': out}
+
+
+@register('hinge_loss')
+def _hinge_loss(ins, attrs, ctx):
+    logits = data_of(ins['Logits'][0])
+    y = data_of(ins['Labels'][0]).astype(logits.dtype)
+    return {'Loss': jnp.maximum(0.0, 1.0 - (2.0 * y - 1.0) * logits)}
+
+
+@register('margin_rank_loss')
+def _margin_rank_loss(ins, attrs, ctx):
+    """out = max(0, -label*(x1-x2) + margin); label in {1,-1} says x1
+    should rank higher/lower (reference margin_rank_loss_op.cc)."""
+    label = data_of(ins['Label'][0])
+    x1 = data_of(ins['X1'][0])
+    x2 = data_of(ins['X2'][0])
+    margin = float(attrs.get('margin', 0.0))
+    act = -label * (x1 - x2) + margin
+    out = jnp.maximum(0.0, act)
+    return {'Out': out, 'Activated': (act > 0).astype(x1.dtype)}
+
+
+@register('modified_huber_loss')
+def _modified_huber_loss(ins, attrs, ctx):
+    """z = y'*x with y' in {-1,1}: max(0,1-z)^2 for z >= -1 else -4z
+    (reference modified_huber_loss_op.cc)."""
+    x = data_of(ins['X'][0])
+    y = data_of(ins['Y'][0]).astype(x.dtype)
+    z = x * (2.0 * y - 1.0)
+    quad = jnp.square(jnp.maximum(0.0, 1.0 - z))
+    out = jnp.where(z >= -1.0, quad, -4.0 * z)
+    return {'Out': out, 'IntermediateVal': z}
+
+
+@register('sampling_id')
+def _sampling_id(ins, attrs, ctx):
+    """Sample a category index per row of a probability matrix
+    (reference sampling_id_op.cc)."""
+    p = data_of(ins['X'][0]).astype(jnp.float32)
+    key = ctx.rng()
+    ids = jax.random.categorical(key, jnp.log(jnp.maximum(p, 1e-30)),
+                                 axis=-1)
+    return {'Out': ids.astype(jnp.int64)}
+
+
+@register('conv_shift')
+def _conv_shift(ins, attrs, ctx):
+    """Circular cross-correlation (reference conv_shift_op.cc): out[i,j] =
+    sum_k x[i, (j+k-M/2) mod N] * y[i, k] with y width M odd."""
+    x = data_of(ins['X'][0])
+    y = data_of(ins['Y'][0])
+    n = x.shape[1]
+    m = y.shape[1]
+    if m % 2 == 0:
+        raise ValueError(
+            'conv_shift filter width must be odd (reference '
+            'conv_shift_op.cc enforcement), got %d' % m)
+    half = m // 2
+    offs = jnp.arange(n)[:, None] + (jnp.arange(m)[None, :] - half)
+    gathered = x[:, offs % n]          # [B, N, M]
+    return {'Out': jnp.einsum('bnm,bm->bn', gathered, y)}

@@ -1,0 +1,159 @@
+"""Long-tail op rules vs numpy references (ops_impl/extra_ops.py — the
+reference's C++-only operators, reached through generate_layer_fn like the
+reference's own generated-layer mechanism)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.layers.layer_function_generator import \
+    generate_layer_fn
+from paddle_tpu.fluid.layer_helper import LayerHelper
+
+from util import fresh_program
+
+
+def _run_op(op_type, feed_arrays, attrs=None, n_out=1, out_slots=None):
+    """Build a one-op program via the registry and run it."""
+    with fresh_program() as (main, startup):
+        helper = LayerHelper(op_type)
+        inputs = {}
+        feed = {}
+        for slot, arr in feed_arrays.items():
+            v = fluid.layers.data(name='in_%s' % slot.lower(),
+                                  shape=list(arr.shape[1:]),
+                                  dtype=str(arr.dtype))
+            inputs[slot] = [v]
+            feed[v.name] = arr
+        outs = []
+        outputs = {}
+        for s in (out_slots or ['Out'] * n_out):
+            o = helper.create_variable_for_type_inference('float32')
+            outputs.setdefault(s, []).append(o)
+            outs.append(o)
+        helper.append_op(type=op_type, inputs=inputs, outputs=outputs,
+                         attrs=attrs or {})
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        res = exe.run(main, feed=feed, fetch_list=[o.name for o in outs])
+    return [np.asarray(r) for r in res]
+
+
+def test_sign_cumsum():
+    x = np.array([[-2., 0., 3.], [1., -1., 4.]], 'float32')
+    out, = _run_op('sign', {'X': x})
+    np.testing.assert_array_equal(out, np.sign(x))
+
+    c, = _run_op('cumsum', {'X': x}, attrs={'axis': 1})
+    np.testing.assert_allclose(c, np.cumsum(x, axis=1))
+    ce, = _run_op('cumsum', {'X': x}, attrs={'axis': 1, 'exclusive': True})
+    np.testing.assert_allclose(ce, np.cumsum(x, 1) - x)
+    cr, = _run_op('cumsum', {'X': x}, attrs={'axis': 1, 'reverse': True})
+    np.testing.assert_allclose(cr, np.cumsum(x[:, ::-1], 1)[:, ::-1])
+
+
+def test_norms_and_distance():
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 4).astype('float32')
+    y = rng.randn(3, 4).astype('float32')
+    out, = _run_op('l1_norm', {'X': x})
+    np.testing.assert_allclose(out, [np.abs(x).sum()], rtol=1e-6)
+    out, = _run_op('squared_l2_norm', {'X': x})
+    np.testing.assert_allclose(out, [(x ** 2).sum()], rtol=1e-6)
+    d, sub = _run_op('squared_l2_distance', {'X': x, 'Y': y},
+                     out_slots=['Out', 'sub_result'])
+    np.testing.assert_allclose(d, ((x - y) ** 2).sum(1, keepdims=True),
+                               rtol=1e-5)
+    np.testing.assert_allclose(sub, x - y, rtol=1e-6)
+    o, n = _run_op('norm', {'X': x}, attrs={'axis': 1, 'epsilon': 1e-10},
+                   out_slots=['Out', 'Norm'])
+    want_norm = np.sqrt((x ** 2).sum(1, keepdims=True) + 1e-10)
+    np.testing.assert_allclose(o, x / want_norm, rtol=1e-5)
+    np.testing.assert_allclose(n, want_norm, rtol=1e-5)
+
+
+def test_simple_elementwise():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 3).astype('float32')
+    y = rng.randn(2, 3).astype('float32')
+    out, = _run_op('minus', {'X': x, 'Y': y})
+    np.testing.assert_allclose(out, x - y, rtol=1e-6)
+    z, = _run_op('fill_zeros_like', {'X': x})
+    np.testing.assert_array_equal(z, np.zeros_like(x))
+
+
+def test_fill():
+    out, = _run_op('fill', {}, attrs={'shape': [2, 3],
+                                      'value': [1, 2, 3, 4, 5, 6],
+                                      'dtype': 'float32'})
+    np.testing.assert_allclose(out, np.arange(1, 7, dtype='float32')
+                               .reshape(2, 3))
+
+
+def test_loss_family():
+    rng = np.random.RandomState(2)
+    p = rng.uniform(0.05, 0.95, (4, 1)).astype('float32')
+    y = (rng.rand(4, 1) > 0.5).astype('float32')
+    eps = 1e-4
+    out, = _run_op('log_loss', {'Predicted': p, 'Labels': y},
+                   attrs={'epsilon': eps}, out_slots=['Loss'])
+    want = -y * np.log(p + eps) - (1 - y) * np.log(1 - p + eps)
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+    logits = rng.randn(4, 1).astype('float32')
+    out, = _run_op('hinge_loss', {'Logits': logits, 'Labels': y},
+                   out_slots=['Loss'])
+    np.testing.assert_allclose(
+        out, np.maximum(0, 1 - (2 * y - 1) * logits), rtol=1e-5)
+
+    x1 = rng.randn(4, 1).astype('float32')
+    x2 = rng.randn(4, 1).astype('float32')
+    lbl = np.where(rng.rand(4, 1) > 0.5, 1.0, -1.0).astype('float32')
+    out, act = _run_op('margin_rank_loss',
+                       {'Label': lbl, 'X1': x1, 'X2': x2},
+                       attrs={'margin': 0.1},
+                       out_slots=['Out', 'Activated'])
+    want = np.maximum(0, -lbl * (x1 - x2) + 0.1)
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+    xh = rng.randn(4, 1).astype('float32')
+    out, inter = _run_op('modified_huber_loss', {'X': xh, 'Y': y},
+                         out_slots=['Out', 'IntermediateVal'])
+    z = xh * (2 * y - 1)
+    want = np.where(z >= -1, np.maximum(0, 1 - z) ** 2, -4 * z)
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def test_sampling_id_distribution():
+    # a peaked distribution must mostly sample its mode
+    p = np.tile(np.array([[0.01, 0.01, 0.97, 0.01]], 'float32'), (64, 1))
+    out, = _run_op('sampling_id', {'X': p})
+    assert out.shape == (64,)
+    assert (out == 2).mean() > 0.8
+
+
+def test_conv_shift():
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 6).astype('float32')
+    y = rng.randn(2, 3).astype('float32')
+    out, = _run_op('conv_shift', {'X': x, 'Y': y})
+    n, m = 6, 3
+    want = np.zeros_like(x)
+    for b in range(2):
+        for j in range(n):
+            for k in range(m):
+                want[b, j] += x[b, (j + k - m // 2) % n] * y[b, k]
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def test_generate_layer_fn_reaches_extra_ops():
+    """The reference's generated-layer mechanism exposes these ops."""
+    sign = generate_layer_fn('sign')
+    with fresh_program() as (main, startup):
+        x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+        s = sign(x)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        out, = exe.run(main, feed={'x': np.array([[-1., 0., 5.]],
+                                                 'float32')},
+                       fetch_list=[s.name])
+    np.testing.assert_array_equal(out, [[-1., 0., 1.]])
