@@ -6,7 +6,9 @@ them, plus the handful of layers/ops.py wrappers.
 
 Parity: paddle/fluid/operators/{sign,cum,l1_norm,squared_l2_norm,
 squared_l2_distance,minus,fill,fill_zeros_like,norm,log_loss,hinge_loss,
-margin_rank_loss,modified_huber_loss,sampling_id,conv_shift}_op.*
+margin_rank_loss,modified_huber_loss,sampling_id,conv_shift,
+bilinear_tensor_product,sequence_concat,sequence_slice,sequence_erase,
+proximal_gd,proximal_adagrad}_op.*
 """
 import numpy as np
 
@@ -163,3 +165,127 @@ def _conv_shift(ins, attrs, ctx):
     offs = jnp.arange(n)[:, None] + (jnp.arange(m)[None, :] - half)
     gathered = x[:, offs % n]          # [B, N, M]
     return {'Out': jnp.einsum('bnm,bm->bn', gathered, y)}
+
+
+@register('bilinear_tensor_product')
+def _bilinear_tensor_product(ins, attrs, ctx):
+    """out[:, k] = x @ W[k] @ y^T (+ bias) — reference
+    bilinear_tensor_product_op.cc."""
+    x = data_of(ins['X'][0])
+    y = data_of(ins['Y'][0])
+    w = data_of(ins['Weight'][0])             # [K, dx, dy]
+    out = jnp.einsum('bi,kij,bj->bk', x, w, y)
+    if ins.get('Bias'):
+        out = out + data_of(ins['Bias'][0])
+    return {'Out': out}
+
+
+@register('sequence_concat')
+def _sequence_concat(ins, attrs, ctx):
+    """Concatenate corresponding sequences along time (reference
+    sequence_concat_op.cc): out_i = [a_i; b_i], ragged. Dense encoding:
+    static width sum(T_k), per-row shifts via traced gathers."""
+    from ..lowering import SeqValue
+    seqs = [v for v in ins['X']]
+    vals = [v if isinstance(v, SeqValue) else None for v in seqs]
+    if any(v is None for v in vals):
+        raise TypeError('sequence_concat expects lod inputs')
+    B = vals[0].data.shape[0]
+    total_T = sum(v.data.shape[1] for v in vals)
+    cols = jnp.arange(total_T)[None, :]                    # [1, Tt]
+    out = jnp.zeros((B, total_T) + vals[0].data.shape[2:],
+                    vals[0].data.dtype)
+    start = jnp.zeros((B, 1), jnp.int32)
+    for v in vals:
+        lens = v.lengths.reshape(B, 1).astype(jnp.int32)
+        T = v.data.shape[1]
+        local = cols - start                               # [B, Tt]
+        inside = (local >= 0) & (local < lens)
+        idx = jnp.clip(local, 0, T - 1)
+        gathered = jnp.take_along_axis(
+            v.data, idx.reshape(B, total_T, *([1] * (v.data.ndim - 2))),
+            axis=1)
+        m = inside.reshape(B, total_T, *([1] * (v.data.ndim - 2)))
+        out = jnp.where(m, gathered, out)
+        start = start + lens
+    new_lens = sum(v.lengths.astype(jnp.int32) for v in vals)
+    return {'Out': SeqValue(out, new_lens)}
+
+
+@register('sequence_slice')
+def _sequence_slice(ins, attrs, ctx):
+    """Per-sequence slice by offset/length tensors (reference
+    sequence_slice_op.cc); output padded to the input's time capacity."""
+    from ..lowering import SeqValue
+    x = ins['X'][0]
+    if not isinstance(x, SeqValue):
+        raise TypeError('sequence_slice expects a lod input')
+    off = data_of(ins['Offset'][0]).reshape(-1).astype(jnp.int32)
+    length = data_of(ins['Length'][0]).reshape(-1).astype(jnp.int32)
+    B, T = x.data.shape[:2]
+    cols = jnp.arange(T)[None, :]
+    idx = jnp.clip(off[:, None] + cols, 0, T - 1)
+    out = jnp.take_along_axis(
+        x.data, idx.reshape(B, T, *([1] * (x.data.ndim - 2))), axis=1)
+    m = (cols < length[:, None]).reshape(
+        B, T, *([1] * (x.data.ndim - 2)))
+    return {'Out': SeqValue(jnp.where(m, out, 0), length)}
+
+
+@register('sequence_erase')
+def _sequence_erase(ins, attrs, ctx):
+    """Remove all occurrences of the given tokens and compact each
+    sequence left (reference sequence_erase_op.cc). Traced-safe
+    compaction: stable argsort on the drop mask."""
+    from ..lowering import SeqValue
+    x = ins['X'][0]
+    if not isinstance(x, SeqValue):
+        raise TypeError('sequence_erase expects a lod input')
+    data = x.data
+    flat = data.reshape(data.shape[0], data.shape[1])
+    valid = x.mask(jnp.bool_)
+    drop = jnp.zeros_like(valid)
+    for t in np.asarray(attrs.get('tokens', [])):
+        drop = drop | (flat == int(t))
+    keep = valid & ~drop
+    # stable sort moves kept tokens left, preserving order
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    compacted = jnp.take_along_axis(flat, order, axis=1)
+    new_lens = keep.sum(axis=1).astype(jnp.int32)
+    cols = jnp.arange(flat.shape[1])[None, :]
+    compacted = jnp.where(cols < new_lens[:, None], compacted, 0)
+    return {'Out': SeqValue(compacted.reshape(data.shape), new_lens)}
+
+
+@register('proximal_gd')
+def _proximal_gd(ins, attrs, ctx):
+    """prox_{l1,l2} gradient step (reference proximal_gd_op.cc):
+    p' = sign(z) * max(|z| - lr*l1, 0) / (1 + lr*l2), z = p - lr*g."""
+    p = data_of(ins['Param'][0])
+    g = data_of(ins['Grad'][0])
+    lr = data_of(ins['LearningRate'][0]).reshape(())
+    l1 = float(attrs.get('l1', 0.0))
+    l2 = float(attrs.get('l2', 0.0))
+    z = p - lr * g
+    out = jnp.sign(z) * jnp.maximum(jnp.abs(z) - lr * l1, 0.0) \
+        / (1.0 + lr * l2)
+    return {'ParamOut': out}
+
+
+@register('proximal_adagrad')
+def _proximal_adagrad(ins, attrs, ctx):
+    """Adagrad accumulator + proximal step (reference
+    proximal_adagrad_op.cc)."""
+    p = data_of(ins['Param'][0])
+    g = data_of(ins['Grad'][0])
+    m = data_of(ins['Moment'][0])
+    lr = data_of(ins['LearningRate'][0]).reshape(())
+    l1 = float(attrs.get('l1', 0.0))
+    l2 = float(attrs.get('l2', 0.0))
+    m_out = m + g * g
+    # adaptive lr scales only the gradient step; the l1/l2 shrinkage uses
+    # the PLAIN lr (reference proximal_adagrad_op.h)
+    z = p - lr / jnp.sqrt(m_out) * g
+    out = jnp.sign(z) * jnp.maximum(jnp.abs(z) - lr * l1, 0.0) \
+        / (1.0 + lr * l2)
+    return {'ParamOut': out, 'MomentOut': m_out}

@@ -157,3 +157,119 @@ def test_generate_layer_fn_reaches_extra_ops():
                                                  'float32')},
                        fetch_list=[s.name])
     np.testing.assert_array_equal(out, [[-1., 0., 1.]])
+
+
+def _seq(data, lens):
+    """Build a feed LoDTensor from padded [B, T] data + lengths."""
+    from paddle_tpu.fluid.lod_tensor import create_lod_tensor
+    flat = []
+    for row, l in zip(data, lens):
+        flat.extend(row[:l])
+    arr = np.asarray(flat).reshape(-1, *np.asarray(data).shape[2:]) \
+        if np.asarray(data).ndim > 2 else np.asarray(flat).reshape(-1, 1)
+    return create_lod_tensor(arr, [list(lens)], fluid.CPUPlace())
+
+
+def test_bilinear_tensor_product():
+    rng = np.random.RandomState(4)
+    x = rng.randn(3, 4).astype('float32')
+    y = rng.randn(3, 5).astype('float32')
+    w = rng.randn(2, 4, 5).astype('float32')
+    out, = _run_op('bilinear_tensor_product',
+                   {'X': x, 'Y': y, 'Weight': w})
+    want = np.einsum('bi,kij,bj->bk', x, w, y)
+    np.testing.assert_allclose(out, want, rtol=1e-4)
+
+
+def test_sequence_concat():
+    with fresh_program() as (main, startup):
+        a = fluid.layers.data(name='a', shape=[1], dtype='float32',
+                              lod_level=1)
+        b = fluid.layers.data(name='b', shape=[1], dtype='float32',
+                              lod_level=1)
+        helper = LayerHelper('sequence_concat')
+        out = helper.create_variable_for_type_inference('float32')
+        out.lod_level = 1
+        helper.append_op(type='sequence_concat', inputs={'X': [a, b]},
+                         outputs={'Out': [out]}, attrs={})
+        pooled = fluid.layers.sequence_pool(out, pool_type='sum')
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        fa = _seq([[1., 2., 0.], [5., 0., 0.]], [2, 1])
+        fb = _seq([[10., 0., 0.], [20., 30., 0.]], [1, 2])
+        res, = exe.run(main, feed={'a': fa, 'b': fb},
+                       fetch_list=[pooled])
+    # row0: 1+2+10, row1: 5+20+30
+    np.testing.assert_allclose(np.asarray(res).reshape(-1), [13., 55.])
+
+
+def test_sequence_slice():
+    with fresh_program() as (main, startup):
+        x = fluid.layers.data(name='x', shape=[1], dtype='float32',
+                              lod_level=1)
+        off = fluid.layers.data(name='off', shape=[1], dtype='int64')
+        ln = fluid.layers.data(name='ln', shape=[1], dtype='int64')
+        helper = LayerHelper('sequence_slice')
+        out = helper.create_variable_for_type_inference('float32')
+        out.lod_level = 1
+        helper.append_op(type='sequence_slice',
+                         inputs={'X': [x], 'Offset': [off],
+                                 'Length': [ln]},
+                         outputs={'Out': [out]}, attrs={})
+        pooled = fluid.layers.sequence_pool(out, pool_type='sum')
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        fx = _seq([[1., 2., 3., 4.], [5., 6., 7., 0.]], [4, 3])
+        res, = exe.run(main, feed={
+            'x': fx, 'off': np.array([[1], [0]], 'int64'),
+            'ln': np.array([[2], [1]], 'int64')}, fetch_list=[pooled])
+    # row0: x[1:3] = 2+3; row1: x[0:1] = 5
+    np.testing.assert_allclose(np.asarray(res).reshape(-1), [5., 5.])
+
+
+def test_sequence_erase():
+    with fresh_program() as (main, startup):
+        x = fluid.layers.data(name='x', shape=[1], dtype='int64',
+                              lod_level=1)
+        helper = LayerHelper('sequence_erase')
+        out = helper.create_variable_for_type_inference('int64')
+        out.lod_level = 1
+        helper.append_op(type='sequence_erase', inputs={'X': [x]},
+                         outputs={'Out': [out]},
+                         attrs={'tokens': [2, 5]})
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        fx = _seq([[1, 2, 3, 2], [5, 5, 9, 0]], [4, 3])
+        res, = exe.run(main, feed={'x': fx}, fetch_list=[out],
+                       return_numpy=False)
+    from paddle_tpu.fluid.lod_tensor import LoDTensor
+    lt = res[0] if isinstance(res, (list, tuple)) else res
+    assert lt.recursive_sequence_lengths() == [[2, 1]]
+    np.testing.assert_array_equal(
+        np.asarray(lt.data).reshape(-1)[:3], [1, 3, 9])
+
+
+def test_proximal_rules():
+    p = np.array([[1.0, -2.0]], 'float32')
+    g = np.array([[0.5, 0.5]], 'float32')
+    lr = np.array([0.1], 'float32')
+    out, = _run_op('proximal_gd',
+                   {'Param': p, 'Grad': g, 'LearningRate': lr},
+                   attrs={'l1': 0.1, 'l2': 0.2},
+                   out_slots=['ParamOut'])
+    z = p - 0.1 * g
+    want = np.sign(z) * np.maximum(np.abs(z) - 0.1 * 0.1, 0) / (1 + 0.1 * 0.2)
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+    m = np.array([[0.4, 0.4]], 'float32')
+    out, mout = _run_op('proximal_adagrad',
+                        {'Param': p, 'Grad': g, 'Moment': m,
+                         'LearningRate': lr},
+                        attrs={'l1': 0.1, 'l2': 0.2},
+                        out_slots=['ParamOut', 'MomentOut'])
+    m2 = m + g * g
+    # gradient step uses the adaptive lr; the shrinkage the PLAIN lr
+    z = p - 0.1 / np.sqrt(m2) * g
+    want = np.sign(z) * np.maximum(np.abs(z) - 0.1 * 0.1, 0) / (1 + 0.1 * 0.2)
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+    np.testing.assert_allclose(mout, m2, rtol=1e-6)
