@@ -289,3 +289,111 @@ def _proximal_adagrad(ins, attrs, ctx):
     out = jnp.sign(z) * jnp.maximum(jnp.abs(z) - lr * l1, 0.0) \
         / (1.0 + lr * l2)
     return {'ParamOut': out, 'MomentOut': m_out}
+
+
+def _window_views(x, kh, kw, sh, sw, ph, pw, pad_value):
+    """[N,C,H,W] -> (windows [N,C,OH,OW,KH*KW], flat input index of each
+    window element [OH,OW,KH*KW] into the PADDED H*W grid, padded dims)."""
+    n, c, h, w = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                 constant_values=pad_value)
+    hp, wp = h + 2 * ph, w + 2 * pw
+    oh = (hp - kh) // sh + 1
+    ow = (wp - kw) // sw + 1
+    r = jnp.arange(oh)[:, None] * sh + jnp.arange(kh)[None, :]   # [OH,KH]
+    cc = jnp.arange(ow)[:, None] * sw + jnp.arange(kw)[None, :]  # [OW,KW]
+    t = xp[:, :, r.reshape(-1), :].reshape(n, c, oh, kh, wp)
+    t = t[:, :, :, :, cc.reshape(-1)].reshape(n, c, oh, kh, ow, kw)
+    win = t.transpose(0, 1, 2, 4, 3, 5).reshape(n, c, oh, ow, kh * kw)
+    fidx = (r[:, None, :, None] * wp +
+            cc[None, :, None, :]).reshape(oh, ow, kh * kw)
+    return win, fidx, hp, wp
+
+
+@register('max_pool2d_with_index')
+def _max_pool2d_with_index(ins, attrs, ctx):
+    """Max pool that also emits the argmax's flat index into the input
+    feature map (reference pool_with_index_op.cc); the index feeds
+    unpool. Honors global_pooling (full-extent kernel, zero padding)."""
+    x = data_of(ins['X'][0])
+    if attrs.get('global_pooling', False):
+        kh, kw = x.shape[2], x.shape[3]
+        sh = sw = 1
+        ph = pw = 0
+    else:
+        kh, kw = [int(k) for k in attrs['ksize']]
+        sh, sw = [int(s) for s in attrs.get('strides', [1, 1])]
+        ph, pw = [int(p) for p in attrs.get('paddings', [0, 0])]
+    neg = jnp.finfo(x.dtype).min
+    win, fidx, hp, wp = _window_views(x, kh, kw, sh, sw, ph, pw, neg)
+    arg = jnp.argmax(win, axis=-1)                       # [N,C,OH,OW]
+    out = jnp.take_along_axis(win, arg[..., None], axis=-1)[..., 0]
+    fidx_b = jnp.broadcast_to(fidx, win.shape)
+    flat_p = jnp.take_along_axis(fidx_b, arg[..., None],
+                                 axis=-1)[..., 0]        # padded-grid idx
+    # convert to UNPADDED input coordinates (reference indexes the input)
+    rr = flat_p // wp - ph
+    cc = flat_p % wp - pw
+    mask = (rr >= 0) & (rr < x.shape[2]) & (cc >= 0) & (cc < x.shape[3])
+    flat = jnp.where(mask, rr * x.shape[3] + cc, 0)
+    return {'Out': out, 'Mask': flat.astype(jnp.int32)}
+
+
+@register('unpool')
+def _unpool(ins, attrs, ctx):
+    """Max-unpool by recorded indices (reference unpool_op.cc,
+    unpooling_type='max'): each pooled value is written back to its argmax
+    position in a zero canvas. Output dims follow the reference InferShape
+    ((in-1)*stride - 2*pad + ksize) unless an explicit output_size attr
+    overrides. Duplicate indices (overlapping pooling) write the same
+    element value, so assignment matches the reference's overwrite."""
+    x = data_of(ins['X'][0])                  # [N,C,OH,OW]
+    idx = data_of(ins['Indices'][0]).astype(jnp.int32)
+    if attrs.get('unpooling_type', 'max') != 'max':
+        raise ValueError('only max unpooling exists (reference parity)')
+    n, c, ih, iw = x.shape
+    if attrs.get('output_size'):
+        oh_, ow_ = [int(v) for v in attrs['output_size']]
+    else:
+        kh, kw = [int(k) for k in attrs['ksize']]
+        sh, sw = [int(s) for s in attrs.get('strides', [1, 1])]
+        ph, pw = [int(p) for p in attrs.get('paddings', [0, 0])]
+        oh_ = (ih - 1) * sh - 2 * ph + kh
+        ow_ = (iw - 1) * sw - 2 * pw + kw
+    canvas = jnp.zeros((n, c, oh_ * ow_), x.dtype)
+    flat_x = x.reshape(n, c, -1)
+    flat_i = idx.reshape(n, c, -1)
+    ni = jnp.arange(n)[:, None, None]
+    ci = jnp.arange(c)[None, :, None]
+    canvas = canvas.at[ni, ci, flat_i].set(flat_x)
+    return {'Out': canvas.reshape(n, c, oh_, ow_)}
+
+
+@register('spp')
+def _spp(ins, attrs, ctx):
+    """Spatial pyramid pooling (reference spp_op.h): level l pools with
+    kernel ceil(H/2^l), stride = kernel, padding (kernel*bins - H + 1)//2,
+    flattened CHANNEL-major per level and concatenated to
+    [N, C*(4^L-1)/3]."""
+    x = data_of(ins['X'][0])
+    levels = int(attrs['pyramid_height'])
+    ptype = attrs.get('pooling_type', 'max')
+    n, c, h, w = x.shape
+    outs = []
+    for l in range(levels):
+        bins = 2 ** l
+        kh = int(np.ceil(h / bins))
+        kw = int(np.ceil(w / bins))
+        ph = (kh * bins - h + 1) // 2
+        pw = (kw * bins - w + 1) // 2
+        if ptype == 'max':
+            pad_v = jnp.finfo(x.dtype).min
+            win, _, _, _ = _window_views(x, kh, kw, kh, kw, ph, pw, pad_v)
+            red = jnp.max(win, axis=-1)            # [N,C,bins,bins]
+        else:
+            win, _, _, _ = _window_views(x, kh, kw, kh, kw, ph, pw, 0.0)
+            # reference 0.14 avg pool divides by the full kernel area
+            # (padding included)
+            red = jnp.sum(win, axis=-1) / float(kh * kw)
+        outs.append(red.reshape(n, c * bins * bins))
+    return {'Out': jnp.concatenate(outs, axis=1)}

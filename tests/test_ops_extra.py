@@ -273,3 +273,77 @@ def test_proximal_rules():
     want = np.sign(z) * np.maximum(np.abs(z) - 0.1 * 0.1, 0) / (1 + 0.1 * 0.2)
     np.testing.assert_allclose(out, want, rtol=1e-5)
     np.testing.assert_allclose(mout, m2, rtol=1e-6)
+
+
+def test_max_pool_with_index_and_unpool():
+    rng = np.random.RandomState(5)
+    x = rng.randn(1, 1, 4, 4).astype('float32')
+    out, mask = _run_op('max_pool2d_with_index', {'X': x},
+                        attrs={'ksize': [2, 2], 'strides': [2, 2],
+                               'paddings': [0, 0]},
+                        out_slots=['Out', 'Mask'])
+    # forward max matches plain pooling
+    want = x.reshape(1, 1, 2, 2, 2, 2).max(axis=(3, 5))
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+    # each index points at the max element of its window
+    flat = x.reshape(-1)
+    np.testing.assert_allclose(flat[mask.reshape(-1).astype(int)],
+                               out.reshape(-1), rtol=1e-6)
+
+    # unpool scatters the pooled values back to their positions
+    with fresh_program() as (main, startup):
+        xo = fluid.layers.data(name='xo', shape=[1, 2, 2],
+                               dtype='float32')
+        mi = fluid.layers.data(name='mi', shape=[1, 2, 2], dtype='int32')
+        helper = LayerHelper('unpool')
+        o = helper.create_variable_for_type_inference('float32')
+        # no output_size: dims derive from ksize/strides/paddings
+        # like the reference InferShape
+        helper.append_op(type='unpool',
+                         inputs={'X': [xo], 'Indices': [mi]},
+                         outputs={'Out': [o]},
+                         attrs={'unpooling_type': 'max',
+                                'ksize': [2, 2], 'strides': [2, 2],
+                                'paddings': [0, 0]})
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        up, = exe.run(main, feed={'xo': out, 'mi': mask},
+                      fetch_list=[o])
+    up = np.asarray(up)
+    assert up.shape == (1, 1, 4, 4)
+    # the max positions carry the values; everything else is zero
+    np.testing.assert_allclose(
+        up.reshape(-1)[mask.reshape(-1).astype(int)],
+        out.reshape(-1), rtol=1e-6)
+    assert (up != 0).sum() == (out != 0).sum()
+    np.testing.assert_allclose(up.sum(), out.sum(), rtol=1e-5)
+
+
+def test_spp_pyramid():
+    rng = np.random.RandomState(6)
+    x = rng.randn(2, 3, 8, 8).astype('float32')
+    out, = _run_op('spp', {'X': x},
+                   attrs={'pyramid_height': 2, 'pooling_type': 'max'})
+    # (4^2-1)/3 = 5 bins x 3 channels
+    assert out.shape == (2, 15)
+    np.testing.assert_allclose(out[:, :3], x.max(axis=(2, 3)), rtol=1e-6)
+    # level 1 flattens CHANNEL-major (reference spp_op.h layout):
+    # cols 3..6 are channel 0's 2x2 bin maxes, first of which is the
+    # top-left 4x4 quadrant
+    quad = x.reshape(2, 3, 2, 4, 2, 4).max(axis=(3, 5))  # [N,C,2,2]
+    np.testing.assert_allclose(out[:, 3:], quad.reshape(2, -1), rtol=1e-6)
+    np.testing.assert_allclose(out[:, 3], x[:, 0, :4, :4].max(axis=(1, 2)),
+                               rtol=1e-6)
+
+    # avg pooling divides by the full kernel area (0.14 semantics)
+    oa, = _run_op('spp', {'X': x},
+                  attrs={'pyramid_height': 1, 'pooling_type': 'avg'})
+    np.testing.assert_allclose(oa, x.mean(axis=(2, 3)), rtol=1e-5)
+
+    # non-divisible size: reference kernel/pad schedule (H=7, level 1:
+    # kernel 4, pad 1 -> windows rows -1..2 / 3..6)
+    x7 = rng.randn(1, 1, 7, 7).astype('float32')
+    o7, = _run_op('spp', {'X': x7},
+                  attrs={'pyramid_height': 2, 'pooling_type': 'max'})
+    assert o7.shape == (1, 5)
+    np.testing.assert_allclose(o7[0, 1], x7[0, 0, :3, :3].max(), rtol=1e-6)
