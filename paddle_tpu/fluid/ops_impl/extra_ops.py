@@ -397,3 +397,97 @@ def _spp(ins, attrs, ctx):
             red = jnp.sum(win, axis=-1) / float(kh * kw)
         outs.append(red.reshape(n, c * bins * bins))
     return {'Out': jnp.concatenate(outs, axis=1)}
+
+
+@register('positive_negative_pair')
+def _positive_negative_pair(ins, attrs, ctx):
+    """Ranking pair statistics per query (reference
+    positive_negative_pair_op.h): over same-query item pairs with
+    different labels, a pair is positive when the score order matches the
+    label order, negative when inverted, neutral on score ties; weights
+    average pairwise. Accumulators chain across batches."""
+    score = data_of(ins['Score'][0]).astype(jnp.float32)
+    label = data_of(ins['Label'][0]).astype(jnp.float32).reshape(-1)
+    query = data_of(ins['QueryID'][0]).reshape(-1)
+    col = int(attrs.get('column', -1))
+    s = score[:, col]
+    n = s.shape[0]
+    if ins.get('Weight'):
+        w = data_of(ins['Weight'][0]).astype(jnp.float32).reshape(-1)
+    else:
+        w = jnp.ones((n,), jnp.float32)
+    same_q = query[:, None] == query[None, :]
+    diff_l = label[:, None] != label[None, :]
+    upper = jnp.triu(jnp.ones((n, n), bool), k=1)
+    consider = same_q & diff_l & upper
+    pw = (w[:, None] + w[None, :]) * 0.5
+    s_d = s[:, None] - s[None, :]
+    l_d = label[:, None] - label[None, :]
+    tie = s_d == 0
+    pos_m = consider & ~tie & (jnp.sign(s_d) == jnp.sign(l_d))
+    neg_m = consider & ~tie & (jnp.sign(s_d) != jnp.sign(l_d))
+    neu_m = consider & tie
+    pos = jnp.sum(jnp.where(pos_m, pw, 0.0)).reshape(1)
+    neg = jnp.sum(jnp.where(neg_m, pw, 0.0)).reshape(1)
+    neu = jnp.sum(jnp.where(neu_m, pw, 0.0)).reshape(1)
+    # accumulators apply only when ALL three are wired (reference &&)
+    if (ins.get('AccumulatePositivePair') and ins.get('AccumulateNegativePair')
+            and ins.get('AccumulateNeutralPair')):
+        pos = pos + data_of(ins['AccumulatePositivePair'][0]).reshape(1)
+        neg = neg + data_of(ins['AccumulateNegativePair'][0]).reshape(1)
+        neu = neu + data_of(ins['AccumulateNeutralPair'][0]).reshape(1)
+    return {'PositivePair': pos, 'NegativePair': neg, 'NeutralPair': neu}
+
+
+@register('precision_recall')
+def _precision_recall(ins, attrs, ctx):
+    """Multi-class precision/recall states + macro/micro metrics
+    (reference precision_recall_op.h; states columns TP FP TN FN)."""
+    idx = data_of(ins['Indices'][0]).reshape(-1).astype(jnp.int32)
+    label = data_of(ins['Labels'][0]).reshape(-1).astype(jnp.int32)
+    C = int(attrs['class_number'])
+    n = idx.shape[0]
+    if ins.get('Weights'):
+        w = data_of(ins['Weights'][0]).astype(jnp.float32).reshape(-1)
+    else:
+        w = jnp.ones((n,), jnp.float32)
+    oh_pred = jax.nn.one_hot(idx, C, dtype=jnp.float32)
+    oh_lbl = jax.nn.one_hot(label, C, dtype=jnp.float32)
+    tp = jnp.sum(oh_pred * oh_lbl * w[:, None], axis=0)
+    fp = jnp.sum(oh_pred * (1 - oh_lbl) * w[:, None], axis=0)
+    fn = jnp.sum(oh_lbl * (1 - oh_pred) * w[:, None], axis=0)
+    # TN per class: everything not touching the class (reference
+    # increments all-others then corrects)
+    total_w = jnp.sum(w)
+    tn = total_w - tp - fp - fn
+    batch_states = jnp.stack([tp, fp, tn, fn], axis=1)    # [C, 4]
+    states = batch_states
+    if ins.get('StatesInfo'):
+        states = states + data_of(ins['StatesInfo'][0]).astype(jnp.float32)
+
+    def metrics(st):
+        # empty classes score 1.0 (reference CalcPrecision/CalcRecall)
+        tp_, fp_, fn_ = st[:, 0], st[:, 1], st[:, 3]
+        prec = jnp.where(tp_ + fp_ > 0,
+                         tp_ / jnp.maximum(tp_ + fp_, 1e-12), 1.0)
+        rec = jnp.where(tp_ + fn_ > 0,
+                        tp_ / jnp.maximum(tp_ + fn_, 1e-12), 1.0)
+
+        def f1(p, r):
+            return jnp.where(p + r > 0,
+                             2 * p * r / jnp.maximum(p + r, 1e-12), 0.0)
+
+        macro_p, macro_r = prec.mean(), rec.mean()
+        stp, sfp, sfn = tp_.sum(), fp_.sum(), fn_.sum()
+        micro_p = jnp.where(stp + sfp > 0,
+                            stp / jnp.maximum(stp + sfp, 1e-12), 1.0)
+        micro_r = jnp.where(stp + sfn > 0,
+                            stp / jnp.maximum(stp + sfn, 1e-12), 1.0)
+        # reference: F1 OF the macro-averaged precision/recall, not the
+        # mean of per-class F1s
+        return jnp.stack([macro_p, macro_r, f1(macro_p, macro_r),
+                          micro_p, micro_r, f1(micro_p, micro_r)])
+
+    return {'BatchMetrics': metrics(batch_states),
+            'AccumMetrics': metrics(states),
+            'AccumStatesInfo': states}

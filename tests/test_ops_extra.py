@@ -347,3 +347,67 @@ def test_spp_pyramid():
                   attrs={'pyramid_height': 2, 'pooling_type': 'max'})
     assert o7.shape == (1, 5)
     np.testing.assert_allclose(o7[0, 1], x7[0, 0, :3, :3].max(), rtol=1e-6)
+
+
+def test_positive_negative_pair():
+    # query 1: scores [3,1] labels [1,0] -> pos pair
+    # query 2: scores [1,2] labels [1,0] -> neg pair; tie pair neutral
+    score = np.array([[3.], [1.], [1.], [2.], [5.], [5.]], 'float32')
+    label = np.array([[1.], [0.], [1.], [0.], [1.], [0.]], 'float32')
+    query = np.array([[1], [1], [2], [2], [3], [3]], 'int64')
+    pos, neg, neu = _run_op(
+        'positive_negative_pair',
+        {'Score': score, 'Label': label, 'QueryID': query},
+        attrs={'column': -1},
+        out_slots=['PositivePair', 'NegativePair', 'NeutralPair'])
+    assert float(pos) == 1.0 and float(neg) == 1.0 and float(neu) == 1.0
+
+    # accumulators chain
+    pos2, neg2, neu2 = _run_op(
+        'positive_negative_pair',
+        {'Score': score, 'Label': label, 'QueryID': query,
+         'AccumulatePositivePair': np.array([10.], 'float32'),
+         'AccumulateNegativePair': np.array([20.], 'float32'),
+         'AccumulateNeutralPair': np.array([30.], 'float32')},
+        attrs={'column': -1},
+        out_slots=['PositivePair', 'NegativePair', 'NeutralPair'])
+    assert float(pos2) == 11.0 and float(neg2) == 21.0 and float(neu2) == 31.0
+
+
+def test_precision_recall():
+    # 2 classes; preds [0,0,1,1], labels [0,1,1,1]
+    idx = np.array([[0], [0], [1], [1]], 'int32')
+    lbl = np.array([[0], [1], [1], [1]], 'int32')
+    batch, accum, states = _run_op(
+        'precision_recall', {'Indices': idx, 'Labels': lbl},
+        attrs={'class_number': 2},
+        out_slots=['BatchMetrics', 'AccumMetrics', 'AccumStatesInfo'])
+    # class0: tp=1 fp=1 fn=0; class1: tp=2 fp=0 fn=1
+    np.testing.assert_allclose(states[0], [1, 1, 2, 0], atol=1e-6)
+    np.testing.assert_allclose(states[1], [2, 0, 1, 1], atol=1e-6)
+    macro_p = (1 / 2 + 2 / 2) / 2
+    macro_r = (1 / 1 + 2 / 3) / 2
+    np.testing.assert_allclose(batch[0], macro_p, rtol=1e-5)
+    np.testing.assert_allclose(batch[1], macro_r, rtol=1e-5)
+    # macro F1 is F1 OF the averaged p/r (reference CalcF1Score)
+    np.testing.assert_allclose(
+        batch[2], 2 * macro_p * macro_r / (macro_p + macro_r), rtol=1e-5)
+    # micro: tp=3 fp=1 fn=1
+    np.testing.assert_allclose(batch[3], 3 / 4, rtol=1e-5)
+    np.testing.assert_allclose(batch[4], 3 / 4, rtol=1e-5)
+    np.testing.assert_allclose(batch[5], 3 / 4, rtol=1e-5)
+
+    # an absent class contributes 1.0 to macro precision/recall
+    b3, _, _ = _run_op(
+        'precision_recall', {'Indices': idx, 'Labels': lbl},
+        attrs={'class_number': 3},
+        out_slots=['BatchMetrics', 'AccumMetrics', 'AccumStatesInfo'])
+    np.testing.assert_allclose(b3[0], (1 / 2 + 1 + 1) / 3, rtol=1e-5)
+
+    # chaining states doubles the counts
+    _, accum2, states2 = _run_op(
+        'precision_recall', {'Indices': idx, 'Labels': lbl,
+                             'StatesInfo': states},
+        attrs={'class_number': 2},
+        out_slots=['BatchMetrics', 'AccumMetrics', 'AccumStatesInfo'])
+    np.testing.assert_allclose(states2, states * 2, atol=1e-6)
