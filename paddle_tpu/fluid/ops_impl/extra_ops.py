@@ -405,7 +405,13 @@ def _positive_negative_pair(ins, attrs, ctx):
     positive_negative_pair_op.h): over same-query item pairs with
     different labels, a pair is positive when the score order matches the
     label order, negative when inverted, neutral on score ties; weights
-    average pairwise. Accumulators chain across batches."""
+    average pairwise. Accumulators chain across batches.
+
+    Pairing is dense [n, n] over the whole batch (masked to same-query
+    pairs): XLA fuses the elementwise chain into the three reductions, but
+    peak memory is still O(n^2) — for very large ranking evals feed the
+    op per query group (the reference's hash-grouping loop is inherently
+    host-sequential)."""
     score = data_of(ins['Score'][0]).astype(jnp.float32)
     label = data_of(ins['Label'][0]).astype(jnp.float32).reshape(-1)
     query = data_of(ins['QueryID'][0]).reshape(-1)
@@ -491,3 +497,43 @@ def _precision_recall(ins, attrs, ctx):
     return {'BatchMetrics': metrics(batch_states),
             'AccumMetrics': metrics(states),
             'AccumStatesInfo': states}
+
+
+@register('fake_quantize')
+def _fake_quantize(ins, attrs, ctx):
+    """Quantization-aware-training preview op (reference
+    fake_quantize_op.cc, quantize_type='abs_max'): Out = round(x / scale *
+    (2^(bits-1)-1)) with scale = max|x|. The static range_abs_max window
+    machinery served CUDA graph rewrites; abs_max (the tested mode) is
+    the supported type here."""
+    qtype = attrs.get('quantize_type', 'abs_max')
+    if qtype != 'abs_max':
+        raise ValueError(
+            "fake_quantize supports quantize_type='abs_max' (got %r); the "
+            "reference's window-based range_abs_max drove CUDA graph "
+            "rewriting that has no XLA analogue" % qtype)
+    x = data_of(ins['X'][0])
+    bits = int(attrs.get('bit_length', 8))
+    qmax = float((1 << (bits - 1)) - 1)
+    scale = jnp.max(jnp.abs(x))
+    q = x / jnp.maximum(scale, 1e-30) * qmax
+    # reference Eigen round() is half-away-from-zero; jnp.round is
+    # half-to-even
+    out = jnp.sign(q) * jnp.floor(jnp.abs(q) + 0.5)
+    res = {'Out': out, 'OutMovingScale': scale.reshape(1)}
+    if ins.get('InScales'):
+        res['OutScales'] = data_of(ins['InScales'][0])
+    if ins.get('InCurrentIter'):
+        res['OutCurrentIter'] = data_of(ins['InCurrentIter'][0])
+    return res
+
+
+@register('fake_dequantize_max_abs')
+def _fake_dequantize_max_abs(ins, attrs, ctx):
+    """Inverse of fake_quantize abs_max (reference
+    fake_dequantize_op.cc): Out = x * scale / (2^(bits-1)-1)."""
+    x = data_of(ins['X'][0])
+    scale = data_of(ins['Scale'][0]).reshape(())
+    bits = int(attrs.get('num_bits', attrs.get('bit_length', 8)))
+    qmax = float((1 << (bits - 1)) - 1)
+    return {'Out': x.astype(jnp.float32) * scale / qmax}

@@ -360,7 +360,7 @@ def test_positive_negative_pair():
         {'Score': score, 'Label': label, 'QueryID': query},
         attrs={'column': -1},
         out_slots=['PositivePair', 'NegativePair', 'NeutralPair'])
-    assert float(pos) == 1.0 and float(neg) == 1.0 and float(neu) == 1.0
+    assert float(pos[0]) == 1.0 and float(neg[0]) == 1.0 and float(neu[0]) == 1.0
 
     # accumulators chain
     pos2, neg2, neu2 = _run_op(
@@ -371,7 +371,7 @@ def test_positive_negative_pair():
          'AccumulateNeutralPair': np.array([30.], 'float32')},
         attrs={'column': -1},
         out_slots=['PositivePair', 'NegativePair', 'NeutralPair'])
-    assert float(pos2) == 11.0 and float(neg2) == 21.0 and float(neu2) == 31.0
+    assert float(pos2[0]) == 11.0 and float(neg2[0]) == 21.0 and float(neu2[0]) == 31.0
 
 
 def test_precision_recall():
@@ -411,3 +411,24 @@ def test_precision_recall():
         attrs={'class_number': 2},
         out_slots=['BatchMetrics', 'AccumMetrics', 'AccumStatesInfo'])
     np.testing.assert_allclose(states2, states * 2, atol=1e-6)
+
+
+def test_fake_quantize_roundtrip():
+    rng = np.random.RandomState(7)
+    x = rng.randn(4, 6).astype('float32')
+    out, scale = _run_op('fake_quantize', {'X': x},
+                         attrs={'bit_length': 8,
+                                'quantize_type': 'abs_max'},
+                         out_slots=['Out', 'OutMovingScale'])
+    s = np.abs(x).max()
+    q = x / s * 127
+    want = np.sign(q) * np.floor(np.abs(q) + 0.5)  # half-away-from-zero
+    np.testing.assert_allclose(out, want, atol=1e-5)
+    np.testing.assert_allclose(scale, [s], rtol=1e-6)
+
+    deq, = _run_op('fake_dequantize_max_abs',
+                   {'X': out.astype('float32'),
+                    'Scale': np.array([s], 'float32')},
+                   attrs={'num_bits': 8})
+    # quantize->dequantize reproduces x within one quantization step
+    assert np.abs(deq - x).max() <= s / 127 * 0.5 + 1e-6
