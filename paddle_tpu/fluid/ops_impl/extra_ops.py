@@ -537,3 +537,44 @@ def _fake_dequantize_max_abs(ins, attrs, ctx):
     bits = int(attrs.get('num_bits', attrs.get('bit_length', 8)))
     qmax = float((1 << (bits - 1)) - 1)
     return {'Out': x.astype(jnp.float32) * scale / qmax}
+
+
+@register('mine_hard_examples')
+def _mine_hard_examples(ins, attrs, ctx):
+    """Hard-negative mining (reference
+    detection/mine_hard_examples_op.cc, mining_type='max_negative'):
+    candidates are unmatched priors with match_dist < neg_dist_threshold;
+    per image the top min(num_pos * neg_pos_ratio, num_candidates) by
+    classification loss are selected; NegIndices returns them ascending as
+    a LoD sequence. The hard_example mode's sample_size re-matching drove
+    a second pserver-era pass and is not rebuilt."""
+    from ..lowering import SeqValue
+    if attrs.get('mining_type', 'max_negative') != 'max_negative':
+        raise ValueError(
+            "mine_hard_examples supports mining_type='max_negative'")
+    cls_loss = data_of(ins['ClsLoss'][0]).astype(jnp.float32)
+    match = data_of(ins['MatchIndices'][0]).astype(jnp.int32)
+    dist = data_of(ins['MatchDist'][0]).astype(jnp.float32)
+    ratio = float(attrs.get('neg_pos_ratio', 3.0))
+    thresh = float(attrs.get('neg_dist_threshold', 0.5))
+    N, P = cls_loss.shape
+
+    cand = (match == -1) & (dist < thresh)                 # [N, P]
+    num_pos = jnp.sum(match != -1, axis=1)
+    num_cand = jnp.sum(cand, axis=1)
+    n_sel = jnp.minimum((num_pos * ratio).astype(jnp.int32), num_cand)
+
+    masked = jnp.where(cand, cls_loss, -jnp.inf)
+    order = jnp.argsort(-masked, axis=1)                   # loss desc
+    rank_of = jnp.argsort(order, axis=1)                   # prior -> rank
+    selected = cand & (rank_of < n_sel[:, None])           # [N, P]
+
+    # compact selected prior indices ascending per image
+    pidx = jnp.broadcast_to(jnp.arange(P)[None, :], (N, P))
+    key = jnp.where(selected, pidx, P)                     # pads sort last
+    neg_sorted = jnp.sort(key, axis=1)
+    lens = jnp.sum(selected, axis=1).astype(jnp.int32)
+    cols = jnp.arange(P)[None, :]
+    neg = jnp.where(cols < lens[:, None], neg_sorted, 0)
+    return {'NegIndices': SeqValue(neg[..., None].astype(jnp.int32), lens),
+            'UpdatedMatchIndices': match}

@@ -242,7 +242,6 @@ def test_sequence_erase():
         fx = _seq([[1, 2, 3, 2], [5, 5, 9, 0]], [4, 3])
         res, = exe.run(main, feed={'x': fx}, fetch_list=[out],
                        return_numpy=False)
-    from paddle_tpu.fluid.lod_tensor import LoDTensor
     lt = res[0] if isinstance(res, (list, tuple)) else res
     assert lt.recursive_sequence_lengths() == [[2, 1]]
     np.testing.assert_array_equal(
@@ -432,3 +431,36 @@ def test_fake_quantize_roundtrip():
                    attrs={'num_bits': 8})
     # quantize->dequantize reproduces x within one quantization step
     assert np.abs(deq - x).max() <= s / 127 * 0.5 + 1e-6
+
+
+def test_mine_hard_examples():
+    # image 0: 1 pos (prior 0), ratio 2 -> up to 2 negs from candidates
+    cls = np.array([[0.1, 0.9, 0.5, 0.8, 0.2]], 'float32')
+    match = np.array([[3, -1, -1, -1, -1]], 'int32')
+    dist = np.array([[0.9, 0.1, 0.2, 0.1, 0.6]], 'float32')
+    with fresh_program() as (main, startup):
+        c = fluid.layers.data(name='c', shape=[5], dtype='float32')
+        m = fluid.layers.data(name='m', shape=[5], dtype='int32')
+        d = fluid.layers.data(name='d', shape=[5], dtype='float32')
+        helper = LayerHelper('mine_hard_examples')
+        neg = helper.create_variable_for_type_inference('int32')
+        neg.lod_level = 1
+        upd = helper.create_variable_for_type_inference('int32')
+        helper.append_op(type='mine_hard_examples',
+                         inputs={'ClsLoss': [c], 'MatchIndices': [m],
+                                 'MatchDist': [d]},
+                         outputs={'NegIndices': [neg],
+                                  'UpdatedMatchIndices': [upd]},
+                         attrs={'mining_type': 'max_negative',
+                                'neg_pos_ratio': 2.0,
+                                'neg_dist_threshold': 0.5})
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        res, u = exe.run(main, feed={'c': cls, 'm': match, 'd': dist},
+                         fetch_list=[neg, upd], return_numpy=False)
+    # candidates: priors 1,2,3 (dist<0.5, unmatched); prior 4 excluded
+    # (dist 0.6); top-2 by loss among candidates: priors 1 (0.9), 3 (0.8)
+    assert res.recursive_sequence_lengths() == [[2]]
+    np.testing.assert_array_equal(
+        np.asarray(res.data).reshape(-1)[:2], [1, 3])
+    np.testing.assert_array_equal(np.asarray(u), match)
