@@ -10,6 +10,7 @@ __activations__ = [
 ]
 
 __all__ = __activations__ + [
+    'sign', 'cumsum',
     'mean', 'mul', 'scale', 'sigmoid_cross_entropy_with_logits',
     'elementwise_add', 'elementwise_div', 'elementwise_sub',
     'elementwise_mul', 'elementwise_max', 'elementwise_min',
@@ -204,3 +205,16 @@ def shape(input, name=None):
 
 def maxout(x, groups, name=None):
     return _single_in_op('maxout', x, attrs={'groups': groups}, name=name)
+
+
+def sign(x, name=None):
+    """Elementwise sign (reference operators/sign_op.cc; no v0.14 python
+    layer existed — exposed here alongside the generated activations)."""
+    return _single_in_op('sign', x, name=name)
+
+
+def cumsum(x, axis=-1, exclusive=False, reverse=False, name=None):
+    """Cumulative sum along axis (reference operators/cum_op.h)."""
+    return _single_in_op('cumsum', x,
+                         attrs={'axis': axis, 'exclusive': exclusive,
+                                'reverse': reverse}, name=name)

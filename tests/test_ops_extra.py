@@ -464,3 +464,18 @@ def test_mine_hard_examples():
     np.testing.assert_array_equal(
         np.asarray(res.data).reshape(-1)[:2], [1, 3])
     np.testing.assert_array_equal(np.asarray(u), match)
+
+
+def test_sign_cumsum_named_layers():
+    """fluid.layers.sign / fluid.layers.cumsum named wrappers."""
+    with fresh_program() as (main, startup):
+        x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+        s = fluid.layers.sign(x)
+        c = fluid.layers.cumsum(x, axis=1, reverse=True)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        o1, o2 = exe.run(main,
+                         feed={'x': np.array([[-2., 0., 5.]], 'float32')},
+                         fetch_list=[s, c])
+    np.testing.assert_array_equal(o1, [[-1., 0., 1.]])
+    np.testing.assert_allclose(o2, [[3., 5., 5.]])
