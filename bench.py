@@ -1,13 +1,30 @@
 """Benchmark driver: ResNet-50 images/sec + Transformer-base tokens/sec,
 single chip (the two metrics named in BASELINE.json).
 
-Prints ONE JSON line whose top-level {metric,value,unit,vs_baseline} is the
-ResNet-50 headline (continuity with round 1) and whose "metrics" list
-carries both benchmarks.
+Harness-survivability contract (round-3 rework):
+  - Each metric's JSON line is printed + flushed THE MOMENT it is measured,
+    so a driver timeout still leaves parseable output; the final line is the
+    headline (ResNet-50, continuity with round 1) carrying the full
+    "metrics" list.
+  - A wall-clock budget (BENCH_BUDGET_S, default 1500s) is checked between
+    phases; an unreached phase emits an explicit {"skipped": true} marker
+    instead of dying silently.
+  - The persistent XLA compilation cache (.jax_cache/) makes re-runs skip
+    the multi-minute batch-1024 ResNet compile.
+  - The accelerator is probed in a SUBPROCESS with a timeout first: the
+    axon tunnel can hang indefinitely at backend init, which is exactly the
+    rc=124-with-no-output failure of round 2. A dead tunnel now falls back
+    to CPU with tiny shapes and an honest "platform": "cpu" label.
+
+Each metric line also carries achieved TFLOP/s and MFU (fraction of the
+chip's bf16 peak, BENCH_PEAK_TFLOPS, default 197 = v5e), from analytic
+FLOP counts: ~3 x 7.7 GFLOPs/image for ResNet-50 train, 6*N*tokens for the
+Transformer step (N = trainable parameter count).
 
 Baselines:
   - ResNet-50: 300 images/sec — the reference's 2018-era fluid
-    benchmark/README single-accelerator figure (batch 64, CUDA).
+    benchmark/README single-accelerator figure (batch 64, CUDA); timing
+    loop matches reference benchmark/fluid/fluid_benchmark.py:116.
   - Transformer-base: 14500 src+tgt tokens/sec/device — derived from the
     original Transformer paper's training throughput (base model, 8x P100,
     ~100k steps x ~50k tokens in 12h => ~14.5k tokens/s per device), the
@@ -16,12 +33,72 @@ Baselines:
 """
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
 REF_IMAGES_PER_SEC = 300.0    # reference CUDA single-device fluid baseline
 REF_TOKENS_PER_SEC = 14500.0  # 2017/18-era per-device Transformer-base
+RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 7.7e9  # fwd 7.7 GFLOP, train ~ 3x fwd
+PEAK_TFLOPS = float(os.environ.get('BENCH_PEAK_TFLOPS', '197'))  # v5e bf16
+
+_T0 = time.time()
+BUDGET_S = float(os.environ.get('BENCH_BUDGET_S', '1500'))
+
+
+def _budget_left():
+    return BUDGET_S - (time.time() - _T0)
+
+
+def _emit(obj):
+    print(json.dumps(obj))
+    sys.stdout.flush()
+
+
+def _log(msg):
+    sys.stderr.write('[bench %5.0fs] %s\n' % (time.time() - _T0, msg))
+    sys.stderr.flush()
+
+
+def _probe_backend(timeout_s=None):
+    """Ask a SUBPROCESS which platform jax sees. The axon TPU plugin can
+    hang for many minutes at backend init when the tunnel is flaky; probing
+    in-process would wedge the whole bench (round-2 failure mode). Returns
+    the platform string, or None if the probe hung/crashed."""
+    if timeout_s is None:
+        timeout_s = float(os.environ.get('BENCH_PROBE_TIMEOUT_S', '180'))
+    code = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
+    try:
+        r = subprocess.run([sys.executable, '-c', code],
+                           capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        _log('backend probe timed out after %.0fs' % timeout_s)
+        return None
+    if r.returncode != 0:
+        _log('backend probe failed rc=%d: %s'
+             % (r.returncode, r.stderr.strip()[-300:]))
+        return None
+    for tok in r.stdout.split():
+        if tok.startswith('PLATFORM='):
+            return tok[len('PLATFORM='):]
+    return None
+
+
+def _setup_jax(force_cpu):
+    import jax
+    if force_cpu:
+        jax.config.update('jax_platforms', 'cpu')
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             '.jax_cache')
+    try:
+        jax.config.update('jax_compilation_cache_dir', cache_dir)
+        jax.config.update('jax_persistent_cache_min_compile_time_secs', 0.0)
+        jax.config.update('jax_persistent_cache_min_entry_size_bytes', 0)
+    except Exception as e:  # older jax without the knobs: cache is optional
+        _log('compilation cache unavailable: %r' % e)
+    return jax
 
 
 def _fresh():
@@ -31,14 +108,19 @@ def _fresh():
     return framework.Program(), framework.Program()
 
 
+def _param_count(program):
+    from paddle_tpu.fluid import framework
+    return sum(int(np.prod(v.shape)) for v in program.list_vars()
+               if isinstance(v, framework.Parameter))
+
+
 def bench_resnet50(batch_size=1024, warmup=3, iters=12, use_amp=True,
                    data_format=None):
     """ResNet-50 train step, bf16 activations end-to-end (fp32 master
     weights + BN statistics): on the MXU the bf16 path is ~35% faster than
-    fp32 activations with per-op casts (2035 vs 1528 img/s at batch 1024
-    on a v5e-class chip). data_format NHWC (the default on TPU; override
-    with BENCH_LAYOUT) runs the tower channels-last — XLA:TPU's native
-    layout — skipping the compiler's NCHW transposes."""
+    fp32 activations with per-op casts. data_format NHWC (the default on
+    TPU; override with BENCH_LAYOUT) runs the tower channels-last —
+    XLA:TPU's native layout — skipping the compiler's NCHW transposes."""
     import paddle_tpu.fluid as fluid
     from paddle_tpu.fluid import framework, unique_name
     from paddle_tpu.models.resnet import resnet_imagenet
@@ -78,8 +160,10 @@ def bench_resnet50(batch_size=1024, warmup=3, iters=12, use_amp=True,
 
             # warmup with the SAME fetch signature as the timed loop so the
             # compile happens here, not inside the timing
+            _log('resnet50 compile+warmup (batch %d)...' % batch_size)
             for _ in range(warmup):
                 exe.run(main, feed=feed, fetch_list=[avg_cost])
+            _log('resnet50 warm; timing %d iters' % iters)
 
             t0 = time.time()
             for _ in range(iters):
@@ -93,7 +177,8 @@ def bench_transformer(batch_size=64, seq_len=256, warmup=3, iters=12,
                       use_amp=True, vocab=30000):
     """Transformer-base (6 layers, d_model 512, 8 heads, d_inner 2048)
     train step through the pallas flash-attention path; tokens/sec counts
-    source + target tokens per step (the tensor2tensor-era convention)."""
+    source + target tokens per step (the tensor2tensor-era convention).
+    Returns (tokens_per_sec, trainable_param_count)."""
     import paddle_tpu.fluid as fluid
     from paddle_tpu.fluid import framework, unique_name
     from paddle_tpu.models import transformer as T
@@ -108,6 +193,7 @@ def bench_transformer(batch_size=64, seq_len=256, warmup=3, iters=12,
                                  epsilon=1e-9).minimize(avg_cost)
             if use_amp:
                 fluid.amp.decorate_program(main)
+            n_params = _param_count(main)
 
             exe = fluid.Executor()
             exe.run(startup)
@@ -118,56 +204,123 @@ def bench_transformer(batch_size=64, seq_len=256, warmup=3, iters=12,
                 ids = rng.randint(1, vocab, size=(batch_size, seq_len))
                 feed[name] = exe._to_device(ids.astype('int64'))
 
+            _log('transformer compile+warmup (batch %d seq %d)...'
+                 % (batch_size, seq_len))
             for _ in range(warmup):
                 exe.run(main, feed=feed, fetch_list=[avg_cost])
+            _log('transformer warm; timing %d iters' % iters)
 
             t0 = time.time()
             for _ in range(iters):
                 loss, = exe.run(main, feed=feed, fetch_list=[avg_cost])
             dt = time.time() - t0
             assert np.isfinite(float(loss)), float(loss)
-            return batch_size * 2 * seq_len * iters / dt  # src + tgt tokens
+            tps = batch_size * 2 * seq_len * iters / dt  # src + tgt tokens
+            return tps, n_params
 
 
 def _try(fn, *scaled_attempts):
-    """Run fn(**kwargs) trying each attempt dict in order (HBM fallbacks)."""
+    """Run fn(**kwargs) trying each attempt dict in order (HBM fallbacks).
+    Every swallowed exception is logged — round 2's _try hid the first
+    failure and silently burned budget on a second full compile."""
     last = None
-    for kw in scaled_attempts:
+    for i, kw in enumerate(scaled_attempts):
+        if last is not None and _budget_left() < 120:
+            _log('budget exhausted; not retrying with %r' % (kw,))
+            break
         try:
             return fn(**kw)
         except Exception as e:
+            _log('attempt %d %r failed: %r' % (i, kw, e))
             last = e
     raise last
 
 
+def _mfu(flops_per_sec):
+    return round(flops_per_sec / (PEAK_TFLOPS * 1e12), 4)
+
+
 def main():
+    platform = _probe_backend()
+    force_cpu = False
+    if platform is None:
+        _log('accelerator unreachable — falling back to CPU, tiny shapes')
+        force_cpu = True
+        platform = 'cpu'
+    _setup_jax(force_cpu)
+    on_cpu = platform == 'cpu'
+
     use_amp = os.environ.get('BENCH_AMP', '1') == '1'
-    iters = int(os.environ.get('BENCH_ITERS', '12'))
-    rbatch = int(os.environ.get('BENCH_BATCH', '1024'))
-    tbatch = int(os.environ.get('BENCH_TBATCH', '64'))
-    seq = int(os.environ.get('BENCH_SEQ', '256'))
+    iters = int(os.environ.get('BENCH_ITERS', '2' if on_cpu else '12'))
+    rbatch = int(os.environ.get('BENCH_BATCH', '16' if on_cpu else '1024'))
+    tbatch = int(os.environ.get('BENCH_TBATCH', '4' if on_cpu else '64'))
+    seq = int(os.environ.get('BENCH_SEQ', '64' if on_cpu else '256'))
+    _log('platform=%s amp=%s budget=%.0fs' % (platform, use_amp, BUDGET_S))
 
-    ips = _try(bench_resnet50,
-               dict(batch_size=rbatch, iters=iters, use_amp=use_amp),
-               dict(batch_size=max(8, rbatch // 4), iters=iters,
-                    use_amp=use_amp))
-    tps = _try(bench_transformer,
-               dict(batch_size=tbatch, seq_len=seq, iters=iters,
-                    use_amp=use_amp),
-               dict(batch_size=max(4, tbatch // 4), seq_len=seq, iters=iters,
-                    use_amp=use_amp))
+    metrics = []
 
-    metrics = [
-        {"metric": "resnet50_train_images_per_sec_per_chip",
-         "value": round(ips, 2), "unit": "images/sec/chip",
-         "vs_baseline": round(ips / REF_IMAGES_PER_SEC, 3)},
-        {"metric": "transformer_base_train_tokens_per_sec_per_chip",
-         "value": round(tps, 2), "unit": "tokens/sec/chip",
-         "vs_baseline": round(tps / REF_TOKENS_PER_SEC, 3)},
-    ]
-    out = dict(metrics[0])
-    out["metrics"] = metrics
-    print(json.dumps(out))
+    rname = 'resnet50_train_images_per_sec_per_chip'
+    if _budget_left() < 120:
+        _emit({'metric': rname, 'skipped': True,
+               'reason': 'wall-clock budget exhausted before phase start'})
+    else:
+        try:
+            ips = _try(bench_resnet50,
+                       dict(batch_size=rbatch, iters=iters, use_amp=use_amp),
+                       dict(batch_size=max(8, rbatch // 4), iters=iters,
+                            use_amp=use_amp))
+            flops = ips * RESNET50_TRAIN_FLOPS_PER_IMG
+            m = {'metric': rname, 'value': round(ips, 2),
+                 'unit': 'images/sec/chip',
+                 'vs_baseline': round(ips / REF_IMAGES_PER_SEC, 3),
+                 'tflops': round(flops / 1e12, 2), 'mfu': _mfu(flops),
+                 'platform': platform, 'batch': rbatch, 'amp': use_amp}
+            metrics.append(m)
+            _emit(m)
+        except Exception as e:
+            _log('resnet50 bench failed: %r' % e)
+            _emit({'metric': rname, 'skipped': True, 'error': str(e)[:300]})
+
+    tname = 'transformer_base_train_tokens_per_sec_per_chip'
+    if _budget_left() < 120:
+        _emit({'metric': tname, 'skipped': True,
+               'reason': 'wall-clock budget exhausted before phase start'})
+    else:
+        try:
+            tps, n_params = _try(
+                bench_transformer,
+                dict(batch_size=tbatch, seq_len=seq, iters=iters,
+                     use_amp=use_amp),
+                dict(batch_size=max(4, tbatch // 4), seq_len=seq,
+                     iters=iters, use_amp=use_amp))
+            flops = 6.0 * n_params * tps
+            m = {'metric': tname, 'value': round(tps, 2),
+                 'unit': 'tokens/sec/chip',
+                 'vs_baseline': round(tps / REF_TOKENS_PER_SEC, 3),
+                 'tflops': round(flops / 1e12, 2), 'mfu': _mfu(flops),
+                 'params': int(n_params),
+                 'platform': platform, 'batch': tbatch, 'seq_len': seq,
+                 'amp': use_amp}
+            metrics.append(m)
+            _emit(m)
+        except Exception as e:
+            _log('transformer bench failed: %r' % e)
+            _emit({'metric': tname, 'skipped': True, 'error': str(e)[:300]})
+
+    # headline LAST so a line-by-line parser and a last-line parser agree;
+    # it is ALWAYS the ResNet-50 series (round-1 continuity) — when that
+    # phase failed, the headline says so explicitly rather than silently
+    # switching series to whatever did complete
+    resnet = [m for m in metrics if m['metric'] == rname]
+    if resnet:
+        out = dict(resnet[0])
+    else:
+        out = {'metric': rname, 'value': None, 'unit': 'images/sec/chip',
+               'vs_baseline': None,
+               'error': 'resnet phase did not complete (accelerator '
+                        'unreachable, OOM, or budget exhausted)'}
+    out['metrics'] = metrics
+    _emit(out)
 
 
 if __name__ == '__main__':

@@ -359,6 +359,22 @@ class Executor(object):
         dist = getattr(program, '_dist_config', None)
         if dist is None:
             return None
+        if not dist.get('sync_mode', True) and not getattr(
+                program, '_async_warned', False):
+            # reference distribute_transpiler.py:185-206 async pserver
+            # updates; inside one GSPMD module replicas are bit-identical
+            # and the gradient all-reduce is part of the compiled step, so
+            # the Program path stays synchronous. The supported async
+            # analogue is local SGD (parallel/local_sgd.py).
+            import warnings
+            warnings.warn(
+                "DistributeTranspiler sync_mode=False: the TPU Program path "
+                "runs SYNCHRONOUS data-parallel (GSPMD all-reduce each "
+                "step). For async-style training use "
+                "paddle_tpu.parallel.LocalSGD (periodic parameter "
+                "averaging, docs/distributed.md).", UserWarning,
+                stacklevel=3)
+            program._async_warned = True
         from .. import parallel
         dp = min(int(dist.get('dp_size') or 1), len(jax.devices()))
         if dp <= 1:

@@ -36,8 +36,11 @@ ROLE_LRSCHED = 16
 ROLE_METRIC = 32
 
 # A distinctive stand-in for the dynamic batch dim (-1) during build-time
-# abstract evaluation; mapped back to -1 in inferred output shapes.
-DYN_DIM = 1997
+# abstract evaluation; mapped back to -1 in inferred output shapes. A large
+# prime so (a) multiples of it can only have come from the stand-in itself
+# and (b) no plausible user tensor dim collides with it; Variable.__init__
+# rejects the collision outright rather than silently mapping the dim to -1.
+DYN_DIM = 999983
 
 
 def grad_var_name(name):
@@ -68,6 +71,10 @@ class Variable(object):
             name = unique_name.generate('_generated_var')
         self.name = name
         self.shape = tuple(int(d) for d in shape) if shape is not None else None
+        if self.shape is not None and DYN_DIM in self.shape:
+            raise ValueError(
+                "dim %d collides with the build-time dynamic-batch sentinel "
+                "(framework.DYN_DIM); use a different size" % DYN_DIM)
         self.dtype = core.convert_dtype(dtype)
         self.lod_level = lod_level
         self.persistable = persistable

@@ -10,7 +10,7 @@ __activations__ = [
 ]
 
 __all__ = __activations__ + [
-    'sign', 'cumsum',
+    'sign', 'cumsum', 'uniform_random', 'hard_shrink', 'thresholded_relu',
     'mean', 'mul', 'scale', 'sigmoid_cross_entropy_with_logits',
     'elementwise_add', 'elementwise_div', 'elementwise_sub',
     'elementwise_mul', 'elementwise_max', 'elementwise_min',
@@ -205,6 +205,34 @@ def shape(input, name=None):
 
 def maxout(x, groups, name=None):
     return _single_in_op('maxout', x, attrs={'groups': groups}, name=name)
+
+
+def uniform_random(shape, dtype='float32', min=-1.0, max=1.0, seed=0):
+    """Uniform-random tensor of a static shape (reference layers/ops.py:77,
+    operators/uniform_random_op.cc). Lowered to jax.random.uniform keyed on
+    the step's threaded PRNG — `seed` is accepted for API parity; the
+    executor's key stream already gives run-to-run determinism."""
+    helper = LayerHelper('uniform_random', **locals())
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type='uniform_random', outputs={'Out': [out]},
+                     attrs={'shape': list(shape), 'dtype': dtype,
+                            'min': min, 'max': max, 'seed': seed})
+    return out
+
+
+def hard_shrink(x, threshold=None):
+    """Hard-shrink: x where |x| > threshold else 0 (reference
+    layers/ops.py:97, operators/activation_op.cc HardShrink, default 0.5)."""
+    attrs = {} if threshold is None else {'threshold': float(threshold)}
+    return _single_in_op('hard_shrink', x, attrs=attrs)
+
+
+def thresholded_relu(x, threshold=None):
+    """Thresholded ReLU: x where x > threshold else 0 (reference
+    layers/ops.py:140, operators/activation_op.cc ThresholdedRelu,
+    default 1.0)."""
+    attrs = {} if threshold is None else {'threshold': float(threshold)}
+    return _single_in_op('thresholded_relu', x, attrs=attrs)
 
 
 def sign(x, name=None):

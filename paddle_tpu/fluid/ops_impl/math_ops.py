@@ -56,6 +56,10 @@ _unary('pow', lambda x, a: jnp.power(x, a.get('factor', 1.0)))
 _unary('stanh', lambda x, a: a.get('scale_b', 1.7159) * jnp.tanh(a.get('scale_a', 2.0 / 3.0) * x))
 _unary('hard_sigmoid', lambda x, a: jnp.clip(a.get('slope', 0.2) * x + a.get('offset', 0.5), 0.0, 1.0))
 _unary('swish', lambda x, a: x * jax.nn.sigmoid(a.get('beta', 1.0) * x))
+_unary('hard_shrink', lambda x, a: jnp.where(
+    jnp.abs(x) > a.get('threshold', 0.5), x, 0.0))
+_unary('thresholded_relu', lambda x, a: jnp.where(
+    x > a.get('threshold', 1.0), x, 0.0))
 _unary('relu', lambda x, a: jnp.maximum(x, 0))
 _unary('log', lambda x, a: jnp.log(x))
 _unary('logical_not', lambda x, a: jnp.logical_not(x))

@@ -23,13 +23,14 @@ __all__ = ['auto_tp_rules', 'fsdp_shard_params',
            'ring_attention', 'ring_self_attention',
            'ulysses_attention', 'ulysses_self_attention',
            'pipeline_apply', 'stack_stage_params',
-           'moe_apply', 'stack_expert_params']
+           'moe_apply', 'stack_expert_params', 'LocalSGD']
 
 from .ring_attention import ring_attention, ring_self_attention  # noqa: E402
 from .ulysses import ulysses_attention, ulysses_self_attention  # noqa: E402
 from .tp import auto_tp_rules  # noqa: E402
 from .pipeline import pipeline_apply, stack_stage_params  # noqa: E402
 from .moe import moe_apply, stack_expert_params  # noqa: E402
+from .local_sgd import LocalSGD  # noqa: E402
 
 
 def init_multihost(coordinator_address=None, num_processes=None,

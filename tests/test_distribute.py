@@ -223,3 +223,86 @@ def test_shard_parameters_implies_sharded_optimizer_state():
             v = global_scope().vars[n]
             assert isinstance(v.sharding, NamedSharding) and \
                 'dp' in str(v.sharding.spec), (n, v.sharding)
+
+
+# ---------------------------------------------------------------------------
+# async story: sync_mode=False (reference distribute_transpiler.py:185-206)
+
+
+def test_sync_mode_false_warns_program_path_stays_synchronous():
+    xs, ys = _data()
+    with fresh_program() as (main, startup):
+        cost = _build()
+        t = fluid.DistributeTranspiler()
+        t.transpile(trainer_id=0, trainers=8, sync_mode=False)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        with pytest.warns(UserWarning, match='LocalSGD'):
+            exe.run(main, feed={'x': xs, 'y': ys}, fetch_list=[cost])
+        # warn once, not per step
+        import warnings as w
+        with w.catch_warnings():
+            w.simplefilter('error')
+            exe.run(main, feed={'x': xs, 'y': ys}, fetch_list=[cost])
+
+
+def test_local_sgd_matches_numpy_simulation():
+    """parallel.LocalSGD: replicas diverge over local steps, one pmean
+    mixes them — checked leaf-for-leaf against a numpy re-implementation."""
+    n, bl, d, lr = 4, 4, 6, 0.1
+    mesh = parallel.make_mesh({'dp': n})
+    rng = np.random.RandomState(0)
+    w0 = rng.rand(d).astype('float32')
+    xs = rng.rand(3, n * bl, d).astype('float32')   # 3 steps of global batch
+    ys = rng.rand(3, n * bl).astype('float32')
+
+    def step_fn(params, batch):
+        x, y = batch
+
+        def loss(w):
+            import jax.numpy as jnp
+            return jnp.mean((x @ w - y) ** 2)
+
+        g = jax.grad(loss)(params['w'])
+        return {'w': params['w'] - lr * g}, loss(params['w'])
+
+    ls = parallel.LocalSGD(step_fn, mesh, axis='dp', sync_steps=3)
+    params = ls.replicate({'w': w0})
+    for i in range(3):
+        batch = ls.shard_batch((xs[i], ys[i]))
+        params, aux = ls.step(params, batch)
+        assert np.asarray(aux).shape == (n,)   # one local loss per replica
+    # replicas have genuinely diverged before the sync
+    pre = np.asarray(params['w'])
+    assert pre.shape == (n, d)
+    assert np.abs(pre - pre[0]).max() > 1e-6
+    params = ls.sync(params)
+    got = np.asarray(params['w'])[0]
+
+    # numpy replica-by-replica simulation
+    sim = np.tile(w0, (n, 1))
+    for i in range(3):
+        for r in range(n):
+            x = xs[i, r * bl:(r + 1) * bl]
+            y = ys[i, r * bl:(r + 1) * bl]
+            g = 2.0 / bl * x.T @ (x @ sim[r] - y)
+            sim[r] = sim[r] - lr * g
+    np.testing.assert_allclose(got, sim.mean(axis=0), rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(params['w'])[1], sim.mean(axis=0),
+                               rtol=2e-5)
+
+    # sync_steps=1 (sync every step) == synchronous dp == full-batch SGD
+    ls1 = parallel.LocalSGD(step_fn, mesh, axis='dp', sync_steps=1)
+    p1 = ls1.replicate({'w': w0})
+    for i in range(3):
+        p1, _ = ls1.step(p1, ls1.shard_batch((xs[i], ys[i])))
+        p1 = ls1.sync(p1)
+    ref = w0.copy()
+    for i in range(3):
+        per = []
+        for r in range(n):
+            x = xs[i, r * bl:(r + 1) * bl]
+            y = ys[i, r * bl:(r + 1) * bl]
+            per.append(2.0 / bl * x.T @ (x @ ref - y))
+        ref = ref - lr * np.mean(per, axis=0)
+    np.testing.assert_allclose(np.asarray(p1['w'])[0], ref, rtol=2e-5)

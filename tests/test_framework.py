@@ -163,3 +163,14 @@ def test_get_var_and_block_lookup():
         assert blk.var('x') is not None
         with pytest.raises((KeyError, ValueError)):
             blk.var('nonexistent_var')
+
+
+def test_dyn_dim_sentinel_collision_rejected():
+    """A user dim equal to the dynamic-batch sentinel is rejected at build
+    time instead of being silently mapped back to -1 by shape inference."""
+    from paddle_tpu.fluid.framework import DYN_DIM
+    with fresh_program() as (main, startup):
+        with pytest.raises(ValueError, match='sentinel'):
+            layers.data(name='clash', shape=[DYN_DIM], dtype='float32')
+        # neighbours are fine
+        layers.data(name='ok', shape=[DYN_DIM - 1], dtype='float32')

@@ -322,3 +322,25 @@ def test_preprocessor_uses_scope_params_and_cleans_on_error(tmp_path):
                 y2 = x2 * 2.0
                 raise NameError('user bug')
         assert len(main.global_block().ops) == n_ops
+
+
+def test_uniform_random_hard_shrink_thresholded_relu():
+    """The three layers/ops.py stragglers (reference layers/ops.py:77,97,140)
+    match numpy semantics."""
+    src = np.array([[-2.0, -0.6, -0.3, 0.0, 0.4, 0.8, 1.5]], 'float32')
+
+    def build():
+        u = layers.uniform_random(shape=[4, 6], min=2.0, max=3.0)
+        x = fluid.layers.data(name='hx', shape=[7], dtype='float32')
+        hs = layers.hard_shrink(x, threshold=0.5)
+        hs_d = layers.hard_shrink(x)             # default threshold 0.5
+        tr = layers.thresholded_relu(x, threshold=0.4)
+        tr_d = layers.thresholded_relu(x)        # default threshold 1.0
+        return u, hs, hs_d, tr, tr_d
+
+    u, hs, hs_d, tr, tr_d = _run(build, {'hx': src})
+    assert u.shape == (4, 6) and (u >= 2.0).all() and (u < 3.0).all()
+    np.testing.assert_allclose(hs, np.where(np.abs(src) > 0.5, src, 0.0))
+    np.testing.assert_allclose(hs_d, hs)
+    np.testing.assert_allclose(tr, np.where(src > 0.4, src, 0.0))
+    np.testing.assert_allclose(tr_d, np.where(src > 1.0, src, 0.0))
