@@ -6,7 +6,7 @@ from . import core
 from . import framework
 from .framework import Program, Operator, Parameter, Variable, \
     default_startup_program, default_main_program, program_guard, \
-    name_scope, get_var
+    name_scope, device_guard, get_var
 from . import executor
 from .executor import Executor, global_scope, scope_guard, _switch_scope, Scope
 from . import layers
@@ -43,7 +43,7 @@ from .core import CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace
 from .parallel_executor import ParallelExecutor, ExecutionStrategy, BuildStrategy
 from . import transpiler
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig, \
-    InferenceTranspiler, memory_optimize, release_memory
+    InferenceTranspiler, PipelineTranspiler, memory_optimize, release_memory
 from . import trainer
 from .trainer import Trainer, BeginEpochEvent, EndEpochEvent, \
     BeginStepEvent, EndStepEvent, CheckpointConfig

@@ -146,6 +146,26 @@ class _CompiledStep(object):
         self.amp = amp
         self.platform = platform
         self.mesh = mesh
+        # GPipe region from PipelineTranspiler: only active when a mesh
+        # with the pp axis exists; otherwise the stamped ops run
+        # sequentially (identical semantics, which tests compare against)
+        pipe = getattr(program, '_pipeline_config', None)
+        self.pipe = (pipe if pipe is not None and mesh is not None
+                     and pipe['axis'] in getattr(mesh, 'shape', {})
+                     else None)
+        if self.pipe is not None:
+            lo_r, hi_r = self.pipe['region']
+            internal = set()
+            for op in block.ops[lo_r:hi_r]:
+                internal.update(op.output_arg_names)
+            internal.discard(self.pipe['output_var'])
+            bad = internal & set(fetch_names)
+            if bad:
+                raise ValueError(
+                    'cannot fetch %r: produced inside the pipeline region, '
+                    'which runs as one GPipe call — fetch the stage output '
+                    '%r or run the program untranspiled'
+                    % (sorted(bad), self.pipe['output_var']))
         self.use_remat = bool(getattr(program, '_use_remat', False))
         # name -> NamedSharding: enforced on the step's outputs so
         # mesh-placed state (ZeRO accumulators, tp weights) STAYS sharded
@@ -235,7 +255,13 @@ class _CompiledStep(object):
     def _run_ops(self, env, lo, hi, key, grad_mode=False, on_op=None):
         """Execute ops [lo, hi); on_op(i, op, seconds, env) — when set, each
         op is synchronized and timed (debug/profiling path, eager only)."""
+        pipe = self.pipe
         for i in range(lo, hi):
+            if pipe is not None and on_op is None \
+                    and pipe['region'][0] <= i < pipe['region'][1]:
+                if i == pipe['region'][0]:
+                    self._run_pipeline_region(env, key, grad_mode=grad_mode)
+                continue  # region ops execute inside pipeline_apply
             op = self.ops[i]
             if op.type == 'autodiff':
                 continue
@@ -259,6 +285,70 @@ class _CompiledStep(object):
                         if v.stop_gradient and v.name in env and env[v.name] is not None:
                             env[v.name] = jax.tree_util.tree_map(
                                 jax.lax.stop_gradient, env[v.name])
+
+    def _run_pipeline_region(self, env, key, grad_mode=False):
+        """Execute the PipelineTranspiler region as ONE GPipe call.
+
+        Per-stage parameters are stacked [S, ...] on the fly (grad of
+        stack = unstack, so jax.grad routes each stage's gradient back to
+        its own parameter, and the program's optimizer ops update them
+        unchanged); pipeline_apply shards the stack over the pp mesh axis
+        and streams n_micro microbatches around the ppermute ring. NOTE:
+        the stage RNG key is shared across stages/microbatches, so
+        in-stage dropout masks are correlated — acceptable for GPipe
+        (dropout is per-activation); tests compare with dropout off.
+        """
+        cfg = self.pipe
+        from .. import parallel
+        S, M = cfg['n_stages'], cfg['n_micro']
+        x = env[cfg['input_var']]
+        if x.shape[0] % M:
+            raise ValueError(
+                'pipeline n_micro=%d does not divide batch size %d'
+                % (M, x.shape[0]))
+        extras = tuple(env[n] for n in cfg['extra_names'])
+        mb = x.shape[0] // M
+        streamed = []
+        for n in cfg['extra_stream_names']:
+            e = env[n]
+            if e.shape[0] != x.shape[0]:
+                raise ValueError(
+                    'batch-aligned pipeline extra %r has leading dim %d, '
+                    'expected the batch size %d' % (n, e.shape[0],
+                                                    x.shape[0]))
+            streamed.append(e.reshape((M, mb) + e.shape[1:]))
+        stacked = {
+            n0: jnp.stack([env[cfg['param_names'][k][j]] for k in range(S)])
+            for j, n0 in enumerate(cfg['param_names'][0])}
+        mbs = x.reshape((M, mb) + x.shape[1:])
+        lo0, hi0 = cfg['stage0']
+        stage_ops = self.ops[lo0:hi0]
+        extra_names = cfg['extra_stream_names'] + cfg['extra_names']
+        input_name, boundary0 = cfg['input_var'], cfg['boundary0']
+
+        def stage_fn(p, xx, *ex):
+            sub = dict(zip(extra_names, ex))
+            sub.update(p)
+            sub[input_name] = xx
+            for t, op in enumerate(stage_ops):
+                lowering.run_op(op, sub, Ctx(key, lo0 + t, amp=self.amp,
+                                             platform=self.platform,
+                                             mesh=self.mesh))
+                if grad_mode:
+                    # same stop_gradient contract as the sequential path
+                    # (_run_ops): frozen vars stay frozen when pipelined
+                    for vs in op.outputs.values():
+                        for v in vs:
+                            if (v.stop_gradient and v.name in sub
+                                    and sub[v.name] is not None):
+                                sub[v.name] = jax.tree_util.tree_map(
+                                    jax.lax.stop_gradient, sub[v.name])
+            return sub[boundary0]
+
+        out = parallel.pipeline_apply(stage_fn, stacked, mbs, self.mesh,
+                                      axis=cfg['axis'], extras=extras,
+                                      extras_streamed=tuple(streamed))
+        env[cfg['output_var']] = out.reshape((-1,) + out.shape[2:])
 
     def debug_step(self, persist, feed, key, check_nan_inf=False, on_op=None):
         """Eager op-by-op execution: per-op NaN/Inf checks (reference C++
@@ -355,6 +445,8 @@ class Executor(object):
         if mesh is not None:
             # Already built from _dist_config, or placed directly by
             # ParallelExecutor. False sentinel -> single device, no-op.
+            if mesh:
+                self._replace_strays(program, scope, mesh)
             return mesh or None
         dist = getattr(program, '_dist_config', None)
         if dist is None:
@@ -376,11 +468,23 @@ class Executor(object):
                 stacklevel=3)
             program._async_warned = True
         from .. import parallel
-        dp = min(int(dist.get('dp_size') or 1), len(jax.devices()))
-        if dp <= 1:
+        n_dev = len(jax.devices())
+        pp = int(dist.get('pp_size') or 1)
+        pp_axis = dist.get('pp_axis', 'pp')
+        if pp > n_dev:
+            raise RuntimeError(
+                'pipeline has %d stages but only %d devices are visible'
+                % (pp, n_dev))
+        dp = min(int(dist.get('dp_size') or 1), max(1, n_dev // pp))
+        axes = {}
+        if dp > 1:
+            axes['dp'] = dp
+        if pp > 1:
+            axes[pp_axis] = pp
+        if not axes:
             program._dist_mesh = False
             return None
-        mesh = parallel.make_mesh({'dp': dp})
+        mesh = parallel.make_mesh(axes)
         program._dist_mesh = mesh
         acc_names = {v.name for v in program.list_vars()
                      if getattr(v, '_is_optimizer_accumulator', False)}
@@ -407,12 +511,32 @@ class Executor(object):
                 scope.vars[name] = parallel.replicate(mesh, v)
         return mesh
 
+    def _replace_strays(self, program, scope, mesh):
+        """Re-assert mesh placement of persistables that were overwritten
+        with single-device arrays since the first placement pass (io.load /
+        load_inference_model / user writes into the scope) — mixing them
+        with mesh-replicated feeds would fail jit's device check."""
+        if len(mesh.devices.flat) <= 1:
+            return
+        from .. import parallel
+        for v in program.list_vars():
+            if not v.persistable:
+                continue
+            val = scope.vars.get(v.name)
+            if (isinstance(val, jax.Array)
+                    and len(val.sharding.device_set) == 1):
+                scope.vars[v.name] = parallel.replicate(mesh, val)
+
     def _dist_shard_feed(self, name, dv, mesh):
         from .. import parallel
         if isinstance(dv, SeqValue):
             return SeqValue(self._dist_shard_feed(name, dv.data, mesh),
                             self._dist_shard_feed(name, dv.lengths, mesh),
                             dv.outer_lengths)
+        if 'dp' not in mesh.shape:
+            # pp-only mesh: feeds replicate; microbatching happens inside
+            # the pipelined step
+            return parallel.replicate(mesh, dv)
         dp = mesh.shape['dp']
         if dv.ndim == 0:
             return parallel.replicate(mesh, dv)

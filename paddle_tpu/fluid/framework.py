@@ -23,7 +23,7 @@ from . import unique_name
 __all__ = [
     'Program', 'Operator', 'Parameter', 'Variable', 'Block',
     'default_startup_program', 'default_main_program', 'program_guard',
-    'name_scope', 'get_var', 'grad_var_name',
+    'name_scope', 'device_guard', 'get_var', 'grad_var_name',
 ]
 
 GRAD_VAR_SUFFIX = "@GRAD"
@@ -152,6 +152,8 @@ class Operator(object):
         self.outputs = {}
         self.attrs = dict(attrs or {})
         self.attrs.setdefault('op_role', ROLE_FORWARD)
+        if _device_guard_stack and _device_guard_stack[-1] is not None:
+            self.attrs.setdefault('op_device', _device_guard_stack[-1])
         if inputs:
             for slot, vs in inputs.items():
                 if vs is None:
@@ -354,6 +356,10 @@ class Program(object):
         for flag in ('_amp', '_fetch_f32', '_use_remat'):
             if hasattr(self, flag):
                 setattr(p, flag, getattr(self, flag))
+        if getattr(self, '_dist_config', None) is not None:
+            # mesh annotations travel with the program (the scope's arrays
+            # are already mesh-placed; a meshless clone would mix devices)
+            p._dist_config = dict(self._dist_config)
         p.blocks = []
         var_maps = []
         for blk in self.blocks:
@@ -401,8 +407,25 @@ class Program(object):
                 nb.append_op(type=op.type, inputs=ins, outputs=outs, attrs=attrs,
                              infer_shape=False)
         p.current_block_idx = 0
+        self._retranspile_pipeline(p)
         p._bump_version()
         return p
+
+    def _retranspile_pipeline(self, p):
+        """Re-derive `_pipeline_config` on a clone/prune result: op indices
+        shift when ops are dropped, so the config is re-computed from the
+        (copied) device_guard stamps. If the surgery broke the stage
+        structure, the stamps stay inert and the region runs sequentially
+        (same semantics) on the mesh the _dist_config still describes."""
+        cfg = getattr(self, '_pipeline_config', None)
+        if cfg is None:
+            return
+        from .transpiler.pipeline_transpiler import PipelineTranspiler
+        try:
+            PipelineTranspiler(n_micro=cfg['n_micro'],
+                               axis=cfg['axis']).transpile(p)
+        except ValueError:
+            p._pipeline_config = None
 
     def inference_optimize(self):
         return self.clone(for_test=True)
@@ -424,6 +447,8 @@ class Program(object):
                 needed |= set(op.input_arg_names)
         keep.reverse()
         blk.ops = keep
+        p._pipeline_config = None
+        self._retranspile_pipeline(p)
         p._bump_version()
         return p
 
@@ -525,6 +550,24 @@ def name_scope(prefix=None):
         yield
     finally:
         _name_scope_stack.pop()
+
+
+_device_guard_stack = []
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Op placement annotation (later-Paddle `fluid.device_guard`; the
+    closest v0.14 notion is per-op Place dispatch). On TPU, XLA owns chip
+    placement, so the only consumed form is 'pipe:K': ops appended inside
+    are stamped with pipeline stage K, which PipelineTranspiler turns into
+    a GPipe schedule over the `pp` mesh axis (parallel/pipeline.py). Other
+    device strings are recorded on the op but ignored."""
+    _device_guard_stack.append(device)
+    try:
+        yield
+    finally:
+        _device_guard_stack.pop()
 
 
 def get_var(name, program=None):

@@ -54,7 +54,15 @@ class BuildStrategy(object):
 
 
 class ParallelExecutor(object):
-    """reference parallel_executor.py:ParallelExecutor."""
+    """reference parallel_executor.py:ParallelExecutor.
+
+    Single-host surface: the dp mesh spans this process's visible devices.
+    The reference's `num_trainers`/`trainer_id` multi-node path
+    (parallel_executor.py:43-46,74 — one NCCL clique across nodes) is
+    accepted for API compatibility but does not grow the mesh here;
+    multi-host scale-out is `parallel.init_multihost()` (jax.distributed)
+    BEFORE building the executor, after which the same GSPMD program spans
+    every host's devices (tests/test_multihost.py)."""
 
     def __init__(self, use_cuda=None, loss_name=None, main_program=None,
                  share_vars_from=None, exec_strategy=None, build_strategy=None,

@@ -8,8 +8,9 @@ from .distribute_transpiler import (DistributeTranspiler,
                                     DistributeTranspilerConfig)
 from .inference_transpiler import InferenceTranspiler
 from .memory_optimization_transpiler import memory_optimize, release_memory
+from .pipeline_transpiler import PipelineTranspiler
 from .ps_dispatcher import HashName, RoundRobin
 
 __all__ = ['DistributeTranspiler', 'DistributeTranspilerConfig',
-           'InferenceTranspiler', 'memory_optimize',
+           'InferenceTranspiler', 'PipelineTranspiler', 'memory_optimize',
            'release_memory', 'HashName', 'RoundRobin']

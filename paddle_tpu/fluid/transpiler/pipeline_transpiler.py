@@ -1,0 +1,298 @@
+"""Pipeline-parallel transpiler: Fluid Program -> GPipe schedule.
+
+Program-level entry for parallel/pipeline.py. The user wraps each repeated
+stage of the network in `fluid.device_guard('pipe:K')` (K = 0..S-1); ops
+appended inside carry `op_device='pipe:K'`. `PipelineTranspiler.transpile`
+then
+
+1. checks the stamped ops form one contiguous region of S contiguous,
+   structurally IDENTICAL stages (same op-type/attr sequence — the GPipe
+   homogeneity requirement: every device runs the same stage function on
+   its own weights);
+2. aligns the stages op-by-op to classify every stage input as
+     - per-stage parameter (different Parameter per stage, same shape)
+         -> stacked [S, ...] and sharded over the `pp` mesh axis,
+     - shared extra (same var in every stage, produced outside: pad-mask
+         biases, a pipelined decoder's encoder output)
+         -> replicated to all stages,
+     - the flow activation (stage k consumes stage k-1's boundary output)
+         -> the microbatched tensor streamed around the ppermute ring;
+3. annotates the program (`_pipeline_config` + `_dist_config.pp_size`).
+
+The Executor consumes the annotation: the region runs as ONE
+parallel.pipeline_apply call inside the jitted step (scan + ppermute over
+the pp mesh axis), and `jax.grad` differentiates straight through it —
+scan, ppermute and the emit-gather all have transpose rules, so GPipe's
+forward-then-backward microbatch schedule falls out of XLA's scheduling of
+the transposed scan rather than being hand-written (the reference has no
+pipeline engine at all; its closest precedent is program splitting in
+transpiler/distribute_transpiler.py:180-300).
+
+Prologue ops (embedding, masks) and epilogue ops (projection, loss) run
+unpipelined on the full batch, replicated over pp — they are cheap relative
+to the stage stack, the standard GPipe arrangement.
+
+Untranspiled, the same annotated program runs sequentially (the stamps are
+inert attrs) — which is exactly what tests compare against.
+"""
+from ..framework import Parameter, default_main_program
+
+__all__ = ['PipelineTranspiler']
+
+_STAGE_PREFIX = 'pipe:'
+
+
+def _stage_of(op):
+    dev = op.attrs.get('op_device')
+    if isinstance(dev, str) and dev.startswith(_STAGE_PREFIX):
+        return int(dev[len(_STAGE_PREFIX):])
+    return None
+
+
+def _attrs_key(op):
+    return {k: v for k, v in op.attrs.items()
+            if k not in ('op_device', 'op_role')}
+
+
+class PipelineTranspiler(object):
+    """Turn device_guard('pipe:K') stage annotations into a GPipe config.
+
+        t = PipelineTranspiler(n_micro=4)
+        t.transpile(main_program)          # annotates the program
+        exe.run(main_program, ...)         # region runs pipelined
+
+    n_micro must divide the batch size; the pp mesh axis size equals the
+    number of annotated stages.
+    """
+
+    def __init__(self, n_micro=4, axis='pp'):
+        self.n_micro = int(n_micro)
+        self.axis = axis
+
+    def transpile(self, program=None):
+        if program is None:
+            program = default_main_program()
+        block = program.global_block()
+        ops = block.ops
+
+        stamped = [(i, _stage_of(op)) for i, op in enumerate(ops)
+                   if _stage_of(op) is not None]
+        if not stamped:
+            raise ValueError(
+                'no device_guard("pipe:K") stages found in the program')
+        lo, hi = stamped[0][0], stamped[-1][0] + 1
+        stages = sorted({s for _, s in stamped})
+        S = len(stages)
+        if stages != list(range(S)) or S < 2:
+            raise ValueError(
+                'pipeline stages must be 0..S-1 with S>=2, got %r' % stages)
+
+        # contiguity: the region is gap-free and stages appear in order,
+        # each as one contiguous run
+        segs = {}
+        prev_stage = None
+        for i in range(lo, hi):
+            s = _stage_of(ops[i])
+            if s is None:
+                raise ValueError(
+                    'op %r at index %d sits inside the pipeline region but '
+                    'has no pipe stage annotation' % (ops[i].type, i))
+            if s != prev_stage:
+                if s in segs:
+                    raise ValueError('stage %d is not contiguous' % s)
+                if prev_stage is not None and s != prev_stage + 1:
+                    raise ValueError(
+                        'stages must appear in increasing order; got %d '
+                        'after %d' % (s, prev_stage))
+                segs[s] = [i, i + 1]
+                prev_stage = s
+            else:
+                segs[s][1] = i + 1
+
+        seg_ops = {s: ops[a:b] for s, (a, b) in segs.items()}
+        n0 = len(seg_ops[0])
+        for s in range(1, S):
+            if len(seg_ops[s]) != n0:
+                raise ValueError(
+                    'stage %d has %d ops, stage 0 has %d — stages must be '
+                    'structurally identical' % (s, len(seg_ops[s]), n0))
+            for j, (a, b) in enumerate(zip(seg_ops[0], seg_ops[s])):
+                if a.type != b.type:
+                    raise ValueError(
+                        'op %d differs: stage 0 %r vs stage %d %r'
+                        % (j, a.type, s, b.type))
+                if _attrs_key(a) != _attrs_key(b):
+                    raise ValueError(
+                        'attrs of op %d (%s) differ between stage 0 and '
+                        'stage %d — stages must be structurally identical'
+                        % (j, a.type, s))
+
+        # ------------------------------------------------------------------
+        # classify inputs by aligning each adjacent stage pair
+        produced_in = [set() for _ in range(S)]
+        for s in range(S):
+            for op in seg_ops[s]:
+                produced_in[s].update(op.output_arg_names)
+
+        param_names = [[] for _ in range(S)]   # [S][j] aligned param names
+        extra_names = []
+        boundary = [None] * S   # boundary[k] = stage k's flow output var
+        input_var = None
+
+        def classify_pair(k):
+            """Align stage k-1 and stage k; fill param/extra/flow info."""
+            nonlocal input_var
+            flow_pairs = set()
+            for j in range(n0):
+                a, b = seg_ops[k - 1][j], seg_ops[k][j]
+                for slot in a.inputs:
+                    va_l, vb_l = a.inputs[slot], b.inputs.get(slot, [])
+                    if len(va_l) != len(vb_l):
+                        raise ValueError(
+                            'op %d (%s) slot %r arity differs between '
+                            'stages %d and %d' % (j, a.type, slot, k - 1, k))
+                    for va, vb in zip(va_l, vb_l):
+                        if va.name == vb.name:
+                            if (va.name in produced_in[k - 1]
+                                    or va.name in produced_in[k]):
+                                raise ValueError(
+                                    'var %r is produced inside one stage '
+                                    'but read by another — stages may only '
+                                    'communicate through the single flow '
+                                    'activation' % va.name)
+                            # shared external tensor (mask bias, tied
+                            # weight, pipelined decoder's encoder output):
+                            # replicated to every stage
+                            if va.name not in extra_names:
+                                extra_names.append(va.name)
+                        elif (isinstance(va, Parameter)
+                              and isinstance(vb, Parameter)):
+                            if va.shape != vb.shape or va.dtype != vb.dtype:
+                                raise ValueError(
+                                    'aligned parameters %r/%r differ in '
+                                    'shape/dtype' % (va.name, vb.name))
+                            if k == 1:
+                                if va.name not in param_names[0]:
+                                    param_names[0].append(va.name)
+                                    param_names[1].append(vb.name)
+                            else:
+                                # consistency with the 0/1 alignment
+                                idx = param_names[k - 1].index(va.name)
+                                while len(param_names[k]) <= idx:
+                                    param_names[k].append(None)
+                                param_names[k][idx] = vb.name
+                        elif (va.name in produced_in[k - 1]
+                              and vb.name in produced_in[k]):
+                            continue  # internal dataflow, aligned by index
+                        else:
+                            # the flow slot: stage k-1 reads its input,
+                            # stage k reads stage k-1's boundary output
+                            flow_pairs.add((va.name, vb.name))
+            if len(flow_pairs) != 1:
+                raise ValueError(
+                    'expected exactly one activation flowing between '
+                    'stages %d and %d, found %r — mark shared tensors by '
+                    'using the SAME variable in every stage'
+                    % (k - 1, k, sorted(flow_pairs)))
+            src, dst = flow_pairs.pop()
+            if k == 1:
+                if src in region_produced_any():
+                    raise ValueError(
+                        'stage 0 input %r must come from before the '
+                        'pipeline region' % src)
+                input_var = src
+            elif src != boundary[k - 2]:
+                raise ValueError(
+                    'flow chain broken: stage %d reads %r but stage %d '
+                    'emits %r' % (k - 1, src, k - 2, boundary[k - 2]))
+            if dst not in produced_in[k - 1]:
+                raise ValueError(
+                    'flow var %r is not produced by stage %d'
+                    % (dst, k - 1))
+            boundary[k - 1] = dst
+            return src, dst
+
+        def region_produced_any():
+            return set().union(*produced_in)
+
+        for k in range(1, S):
+            classify_pair(k)
+        nparam = len(param_names[0])
+        for k in range(S):
+            if len(param_names[k]) != nparam or None in param_names[k]:
+                raise ValueError(
+                    'parameter alignment incomplete for stage %d '
+                    '(%r vs stage 0 %r)' % (k, param_names[k],
+                                            param_names[0]))
+
+        # last stage's flow output: produced by the op aligned with the
+        # one that produces boundary[0] in stage 0
+        def producer_index(k, name):
+            for j, op in enumerate(seg_ops[k]):
+                for slot, vs in op.outputs.items():
+                    for pos, v in enumerate(vs):
+                        if v.name == name:
+                            return j, slot, pos
+            raise ValueError('%r not produced by stage %d' % (name, k))
+
+        jb, slot_b, pos_b = producer_index(0, boundary[0])
+        out_op = seg_ops[S - 1][jb]
+        output_var = out_op.outputs[slot_b][pos_b].name
+        boundary[S - 1] = output_var
+
+        # escape check: nothing but the final boundary may leave the region
+        region_produced = set().union(*produced_in)
+        consumed_after = set()
+        for op in ops[hi:]:
+            consumed_after.update(op.input_arg_names)
+        leaked = (region_produced & consumed_after) - {output_var}
+        if leaked:
+            raise ValueError(
+                'vars %r produced inside the pipeline region are consumed '
+                'after it; only the final stage output %r may escape'
+                % (sorted(leaked), output_var))
+
+        in_v = block._var_recursive(input_var)
+        out_v = block._var_recursive(output_var)
+        if (in_v.shape is not None and out_v.shape is not None
+                and tuple(in_v.shape) != tuple(out_v.shape)):
+            raise ValueError(
+                'pipeline stages must preserve the activation shape: input '
+                '%r %r vs output %r %r' % (input_var, in_v.shape,
+                                           output_var, out_v.shape))
+
+        # batch-aligned extras (leading dynamic dim: pad-mask biases, a
+        # pipelined decoder's encoder output) are streamed per-microbatch;
+        # static-shape extras (tied weights, tables) replicate whole
+        stream, static = [], []
+        for n in extra_names:
+            v = block._var_recursive(n)
+            if v.shape is not None and len(v.shape) and v.shape[0] == -1:
+                stream.append(n)
+            else:
+                static.append(n)
+
+        program._pipeline_config = {
+            'axis': self.axis,
+            'n_micro': self.n_micro,
+            'n_stages': S,
+            'region': (lo, hi),
+            'stage0': tuple(segs[0]),
+            'param_names': param_names,
+            'input_var': input_var,
+            'boundary0': boundary[0],
+            'output_var': output_var,
+            'extra_stream_names': stream,
+            'extra_names': static,
+        }
+        base = dict(getattr(program, '_dist_config', None) or {})
+        base['pp_size'] = S
+        base['pp_axis'] = self.axis
+        base.setdefault('sync_mode', True)
+        base['mesh_axes'] = tuple(
+            ax for ax in ('dp', 'pp')
+            if int(base.get(ax + '_size') or 1) > 1)
+        program._dist_config = base
+        program._dist_mesh = None  # force (re)build with the pp axis
+        program._bump_version()
+        return self

@@ -126,8 +126,15 @@ def _embed(word, vocab_size, d_model, max_len, dropout_rate, name_prefix):
 
 def transformer(src_vocab_size, trg_vocab_size, max_length, n_layer=6,
                 d_model=512, n_head=8, d_inner=2048, dropout_rate=0.1,
-                label_smooth_eps=0.1):
-    """Build the training graph; returns (avg_cost, token_count, feeds)."""
+                label_smooth_eps=0.1, pp_decoder=False):
+    """Build the training graph; returns (avg_cost, token_count, feeds).
+
+    pp_decoder=True wraps each decoder layer in device_guard('pipe:k') so
+    PipelineTranspiler can run the decoder stack as a GPipe schedule over a
+    `pp` mesh axis (n_layer == number of stages); the encoder + embeddings
+    stay in the prologue and the enc output / pad biases become streamed
+    pipeline extras. Without transpiling, the stamps are inert."""
+    import contextlib
     src_word = layers.data(name='src_word', shape=[max_length],
                            dtype='int64')
     trg_word = layers.data(name='trg_word', shape=[max_length],
@@ -146,9 +153,12 @@ def transformer(src_vocab_size, trg_vocab_size, max_length, n_layer=6,
 
     dec = _embed(trg_word, trg_vocab_size, d_model, max_length,
                  dropout_rate, 'trg')
-    for _ in range(n_layer):
-        dec = decoder_layer(dec, enc, self_bias, src_bias, d_model, n_head,
-                            d_inner, dropout_rate)
+    for k in range(n_layer):
+        guard = (fluid.device_guard('pipe:%d' % k) if pp_decoder
+                 else contextlib.nullcontext())
+        with guard:
+            dec = decoder_layer(dec, enc, self_bias, src_bias, d_model,
+                                n_head, d_inner, dropout_rate)
 
     logits = layers.fc(input=dec, size=trg_vocab_size, num_flatten_dims=2,
                        bias_attr=False)

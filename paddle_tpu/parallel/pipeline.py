@@ -10,6 +10,12 @@ next stage while the first stage ingests the next microbatch. After
 n_micro + n_stages - 1 ticks the last stage has emitted every microbatch.
 Bubble fraction is (n_stages-1)/(n_micro+n_stages-1) — the standard GPipe
 trade; raise n_micro to amortize.
+
+`extras` are per-call tensors every stage reads but none produce (pad-mask
+biases, encoder output for a pipelined decoder stack): replicated over the
+pp axis and passed to stage_fn after the activation. This is what lets a
+full Fluid transformer stack — not just a toy closure — run through the
+pipeline (see fluid/transpiler/pipeline_transpiler.py).
 """
 import jax
 import jax.numpy as jnp
@@ -24,22 +30,33 @@ __all__ = ['pipeline_apply', 'stack_stage_params']
 stack_stage_params = stack_unit_params
 
 
-def pipeline_apply(stage_fn, stacked_params, microbatches, mesh, axis='pp'):
+def pipeline_apply(stage_fn, stacked_params, microbatches, mesh, axis='pp',
+                   extras=(), extras_streamed=()):
     """Run the pipeline.
 
-    stage_fn(params, x) -> y        same signature for every stage; all
-                                    stages must map [mb, d] -> [mb, d]
-                                    (equal widths — pad if needed)
+    stage_fn(params, x, *extras_streamed_mb, *extras) -> y
+                    same signature for every stage; all stages must map
+                    [mb, ...] -> same shape/dtype (equal widths — pad if
+                    needed)
     stacked_params: pytree, leaves [n_stages, ...], sharded over `axis`
-    microbatches:   [n_micro, mb, d] (replicated or batch-sharded on dp)
-    Returns [n_micro, mb, d]: the last stage's output per microbatch.
+    microbatches:   [n_micro, mb, ...] (replicated or batch-sharded on dp)
+    extras:         global tensors every stage reads whole (tied weights,
+                    precomputed tables) — replicated over `axis`
+    extras_streamed: batch-aligned tensors ([n_micro, mb, ...], microbatched
+                    like x: pad-mask biases, a pipelined decoder's encoder
+                    output). At tick t, stage k is processing microbatch
+                    t - k, so each device dynamic-indexes its OWN in-flight
+                    microbatch slice — the tensors do not ride the ring.
+    Returns [n_micro, mb, ...]: the last stage's output per microbatch.
     """
     n_stages = mesh.shape[axis]
     n_micro = microbatches.shape[0]
     check_units_match_axis(stacked_params, mesh, axis, 'pipeline stage')
     from jax import shard_map
+    n_stream = len(extras_streamed)
 
-    def body(params, mbs):
+    def body(params, mbs, *ex):
+        stream, glob = ex[:n_stream], ex[n_stream:]
         # params leaves arrive as [1, ...] (this device's stage); unstack
         p_local = jax.tree_util.tree_map(lambda x: x[0], params)
         idx = lax.axis_index(axis)
@@ -49,13 +66,18 @@ def pipeline_apply(stage_fn, stacked_params, microbatches, mesh, axis='pp'):
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
         def tick(carry, t):
-            held = carry  # [mb, d] activation each device currently holds
+            held = carry  # [mb, ...] activation each device currently holds
             # first stage ingests microbatch t (or zeros past the end)
             mb_idx = jnp.minimum(t, n_micro - 1)
             fresh = lax.dynamic_index_in_dim(mbs, mb_idx, axis=0,
                                              keepdims=False)
             x = jnp.where(is_first, fresh, held)
-            y = stage_fn(p_local, x)
+            # stage idx processes microbatch t - idx at tick t (clipped to
+            # a valid index during fill/drain; those results are discarded)
+            my_mb = jnp.clip(t - idx, 0, n_micro - 1)
+            sex = [lax.dynamic_index_in_dim(e, my_mb, axis=0,
+                                            keepdims=False) for e in stream]
+            y = stage_fn(p_local, x, *sex, *glob)
             # last stage emits y at tick t when t - (n_stages-1) >= 0
             emit_idx = t - (n_stages - 1)
             # everyone passes its output to the next stage; the wraparound
@@ -66,19 +88,35 @@ def pipeline_apply(stage_fn, stacked_params, microbatches, mesh, axis='pp'):
         init = jnp.zeros(mbs.shape[1:], mbs.dtype)
         _, (ys, emit_idxs) = lax.scan(tick, init, jnp.arange(T))
         # gather the last stage's outputs in microbatch order
-        out = jnp.zeros((n_micro,) + mbs.shape[1:], mbs.dtype)
+        out = jnp.zeros((n_micro,) + ys.shape[1:], ys.dtype)
         valid = emit_idxs >= 0
+        valid_b = valid.reshape(valid.shape + (1,) * (ys.ndim - 1))
         out = out.at[jnp.where(valid, emit_idxs, 0)].add(
-            jnp.where(valid[:, None, None], ys, 0.0))
+            jnp.where(valid_b, ys, 0.0))
         # only the last stage holds real outputs; broadcast them to all
         # shards so the result is replicated over the pp axis
         out = jnp.where(is_last, out, 0.0)
         out = lax.psum(out, axis)
         return out
 
+    # compose with data parallel: when the mesh also carries 'dp', the
+    # microbatch dim (dim 1 of [n_micro, mb, ...]) stays dp-sharded and
+    # every dp slice runs its own pipeline; global extras stay replicated
+    if 'dp' in mesh.shape and 'dp' != axis:
+        dp = mesh.shape['dp']
+        if microbatches.shape[1] % dp:
+            raise ValueError(
+                'per-microbatch size %d does not divide the dp mesh axis '
+                '%d — lower n_micro or the dp size so every dp shard gets '
+                'whole microbatch rows' % (microbatches.shape[1], dp))
+        mb_spec = P(None, 'dp')
+    else:
+        mb_spec = P()
     fn = shard_map(
         body, mesh=mesh,
         in_specs=(jax.tree_util.tree_map(lambda _: P(axis), stacked_params),
-                  P()),
-        out_specs=P(), check_vma=False)
-    return fn(stacked_params, microbatches)
+                  mb_spec)
+                 + tuple(mb_spec for _ in extras_streamed)
+                 + tuple(P() for _ in extras),
+        out_specs=mb_spec, check_vma=False)
+    return fn(stacked_params, microbatches, *extras_streamed, *extras)

@@ -91,8 +91,10 @@ def save_sharded(ckpt_dir, arrays, step=0, extra_meta=None):
                 continue
             seen.add(key)
             fname = '%s.p%d.shard%d.npy' % (base, proc, len(entry['shards']))
-            np.save(os.path.join(ckpt_dir, fname), np.asarray(shard.data))
+            fpath = os.path.join(ckpt_dir, fname)
+            np.save(fpath, np.asarray(shard.data))
             entry['shards'].append({'file': fname,
+                                    'bytes': os.path.getsize(fpath),
                                     'start': [k[0] for k in key],
                                     'stop': [k[1] for k in key]})
         manifest['arrays'][name] = entry
@@ -102,6 +104,30 @@ def save_sharded(ckpt_dir, arrays, step=0, extra_meta=None):
         json.dump(manifest, f)
     os.replace(tmp, os.path.join(ckpt_dir, fname))
     return ckpt_dir
+
+
+def _load_shard(ckpt_dir, sh):
+    """np.load with corruption detection: a missing or size-mismatched
+    (truncated / partially-written) shard file raises a RuntimeError naming
+    the file instead of a cryptic numpy parse error (reference io.py's
+    load_persistables raises per-var on missing files the same way)."""
+    path = os.path.join(ckpt_dir, sh['file'] if isinstance(sh, dict) else sh)
+    meta = sh if isinstance(sh, dict) else {}
+    if not os.path.exists(path):
+        raise RuntimeError(
+            'sharded checkpoint shard %r is missing (deleted or never '
+            'fully written)' % path)
+    want = meta.get('bytes')
+    if want is not None and os.path.getsize(path) != want:
+        raise RuntimeError(
+            'sharded checkpoint shard %r is corrupt: %d bytes on disk, '
+            'manifest recorded %d (truncated write?)'
+            % (path, os.path.getsize(path), want))
+    try:
+        return np.load(path)
+    except Exception as e:
+        raise RuntimeError(
+            'sharded checkpoint shard %r is unreadable: %r' % (path, e))
 
 
 def load_sharded(ckpt_dir, mesh=None):
@@ -146,22 +172,22 @@ def load_sharded(ckpt_dir, mesh=None):
         shard_map = {}
         for sh in entry['shards']:
             key = tuple((s, t) for s, t in zip(sh['start'], sh['stop']))
-            shard_map[key] = sh['file']
+            shard_map[key] = sh
 
         def cb(index, _shape=shape, _smap=shard_map, _dtype=dtype):
             key = _index_key(index, _shape)
             if key in _smap:
-                return np.load(os.path.join(ckpt_dir, _smap[key])).astype(_dtype)
+                return _load_shard(ckpt_dir, _smap[key]).astype(_dtype)
             # Restoring onto a different mesh/spec: assemble the requested
             # region from the overlapping saved shards (elastic restore).
             region = np.empty([t - s for s, t in key], dtype=_dtype)
             covered = np.zeros(region.shape, dtype=bool)
-            for skey, fname in _smap.items():
+            for skey, sh in _smap.items():
                 lo = [max(a[0], b[0]) for a, b in zip(key, skey)]
                 hi = [min(a[1], b[1]) for a, b in zip(key, skey)]
                 if any(l >= h for l, h in zip(lo, hi)):
                     continue
-                data = np.load(os.path.join(ckpt_dir, fname))
+                data = _load_shard(ckpt_dir, sh)
                 src = tuple(slice(l - b[0], h - b[0])
                             for l, h, b in zip(lo, hi, skey))
                 dst = tuple(slice(l - a[0], h - a[0])
