@@ -17,8 +17,11 @@ from . import metric_op
 from .metric_op import *  # noqa: F401,F403
 from . import learning_rate_scheduler
 from .learning_rate_scheduler import *  # noqa: F401,F403
+from . import layer_function_generator
+from .layer_function_generator import *  # noqa: F401,F403
 
 __all__ = []
+__all__ += layer_function_generator.__all__
 __all__ += nn.__all__
 __all__ += ops.__all__
 __all__ += tensor.__all__

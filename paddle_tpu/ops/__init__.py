@@ -4,6 +4,7 @@ The compute path is JAX/XLA; these kernels take over where hand-tiling
 beats the compiler — currently flash attention (the reference's equivalent
 hot path is the cuDNN/cuBLAS attention chain in its benchmark models).
 """
-from .flash_attention import flash_attention, reference_attention
+from .flash_attention import flash_attention, flash_attention_lse, \
+    reference_attention
 
-__all__ = ['flash_attention', 'reference_attention']
+__all__ = ['flash_attention', 'flash_attention_lse', 'reference_attention']

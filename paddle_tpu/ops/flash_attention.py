@@ -266,19 +266,26 @@ def _bwd_call(q, k, v, kb, do, lse, delta, causal, scale, bq, bk, interpret):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q, k, v, kb, causal, scale, bq, bk, interpret):
-    o, _ = _fwd_call(q, k, v, kb, causal, scale, bq, bk, interpret)
-    return o
-
-
-def _flash_fwd(q, k, v, kb, causal, scale, bq, bk, interpret):
+def _flash_lse(q, k, v, kb, causal, scale, bq, bk, interpret):
     o, lse = _fwd_call(q, k, v, kb, causal, scale, bq, bk, interpret)
-    return o, (q, k, v, kb, o, lse)
+    return o, lse[..., 0]
 
 
-def _flash_bwd(causal, scale, bq, bk, interpret, res, do):
+def _flash_lse_fwd(q, k, v, kb, causal, scale, bq, bk, interpret):
+    o, lse = _fwd_call(q, k, v, kb, causal, scale, bq, bk, interpret)
+    return (o, lse[..., 0]), (q, k, v, kb, o, lse)
+
+
+def _flash_lse_bwd(causal, scale, bq, bk, interpret, res, cot):
+    """Backward with an lse cotangent, sharing the kernels unchanged:
+    lse = logsumexp(S) gives dS|lse = P * dlse, and the kernels compute
+    dS = P * (dP - delta), so folding delta' = delta - dlse routes the lse
+    gradient through the same two pallas calls (the FlashAttention D-trick
+    extended one term)."""
+    do, dlse = cot
     q, k, v, kb, o, lse = res
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = delta - dlse.astype(jnp.float32)
     delta = jnp.broadcast_to(delta[..., None], delta.shape + (LANES,))
     dq, dk, dv = _bwd_call(q, k, v, kb, do, lse, delta, causal, scale,
                            bq, bk, interpret)
@@ -286,21 +293,11 @@ def _flash_bwd(causal, scale, bq, bk, interpret, res, do):
     return dq, dk, dv, jnp.zeros_like(kb)
 
 
-_flash.defvjp(_flash_fwd, _flash_bwd)
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
-def flash_attention(q, k, v, key_bias=None, causal=False, sm_scale=None,
-                    block_q=None, block_k=None, interpret=None):
-    """Flash attention over [B, H, T, D] tensors.
-
-    key_bias: optional additive [B, Tk] bias (e.g. -1e9 on padded keys);
-              treated as a non-differentiable mask.
-    causal:   lower-triangular masking (decoder self-attention).
-    block_q/block_k: kernel tile sizes (default 128/128, overridable with
-              PADDLE_TPU_FLASH_BQ / PADDLE_TPU_FLASH_BK — see
-              tools/tune_flash.py for the on-chip sweep).
-    Returns [B, H, Tq, D] in q's dtype; differentiable w.r.t. q/k/v.
-    """
+def _prep(q, k, v, key_bias, sm_scale, block_q, block_k, interpret):
+    """Shared block-size/padding/bias plumbing for the public wrappers."""
     import os
     if block_q is None:
         block_q = int(os.environ.get('PADDLE_TPU_FLASH_BQ', 128))
@@ -317,9 +314,6 @@ def flash_attention(q, k, v, key_bias=None, causal=False, sm_scale=None,
     else:
         key_bias = key_bias.reshape(B, Tk).astype(jnp.float32)
     key_bias = lax.stop_gradient(key_bias)
-
-    # pad sequence dims to block multiples; padded keys are masked off,
-    # padded query rows are sliced away
     bq = min(block_q, _round_up(Tq, 128))
     bk = min(block_k, _round_up(Tk, 128))
     Tq_p = _round_up(Tq, bq)
@@ -334,11 +328,42 @@ def flash_attention(q, k, v, key_bias=None, causal=False, sm_scale=None,
     # (B, 1, Tk): Mosaic block shapes need the sublane dim to equal the
     # array dim, so the bias carries an explicit singleton sublane
     key_bias = key_bias.reshape(B, 1, Tk_p)
+    return (q, k, v, key_bias, float(sm_scale), int(bq), int(bk),
+            bool(interpret), Tq, Tq_p)
 
-    o = _flash(q, k, v, key_bias, bool(causal), float(sm_scale),
-               int(bq), int(bk), bool(interpret))
+
+def flash_attention_lse(q, k, v, key_bias=None, causal=False, sm_scale=None,
+                        block_q=None, block_k=None, interpret=None):
+    """flash_attention that ALSO returns the per-query logsumexp
+    ([B, H, Tq], f32) — the combine statistic ring attention needs to merge
+    partial attention over key shards. Differentiable in q/k/v through BOTH
+    outputs (see _flash_lse_bwd)."""
+    (q, k, v, kb, scale, bq, bk, interp, Tq, Tq_p) = _prep(
+        q, k, v, key_bias, sm_scale, block_q, block_k, interpret)
+    o, lse = _flash_lse(q, k, v, kb, bool(causal), scale, bq, bk, interp)
     if Tq_p != Tq:
         o = o[:, :, :Tq, :]
+        lse = lse[:, :, :Tq]
+    return o, lse
+
+
+def flash_attention(q, k, v, key_bias=None, causal=False, sm_scale=None,
+                    block_q=None, block_k=None, interpret=None):
+    """Flash attention over [B, H, T, D] tensors.
+
+    key_bias: optional additive [B, Tk] bias (e.g. -1e9 on padded keys);
+              treated as a non-differentiable mask.
+    causal:   lower-triangular masking (decoder self-attention).
+    block_q/block_k: kernel tile sizes (default 128/128, overridable with
+              PADDLE_TPU_FLASH_BQ / PADDLE_TPU_FLASH_BK — see
+              tools/tune_flash.py for the on-chip sweep).
+    Returns [B, H, Tq, D] in q's dtype; differentiable w.r.t. q/k/v.
+    """
+    # one custom_vjp serves both wrappers: the unused lse output gets a
+    # zero cotangent, making _flash_lse_bwd exactly the classic backward
+    o, _ = flash_attention_lse(q, k, v, key_bias=key_bias, causal=causal,
+                               sm_scale=sm_scale, block_q=block_q,
+                               block_k=block_k, interpret=interpret)
     return o
 
 

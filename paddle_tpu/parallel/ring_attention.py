@@ -19,13 +19,36 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 NEG_BIG = -1e9
 
 
+def _resolve_impl(impl):
+    """None -> env override or backend default; typos raise rather than
+    silently running the O(Tl^2) dense body."""
+    if impl is None:
+        import os
+        impl = os.environ.get(
+            'PADDLE_TPU_RING_IMPL',
+            'flash' if jax.default_backend() == 'tpu' else 'dense')
+    if impl not in ('flash', 'dense'):
+        raise ValueError(
+            "ring attention impl must be 'flash' or 'dense', got %r" % impl)
+    return impl
+
+
 def ring_attention(q, k, v, axis_name, key_bias=None, causal=False,
-                   sm_scale=None):
+                   sm_scale=None, impl=None):
     """Per-shard body (call inside shard_map).
 
     q, k, v: [B, H, T_local, D] — the sequence axis sharded over axis_name.
     key_bias: [B, T_local] additive bias for the local keys (or None).
+    impl: 'flash' runs each local block through the pallas flash kernel
+        (no [Tl, Tl] score matrix ever materializes — the long-context MXU
+        path) and merges ring steps with logsumexp statistics; 'dense' is
+        the plain-XLA einsum body. None auto-selects flash on TPU
+        (overridable with PADDLE_TPU_RING_IMPL).
     """
+    impl = _resolve_impl(impl)
+    if impl == 'flash':
+        return _ring_attention_flash(q, k, v, axis_name, key_bias, causal,
+                                     sm_scale)
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     B, H, Tl, D = q.shape
@@ -72,13 +95,80 @@ def ring_attention(q, k, v, axis_name, key_bias=None, causal=False,
     return (acc / l[..., None]).astype(q.dtype)
 
 
+def _ring_attention_flash(q, k, v, axis_name, key_bias, causal, sm_scale):
+    """Ring schedule with the pallas flash kernel as the per-step block.
+
+    Each ring step computes (o_s, lse_s) = flash(q_local, kv_shard); steps
+    merge with the standard partial-softmax combine
+        lse' = logaddexp(lse, lse_s)
+        o'   = o * e^{lse-lse'} + o_s * e^{lse_s-lse'}
+    which is exact (the union of key shards IS full attention). Causality
+    across shards is a per-step trichotomy on the ring offset — fully
+    visible (earlier shard: plain kernel), diagonal (own shard: causal
+    kernel), fully masked (later shard: skip) — so the kernel's local
+    causal mask is always position-correct. Gradients flow through both
+    kernel outputs (ops.flash_attention._flash_lse_bwd) and the combine.
+    """
+    from ..ops.flash_attention import flash_attention_lse
+
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    B, H, Tl, D = q.shape
+    if key_bias is None:
+        key_bias = jnp.zeros((B, Tl), jnp.float32)
+    key_bias = lax.stop_gradient(key_bias)
+
+    o = jnp.zeros((B, H, Tl, D), jnp.float32)
+    lse = jnp.full((B, H, Tl), -1e30, jnp.float32)
+    kc, vc, kbc = k, v, key_bias
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def merge(o, lse, o_s, lse_s):
+        lse_new = jnp.logaddexp(lse, lse_s)
+        w = jnp.exp(lse - lse_new)[..., None]
+        w_s = jnp.exp(lse_s - lse_new)[..., None]
+        return o * w + o_s.astype(jnp.float32) * w_s, lse_new
+
+    for s in range(int(n)):
+        src = (idx - s) % n           # whose kv shard we currently hold
+        if causal:
+            def visible(kc=kc, vc=vc, kbc=kbc):
+                return flash_attention_lse(q, kc, vc, key_bias=kbc,
+                                           causal=False, sm_scale=sm_scale)
+
+            def diagonal(kc=kc, vc=vc, kbc=kbc):
+                return flash_attention_lse(q, kc, vc, key_bias=kbc,
+                                           causal=True, sm_scale=sm_scale)
+
+            def masked():
+                return (jnp.zeros((B, H, Tl, D), q.dtype),
+                        jnp.full((B, H, Tl), -1e30, jnp.float32))
+
+            o_s, lse_s = lax.cond(
+                src > idx, masked,
+                lambda: lax.cond(src == idx, diagonal, visible))
+        else:
+            o_s, lse_s = flash_attention_lse(q, kc, vc, key_bias=kbc,
+                                             causal=False, sm_scale=sm_scale)
+        o, lse = merge(o, lse, o_s, lse_s)
+        if s != n - 1:   # the last shard needs no further rotation
+            kc = lax.ppermute(kc, axis_name, perm)
+            vc = lax.ppermute(vc, axis_name, perm)
+            kbc = lax.ppermute(kbc, axis_name, perm)
+    return o.astype(q.dtype)
+
+
 def ring_self_attention(mesh, q, k, v, axis='sp', key_bias=None,
-                        causal=False, sm_scale=None):
+                        causal=False, sm_scale=None, impl=None):
     """pjit-level entry: q/k/v [B, H, T, D] with T sharded over mesh axis."""
     from ._sp import sp_shard_map
+    impl = _resolve_impl(impl)  # resolve HERE so check_vma is exact
 
     def body(q, k, v, kb):
         return ring_attention(q, k, v, axis, key_bias=kb, causal=causal,
-                              sm_scale=sm_scale)
+                              sm_scale=sm_scale, impl=impl)
 
-    return sp_shard_map(body, mesh, q, k, v, axis, key_bias)
+    # pallas ShapeDtypeStructs carry no varying-mesh-axes info, so the vma
+    # check must be off when the flash body runs
+    return sp_shard_map(body, mesh, q, k, v, axis, key_bias,
+                        check_vma=impl == 'dense')
