@@ -307,6 +307,32 @@ def main():
             _log('transformer bench failed: %r' % e)
             _emit({'metric': tname, 'skipped': True, 'error': str(e)[:300]})
 
+    # bonus: long-sequence Transformer through the pallas flash path —
+    # showcases the long-context design; only after both contract metrics,
+    # only with generous budget left, skippable via BENCH_LONGSEQ=0
+    lname = 'transformer_base_seq1024_train_tokens_per_sec_per_chip'
+    if os.environ.get('BENCH_LONGSEQ', '1') == '1' and not on_cpu:
+        if _budget_left() < 420:
+            _emit({'metric': lname, 'skipped': True,
+                   'reason': 'budget reserved for contract metrics'})
+        else:
+            try:
+                tps, n_params = bench_transformer(
+                    batch_size=8, seq_len=1024, iters=iters, use_amp=use_amp)
+                flops = 6.0 * n_params * tps
+                m = {'metric': lname, 'value': round(tps, 2),
+                     'unit': 'tokens/sec/chip',
+                     'vs_baseline': round(tps / REF_TOKENS_PER_SEC, 3),
+                     'tflops': round(flops / 1e12, 2), 'mfu': _mfu(flops),
+                     'params': int(n_params), 'platform': platform,
+                     'batch': 8, 'seq_len': 1024, 'amp': use_amp}
+                metrics.append(m)
+                _emit(m)
+            except Exception as e:
+                _log('long-seq bench failed: %r' % e)
+                _emit({'metric': lname, 'skipped': True,
+                       'error': str(e)[:300]})
+
     # headline LAST so a line-by-line parser and a last-line parser agree;
     # it is ALWAYS the ResNet-50 series (round-1 continuity) — when that
     # phase failed, the headline says so explicitly rather than silently
