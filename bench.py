@@ -281,57 +281,50 @@ def main():
             _log('resnet50 bench failed: %r' % e)
             _emit({'metric': rname, 'skipped': True, 'error': str(e)[:300]})
 
+    def transformer_metric(name, batch, seq_len, fallback_batch=None):
+        """Run one transformer phase and emit its metric line (shared by
+        the contract seq-256 phase and the long-seq bonus phase)."""
+        try:
+            attempts = [dict(batch_size=batch, seq_len=seq_len, iters=iters,
+                             use_amp=use_amp)]
+            if fallback_batch:
+                attempts.append(dict(batch_size=fallback_batch,
+                                     seq_len=seq_len, iters=iters,
+                                     use_amp=use_amp))
+            tps, n_params = _try(bench_transformer, *attempts)
+            flops = 6.0 * n_params * tps
+            m = {'metric': name, 'value': round(tps, 2),
+                 'unit': 'tokens/sec/chip',
+                 'vs_baseline': round(tps / REF_TOKENS_PER_SEC, 3),
+                 'tflops': round(flops / 1e12, 2), 'mfu': _mfu(flops),
+                 'params': int(n_params), 'platform': platform,
+                 'batch': batch, 'seq_len': seq_len, 'amp': use_amp}
+            metrics.append(m)
+            _emit(m)
+        except Exception as e:
+            _log('%s failed: %r' % (name, e))
+            _emit({'metric': name, 'skipped': True, 'error': str(e)[:300]})
+
     tname = 'transformer_base_train_tokens_per_sec_per_chip'
     if _budget_left() < 120:
         _emit({'metric': tname, 'skipped': True,
                'reason': 'wall-clock budget exhausted before phase start'})
     else:
-        try:
-            tps, n_params = _try(
-                bench_transformer,
-                dict(batch_size=tbatch, seq_len=seq, iters=iters,
-                     use_amp=use_amp),
-                dict(batch_size=max(4, tbatch // 4), seq_len=seq,
-                     iters=iters, use_amp=use_amp))
-            flops = 6.0 * n_params * tps
-            m = {'metric': tname, 'value': round(tps, 2),
-                 'unit': 'tokens/sec/chip',
-                 'vs_baseline': round(tps / REF_TOKENS_PER_SEC, 3),
-                 'tflops': round(flops / 1e12, 2), 'mfu': _mfu(flops),
-                 'params': int(n_params),
-                 'platform': platform, 'batch': tbatch, 'seq_len': seq,
-                 'amp': use_amp}
-            metrics.append(m)
-            _emit(m)
-        except Exception as e:
-            _log('transformer bench failed: %r' % e)
-            _emit({'metric': tname, 'skipped': True, 'error': str(e)[:300]})
+        transformer_metric(tname, tbatch, seq, fallback_batch=max(4, tbatch // 4))
 
     # bonus: long-sequence Transformer through the pallas flash path —
     # showcases the long-context design; only after both contract metrics,
     # only with generous budget left, skippable via BENCH_LONGSEQ=0
     lname = 'transformer_base_seq1024_train_tokens_per_sec_per_chip'
-    if os.environ.get('BENCH_LONGSEQ', '1') == '1' and not on_cpu:
-        if _budget_left() < 420:
-            _emit({'metric': lname, 'skipped': True,
-                   'reason': 'budget reserved for contract metrics'})
-        else:
-            try:
-                tps, n_params = bench_transformer(
-                    batch_size=8, seq_len=1024, iters=iters, use_amp=use_amp)
-                flops = 6.0 * n_params * tps
-                m = {'metric': lname, 'value': round(tps, 2),
-                     'unit': 'tokens/sec/chip',
-                     'vs_baseline': round(tps / REF_TOKENS_PER_SEC, 3),
-                     'tflops': round(flops / 1e12, 2), 'mfu': _mfu(flops),
-                     'params': int(n_params), 'platform': platform,
-                     'batch': 8, 'seq_len': 1024, 'amp': use_amp}
-                metrics.append(m)
-                _emit(m)
-            except Exception as e:
-                _log('long-seq bench failed: %r' % e)
-                _emit({'metric': lname, 'skipped': True,
-                       'error': str(e)[:300]})
+    if os.environ.get('BENCH_LONGSEQ', '1') != '1' or on_cpu:
+        _emit({'metric': lname, 'skipped': True,
+               'reason': 'disabled' if os.environ.get('BENCH_LONGSEQ') == '0'
+                         else 'cpu fallback platform'})
+    elif _budget_left() < 420:
+        _emit({'metric': lname, 'skipped': True,
+               'reason': 'budget reserved for contract metrics'})
+    else:
+        transformer_metric(lname, 8, 1024)
 
     # headline LAST so a line-by-line parser and a last-line parser agree;
     # it is ALWAYS the ResNet-50 series (round-1 continuity) — when that
