@@ -43,7 +43,8 @@ from .core import CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace
 from .parallel_executor import ParallelExecutor, ExecutionStrategy, BuildStrategy
 from . import transpiler
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig, \
-    InferenceTranspiler, PipelineTranspiler, memory_optimize, release_memory
+    InferenceTranspiler, PipelineTranspiler, SequenceParallelTranspiler, \
+    memory_optimize, release_memory
 from . import trainer
 from .trainer import Trainer, BeginEpochEvent, EndEpochEvent, \
     BeginStepEvent, EndStepEvent, CheckpointConfig

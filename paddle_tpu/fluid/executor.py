@@ -471,16 +471,20 @@ class Executor(object):
         n_dev = len(jax.devices())
         pp = int(dist.get('pp_size') or 1)
         pp_axis = dist.get('pp_axis', 'pp')
-        if pp > n_dev:
+        sp = int(dist.get('sp_size') or 1)
+        fixed = pp * sp   # stage/shard counts are structural, not capped
+        if fixed > n_dev:
             raise RuntimeError(
-                'pipeline has %d stages but only %d devices are visible'
-                % (pp, n_dev))
-        dp = min(int(dist.get('dp_size') or 1), max(1, n_dev // pp))
+                'mesh needs pp=%d x sp=%d = %d devices but only %d are '
+                'visible' % (pp, sp, fixed, n_dev))
+        dp = min(int(dist.get('dp_size') or 1), max(1, n_dev // fixed))
         axes = {}
         if dp > 1:
             axes['dp'] = dp
         if pp > 1:
             axes[pp_axis] = pp
+        if sp > 1:
+            axes['sp'] = sp
         if not axes:
             program._dist_mesh = False
             return None

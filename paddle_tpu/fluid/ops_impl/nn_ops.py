@@ -533,7 +533,21 @@ def _flash_attention(ins, attrs, ctx):
     scale = None if scale is None or scale < 0 else float(scale)
     causal = bool(attrs.get('causal', False))
     q, k, v = amp_cast(ctx, q, k, v)
-    if ctx.platform in ('tpu', 'axon'):
+    mesh = getattr(ctx, 'mesh', None)
+    if mesh is not None and 'sp' in getattr(mesh, 'shape', {}):
+        # sequence-parallel mesh (SequenceParallelTranspiler): the O(T^2)
+        # attention distributes over the sp axis as a ppermute ring; each
+        # device holds O(T/sp) keys (flash blocks on TPU, dense on CPU)
+        sp = mesh.shape['sp']
+        if q.shape[2] % sp or k.shape[2] % sp:
+            raise ValueError(
+                'sequence parallelism: the sp mesh axis size %d must '
+                'divide the seq lens %d/%d'
+                % (sp, q.shape[2], k.shape[2]))
+        from ...parallel.ring_attention import ring_self_attention
+        out = ring_self_attention(mesh, q, k, v, axis='sp', key_bias=kb,
+                                  causal=causal, sm_scale=scale)
+    elif ctx.platform in ('tpu', 'axon'):
         out = tpu_ops.flash_attention(q, k, v, key_bias=kb, causal=causal,
                                       sm_scale=scale, interpret=False)
     else:

@@ -9,8 +9,10 @@ from .distribute_transpiler import (DistributeTranspiler,
 from .inference_transpiler import InferenceTranspiler
 from .memory_optimization_transpiler import memory_optimize, release_memory
 from .pipeline_transpiler import PipelineTranspiler
+from .sp_transpiler import SequenceParallelTranspiler
 from .ps_dispatcher import HashName, RoundRobin
 
 __all__ = ['DistributeTranspiler', 'DistributeTranspilerConfig',
-           'InferenceTranspiler', 'PipelineTranspiler', 'memory_optimize',
+           'InferenceTranspiler', 'PipelineTranspiler',
+           'SequenceParallelTranspiler', 'memory_optimize',
            'release_memory', 'HashName', 'RoundRobin']

@@ -286,6 +286,10 @@ class PipelineTranspiler(object):
             'extra_names': static,
         }
         base = dict(getattr(program, '_dist_config', None) or {})
+        if int(base.get('sp_size') or 1) > 1:
+            raise ValueError(
+                'pipeline parallelism does not compose with sequence '
+                'parallelism (see sp_transpiler.py docstring)')
         base['pp_size'] = S
         base['pp_axis'] = self.axis
         base.setdefault('sync_mode', True)

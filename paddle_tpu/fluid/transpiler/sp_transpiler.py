@@ -1,0 +1,62 @@
+"""Sequence-parallel transpiler: long-context Fluid programs over `sp`.
+
+TPU-first extension (no reference counterpart — the reference caps context
+by single-GPU memory; benchmark/fluid machine_translation max_length).
+Annotates the program so the Executor builds a mesh with an `sp` axis;
+every `fused_attention` op in the program then routes through
+parallel.ring_attention (ops_impl/nn_ops.py:_flash_attention): k/v shards
+rotate around the ICI ring, each device holding O(T/sp) keys, with the
+pallas flash kernel as the per-step block on TPU. Attention is the O(T^2)
+term, so this is where long-context memory and compute distribute; the
+pointwise/ffn ops stay data-parallel-shaped and XLA propagates shardings
+through them.
+
+    avg_cost, _, feeds = transformer(..., max_length=32768)
+    fluid.SequenceParallelTranspiler(sp=8).transpile(main_program)
+    exe.run(main_program, ...)          # attention rides the sp ring
+
+Composes with DistributeTranspiler (dp) — axis sizes multiply, so dp x sp
+needs dp*sp visible devices, each dp replica running its own ring over its
+batch slice. Does NOT compose with PipelineTranspiler (pp): the pipeline
+region already runs inside a shard_map, and nesting the ring's shard_map
+there would need the stage specs to carry the sequence sharding —
+transpile() rejects the combination rather than crashing at trace time.
+"""
+from ..framework import default_main_program
+
+__all__ = ['SequenceParallelTranspiler']
+
+
+class SequenceParallelTranspiler(object):
+    """The mesh axis is fixed to 'sp' — the fused_attention lowering routes
+    by that name (ops_impl/nn_ops.py)."""
+
+    def __init__(self, sp):
+        if int(sp) < 2:
+            raise ValueError('sp must be >= 2, got %r' % (sp,))
+        self.sp = int(sp)
+
+    def transpile(self, program=None):
+        if program is None:
+            program = default_main_program()
+        if not any(op.type == 'flash_attention'
+                   for blk in program.blocks for op in blk.ops):
+            raise ValueError(
+                'no fused_attention ops in the program — sequence '
+                'parallelism distributes attention; build the model with '
+                'fluid.layers.fused_attention (or nets.sdpa)')
+        if getattr(program, '_pipeline_config', None) is not None or \
+                int((getattr(program, '_dist_config', None) or {})
+                    .get('pp_size') or 1) > 1:
+            raise ValueError(
+                'sequence parallelism does not compose with pipeline '
+                'parallelism: the pipeline region already runs inside a '
+                'shard_map and cannot nest the attention ring (see module '
+                'docstring)')
+        base = dict(getattr(program, '_dist_config', None) or {})
+        base['sp_size'] = self.sp
+        base.setdefault('sync_mode', True)
+        program._dist_config = base
+        program._dist_mesh = None  # force (re)build with the sp axis
+        program._bump_version()
+        return self

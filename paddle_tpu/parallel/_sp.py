@@ -9,11 +9,15 @@ def sp_shard_map(body, mesh, q, k, v, axis, key_bias, check_vma=True):
     """Wrap a per-shard attention body in shard_map with the sequence
     sharding contract; defaults a zero key bias. check_vma=False only for
     bodies containing pallas calls, whose ShapeDtypeStructs carry no
-    varying-mesh-axes info (the default check rejects them)."""
+    varying-mesh-axes info (the default check rejects them). When the mesh
+    also carries 'dp', the batch dim stays dp-sharded — each dp replica
+    runs its own sequence ring/all_to_all over its batch slice instead of
+    re-computing the global batch."""
     from jax import shard_map
 
-    qkv_spec = P(None, None, axis, None)
-    kb_spec = P(None, axis)
+    bdim = 'dp' if ('dp' in mesh.shape and axis != 'dp') else None
+    qkv_spec = P(bdim, None, axis, None)
+    kb_spec = P(bdim, axis)
     if key_bias is None:
         key_bias = jnp.zeros((q.shape[0], k.shape[2]), jnp.float32)
     fn = shard_map(body, mesh=mesh,
