@@ -544,9 +544,15 @@ def _flash_attention(ins, attrs, ctx):
                 'sequence parallelism: the sp mesh axis size %d must '
                 'divide the seq lens %d/%d'
                 % (sp, q.shape[2], k.shape[2]))
-        from ...parallel.ring_attention import ring_self_attention
-        out = ring_self_attention(mesh, q, k, v, axis='sp', key_bias=kb,
-                                  causal=causal, sm_scale=scale)
+        if attrs.get('sp_strategy', 'ring') == 'ulysses':
+            from ...parallel.ulysses import ulysses_self_attention
+            out = ulysses_self_attention(mesh, q, k, v, axis='sp',
+                                         key_bias=kb, causal=causal,
+                                         sm_scale=scale)
+        else:
+            from ...parallel.ring_attention import ring_self_attention
+            out = ring_self_attention(mesh, q, k, v, axis='sp', key_bias=kb,
+                                      causal=causal, sm_scale=scale)
     elif ctx.platform in ('tpu', 'axon'):
         out = tpu_ops.flash_attention(q, k, v, key_bias=kb, causal=causal,
                                       sm_scale=scale, interpret=False)

@@ -70,7 +70,11 @@ class DistributeTranspiler(object):
         self._startup_program = startup_program
         self._sync_mode = sync_mode
         self._pserver_endpoints = pserver_endpoints
-        program._dist_config = {
+        # MERGE into any existing annotation (SequenceParallelTranspiler /
+        # PipelineTranspiler may have run first — clobbering would silently
+        # drop their axes) and force the mesh to rebuild
+        base = dict(getattr(program, '_dist_config', None) or {})
+        base.update({
             'mesh_axes': ('dp',),
             'dp_size': trainers,
             'trainer_id': trainer_id,
@@ -81,7 +85,9 @@ class DistributeTranspiler(object):
                 slice_var_up and getattr(self._config, 'slice_var_up', True)),
             'shard_parameters': bool(
                 getattr(self._config, 'shard_parameters', False)),
-        }
+        })
+        program._dist_config = base
+        program._dist_mesh = None
         return self
 
     def get_trainer_program(self):

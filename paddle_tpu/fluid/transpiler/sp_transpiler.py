@@ -29,12 +29,23 @@ __all__ = ['SequenceParallelTranspiler']
 
 class SequenceParallelTranspiler(object):
     """The mesh axis is fixed to 'sp' — the fused_attention lowering routes
-    by that name (ops_impl/nn_ops.py)."""
+    by that name (ops_impl/nn_ops.py).
 
-    def __init__(self, sp):
+    strategy: 'ring' (ppermute ring, O(T/sp) keys per device — extreme
+        context) or 'ulysses' (two all_to_alls re-partitioning to head
+        sharding — needs heads % sp == 0 and full T on-device for scores;
+        cheaper comm when heads are plentiful). Stamped on each
+        fused_attention op, so the choice serializes with the program.
+    """
+
+    def __init__(self, sp, strategy='ring'):
         if int(sp) < 2:
             raise ValueError('sp must be >= 2, got %r' % (sp,))
+        if strategy not in ('ring', 'ulysses'):
+            raise ValueError("strategy must be 'ring' or 'ulysses', got %r"
+                             % (strategy,))
         self.sp = int(sp)
+        self.strategy = strategy
 
     def transpile(self, program=None):
         if program is None:
@@ -53,6 +64,10 @@ class SequenceParallelTranspiler(object):
                 'parallelism: the pipeline region already runs inside a '
                 'shard_map and cannot nest the attention ring (see module '
                 'docstring)')
+        for blk in program.blocks:
+            for op in blk.ops:
+                if op.type == 'flash_attention':
+                    op.attrs['sp_strategy'] = self.strategy
         base = dict(getattr(program, '_dist_config', None) or {})
         base['sp_size'] = self.sp
         base.setdefault('sync_mode', True)

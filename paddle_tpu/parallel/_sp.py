@@ -16,6 +16,12 @@ def sp_shard_map(body, mesh, q, k, v, axis, key_bias, check_vma=True):
     from jax import shard_map
 
     bdim = 'dp' if ('dp' in mesh.shape and axis != 'dp') else None
+    if bdim is not None and q.shape[0] % mesh.shape['dp']:
+        raise ValueError(
+            'sequence-parallel attention on a dp-carrying mesh: batch %d '
+            'must be divisible by dp=%d (drop the remainder, e.g. '
+            'paddle.batch(..., drop_last=True))'
+            % (q.shape[0], mesh.shape['dp']))
     qkv_spec = P(bdim, None, axis, None)
     kb_spec = P(bdim, axis)
     if key_bias is None:

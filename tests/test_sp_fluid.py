@@ -98,16 +98,51 @@ def test_sp_dp_composition_matches_single_device():
                 vocab, vocab, seq, n_layer=1, d_model=16, n_head=2,
                 d_inner=32, dropout_rate=0.0)
             fluid.optimizer.SGD(learning_rate=0.01).minimize(avg_cost)
-            if dist:
+            if dist == 'dp_first':
                 fluid.DistributeTranspiler().transpile(trainer_id=0,
                                                        trainers=2)
                 fluid.SequenceParallelTranspiler(sp=4).transpile(main)
+            elif dist == 'sp_first':   # reverse order must ALSO keep sp
+                fluid.SequenceParallelTranspiler(sp=4).transpile(main)
+                fluid.DistributeTranspiler().transpile(trainer_id=0,
+                                                       trainers=2)
+                assert main._dist_config.get('sp_size') == 4
             exe = fluid.Executor(fluid.CPUPlace())
             exe.run(startup)
             return [float(exe.run(main, feed=feed_ids,
                                   fetch_list=[avg_cost])[0])
                     for _ in range(2)]
 
-    seq_l = run(False)
-    par_l = run(True)
-    np.testing.assert_allclose(par_l, seq_l, rtol=2e-4)
+    seq_l = run(None)
+    np.testing.assert_allclose(run('dp_first'), seq_l, rtol=2e-4)
+    np.testing.assert_allclose(run('sp_first'), seq_l, rtol=2e-4)
+
+
+def test_sp_ulysses_strategy_matches_single_device():
+    from paddle_tpu.models import transformer as T
+    rng = np.random.RandomState(41)
+    vocab, seq, batch = 32, 16, 2
+    feed_ids = {n: rng.randint(1, vocab, size=(batch, seq)).astype('int64')
+                for n in ('src_word', 'trg_word', 'lbl_word')}
+
+    def run(strategy):
+        with fresh_program() as (main, startup):
+            # n_head=2 == sp so ulysses' head-divisibility holds
+            avg_cost, _, feeds = T.transformer(
+                vocab, vocab, seq, n_layer=1, d_model=16, n_head=2,
+                d_inner=32, dropout_rate=0.0)
+            fluid.optimizer.SGD(learning_rate=0.01).minimize(avg_cost)
+            if strategy:
+                fluid.SequenceParallelTranspiler(
+                    sp=2, strategy=strategy).transpile(main)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            return [float(exe.run(main, feed=feed_ids,
+                                  fetch_list=[avg_cost])[0])
+                    for _ in range(2)]
+
+    base = run(None)
+    np.testing.assert_allclose(run('ulysses'), base, rtol=2e-4)
+    np.testing.assert_allclose(run('ring'), base, rtol=2e-4)
+    with pytest.raises(ValueError, match='ring.*ulysses|ulysses.*ring'):
+        fluid.SequenceParallelTranspiler(sp=2, strategy='nope')
