@@ -45,3 +45,8 @@ def test_high_level_api_example(tmp_path):
     pred = _run_example('high_level_api',
                         ['--epochs', '4', '--save_dir', str(tmp_path)])
     assert np.isfinite(pred)
+
+
+def test_parallelism_example():
+    loss = _run_example('parallelism', ['--steps', '2'])
+    assert np.isfinite(loss)

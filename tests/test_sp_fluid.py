@@ -9,19 +9,23 @@ import paddle_tpu.fluid as fluid
 from util import fresh_program
 
 
-def _train_transformer(sp, steps=2):
+def _train_transformer(sp, steps=2, pp=False, amp=False, seed=21):
     from paddle_tpu.models import transformer as T
-    rng = np.random.RandomState(21)
+    rng = np.random.RandomState(seed)
     vocab, seq, batch = 32, 16, 4
     feed_ids = {n: rng.randint(1, vocab, size=(batch, seq)).astype('int64')
                 for n in ('src_word', 'trg_word', 'lbl_word')}
     with fresh_program() as (main, startup):
         avg_cost, _, feeds = T.transformer(
             vocab, vocab, seq, n_layer=2, d_model=16, n_head=2, d_inner=32,
-            dropout_rate=0.0)
+            dropout_rate=0.0, pp_decoder=pp)
         fluid.optimizer.SGD(learning_rate=0.01).minimize(avg_cost)
+        if pp:
+            fluid.PipelineTranspiler(n_micro=2).transpile(main)
         if sp:
             fluid.SequenceParallelTranspiler(sp=sp).transpile(main)
+        if amp:
+            fluid.amp.decorate_program(main)
         exe = fluid.Executor(fluid.CPUPlace())
         exe.run(startup)
         return [float(exe.run(main, feed=feed_ids,
@@ -146,3 +150,15 @@ def test_sp_ulysses_strategy_matches_single_device():
     np.testing.assert_allclose(run('ring'), base, rtol=2e-4)
     with pytest.raises(ValueError, match='ring.*ulysses|ulysses.*ring'):
         fluid.SequenceParallelTranspiler(sp=2, strategy='nope')
+
+
+def test_sp_and_pp_compose_with_amp():
+    """bf16 AMP through both new Program-level surfaces: the pipeline
+    carry and the ring merge keep consistent dtypes."""
+    base = _train_transformer(sp=0, amp=True, seed=51)
+    for kw in (dict(sp=0, pp=True), dict(sp=4)):
+        got = _train_transformer(amp=True, seed=51, **kw)
+        assert all(np.isfinite(got)), (kw, got)
+        # bf16 numerics: looser tolerance, but same trajectory
+        np.testing.assert_allclose(got, base, rtol=5e-2,
+                                   err_msg='amp %r' % kw)
