@@ -44,7 +44,7 @@ from .parallel_executor import ParallelExecutor, ExecutionStrategy, BuildStrateg
 from . import transpiler
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig, \
     InferenceTranspiler, PipelineTranspiler, SequenceParallelTranspiler, \
-    memory_optimize, release_memory
+    TensorParallelTranspiler, memory_optimize, release_memory
 from . import trainer
 from .trainer import Trainer, BeginEpochEvent, EndEpochEvent, \
     BeginStepEvent, EndStepEvent, CheckpointConfig

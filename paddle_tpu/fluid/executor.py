@@ -472,15 +472,18 @@ class Executor(object):
         pp = int(dist.get('pp_size') or 1)
         pp_axis = dist.get('pp_axis', 'pp')
         sp = int(dist.get('sp_size') or 1)
-        fixed = pp * sp   # stage/shard counts are structural, not capped
+        tp = int(dist.get('tp_size') or 1)
+        fixed = pp * sp * tp  # structural axis sizes are never capped
         if fixed > n_dev:
             raise RuntimeError(
-                'mesh needs pp=%d x sp=%d = %d devices but only %d are '
-                'visible' % (pp, sp, fixed, n_dev))
+                'mesh needs pp=%d x sp=%d x tp=%d = %d devices but only %d '
+                'are visible' % (pp, sp, tp, fixed, n_dev))
         dp = min(int(dist.get('dp_size') or 1), max(1, n_dev // fixed))
         axes = {}
         if dp > 1:
             axes['dp'] = dp
+        if tp > 1:
+            axes['tp'] = tp
         if pp > 1:
             axes[pp_axis] = pp
         if sp > 1:
@@ -498,14 +501,69 @@ class Executor(object):
         # replicating Adam state (2x the params) would silently forfeit
         # the memory scaling just asked for
         zero = dist.get('shard_optimizer_states', False) or fsdp
+        # tp: Megatron layouts from the program graph
+        # (TensorParallelTranspiler); accumulators inherit their master
+        # parameter's layout (names embed the param name, shapes match)
+        tp_specs = {}
+        if tp > 1:
+            import re as _re
+            rules = parallel.auto_tp_rules(program)
+            for name in persistable:
+                for pat, spec in rules:
+                    if _re.search(pat, name):
+                        tp_specs[name] = spec
+                        break
+            for name in acc_names & persistable:
+                if name in tp_specs:
+                    continue
+                av = scope.vars.get(name)
+                for pname, spec in list(tp_specs.items()):
+                    pv = scope.vars.get(pname)
+                    if (pname in name and av is not None and pv is not None
+                            and getattr(av, 'shape', None) == pv.shape):
+                        tp_specs[name] = spec
+                        break
+        import re as _re2
+        from jax.sharding import PartitionSpec as _P
+        has_dp = 'dp' in mesh.shape
+
+        def compose_dp(spec, v):
+            """Also shard a ZeRO-requested var over dp: put 'dp' on the
+            first dim the tp layout left whole (and that divides)."""
+            entries = list(tuple(spec)) + [None] * (v.ndim - len(tuple(spec)))
+            for i, e in enumerate(entries):
+                if e is None and v.shape[i] % mesh.shape['dp'] == 0:
+                    entries[i] = 'dp'
+                    return _P(*entries)
+            return None
+
         for name in persistable:
             v = scope.vars.get(name)
             if v is None or isinstance(v, SeqValue):
                 continue
-            if zero and name in acc_names:
+            if name in tp_specs:
+                spec = tp_specs[name]
+                wants_zero = has_dp and ((zero and name in acc_names)
+                                         or (fsdp and name not in acc_names))
+                if wants_zero:
+                    both = compose_dp(spec, v)
+                    if both is not None:
+                        spec = both
+                    else:
+                        import warnings
+                        warnings.warn(
+                            '%r keeps a tp-only layout %r (no remaining '
+                            'dim divides dp=%d); its dp ZeRO sharding is '
+                            'forfeited' % (name, spec, mesh.shape['dp']))
+                # single placement path shared with the functional API
+                # (device_put + warn-and-replicate on misfit)
+                scope.vars.update(parallel.shard_params_by_rules(
+                    {name: v}, mesh,
+                    [('^' + _re2.escape(name) + '$', spec)]))
+            elif has_dp and zero and name in acc_names:
                 scope.vars.update(parallel.shard_optimizer_states(
                     {name: v}, mesh))
-            elif fsdp and name not in acc_names:
+            elif has_dp and fsdp and name not in acc_names:
                 # ZeRO-3: the parameters themselves shard over dp (the
                 # reference's slice_var_up split param blocks across
                 # pservers; this is its GSPMD equivalent)

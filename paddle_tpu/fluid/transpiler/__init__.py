@@ -10,9 +10,10 @@ from .inference_transpiler import InferenceTranspiler
 from .memory_optimization_transpiler import memory_optimize, release_memory
 from .pipeline_transpiler import PipelineTranspiler
 from .sp_transpiler import SequenceParallelTranspiler
+from .tp_transpiler import TensorParallelTranspiler
 from .ps_dispatcher import HashName, RoundRobin
 
 __all__ = ['DistributeTranspiler', 'DistributeTranspilerConfig',
            'InferenceTranspiler', 'PipelineTranspiler',
-           'SequenceParallelTranspiler', 'memory_optimize',
-           'release_memory', 'HashName', 'RoundRobin']
+           'SequenceParallelTranspiler', 'TensorParallelTranspiler',
+           'memory_optimize', 'release_memory', 'HashName', 'RoundRobin']

@@ -290,6 +290,10 @@ class PipelineTranspiler(object):
             raise ValueError(
                 'pipeline parallelism does not compose with sequence '
                 'parallelism (see sp_transpiler.py docstring)')
+        if int(base.get('tp_size') or 1) > 1:
+            raise ValueError(
+                'pipeline parallelism does not compose with tensor '
+                'parallelism (see tp_transpiler.py docstring)')
         base['pp_size'] = S
         base['pp_axis'] = self.axis
         base.setdefault('sync_mode', True)

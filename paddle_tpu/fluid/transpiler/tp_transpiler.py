@@ -1,0 +1,53 @@
+"""Tensor-parallel transpiler: Megatron-style layouts from the Fluid API.
+
+TPU-first redesign of intra-layer model parallelism (the reference's only
+model-parallel lever was pserver slicing of large vars,
+transpiler/distribute_transpiler.py slice_var_up): annotate the program so
+the Executor builds a `tp` mesh axis and places every fc/embedding
+parameter by `parallel.auto_tp_rules` — the Megatron column/row
+alternation derived from the program graph. GSPMD partitions every matmul
+touching a sharded weight and inserts the all-reduces on ICI; the rules
+decide LAYOUT, never numerics, so tp == single-device exactly.
+
+    transformer(...); opt.minimize(cost)
+    fluid.TensorParallelTranspiler(tp=2).transpile(main_program)
+    exe.run(main_program, ...)        # fc/embedding weights sharded
+
+Composes with DistributeTranspiler (dp x tp — the classic 2D layout) and
+SequenceParallelTranspiler (sp rings gather the tp-sharded projections at
+the attention boundary). Does NOT compose with PipelineTranspiler: the
+pipeline's stacked stage parameters replicate within its shard_map, so the
+combination is rejected at transpile time.
+"""
+from ..framework import default_main_program
+
+__all__ = ['TensorParallelTranspiler']
+
+
+class TensorParallelTranspiler(object):
+    def __init__(self, tp):
+        if int(tp) < 2:
+            raise ValueError('tp must be >= 2, got %r' % (tp,))
+        self.tp = int(tp)
+
+    def transpile(self, program=None):
+        if program is None:
+            program = default_main_program()
+        from ...parallel.tp import auto_tp_rules
+        if not auto_tp_rules(program):
+            raise ValueError(
+                'no tensor-parallelizable parameters (fc/embedding) found '
+                'in the program')
+        base = dict(getattr(program, '_dist_config', None) or {})
+        if int(base.get('pp_size') or 1) > 1 or \
+                getattr(program, '_pipeline_config', None) is not None:
+            raise ValueError(
+                'tensor parallelism does not compose with pipeline '
+                'parallelism (stage parameters replicate inside the '
+                'pipeline shard_map; see module docstring)')
+        base['tp_size'] = self.tp
+        base.setdefault('sync_mode', True)
+        program._dist_config = base
+        program._dist_mesh = None  # force (re)build with the tp axis
+        program._bump_version()
+        return self
