@@ -21,7 +21,9 @@ import time
 import numpy as np
 
 BENCHMARK_MODELS = [
-    'machine_translation', 'resnet', 'vgg', 'mnist', 'stacked_dynamic_lstm'
+    'machine_translation', 'resnet', 'vgg', 'mnist', 'stacked_dynamic_lstm',
+    'transformer',   # TPU extension: the flagship fused-attention model,
+                     # the one --sp (sequence parallelism) applies to
 ]
 
 
@@ -54,6 +56,13 @@ def parse_args(argv=None):
                    choices=['local', 'pserver', 'nccl2'])
     p.add_argument('--no_random', action='store_true')
     p.add_argument('--use_inference_transpiler', action='store_true')
+    p.add_argument('--tp', type=int, default=1,
+                   help='tensor-parallel degree (TensorParallelTranspiler; '
+                        'Megatron layouts over a tp mesh axis)')
+    p.add_argument('--sp', type=int, default=1,
+                   help='sequence-parallel degree (SequenceParallel'
+                        'Transpiler; attention rides the ring — the model '
+                        'must use fused_attention)')
     return p.parse_args(argv)
 
 
@@ -79,6 +88,11 @@ def _build(args):
         loss, infer, train_r, test_r, feeding = machine_translation.get_model(
             batch_size=args.batch_size)
         acc = None
+    elif args.model == 'transformer':
+        from paddle_tpu.models import transformer
+        loss, tok, train_r, test_r, feeds = transformer.get_model(
+            batch_size=args.batch_size)
+        infer, acc = None, None
     else:
         loss, infer, train_r, test_r, acc = stacked_dynamic_lstm.get_model(
             batch_size=args.batch_size)
@@ -104,7 +118,9 @@ def _fake_batch(feed_vars, batch_size):
         for v in feed_vars:
             shape = [int(s) for s in v.shape[1:]]
             if 'int' in str(v.dtype):
-                row.append(np.zeros(shape or [1], dtype='int64'))
+                # ones, not zeros: id 0 is the pad token in the seq models,
+                # and an all-pad batch has zero loss weight (NaN loss)
+                row.append(np.ones(shape or [1], dtype='int64'))
             else:
                 row.append(rng.rand(*shape).astype('float32'))
         samples.append(tuple(row))
@@ -128,6 +144,19 @@ def run_benchmark(args):
             t.transpile(trainer_id=0, program=main, trainers=args.chips,
                         startup_program=startup)
             main = t.get_trainer_program()
+        if (args.tp > 1 or args.sp > 1) and args.chips > 1 \
+                and args.update_method == 'local':
+            raise ValueError(
+                '--tp/--sp with --chips > 1: use --update_method pserver '
+                '(DistributeTranspiler dp composes with tp/sp through the '
+                'Executor; the local ParallelExecutor builds its own '
+                'dp-only mesh)')
+        for prog in [main] + ([infer_prog] if infer_prog is not None
+                              else []):
+            if args.tp > 1:
+                fluid.TensorParallelTranspiler(tp=args.tp).transpile(prog)
+            if args.sp > 1:
+                fluid.SequenceParallelTranspiler(sp=args.sp).transpile(prog)
         if args.memory_optimize:
             fluid.memory_optimize(main)
         if args.infer_only and infer_prog is None:

@@ -16,9 +16,10 @@ def test_arg_surface_matches_reference():
                     '--update_method', 'pserver', '--no_random'])
     assert a.model == 'mnist' and a.chips == 2 and a.batch_size == 16
     assert a.update_method == 'pserver' and a.no_random
+    # the reference set, plus the TPU-extension transformer model
     assert set(BENCHMARK_MODELS) == {
         'machine_translation', 'resnet', 'vgg', 'mnist',
-        'stacked_dynamic_lstm'}
+        'stacked_dynamic_lstm', 'transformer'}
 
 
 def test_mnist_local_runs_and_learns():
@@ -72,3 +73,29 @@ def test_converter_leaves_default_program_untouched(tmp_path):
     before = fluid.default_main_program()
     rc.prepare_mnist(str(tmp_path), 8)
     assert fluid.default_main_program() is before
+
+
+def test_mnist_tensor_parallel_flag():
+    a = parse_args(['--model', 'mnist', '--iterations', '2',
+                    '--skip_batch_num', '1', '--batch_size', '32',
+                    '--device', 'CPU', '--no_test', '--tp', '2',
+                    '--use_fake_data'])
+    assert np.isfinite(run_benchmark(a))
+
+
+def test_transformer_model_with_sequence_parallel():
+    a = parse_args(['--model', 'transformer', '--iterations', '1',
+                    '--skip_batch_num', '0', '--batch_size', '4',
+                    '--device', 'CPU', '--no_test', '--sp', '2',
+                    '--use_fake_data'])
+    assert np.isfinite(run_benchmark(a))
+
+
+def test_tp_with_local_chips_rejected():
+    import pytest
+    a = parse_args(['--model', 'mnist', '--iterations', '1',
+                    '--skip_batch_num', '0', '--batch_size', '32',
+                    '--device', 'CPU', '--no_test', '--chips', '2',
+                    '--tp', '2', '--use_fake_data'])
+    with pytest.raises(ValueError, match='pserver'):
+        run_benchmark(a)
