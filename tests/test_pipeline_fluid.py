@@ -232,3 +232,32 @@ def test_pipeline_clone_and_inference_model_roundtrip(tmp_path):
         assert np.asarray(out).shape == (BATCH, 1)
         # and the mesh'd training program still runs after the load
         exe.run(main, feed={'x': xs, 'y': ys}, fetch_list=[cost])
+
+
+@pytest.mark.parametrize('order', ['dp_first', 'pp_first'])
+def test_pipeline_composes_with_dp(order):
+    """dp x pp: DistributeTranspiler + PipelineTranspiler in either
+    order — feeds shard over dp, each dp slice runs its own GPipe ring;
+    losses AND final parameters == sequential."""
+    seq_losses, seq_params = _train(transpile=False)
+    xs, ys = _data()
+    with fresh_program() as (main, startup):
+        cost, _ = _build()
+        params = [p.name for p in main.global_block().all_parameters()]
+        if order == 'dp_first':
+            fluid.DistributeTranspiler().transpile(trainer_id=0, trainers=2)
+            fluid.PipelineTranspiler(n_micro=NMICRO).transpile(main)
+        else:
+            fluid.PipelineTranspiler(n_micro=NMICRO).transpile(main)
+            fluid.DistributeTranspiler().transpile(trainer_id=0, trainers=2)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        losses = [float(exe.run(main, feed={'x': xs, 'y': ys},
+                                fetch_list=[cost])[0]) for _ in range(4)]
+        assert set(main._dist_mesh.shape) == {'dp', 'pp'}
+        finals = [np.asarray(v) for v in
+                  exe.run(main, feed={'x': xs, 'y': ys}, fetch_list=params)]
+    np.testing.assert_allclose(losses, seq_losses, rtol=1e-4)
+    for name, got in zip(params, finals):
+        np.testing.assert_allclose(got, seq_params[name], rtol=1e-4,
+                                   atol=1e-6, err_msg=name)
