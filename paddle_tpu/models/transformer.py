@@ -129,12 +129,28 @@ def transformer(src_vocab_size, trg_vocab_size, max_length, n_layer=6,
                 label_smooth_eps=0.1, pp_decoder=False):
     """Build the training graph; returns (avg_cost, token_count, feeds).
 
-    pp_decoder=True wraps each decoder layer in device_guard('pipe:k') so
-    PipelineTranspiler can run the decoder stack as a GPipe schedule over a
-    `pp` mesh axis (n_layer == number of stages); the encoder + embeddings
-    stay in the prologue and the enc output / pad biases become streamed
-    pipeline extras. Without transpiling, the stamps are inert."""
+    pp_decoder wraps the decoder layers in device_guard('pipe:k') so
+    PipelineTranspiler can run the decoder stack as a GPipe schedule over
+    a `pp` mesh axis. True stamps one stage per layer; an int S groups
+    n_layer into S equal multi-layer stages (n_layer % S == 0 — fewer
+    chips than layers, the standard GPipe packing; the stages stay
+    structurally identical so the transpiler's alignment holds). The
+    encoder + embeddings stay in the prologue and the enc output / pad
+    biases become streamed pipeline extras. Without transpiling, the
+    stamps are inert."""
     import contextlib
+    if pp_decoder and pp_decoder is not True:
+        if int(pp_decoder) < 2:
+            raise ValueError(
+                'pp_decoder stage count must be >= 2 (or True for one '
+                'stage per layer), got %r' % (pp_decoder,))
+        if n_layer % int(pp_decoder):
+            raise ValueError(
+                'pp_decoder=%d stages must divide n_layer=%d'
+                % (pp_decoder, n_layer))
+        layers_per_stage = n_layer // int(pp_decoder)
+    else:
+        layers_per_stage = 1
     src_word = layers.data(name='src_word', shape=[max_length],
                            dtype='int64')
     trg_word = layers.data(name='trg_word', shape=[max_length],
@@ -154,8 +170,8 @@ def transformer(src_vocab_size, trg_vocab_size, max_length, n_layer=6,
     dec = _embed(trg_word, trg_vocab_size, d_model, max_length,
                  dropout_rate, 'trg')
     for k in range(n_layer):
-        guard = (fluid.device_guard('pipe:%d' % k) if pp_decoder
-                 else contextlib.nullcontext())
+        guard = (fluid.device_guard('pipe:%d' % (k // layers_per_stage))
+                 if pp_decoder else contextlib.nullcontext())
         with guard:
             dec = decoder_layer(dec, enc, self_bias, src_bias, d_model,
                                 n_head, d_inner, dropout_rate)

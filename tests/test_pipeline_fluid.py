@@ -261,3 +261,40 @@ def test_pipeline_composes_with_dp(order):
     for name, got in zip(params, finals):
         np.testing.assert_allclose(got, seq_params[name], rtol=1e-4,
                                    atol=1e-6, err_msg=name)
+
+
+def test_pipeline_multi_layer_stages():
+    """4 decoder layers packed into 2 stages (pp_decoder=2): fewer chips
+    than layers, the standard GPipe packing — still == sequential."""
+    from paddle_tpu.models import transformer as T
+    rng = np.random.RandomState(91)
+    vocab, seq, batch = 32, 8, 4
+    feed_ids = {n: rng.randint(1, vocab, size=(batch, seq)).astype('int64')
+                for n in ('src_word', 'trg_word', 'lbl_word')}
+
+    def run(pp):
+        with fresh_program() as (main, startup):
+            avg_cost, _, feeds = T.transformer(
+                vocab, vocab, seq, n_layer=4, d_model=16, n_head=2,
+                d_inner=32, dropout_rate=0.0, pp_decoder=pp)
+            fluid.optimizer.SGD(learning_rate=0.01).minimize(avg_cost)
+            if pp:
+                fluid.PipelineTranspiler(n_micro=2).transpile(main)
+                assert main._pipeline_config['n_stages'] == 2
+                # 2 layers' worth of params per stage (4 fc in mha x2 +
+                # 2 ffn fc + 3 layer_norm scale/bias pairs, x2 layers)
+                assert len(main._pipeline_config['param_names'][0]) > 10
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            return [float(exe.run(main, feed=feed_ids,
+                                  fetch_list=[avg_cost])[0])
+                    for _ in range(2)]
+
+    base = run(False)
+    got = run(2)
+    assert base[0] != base[1]
+    np.testing.assert_allclose(got, base, rtol=2e-4)
+
+    with pytest.raises(ValueError, match='divide n_layer'):
+        T.transformer(32, 32, 8, n_layer=4, d_model=16, n_head=2,
+                      d_inner=32, pp_decoder=3)
