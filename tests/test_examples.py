@@ -19,6 +19,15 @@ def _run_example(mod_name, argv):
         return mod.main()
     finally:
         sys.argv = old_argv
+        # examples share this process's global scope/default programs;
+        # drop whatever state (incl. mesh-placed arrays) the script left
+        # so later tests' same-named vars don't collide with it. Never
+        # mask the example's own exception with a cleanup failure.
+        try:
+            import common
+            common.fresh_session()
+        except Exception:
+            pass
 
 
 def test_fit_a_line_example(tmp_path):
