@@ -113,7 +113,13 @@ class Trainer(object):
     """reference trainer.py:169."""
 
     def __init__(self, train_func, optimizer_func, param_path=None,
-                 place=None, parallel=False, checkpoint_config=None):
+                 place=None, parallel=False, checkpoint_config=None,
+                 transpiler_fn=None):
+        """transpiler_fn(train_program): optional hook applied after
+        minimize — the high-level entry for the Program transpilers, e.g.
+        lambda p: fluid.TensorParallelTranspiler(tp=2).transpile(p)
+        (or SequenceParallel/Pipeline; TPU extension, the reference's
+        Trainer had only the pserver path)."""
         self.__stop = False
         self.parallel = parallel
         self.trainer_id = 0
@@ -137,6 +143,24 @@ class Trainer(object):
                     raise TypeError(
                         "The optimizer should be an instance of Optimizer")
                 optimizer.minimize(loss)
+                if transpiler_fn is not None:
+                    if self.parallel:
+                        raise ValueError(
+                            'parallel=True builds its own dp-only mesh and '
+                            'would silently drop the transpiler_fn '
+                            'annotations; compose dp via '
+                            'fluid.DistributeTranspiler inside '
+                            'transpiler_fn instead')
+                    transpiler_fn(self.train_program)
+                    # the for_test clone was taken before the hook ran
+                    # (reference ordering); carry the mesh annotations over
+                    # so test() runs against the same mesh-placed scope
+                    dc = getattr(self.train_program, '_dist_config', None)
+                    if dc is not None:
+                        self.test_program._dist_config = dict(dc)
+                        self.test_program._dist_mesh = None
+                    self.train_program._retranspile_pipeline(
+                        self.test_program)
 
         self.place = check_and_get_place(place)
         self.exe = Executor(self.place)

@@ -190,3 +190,65 @@ def test_trainer_parallel_path():
     trainer.train(num_epochs=10, event_handler=handler, reader=_reader(),
                   feed_order=['x', 'y'])
     assert losses[-1] < losses[0]
+
+
+def test_trainer_transpiler_fn_hook():
+    """transpiler_fn: the Program transpilers from the high-level API —
+    a tp=2 trainer matches the plain one and actually shards weights."""
+    def train_func():
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        h = fluid.layers.fc(input=x, size=8, act='tanh')
+        pred = fluid.layers.fc(input=h, size=1, act=None)
+        return fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+
+    def run(hook):
+        losses = []
+
+        def handler(ev):
+            if isinstance(ev, fluid.EndStepEvent):
+                losses.append(float(np.asarray(ev.metrics[0])))
+
+        tr = fluid.Trainer(train_func=train_func,
+                           optimizer_func=_sgd, place=fluid.CPUPlace(),
+                           transpiler_fn=hook)
+        tr.train(num_epochs=10, event_handler=handler,
+                 reader=_reader(), feed_order=['x', 'y'])
+        sharded = any(
+            'tp' in str(v.sharding.spec)
+            for v in tr.scope.vars.values()
+            if hasattr(v, 'sharding')
+            and type(v.sharding).__name__ == 'NamedSharding')
+        return losses, sharded
+
+    base, _ = run(None)
+    tp, sharded = run(
+        lambda p: fluid.TensorParallelTranspiler(tp=2).transpile(p))
+    assert sharded   # the hidden fc weight [4, 8] really sharded over tp
+    assert base[0] != base[1]
+    np.testing.assert_allclose(tp, base, rtol=1e-4, atol=1e-6)
+
+
+def test_trainer_transpiler_fn_test_clone_and_parallel_guard():
+    def train_func():
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        h = fluid.layers.fc(input=x, size=8, act='tanh')
+        pred = fluid.layers.fc(input=h, size=1, act=None)
+        return fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+
+    hook = lambda p: fluid.TensorParallelTranspiler(tp=2).transpile(p)
+    tr = fluid.Trainer(train_func=train_func, optimizer_func=_sgd,
+                       place=fluid.CPUPlace(), transpiler_fn=hook)
+    tr.train(num_epochs=3, event_handler=lambda ev: None,
+             reader=_reader(), feed_order=['x', 'y'])
+    # the for_test clone must run on the same mesh as training
+    test_loss = tr.test(reader=_reader(seed=1), feed_order=['x', 'y'])
+    assert np.isfinite(float(np.asarray(test_loss[0])))
+
+    with pytest.raises(ValueError, match='parallel=True'):
+        fluid.Trainer(train_func=train_func, optimizer_func=_sgd,
+                      place=fluid.CPUPlace(), parallel=True,
+                      transpiler_fn=hook)
