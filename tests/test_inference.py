@@ -66,3 +66,33 @@ def test_compiled_artifact_sequence_model(tmp_path):
     run = inference.load_compiled(str(tmp_path))
     got, = run({'words': ids})
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_export_compiled_from_tp_transpiled_program(tmp_path):
+    """StableHLO export round-trips from a mesh-transpiled (tp=2) training
+    program: the pruned inference graph loads and runs frameworkless."""
+    from paddle_tpu import inference
+    with fresh_program() as (main, startup):
+        x = fluid.layers.data(name='x', shape=[8], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        h = layers.fc(input=x, size=8, act='tanh')
+        pred = layers.fc(input=h, size=1)
+        cost = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(cost)
+        fluid.TensorParallelTranspiler(tp=2).transpile(main)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        xs = np.random.RandomState(0).rand(4, 8).astype('float32')
+        exe.run(main, feed={'x': xs, 'y': np.zeros((4, 1), 'float32')},
+                fetch_list=[cost])
+        want, = exe.run(main.clone(for_test=True),
+                        feed={'x': xs, 'y': np.zeros((4, 1), 'float32')},
+                        fetch_list=[pred])
+        d = str(tmp_path / 'hlo')
+        inference.export_compiled(d, {'x': xs}, [pred], exe,
+                                  main_program=main)
+        fn = inference.load_compiled(d)
+        got = fn({'x': xs})
+    np.testing.assert_allclose(np.asarray(got[0]), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
