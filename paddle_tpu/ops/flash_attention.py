@@ -17,6 +17,13 @@ together these cover every mask the Transformer model builds
 (models/transformer.py _pad_mask_bias). Arbitrary [B,H,Tq,Tk] biases fall
 back to the XLA path in the op lowering (ops_impl/nn_ops.py).
 
+Causal self-attention (Tq == Tk, square blocks) runs on a LINEARIZED
+LOWER-TRIANGLE grid: scalar-prefetch index arrays enumerate only the
+(q-block, k-block) pairs on or below the diagonal, so blocks above it are
+never computed — causal forward+backward costs ~half the rectangular
+FLOPs. See the strategy note above _tri_maps for why this (and not
+compute predication) is the safe way to skip blocks under Mosaic.
+
 Off-TPU the kernels run under the pallas interpreter (slow; tests use tiny
 shapes) — the op lowering only routes here on real TPU backends.
 
@@ -43,25 +50,61 @@ def _round_up(x, m):
 
 
 # ---------------------------------------------------------------------------
-# forward kernel: grid (B, H, nq, nk), online softmax state in VMEM scratch
+# grid shapes. Two causal strategies:
+#   rectangular  — grid (B, H, nq, nk), every block computed, upper-triangle
+#                  blocks masked to NEG_BIG. Predicating the COMPUTE on the
+#                  grid position is NOT safe: it desynchronizes Mosaic's
+#                  block pipelining when a revisited input block's index map
+#                  depends on an outer grid dim (observed: batch>1 +
+#                  key-bias blocks read stale data).
+#   triangular   — grid (B, H, n_tri) where n_tri enumerates ONLY the
+#                  lower-triangle (q-block, k-block) pairs; the (i, j)
+#                  coordinates come from scalar-prefetch index arrays
+#                  (pltpu.PrefetchScalarGridSpec). Upper blocks are never in
+#                  the grid, so causal pays ~half the FLOPs, and every block
+#                  is visited exactly once — no predication, so the Mosaic
+#                  hazard above never arises. Used when Tq == Tk and
+#                  bq == bk (decoder self-attention); anything else falls
+#                  back to rectangular.
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, kb_ref, o_ref, lse_ref,
-                m_s, l_s, acc_s, *, scale, causal, block_q, block_k):
-    i, j = pl.program_id(2), pl.program_id(3)
-    nk = pl.num_programs(3)
 
-    @pl.when(j == 0)
+def _tri_maps(n):
+    """Row-major lower-triangle enumeration: (0,0),(1,0),(1,1),(2,0),...
+    Returns int32 (i_map, j_map) with j <= i, length n*(n+1)//2."""
+    import numpy as np
+    i = np.repeat(np.arange(n), np.arange(1, n + 1))
+    j = np.concatenate([np.arange(r + 1) for r in range(n)])
+    return i.astype(np.int32), j.astype(np.int32)
+
+
+def _tri_maps_kv(n):
+    """Lower-triangle enumeration ordered for the dk/dv kernel: k-block j
+    outer (visited last-to-first), its contributing q-blocks i = j..n-1
+    inner, so the (dk, dv) accumulator runs over consecutive steps."""
+    import numpy as np
+    ii, jj = [], []
+    for a in range(n):          # a = n-1-j
+        j = n - 1 - a
+        ii.append(np.arange(j, n))
+        jj.append(np.full(n - j, j))
+    return (np.concatenate(ii).astype(np.int32),
+            np.concatenate(jj).astype(np.int32))
+
+
+# ---------------------------------------------------------------------------
+# forward kernel body + rectangular/triangular wrappers
+# ---------------------------------------------------------------------------
+
+def _fwd_body(q_ref, k_ref, v_ref, kb_ref, o_ref, lse_ref,
+              m_s, l_s, acc_s, i, j, is_first, is_last, *,
+              scale, causal, block_q, block_k):
+    @pl.when(is_first)
     def _init():
         m_s[:] = jnp.full_like(m_s, -1e30)
         l_s[:] = jnp.zeros_like(l_s)
         acc_s[:] = jnp.zeros_like(acc_s)
 
-    # NOTE: blocks above the causal diagonal are NOT skipped — predicating
-    # the compute on the grid position desynchronizes Mosaic's block
-    # pipelining when a revisited input block's index map depends on an
-    # outer grid dim (observed: batch>1 + key-bias blocks read stale data).
-    # Masking alone keeps causal correctness.
     def _compute():
         q = q_ref[0, 0].astype(jnp.float32) * scale            # [bq, D]
         kb = k_ref[0, 0].astype(jnp.float32)                   # [bk, D]
@@ -87,7 +130,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kb_ref, o_ref, lse_ref,
 
     _compute()
 
-    @pl.when(j == nk - 1)
+    @pl.when(is_last)
     def _finish():
         m, l = m_s[:, 0], jnp.maximum(l_s[:, 0], 1e-30)
         o_ref[0, 0] = (acc_s[:] / l[:, None]).astype(o_ref.dtype)
@@ -95,16 +138,34 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kb_ref, o_ref, lse_ref,
                                          lse_ref.shape[2:])
 
 
+def _fwd_kernel(q_ref, k_ref, v_ref, kb_ref, o_ref, lse_ref,
+                m_s, l_s, acc_s, *, scale, causal, block_q, block_k):
+    i, j = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+    _fwd_body(q_ref, k_ref, v_ref, kb_ref, o_ref, lse_ref, m_s, l_s, acc_s,
+              i, j, j == 0, j == nk - 1,
+              scale=scale, causal=causal, block_q=block_q, block_k=block_k)
+
+
+def _fwd_kernel_tri(im_ref, jm_ref, q_ref, k_ref, v_ref, kb_ref,
+                    o_ref, lse_ref, m_s, l_s, acc_s, *,
+                    scale, block_q, block_k):
+    t = pl.program_id(2)
+    i, j = im_ref[t], jm_ref[t]
+    # j == 0 starts row i; j == i is the diagonal block, last for row i
+    _fwd_body(q_ref, k_ref, v_ref, kb_ref, o_ref, lse_ref, m_s, l_s, acc_s,
+              i, j, j == 0, j == i,
+              scale=scale, causal=True, block_q=block_q, block_k=block_k)
+
+
 # ---------------------------------------------------------------------------
 # backward kernels
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_s, *, scale, causal, block_q, block_k):
-    i, j = pl.program_id(2), pl.program_id(3)
-    nk = pl.num_programs(3)
-
-    @pl.when(j == 0)
+def _bwd_dq_body(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
+                 dq_ref, dq_s, i, j, is_first, is_last, *,
+                 scale, causal, block_q, block_k):
+    @pl.when(is_first)
     def _init():
         dq_s[:] = jnp.zeros_like(dq_s)
 
@@ -131,18 +192,34 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
 
     _compute()
 
-    @pl.when(j == nk - 1)
+    @pl.when(is_last)
     def _finish():
         dq_ref[0, 0] = dq_s[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_s, dv_s, *, scale, causal, block_q,
-                    block_k):
-    j, i = pl.program_id(2), pl.program_id(3)   # k block outer, q block inner
-    nq = pl.num_programs(3)
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_s, *, scale, causal, block_q, block_k):
+    i, j = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+    _bwd_dq_body(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
+                 dq_ref, dq_s, i, j, j == 0, j == nk - 1,
+                 scale=scale, causal=causal, block_q=block_q, block_k=block_k)
 
-    @pl.when(i == 0)
+
+def _bwd_dq_kernel_tri(im_ref, jm_ref, q_ref, k_ref, v_ref, kb_ref, do_ref,
+                       lse_ref, delta_ref, dq_ref, dq_s, *,
+                       scale, block_q, block_k):
+    t = pl.program_id(2)
+    i, j = im_ref[t], jm_ref[t]
+    _bwd_dq_body(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
+                 dq_ref, dq_s, i, j, j == 0, j == i,
+                 scale=scale, causal=True, block_q=block_q, block_k=block_k)
+
+
+def _bwd_dkv_body(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
+                  dk_ref, dv_ref, dk_s, dv_s, i, j, is_first, is_last, *,
+                  scale, causal, block_q, block_k):
+    @pl.when(is_first)
     def _init():
         dk_s[:] = jnp.zeros_like(dk_s)
         dv_s[:] = jnp.zeros_like(dv_s)
@@ -172,25 +249,99 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
 
     _compute()
 
-    @pl.when(i == nq - 1)
+    @pl.when(is_last)
     def _finish():
         dk_ref[0, 0] = dk_s[:].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_s[:].astype(dv_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_s, dv_s, *, scale, causal, block_q,
+                    block_k):
+    j, i = pl.program_id(2), pl.program_id(3)   # k block outer, q block inner
+    nq = pl.num_programs(3)
+    _bwd_dkv_body(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
+                  dk_ref, dv_ref, dk_s, dv_s, i, j, i == 0, i == nq - 1,
+                  scale=scale, causal=causal,
+                  block_q=block_q, block_k=block_k)
+
+
+def _bwd_dkv_kernel_tri(im_ref, jm_ref, q_ref, k_ref, v_ref, kb_ref, do_ref,
+                        lse_ref, delta_ref, dk_ref, dv_ref, dk_s, dv_s, *,
+                        scale, block_q, block_k, nq):
+    t = pl.program_id(2)
+    i, j = im_ref[t], jm_ref[t]
+    # contributing q-blocks for k-block j run i = j..nq-1 (tri_maps_kv
+    # order): the accumulator starts at the diagonal and ends at the last
+    # q-block
+    _bwd_dkv_body(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
+                  dk_ref, dv_ref, dk_s, dv_s, i, j, i == j, i == nq - 1,
+                  scale=scale, causal=True,
+                  block_q=block_q, block_k=block_k)
 
 
 # ---------------------------------------------------------------------------
 # pallas_call plumbing
 # ---------------------------------------------------------------------------
 
+def _use_tri(causal, Tq, Tk, bq, bk):
+    """Triangular (block-skipping) causal grid applies to the aligned
+    self-attention case; nq == 1 has no upper blocks to skip.
+    PADDLE_TPU_FLASH_TRI=0 forces the rectangular fallback (escape hatch
+    if a Mosaic version mishandles the scalar-prefetch grid on-chip)."""
+    import os
+    if os.environ.get('PADDLE_TPU_FLASH_TRI', '1') != '1':
+        return False
+    return causal and Tq == Tk and bq == bk and Tq // bq > 1
+
+
+def _tri_specs(bq, bk, D):
+    """Shared BlockSpecs for the triangular grids: q-row-indexed [bq, D]
+    blocks (q/do/dq), k-col-indexed [bk, D] blocks (k/v/dk/dv), the
+    [1, bk] key-bias block, and the q-row [bq, LANES] stats block
+    (lse/delta). One definition keeps the three pallas_calls in sync."""
+    qrow = pl.BlockSpec((1, 1, bq, D), lambda b, h, t, im, jm: (b, h, im[t], 0))
+    kcol = pl.BlockSpec((1, 1, bk, D), lambda b, h, t, im, jm: (b, h, jm[t], 0))
+    kbias = pl.BlockSpec((1, 1, bk), lambda b, h, t, im, jm: (b, 0, jm[t]))
+    stats = pl.BlockSpec((1, 1, bq, LANES),
+                         lambda b, h, t, im, jm: (b, h, im[t], 0))
+    return qrow, kcol, kbias, stats
+
+
 def _fwd_call(q, k, v, kb, causal, scale, bq, bk, interpret):
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
-    grid = (B, H, Tq // bq, Tk // bk)
+    out_shape = [
+        jax.ShapeDtypeStruct(q.shape, q.dtype),
+        jax.ShapeDtypeStruct((B, H, Tq, LANES), jnp.float32),
+    ]
+    scratch_shapes = [
+        pltpu.VMEM((bq, LANES), jnp.float32),
+        pltpu.VMEM((bq, LANES), jnp.float32),
+        pltpu.VMEM((bq, D), jnp.float32),
+    ]
+    if _use_tri(causal, Tq, Tk, bq, bk):
+        im, jm = _tri_maps(Tq // bq)
+        qrow, kcol, kbias, stats = _tri_specs(bq, bk, D)
+        kern = functools.partial(_fwd_kernel_tri, scale=scale,
+                                 block_q=bq, block_k=bk)
+        return pl.pallas_call(
+            kern,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(B, H, len(im)),
+                in_specs=[qrow, kcol, kcol, kbias],
+                out_specs=[qrow, stats],
+                scratch_shapes=scratch_shapes,
+            ),
+            out_shape=out_shape,
+            interpret=interpret,
+        )(jnp.asarray(im), jnp.asarray(jm), q, k, v, kb)
     kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                              block_q=bq, block_k=bk)
     return pl.pallas_call(
         kern,
-        grid=grid,
+        grid=(B, H, Tq // bq, Tk // bk),
         in_specs=[
             pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
             pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
@@ -201,22 +352,63 @@ def _fwd_call(q, k, v, kb, causal, scale, bq, bk, interpret):
             pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
             pl.BlockSpec((1, 1, bq, LANES), lambda b, h, i, j: (b, h, i, 0)),
         ],
-        out_shape=[
-            jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((B, H, Tq, LANES), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((bq, LANES), jnp.float32),
-            pltpu.VMEM((bq, LANES), jnp.float32),
-            pltpu.VMEM((bq, D), jnp.float32),
-        ],
+        out_shape=out_shape,
+        scratch_shapes=scratch_shapes,
         interpret=interpret,
     )(q, k, v, kb)
+
+
+def _bwd_call_tri(q, k, v, kb, do, lse, delta, scale, bq, bk, interpret):
+    """Causal backward over the linearized lower-triangle grid (see the
+    strategy note at the top): dq accumulates over a q-row's k-blocks, then
+    dk/dv re-walk the triangle k-block-major (_tri_maps_kv order)."""
+    B, H, Tq, D = q.shape
+    nq = Tq // bq
+    qrow, kcol, kbias, stats = _tri_specs(bq, bk, D)
+    bwd_in_specs = [qrow, kcol, kcol, kbias, qrow, stats, stats]
+    im, jm = _tri_maps(nq)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel_tri, scale=scale,
+                          block_q=bq, block_k=bk),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, H, len(im)),
+            in_specs=bwd_in_specs,
+            out_specs=qrow,
+            scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(jnp.asarray(im), jnp.asarray(jm), q, k, v, kb, do, lse, delta)
+    im2, jm2 = _tri_maps_kv(nq)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel_tri, scale=scale,
+                          block_q=bq, block_k=bk, nq=nq),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, H, len(im2)),
+            in_specs=bwd_in_specs,
+            out_specs=[kcol, kcol],
+            scratch_shapes=[
+                pltpu.VMEM((bk, D), jnp.float32),
+                pltpu.VMEM((bk, D), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        interpret=interpret,
+    )(jnp.asarray(im2), jnp.asarray(jm2), q, k, v, kb, do, lse, delta)
+    return dq, dk, dv
 
 
 def _bwd_call(q, k, v, kb, do, lse, delta, causal, scale, bq, bk, interpret):
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
+    if _use_tri(causal, Tq, Tk, bq, bk):
+        return _bwd_call_tri(q, k, v, kb, do, lse, delta, scale, bq, bk,
+                             interpret)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=bq, block_k=bk),

@@ -254,3 +254,50 @@ def test_ring_attention_flash_impl_matches_dense_and_full():
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=5e-4, atol=5e-4,
                                        err_msg='causal=%s %s' % (causal, name))
+
+
+def test_tri_maps_enumerate_lower_triangle():
+    from paddle_tpu.ops.flash_attention import (_tri_maps, _tri_maps_kv,
+                                                _use_tri)
+    for n in (1, 2, 3, 5):
+        im, jm = _tri_maps(n)
+        assert len(im) == n * (n + 1) // 2
+        assert set(zip(im.tolist(), jm.tolist())) == {
+            (i, j) for i in range(n) for j in range(i + 1)}
+        # row-major: q-block index non-decreasing, each row starts at j=0
+        assert all(im[t] <= im[t + 1] for t in range(len(im) - 1))
+        im2, jm2 = _tri_maps_kv(n)
+        assert set(zip(im2.tolist(), jm2.tolist())) == {
+            (i, j) for i in range(n) for j in range(i + 1)}
+        # k-block-major: within a k-block, q runs j..n-1 consecutively
+        starts = [t for t in range(len(im2)) if im2[t] == jm2[t]]
+        assert len(starts) == n
+    # selection predicate: aligned causal self-attention only
+    assert _use_tri(True, 256, 256, 128, 128)
+    assert not _use_tri(False, 256, 256, 128, 128)   # not causal
+    assert not _use_tri(True, 256, 512, 128, 128)    # cross lengths
+    assert not _use_tri(True, 256, 256, 128, 64)     # uneven blocks
+    assert not _use_tri(True, 128, 128, 128, 128)    # single block
+
+
+def test_causal_triangular_grid_3x3_forward_and_grads():
+    """3x3-block causal triangle (T=384, bq=bk=128): the scalar-prefetch
+    grid must agree with the XLA oracle through forward and backward."""
+    q, k, v, kb = _rand_qkv(B=2, H=1, Tq=384, Tk=384, D=16, seed=11)
+    got = ops.flash_attention(q, k, v, key_bias=kb, causal=True,
+                              interpret=True)
+    want = ops.reference_attention(q, k, v, key_bias=kb, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    def mk(fn):
+        def g(q, k, v):
+            o = fn(q, k, v, key_bias=kb, causal=True)
+            return jnp.sum(o * jnp.sin(o))
+        return jax.grad(g, argnums=(0, 1, 2))
+
+    g1 = mk(lambda *a, **kw: ops.flash_attention(*a, interpret=True, **kw))(q, k, v)
+    g2 = mk(ops.reference_attention)(q, k, v)
+    for a, b, name in zip(g1, g2, 'qkv'):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-4, err_msg=name)
