@@ -27,6 +27,12 @@ from . import ops_impl  # noqa: F401  (registers all rules)
 from .framework import default_main_program, Program
 from .lowering import SeqValue, Ctx
 
+# ZeRO floor (elements): tensors smaller than this keep their tp-only
+# layout instead of ('tp','dp')-product sharding — mirrors
+# parallel.fsdp_shard_params(min_size=1024). Tests lower it to exercise
+# the product path on tiny models.
+_ZERO_MIN_SIZE = 1024
+
 __all__ = ['Executor', 'global_scope', 'scope_guard', '_switch_scope', 'Scope']
 
 
@@ -529,11 +535,25 @@ class Executor(object):
 
         def compose_dp(spec, v):
             """Also shard a ZeRO-requested var over dp: put 'dp' on the
-            first dim the tp layout left whole (and that divides)."""
+            first dim the tp layout left whole (and that divides). When no
+            free dim divides dp (typically 1-D biases / their moments,
+            whose only dim 'tp' took), shard a tp-taken dim over the
+            ('tp', 'dp') PRODUCT instead — each device then holds
+            size/(tp*dp) elements, the full ZeRO scaling. Tensors under
+            _ZERO_MIN_SIZE elements keep their tp-only layout: like
+            fsdp_shard_params' min_size floor, the gather latency on a
+            tiny tensor outweighs the bytes saved."""
             entries = list(tuple(spec)) + [None] * (v.ndim - len(tuple(spec)))
             for i, e in enumerate(entries):
                 if e is None and v.shape[i] % mesh.shape['dp'] == 0:
                     entries[i] = 'dp'
+                    return _P(*entries)
+            if v.size < _ZERO_MIN_SIZE:
+                return _P(*entries)   # keep the tp-only layout, no warning
+            prod = mesh.shape['tp'] * mesh.shape['dp']
+            for i, e in enumerate(entries):
+                if e == 'tp' and v.shape[i] % prod == 0:
+                    entries[i] = ('tp', 'dp')
                     return _P(*entries)
             return None
 
