@@ -539,10 +539,11 @@ class Executor(object):
             free dim divides dp (typically 1-D biases / their moments,
             whose only dim 'tp' took), shard a tp-taken dim over the
             ('tp', 'dp') PRODUCT instead — each device then holds
-            size/(tp*dp) elements, the full ZeRO scaling. Tensors under
-            _ZERO_MIN_SIZE elements keep their tp-only layout: like
-            fsdp_shard_params' min_size floor, the gather latency on a
-            tiny tensor outweighs the bytes saved."""
+            size/(tp*dp) elements, the full ZeRO scaling. The product
+            path (only) floors at _ZERO_MIN_SIZE elements, mirroring
+            fsdp_shard_params' min_size rationale: gather latency on a
+            tiny tensor outweighs the bytes saved. The free-dim 'dp'
+            path above keeps its historical no-floor behavior."""
             entries = list(tuple(spec)) + [None] * (v.ndim - len(tuple(spec)))
             for i, e in enumerate(entries):
                 if e is None and v.shape[i] % mesh.shape['dp'] == 0:

@@ -2,7 +2,7 @@
 import itertools
 import random
 from queue import Queue
-from threading import Thread
+from threading import Condition, Thread
 
 __all__ = [
     'map_readers', 'buffered', 'compose', 'chain', 'shuffle',
@@ -24,19 +24,17 @@ def map_readers(func, *readers):
 
 
 def shuffle(reader, buf_size):
+    """Block shuffle: consume the stream in blocks of up to buf_size
+    samples and yield each block in random order. buf_size >= dataset
+    size gives a full shuffle; smaller sizes trade memory for locality."""
     def data_reader():
-        buf = []
-        for e in reader():
-            buf.append(e)
-            if len(buf) >= buf_size:
-                random.shuffle(buf)
-                for b in buf:
-                    yield b
-                buf = []
-        if len(buf) > 0:
-            random.shuffle(buf)
-            for b in buf:
-                yield b
+        it = iter(reader())
+        while True:
+            block = list(itertools.islice(it, buf_size))
+            if not block:
+                return
+            random.shuffle(block)
+            yield from block
     return data_reader
 
 
@@ -53,50 +51,52 @@ class ComposeNotAligned(ValueError):
 
 
 def compose(*readers, **kwargs):
+    """Zip several readers into one, concatenating their samples into a
+    flat tuple per step. With check_alignment (default), a reader ending
+    before the others raises ComposeNotAligned; without it, the stream
+    silently stops at the shortest reader."""
     check_alignment = kwargs.pop('check_alignment', True)
+    _missing = object()
 
     def make_tuple(x):
-        if isinstance(x, tuple):
-            return x
-        else:
-            return (x,)
+        return x if isinstance(x, tuple) else (x,)
 
     def reader():
         rs = [r() for r in readers]
         if not check_alignment:
             for outputs in zip(*rs):
-                yield sum(list(map(make_tuple, outputs)), ())
-        else:
-            for outputs in zip(*rs):
-                lens = set(map(len, outputs)) if all(
-                    isinstance(o, tuple) for o in outputs) else None
-                yield sum(list(map(make_tuple, outputs)), ())
+                yield sum(map(make_tuple, outputs), ())
+            return
+        for outputs in itertools.zip_longest(*rs, fillvalue=_missing):
+            if any(o is _missing for o in outputs):
+                raise ComposeNotAligned(
+                    "outputs of composed readers are not aligned: one "
+                    "reader ended before the others")
+            yield sum(map(make_tuple, outputs), ())
     return reader
 
 
 def buffered(reader, size):
-    """Prefetch up to `size` samples in a background thread."""
-
-    class EndSignal():
-        pass
-
-    end = EndSignal()
-
-    def read_worker(r, q):
-        for d in r:
-            q.put(d)
-        q.put(end)
-
+    """Decouple production from consumption: a daemon thread runs the
+    source reader up to `size` samples ahead of the consumer."""
     def data_reader():
-        r = reader()
+        done = object()
         q = Queue(maxsize=size)
-        t = Thread(target=read_worker, args=(r, q))
-        t.daemon = True
-        t.start()
-        e = q.get()
-        while e is not end:
-            yield e
-            e = q.get()
+        failure = []
+
+        def pump():
+            try:
+                for sample in reader():
+                    q.put(sample)
+            except BaseException as e:   # re-raised at the consumer
+                failure.append(e)
+            finally:
+                q.put(done)
+
+        Thread(target=pump, daemon=True).start()
+        yield from iter(q.get, done)
+        if failure:
+            raise failure[0]
     return data_reader
 
 
@@ -110,76 +110,84 @@ def firstn(reader, n):
 
 
 class XmapEndSignal():
-    pass
+    """Kept for API compat with code that imported it; the pool below uses
+    private sentinels."""
 
 
 def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
-    """Parallel map over a reader with worker threads (reference
-    decorator.py:xmap_readers)."""
-    end = XmapEndSignal()
+    """Thread-pool map over a reader (API parity with the reference's
+    xmap_readers; the pool itself is a from-scratch design).
 
-    def read_worker(reader, in_queue):
-        for i in reader():
-            in_queue.put(i)
-        in_queue.put(end)
-
-    def order_read_worker(reader, in_queue):
-        in_order = 0
-        for i in reader():
-            in_queue.put((in_order, i))
-            in_order += 1
-        in_queue.put(end)
-
-    def handle_worker(in_queue, out_queue, mapper):
-        sample = in_queue.get()
-        while not isinstance(sample, XmapEndSignal):
-            r = mapper(sample)
-            out_queue.put(r)
-            sample = in_queue.get()
-        in_queue.put(end)
-        out_queue.put(end)
-
-    def order_handle_worker(in_queue, out_queue, mapper, out_order):
-        ins = in_queue.get()
-        while not isinstance(ins, XmapEndSignal):
-            order, sample = ins
-            r = mapper(sample)
-            while order != out_order[0]:
-                pass
-            out_queue.put(r)
-            out_order[0] += 1
-            ins = in_queue.get()
-        in_queue.put(end)
-        out_queue.put(end)
-
+    A feeder thread enumerates the source into a bounded feed queue as
+    (seq, sample); process_num workers apply `mapper` concurrently and
+    push results to a bounded output queue. With order=True a Condition
+    gates each push until the worker's seq is next — workers sleep on the
+    condition rather than spinning, so a slow mapper never busy-waits the
+    (single-core) host. A mapper exception is forwarded to the consumer
+    and re-raised there instead of hanging the stream."""
     def xreader():
-        in_queue = Queue(buffer_size)
-        out_queue = Queue(buffer_size)
-        out_order = [0]
-        target = order_read_worker if order else read_worker
-        t = Thread(target=target, args=(reader, in_queue))
-        t.daemon = True
-        t.start()
-        target = order_handle_worker if order else handle_worker
-        args = (in_queue, out_queue, mapper, out_order) if order else (
-            in_queue, out_queue, mapper)
-        workers = []
-        for i in range(process_num):
-            worker = Thread(target=target, args=args)
-            worker.daemon = True
-            workers.append(worker)
-        for w in workers:
-            w.start()
-        # drain until EVERY worker has signalled end — each worker enqueues
-        # all of its samples before its end signal, so counting all
-        # process_num ends guarantees no tail sample is dropped
+        stop = object()
+        feed_q = Queue(buffer_size)
+        out_q = Queue(buffer_size)
+        turn = Condition()
+        state = {'next_seq': 0, 'error': None}
+
+        def feeder():
+            try:
+                for item in enumerate(reader()):
+                    feed_q.put(item)
+            except BaseException as e:   # source errors forward too
+                with turn:
+                    state['error'] = e
+                    turn.notify_all()
+            finally:
+                for _ in range(process_num):
+                    feed_q.put(stop)
+
+        def worker():
+            while True:
+                item = feed_q.get()
+                if item is stop:
+                    out_q.put(stop)
+                    return
+                seq, sample = item
+                try:
+                    result = mapper(sample)
+                except BaseException as e:   # forwarded, not swallowed
+                    with turn:
+                        state['error'] = e
+                        turn.notify_all()
+                    out_q.put(stop)
+                    return
+                if order:
+                    with turn:
+                        turn.wait_for(
+                            lambda: state['next_seq'] == seq
+                            or state['error'] is not None)
+                        if state['error'] is not None:
+                            out_q.put(stop)
+                            return
+                        out_q.put(result)
+                        state['next_seq'] += 1
+                        turn.notify_all()
+                else:
+                    out_q.put(result)
+
+        Thread(target=feeder, daemon=True).start()
+        for _ in range(process_num):
+            Thread(target=worker, daemon=True).start()
+
+        # every worker flushes its results before its stop marker, so the
+        # stream is complete once all process_num markers are seen
         finished = 0
         while finished < process_num:
-            sample = out_queue.get()
-            if isinstance(sample, XmapEndSignal):
+            item = out_q.get()
+            if item is stop:
                 finished += 1
+                if state['error'] is not None:
+                    raise state['error']
             else:
-                yield sample
+                yield item
     return xreader
 
 

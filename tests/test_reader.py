@@ -171,3 +171,56 @@ def test_decorator_module_alias():
     from paddle_tpu.reader import decorator
     for name in decorator.__all__:
         assert getattr(decorator, name) is getattr(paddle.reader, name)
+
+
+def test_compose_misaligned_raises():
+    import pytest
+    c = reader.compose(_ints(3), _ints(5))
+    with pytest.raises(reader.ComposeNotAligned):
+        list(c())
+    # without the check the stream just stops at the shortest reader
+    c2 = reader.compose(_ints(3), _ints(5), check_alignment=False)
+    assert len(list(c2())) == 3
+
+
+def test_xmap_ordered_under_jitter():
+    """Ordering must hold even when later samples finish mapping first."""
+    import time, random as _r
+    rng = _r.Random(0)
+
+    def slow_sq(x, _rng=rng):
+        time.sleep(_rng.uniform(0, 0.005))
+        return x * x
+
+    got = list(reader.xmap_readers(slow_sq, _ints(60), 4, 8, order=True)())
+    assert got == [i * i for i in range(60)]
+
+
+def test_xmap_mapper_exception_propagates():
+    import pytest
+
+    def bad(x):
+        if x == 7:
+            raise RuntimeError('mapper blew up on 7')
+        return x
+
+    for order in (False, True):
+        with pytest.raises(RuntimeError, match='blew up'):
+            list(reader.xmap_readers(bad, _ints(30), 3, 4, order=order)())
+
+
+def test_source_reader_exception_propagates():
+    """Errors in the SOURCE reader (not just the mapper) must surface at
+    the consumer instead of truncating the stream to a silent EOF."""
+    import pytest
+
+    def broken():
+        yield from range(5)
+        raise IOError('shard corrupt')
+
+    with pytest.raises(IOError, match='shard corrupt'):
+        list(reader.buffered(broken, size=2)())
+    for order in (False, True):
+        with pytest.raises(IOError, match='shard corrupt'):
+            list(reader.xmap_readers(lambda x: x, broken, 2, 4,
+                                     order=order)())
