@@ -75,7 +75,6 @@ class DistributeTranspiler(object):
         # drop their axes) and force the mesh to rebuild
         base = dict(getattr(program, '_dist_config', None) or {})
         base.update({
-            'mesh_axes': ('dp',),
             'dp_size': trainers,
             'trainer_id': trainer_id,
             'sync_mode': sync_mode,
@@ -86,6 +85,14 @@ class DistributeTranspiler(object):
             'shard_parameters': bool(
                 getattr(self._config, 'shard_parameters', False)),
         })
+        # recompute from the MERGED sizes (executor order dp/tp/pp/sp) so
+        # an earlier pipeline/sp/tp transpile keeps its axis in the
+        # annotation instead of being clobbered to a dp-only claim; the
+        # pipeline axis keeps its configured name (pp_axis may be custom)
+        pp_ax = base.get('pp_axis', 'pp')
+        base['mesh_axes'] = tuple(
+            (pp_ax if ax == 'pp' else ax) for ax in ('dp', 'tp', 'pp', 'sp')
+            if int(base.get(ax + '_size') or 1) > 1)
         program._dist_config = base
         program._dist_mesh = None
         return self

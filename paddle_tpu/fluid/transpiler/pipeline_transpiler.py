@@ -72,6 +72,18 @@ class PipelineTranspiler(object):
     def transpile(self, program=None):
         if program is None:
             program = default_main_program()
+        # composition checks FIRST: they read only _dist_config, so a
+        # rejected transpile is O(1) and leaves the program unmodified
+        # (no stale _pipeline_config for clone() to silently re-run)
+        base = dict(getattr(program, '_dist_config', None) or {})
+        if int(base.get('sp_size') or 1) > 1:
+            raise ValueError(
+                'pipeline parallelism does not compose with sequence '
+                'parallelism (see sp_transpiler.py docstring)')
+        if int(base.get('tp_size') or 1) > 1:
+            raise ValueError(
+                'pipeline parallelism does not compose with tensor '
+                'parallelism (see tp_transpiler.py docstring)')
         block = program.global_block()
         ops = block.ops
 
@@ -126,6 +138,20 @@ class PipelineTranspiler(object):
                         'attrs of op %d (%s) differ between stage 0 and '
                         'stage %d — stages must be structurally identical'
                         % (j, a.type, s))
+                # slot SETS must match exactly: the executor replays stage
+                # 0's op list for every stage, so an optional input/output
+                # present only in a later stage would be silently dropped
+                if sorted(a.inputs) != sorted(b.inputs):
+                    raise ValueError(
+                        'input slots of op %d (%s) differ between stage 0 '
+                        '%r and stage %d %r'
+                        % (j, a.type, sorted(a.inputs), s, sorted(b.inputs)))
+                if sorted(a.outputs) != sorted(b.outputs):
+                    raise ValueError(
+                        'output slots of op %d (%s) differ between stage 0 '
+                        '%r and stage %d %r'
+                        % (j, a.type, sorted(a.outputs), s,
+                           sorted(b.outputs)))
 
         # ------------------------------------------------------------------
         # classify inputs by aligning each adjacent stage pair
@@ -260,6 +286,14 @@ class PipelineTranspiler(object):
                 'pipeline stages must preserve the activation shape: input '
                 '%r %r vs output %r %r' % (input_var, in_v.shape,
                                            output_var, out_v.shape))
+        if (in_v.dtype is not None and out_v.dtype is not None
+                and in_v.dtype != out_v.dtype):
+            # catch AMP-boundary mismatches here, not as an opaque
+            # lax.scan carry error at trace time
+            raise ValueError(
+                'pipeline stages must preserve the activation dtype: input '
+                '%r %r vs output %r %r' % (input_var, in_v.dtype,
+                                           output_var, out_v.dtype))
 
         # batch-aligned extras (leading dynamic dim: pad-mask biases, a
         # pipelined decoder's encoder output) are streamed per-microbatch;
@@ -285,20 +319,13 @@ class PipelineTranspiler(object):
             'extra_stream_names': stream,
             'extra_names': static,
         }
-        base = dict(getattr(program, '_dist_config', None) or {})
-        if int(base.get('sp_size') or 1) > 1:
-            raise ValueError(
-                'pipeline parallelism does not compose with sequence '
-                'parallelism (see sp_transpiler.py docstring)')
-        if int(base.get('tp_size') or 1) > 1:
-            raise ValueError(
-                'pipeline parallelism does not compose with tensor '
-                'parallelism (see tp_transpiler.py docstring)')
         base['pp_size'] = S
         base['pp_axis'] = self.axis
         base.setdefault('sync_mode', True)
+        # annotation uses the ACTUAL axis names the executor will build
+        # (a custom pipeline axis keeps its name, not the literal 'pp')
         base['mesh_axes'] = tuple(
-            ax for ax in ('dp', 'pp')
+            (self.axis if ax == 'pp' else ax) for ax in ('dp', 'pp')
             if int(base.get(ax + '_size') or 1) > 1)
         program._dist_config = base
         program._dist_mesh = None  # force (re)build with the pp axis

@@ -31,7 +31,11 @@ __all__ = ['LocalSGD']
 
 
 def _leaf_spec(x, axis):
-    """Shard the leading (replica) axis; everything else stays local."""
+    """Shard the leading (replica) axis; everything else stays local.
+    0-d leaves (scalar step counts, temperatures) have no leading dim to
+    split — they replicate to every replica."""
+    if jnp.ndim(x) == 0:
+        return P()
     return P(axis, *([None] * (jnp.ndim(x) - 1)))
 
 

@@ -306,3 +306,25 @@ def test_local_sgd_matches_numpy_simulation():
             per.append(2.0 / bl * x.T @ (x @ ref - y))
         ref = ref - lr * np.mean(per, axis=0)
     np.testing.assert_allclose(np.asarray(p1['w'])[0], ref, rtol=2e-5)
+
+
+def test_local_sgd_scalar_batch_leaf_replicates():
+    """A 0-d batch leaf (scalar temperature/step) has no leading dim to
+    split — it must replicate instead of producing an invalid spec."""
+    n, bl, d = 4, 2, 3
+    mesh = parallel.make_mesh({'dp': n})
+    rng = np.random.RandomState(1)
+
+    def step_fn(params, batch):
+        x, temp = batch['x'], batch['temp']
+        return {'w': params['w'] + temp * x.sum()}, temp
+
+    ls = parallel.LocalSGD(step_fn, mesh, axis='dp', sync_steps=2)
+    params = ls.replicate({'w': np.zeros(d, 'float32')})
+    batch = ls.shard_batch({
+        'x': rng.rand(n * bl, d).astype('float32'),
+        'temp': np.float32(0.5),
+    })
+    params, aux = ls.step(params, batch)
+    assert np.allclose(np.asarray(aux), 0.5)   # every replica saw it
+    assert np.isfinite(np.asarray(params['w'])).all()

@@ -298,3 +298,70 @@ def test_pipeline_multi_layer_stages():
     with pytest.raises(ValueError, match='divide n_layer'):
         T.transformer(32, 32, 8, n_layer=4, d_model=16, n_head=2,
                       d_inner=32, pp_decoder=3)
+
+
+def test_rejected_transpile_leaves_program_unmodified():
+    """A pp-on-sp/tp rejection must not leave a stale _pipeline_config
+    behind (clone()'s _retranspile_pipeline would silently re-run it)."""
+    with fresh_program() as (main, startup):
+        _build()
+        main._dist_config = {'sp_size': 2, 'mesh_axes': ('sp',)}
+        with pytest.raises(ValueError, match='does not compose'):
+            fluid.PipelineTranspiler(n_micro=2).transpile(main)
+        assert getattr(main, '_pipeline_config', None) is None
+        assert 'pp_size' not in main._dist_config
+
+
+def test_pipeline_rejects_extra_slot_in_later_stage():
+    """The executor replays stage 0's op list for every stage: an extra
+    input/output slot present only in a later stage must be rejected, not
+    silently dropped."""
+    with fresh_program() as (main, startup):
+        x = fluid.layers.data(name='x', shape=[D], dtype='float32')
+        h = layers.fc(input=x, size=D)
+        blk = main.global_block()
+        bonus = blk.create_var(name='bonus', shape=[-1, D], dtype='float32')
+        s0 = blk.create_var(name='s0_out', shape=[-1, D], dtype='float32')
+        s1 = blk.create_var(name='s1_out', shape=[-1, D], dtype='float32')
+        with fluid.device_guard('pipe:0'):
+            blk.append_op(type='scale', inputs={'X': [h]},
+                          outputs={'Out': [s0]}, attrs={'scale': 2.0})
+        with fluid.device_guard('pipe:1'):
+            blk.append_op(type='scale', inputs={'X': [s0], 'Bonus': [bonus]},
+                          outputs={'Out': [s1]}, attrs={'scale': 2.0})
+        with pytest.raises(ValueError, match='input slots'):
+            fluid.PipelineTranspiler(n_micro=2).transpile(main)
+
+
+def test_pipeline_rejects_dtype_changing_region():
+    """Boundary dtype mismatch surfaces as a transpile-time error, not an
+    opaque lax.scan carry mismatch (AMP-boundary case)."""
+    with fresh_program() as (main, startup):
+        x = fluid.layers.data(name='x', shape=[D], dtype='float32')
+        h = layers.fc(input=x, size=D)
+        blk = main.global_block()
+        s0 = blk.create_var(name='s0_outb', shape=[-1, D], dtype='bfloat16')
+        s1 = blk.create_var(name='s1_outb', shape=[-1, D], dtype='bfloat16')
+        # infer_shape=False keeps the declared bf16 outputs (the dtype
+        # mismatch an AMP pass would introduce at the region boundary)
+        with fluid.device_guard('pipe:0'):
+            blk.append_op(type='scale', inputs={'X': [h]},
+                          outputs={'Out': [s0]}, attrs={'scale': 2.0},
+                          infer_shape=False)
+        with fluid.device_guard('pipe:1'):
+            blk.append_op(type='scale', inputs={'X': [s0]},
+                          outputs={'Out': [s1]}, attrs={'scale': 2.0},
+                          infer_shape=False)
+        with pytest.raises(ValueError, match='activation dtype'):
+            fluid.PipelineTranspiler(n_micro=2).transpile(main)
+
+
+def test_distribute_after_pipeline_keeps_pp_in_mesh_axes():
+    """DistributeTranspiler run AFTER PipelineTranspiler must recompute
+    mesh_axes from the merged sizes, not claim a dp-only mesh."""
+    with fresh_program() as (main, startup):
+        _build()
+        fluid.PipelineTranspiler(n_micro=NMICRO).transpile(main)
+        fluid.DistributeTranspiler().transpile(
+            trainer_id=0, trainers=2, program=main)
+        assert main._dist_config['mesh_axes'] == ('dp', 'pp')
