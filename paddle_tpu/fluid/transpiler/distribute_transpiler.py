@@ -85,14 +85,11 @@ class DistributeTranspiler(object):
             'shard_parameters': bool(
                 getattr(self._config, 'shard_parameters', False)),
         })
-        # recompute from the MERGED sizes (executor order dp/tp/pp/sp) so
-        # an earlier pipeline/sp/tp transpile keeps its axis in the
-        # annotation instead of being clobbered to a dp-only claim; the
-        # pipeline axis keeps its configured name (pp_axis may be custom)
-        pp_ax = base.get('pp_axis', 'pp')
-        base['mesh_axes'] = tuple(
-            (pp_ax if ax == 'pp' else ax) for ax in ('dp', 'tp', 'pp', 'sp')
-            if int(base.get(ax + '_size') or 1) > 1)
+        # recompute from the MERGED sizes so an earlier pipeline/sp/tp
+        # transpile keeps its axis in the annotation instead of being
+        # clobbered to a dp-only claim
+        from ._mesh_axes import rebuild_mesh_axes
+        base['mesh_axes'] = rebuild_mesh_axes(base)
         program._dist_config = base
         program._dist_mesh = None
         return self

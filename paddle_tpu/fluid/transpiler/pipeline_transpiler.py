@@ -74,16 +74,14 @@ class PipelineTranspiler(object):
             program = default_main_program()
         # composition checks FIRST: they read only _dist_config, so a
         # rejected transpile is O(1) and leaves the program unmodified
-        # (no stale _pipeline_config for clone() to silently re-run)
+        # (no stale _pipeline_config for clone() to silently re-run).
+        # tp composes (the shard_map is manual only over dp/pp — GSPMD
+        # partitions tp inside the stages); sp does not.
         base = dict(getattr(program, '_dist_config', None) or {})
         if int(base.get('sp_size') or 1) > 1:
             raise ValueError(
                 'pipeline parallelism does not compose with sequence '
                 'parallelism (see sp_transpiler.py docstring)')
-        if int(base.get('tp_size') or 1) > 1:
-            raise ValueError(
-                'pipeline parallelism does not compose with tensor '
-                'parallelism (see tp_transpiler.py docstring)')
         block = program.global_block()
         ops = block.ops
 
@@ -319,14 +317,11 @@ class PipelineTranspiler(object):
             'extra_stream_names': stream,
             'extra_names': static,
         }
+        from ._mesh_axes import rebuild_mesh_axes
         base['pp_size'] = S
         base['pp_axis'] = self.axis
         base.setdefault('sync_mode', True)
-        # annotation uses the ACTUAL axis names the executor will build
-        # (a custom pipeline axis keeps its name, not the literal 'pp')
-        base['mesh_axes'] = tuple(
-            (self.axis if ax == 'pp' else ax) for ax in ('dp', 'pp')
-            if int(base.get(ax + '_size') or 1) > 1)
+        base['mesh_axes'] = rebuild_mesh_axes(base)
         program._dist_config = base
         program._dist_mesh = None  # force (re)build with the pp axis
         program._bump_version()

@@ -68,9 +68,11 @@ class SequenceParallelTranspiler(object):
             for op in blk.ops:
                 if op.type == 'flash_attention':
                     op.attrs['sp_strategy'] = self.strategy
+        from ._mesh_axes import rebuild_mesh_axes
         base = dict(getattr(program, '_dist_config', None) or {})
         base['sp_size'] = self.sp
         base.setdefault('sync_mode', True)
+        base['mesh_axes'] = rebuild_mesh_axes(base)
         program._dist_config = base
         program._dist_mesh = None  # force (re)build with the sp axis
         program._bump_version()

@@ -13,11 +13,13 @@ decide LAYOUT, never numerics, so tp == single-device exactly.
     fluid.TensorParallelTranspiler(tp=2).transpile(main_program)
     exe.run(main_program, ...)        # fc/embedding weights sharded
 
-Composes with DistributeTranspiler (dp x tp — the classic 2D layout) and
+Composes with DistributeTranspiler (dp x tp — the classic 2D layout),
 SequenceParallelTranspiler (sp rings gather the tp-sharded projections at
-the attention boundary). Does NOT compose with PipelineTranspiler: the
-pipeline's stacked stage parameters replicate within its shard_map, so the
-combination is rejected at transpile time.
+the attention boundary), and PipelineTranspiler (dp x pp x tp — the
+standard Megatron large-model layout): the pipeline's shard_map is manual
+only over dp/pp, so the tp axis stays automatic inside it and GSPMD
+partitions each stage's matmuls by the stacked stage params' Megatron
+shardings (parallel/pipeline.py).
 """
 from ..framework import default_main_program
 
@@ -38,15 +40,11 @@ class TensorParallelTranspiler(object):
             raise ValueError(
                 'no tensor-parallelizable parameters (fc/embedding) found '
                 'in the program')
+        from ._mesh_axes import rebuild_mesh_axes
         base = dict(getattr(program, '_dist_config', None) or {})
-        if int(base.get('pp_size') or 1) > 1 or \
-                getattr(program, '_pipeline_config', None) is not None:
-            raise ValueError(
-                'tensor parallelism does not compose with pipeline '
-                'parallelism (stage parameters replicate inside the '
-                'pipeline shard_map; see module docstring)')
         base['tp_size'] = self.tp
         base.setdefault('sync_mode', True)
+        base['mesh_axes'] = rebuild_mesh_axes(base)
         program._dist_config = base
         program._dist_mesh = None  # force (re)build with the tp axis
         program._bump_version()

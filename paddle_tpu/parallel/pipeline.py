@@ -112,11 +112,17 @@ def pipeline_apply(stage_fn, stacked_params, microbatches, mesh, axis='pp',
         mb_spec = P(None, 'dp')
     else:
         mb_spec = P()
+    # manual ONLY over dp + the pipeline axis: any other mesh axis (tp)
+    # stays automatic, so GSPMD partitions the matmuls INSIDE each stage
+    # by the stacked params' Megatron shardings and inserts the tp
+    # all-reduces — the Megatron-style dp x pp x tp layout with no
+    # hand-written tensor-parallel collectives
+    manual = frozenset(a for a in ('dp', axis) if a in mesh.shape)
     fn = shard_map(
         body, mesh=mesh,
         in_specs=(jax.tree_util.tree_map(lambda _: P(axis), stacked_params),
                   mb_spec)
                  + tuple(mb_spec for _ in extras_streamed)
                  + tuple(P() for _ in extras),
-        out_specs=mb_spec, check_vma=False)
+        out_specs=mb_spec, axis_names=manual, check_vma=False)
     return fn(stacked_params, microbatches, *extras_streamed, *extras)

@@ -58,7 +58,7 @@ def test_dp_tp_matches_single_device():
     assert sharded
 
 
-def test_tp_validation_and_pp_rejection():
+def test_tp_validation_and_pp_composition():
     with fresh_program() as (main, startup):
         x = fluid.layers.data(name='x', shape=[4], dtype='float32')
         fluid.layers.relu(x)
@@ -67,23 +67,69 @@ def test_tp_validation_and_pp_rejection():
     with pytest.raises(ValueError, match='tp must be'):
         fluid.TensorParallelTranspiler(tp=1)
 
+    # pp x tp composes (both transpile orders), and the annotation names
+    # both axes
     from paddle_tpu.models import transformer as T
-    with fresh_program() as (main, startup):
-        avg_cost, _, _ = T.transformer(32, 32, 8, n_layer=2, d_model=16,
-                                       n_head=2, d_inner=32,
-                                       dropout_rate=0.0, pp_decoder=True)
-        fluid.optimizer.SGD(learning_rate=0.01).minimize(avg_cost)
-        fluid.PipelineTranspiler(n_micro=2).transpile(main)
-        with pytest.raises(ValueError, match='does not compose'):
-            fluid.TensorParallelTranspiler(tp=2).transpile(main)
-    with fresh_program() as (main, startup):
-        avg_cost, _, _ = T.transformer(32, 32, 8, n_layer=2, d_model=16,
-                                       n_head=2, d_inner=32,
-                                       dropout_rate=0.0, pp_decoder=True)
-        fluid.optimizer.SGD(learning_rate=0.01).minimize(avg_cost)
-        fluid.TensorParallelTranspiler(tp=2).transpile(main)
-        with pytest.raises(ValueError, match='does not compose'):
-            fluid.PipelineTranspiler(n_micro=2).transpile(main)
+    for order in ('pp_first', 'tp_first'):
+        with fresh_program() as (main, startup):
+            avg_cost, _, _ = T.transformer(32, 32, 8, n_layer=2, d_model=16,
+                                           n_head=2, d_inner=32,
+                                           dropout_rate=0.0, pp_decoder=True)
+            fluid.optimizer.SGD(learning_rate=0.01).minimize(avg_cost)
+            if order == 'pp_first':
+                fluid.PipelineTranspiler(n_micro=2).transpile(main)
+                fluid.TensorParallelTranspiler(tp=2).transpile(main)
+            else:
+                fluid.TensorParallelTranspiler(tp=2).transpile(main)
+                fluid.PipelineTranspiler(n_micro=2).transpile(main)
+            assert main._dist_config['pp_size'] == 2
+            assert main._dist_config['tp_size'] == 2
+            assert main._dist_config['mesh_axes'] == ('tp', 'pp'), \
+                main._dist_config['mesh_axes']
+
+
+@pytest.mark.parametrize('order', ['pp_first', 'tp_first'])
+def test_dp_pp_tp_three_way_matches_single_device(order):
+    """The Megatron large-model layout: dp x pp x tp on one mesh — a
+    pipelined Fluid Transformer decoder with tp-sharded stage weights
+    trains identically to the single-device program."""
+    from paddle_tpu.models import transformer as T
+    rng = np.random.RandomState(81)
+    vocab, seq, batch = 32, 8, 8
+    feed_ids = {n: rng.randint(1, vocab, size=(batch, seq)).astype('int64')
+                for n in ('src_word', 'trg_word', 'lbl_word')}
+
+    def run(transpile):
+        with fresh_program() as (main, startup):
+            avg_cost, _, _ = T.transformer(
+                vocab, vocab, seq, n_layer=2, d_model=16, n_head=2,
+                d_inner=32, dropout_rate=0.0, pp_decoder=True)
+            fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
+            if transpile:
+                if order == 'pp_first':
+                    fluid.PipelineTranspiler(n_micro=2).transpile(main)
+                    fluid.TensorParallelTranspiler(tp=2).transpile(main)
+                else:
+                    fluid.TensorParallelTranspiler(tp=2).transpile(main)
+                    fluid.PipelineTranspiler(n_micro=2).transpile(main)
+                fluid.DistributeTranspiler().transpile(
+                    trainer_id=0, trainers=2)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            losses = [float(exe.run(main, feed=feed_ids,
+                                    fetch_list=[avg_cost])[0])
+                      for _ in range(3)]
+            sharded = [n for n, v in global_scope().vars.items()
+                       if isinstance(v, jax.Array)
+                       and isinstance(v.sharding, NamedSharding)
+                       and 'tp' in str(v.sharding.spec)]
+        return losses, sharded
+
+    base, _ = run(False)
+    three, sharded = run(True)
+    assert base[0] != base[1]
+    np.testing.assert_allclose(three, base, rtol=2e-4)
+    assert sharded, 'no tp-sharded params on the 3-way mesh'
 
 
 def test_tp_with_zero_composes_dp_sharding(monkeypatch):
