@@ -1289,28 +1289,41 @@ def beam_search_decode(ids, scores, beam_size=None, end_id=0, parents=None,
 
 
 def moe_mlp(input, num_experts, hidden_size, size=None, act='relu',
-            capacity_factor=2.0, gate_param_attr=None, param_attr=None,
-            bias_attr=None, name=None):
-    """Top-1 gated mixture-of-experts FFN (TPU extension; the reference
+            capacity_factor=2.0, top_k=1, return_aux_loss=False,
+            gate_param_attr=None, param_attr=None, bias_attr=None,
+            name=None):
+    """Top-k gated mixture-of-experts FFN (TPU extension; the reference
     predates MoE — its conditional-computation ancestor is layers.Switch).
 
     Each of `num_experts` experts is a two-layer MLP
     ``act(x @ w1 + b1) @ w2 + b2`` with hidden width `hidden_size`; tokens
-    are routed top-1 by a learned linear gate with Switch-style fixed
-    capacity (capacity_factor * tokens / experts; overflow dropped). Under
-    ParallelExecutor or a DistributeTranspiler mesh whose dp size equals
-    num_experts, experts are sharded one-per-device and dispatch rides two
+    are routed top-k by a learned linear gate with fixed capacity
+    (capacity_factor * top_k * tokens / experts; overflow dropped, all
+    first choices claiming slots before any second choice). top_k=1 uses
+    Switch-style raw-probability gates; top_k>=2 renormalizes the selected
+    gates per token (GShard). Under ParallelExecutor or a
+    DistributeTranspiler mesh whose dp size divides num_experts, experts
+    are sharded num_experts/dp-per-device and dispatch rides two
     all_to_alls (paddle_tpu.parallel.moe); otherwise experts run locally
     with identical semantics.
 
+    With return_aux_loss=True, also returns the scalar Switch/GShard
+    load-balancing auxiliary loss (E * sum_e f_e * P_e, minimized at 1.0
+    by a uniform router) to add to the training objective with a small
+    weight, e.g. ``cost = cost + 0.01 * aux``.
+
     input: [N, d] tokens or [B, T, d] sequence activations.
-    Returns the same shape with the last dim `size` (default d).
+    Returns the same shape with the last dim `size` (default d), or
+    (out, aux_loss) when return_aux_loss=True.
     """
     from ..ops_impl.moe_ops import supported_acts
     if (act or None) is not None and act not in supported_acts():
         raise ValueError(
             "moe_mlp act=%r is not supported; pick one of %s"
             % (act, sorted(a for a in supported_acts() if a)))
+    if not 1 <= int(top_k) <= int(num_experts):
+        raise ValueError('moe_mlp top_k=%r must be in [1, num_experts=%d]'
+                         % (top_k, num_experts))
     helper = LayerHelper('moe_mlp', **locals())
     dtype = helper.input_dtype()
     d = int(input.shape[-1])
@@ -1332,12 +1345,16 @@ def moe_mlp(input, num_experts, hidden_size, size=None, act='relu',
                                  shape=[num_experts, out_d], dtype=dtype,
                                  is_bias=True)
     out = helper.create_variable_for_type_inference(dtype)
+    aux = helper.create_variable_for_type_inference('float32')
     helper.append_op(
         type='moe_mlp',
         inputs={'X': [input], 'GateW': [gate_w], 'W1': [w1], 'B1': [b1],
                 'W2': [w2], 'B2': [b2]},
-        outputs={'Out': [out]},
+        outputs={'Out': [out], 'AuxLoss': [aux]},
         attrs={'num_experts': int(num_experts),
                'capacity_factor': float(capacity_factor),
+               'top_k': int(top_k),
                'act': act or ''})
+    if return_aux_loss:
+        return out, aux
     return out

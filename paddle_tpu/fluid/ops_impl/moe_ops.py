@@ -2,23 +2,28 @@
 
 TPU-first extension (no reference counterpart — the reference predates MoE
 layers; closest ancestor is its conditional-computation machinery,
-fluid/layers/control_flow.py Switch). The `moe_mlp` op is a top-1 gated
+fluid/layers/control_flow.py Switch). The `moe_mlp` op is a top-k gated
 two-layer expert FFN:
 
   gate_logits = x @ gate_w                       [N, E]
   expert e:  y = act(x @ w1[e] + b1[e]) @ w2[e] + b2[e]
 
-Dispatch uses the Switch-Transformer fixed-capacity packing semantics of
-paddle_tpu.parallel.moe: tokens are routed top-1, packed into
-[E, capacity] slots (overflow dropped — static shapes for XLA), gate-
-weighted on return. Two execution paths, same math:
+Dispatch uses the Switch/GShard fixed-capacity packing semantics of
+paddle_tpu.parallel.moe: tokens are routed top-k (k=1 Switch raw-prob
+gates, k>1 GShard renormalized gates), packed into [E, capacity] slots
+(overflow dropped, first choices before second — static shapes for XLA),
+gate-weighted on return. The op also emits the Switch/GShard
+load-balancing auxiliary loss (E * sum_e f_e * P_e) as a scalar `AuxLoss`
+output for the model to add to its objective. Two execution paths, same
+math:
 
 - mesh path: when the step is compiled against a mesh (DistributeTranspiler
-  or ParallelExecutor) whose dp axis size equals num_experts, experts are
-  sharded one-per-device over dp and tokens ride TWO all_to_alls
-  (parallel/moe.py moe_apply) — true expert parallelism on the ICI.
+  or ParallelExecutor) whose dp axis size divides num_experts, experts are
+  sharded num_experts/dp-per-device over dp and tokens ride TWO
+  all_to_alls (parallel/moe.py moe_apply) — true expert parallelism on
+  the ICI.
 - dense path: identical pack/transform/unpack with the experts vmapped
-  locally (single device, or expert count != mesh size).
+  locally (single device, or expert count not a multiple of mesh size).
 
 The two paths agree exactly when capacity is not exceeded; under overflow
 the drop PATTERN differs (per-shard vs global cumsum order) — the standard
@@ -51,17 +56,17 @@ def _expert_mlp(p, t, act):
     return h @ p['w2'] + p['b2']
 
 
-def _dense_moe(params, x, logits, capacity_factor, act):
+def _dense_moe(params, x, logits, capacity_factor, act, top_k):
     """Local pack/transform/unpack with the same fixed-capacity semantics
     as parallel.moe.moe_apply (minus the all_to_all exchanges) — routing
-    math is shared via pack_top1/combine_top1 so the paths cannot drift."""
-    from ...parallel.moe import pack_top1, combine_top1
+    math is shared via pack_topk/combine_topk so the paths cannot drift."""
+    from ...parallel.moe import pack_topk, combine_topk
     nt = x.shape[0]
     n_exp = logits.shape[-1]
-    cap = int(max(1, capacity_factor * nt / n_exp))
-    send, route = pack_top1(x, logits, n_exp, cap)
+    cap = int(max(1, capacity_factor * top_k * nt / n_exp))
+    send, route = pack_topk(x, logits, n_exp, cap, top_k)
     out = jax.vmap(lambda p, t: _expert_mlp(p, t, act))(params, send)
-    return combine_top1(out, route, x.dtype)
+    return combine_topk(out, route, x.dtype)
 
 
 @register('moe_mlp')
@@ -75,6 +80,7 @@ def _moe_mlp(ins, attrs, ctx):
     act = attrs.get('act') or None
     cf = float(attrs.get('capacity_factor', 2.0))
     n_exp = int(attrs.get('num_experts'))
+    top_k = int(attrs.get('top_k', 1))
 
     shape_in = x.shape
     if x.ndim > 2:
@@ -83,17 +89,24 @@ def _moe_mlp(ins, attrs, ctx):
     params = dict(zip(params, amp_cast(ctx, *params.values())))
     logits = (x @ gate_w).astype(jnp.float32)
 
+    from ...parallel.moe import load_balancing_loss
+    aux = load_balancing_loss(logits, top_k)
+
     mesh = ctx.mesh
     if (mesh is not None and 'dp' in getattr(mesh, 'shape', {})
-            and mesh.shape['dp'] == n_exp):
+            and n_exp % mesh.shape['dp'] == 0
+            and n_exp >= mesh.shape['dp']):
         from ...parallel.moe import moe_apply
         from jax.sharding import NamedSharding, PartitionSpec as P
-        # one expert per dp device; tokens already batch-sharded over dp
+        # experts block-sharded over dp (n_exp/dp per device); tokens
+        # already batch-sharded over dp
         params = jax.tree_util.tree_map(
             lambda p: jax.lax.with_sharding_constraint(
                 p, NamedSharding(mesh, P('dp'))), params)
         y = moe_apply(lambda p, t: _expert_mlp(p, t, act), params, x,
-                      logits, mesh, axis='dp', capacity_factor=cf)
+                      logits, mesh, axis='dp', capacity_factor=cf,
+                      top_k=top_k)
     else:
-        y = _dense_moe(params, x, logits, cf, act)
-    return {'Out': y.reshape(shape_in[:-1] + y.shape[-1:])}
+        y = _dense_moe(params, x, logits, cf, act, top_k)
+    return {'Out': y.reshape(shape_in[:-1] + y.shape[-1:]),
+            'AuxLoss': aux}

@@ -1,94 +1,177 @@
-"""Expert parallelism: top-1 gated mixture-of-experts with all_to_all
+"""Expert parallelism: top-k gated mixture-of-experts with all_to_all
 dispatch over a mesh axis.
 
 TPU-first design (no reference counterpart — the reference predates MoE
-layers): experts live one-per-device along the `ep` mesh axis (expert
-weights stacked [n_experts, ...] and sharded like pipeline stages). Tokens
-are gated top-1, packed into fixed per-expert capacity slots (static
-shapes — overflow tokens are dropped, the standard TPU MoE trade), sent to
-their expert with ONE all_to_all, transformed, and returned with a second
-all_to_all; dropped tokens pass through the residual unchanged.
+layers; its conditional-computation ancestor is fluid/layers/control_flow.py
+Switch): experts live along the `ep` mesh axis (expert weights stacked
+[n_experts, ...] and sharded like pipeline stages), with experts-per-device
+= n_experts / axis_size when the counts differ (divisibility required).
+Tokens are gated top-k (k=1 Switch-style raw-probability gates; k>1
+GShard-style gates renormalized over the selected experts), packed into
+fixed per-expert capacity slots (static shapes — overflow tokens are
+dropped, the standard TPU MoE trade, with all first choices claiming slots
+before any second choice), sent to their expert with ONE all_to_all,
+transformed, and returned with a second all_to_all; dropped tokens pass
+through gate-weighted as zeros.
+
+`load_balancing_loss` is the Switch/GShard auxiliary objective
+E * sum_e f_e * P_e — differentiable through P_e, minimized at 1.0 by a
+uniform router — to be added to the model loss with a small weight.
 """
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ._sp import stack_unit_params, check_units_match_axis
+from ._sp import stack_unit_params
 
-__all__ = ['moe_apply', 'stack_expert_params', 'pack_top1', 'combine_top1']
+__all__ = ['moe_apply', 'stack_expert_params', 'router_topk', 'pack_topk',
+           'combine_topk', 'pack_top1', 'combine_top1',
+           'load_balancing_loss']
 
 # [{param pytree} per expert] -> pytree with leading [n_experts, ...] axis
 stack_expert_params = stack_unit_params
 
 
-def pack_top1(xs, logits, n_exp, cap):
-    """Top-1 routing + fixed-capacity packing (shared by the sharded
+def router_topk(logits, top_k):
+    """Routing decisions shared by the dense and sharded paths.
+
+    Returns (expert [k, nt] int, gate [k, nt] f32). k=1 keeps the Switch
+    semantics (gate = raw softmax probability of the chosen expert); k>1
+    renormalizes the selected probabilities to sum to 1 per token (GShard).
+    """
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [nt, E]
+    _, idx = lax.top_k(logits, top_k)                            # [nt, k]
+    gate = jnp.take_along_axis(probs, idx, axis=-1)              # [nt, k]
+    if top_k > 1:
+        gate = gate / jnp.sum(gate, axis=-1, keepdims=True)
+    return idx.T, gate.T
+
+
+def load_balancing_loss(logits, top_k=1):
+    """Switch/GShard auxiliary load-balancing loss: E * sum_e f_e * P_e,
+    where f_e is the fraction of (token, choice) assignments routed to
+    expert e and P_e the mean router probability of e. Equals 1.0 for a
+    perfectly uniform router, approaches E under total collapse; the f_e
+    factor is non-differentiable (argmax) so gradients flow through P_e,
+    pushing probability mass away from overloaded experts."""
+    n_exp = logits.shape[-1]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    _, idx = lax.top_k(logits, top_k)                            # [nt, k]
+    f = jnp.mean(jax.nn.one_hot(idx, n_exp, dtype=jnp.float32), axis=(0, 1))
+    p = jnp.mean(probs, axis=0)
+    return n_exp * jnp.sum(f * p)
+
+
+def pack_topk(xs, logits, n_exp, cap, top_k=1):
+    """Top-k routing + fixed-capacity packing (shared by the sharded
     all_to_all path below and ops_impl/moe_ops.py's dense fallback, so the
     two stay numerically identical).
 
+    Capacity slots are claimed in choice-major order — every token's first
+    choice before any token's second choice (GShard priority), then token
+    order within a choice level.
+
     Returns (send [n_exp, cap, d], route) where route carries the
-    (expert, slot, keep, gate) needed to combine."""
+    (expert, slot, keep, gate) [k, nt] arrays needed to combine."""
     nt, d = xs.shape
-    expert = jnp.argmax(logits, axis=-1)                     # [nt]
-    gate = jax.nn.softmax(logits.astype(jnp.float32),
-                          axis=-1)[jnp.arange(nt), expert]   # [nt]
-    # position of each token within its expert's capacity buffer
-    onehot = jax.nn.one_hot(expert, n_exp, dtype=jnp.int32)  # [nt, E]
+    expert, gate = router_topk(logits, top_k)                # [k, nt]
+    onehot = jax.nn.one_hot(expert.reshape(-1), n_exp,
+                            dtype=jnp.int32)                 # [k*nt, E]
     pos = jnp.cumsum(onehot, axis=0) * onehot                # 1-based
-    slot = jnp.sum(pos, axis=-1) - 1                         # [nt]
+    slot = (jnp.sum(pos, axis=-1) - 1).reshape(top_k, nt)    # [k, nt]
     keep = slot < cap
+    xs_k = jnp.broadcast_to(xs[None], (top_k, nt, d))
     send = jnp.zeros((n_exp, cap, d), xs.dtype)
-    send = send.at[jnp.where(keep, expert, 0),
-                   jnp.where(keep, slot, 0)].add(
-        jnp.where(keep[:, None], xs, 0.0))
+    send = send.at[jnp.where(keep, expert, 0).reshape(-1),
+                   jnp.where(keep, slot, 0).reshape(-1)].add(
+        jnp.where(keep.reshape(-1)[:, None], xs_k.reshape(-1, d), 0.0))
     return send, (expert, slot, keep, gate)
 
 
-def combine_top1(back, route, dtype):
-    """Unpack expert outputs [n_exp, cap, d] by route and gate-weight;
-    dropped tokens get zeros."""
-    expert, slot, keep, gate = route
+def combine_topk(back, route, dtype):
+    """Unpack expert outputs [n_exp, cap, d_out] by route, gate-weight and
+    sum over the k choices; dropped assignments contribute zeros."""
+    expert, slot, keep, gate = route                         # [k, nt]
     y = back[jnp.where(keep, expert, 0), jnp.where(keep, slot, 0)]
-    y = jnp.where(keep[:, None], y, 0.0)
-    return (y.astype(jnp.float32) * gate[:, None]).astype(dtype)
+    y = jnp.where(keep[..., None], y, 0.0)                   # [k, nt, d_out]
+    return jnp.sum(y.astype(jnp.float32) * gate[..., None],
+                   axis=0).astype(dtype)
+
+
+def pack_top1(xs, logits, n_exp, cap):
+    """Top-1 convenience wrapper (route arrays squeezed to [nt])."""
+    send, (expert, slot, keep, gate) = pack_topk(xs, logits, n_exp, cap, 1)
+    return send, (expert[0], slot[0], keep[0], gate[0])
+
+
+def combine_top1(back, route, dtype):
+    expert, slot, keep, gate = route
+    return combine_topk(back, (expert[None], slot[None], keep[None],
+                               gate[None]), dtype)
+
+
+def _n_experts_of(stacked, mesh, axis):
+    """Leading dim of the stacked expert pytree; must be a positive
+    multiple of the mesh axis (experts-per-device >= 1, sharded evenly —
+    a non-multiple would shard raggedly or drop experts silently)."""
+    leaves = jax.tree_util.tree_leaves(stacked)
+    n_exp = leaves[0].shape[0]
+    ws = mesh.shape[axis]
+    for leaf in leaves:
+        if leaf.shape[0] != n_exp:
+            raise ValueError('expert: inconsistent stacked leading dims '
+                             '%d vs %d' % (leaf.shape[0], n_exp))
+    if n_exp % ws or n_exp < ws:
+        raise ValueError(
+            'expert: stacked leading dim %d must equal mesh axis %r size %d '
+            'or a multiple of it (experts-per-device)' % (n_exp, axis, ws))
+    return n_exp
 
 
 def moe_apply(expert_fn, stacked_params, x, gate_logits, mesh, axis='ep',
-              capacity_factor=2.0):
+              capacity_factor=2.0, top_k=1):
     """Dispatch tokens to experts and combine.
 
     expert_fn(params, x) -> y        applied per expert on [cap, d]
     stacked_params: leaves [n_experts, ...], sharded over `axis`
+                    (n_experts must be a multiple of the axis size;
+                    each device holds n_experts/axis_size experts)
     x:           [n_tokens, d] tokens, sharded over `axis` (token shards)
     gate_logits: [n_tokens, n_experts], sharded like x
-    Returns [n_tokens, d]: gate-weighted expert outputs (0 for dropped).
+    Returns [n_tokens, d_out]: gate-weighted expert outputs (0 for dropped).
     """
-    n_exp = mesh.shape[axis]
-    check_units_match_axis(stacked_params, mesh, axis, 'expert')
+    ws = mesh.shape[axis]
+    n_exp = _n_experts_of(stacked_params, mesh, axis)
+    epd = n_exp // ws                          # experts per device
     if gate_logits.shape[-1] != n_exp:
         raise ValueError(
-            'gate_logits last dim %d must equal mesh axis %r size %d (one '
-            'expert per device)' % (gate_logits.shape[-1], axis, n_exp))
+            'gate_logits last dim %d must equal the stacked expert count %d'
+            % (gate_logits.shape[-1], n_exp))
     from jax import shard_map
 
     def body(params, xs, logits):
-        p_local = jax.tree_util.tree_map(lambda p: p[0], params)
+        # params leaves [epd, ...]: this device's expert block — expert e
+        # lives on device e // epd at local index e % epd, matching the
+        # [ws, epd, ...] reshape of the send buffer below
         nt, d = xs.shape
-        cap = int(max(1, capacity_factor * nt / n_exp))
+        cap = int(max(1, capacity_factor * top_k * nt / n_exp))
 
         # pack: [E, cap, d] send buffer (local tokens destined per expert)
-        send, route = pack_top1(xs, logits, n_exp, cap)
+        send, route = pack_topk(xs, logits, n_exp, cap, top_k)
 
-        # exchange: device e receives every shard's buffer for expert e
-        recv = lax.all_to_all(send, axis, split_axis=0, concat_axis=0,
-                              tiled=True)                        # [E*cap, d]
-        out = expert_fn(p_local, recv.reshape(-1, d))
-        out = out.reshape(n_exp, cap, d)
+        # exchange: device j receives every shard's buffers for its block
+        # of experts [j*epd, (j+1)*epd)
+        recv = lax.all_to_all(send.reshape(ws, epd, cap, d), axis,
+                              split_axis=0, concat_axis=0, tiled=True)
+        toks = recv.reshape(ws, epd, cap, d).transpose(1, 0, 2, 3)
+        out = jax.vmap(expert_fn)(params, toks.reshape(epd, ws * cap, d))
+        d_out = out.shape[-1]
+        out = out.reshape(epd, ws, cap, d_out).transpose(1, 0, 2, 3)
         back = lax.all_to_all(out, axis, split_axis=0, concat_axis=0,
-                              tiled=True).reshape(n_exp, cap, d)
+                              tiled=True).reshape(n_exp, cap, d_out)
 
-        return combine_top1(back, route, xs.dtype)
+        return combine_topk(back, route, xs.dtype)
 
     fn = shard_map(
         body, mesh=mesh,

@@ -58,11 +58,12 @@ def test_unit_count_must_match_axis():
     with pytest.raises(ValueError, match='must equal mesh axis'):
         pipeline_apply(_mlp_stage, stack_stage_params(stages), mbs, mesh)
     ep_mesh = parallel.make_mesh({'ep': 8})
-    experts = [{'w': jnp.eye(D, dtype='float32')} for _ in range(16)]
+    # 12 experts on an ep=8 mesh: not a multiple -> ragged shard rejected
+    experts = [{'w': jnp.eye(D, dtype='float32')} for _ in range(12)]
     toks = jnp.zeros((16, D), jnp.float32)
     with pytest.raises(ValueError, match='must equal mesh axis'):
         moe_apply(_expert, stack_expert_params(experts), toks,
-                  jnp.zeros((16, 16), jnp.float32), ep_mesh)
+                  jnp.zeros((16, 12), jnp.float32), ep_mesh)
     # right expert count but wrong gate width
     experts8 = [{'w': jnp.eye(D, dtype='float32')} for _ in range(8)]
     with pytest.raises(ValueError, match='gate_logits'):
@@ -95,6 +96,87 @@ def test_moe_matches_dense_with_headroom():
         np.asarray(_expert(per_expert[e], x[i:i + 1]))[0] * gate[i]
         for i, e in enumerate(expert)])
     np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-5)
+
+
+def test_moe_top2_matches_manual():
+    """top_k=2 with capacity headroom == gate-renormalized two-expert sum
+    computed by hand (GShard semantics)."""
+    from paddle_tpu.parallel.moe import moe_apply
+    mesh = parallel.make_mesh({'ep': 8})
+    E, D, NT = 8, 4, 64
+    rng = np.random.RandomState(7)
+    per_expert = [{'w': jnp.asarray(rng.randn(D, D).astype('float32') * 0.5)}
+                  for _ in range(E)]
+    stacked = stack_expert_params(per_expert)
+    x = jnp.asarray(rng.randn(NT, D).astype('float32'))
+    logits = jnp.asarray(rng.randn(NT, E).astype('float32'))
+
+    got = moe_apply(_expert, stacked, x, logits, mesh, axis='ep',
+                    capacity_factor=8.0, top_k=2)
+
+    probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+    order = np.argsort(-np.asarray(logits), axis=-1)[:, :2]   # [NT, 2]
+    want = np.zeros((NT, D), np.float32)
+    for i in range(NT):
+        e1, e2 = order[i]
+        g1, g2 = probs[i, e1], probs[i, e2]
+        s = g1 + g2
+        want[i] = (np.asarray(_expert(per_expert[e1], x[i:i + 1]))[0] * g1 / s
+                   + np.asarray(_expert(per_expert[e2], x[i:i + 1]))[0] * g2 / s)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-5)
+
+
+def test_moe_experts_per_device():
+    """16 experts on an ep=8 mesh (2 per device): the block-sharded
+    all_to_all path == a dense vmap over all 16 experts."""
+    from paddle_tpu.parallel.moe import moe_apply, pack_topk, combine_topk
+    mesh = parallel.make_mesh({'ep': 8})
+    E, D, DO, NT = 16, 4, 6, 64
+    rng = np.random.RandomState(11)
+    # d_out != d_in also exercises the output-width-agnostic return path
+    per_expert = [{'w': jnp.asarray(rng.randn(D, DO).astype('float32') * 0.5)}
+                  for _ in range(E)]
+    stacked = stack_expert_params(per_expert)
+    x = jnp.asarray(rng.randn(NT, D).astype('float32'))
+    logits = jnp.asarray(rng.randn(NT, E).astype('float32'))
+
+    got = moe_apply(_expert, stacked, x, logits, mesh, axis='ep',
+                    capacity_factor=16.0, top_k=2)
+
+    cap = int(16.0 * 2 * NT / E)
+    send, route = pack_topk(x, logits, E, cap, 2)
+    out = jax.vmap(_expert)(stacked, send)
+    want = combine_topk(out, route, x.dtype)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_load_balancing_loss():
+    """Balanced router -> ~1.0; collapsed router -> ~E; uniform-probability
+    router == exactly 1 regardless of assignments; differentiable."""
+    from paddle_tpu.parallel.moe import load_balancing_loss
+    E, NT = 8, 256
+    rng = np.random.RandomState(13)
+    # perfectly balanced: token i strongly prefers expert i % E
+    bal = np.full((NT, E), -8.0, np.float32)
+    bal[np.arange(NT), np.arange(NT) % E] = 8.0
+    # collapsed: every token strongly prefers expert 0
+    col = np.full((NT, E), -8.0, np.float32)
+    col[:, 0] = 8.0
+    l_bal = float(load_balancing_loss(jnp.asarray(bal)))
+    l_col = float(load_balancing_loss(jnp.asarray(col)))
+    assert abs(l_bal - 1.0) < 1e-2, l_bal
+    assert l_col > 0.9 * E, (l_col, E)
+    # exactly-uniform probabilities: E * sum_e f_e * (1/E) = 1 for any f
+    uni = jnp.zeros((NT, E), jnp.float32)
+    np.testing.assert_allclose(float(load_balancing_loss(uni)), 1.0,
+                               rtol=1e-6)
+    # top-2 accounting: balanced assignments still ~1
+    l2 = float(load_balancing_loss(jnp.asarray(bal), top_k=2))
+    assert np.isfinite(l2) and l2 < E
+    # gradient flows (through P_e; f_e is argmax-blocked)
+    g = jax.grad(lambda z: load_balancing_loss(z))(jnp.asarray(col))
+    assert float(jnp.abs(g).sum()) > 0.0
 
 
 def test_moe_capacity_drops_overflow():
@@ -210,6 +292,86 @@ class TestMoeMlpLayer:
         # plain Executor.run must not see a forced dp mesh (the scope's
         # mesh-REPLICATED params are a separate, documented GSPMD property)
         assert getattr(main, '_dist_mesh', None) is None
+
+    def test_top2_aux_loss_in_loss_graph(self):
+        """top_k=2 with the load-balancing aux loss ADDED TO THE PROGRAM'S
+        OBJECTIVE: the combined loss trains, the aux term starts near its
+        uniform-router value (~1.0) and stays bounded, and the gate weights
+        receive gradient through the aux path."""
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.fluid import framework, unique_name
+        from paddle_tpu.fluid.executor import Scope, _switch_scope
+        _switch_scope(Scope())
+        main, startup = framework.Program(), framework.Program()
+        rng = np.random.RandomState(5)
+        X = rng.randn(64, 16).astype('float32')
+        Y = X @ rng.randn(16, 1).astype('float32')
+        with unique_name.guard(), framework.program_guard(main, startup):
+            x = fluid.layers.data(name='x', shape=[16], dtype='float32')
+            y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+            h, aux = fluid.layers.moe_mlp(x, num_experts=4, hidden_size=32,
+                                          top_k=2, return_aux_loss=True)
+            pred = fluid.layers.fc(input=h, size=1)
+            task = fluid.layers.mean(
+                fluid.layers.square_error_cost(input=pred, label=y))
+            cost = task + 0.01 * aux
+            fluid.optimizer.Adam(learning_rate=3e-3).minimize(cost)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            first = last = aux0 = None
+            for _ in range(100):
+                loss, a = exe.run(main, feed={'x': X, 'y': Y},
+                                  fetch_list=[task, aux])
+                first = first if first is not None else float(loss)
+                aux0 = aux0 if aux0 is not None else float(a)
+                last = float(loss)
+        assert last < first * 0.2, (first, last)
+        # aux is the Switch objective: 1.0 uniform .. E collapsed
+        assert 0.9 <= aux0 <= 4.0, aux0
+        assert 0.9 <= float(a) <= 4.0, float(a)
+
+    def test_mesh_path_experts_per_device(self):
+        """num_experts=8 on a dp=4 ParallelExecutor mesh (2 experts per
+        device) routes through the block-sharded all_to_all path and
+        matches the single-device forward."""
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.fluid import framework, unique_name
+        from paddle_tpu.fluid.executor import Scope, _switch_scope
+        import paddle_tpu.parallel.moe as moe_mod
+        _switch_scope(Scope())
+        main, startup = framework.Program(), framework.Program()
+        rng = np.random.RandomState(9)
+        X = rng.randn(64, 16).astype('float32')
+        Y = X @ rng.randn(16, 1).astype('float32')
+        with unique_name.guard(), framework.program_guard(main, startup):
+            x = fluid.layers.data(name='x', shape=[16], dtype='float32')
+            y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+            h = fluid.layers.moe_mlp(x, num_experts=8, hidden_size=8,
+                                     top_k=2, capacity_factor=8.0)
+            pred = fluid.layers.fc(input=h, size=1)
+            cost = fluid.layers.mean(
+                fluid.layers.square_error_cost(input=pred, label=y))
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            single, = exe.run(main, feed={'x': X, 'y': Y},
+                              fetch_list=[cost])
+            calls = {'mesh': 0}
+            real = moe_mod.moe_apply
+
+            def spy(*a, **kw):
+                calls['mesh'] += 1
+                return real(*a, **kw)
+
+            pe = fluid.ParallelExecutor(use_cuda=False, main_program=main,
+                                        loss_name=cost.name, num_devices=4)
+            moe_mod.moe_apply = spy
+            try:
+                par, = pe.run(fetch_list=[cost.name], feed={'x': X, 'y': Y})
+            finally:
+                moe_mod.moe_apply = real
+        assert calls['mesh'] >= 1
+        np.testing.assert_allclose(float(single),
+                                   float(np.asarray(par).mean()), rtol=2e-4)
 
     def test_bad_act_rejected_at_layer_time(self):
         import pytest

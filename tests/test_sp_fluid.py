@@ -159,7 +159,12 @@ def test_sp_and_pp_compose_with_amp():
     for kw in (dict(sp=0, pp=True), dict(sp=4)):
         got = _train_transformer(amp=True, seed=51, **kw)
         assert all(np.isfinite(got)), (kw, got)
-        # bf16 numerics: looser tolerance, but same trajectory
+        # 5e-2 is a bf16 bound, not sloppiness: bf16 has an 8-bit mantissa
+        # (relative rounding 2^-9 ~ 2e-3 PER op), and the pipeline/ring
+        # regroupings reorder reductions, so two training steps compound
+        # percent-level drift. The fp32 versions of these same stacks are
+        # held to 2e-4 above; the bf16 run only asserts the trajectories
+        # agree to bf16 precision.
         np.testing.assert_allclose(got, base, rtol=5e-2,
                                    err_msg='amp %r' % kw)
 
