@@ -1289,9 +1289,8 @@ def beam_search_decode(ids, scores, beam_size=None, end_id=0, parents=None,
 
 
 def moe_mlp(input, num_experts, hidden_size, size=None, act='relu',
-            capacity_factor=2.0, top_k=1, return_aux_loss=False,
-            gate_param_attr=None, param_attr=None, bias_attr=None,
-            name=None):
+            capacity_factor=2.0, gate_param_attr=None, param_attr=None,
+            bias_attr=None, name=None, top_k=1, return_aux_loss=False):
     """Top-k gated mixture-of-experts FFN (TPU extension; the reference
     predates MoE — its conditional-computation ancestor is layers.Switch).
 

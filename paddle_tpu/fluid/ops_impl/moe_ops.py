@@ -94,8 +94,7 @@ def _moe_mlp(ins, attrs, ctx):
 
     mesh = ctx.mesh
     if (mesh is not None and 'dp' in getattr(mesh, 'shape', {})
-            and n_exp % mesh.shape['dp'] == 0
-            and n_exp >= mesh.shape['dp']):
+            and n_exp % mesh.shape['dp'] == 0):
         from ...parallel.moe import moe_apply
         from jax.sharding import NamedSharding, PartitionSpec as P
         # experts block-sharded over dp (n_exp/dp per device); tokens

@@ -9,9 +9,16 @@ paddle_tpu.ops.flash_attention). Run on TPU:
 """
 import argparse
 import itertools
+import os
+import sys
 import time
 
 import numpy as np
+
+# make paddle_tpu importable when run as `python tools/tune_flash.py`
+# (sys.path gets tools/, not the repo root; do NOT use PYTHONPATH for this —
+# a PYTHONPATH entry breaks the axon TPU plugin's backend discovery)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
