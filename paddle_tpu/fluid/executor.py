@@ -353,7 +353,8 @@ class _CompiledStep(object):
 
         out = parallel.pipeline_apply(stage_fn, stacked, mbs, self.mesh,
                                       axis=cfg['axis'], extras=extras,
-                                      extras_streamed=tuple(streamed))
+                                      extras_streamed=tuple(streamed),
+                                      n_virtual=cfg.get('n_virtual', 1))
         env[cfg['output_var']] = out.reshape((-1,) + out.shape[2:])
 
     def debug_step(self, persist, feed, key, check_nan_inf=False, on_op=None):

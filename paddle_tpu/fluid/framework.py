@@ -423,7 +423,9 @@ class Program(object):
         from .transpiler.pipeline_transpiler import PipelineTranspiler
         try:
             PipelineTranspiler(n_micro=cfg['n_micro'],
-                               axis=cfg['axis']).transpile(p)
+                               axis=cfg['axis'],
+                               n_virtual=cfg.get('n_virtual', 1)
+                               ).transpile(p)
         except ValueError:
             p._pipeline_config = None
 

@@ -1,4 +1,5 @@
-"""Pipeline-parallel transpiler: Fluid Program -> GPipe schedule.
+"""Pipeline-parallel transpiler: Fluid Program -> GPipe or circular
+(interleaved) schedule.
 
 Program-level entry for parallel/pipeline.py. The user wraps each repeated
 stage of the network in `fluid.device_guard('pipe:K')` (K = 0..S-1); ops
@@ -55,19 +56,31 @@ def _attrs_key(op):
 
 
 class PipelineTranspiler(object):
-    """Turn device_guard('pipe:K') stage annotations into a GPipe config.
+    """Turn device_guard('pipe:K') stage annotations into a pipeline config.
 
-        t = PipelineTranspiler(n_micro=4)
+        t = PipelineTranspiler(n_micro=4)              # GPipe
+        t = PipelineTranspiler(n_micro=4, n_virtual=2) # circular schedule
         t.transpile(main_program)          # annotates the program
         exe.run(main_program, ...)         # region runs pipelined
 
-    n_micro must divide the batch size; the pp mesh axis size equals the
-    number of annotated stages.
+    n_micro must divide the batch size. The pp mesh axis size equals the
+    number of annotated stages divided by n_virtual: with n_virtual > 1
+    each device holds n_virtual chunks and every microbatch rides the ring
+    n_virtual times (the Megatron/praxis interleaved loop placement),
+    shrinking the fill/drain bubble by n_virtual at the cost of n_micro
+    having to be a multiple of the device count.
     """
 
-    def __init__(self, n_micro=4, axis='pp'):
+    def __init__(self, n_micro=4, axis='pp', n_virtual=1):
         self.n_micro = int(n_micro)
         self.axis = axis
+        # circular (interleaved) schedule: n_virtual chunks per device,
+        # each microbatch rides the ring n_virtual times — the fill/drain
+        # bubble shrinks by n_virtual (see parallel/pipeline.py docstring)
+        self.n_virtual = int(n_virtual)
+        if self.n_virtual < 1:
+            raise ValueError('n_virtual must be >= 1, got %d'
+                             % self.n_virtual)
 
     def transpile(self, program=None):
         if program is None:
@@ -304,9 +317,24 @@ class PipelineTranspiler(object):
             else:
                 static.append(n)
 
+        if S % self.n_virtual or S // self.n_virtual < 2:
+            raise ValueError(
+                'n_virtual=%d must divide the %d stamped stages with '
+                'stages/n_virtual >= 2 (that quotient is the pp mesh axis '
+                'size — devices each hold n_virtual chunks)'
+                % (self.n_virtual, S))
+        if self.n_virtual > 1 and self.n_micro % (S // self.n_virtual):
+            # statically knowable: fail at transpile time, not inside jit
+            raise ValueError(
+                'circular pipeline (n_virtual=%d) injects microbatches in '
+                'rounds of the device count %d; n_micro=%d is not a '
+                'multiple' % (self.n_virtual, S // self.n_virtual,
+                              self.n_micro))
+
         program._pipeline_config = {
             'axis': self.axis,
             'n_micro': self.n_micro,
+            'n_virtual': self.n_virtual,
             'n_stages': S,
             'region': (lo, hi),
             'stage0': tuple(segs[0]),
@@ -318,7 +346,7 @@ class PipelineTranspiler(object):
             'extra_names': static,
         }
         from ._mesh_axes import rebuild_mesh_axes
-        base['pp_size'] = S
+        base['pp_size'] = S // self.n_virtual
         base['pp_axis'] = self.axis
         base.setdefault('sync_mode', True)
         base['mesh_axes'] = rebuild_mesh_axes(base)

@@ -41,14 +41,3 @@ def stack_unit_params(per_unit_params):
         lambda *xs: jnp.stack(xs, axis=0), *per_unit_params)
 
 
-def check_units_match_axis(stacked, mesh, axis, what):
-    """Every leaf's leading dim must EQUAL the mesh axis size — a multiple
-    would shard silently and drop units (each device applies only its
-    shard's first unit)."""
-    import jax
-    n = mesh.shape[axis]
-    for leaf in jax.tree_util.tree_leaves(stacked):
-        if leaf.shape[0] != n:
-            raise ValueError(
-                '%s: stacked leading dim %d must equal mesh axis %r size %d '
-                '(one %s per device)' % (what, leaf.shape[0], axis, n, what))

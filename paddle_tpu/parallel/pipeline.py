@@ -1,15 +1,27 @@
-"""Pipeline parallelism: GPipe-style microbatch streaming over a mesh axis.
+"""Pipeline parallelism: microbatch streaming over a mesh axis — GPipe and
+the circular (interleaved / virtual-stage) schedule.
 
 TPU-first design (the reference's closest notion is device placement of
 ops; it has no pipeline engine): stage parameters are STACKED on a leading
 [n_stages, ...] axis sharded over the `pp` mesh axis, so each device holds
-exactly its stage's weights. Inside shard_map, a lax.scan runs the classic
-collective-permute pipeline: every tick each device applies its stage to
+exactly its stages' weights. Inside shard_map, a lax.scan runs the classic
+collective-permute pipeline: every tick each device applies one stage to
 the activation it holds, then the ring `ppermute` hands the result to the
-next stage while the first stage ingests the next microbatch. After
-n_micro + n_stages - 1 ticks the last stage has emitted every microbatch.
-Bubble fraction is (n_stages-1)/(n_micro+n_stages-1) — the standard GPipe
-trade; raise n_micro to amortize.
+next device while the first device ingests the next microbatch.
+
+With n_virtual == 1 this is GPipe: n_micro + S - 1 ticks, bubble fraction
+(S-1)/(n_micro+S-1) — raise n_micro to amortize.
+
+With n_virtual == v > 1 it is the circular schedule (Megatron/praxis
+"interleaved 1F1B" loop placement): the model is cut into v*S chunks,
+device d holding chunks {p*S + d : p < v}, and each microbatch rides the
+ring v times. Microbatches are injected in rounds of S (n_micro must be a
+multiple of S); the schedule position u = t - d decomposes uniquely as
+u = ((r*v + p)*S + j), so every device applies exactly one chunk per tick
+with no collisions. Total ticks v*n_micro + S - 1, each 1/v the cost of a
+GPipe stage — the fill/drain bubble shrinks by v while per-device weight
+memory stays the same. The backward schedule falls out of XLA transposing
+the scan, exactly as for GPipe.
 
 `extras` are per-call tensors every stage reads but none produce (pad-mask
 biases, encoder output for a pipelined decoder stack): replicated over the
@@ -22,7 +34,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ._sp import stack_unit_params, check_units_match_axis
+from ._sp import stack_unit_params
 
 __all__ = ['pipeline_apply', 'stack_stage_params']
 
@@ -31,69 +43,108 @@ stack_stage_params = stack_unit_params
 
 
 def pipeline_apply(stage_fn, stacked_params, microbatches, mesh, axis='pp',
-                   extras=(), extras_streamed=()):
+                   extras=(), extras_streamed=(), n_virtual=1):
     """Run the pipeline.
 
     stage_fn(params, x, *extras_streamed_mb, *extras) -> y
                     same signature for every stage; all stages must map
                     [mb, ...] -> same shape/dtype (equal widths — pad if
                     needed)
-    stacked_params: pytree, leaves [n_stages, ...], sharded over `axis`
+    stacked_params: pytree, leaves [n_virtual * S, ...] (S = pp axis size)
+                    in sequential stage order — chunk g runs as phase
+                    g // S on device g % S
     microbatches:   [n_micro, mb, ...] (replicated or batch-sharded on dp)
     extras:         global tensors every stage reads whole (tied weights,
                     precomputed tables) — replicated over `axis`
     extras_streamed: batch-aligned tensors ([n_micro, mb, ...], microbatched
                     like x: pad-mask biases, a pipelined decoder's encoder
-                    output). At tick t, stage k is processing microbatch
-                    t - k, so each device dynamic-indexes its OWN in-flight
+                    output). Each device dynamic-indexes its OWN in-flight
                     microbatch slice — the tensors do not ride the ring.
-    Returns [n_micro, mb, ...]: the last stage's output per microbatch.
+    n_virtual:      chunks per device (circular schedule); > 1 requires
+                    n_micro to be a multiple of S.
+    Returns [n_micro, mb, ...]: the final chunk's output per microbatch.
     """
-    n_stages = mesh.shape[axis]
+    S = mesh.shape[axis]
+    v = int(n_virtual)
     n_micro = microbatches.shape[0]
-    check_units_match_axis(stacked_params, mesh, axis, 'pipeline stage')
+    leaves = jax.tree_util.tree_leaves(stacked_params)
+    # an empty pytree (activation-only stages) is valid: nothing to shard
+    if leaves and (v < 1 or leaves[0].shape[0] != v * S or any(
+            leaf.shape[0] != leaves[0].shape[0] for leaf in leaves)):
+        raise ValueError(
+            'pipeline stage: stacked leading dim %d must equal mesh axis '
+            '%r size %d times n_virtual=%d (one chunk per device per '
+            'phase)' % (leaves[0].shape[0], axis, S, v))
+    if v < 1:
+        raise ValueError('n_virtual must be >= 1, got %d' % v)
+    if v > 1 and n_micro % S:
+        raise ValueError(
+            'circular pipeline (n_virtual=%d) injects microbatches in '
+            'rounds of S=%d; n_micro=%d is not a multiple' % (v, S, n_micro))
     from jax import shard_map
     n_stream = len(extras_streamed)
 
+    # [v*S, ...] sequential chunk order -> [v, S, ...]: row p column d is
+    # chunk p*S + d, so sharding dim 1 over the pp axis gives device d its
+    # phase-indexed chunk block [v, 1, ...]
+    stacked_params = jax.tree_util.tree_map(
+        lambda w: w.reshape((v, S) + w.shape[1:]), stacked_params)
+
     def body(params, mbs, *ex):
         stream, glob = ex[:n_stream], ex[n_stream:]
-        # params leaves arrive as [1, ...] (this device's stage); unstack
-        p_local = jax.tree_util.tree_map(lambda x: x[0], params)
         idx = lax.axis_index(axis)
         is_first = idx == 0
-        is_last = idx == n_stages - 1
-        T = n_micro + n_stages - 1
-        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        is_last = idx == S - 1
+        T = v * n_micro + S - 1
+        perm = [(i, (i + 1) % S) for i in range(S)]
 
         def tick(carry, t):
             held = carry  # [mb, ...] activation each device currently holds
-            # first stage ingests microbatch t (or zeros past the end)
-            mb_idx = jnp.minimum(t, n_micro - 1)
-            fresh = lax.dynamic_index_in_dim(mbs, mb_idx, axis=0,
+            # schedule position: u = ((r*v + p)*S + j) uniquely — device
+            # idx works round r, phase p, round-slot j at tick t
+            u = t - idx
+            j = u % S
+            q = u // S
+            if v > 1:
+                p = q % v
+                mb = (q // v) * S + j
+            else:
+                p = 0
+                mb = u
+            mb_c = jnp.clip(mb, 0, n_micro - 1)
+            # first device ingests a fresh microbatch on phase 0; on later
+            # phases it consumes the wrap-around activation from the ring
+            fresh = lax.dynamic_index_in_dim(mbs, mb_c, axis=0,
                                              keepdims=False)
-            x = jnp.where(is_first, fresh, held)
-            # stage idx processes microbatch t - idx at tick t (clipped to
-            # a valid index during fill/drain; those results are discarded)
-            my_mb = jnp.clip(t - idx, 0, n_micro - 1)
-            sex = [lax.dynamic_index_in_dim(e, my_mb, axis=0,
+            ingest = is_first if v == 1 else (is_first & (p == 0))
+            x = jnp.where(ingest, fresh, held)
+            sex = [lax.dynamic_index_in_dim(e, mb_c, axis=0,
                                             keepdims=False) for e in stream]
-            y = stage_fn(p_local, x, *sex, *glob)
-            # last stage emits y at tick t when t - (n_stages-1) >= 0
-            emit_idx = t - (n_stages - 1)
-            # everyone passes its output to the next stage; the wraparound
-            # (last -> first) is ignored by the first stage's ingest above
+            if v > 1:
+                chunk = jax.tree_util.tree_map(
+                    lambda w: lax.dynamic_index_in_dim(
+                        w, p, axis=0, keepdims=False)[0], params)
+            else:
+                chunk = jax.tree_util.tree_map(lambda w: w[0, 0], params)
+            y = stage_fn(chunk, x, *sex, *glob)
+            # the last device completes microbatch mb on the final phase
+            emit = (u >= 0) & (mb < n_micro) & (p == v - 1)
+            emit_idx = jnp.where(emit, mb_c, -1)
+            # everyone passes its output to the next device; the wraparound
+            # (last -> first) either advances the phase or is ignored by
+            # the first device's ingest above
             handed = lax.ppermute(y, axis, perm)
             return handed, (y, emit_idx)
 
         init = jnp.zeros(mbs.shape[1:], mbs.dtype)
         _, (ys, emit_idxs) = lax.scan(tick, init, jnp.arange(T))
-        # gather the last stage's outputs in microbatch order
+        # gather the last device's completed outputs in microbatch order
         out = jnp.zeros((n_micro,) + ys.shape[1:], ys.dtype)
         valid = emit_idxs >= 0
         valid_b = valid.reshape(valid.shape + (1,) * (ys.ndim - 1))
         out = out.at[jnp.where(valid, emit_idxs, 0)].add(
             jnp.where(valid_b, ys, 0.0))
-        # only the last stage holds real outputs; broadcast them to all
+        # only the last device holds real outputs; broadcast them to all
         # shards so the result is replicated over the pp axis
         out = jnp.where(is_last, out, 0.0)
         out = lax.psum(out, axis)
@@ -120,7 +171,8 @@ def pipeline_apply(stage_fn, stacked_params, microbatches, mesh, axis='pp',
     manual = frozenset(a for a in ('dp', axis) if a in mesh.shape)
     fn = shard_map(
         body, mesh=mesh,
-        in_specs=(jax.tree_util.tree_map(lambda _: P(axis), stacked_params),
+        in_specs=(jax.tree_util.tree_map(lambda _: P(None, axis),
+                                         stacked_params),
                   mb_spec)
                  + tuple(mb_spec for _ in extras_streamed)
                  + tuple(P() for _ in extras),

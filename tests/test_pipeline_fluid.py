@@ -57,15 +57,17 @@ def _data():
             rng.rand(BATCH, 1).astype('float32'))
 
 
-def _train(transpile, steps=4):
+def _train(transpile, steps=4, n_virtual=1):
     xs, ys = _data()
     with fresh_program() as (main, startup):
         cost, _ = _build()
         params = [p.name for p in main.global_block().all_parameters()]
         if transpile:
-            fluid.PipelineTranspiler(n_micro=NMICRO).transpile(main)
+            fluid.PipelineTranspiler(n_micro=NMICRO,
+                                     n_virtual=n_virtual).transpile(main)
             cfg = main._pipeline_config
             assert cfg['n_stages'] == S
+            assert main._dist_config['pp_size'] == S // n_virtual
             assert len(cfg['param_names'][0]) == 1      # one fc.w per stage
             assert cfg['extra_names'] == []
             assert len(cfg['extra_stream_names']) == 1   # the shared mask
@@ -87,6 +89,34 @@ def test_pipeline_matches_sequential_training():
         np.testing.assert_allclose(pp_params[name], seq_params[name],
                                    rtol=1e-4, atol=1e-6,
                                    err_msg='parameter %s diverged' % name)
+
+
+def test_circular_pipeline_matches_sequential_training():
+    """n_virtual=2: the 4 stamped stages run as 2 chunks per device on a
+    pp=2 mesh (each microbatch rides the ring twice); losses AND updated
+    parameters match the sequential run."""
+    seq_losses, seq_params = _train(transpile=False)
+    pp_losses, pp_params = _train(transpile=True, n_virtual=2)
+    np.testing.assert_allclose(pp_losses, seq_losses, rtol=1e-4)
+    for name in seq_params:
+        np.testing.assert_allclose(pp_params[name], seq_params[name],
+                                   rtol=1e-4, atol=1e-6,
+                                   err_msg='parameter %s diverged' % name)
+
+
+def test_circular_pipeline_validation():
+    with fresh_program() as (main, startup):
+        _build()
+        # 4 stages / n_virtual=3 does not divide
+        with pytest.raises(ValueError, match='n_virtual'):
+            fluid.PipelineTranspiler(n_micro=NMICRO,
+                                     n_virtual=3).transpile(main)
+        # 4 stages / n_virtual=4 leaves a 1-device pipeline
+        with pytest.raises(ValueError, match='n_virtual'):
+            fluid.PipelineTranspiler(n_micro=NMICRO,
+                                     n_virtual=4).transpile(main)
+    with pytest.raises(ValueError, match='n_virtual'):
+        fluid.PipelineTranspiler(n_micro=2, n_virtual=0)
 
 
 def test_pipeline_validation_errors():
