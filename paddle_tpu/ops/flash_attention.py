@@ -489,12 +489,15 @@ _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 # On-chip tuned tile defaults (tools/tune_flash.py sweep, TPU v5e, bf16,
-# D in {64, 128}, T in {256, 512, 1024}, fwd+bwd): a 256-row q tile beats
-# the old 128/128 default by ~15-20% at every swept shape. Non-causal
-# favors (bq=256, bk=128); causal uses bq == bk == 256 so the triangular
-# block-skipping grid stays eligible (_use_tri), which tied the best
-# rectangular split where they differed. PADDLE_TPU_FLASH_BQ/BK override.
-_TUNED_BQ_BK = {True: (256, 256), False: (256, 128)}
+# D in {64, 128}, T in {256, 1024}, fwd+bwd, timed as chained on-device
+# steps — the axon tunnel's block_until_ready returns early, so per-step
+# host syncs mis-rank candidates): 512x512 tiles win at every swept shape,
+# 20-30% over the old 128/128 (5.77 -> 4.16 ms/step at causal T=1024
+# D=64; 6.03 -> 4.51 at T=256 D=64 where tiles clip to 256; 3.65-3.70
+# ms/step at D=128). Equal bq == bk keeps the causal triangular
+# block-skipping grid eligible (_use_tri). Shorter sequences clip the
+# tiles in _prep automatically. PADDLE_TPU_FLASH_BQ/BK override.
+_TUNED_BQ_BK = {True: (512, 512), False: (512, 512)}
 
 
 def _prep(q, k, v, key_bias, sm_scale, block_q, block_k, interpret,

@@ -67,16 +67,16 @@ def pipeline_apply(stage_fn, stacked_params, microbatches, mesh, axis='pp',
     S = mesh.shape[axis]
     v = int(n_virtual)
     n_micro = microbatches.shape[0]
-    leaves = jax.tree_util.tree_leaves(stacked_params)
-    # an empty pytree (activation-only stages) is valid: nothing to shard
-    if leaves and (v < 1 or leaves[0].shape[0] != v * S or any(
-            leaf.shape[0] != leaves[0].shape[0] for leaf in leaves)):
-        raise ValueError(
-            'pipeline stage: stacked leading dim %d must equal mesh axis '
-            '%r size %d times n_virtual=%d (one chunk per device per '
-            'phase)' % (leaves[0].shape[0], axis, S, v))
     if v < 1:
         raise ValueError('n_virtual must be >= 1, got %d' % v)
+    # an empty pytree (activation-only stages) is valid: nothing to shard
+    for leaf in jax.tree_util.tree_leaves(stacked_params):
+        if leaf.shape[0] != v * S:
+            raise ValueError(
+                'pipeline stage: stacked leading dim %d (leaf shape %r) '
+                'must equal mesh axis %r size %d times n_virtual=%d (one '
+                'chunk per device per phase)'
+                % (leaf.shape[0], tuple(leaf.shape), axis, S, v))
     if v > 1 and n_micro % S:
         raise ValueError(
             'circular pipeline (n_virtual=%d) injects microbatches in '

@@ -1,0 +1,38 @@
+"""On-chip timing helper shared by bench.py and tools/tune_flash.py.
+
+Through the axon TPU tunnel `jax.block_until_ready` returns before the
+computation has actually finished, and a per-step host sync adds a fixed
+round-trip that drowns small per-candidate deltas — so honest kernel
+timing chains the steps ON DEVICE (each step's input depends on the
+previous step's gradient) and round-trips ONE scalar whose value depends
+on the final result.
+"""
+import time
+
+__all__ = ['time_fwd_bwd_chained']
+
+
+def time_fwd_bwd_chained(loss_fn, q, k, v, iters, warmup=1):
+    """Seconds per fwd+bwd step of loss_fn(q, k, v) -> scalar, measured as
+    `iters` chained steps (q <- q + 1e-6 * dq) inside one jit with a
+    single scalar pulled to the host at the end."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    grad = jax.grad(loss_fn, argnums=(0, 1, 2))
+
+    @jax.jit
+    def run(q, k, v):
+        def body(_, qq):
+            dq, _, _ = grad(qq, k, v)
+            return qq + 1e-6 * dq
+        qn = jax.lax.fori_loop(0, iters, body, q)
+        return jnp.sum(qn[0, 0, 0, :8].astype(jnp.float32))
+
+    for _ in range(warmup):
+        s = float(run(q, k, v))     # compile + warm; host sync
+        assert np.isfinite(s), s
+    t0 = time.time()
+    s = float(run(q, k, v))         # host round-trip = completion
+    assert np.isfinite(s), s
+    return (time.time() - t0) / iters

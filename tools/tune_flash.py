@@ -58,15 +58,9 @@ def main():
                                 block_q=bq, block_k=bk, interpret=False)
             return jnp.sum(o.astype(jnp.float32) ** 2)
 
-        step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        from paddle_tpu.utils.timing import time_fwd_bwd_chained
         try:
-            g = step(q, k, v)
-            jax.block_until_ready(g)
-            t0 = time.time()
-            for _ in range(args.iters):
-                g = step(q, k, v)
-            jax.block_until_ready(g)
-            dt = (time.time() - t0) / args.iters
+            dt = time_fwd_bwd_chained(loss, q, k, v, args.iters)
         except Exception as e:
             print('bq=%-4d bk=%-4d FAILED: %s' % (bq, bk, str(e)[:80]))
             continue
