@@ -489,14 +489,15 @@ _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 # On-chip tuned tile defaults (tools/tune_flash.py sweep, TPU v5e, bf16,
-# D in {64, 128}, T in {256, 1024}, fwd+bwd, timed as chained on-device
-# steps — the axon tunnel's block_until_ready returns early, so per-step
-# host syncs mis-rank candidates): 512x512 tiles win at every swept shape,
-# 20-30% over the old 128/128 (5.77 -> 4.16 ms/step at causal T=1024
-# D=64; 6.03 -> 4.51 at T=256 D=64 where tiles clip to 256; 3.65-3.70
-# ms/step at D=128). Equal bq == bk keeps the causal triangular
-# block-skipping grid eligible (_use_tri). Shorter sequences clip the
-# tiles in _prep automatically. PADDLE_TPU_FLASH_BQ/BK override.
+# D in {64, 128}, T in {256, 1024}, full fwd+bwd timed as chained
+# on-device steps advancing q, k AND v — the axon tunnel's
+# block_until_ready returns early, and a chain consuming only dq would
+# DCE the dk/dv kernel): 512x512 tiles win at every swept shape, ~40%
+# over the old 128/128 (8.27 -> 4.84 ms/step at causal T=1024 D=64;
+# 3.97-4.10 ms/step at T=1024 D=128; T=256 clips to 256x256, its own
+# winner). Equal bq == bk keeps the causal triangular block-skipping grid
+# eligible (_use_tri). Shorter sequences clip the tiles in _prep
+# automatically. PADDLE_TPU_FLASH_BQ/BK override.
 _TUNED_BQ_BK = {True: (512, 512), False: (512, 512)}
 
 

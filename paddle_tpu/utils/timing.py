@@ -14,8 +14,11 @@ __all__ = ['time_fwd_bwd_chained']
 
 def time_fwd_bwd_chained(loss_fn, q, k, v, iters, warmup=1):
     """Seconds per fwd+bwd step of loss_fn(q, k, v) -> scalar, measured as
-    `iters` chained steps (q <- q + 1e-6 * dq) inside one jit with a
-    single scalar pulled to the host at the end."""
+    `iters` chained steps inside one jit with a single scalar pulled to
+    the host at the end. ALL THREE inputs advance by their gradients —
+    dq and (dk, dv) come from separate pallas calls in the flash backward,
+    so a chain that consumed only dq would let XLA dead-code-eliminate
+    the dk/dv kernel and time half a backward."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -23,11 +26,13 @@ def time_fwd_bwd_chained(loss_fn, q, k, v, iters, warmup=1):
 
     @jax.jit
     def run(q, k, v):
-        def body(_, qq):
-            dq, _, _ = grad(qq, k, v)
-            return qq + 1e-6 * dq
-        qn = jax.lax.fori_loop(0, iters, body, q)
-        return jnp.sum(qn[0, 0, 0, :8].astype(jnp.float32))
+        def body(_, qkv):
+            qq, kk, vv = qkv
+            dq, dk, dv = grad(qq, kk, vv)
+            return (qq + 1e-6 * dq, kk + 1e-6 * dk, vv + 1e-6 * dv)
+        qn, kn, vn = jax.lax.fori_loop(0, iters, body, (q, k, v))
+        return jnp.sum((qn[0, 0, 0, :8] + kn[0, 0, 0, :8]
+                        + vn[0, 0, 0, :8]).astype(jnp.float32))
 
     for _ in range(warmup):
         s = float(run(q, k, v))     # compile + warm; host sync
