@@ -332,6 +332,12 @@ class _CompiledStep(object):
         extra_names = cfg['extra_stream_names'] + cfg['extra_names']
         input_name, boundary0 = cfg['input_var'], cfg['boundary0']
 
+        # the region body is manual over dp/pp (and sp when composed);
+        # mesh-aware lowerings (sp attention) must use per-shard
+        # collective bodies on these axes instead of opening a shard_map
+        manual = (parallel.pipeline_manual_axes(self.mesh, cfg['axis'])
+                  if self.mesh is not None else frozenset())
+
         def stage_fn(p, xx, *ex):
             sub = dict(zip(extra_names, ex))
             sub.update(p)
@@ -339,7 +345,8 @@ class _CompiledStep(object):
             for t, op in enumerate(stage_ops):
                 lowering.run_op(op, sub, Ctx(key, lo0 + t, amp=self.amp,
                                              platform=self.platform,
-                                             mesh=self.mesh))
+                                             mesh=self.mesh,
+                                             manual_axes=manual))
                 if grad_mode:
                     # same stop_gradient contract as the sequential path
                     # (_run_ops): frozen vars stay frozen when pipelined

@@ -40,8 +40,15 @@ class LoDTensor(object):
         return [_lengths_to_offsets(level) for level in self._lengths]
 
     def has_valid_recursive_sequence_lengths(self):
+        """Full recursive check (reference lod_tensor.h CheckLoD): level k's
+        entry count must equal the sum of level k-1's lengths (each outer
+        sequence is a run of inner sequences), and the innermost level's
+        lengths must sum to the number of data rows."""
         if not self._lengths:
             return True
+        for outer, inner in zip(self._lengths, self._lengths[1:]):
+            if len(inner) != sum(outer):
+                return False
         total = sum(self._lengths[-1])
         return total == (self.data.shape[0] if self.data is not None else 0)
 
@@ -69,9 +76,13 @@ class LoDTensor(object):
         for i, l in enumerate(lens):
             padded[i, :l] = self.data[off:off + l]
             off += l
+        # Every level above the innermost rides along as a tuple of int32
+        # vectors (outermost first) — arbitrary-depth LoD, matching the
+        # reference's recursive LoD table (lod_tensor.h).
         outer = None
         if len(self._lengths) > 1:
-            outer = jnp.asarray(np.asarray(self._lengths[0], np.int32))
+            outer = tuple(jnp.asarray(np.asarray(lv, np.int32))
+                          for lv in self._lengths[:-1])
         return SeqValue(jnp.asarray(padded), jnp.asarray(lens), outer)
 
     @staticmethod
@@ -83,25 +94,56 @@ class LoDTensor(object):
             rows.append(data[i, :int(l)])
         flat = np.concatenate(rows, axis=0) if rows else data.reshape((0,) + data.shape[2:])
         lengths = [list(int(l) for l in lens)]
-        if sv.outer_lengths is not None:
-            lengths = [list(int(l) for l in np.asarray(sv.outer_lengths))] + lengths
+        for lv in reversed(sv.outer_lengths or ()):
+            lengths = [list(int(l) for l in np.asarray(lv))] + lengths
         return LoDTensor(flat, lengths)
 
 
-def create_lod_tensor(data, recursive_seq_lens, place=None):
-    """reference python/paddle/fluid/lod_tensor.py:create_lod_tensor."""
-    if isinstance(data, list):
-        # list of sequences (possibly nested); flatten
+def _nested_levels(data):
+    """Walk a nested list down to its innermost sequences. Returns
+    (levels, flat): `levels` is the recursive_seq_lens derived from the
+    nesting (one level per list depth above the innermost), `flat` the
+    innermost sequences as [len, d] arrays, in order."""
+    if isinstance(data[0], list) and data[0] and isinstance(data[0][0], list):
+        # one level of grouping above sequences: recurse per group
+        group_lens = []
+        sub_levels = None
         flat = []
-        lens = []
-        for seq in data:
-            seq = np.asarray(seq)
-            if seq.ndim == 1:
-                seq = seq[:, None]
-            lens.append(seq.shape[0])
-            flat.append(seq)
+        for group in data:
+            levels, seqs = _nested_levels(group)
+            group_lens.append(len(levels[0]) if levels else len(seqs))
+            if sub_levels is None:
+                sub_levels = [list(lv) for lv in levels]
+            else:
+                for acc, lv in zip(sub_levels, levels):
+                    acc.extend(lv)
+            flat.extend(seqs)
+        return [group_lens] + (sub_levels or []), flat
+    # innermost: a list of sequences (1-D scalar runs or [len, d] rows)
+    lens, flat = [], []
+    for seq in data:
+        seq = np.asarray(seq)
+        if seq.ndim == 1:
+            seq = seq[:, None]
+        lens.append(seq.shape[0])
+        flat.append(seq)
+    return [lens], flat
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    """reference python/paddle/fluid/lod_tensor.py:create_lod_tensor.
+
+    List `data` is interpreted as nested sequences of SCALARS (word ids
+    etc., the reference's documented list form): each nesting level above
+    the innermost becomes one LoD level. Pass an ndarray plus explicit
+    `recursive_seq_lens` for multi-dimensional rows."""
+    if isinstance(data, list):
+        # Nested list of sequences: each nesting level above the innermost
+        # contributes one LoD level (reference create_lod_tensor derives
+        # the recursive structure from the list shape).
+        levels, flat = _nested_levels(data)
         arr = np.concatenate(flat, axis=0)
-        return LoDTensor(arr, [lens])
+        return LoDTensor(arr, levels)
     arr = np.asarray(data)
     t = LoDTensor(arr, recursive_seq_lens)
     if not t.has_valid_recursive_sequence_lengths():

@@ -66,18 +66,24 @@ class Ctx(object):
     (the Executor's Place decides this — jax.default_backend() lies when a
     TPU plugin is present but the computation is placed on CPU), and the
     device mesh the step is compiled against (None = single device) so
-    mesh-aware rules (moe_mlp) can shard_map over it."""
+    mesh-aware rules (moe_mlp) can shard_map over it. `manual_axes` names
+    mesh axes the op is ALREADY manual over (inside a shard_map body, e.g.
+    the pipeline region): rules that would otherwise open their own
+    shard_map (sp attention) must instead use the per-shard collective
+    bodies on those axes."""
 
-    __slots__ = ('key', 'op_index', 'is_test', 'amp', 'platform', 'mesh')
+    __slots__ = ('key', 'op_index', 'is_test', 'amp', 'platform', 'mesh',
+                 'manual_axes')
 
     def __init__(self, key, op_index=0, is_test=False, amp=False,
-                 platform='cpu', mesh=None):
+                 platform='cpu', mesh=None, manual_axes=frozenset()):
         self.key = key
         self.op_index = op_index
         self.is_test = is_test
         self.amp = amp
         self.platform = platform
         self.mesh = mesh
+        self.manual_axes = manual_axes
 
     def rng(self):
         return jax.random.fold_in(self.key, self.op_index)
@@ -98,8 +104,13 @@ class SeqValue(object):
     TPU-first replacement for LoDTensor's flattened [total_tokens, d] layout
     (reference paddle/fluid/framework/lod_tensor.h): static shapes
     [batch, max_len, ...] keep XLA happy; `lengths` int32[batch] carries the
-    ragged structure; masked ops consult it. Nested LoD (level 2) keeps the
-    outer lengths in `outer_lengths`.
+    ragged structure of the INNERMOST LoD level; masked ops consult it.
+    Nested LoD of arbitrary depth (the reference's recursive LoD table)
+    keeps every level above the innermost in `outer_lengths`, a tuple of
+    int32 vectors ordered outermost-first: level k's entries are lengths
+    measured in units of level k+1's sequences, and the innermost level
+    (`lengths`) is measured in tokens/rows. A bare array is accepted for
+    the common 2-level case and normalised to a 1-tuple.
     """
 
     __slots__ = ('data', 'lengths', 'outer_lengths')
@@ -107,7 +118,12 @@ class SeqValue(object):
     def __init__(self, data, lengths, outer_lengths=None):
         self.data = data
         self.lengths = lengths
-        self.outer_lengths = outer_lengths
+        if outer_lengths is not None and not isinstance(outer_lengths, tuple):
+            if isinstance(outer_lengths, list):
+                outer_lengths = tuple(outer_lengths)
+            else:
+                outer_lengths = (outer_lengths,)
+        self.outer_lengths = outer_lengths or None
 
     @property
     def max_len(self):
@@ -120,13 +136,14 @@ class SeqValue(object):
 
     def tree_flatten(self):
         if self.outer_lengths is None:
-            return (self.data, self.lengths), False
-        return (self.data, self.lengths, self.outer_lengths), True
+            return (self.data, self.lengths), 0
+        return (self.data, self.lengths) + self.outer_lengths, \
+            len(self.outer_lengths)
 
     @classmethod
-    def tree_unflatten(cls, has_outer, children):
-        if has_outer:
-            return cls(children[0], children[1], children[2])
+    def tree_unflatten(cls, n_outer, children):
+        if n_outer:
+            return cls(children[0], children[1], tuple(children[2:2 + n_outer]))
         return cls(children[0], children[1])
 
 
@@ -175,7 +192,7 @@ def run_block(block, env, ctx):
     for i, op in enumerate(block.ops):
         run_op(op, env, Ctx(ctx.key, base + i, is_test=ctx.is_test,
                             amp=ctx.amp, platform=ctx.platform,
-                            mesh=ctx.mesh))
+                            mesh=ctx.mesh, manual_axes=ctx.manual_axes))
 
 
 # Default slot count for LoDTensorArray buffers (see ArrayValue). Layers

@@ -36,7 +36,8 @@ def _iter_ctx(ctx, t):
     """Fold the loop-iteration counter into the PRNG key so random ops
     (dropout etc.) inside loop bodies draw fresh bits every step."""
     return Ctx(jax.random.fold_in(ctx.key, t), is_test=ctx.is_test,
-               amp=ctx.amp, platform=ctx.platform, mesh=ctx.mesh)
+               amp=ctx.amp, platform=ctx.platform, mesh=ctx.mesh,
+               manual_axes=ctx.manual_axes)
 
 
 def _pred_where(cond, a, b):

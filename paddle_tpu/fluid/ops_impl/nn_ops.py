@@ -539,12 +539,27 @@ def _flash_attention(ins, attrs, ctx):
         # attention distributes over the sp axis as a ppermute ring; each
         # device holds O(T/sp) keys (flash blocks on TPU, dense on CPU)
         sp = mesh.shape['sp']
+        strategy = attrs.get('sp_strategy', 'ring')
+        if 'sp' in getattr(ctx, 'manual_axes', ()):
+            # already INSIDE a shard_map manual over sp (the pipeline
+            # region): q/k/v arrive sequence-LOCAL [B, H, T/sp, D]; call
+            # the per-shard collective bodies directly — nesting another
+            # shard_map here would be invalid
+            if strategy == 'ulysses':
+                from ...parallel.ulysses import ulysses_attention
+                out = ulysses_attention(q, k, v, 'sp', key_bias=kb,
+                                        causal=causal, sm_scale=scale)
+            else:
+                from ...parallel.ring_attention import ring_attention
+                out = ring_attention(q, k, v, 'sp', key_bias=kb,
+                                     causal=causal, sm_scale=scale)
+            return {'Out': out}
         if q.shape[2] % sp or k.shape[2] % sp:
             raise ValueError(
                 'sequence parallelism: the sp mesh axis size %d must '
                 'divide the seq lens %d/%d'
                 % (sp, q.shape[2], k.shape[2]))
-        if attrs.get('sp_strategy', 'ring') == 'ulysses':
+        if strategy == 'ulysses':
             from ...parallel.ulysses import ulysses_self_attention
             out = ulysses_self_attention(mesh, q, k, v, axis='sp',
                                          key_bias=kb, causal=causal,

@@ -67,7 +67,32 @@ def _sequence_pool(ins, attrs, ctx):
         out = jnp.squeeze(out, 1)
     else:
         raise ValueError("unknown pooltype %r" % ptype)
+    if x.outer_lengths:
+        # Nested LoD: pooling consumes the innermost level only (reference
+        # sequence_pool_op pools the last LoD level); the pooled rows — one
+        # per inner sequence — regroup under the next level out, which
+        # becomes the new innermost.
+        out = _regroup_rows(out, x.outer_lengths[-1],
+                            x.outer_lengths[:-1] or None)
     return {'Out': out, 'MaxIndex': None}
+
+
+def _regroup_rows(rows, group_lens, remaining_outers):
+    """[B, ...] rows -> padded SeqValue [G, B, ...] grouped into runs of
+    group_lens (int32[G]) consecutive rows. The time axis is padded to the
+    static bound B (total rows) so shapes stay static under jit."""
+    b = rows.shape[0]
+    starts = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         jnp.cumsum(group_lens.astype(jnp.int32))[:-1]])
+    j = jnp.arange(b, dtype=jnp.int32)
+    idx = jnp.clip(starts[:, None] + j[None, :], 0, b - 1)  # [G, B]
+    valid = j[None, :] < group_lens[:, None]
+    out = rows[idx]                                          # [G, B, ...]
+    while valid.ndim < out.ndim:
+        valid = valid[..., None]
+    out = jnp.where(valid, out, jnp.zeros((), out.dtype))
+    return SeqValue(out, group_lens, remaining_outers)
 
 
 @register('sequence_softmax')

@@ -85,16 +85,11 @@ class PipelineTranspiler(object):
     def transpile(self, program=None):
         if program is None:
             program = default_main_program()
-        # composition checks FIRST: they read only _dist_config, so a
-        # rejected transpile is O(1) and leaves the program unmodified
-        # (no stale _pipeline_config for clone() to silently re-run).
-        # tp composes (the shard_map is manual only over dp/pp — GSPMD
-        # partitions tp inside the stages); sp does not.
+        # tp composes via GSPMD (the shard_map is manual only over
+        # dp/pp/sp — GSPMD partitions tp inside the stages); sp composes
+        # manually: pipeline_apply shards the activation's sequence dim
+        # over 'sp' and the attention lowering rides the ring per shard.
         base = dict(getattr(program, '_dist_config', None) or {})
-        if int(base.get('sp_size') or 1) > 1:
-            raise ValueError(
-                'pipeline parallelism does not compose with sequence '
-                'parallelism (see sp_transpiler.py docstring)')
         block = program.global_block()
         ops = block.ops
 

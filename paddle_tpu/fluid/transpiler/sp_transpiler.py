@@ -17,10 +17,12 @@ through them.
 
 Composes with DistributeTranspiler (dp) — axis sizes multiply, so dp x sp
 needs dp*sp visible devices, each dp replica running its own ring over its
-batch slice. Does NOT compose with PipelineTranspiler (pp): the pipeline
-region already runs inside a shard_map, and nesting the ring's shard_map
-there would need the stage specs to carry the sequence sharding —
-transpile() rejects the combination rather than crashing at trace time.
+batch slice. Composes with PipelineTranspiler (pp) too: the pipeline
+region's shard_map is manual over dp/pp AND sp — pipeline_apply shards the
+activation's sequence dim over 'sp', stage bodies run sequence-local, and
+the attention lowering detects the manual context (ctx.manual_axes) and
+calls the per-shard ring/ulysses collective body instead of opening its
+own shard_map.
 """
 from ..framework import default_main_program
 
@@ -56,14 +58,6 @@ class SequenceParallelTranspiler(object):
                 'no fused_attention ops in the program — sequence '
                 'parallelism distributes attention; build the model with '
                 'fluid.layers.fused_attention (or nets.sdpa)')
-        if getattr(program, '_pipeline_config', None) is not None or \
-                int((getattr(program, '_dist_config', None) or {})
-                    .get('pp_size') or 1) > 1:
-            raise ValueError(
-                'sequence parallelism does not compose with pipeline '
-                'parallelism: the pipeline region already runs inside a '
-                'shard_map and cannot nest the attention ring (see module '
-                'docstring)')
         for blk in program.blocks:
             for op in blk.ops:
                 if op.type == 'flash_attention':

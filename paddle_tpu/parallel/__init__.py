@@ -22,13 +22,14 @@ __all__ = ['auto_tp_rules', 'fsdp_shard_params',
            'init_multihost', 'Mesh', 'NamedSharding', 'P',
            'ring_attention', 'ring_self_attention',
            'ulysses_attention', 'ulysses_self_attention',
-           'pipeline_apply', 'stack_stage_params',
+           'pipeline_apply', 'pipeline_manual_axes', 'stack_stage_params',
            'moe_apply', 'stack_expert_params', 'LocalSGD']
 
 from .ring_attention import ring_attention, ring_self_attention  # noqa: E402
 from .ulysses import ulysses_attention, ulysses_self_attention  # noqa: E402
 from .tp import auto_tp_rules  # noqa: E402
-from .pipeline import pipeline_apply, stack_stage_params  # noqa: E402
+from .pipeline import (pipeline_apply, pipeline_manual_axes,  # noqa: E402
+                       stack_stage_params)
 from .moe import moe_apply, stack_expert_params  # noqa: E402
 from .local_sgd import LocalSGD  # noqa: E402
 

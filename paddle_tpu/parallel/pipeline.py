@@ -36,7 +36,16 @@ from jax.sharding import PartitionSpec as P
 
 from ._sp import stack_unit_params
 
-__all__ = ['pipeline_apply', 'stack_stage_params']
+__all__ = ['pipeline_apply', 'pipeline_manual_axes', 'stack_stage_params']
+
+
+def pipeline_manual_axes(mesh, axis='pp'):
+    """The mesh axes pipeline_apply's shard_map goes MANUAL over: dp, sp
+    and the pipeline axis (tp stays automatic for GSPMD). Single source of
+    truth — the Executor passes this same set into the stage Ctx so the
+    attention lowering's per-shard routing always agrees with the actual
+    shard_map axis_names."""
+    return frozenset(a for a in ('dp', 'sp', axis) if a in mesh.shape)
 
 # [{param pytree} per stage] -> pytree with leading [n_stages, ...] axis
 stack_stage_params = stack_unit_params
@@ -60,6 +69,13 @@ def pipeline_apply(stage_fn, stacked_params, microbatches, mesh, axis='pp',
                     like x: pad-mask biases, a pipelined decoder's encoder
                     output). Each device dynamic-indexes its OWN in-flight
                     microbatch slice — the tensors do not ride the ring.
+                    CONTRACT under an 'sp' mesh axis: every streamed extra
+                    must be sequence-shaped [batch, seq, ...] (seq % sp
+                    == 0) — dim 2 post-microbatching is sharded over sp
+                    like the activation's. A per-row feature extra
+                    [batch, d] would have its FEATURE dim sharded;
+                    restructure it as a replicated `extras` entry or fold
+                    it into the activation when composing with sp.
     n_virtual:      chunks per device (circular schedule); > 1 requires
                     n_micro to be a multiple of S.
     Returns [n_micro, mb, ...]: the final chunk's output per microbatch.
@@ -153,28 +169,49 @@ def pipeline_apply(stage_fn, stacked_params, microbatches, mesh, axis='pp',
     # compose with data parallel: when the mesh also carries 'dp', the
     # microbatch dim (dim 1 of [n_micro, mb, ...]) stays dp-sharded and
     # every dp slice runs its own pipeline; global extras stay replicated
-    if 'dp' in mesh.shape and 'dp' != axis:
-        dp = mesh.shape['dp']
-        if microbatches.shape[1] % dp:
-            raise ValueError(
-                'per-microbatch size %d does not divide the dp mesh axis '
-                '%d — lower n_micro or the dp size so every dp shard gets '
-                'whole microbatch rows' % (microbatches.shape[1], dp))
-        mb_spec = P(None, 'dp')
-    else:
-        mb_spec = P()
-    # manual ONLY over dp + the pipeline axis: any other mesh axis (tp)
-    # stays automatic, so GSPMD partitions the matmuls INSIDE each stage
-    # by the stacked params' Megatron shardings and inserts the tp
+    dp_axis = 'dp' if ('dp' in mesh.shape and 'dp' != axis) else None
+    if dp_axis and microbatches.shape[1] % mesh.shape['dp']:
+        raise ValueError(
+            'per-microbatch size %d does not divide the dp mesh axis '
+            '%d — lower n_micro or the dp size so every dp shard gets '
+            'whole microbatch rows' % (microbatches.shape[1],
+                                       mesh.shape['dp']))
+    # compose with sequence parallel: an 'sp' mesh axis shards the
+    # SEQUENCE dim (dim 2 of [n_micro, mb, T, ...]) of the activation and
+    # every streamed extra; stage bodies then run sequence-local and the
+    # attention lowering rides the sp ring via its per-shard collective
+    # body (ops_impl/nn_ops.py routes on ctx.manual_axes)
+    sp_axis = 'sp' if ('sp' in mesh.shape and 'sp' != axis) else None
+    if sp_axis:
+        sp = mesh.shape['sp']
+        for t, name in [(microbatches, 'activation')] + \
+                [(e, 'streamed extra') for e in extras_streamed]:
+            if t.ndim < 3 or t.shape[2] % sp:
+                raise ValueError(
+                    'pp x sp: the %s (shape %r) needs a sequence dim at '
+                    'index 2 divisible by the sp mesh axis size %d — '
+                    'under sp every streamed extra must be sequence-shaped '
+                    '[batch, seq, ...]; pass per-row features as a '
+                    'replicated extra instead (see pipeline_apply '
+                    'docstring)' % (name, tuple(t.shape), sp))
+
+    def mbspec(ndim):
+        spec = [None, dp_axis, sp_axis] + [None] * (ndim - 3)
+        return P(*spec[:ndim])
+
+    mb_spec = mbspec(microbatches.ndim)
+    # manual ONLY over dp + sp + the pipeline axis: any other mesh axis
+    # (tp) stays automatic, so GSPMD partitions the matmuls INSIDE each
+    # stage by the stacked params' Megatron shardings and inserts the tp
     # all-reduces — the Megatron-style dp x pp x tp layout with no
     # hand-written tensor-parallel collectives
-    manual = frozenset(a for a in ('dp', axis) if a in mesh.shape)
+    manual = pipeline_manual_axes(mesh, axis)
     fn = shard_map(
         body, mesh=mesh,
         in_specs=(jax.tree_util.tree_map(lambda _: P(None, axis),
                                          stacked_params),
                   mb_spec)
-                 + tuple(mb_spec for _ in extras_streamed)
+                 + tuple(mbspec(e.ndim) for e in extras_streamed)
                  + tuple(P() for _ in extras),
         out_specs=mb_spec, axis_names=manual, check_vma=False)
     return fn(stacked_params, microbatches, *extras_streamed, *extras)

@@ -63,10 +63,69 @@ def test_seq_value_roundtrip_level2():
                           [[2, 1], [3, 2, 3]])
     sv = t.to_seq_value()
     assert sv.outer_lengths is not None
-    assert list(np.asarray(sv.outer_lengths)) == [2, 1]
+    assert list(np.asarray(sv.outer_lengths[-1])) == [2, 1]
     back = LoDTensor.from_seq_value(sv)
     np.testing.assert_array_equal(back.data, t.data)
     assert back.recursive_sequence_lengths() == [[2, 1], [3, 2, 3]]
+
+
+def test_seq_value_roundtrip_level3():
+    """Arbitrary-depth LoD (reference lod_tensor.h recursive LoD table):
+    every level above the innermost rides the SeqValue as one outer-lengths
+    vector, outermost first, and survives the device round-trip."""
+    # 2 books of [2, 1] chapters; 3 chapters of [2, 1, 2] sentences;
+    # 5 sentences of [2, 3, 1, 2, 2] words = 10 rows
+    lens = [[2, 1], [2, 1, 2], [2, 3, 1, 2, 2]]
+    t = create_lod_tensor(np.arange(10, dtype='float32').reshape(10, 1), lens)
+    assert t.has_valid_recursive_sequence_lengths()
+    sv = t.to_seq_value()
+    assert len(sv.outer_lengths) == 2
+    assert list(np.asarray(sv.outer_lengths[0])) == [2, 1]
+    assert list(np.asarray(sv.outer_lengths[1])) == [2, 1, 2]
+    back = LoDTensor.from_seq_value(sv)
+    np.testing.assert_array_equal(back.data, t.data)
+    assert back.recursive_sequence_lengths() == lens
+    # SeqValue is a pytree: deep LoD must survive jit tracing untouched
+    import jax
+    sv2 = jax.jit(lambda s: s)(sv)
+    assert back.recursive_sequence_lengths() == \
+        LoDTensor.from_seq_value(sv2).recursive_sequence_lengths()
+
+
+def test_multilevel_validity_check():
+    # level counts must chain: len(level k) == sum(level k-1)
+    bad = LoDTensor(np.zeros((5, 1)), [[2, 1], [2, 3]])  # 3 != 2 entries
+    assert not bad.has_valid_recursive_sequence_lengths()
+    good = LoDTensor(np.zeros((5, 1)), [[2, 1], [1, 2, 2]])
+    assert good.has_valid_recursive_sequence_lengths()
+    with pytest.raises(ValueError):
+        create_lod_tensor(np.zeros((5, 1)), [[2, 1], [2, 3]])
+
+
+def test_create_lod_tensor_from_nested_list():
+    t = create_lod_tensor([[[1, 2], [3]], [[4, 5, 6]]], None)
+    assert t.recursive_sequence_lengths() == [[2, 1], [2, 1, 3]]
+    np.testing.assert_array_equal(t.data.squeeze(-1), [1, 2, 3, 4, 5, 6])
+
+
+def test_sequence_pool_drops_innermost_lod_level():
+    """Pooling a depth-2 LoD consumes the innermost level (reference
+    sequence_pool_op): output rows are one per inner sequence, grouped
+    under the former outer level."""
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[1], dtype='float32', lod_level=2)
+        pooled = layers.sequence_pool(input=x, pool_type='sum')
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        t = create_lod_tensor(
+            np.array([[1.], [2.], [3.], [10.], [20.], [40.]], 'float32'),
+            [[2, 1], [2, 1, 3]])
+        out, = exe.run(main, feed={'x': t}, fetch_list=[pooled],
+                       return_numpy=False)
+    # inner sums: [1+2, 3, 10+20+40] grouped as [[3, 3], [70]]
+    assert out.recursive_sequence_lengths() == [[2, 1]]
+    np.testing.assert_allclose(np.asarray(out.data).squeeze(-1),
+                               [3., 3., 70.])
 
 
 def test_executor_feed_lod_tensor_sequence_pool():

@@ -331,13 +331,14 @@ def test_pipeline_multi_layer_stages():
 
 
 def test_rejected_transpile_leaves_program_unmodified():
-    """A pp-on-sp/tp rejection must not leave a stale _pipeline_config
-    behind (clone()'s _retranspile_pipeline would silently re-run it)."""
+    """A rejected transpile must not leave a stale _pipeline_config behind
+    (clone()'s _retranspile_pipeline would silently re-run it): every
+    validation error fires before the program is annotated."""
     with fresh_program() as (main, startup):
         _build()
         main._dist_config = {'sp_size': 2, 'mesh_axes': ('sp',)}
-        with pytest.raises(ValueError, match='does not compose'):
-            fluid.PipelineTranspiler(n_micro=2).transpile(main)
+        with pytest.raises(ValueError, match='n_virtual'):
+            fluid.PipelineTranspiler(n_micro=2, n_virtual=3).transpile(main)
         assert getattr(main, '_pipeline_config', None) is None
         assert 'pp_size' not in main._dist_config
 

@@ -67,24 +67,56 @@ def test_sp_rejects_indivisible_seq():
             exe.run(main, feed=feed_ids, fetch_list=[avg_cost])
 
 
-def test_sp_pp_composition_rejected_both_orders():
+def _train_pp_sp(pp, sp, dp=1, order='pp_first', seed=61, steps=2):
+    """Transformer with a pipelined decoder over a pp x sp (x dp) mesh."""
     from paddle_tpu.models import transformer as T
+    rng = np.random.RandomState(seed)
+    vocab, seq, batch = 32, 16, 4
+    feed_ids = {n: rng.randint(1, vocab, size=(batch, seq)).astype('int64')
+                for n in ('src_word', 'trg_word', 'lbl_word')}
     with fresh_program() as (main, startup):
         avg_cost, _, feeds = T.transformer(
-            32, 32, 16, n_layer=2, d_model=16, n_head=2, d_inner=32,
-            dropout_rate=0.0, pp_decoder=True)
+            vocab, vocab, seq, n_layer=2, d_model=16, n_head=2, d_inner=32,
+            dropout_rate=0.0, pp_decoder=pp)
         fluid.optimizer.SGD(learning_rate=0.01).minimize(avg_cost)
-        fluid.PipelineTranspiler(n_micro=2).transpile(main)
-        with pytest.raises(ValueError, match='does not compose'):
-            fluid.SequenceParallelTranspiler(sp=2).transpile(main)
-    with fresh_program() as (main, startup):
-        avg_cost, _, feeds = T.transformer(
-            32, 32, 16, n_layer=2, d_model=16, n_head=2, d_inner=32,
-            dropout_rate=0.0, pp_decoder=True)
-        fluid.optimizer.SGD(learning_rate=0.01).minimize(avg_cost)
-        fluid.SequenceParallelTranspiler(sp=2).transpile(main)
-        with pytest.raises(ValueError, match='does not compose'):
-            fluid.PipelineTranspiler(n_micro=2).transpile(main)
+        steps_t = []
+        if pp:
+            steps_t.append(lambda: fluid.PipelineTranspiler(
+                n_micro=2).transpile(main))
+        if sp:
+            steps_t.append(lambda: fluid.SequenceParallelTranspiler(
+                sp=sp).transpile(main))
+        if order != 'pp_first':
+            steps_t.reverse()
+        for t in steps_t:
+            t()
+        if dp > 1:
+            fluid.DistributeTranspiler().transpile(trainer_id=0,
+                                                   trainers=dp)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        return [float(exe.run(main, feed=feed_ids,
+                              fetch_list=[avg_cost])[0])
+                for _ in range(steps)]
+
+
+def test_pp_sp_composition_matches_single_device():
+    """pp x sp: the pipeline shard_map is manual over pp AND sp; stage
+    bodies run sequence-local with the ring riding per shard. Both
+    transpile orders == sequential."""
+    base = _train_pp_sp(pp=False, sp=0)
+    assert base[0] != base[1]
+    np.testing.assert_allclose(_train_pp_sp(pp=True, sp=2), base,
+                               rtol=2e-4)
+    np.testing.assert_allclose(
+        _train_pp_sp(pp=True, sp=2, order='sp_first'), base, rtol=2e-4)
+
+
+def test_three_way_dp_pp_sp_composition():
+    """dp=2 x pp=2 x sp=2 on the 8-device mesh == single-device."""
+    base = _train_pp_sp(pp=False, sp=0, seed=62)
+    got = _train_pp_sp(pp=True, sp=2, dp=2, seed=62)
+    np.testing.assert_allclose(got, base, rtol=2e-4)
 
 
 def test_sp_dp_composition_matches_single_device():
