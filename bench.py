@@ -311,139 +311,242 @@ def _mfu(flops_per_sec, platform):
     return round(flops_per_sec / (PEAK_TFLOPS * 1e12), 4)
 
 
+NAME_T = 'transformer_base_train_tokens_per_sec_per_chip'
+NAME_R = 'resnet50_train_images_per_sec_per_chip'
+NAME_L = 'transformer_base_seq1024_train_tokens_per_sec_per_chip'
+NAME_F = 'flash_causal_seq32768_tokens_per_sec_per_chip'
+PHASES = ('transformer', 'resnet', 'longseq', 'longctx')
+PHASE_NAMES = {'transformer': NAME_T, 'resnet': NAME_R,
+               'longseq': NAME_L, 'longctx': NAME_F}
+
+
+def _tier(platform):
+    """Shape/iteration tier for a platform. The CPU tier MUST be tiny:
+    full TPU shapes on the host would blow the whole budget on compiles."""
+    on_cpu = platform != 'tpu'
+    return dict(
+        use_amp=os.environ.get('BENCH_AMP', '1') == '1',
+        iters=int(os.environ.get('BENCH_ITERS', '2' if on_cpu else '12')),
+        rbatch=int(os.environ.get('BENCH_BATCH', '16' if on_cpu else '1024')),
+        tbatch=int(os.environ.get('BENCH_TBATCH', '4' if on_cpu else '64')),
+        seq=int(os.environ.get('BENCH_SEQ', '64' if on_cpu else '256')))
+
+
+def _transformer_metric(name, batch, seq_len, iters, use_amp, platform,
+                        fallback_batch=None):
+    """Run one transformer phase and emit its metric line (shared by the
+    contract seq-256 phase and the long-seq bonus phase)."""
+    try:
+        attempts = [dict(batch_size=batch, seq_len=seq_len, iters=iters,
+                         use_amp=use_amp)]
+        if fallback_batch:
+            attempts.append(dict(batch_size=fallback_batch,
+                                 seq_len=seq_len, iters=iters,
+                                 use_amp=use_amp))
+        tps, n_params = _try(bench_transformer, *attempts)
+        flops = 6.0 * n_params * tps
+        _emit({'metric': name, 'value': round(tps, 2),
+               'unit': 'tokens/sec/chip',
+               'vs_baseline': round(tps / REF_TOKENS_PER_SEC, 3),
+               'tflops': round(flops / 1e12, 2),
+               'mfu': _mfu(flops, platform),
+               'params': int(n_params), 'platform': platform,
+               'batch': batch, 'seq_len': seq_len, 'amp': use_amp})
+    except Exception as e:
+        _log('%s failed: %r' % (name, e))
+        _emit({'metric': name, 'skipped': True, 'error': str(e)[:300]})
+
+
+def run_phase(phase, platform):
+    """Child-process entry: run ONE phase inline and emit its metric
+    line(s). Isolation means a tunnel hang mid-phase kills only this
+    process — the parent's timeout fires, and later phases still run."""
+    _setup_jax(force_cpu=platform != 'tpu')
+    t = _tier(platform)
+    if phase == 'transformer':
+        _transformer_metric(NAME_T, t['tbatch'], t['seq'], t['iters'],
+                            t['use_amp'], platform,
+                            fallback_batch=max(4, t['tbatch'] // 4))
+    elif phase == 'resnet':
+        try:
+            ips = _try(bench_resnet50,
+                       dict(batch_size=t['rbatch'], iters=t['iters'],
+                            use_amp=t['use_amp']),
+                       dict(batch_size=max(8, t['rbatch'] // 4),
+                            iters=t['iters'], use_amp=t['use_amp']))
+            flops = ips * RESNET50_TRAIN_FLOPS_PER_IMG
+            _emit({'metric': NAME_R, 'value': round(ips, 2),
+                   'unit': 'images/sec/chip',
+                   'vs_baseline': round(ips / REF_IMAGES_PER_SEC, 3),
+                   'tflops': round(flops / 1e12, 2),
+                   'mfu': _mfu(flops, platform),
+                   'platform': platform, 'batch': t['rbatch'],
+                   'amp': t['use_amp']})
+        except Exception as e:
+            _log('resnet50 bench failed: %r' % e)
+            _emit({'metric': NAME_R, 'skipped': True,
+                   'error': str(e)[:300]})
+    elif phase == 'longseq':
+        _transformer_metric(NAME_L, 8, 1024, t['iters'], t['use_amp'],
+                            platform)
+    elif phase == 'longctx':
+        try:
+            tps, fps, peak = bench_flash_longcontext()
+            _emit({'metric': NAME_F, 'value': round(tps, 2),
+                   'unit': 'tokens/sec/chip', 'vs_baseline': None,
+                   'tflops': round(fps / 1e12, 2),
+                   'mfu': _mfu(fps, platform),
+                   'peak_hbm_gb': round(peak / 2 ** 30, 2) if peak
+                   else None,
+                   'platform': platform, 'batch': 1, 'seq_len': 32768,
+                   'amp': True})
+        except Exception as e:
+            _log('%s failed: %r' % (NAME_F, e))
+            _emit({'metric': NAME_F, 'skipped': True,
+                   'error': str(e)[:300]})
+    else:
+        raise SystemExit('unknown phase %r' % phase)
+
+
+def _run_phase_subprocess(phase, platform, timeout_s, metrics, seen_names):
+    """Spawn `bench.py --phase` with a hard timeout; re-emit its metric
+    lines as they arrive (streaming survives a later phase dying) and
+    collect successes into `metrics`. Returns 'ok', 'timeout' or 'died'.
+
+    Round-4 lesson: the axon tunnel died MID-phase and the in-process jax
+    call blocked forever — no Python-level exception, no budget check, the
+    whole bench rode rc=124 with no output. A subprocess with a kill is
+    the only reliable containment."""
+    cmd = [sys.executable, os.path.abspath(__file__),
+           '--phase', phase, '--platform', platform]
+    _log('phase %s: spawning (timeout %.0fs)' % (phase, timeout_s))
+    # the child re-imports this module, resetting its _T0 — forward the
+    # ACTUAL time it has, so in-child budget guards (_try's no-retry
+    # check) fire instead of reading a fresh full budget
+    env = dict(os.environ,
+               BENCH_BUDGET_S=str(int(max(60, min(timeout_s,
+                                                  _budget_left())))))
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=None,
+                            text=True, env=env)
+    import threading
+
+    def pump():
+        for line in proc.stdout:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                _log('phase %s: non-JSON stdout %r' % (phase, line[:120]))
+                continue
+            if 'skipped' not in obj and obj.get('value') is not None:
+                metrics.append(obj)
+            if obj.get('metric'):
+                seen_names.add(obj['metric'])
+            _emit(obj)
+
+    th = threading.Thread(target=pump, daemon=True)
+    th.start()
+    try:
+        proc.wait(timeout=timeout_s)
+        th.join(timeout=30)
+        return 'ok' if proc.returncode == 0 else 'died'
+    except subprocess.TimeoutExpired:
+        _log('phase %s: TIMED OUT after %.0fs — killing (tunnel hang?)'
+             % (phase, timeout_s))
+        proc.kill()
+        proc.wait()
+        th.join(timeout=30)
+        return 'timeout'
+
+
 def main():
+    if '--phase' in sys.argv:
+        i = sys.argv.index('--phase')
+        phase = sys.argv[i + 1]
+        platform = 'tpu'
+        if '--platform' in sys.argv:
+            platform = sys.argv[sys.argv.index('--platform') + 1]
+        run_phase(phase, platform)
+        return
+
     platform = _probe_backend()
     if platform is None:
         _log('accelerator unreachable — falling back to CPU, tiny shapes')
         platform = 'cpu'
-    # force jax onto CPU for ANY non-tpu platform: the axon plugin ignores
-    # JAX_PLATFORMS and hangs at in-process backend init when the tunnel is
-    # down, even after the subprocess probe said 'cpu'. The shape tier MUST
-    # follow the same predicate — full TPU shapes on a forced-CPU host
-    # would blow the whole budget on one compile.
-    on_cpu = platform != 'tpu'
-    if on_cpu and platform != 'cpu':
+    if platform != 'tpu' and platform != 'cpu':
         _log('unrecognized platform %r: treating as cpu' % platform)
         platform = 'cpu'
-    _setup_jax(force_cpu=on_cpu)
-
-    use_amp = os.environ.get('BENCH_AMP', '1') == '1'
-    iters = int(os.environ.get('BENCH_ITERS', '2' if on_cpu else '12'))
-    rbatch = int(os.environ.get('BENCH_BATCH', '16' if on_cpu else '1024'))
-    tbatch = int(os.environ.get('BENCH_TBATCH', '4' if on_cpu else '64'))
-    seq = int(os.environ.get('BENCH_SEQ', '64' if on_cpu else '256'))
-    _log('platform=%s amp=%s budget=%.0fs' % (platform, use_amp, BUDGET_S))
+    _log('platform=%s budget=%.0fs' % (platform, BUDGET_S))
 
     metrics = []
-    rname = 'resnet50_train_images_per_sec_per_chip'
+    emitted = set()
 
-    def transformer_metric(name, batch, seq_len, fallback_batch=None):
-        """Run one transformer phase and emit its metric line (shared by
-        the contract seq-256 phase and the long-seq bonus phase)."""
-        try:
-            attempts = [dict(batch_size=batch, seq_len=seq_len, iters=iters,
-                             use_amp=use_amp)]
-            if fallback_batch:
-                attempts.append(dict(batch_size=fallback_batch,
-                                     seq_len=seq_len, iters=iters,
-                                     use_amp=use_amp))
-            tps, n_params = _try(bench_transformer, *attempts)
-            flops = 6.0 * n_params * tps
-            m = {'metric': name, 'value': round(tps, 2),
-                 'unit': 'tokens/sec/chip',
-                 'vs_baseline': round(tps / REF_TOKENS_PER_SEC, 3),
-                 'tflops': round(flops / 1e12, 2),
-                 'mfu': _mfu(flops, platform),
-                 'params': int(n_params), 'platform': platform,
-                 'batch': batch, 'seq_len': seq_len, 'amp': use_amp}
-            metrics.append(m)
-            _emit(m)
-        except Exception as e:
-            _log('%s failed: %r' % (name, e))
-            _emit({'metric': name, 'skipped': True, 'error': str(e)[:300]})
+    def gate_bonus(phase):
+        """Budget/env gates for the two bonus phases (parent side)."""
+        env = 'BENCH_LONGSEQ' if phase == 'longseq' else 'BENCH_LONGCTX'
+        floor = 420 if phase == 'longseq' else 240
+        if os.environ.get(env, '1') != '1':
+            return 'disabled'
+        if platform != 'tpu':
+            return 'cpu fallback platform'
+        if _budget_left() < floor:
+            return 'budget reserved for contract metrics'
+        return None
 
     # PHASE ORDER: transformer first. Its compile is minutes cheaper than
-    # batch-1024 ResNet's, and it is the metric with no harness evidence
-    # from rounds 1-2 — if a cold-cache compile eats the budget, this order
-    # still banks one contract number instead of zero.
-    tname = 'transformer_base_train_tokens_per_sec_per_chip'
-    if _budget_left() < 120:
-        _emit({'metric': tname, 'skipped': True,
-               'reason': 'wall-clock budget exhausted before phase start'})
-    else:
-        transformer_metric(tname, tbatch, seq, fallback_batch=max(4, tbatch // 4))
-
-    if _budget_left() < 120:
-        _emit({'metric': rname, 'skipped': True,
-               'reason': 'wall-clock budget exhausted before phase start'})
-    else:
-        try:
-            ips = _try(bench_resnet50,
-                       dict(batch_size=rbatch, iters=iters, use_amp=use_amp),
-                       dict(batch_size=max(8, rbatch // 4), iters=iters,
-                            use_amp=use_amp))
-            flops = ips * RESNET50_TRAIN_FLOPS_PER_IMG
-            m = {'metric': rname, 'value': round(ips, 2),
-                 'unit': 'images/sec/chip',
-                 'vs_baseline': round(ips / REF_IMAGES_PER_SEC, 3),
-                 'tflops': round(flops / 1e12, 2),
-                 'mfu': _mfu(flops, platform),
-                 'platform': platform, 'batch': rbatch, 'amp': use_amp}
-            metrics.append(m)
-            _emit(m)
-        except Exception as e:
-            _log('resnet50 bench failed: %r' % e)
-            _emit({'metric': rname, 'skipped': True, 'error': str(e)[:300]})
-
-    # bonus: long-sequence Transformer through the pallas flash path —
-    # showcases the long-context design; only after both contract metrics,
-    # only with generous budget left, skippable via BENCH_LONGSEQ=0
-    lname = 'transformer_base_seq1024_train_tokens_per_sec_per_chip'
-    if os.environ.get('BENCH_LONGSEQ', '1') != '1' or on_cpu:
-        _emit({'metric': lname, 'skipped': True,
-               'reason': 'disabled' if os.environ.get('BENCH_LONGSEQ') == '0'
-                         else 'cpu fallback platform'})
-    elif _budget_left() < 420:
-        _emit({'metric': lname, 'skipped': True,
-               'reason': 'budget reserved for contract metrics'})
-    else:
-        transformer_metric(lname, 8, 1024)
-
-    # bonus 2: causal flash at 32k context on one chip — the long-context
-    # linear-memory claim with a measured number (XLA attention would need
-    # a ~34 GB score tensor here). Cheap (~1 min) but strictly after the
-    # contract metrics; BENCH_LONGCTX=0 disables.
-    fname = 'flash_causal_seq32768_tokens_per_sec_per_chip'
-    if os.environ.get('BENCH_LONGCTX', '1') != '1' or on_cpu:
-        _emit({'metric': fname, 'skipped': True,
-               'reason': 'cpu fallback platform' if on_cpu else 'disabled'})
-    elif _budget_left() < 240:
-        _emit({'metric': fname, 'skipped': True,
-               'reason': 'budget reserved for contract metrics'})
-    else:
-        try:
-            tps, fps, peak = bench_flash_longcontext()
-            m = {'metric': fname, 'value': round(tps, 2),
-                 'unit': 'tokens/sec/chip', 'vs_baseline': None,
-                 'tflops': round(fps / 1e12, 2), 'mfu': _mfu(fps, platform),
-                 'peak_hbm_gb': round(peak / 2 ** 30, 2) if peak else None,
-                 'platform': platform, 'batch': 1, 'seq_len': 32768,
-                 'amp': True}
-            metrics.append(m)
-            _emit(m)
-        except Exception as e:
-            _log('%s failed: %r' % (fname, e))
-            _emit({'metric': fname, 'skipped': True, 'error': str(e)[:300]})
+    # batch-1024 ResNet's, and it is the metric with the least harness
+    # evidence — if a cold-cache compile eats the budget, this order still
+    # banks one contract number instead of zero.
+    for phase in PHASES:
+        name = PHASE_NAMES[phase]
+        if phase in ('longseq', 'longctx'):
+            reason = gate_bonus(phase)
+            if reason:
+                _emit({'metric': name, 'skipped': True, 'reason': reason})
+                emitted.add(name)
+                continue
+        if _budget_left() < 120:
+            _emit({'metric': name, 'skipped': True,
+                   'reason': 'wall-clock budget exhausted before phase '
+                             'start'})
+            emitted.add(name)
+            continue
+        # leave at least 240s for the phases after the two contract ones;
+        # a phase never gets more than 55% of the total budget
+        reserve = 240 if phase in ('transformer', 'resnet') else 60
+        timeout_s = max(120, min(_budget_left() - reserve,
+                                 0.55 * BUDGET_S))
+        status = _run_phase_subprocess(phase, platform, timeout_s, metrics,
+                                       emitted)
+        if status != 'ok':
+            if name not in emitted:
+                _emit({'metric': name, 'skipped': True,
+                       'error': 'phase %s %s after %.0fs (accelerator '
+                                'hang or crash)'
+                                % (phase, status, timeout_s)})
+                emitted.add(name)
+            if platform == 'tpu':
+                # the chip (or its tunnel) may be gone: cheap re-probe;
+                # if it no longer answers, run the REMAINING phases on
+                # CPU tiny shapes so the driver still gets valid numbers
+                _log('re-probing accelerator after failed phase...')
+                p2 = _probe_backend_once(90)
+                if p2 != 'tpu':
+                    _log('accelerator gone (probe=%r) — remaining phases '
+                         'fall back to CPU tiny shapes' % (p2,))
+                    platform = 'cpu'
 
     # headline LAST so a line-by-line parser and a last-line parser agree;
     # it is ALWAYS the ResNet-50 series (round-1 continuity) — when that
     # phase failed, the headline says so explicitly rather than silently
     # switching series to whatever did complete
-    resnet = [m for m in metrics if m['metric'] == rname]
+    resnet = [m for m in metrics if m['metric'] == NAME_R]
     if resnet:
         out = dict(resnet[0])
     else:
-        out = {'metric': rname, 'value': None, 'unit': 'images/sec/chip',
+        out = {'metric': NAME_R, 'value': None, 'unit': 'images/sec/chip',
                'vs_baseline': None,
                'error': 'resnet phase did not complete (accelerator '
                         'unreachable, OOM, or budget exhausted)'}
