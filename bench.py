@@ -364,9 +364,10 @@ def run_phase(phase, platform):
     _setup_jax(force_cpu=platform != 'tpu')
     t = _tier(platform)
     if phase == 'transformer':
+        fb = max(4, t['tbatch'] // 4)
         _transformer_metric(NAME_T, t['tbatch'], t['seq'], t['iters'],
                             t['use_amp'], platform,
-                            fallback_batch=max(4, t['tbatch'] // 4))
+                            fallback_batch=fb if fb != t['tbatch'] else None)
     elif phase == 'resnet':
         try:
             ips = _try(bench_resnet50,
@@ -448,17 +449,19 @@ def _run_phase_subprocess(phase, platform, timeout_s, metrics, seen_names):
 
     th = threading.Thread(target=pump, daemon=True)
     th.start()
+    t0 = time.time()
     try:
         proc.wait(timeout=timeout_s)
         th.join(timeout=30)
-        return 'ok' if proc.returncode == 0 else 'died'
+        return ('ok' if proc.returncode == 0 else 'died',
+                time.time() - t0)
     except subprocess.TimeoutExpired:
         _log('phase %s: TIMED OUT after %.0fs — killing (tunnel hang?)'
              % (phase, timeout_s))
         proc.kill()
         proc.wait()
         th.join(timeout=30)
-        return 'timeout'
+        return 'timeout', time.time() - t0
 
 
 def main():
@@ -518,14 +521,14 @@ def main():
         reserve = 240 if phase in ('transformer', 'resnet') else 60
         timeout_s = max(120, min(_budget_left() - reserve,
                                  0.55 * BUDGET_S))
-        status = _run_phase_subprocess(phase, platform, timeout_s, metrics,
-                                       emitted)
+        status, elapsed = _run_phase_subprocess(phase, platform, timeout_s,
+                                                metrics, emitted)
         if status != 'ok':
             if name not in emitted:
                 _emit({'metric': name, 'skipped': True,
                        'error': 'phase %s %s after %.0fs (accelerator '
                                 'hang or crash)'
-                                % (phase, status, timeout_s)})
+                                % (phase, status, elapsed)})
                 emitted.add(name)
             if platform == 'tpu':
                 # the chip (or its tunnel) may be gone: cheap re-probe;

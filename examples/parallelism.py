@@ -1,8 +1,8 @@
-"""Program-level parallelism tour: pp / sp / local-SGD on a virtual mesh.
+"""Program-level parallelism tour: pp / sp / pp+sp / local-SGD on a mesh.
 
 TPU-first capabilities beyond the reference book chapters (the reference's
 distributed story is pserver scripts; see docs/distributed.md): one small
-Fluid Transformer is trained three ways on an 8-device mesh —
+Fluid Transformer is trained four ways on an 8-device mesh —
 
   1. pipeline parallelism: decoder stages stamped with
      fluid.device_guard('pipe:K'), transpiled by fluid.PipelineTranspiler,
@@ -10,7 +10,9 @@ Fluid Transformer is trained three ways on an 8-device mesh —
   2. sequence parallelism: fluid.SequenceParallelTranspiler routes every
      fused_attention through the ring (flash blocks on TPU) — the
      long-context path;
-  3. local SGD (parallel.LocalSGD): the async-training analogue — dp
+  3. pp + sp composed: pipeline stage bodies run sequence-local, the
+     attention ring turning inside the pipeline's shard_map;
+  4. local SGD (parallel.LocalSGD): the async-training analogue — dp
      replicas take collective-free local steps and periodically average.
 
 Run:  python examples/parallelism.py [--steps 4]
@@ -70,14 +72,21 @@ def main():
         print('%-10s loss %.4f -> %.4f' % (tag, out[0], out[-1]))
         return out
 
+    def pp_and_sp(p):
+        # the composed stack: pipelined decoder stages run sequence-local,
+        # attention rides the sp ring inside the pipeline's shard_map
+        fluid.PipelineTranspiler(n_micro=2).transpile(p)
+        fluid.SequenceParallelTranspiler(sp=2).transpile(p)
+
     train('baseline', lambda p: None)
     train('pipeline', lambda p: fluid.PipelineTranspiler(
         n_micro=2).transpile(p), pp_decoder=True)
     train('seq-par', lambda p: fluid.SequenceParallelTranspiler(
         sp=8).transpile(p))
+    train('pp+sp', pp_and_sp, pp_decoder=True)
 
     # identical math, different schedules
-    for tag in ('pipeline', 'seq-par'):
+    for tag in ('pipeline', 'seq-par', 'pp+sp'):
         np.testing.assert_allclose(losses[tag], losses['baseline'],
                                    rtol=2e-4)
 

@@ -26,6 +26,9 @@ _SLOW_TESTS = {
     'test_nhwc.py::test_resnet18_nhwc_matches_nchw',
     'test_pipeline_fluid.py::test_pipeline_multi_layer_stages',
     'test_sp_fluid.py::test_sp_and_pp_compose_with_amp',
+    'test_sp_fluid.py::test_pp_sp_composition_matches_single_device',
+    'test_sp_fluid.py::test_three_way_dp_pp_sp_composition',
+    'test_sp_fluid.py::test_pp_sp_ulysses_strategy',
     'test_tp_fluid.py::test_dp_pp_tp_three_way_matches_single_device[pp_first]',
     'test_sp_fluid.py::test_sp_transformer_matches_single_device',
     'test_tp_fluid.py::test_dp_pp_tp_three_way_matches_single_device[tp_first]',

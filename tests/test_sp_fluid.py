@@ -67,7 +67,8 @@ def test_sp_rejects_indivisible_seq():
             exe.run(main, feed=feed_ids, fetch_list=[avg_cost])
 
 
-def _train_pp_sp(pp, sp, dp=1, order='pp_first', seed=61, steps=2):
+def _train_pp_sp(pp, sp, dp=1, order='pp_first', seed=61, steps=2,
+                 strategy='ring'):
     """Transformer with a pipelined decoder over a pp x sp (x dp) mesh."""
     from paddle_tpu.models import transformer as T
     rng = np.random.RandomState(seed)
@@ -85,7 +86,7 @@ def _train_pp_sp(pp, sp, dp=1, order='pp_first', seed=61, steps=2):
                 n_micro=2).transpile(main))
         if sp:
             steps_t.append(lambda: fluid.SequenceParallelTranspiler(
-                sp=sp).transpile(main))
+                sp=sp, strategy=strategy).transpile(main))
         if order != 'pp_first':
             steps_t.reverse()
         for t in steps_t:
@@ -116,6 +117,14 @@ def test_three_way_dp_pp_sp_composition():
     """dp=2 x pp=2 x sp=2 on the 8-device mesh == single-device."""
     base = _train_pp_sp(pp=False, sp=0, seed=62)
     got = _train_pp_sp(pp=True, sp=2, dp=2, seed=62)
+    np.testing.assert_allclose(got, base, rtol=2e-4)
+
+
+def test_pp_sp_ulysses_strategy():
+    """The ulysses all-to-all per-shard body also runs inside the
+    pipeline's manual shard_map (n_head=2 == sp)."""
+    base = _train_pp_sp(pp=False, sp=0, seed=63)
+    got = _train_pp_sp(pp=True, sp=2, seed=63, strategy='ulysses')
     np.testing.assert_allclose(got, base, rtol=2e-4)
 
 
