@@ -25,7 +25,11 @@ from . import metrics
 from . import average
 from .param_attr import ParamAttr, WeightNormParamAttr
 from .data_feeder import DataFeeder
-from .lod_tensor import LoDTensor, create_lod_tensor, create_random_int_lodtensor
+from .lod_tensor import LoDTensor, LoDTensorArray, create_lod_tensor, \
+    create_random_int_lodtensor
+# API parity re-export (reference fluid/__init__.py imports it by name);
+# the patch itself is applied as math_op_patch's import side effect
+from .layers.math_op_patch import monkey_patch_variable
 from . import unique_name
 from . import amp
 from . import annotations
@@ -57,6 +61,7 @@ __all__ = framework.__all__ + executor.__all__ + transpiler.__all__ + \
     trainer.__all__ + inferencer.__all__ + [
     'io', 'initializer', 'layers', 'transpiler', 'nets', 'optimizer',
     'learning_rate_decay', 'backward', 'regularizer', 'LoDTensor',
+    'LoDTensorArray',
     'CPUPlace', 'TPUPlace', 'CUDAPlace', 'CUDAPinnedPlace', 'Tensor',
     'ParamAttr', 'WeightNormParamAttr', 'DataFeeder', 'clip', 'profiler',
     'unique_name',

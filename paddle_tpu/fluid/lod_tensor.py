@@ -8,7 +8,8 @@ class converts between the two at the host boundary.
 """
 import numpy as np
 
-__all__ = ['LoDTensor', 'create_lod_tensor', 'create_random_int_lodtensor']
+__all__ = ['LoDTensor', 'LoDTensorArray', 'create_lod_tensor',
+           'create_random_int_lodtensor']
 
 
 def _lengths_to_offsets(lengths):
@@ -97,6 +98,49 @@ class LoDTensor(object):
         for lv in reversed(sv.outer_lengths or ()):
             lengths = [list(int(l) for l in np.asarray(lv))] + lengths
         return LoDTensor(flat, lengths)
+
+
+class LoDTensorArray(list):
+    """Host-side array of LoDTensor (reference
+    paddle/fluid/framework/lod_tensor_array.h — a std::vector<LoDTensor>
+    exposed through pybind as `core.LoDTensorArray`; python/paddle/fluid/
+    __init__.py:48 re-exports it). The reference API is append/len/index,
+    which `list` already provides; every mutation path coerces raw
+    arrays so feed code can push numpy directly and indexing always
+    yields LoDTensor. The DEVICE analogue is `lowering.ArrayValue`
+    (fixed-capacity stacked buffers for array_write/array_read inside
+    While loops) — this class is the feed/fetch-side container."""
+
+    @staticmethod
+    def _coerce(value):
+        if not isinstance(value, LoDTensor):
+            value = LoDTensor(np.asarray(value))
+        return value
+
+    def __init__(self, iterable=()):
+        super(LoDTensorArray, self).__init__(
+            self._coerce(v) for v in iterable)
+
+    def append(self, value):
+        super(LoDTensorArray, self).append(self._coerce(value))
+
+    def extend(self, iterable):
+        super(LoDTensorArray, self).extend(
+            self._coerce(v) for v in iterable)
+
+    def insert(self, index, value):
+        super(LoDTensorArray, self).insert(index, self._coerce(value))
+
+    def __setitem__(self, index, value):
+        if isinstance(index, slice):
+            value = [self._coerce(v) for v in value]
+        else:
+            value = self._coerce(value)
+        super(LoDTensorArray, self).__setitem__(index, value)
+
+    def __iadd__(self, iterable):
+        self.extend(iterable)
+        return self
 
 
 def _nested_levels(data):

@@ -102,6 +102,25 @@ def test_multilevel_validity_check():
         create_lod_tensor(np.zeros((5, 1)), [[2, 1], [2, 3]])
 
 
+def test_lod_tensor_array():
+    """fluid.LoDTensorArray (reference core.LoDTensorArray, a
+    vector<LoDTensor>): append coerces raw arrays, list semantics hold."""
+    arr = fluid.LoDTensorArray()
+    arr.append(np.ones((2, 3), 'float32'))
+    arr.append(create_lod_tensor(np.zeros((3, 1)), [[1, 2]]))
+    assert len(arr) == 2
+    assert isinstance(arr[0], LoDTensor)
+    assert arr[1].recursive_sequence_lengths() == [[1, 2]]
+    # every mutation path coerces: ctor, extend, +=, insert, setitem
+    arr2 = fluid.LoDTensorArray([np.zeros((1, 1))])
+    arr2.extend([np.ones((2, 2))])
+    arr2 += [np.ones((1, 3))]
+    arr2.insert(0, np.zeros((4, 1)))
+    arr2[1] = np.full((2, 2), 7.0)
+    assert all(isinstance(t, LoDTensor) for t in arr2)
+    assert float(arr2[1].data[0, 0]) == 7.0
+
+
 def test_create_lod_tensor_from_nested_list():
     t = create_lod_tensor([[[1, 2], [3]], [[4, 5, 6]]], None)
     assert t.recursive_sequence_lengths() == [[2, 1], [2, 1, 3]]
