@@ -140,8 +140,16 @@ def _pool(x, pool_type, ksize, strides, pads, global_pooling, exclusive=True,
         return lax.reduce_window(x, init, lax.max, window, strides_full, pad_full)
     ssum = lax.reduce_window(x, 0.0, lax.add, window, strides_full, pad_full)
     if exclusive:
-        ones = jnp.ones(x.shape, dtype=x.dtype)
-        cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides_full, pad_full)
+        # valid-count divisor: identical for every batch/channel, so count
+        # over a singleton-batch/channel ones array and let broadcasting
+        # expand it. Counting over full x.shape makes XLA constant-fold a
+        # [B, C, H, W] reduce_window at COMPILE time — tens of seconds per
+        # pool layer in a ResNet compile.
+        shape1 = (1,) + tuple(x.shape[1:1 + nd]) + (1,) if channels_last \
+            else (1, 1) + x.shape[2:]
+        ones = jnp.ones(shape1, dtype=x.dtype)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides_full,
+                                pad_full)
         return ssum / cnt
     return ssum / float(np.prod(ksize))
 
