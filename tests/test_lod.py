@@ -127,6 +127,28 @@ def test_create_lod_tensor_from_nested_list():
     np.testing.assert_array_equal(t.data.squeeze(-1), [1, 2, 3, 4, 5, 6])
 
 
+def test_nested_lod_roundtrip_fuzz():
+    """Randomized depth-1..4 LoD tensors survive to_seq_value /
+    from_seq_value exactly (lengths and data), and the derived lengths
+    always validate — guards the recursive encoding."""
+    rng = np.random.RandomState(7)
+    for _ in range(60):
+        depth = int(rng.randint(1, 5))
+        # build level lengths top-down: level k entries = sum(level k-1)
+        levels = [[int(rng.randint(1, 4))
+                   for _ in range(int(rng.randint(1, 4)))]]
+        for _ in range(depth - 1):
+            levels.append([int(rng.randint(1, 4))
+                           for _ in range(sum(levels[-1]))])
+        total = sum(levels[-1])
+        d = int(rng.randint(1, 3))
+        t = LoDTensor(rng.randn(total, d).astype('float32'), levels)
+        assert t.has_valid_recursive_sequence_lengths(), levels
+        back = LoDTensor.from_seq_value(t.to_seq_value())
+        assert back.recursive_sequence_lengths() == levels
+        np.testing.assert_array_equal(back.data, t.data)
+
+
 def test_sequence_pool_drops_innermost_lod_level():
     """Pooling a depth-2 LoD consumes the innermost level (reference
     sequence_pool_op): output rows are one per inner sequence, grouped
