@@ -24,7 +24,8 @@ import re
 
 import numpy as np
 
-__all__ = ['save_sharded', 'load_sharded', 'latest_step']
+__all__ = ['save_sharded', 'save_sharded_async', 'load_sharded',
+           'latest_step', 'AsyncSave']
 
 _MANIFEST = 'manifest.json'
 
@@ -60,23 +61,25 @@ def _index_key(index, shape):
     return tuple(out)
 
 
-def save_sharded(ckpt_dir, arrays, step=0, extra_meta=None):
-    """Save {name: jax.Array} without gathering: each process writes the
-    replica-0 shards it can address (filenames carry the process index, so
-    hosts never collide) and its own manifest listing exactly those shards;
-    the loader merges all manifests."""
+def _collect_shards(arrays, step, extra_meta):
+    """Snapshot replica-0 shards to HOST memory and build the manifest
+    skeleton. The device->host copies happen HERE, synchronously — after
+    this returns, the caller may donate/overwrite the device buffers (the
+    next train step can run while a background thread does the file IO).
+    Returns (manifest, writes): writes = [(fname, ndarray, shard_entry)]
+    with shard_entry['bytes'] left None until the file lands."""
     import jax
+    from jax.sharding import NamedSharding
 
-    os.makedirs(ckpt_dir, exist_ok=True)
     proc = jax.process_index()
     manifest = {'step': int(step), 'format': 'paddle_tpu-sharded-v1',
                 'process': proc, 'extra': extra_meta or {}, 'arrays': {}}
+    writes = []
     for name, arr in arrays.items():
         arr = arr if isinstance(arr, jax.Array) else jax.numpy.asarray(arr)
         sharding = arr.sharding
         entry = {'shape': list(arr.shape), 'dtype': str(arr.dtype),
                  'shards': []}
-        from jax.sharding import NamedSharding
         if isinstance(sharding, NamedSharding):
             entry['mesh_axes'] = [str(a) for a in sharding.mesh.axis_names]
             entry['mesh_shape'] = [int(s) for s in sharding.mesh.devices.shape]
@@ -91,19 +94,81 @@ def save_sharded(ckpt_dir, arrays, step=0, extra_meta=None):
                 continue
             seen.add(key)
             fname = '%s.p%d.shard%d.npy' % (base, proc, len(entry['shards']))
-            fpath = os.path.join(ckpt_dir, fname)
-            np.save(fpath, np.asarray(shard.data))
-            entry['shards'].append({'file': fname,
-                                    'bytes': os.path.getsize(fpath),
-                                    'start': [k[0] for k in key],
-                                    'stop': [k[1] for k in key]})
+            sh = {'file': fname, 'bytes': None,
+                  'start': [k[0] for k in key],
+                  'stop': [k[1] for k in key]}
+            # copy=True: on the CPU backend np.asarray can be a ZERO-COPY
+            # view of the device buffer — a donating next step would then
+            # clobber what the writer thread serializes
+            writes.append((fname, np.array(shard.data, copy=True), sh))
+            entry['shards'].append(sh)
         manifest['arrays'][name] = entry
+    return manifest, writes
+
+
+def _write_all(ckpt_dir, manifest, writes):
+    """Write shard files, fill in their byte sizes, then write the
+    manifest ATOMICALLY LAST — a crash mid-save leaves either no manifest
+    (save never happened) or a manifest whose byte counts expose any
+    truncated shard to _load_shard's corruption check."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    for fname, data, sh in writes:
+        fpath = os.path.join(ckpt_dir, fname)
+        np.save(fpath, data)
+        sh['bytes'] = os.path.getsize(fpath)
+    proc = manifest['process']
     fname = _MANIFEST if proc == 0 else 'manifest.p%d.json' % proc
     tmp = os.path.join(ckpt_dir, fname + '.tmp')
     with open(tmp, 'w') as f:
         json.dump(manifest, f)
     os.replace(tmp, os.path.join(ckpt_dir, fname))
     return ckpt_dir
+
+
+def save_sharded(ckpt_dir, arrays, step=0, extra_meta=None):
+    """Save {name: jax.Array} without gathering: each process writes the
+    replica-0 shards it can address (filenames carry the process index, so
+    hosts never collide) and its own manifest listing exactly those shards;
+    the loader merges all manifests."""
+    manifest, writes = _collect_shards(arrays, step, extra_meta)
+    return _write_all(ckpt_dir, manifest, writes)
+
+
+class AsyncSave(object):
+    """Handle for an in-flight save_sharded_async, wrapping the writer
+    Future: wait() blocks and re-raises any IO error with its original
+    traceback; done() polls."""
+
+    def __init__(self, future, ckpt_dir):
+        self._future = future
+        self.ckpt_dir = ckpt_dir
+
+    def done(self):
+        return self._future.done()
+
+    def wait(self, timeout=None):
+        return self._future.result(timeout=timeout)
+
+
+def save_sharded_async(ckpt_dir, arrays, step=0, extra_meta=None):
+    """save_sharded with the file IO off the critical path: device->host
+    shard COPIES happen synchronously (so the caller may immediately
+    donate/overwrite the device buffers — the next train step overlaps
+    the disk write), then a background thread writes files and commits
+    the manifest last. Returns an AsyncSave handle; call .wait() before
+    relying on the checkpoint, and before issuing another save to the
+    SAME directory (overlapping saves to one dir would interleave
+    identically-named files — nothing serializes them for you). No orbax
+    dependency — the format is identical to save_sharded's, so
+    load_sharded reads both."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    manifest, writes = _collect_shards(arrays, step, extra_meta)
+    pool = ThreadPoolExecutor(max_workers=1,
+                              thread_name_prefix='paddle-tpu-async-ckpt')
+    future = pool.submit(_write_all, ckpt_dir, manifest, writes)
+    pool.shutdown(wait=False)  # lets the worker finish; nothing else queues
+    return AsyncSave(future, ckpt_dir)
 
 
 def _load_shard(ckpt_dir, sh):

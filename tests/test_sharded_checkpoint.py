@@ -160,3 +160,56 @@ def test_missing_shard_file_raises_clear_error(tmp_path):
     with pytest.raises(RuntimeError, match='missing'):
         got, _ = ck.load_sharded(d, mesh=mesh)
         np.asarray(got['fc_0.w_0'])
+
+
+def test_async_save_round_trip_and_snapshot_semantics(tmp_path):
+    """save_sharded_async: values are snapshotted BEFORE the handle
+    returns (caller may donate/overwrite device buffers immediately);
+    wait() commits; load matches the state at call time."""
+    mesh = _mesh()
+    state = _state(mesh)
+    expect = {k: np.array(np.asarray(v), copy=True)
+              for k, v in state.items()}
+    d = str(tmp_path / 'async_ck')
+    h = ck.save_sharded_async(d, state, step=9)
+    # DONATE the saved buffers while the writer may still be running: the
+    # snapshot must have copied, so the checkpoint holds the ORIGINAL
+    # values even though XLA reuses the donated memory for the update
+    bump = jax.jit(lambda t: jax.tree_util.tree_map(lambda a: a * 0 - 1, t),
+                   donate_argnums=0)
+    clobbered = bump(state)
+    jax.block_until_ready(clobbered)
+    assert h.wait() == d
+    assert h.done()
+    loaded, meta = ck.load_sharded(d, mesh=mesh)
+    assert meta['step'] == 9
+    for k, v in expect.items():
+        np.testing.assert_array_equal(np.asarray(loaded[k]), v)
+        assert loaded[k].sharding == clobbered[k].sharding
+
+
+def test_async_save_error_surfaces_on_wait(tmp_path):
+    """IO failures in the background writer re-raise from wait(), not
+    silently vanish."""
+    mesh = _mesh()
+    state = _state(mesh)
+    blocker = tmp_path / 'not_a_dir'
+    blocker.write_text('file where the ckpt dir should go')
+    h = ck.save_sharded_async(str(blocker), state, step=1)
+    with pytest.raises(OSError):   # os.makedirs on a file path
+        h.wait()
+
+
+def test_async_manifest_commits_last(tmp_path):
+    """After wait(), the manifest byte counts match the shard files — the
+    corruption detector would catch any torn write."""
+    mesh = _mesh()
+    state = _state(mesh)
+    d = str(tmp_path / 'async_ck2')
+    ck.save_sharded_async(d, state, step=2).wait()
+    import json as _json
+    man = _json.load(open(os.path.join(d, 'manifest.json')))
+    for entry in man['arrays'].values():
+        for sh in entry['shards']:
+            assert sh['bytes'] == os.path.getsize(
+                os.path.join(d, sh['file']))
