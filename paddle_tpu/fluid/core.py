@@ -107,3 +107,15 @@ def convert_dtype(dtype):
     if dtype == 'bfloat16' or dtype is jnp.bfloat16:
         return 'bfloat16'
     return np.dtype(dtype).name
+
+
+def __getattr__(name):
+    # Scope lives in executor.py (it owns the var-store design), but the
+    # reference exposes it as `fluid.core.Scope` (pybind core module) and
+    # reference book code instantiates it through that path — lazy alias
+    # to avoid a core <-> executor import cycle.
+    if name == 'Scope':
+        from .executor import Scope
+        return Scope
+    raise AttributeError('module %r has no attribute %r'
+                         % (__name__, name))

@@ -216,11 +216,12 @@ class LayerHelper(object):
             raise ValueError("no Parameter named %s" % name)
         return param
 
-    def create_variable_for_type_inference(self, dtype, stop_gradient=False):
+    def create_variable_for_type_inference(self, dtype, stop_gradient=False,
+                                           shape=None, lod_level=0):
         return self.main_program.current_block().create_var(
             name=unique_name.generate(".".join([self.name, 'tmp'])),
-            dtype=dtype, shape=None, persistable=False,
-            stop_gradient=stop_gradient)
+            dtype=dtype, shape=shape, persistable=False,
+            lod_level=lod_level, stop_gradient=stop_gradient)
 
     # reference name
     create_tmp_variable = create_variable_for_type_inference
@@ -252,7 +253,10 @@ class LayerHelper(object):
             return input_var
         b = self.create_parameter(attr=bias_attr, shape=size,
                                   dtype=input_var.dtype, is_bias=True)
-        tmp = self.create_variable_for_type_inference(dtype=input_var.dtype)
+        # elementwise: shape/lod carry through
+        tmp = self.create_variable_for_type_inference(
+            dtype=input_var.dtype, shape=input_var.shape,
+            lod_level=input_var.lod_level)
         self.append_op(
             type='elementwise_add',
             inputs={'X': [input_var], 'Y': [b]},
@@ -269,7 +273,10 @@ class LayerHelper(object):
         else:
             act = copy.deepcopy(act)
         act_type = act.pop('type')
-        tmp = self.create_variable_for_type_inference(dtype=input_var.dtype)
+        # activations are elementwise: shape/lod carry through
+        tmp = self.create_variable_for_type_inference(
+            dtype=input_var.dtype, shape=input_var.shape,
+            lod_level=input_var.lod_level)
         self.append_op(type=act_type, inputs={"X": [input_var]},
                        outputs={"Out": [tmp]}, attrs=act)
         return tmp

@@ -51,7 +51,14 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
         ] + [size]
         w = helper.create_parameter(attr=param_attr_, shape=param_shape,
                                     dtype=dtype, is_bias=False)
-        tmp = helper.create_variable_for_type_inference(dtype)
+        # static out shape (reference mul_op InferShape with
+        # y_num_col_dims=1): X.dims[:k] + [size] — bias append and any
+        # downstream fc read it (input_shape is non-None here: param_shape
+        # above already dereferenced it)
+        out_shape = list(input_shape[:flat_dims]) + [size]
+        tmp = helper.create_variable_for_type_inference(
+            dtype, shape=out_shape,
+            lod_level=getattr(input_var, 'lod_level', 0) or 0)
         helper.append_op(
             type="mul", inputs={"X": [input_var], "Y": [w]},
             outputs={"Out": [tmp]},
@@ -60,7 +67,9 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
     if len(mul_results) == 1:
         pre_bias = mul_results[0]
     else:
-        pre_bias = helper.create_variable_for_type_inference(dtype)
+        pre_bias = helper.create_variable_for_type_inference(
+            dtype, shape=mul_results[0].shape,
+            lod_level=mul_results[0].lod_level)
         helper.append_op(type="sum", inputs={"X": mul_results},
                          outputs={"Out": [pre_bias]}, attrs={})
     pre_act = helper.append_bias_op(pre_bias, dim_start=-1, dim_end=None)
@@ -75,7 +84,17 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
     helper = LayerHelper('embedding', **locals())
     w = helper.create_parameter(attr=helper.param_attr, shape=size,
                                 dtype=dtype, is_bias=False)
-    tmp = helper.create_variable_for_type_inference(dtype)
+    # static out shape (reference lookup_table_op InferShape): an id
+    # column [..., 1] embeds to [..., emb_dim] — downstream layers (fc)
+    # read .shape for their own parameter shapes
+    in_shape = getattr(input, 'shape', None)
+    out_shape = None
+    if in_shape is not None and len(in_shape):
+        base = list(in_shape[:-1]) if in_shape[-1] == 1 else list(in_shape)
+        out_shape = base + [size[-1]]
+    tmp = helper.create_variable_for_type_inference(
+        dtype, shape=out_shape,
+        lod_level=getattr(input, 'lod_level', 0) or 0)
     padding_idx = -1 if padding_idx is None else \
         padding_idx if padding_idx >= 0 else (size[0] + padding_idx)
     helper.append_op(type='lookup_table',
@@ -308,7 +327,12 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
     filter_shape = [filter_size * input.shape[-1], num_filters]
     filter_param = helper.create_parameter(attr=helper.param_attr,
                                            shape=filter_shape, dtype=dtype)
-    pre_bias = helper.create_variable_for_type_inference(dtype)
+    # out shape: input with the feature axis -> num_filters (reference
+    # sequence_conv_op InferShape; input.shape is non-None here —
+    # filter_shape above already dereferenced it)
+    out_shape = list(input.shape[:-1]) + [num_filters]
+    pre_bias = helper.create_variable_for_type_inference(
+        dtype, shape=out_shape, lod_level=input.lod_level)
     helper.append_op(type='sequence_conv',
                      inputs={'X': [input], 'Filter': [filter_param]},
                      outputs={'Out': [pre_bias]},
@@ -403,7 +427,18 @@ def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
 def sequence_pool(input, pool_type):
     helper = LayerHelper('sequence_pool', **locals())
     dtype = helper.input_dtype()
-    pool_out = helper.create_variable_for_type_inference(dtype)
+    # pooling consumes the innermost LoD level: one output row per inner
+    # sequence, same trailing feature dims (reference sequence_pool_op).
+    # In the padded [B, T, ...] SeqValue convention that drops the time
+    # dim (rank - 1); the batch dim stays dynamic.
+    lod = getattr(input, 'lod_level', 0) or 0
+    shape = None
+    if input.shape is not None:
+        shape = (list(input.shape[:1]) + list(input.shape[2:])
+                 if lod > 0 and len(input.shape) >= 3 else
+                 list(input.shape))
+    pool_out = helper.create_variable_for_type_inference(
+        dtype, shape=shape, lod_level=max(lod - 1, 0))
     max_index = helper.create_variable_for_type_inference(dtype)
     helper.append_op(type="sequence_pool", inputs={"X": [input]},
                      outputs={"Out": [pool_out], "MaxIndex": [max_index]},

@@ -219,8 +219,12 @@ def _mean(ins, attrs, ctx):
         # average over VALID tokens only (reference mean sees the flattened
         # LoDTensor, which has no pad rows at all — lod_tensor.h)
         mask = jnp.broadcast_to(_seq_pad_mask(xv), x.shape)
-        return {'Out': jnp.sum(x * mask) / jnp.maximum(jnp.sum(mask), 1)}
-    return {'Out': jnp.mean(x)}
+        # shape [1], not 0-d: reference mean_op's output dims are {1}
+        # (mean_op.cc InferShape) and verbatim reference scripts index
+        # the fetched loss as avg_loss_value[0]
+        return {'Out': (jnp.sum(x * mask)
+                        / jnp.maximum(jnp.sum(mask), 1)).reshape(1)}
+    return {'Out': jnp.mean(x).reshape(1)}
 
 
 @register('sum')

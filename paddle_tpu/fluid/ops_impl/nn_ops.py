@@ -356,7 +356,9 @@ def _accuracy(ins, attrs, ctx):
     correct = jnp.any(idx == label, axis=-1)
     num_correct = jnp.sum(correct.astype(jnp.int32))
     total = correct.size
-    acc = num_correct.astype(jnp.float32) / float(total)
+    # shape [1] like the reference (accuracy_op InferShape dims {1}):
+    # verbatim scripts index the fetched value as acc_np[0]
+    acc = (num_correct.astype(jnp.float32) / float(total)).reshape(1)
     return {'Accuracy': acc, 'Correct': num_correct,
             'Total': jnp.asarray(total, dtype=jnp.int32)}
 

@@ -61,13 +61,16 @@ def _index_key(index, shape):
     return tuple(out)
 
 
-def _collect_shards(arrays, step, extra_meta):
-    """Snapshot replica-0 shards to HOST memory and build the manifest
-    skeleton. The device->host copies happen HERE, synchronously — after
-    this returns, the caller may donate/overwrite the device buffers (the
-    next train step can run while a background thread does the file IO).
-    Returns (manifest, writes): writes = [(fname, ndarray, shard_entry)]
-    with shard_entry['bytes'] left None until the file lands."""
+def _collect_shards(arrays, step, extra_meta, sink=None):
+    """Walk replica-0 shards, build the manifest skeleton, and hand each
+    shard to `sink(fname, host_array, shard_entry)`. With the default
+    deferred sink, every shard is COPIED to host memory (copy=True — on
+    the CPU backend np.asarray can be a zero-copy view of the device
+    buffer, which a donating next step would clobber under the writer
+    thread) and returned in `writes` for a background writer. A
+    direct-write sink (the sync path) streams each shard to disk
+    immediately instead, so peak host memory stays one shard, not the
+    whole checkpoint."""
     import jax
     from jax.sharding import NamedSharding
 
@@ -75,6 +78,9 @@ def _collect_shards(arrays, step, extra_meta):
     manifest = {'step': int(step), 'format': 'paddle_tpu-sharded-v1',
                 'process': proc, 'extra': extra_meta or {}, 'arrays': {}}
     writes = []
+    if sink is None:
+        def sink(fname, shard_data, sh):
+            writes.append((fname, np.array(shard_data, copy=True), sh))
     for name, arr in arrays.items():
         arr = arr if isinstance(arr, jax.Array) else jax.numpy.asarray(arr)
         sharding = arr.sharding
@@ -97,25 +103,16 @@ def _collect_shards(arrays, step, extra_meta):
             sh = {'file': fname, 'bytes': None,
                   'start': [k[0] for k in key],
                   'stop': [k[1] for k in key]}
-            # copy=True: on the CPU backend np.asarray can be a ZERO-COPY
-            # view of the device buffer — a donating next step would then
-            # clobber what the writer thread serializes
-            writes.append((fname, np.array(shard.data, copy=True), sh))
+            sink(fname, shard.data, sh)
             entry['shards'].append(sh)
         manifest['arrays'][name] = entry
     return manifest, writes
 
 
-def _write_all(ckpt_dir, manifest, writes):
-    """Write shard files, fill in their byte sizes, then write the
-    manifest ATOMICALLY LAST — a crash mid-save leaves either no manifest
-    (save never happened) or a manifest whose byte counts expose any
-    truncated shard to _load_shard's corruption check."""
-    os.makedirs(ckpt_dir, exist_ok=True)
-    for fname, data, sh in writes:
-        fpath = os.path.join(ckpt_dir, fname)
-        np.save(fpath, data)
-        sh['bytes'] = os.path.getsize(fpath)
+def _write_manifest(ckpt_dir, manifest):
+    """ATOMICALLY LAST — a crash mid-save leaves either no manifest (save
+    never happened) or byte counts that expose any truncated shard to
+    _load_shard's corruption check."""
     proc = manifest['process']
     fname = _MANIFEST if proc == 0 else 'manifest.p%d.json' % proc
     tmp = os.path.join(ckpt_dir, fname + '.tmp')
@@ -125,13 +122,31 @@ def _write_all(ckpt_dir, manifest, writes):
     return ckpt_dir
 
 
+def _write_all(ckpt_dir, manifest, writes):
+    """Deferred writer (async path): shard files first, manifest last."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    for fname, data, sh in writes:
+        fpath = os.path.join(ckpt_dir, fname)
+        np.save(fpath, data)
+        sh['bytes'] = os.path.getsize(fpath)
+    return _write_manifest(ckpt_dir, manifest)
+
+
 def save_sharded(ckpt_dir, arrays, step=0, extra_meta=None):
     """Save {name: jax.Array} without gathering: each process writes the
     replica-0 shards it can address (filenames carry the process index, so
     hosts never collide) and its own manifest listing exactly those shards;
-    the loader merges all manifests."""
-    manifest, writes = _collect_shards(arrays, step, extra_meta)
-    return _write_all(ckpt_dir, manifest, writes)
+    the loader merges all manifests. Shards stream to disk one at a time
+    (no whole-checkpoint host copy); the manifest commits last."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+
+    def sink(fname, shard_data, sh):
+        fpath = os.path.join(ckpt_dir, fname)
+        np.save(fpath, np.asarray(shard_data))
+        sh['bytes'] = os.path.getsize(fpath)
+
+    manifest, _ = _collect_shards(arrays, step, extra_meta, sink=sink)
+    return _write_manifest(ckpt_dir, manifest)
 
 
 class AsyncSave(object):

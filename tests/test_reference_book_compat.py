@@ -1,0 +1,90 @@
+"""Migration proof: the REFERENCE's own book test files run VERBATIM
+against paddle_tpu through nothing but a sys.modules import alias.
+
+The reference files are executed from /root/reference (read-only, never
+copied into this repo); `import paddle` / `import paddle.fluid` inside
+them resolve to paddle_tpu. This is the strongest form of the parity
+claim — a reference user's training script works unchanged on TPU
+(reference python/paddle/fluid/tests/book/*.py).
+
+Each case runs in a subprocess: the alias must not leak into other tests,
+and the scripts write model dirs into their cwd (a tmp dir here).
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REF_BOOK = '/root/reference/python/paddle/fluid/tests/book'
+
+_RUNNER = r"""
+import sys, types, os, json
+import jax
+jax.config.update('jax_platforms', 'cpu')
+
+import paddle_tpu
+# the alias: EVERY `paddle.*` import in the reference file — including
+# deep ones like `from paddle.fluid.executor import Executor` — must
+# resolve to the SAME module objects (a second copy loaded through the
+# package __path__ breaks isinstance across the boundary)
+paddle_tpu.install_as_paddle()
+
+path, funcname, kwargs = sys.argv[1], sys.argv[2], json.loads(sys.argv[3])
+import importlib.util
+spec = importlib.util.spec_from_file_location('ref_book_case', path)
+mod = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(mod)
+
+fluid = paddle_tpu.fluid
+with fluid.scope_guard(fluid.core.Scope()):
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        getattr(mod, funcname)(**kwargs)
+print('REF-BOOK-COMPAT OK:', os.path.basename(path))
+"""
+
+
+def _run_case(tmp_path, fname, kwargs=None, funcname='main', timeout=900):
+    import json
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, '-c', _RUNNER, os.path.join(_REF_BOOK, fname),
+         funcname, json.dumps(kwargs or {'use_cuda': False})],
+        cwd=str(tmp_path), capture_output=True, text=True, timeout=timeout,
+        env=dict(os.environ, PYTHONPATH=here, JAX_PLATFORMS='cpu'))
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    assert 'REF-BOOK-COMPAT OK' in r.stdout
+
+
+def test_reference_fit_a_line_runs_verbatim(tmp_path):
+    """Linear regression: trains to loss<10, saves an inference model,
+    reloads it and infers — all through the reference's own code."""
+    _run_case(tmp_path, 'test_fit_a_line.py')
+
+
+def test_reference_recognize_digits_mlp_runs_verbatim(tmp_path):
+    """MNIST MLP from the reference book, verbatim: train to the
+    reference's own acceptance threshold, save/load inference model,
+    infer."""
+    _run_case(tmp_path, 'test_recognize_digits.py',
+              kwargs={'use_cuda': False, 'parallel': False,
+                      'nn_type': 'mlp', 'combine': False},
+              timeout=1200)
+
+
+def test_reference_word2vec_runs_verbatim(tmp_path):
+    """Skip-gram-style N-gram LM from the reference book (embedding
+    lookups, concat, shared ParamAttrs, LoD feeds via
+    create_lod_tensor in its infer()) — verbatim to cost < 5.0."""
+    _run_case(tmp_path, 'test_word2vec.py',
+              kwargs={'use_cuda': False, 'is_sparse': False,
+                      'is_parallel': False},
+              timeout=1200)
+
+
+def test_reference_recommender_system_runs_verbatim(tmp_path):
+    """The book's DSSM-style recommender (9 feeds incl. a sequence
+    movie-title column, cos_sim head, test-program clone) — verbatim to
+    the reference's own test-cost < 6.0 bar, then inference reload."""
+    _run_case(tmp_path, 'test_recommender_system.py',
+              kwargs={'use_cuda': False}, timeout=1200)
