@@ -170,13 +170,21 @@ def array_write(x, i, array=None, capacity=None):
     array._initialized = True
     if getattr(array, '_elem_shape', None) is None:
         array._elem_shape = x.shape
+    # lod rides along too: a downstream fc must see a sequence var to
+    # pick the per-step (feature-only) parameter shape. max over ALL
+    # writes — beam-search arrays are often seeded with a lod-0 init and
+    # then filled with sequence step outputs.
+    array._elem_lod_level = max(getattr(array, '_elem_lod_level', 0),
+                                getattr(x, 'lod_level', 0) or 0)
     return array
 
 
 def array_read(array, i):
     """reference control_flow.py:array_read."""
     helper = LayerHelper('array_read', **locals())
-    out = helper.create_variable_for_type_inference(dtype=array.dtype)
+    out = helper.create_variable_for_type_inference(
+        dtype=array.dtype,
+        lod_level=getattr(array, '_elem_lod_level', 0))
     out.shape = getattr(array, '_elem_shape', None)
     helper.append_op(type='array_read', inputs={'Array': [array], 'I': [i]},
                      outputs={'Out': [out]}, infer_shape=False)

@@ -677,7 +677,17 @@ def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
 def sequence_expand(x, y, ref_level=-1, name=None):
     helper = LayerHelper('sequence_expand', **locals())
     dtype = helper.input_dtype('x')
-    tmp = helper.create_variable_for_type_inference(dtype)
+    # out is a SEQUENCE [rows, T(dynamic), features...]: the lowering
+    # broadcasts each x row across y's time axis (dense x gains a time
+    # dim; sequence x keeps rank with a new T)
+    shape = None
+    if x.shape is not None:
+        feat = (list(x.shape[2:]) if (x.lod_level or 0) > 0
+                and len(x.shape) >= 3 else list(x.shape[1:]))
+        shape = [x.shape[0], -1] + feat
+    tmp = helper.create_variable_for_type_inference(
+        dtype, shape=shape,
+        lod_level=max(1, getattr(y, 'lod_level', 0) or 0))
     helper.append_op(type='sequence_expand',
                      inputs={'X': [x], 'Y': [y]}, outputs={'Out': [tmp]},
                      attrs={'ref_level': ref_level})
@@ -962,7 +972,17 @@ def reshape(x, shape, actual_shape=None, act=None, inplace=True, name=None):
 
 def lod_reset(x, y=None, target_lod=None):
     helper = LayerHelper("lod_reset", **locals())
-    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    # the token buffer REGROUPS under the new lod: out is a sequence
+    # [n_seqs(dynamic), T(dynamic), features...] where the features are
+    # x's trailing dims (lowering flattens valid tokens and re-pads)
+    new_lod = (getattr(y, 'lod_level', 0) or 1) if y is not None else 1
+    shape = None
+    if x.shape is not None:
+        feat = (list(x.shape[2:]) if (x.lod_level or 0) > 0
+                and len(x.shape) >= 3 else list(x.shape[1:]))
+        shape = [-1, -1] + feat
+    out = helper.create_variable_for_type_inference(
+        dtype=x.dtype, shape=shape, lod_level=new_lod)
     if y is not None:
         helper.append_op(type="lod_reset", inputs={'X': [x], 'Y': [y]},
                          outputs={'Out': [out]})

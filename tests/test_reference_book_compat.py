@@ -9,6 +9,14 @@ claim — a reference user's training script works unchanged on TPU
 
 Each case runs in a subprocess: the alias must not leak into other tests,
 and the scripts write model dirs into their cwd (a tmp dir here).
+
+Known verbatim boundary: test_machine_translation.py's decode_main — the
+reference's While-loop beam search stores LoD tensors in LoDTensorArrays
+and REGROUPS the beam per iteration (dynamic per-step LoD), which the
+static-shape design intentionally replaces with the dense beam
+(layers.beam_search / beam_search_decode, exercised by
+examples/machine_translation.py and tests/test_ops_sampled.py). Its
+train_main runs verbatim below.
 """
 import os
 import subprocess
@@ -80,6 +88,22 @@ def test_reference_word2vec_runs_verbatim(tmp_path):
               kwargs={'use_cuda': False, 'is_sparse': False,
                       'is_parallel': False},
               timeout=1200)
+
+
+def test_reference_machine_translation_train_runs_verbatim(tmp_path):
+    """Seq2seq attention trainer (DynamicRNN-style decoder over LoD
+    feeds) from the reference book, verbatim — 4 batches, finite loss."""
+    _run_case(tmp_path, 'test_machine_translation.py',
+              funcname='train_main',
+              kwargs={'use_cuda': False, 'is_sparse': False},
+              timeout=1200)
+
+
+def test_reference_rnn_encoder_decoder_runs_verbatim(tmp_path):
+    """The book's plain RNN encoder-decoder (DynamicRNN memories) —
+    train + save/load inference model + infer, verbatim."""
+    _run_case(tmp_path, 'test_rnn_encoder_decoder.py',
+              kwargs={'use_cuda': False}, timeout=1200)
 
 
 def test_reference_recommender_system_runs_verbatim(tmp_path):
