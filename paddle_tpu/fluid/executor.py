@@ -44,13 +44,43 @@ class _VarHolder(object):
         self._name = name
 
     def get_tensor(self):
-        v = self._scope.vars[self._name]
-        if isinstance(v, SeqValue):
-            return np.asarray(v.data)
-        return np.asarray(v)
+        return _TensorHandle(self._scope, self._name)
 
     def set(self, value, place=None):
         self._scope.vars[self._name] = jnp.asarray(value)
+
+
+class _TensorHandle(object):
+    """The pybind Tensor surface on a scope var: reads like an ndarray
+    (__array__), writes back with set(value, place) — the reference idiom
+    `scope.find_var(n).get_tensor().set(arr, place)` loads pretrained
+    parameters in place (book test_label_semantic_roles.py:180)."""
+
+    def __init__(self, scope, name):
+        self._scope = scope
+        self._name = name
+
+    def _raw(self):
+        v = self._scope.vars[self._name]
+        return v.data if isinstance(v, SeqValue) else v
+
+    def __array__(self, dtype=None, copy=None):
+        a = np.asarray(self._raw())
+        if dtype is not None and a.dtype != np.dtype(dtype):
+            a = a.astype(dtype)
+        elif copy:
+            a = a.copy()
+        return a
+
+    def set(self, value, place=None):
+        self._scope.vars[self._name] = jnp.asarray(value)
+
+    def shape(self):
+        # metadata only — no device-to-host transfer
+        return list(self._raw().shape)
+
+    def __repr__(self):
+        return '_TensorHandle(%r, shape=%r)' % (self._name, self.shape())
 
 
 class Scope(object):

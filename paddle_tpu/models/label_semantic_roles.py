@@ -121,7 +121,11 @@ def load_pretrained_embedding(scope=None, embedding_name='emb'):
         raise ValueError('run the startup program before loading the '
                          'pretrained embedding')
     cur = np.asarray(scope.vars[embedding_name])
-    emb = paddle.dataset.conll05.get_embedding()
+    # get_embedding returns a PATH (reference API: a downloaded binary,
+    # 16-byte header + raw float32 [vocab, 32] — book load_parameter)
+    with open(paddle.dataset.conll05.get_embedding(), 'rb') as f:
+        f.read(16)
+        emb = np.fromfile(f, dtype=np.float32).reshape(-1, 32)
     if emb.shape[1] < cur.shape[1]:
         reps = -(-cur.shape[1] // emb.shape[1])
         emb = np.tile(emb, (1, reps))

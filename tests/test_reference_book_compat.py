@@ -99,6 +99,32 @@ def test_reference_machine_translation_train_runs_verbatim(tmp_path):
               timeout=1200)
 
 
+def test_reference_image_classification_vgg_runs_verbatim(tmp_path):
+    """VGG on cifar from the reference book, verbatim — conv/bn/dropout
+    tower, test-program clone + accuracy eval + inference round-trip."""
+    _run_case(tmp_path, 'test_image_classification.py',
+              kwargs={'use_cuda': False, 'net_type': 'vgg'},
+              timeout=1200)
+
+
+def test_reference_high_level_api_fit_a_line_runs_verbatim(tmp_path):
+    """The reference's Trainer-based (high-level API) fit_a_line,
+    verbatim: fluid.Trainer + EndStepEvent handler + trainer.stop() +
+    params save/infer."""
+    _run_case(tmp_path,
+              'high-level-api/fit_a_line/test_fit_a_line.py',
+              kwargs={'use_cuda': False}, timeout=1200)
+
+
+def test_reference_label_semantic_roles_runs_verbatim(tmp_path):
+    """SRL with the 8-feature deep bidirectional LSTM mix + linear-chain
+    CRF, verbatim: loads the pretrained embedding FILE via
+    scope.find_var().get_tensor().set(), trains to the reference's
+    cost<60 bar, saves + reloads the inference model."""
+    _run_case(tmp_path, 'test_label_semantic_roles.py',
+              kwargs={'use_cuda': False}, timeout=1200)
+
+
 def test_reference_rnn_encoder_decoder_runs_verbatim(tmp_path):
     """The book's plain RNN encoder-decoder (DynamicRNN memories) —
     train + save/load inference model + infer, verbatim."""
