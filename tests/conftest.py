@@ -25,6 +25,10 @@ _SLOW_TESTS = {
     'test_reference_book_compat.py::test_reference_machine_translation_train_runs_verbatim',
     'test_reference_book_compat.py::test_reference_recommender_system_runs_verbatim',
     'test_reference_book_compat.py::test_reference_word2vec_runs_verbatim',
+    'test_reference_book_compat.py::test_reference_hl_recognize_digits_conv_runs_verbatim',
+    'test_reference_book_compat.py::test_reference_hl_sentiment_conv_runs_verbatim',
+    'test_reference_book_compat.py::test_reference_hl_sentiment_dynamic_rnn_runs_verbatim',
+    'test_reference_book_compat.py::test_reference_hl_sentiment_stacked_lstm_runs_verbatim',
     'test_examples.py::test_parallelism_example',
     'test_fluid_benchmark.py::test_transformer_model_with_sequence_parallel',
     'test_parallel.py::test_dryrun_multichip',

@@ -116,6 +116,42 @@ def test_reference_high_level_api_fit_a_line_runs_verbatim(tmp_path):
               kwargs={'use_cuda': False}, timeout=1200)
 
 
+def test_reference_hl_recognize_digits_conv_runs_verbatim(tmp_path):
+    """Trainer-based LeNet (conv+pool tower) from the high-level-api
+    book dir, verbatim — EndStepEvent accuracy gate + save + infer."""
+    _run_case(tmp_path,
+              'high-level-api/recognize_digits/test_recognize_digits_conv.py',
+              kwargs={'use_cuda': False}, timeout=1200)
+
+
+def test_reference_hl_sentiment_conv_runs_verbatim(tmp_path):
+    """Trainer-based sentiment conv net (sequence_conv_pool x2 over an
+    imdb lod feed), verbatim."""
+    _run_case(
+        tmp_path,
+        'high-level-api/understand_sentiment/test_understand_sentiment_conv.py',
+        kwargs={'use_cuda': False}, timeout=1200)
+
+
+def test_reference_hl_sentiment_dynamic_rnn_runs_verbatim(tmp_path):
+    """Trainer-based sentiment DynamicRNN (per-step rnn.step_input /
+    memory update inside the dynamic rnn block), verbatim."""
+    _run_case(
+        tmp_path,
+        'high-level-api/understand_sentiment/'
+        'test_understand_sentiment_dynamic_rnn.py',
+        kwargs={'use_cuda': False}, timeout=1200)
+
+
+def test_reference_hl_sentiment_stacked_lstm_runs_verbatim(tmp_path):
+    """Trainer-based sentiment stacked (3-layer) LSTM, verbatim."""
+    _run_case(
+        tmp_path,
+        'high-level-api/understand_sentiment/'
+        'test_understand_sentiment_stacked_lstm.py',
+        kwargs={'use_cuda': False}, timeout=1200)
+
+
 def test_reference_label_semantic_roles_runs_verbatim(tmp_path):
     """SRL with the 8-feature deep bidirectional LSTM mix + linear-chain
     CRF, verbatim: loads the pretrained embedding FILE via
