@@ -20,6 +20,7 @@ jax.config.update('jax_num_cpu_devices', 8)
 _SLOW_TESTS = {
     'test_flash_attention.py::test_ring_attention_flash_impl_matches_dense_and_full',
     'test_reference_book_compat.py::test_reference_image_classification_vgg_runs_verbatim',
+    'test_reference_book_compat.py::test_reference_image_classification_resnet_runs_verbatim',
     'test_reference_book_compat.py::test_reference_rnn_encoder_decoder_runs_verbatim',
     'test_reference_book_compat.py::test_reference_label_semantic_roles_runs_verbatim',
     'test_reference_book_compat.py::test_reference_machine_translation_train_runs_verbatim',

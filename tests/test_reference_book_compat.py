@@ -116,6 +116,14 @@ def test_reference_high_level_api_fit_a_line_runs_verbatim(tmp_path):
               kwargs={'use_cuda': False}, timeout=1200)
 
 
+def test_reference_image_classification_resnet_runs_verbatim(tmp_path):
+    """The book's cifar ResNet (conv-residual basicblocks) variant of
+    the same file, verbatim."""
+    _run_case(tmp_path, 'test_image_classification.py',
+              kwargs={'use_cuda': False, 'net_type': 'resnet'},
+              timeout=1200)
+
+
 def test_reference_hl_recognize_digits_conv_runs_verbatim(tmp_path):
     """Trainer-based LeNet (conv+pool tower) from the high-level-api
     book dir, verbatim — EndStepEvent accuracy gate + save + infer."""
