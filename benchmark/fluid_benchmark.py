@@ -63,6 +63,12 @@ def parse_args(argv=None):
                    help='sequence-parallel degree (SequenceParallel'
                         'Transpiler; attention rides the ring — the model '
                         'must use fused_attention)')
+    p.add_argument('--pp', type=int, default=1,
+                   help='pipeline stages (transformer only: packs the '
+                        'decoder layers into S device_guard stages, '
+                        'PipelineTranspiler schedules them as GPipe)')
+    p.add_argument('--n_micro', type=int, default=2,
+                   help='pipeline microbatches per step (with --pp)')
     return p.parse_args(argv)
 
 
@@ -91,7 +97,8 @@ def _build(args):
     elif args.model == 'transformer':
         from paddle_tpu.models import transformer
         loss, tok, train_r, test_r, feeds = transformer.get_model(
-            batch_size=args.batch_size)
+            batch_size=args.batch_size,
+            pp_decoder=args.pp if args.pp > 1 else False)
         infer, acc = None, None
     else:
         loss, infer, train_r, test_r, acc = stacked_dynamic_lstm.get_model(
@@ -144,13 +151,18 @@ def run_benchmark(args):
             t.transpile(trainer_id=0, program=main, trainers=args.chips,
                         startup_program=startup)
             main = t.get_trainer_program()
-        if (args.tp > 1 or args.sp > 1) and args.chips > 1 \
+        if (args.tp > 1 or args.sp > 1 or args.pp > 1) and args.chips > 1 \
                 and args.update_method == 'local':
             raise ValueError(
-                '--tp/--sp with --chips > 1: use --update_method pserver '
-                '(DistributeTranspiler dp composes with tp/sp through the '
-                'Executor; the local ParallelExecutor builds its own '
-                'dp-only mesh)')
+                '--tp/--sp/--pp with --chips > 1: use --update_method '
+                'pserver (DistributeTranspiler dp composes with tp/sp/pp '
+                'through the Executor; the local ParallelExecutor builds '
+                'its own dp-only mesh)')
+        if args.pp > 1 and args.model != 'transformer':
+            raise ValueError('--pp: only the transformer model builds '
+                             'device_guard pipeline stages')
+        if args.pp > 1:
+            fluid.PipelineTranspiler(n_micro=args.n_micro).transpile(main)
         for prog in [main] + ([infer_prog] if infer_prog is not None
                               else []):
             if args.tp > 1:
