@@ -1,0 +1,61 @@
+#!/bin/bash
+# Opportunistic TPU bench capture (VERDICT r4, task 1).
+#
+# The axon tunnel to the single real TPU chip dies and heals on its own
+# schedule; waiting for the driver's end-of-round bench risks another
+# "platform": "cpu" non-number. This loop probes the backend every
+# PROBE_EVERY_S seconds for the whole round; the moment a probe answers
+# "tpu" it fires bench.py with a TPU-only budget, records the result to
+# BENCH_TPU_SENTINEL.json, refreshes tools/tune_flash.py tuned defaults,
+# and commits the artifacts + the warmed .jax_cache so the driver's own
+# later run warm-starts.
+#
+# Commit safety: `git commit --only <paths>` commits ONLY those paths, so
+# a concurrent interactive session's staged work is never swept in.
+set -u
+cd "$(dirname "$0")/.."
+LOG=tools/sentinel.log
+PROBE_EVERY_S=${SENTINEL_PROBE_EVERY_S:-600}
+PROBE_TIMEOUT_S=${SENTINEL_PROBE_TIMEOUT_S:-90}
+
+log() { echo "[sentinel $(date -u +%H:%M:%S)] $*" >> "$LOG"; }
+
+probe() {
+    timeout "$PROBE_TIMEOUT_S" python -c \
+        "import jax; print('PLATFORM=' + jax.devices()[0].platform)" \
+        2>/dev/null | grep -o 'PLATFORM=.*' | cut -d= -f2
+}
+
+capture() {
+    log "TPU answered; running bench.py"
+    BENCH_PLATFORM=tpu BENCH_BUDGET_S=2400 \
+        python bench.py > BENCH_TPU_SENTINEL.json 2>> "$LOG"
+    rc=$?
+    log "bench.py rc=$rc"
+    tail -c 400 BENCH_TPU_SENTINEL.json >> "$LOG"
+    grep -q '"platform": "tpu"' BENCH_TPU_SENTINEL.json || return 1
+    timeout 1200 python tools/tune_flash.py --seq 1024 --iters 10 \
+        > tools/flash_tuned_sentinel.json 2>> "$LOG" \
+        && git add -f tools/flash_tuned_sentinel.json
+    git add -f BENCH_TPU_SENTINEL.json .jax_cache >> "$LOG" 2>&1
+    git commit --only BENCH_TPU_SENTINEL.json .jax_cache \
+        tools/flash_tuned_sentinel.json \
+        -m "bench sentinel: on-chip TPU capture" >> "$LOG" 2>&1
+    return 0
+}
+
+log "sentinel start (probe every ${PROBE_EVERY_S}s, timeout ${PROBE_TIMEOUT_S}s)"
+while :; do
+    p=$(probe)
+    if [ "$p" = "tpu" ]; then
+        if capture; then
+            log "capture committed; re-probing hourly for freshness"
+            PROBE_EVERY_S=3600
+        else
+            log "capture ran but no tpu-labeled metric; will retry"
+        fi
+    else
+        log "probe: '${p:-none}'"
+    fi
+    sleep "$PROBE_EVERY_S"
+done
