@@ -65,6 +65,12 @@ class _TensorHandle(object):
         return v.data if isinstance(v, SeqValue) else v
 
     def __array__(self, dtype=None, copy=None):
+        if copy is False:
+            # NumPy 2 __array__ contract: materializing a device array on
+            # the host always copies, so a no-copy request is unsatisfiable
+            raise ValueError(
+                'converting a device tensor to numpy requires a '
+                'device-to-host copy; copy=False cannot be satisfied')
         a = np.asarray(self._raw())
         if dtype is not None and a.dtype != np.dtype(dtype):
             a = a.astype(dtype)
@@ -189,6 +195,14 @@ class _CompiledStep(object):
         self.pipe = (pipe if pipe is not None and mesh is not None
                      and pipe['axis'] in getattr(mesh, 'shape', {})
                      else None)
+        if self.pipe is not None and 'sp' in getattr(mesh, 'shape', {}):
+            # backstop for programs whose configs were hand-assembled or
+            # clone-carried past the transpilers' own validation: stage
+            # bodies run sequence-local under sp (see pipeline_transpiler)
+            from .transpiler.pipeline_transpiler import (
+                validate_sp_sequence_local)
+            lo0, hi0 = self.pipe['stage0']
+            validate_sp_sequence_local(block.ops[lo0:hi0])
         if self.pipe is not None:
             lo_r, hi_r = self.pipe['region']
             internal = set()
@@ -214,6 +228,7 @@ class _CompiledStep(object):
         ad_idxs = [i for i, op in enumerate(ops) if op.type == 'autodiff']
         assert len(ad_idxs) <= 1, "at most one append_backward per program"
         self.ad_idx = ad_idxs[0] if ad_idxs else None
+        self.sparse_plan = self._sparse_embedding_plan(program)
         # names that will exist in env and are persistable -> written back
         produced = set(self.persist_in)
         persistable = {v.name for v in program.list_vars() if v.persistable}
@@ -233,8 +248,9 @@ class _CompiledStep(object):
                 run_range(env, 0, len(ops), key)
             else:
                 ad = ops[self.ad_idx]
-                pnames, gnames, trainable, base = self._grad_setup(env, ad)
-                fwd = self._make_fwd(base, ad, key)
+                pnames, gnames, trainable, base, taps = \
+                    self._grad_setup(env, ad)
+                fwd = self._make_fwd(base, ad, key, taps=taps)
                 if self.use_remat:
                     # memory_optimize(): recompute forward activations in
                     # the backward pass instead of saving them (the TPU
@@ -254,20 +270,121 @@ class _CompiledStep(object):
         self._step = step  # pure, un-jitted (re-jittable with shardings)
         self._jitted = jax.jit(step, donate_argnums=(0,))
 
+    # optimizer ops with a SparseRows (SelectedRows-analogue) grad branch
+    # in ops_impl/optim_ops.py
+    _SPARSE_OPTS = frozenset(['sgd', 'adagrad', 'adam'])
+
+    def _sparse_embedding_plan(self, program):
+        """Which embedding tables can take the sparse gradient path.
+
+        Reference: lookup_table_op.cc emits a SelectedRows grad when
+        is_sparse=True and sgd/adagrad/adam update only the touched rows.
+        Here jax.grad would produce a DENSE vocab-sized @GRAD buffer; for a
+        table W we instead differentiate w.r.t. a zero "tap" added to each
+        lookup's gathered rows, and hand the optimizer a
+        lowering.SparseRows(ids, rows) — the vocab-sized buffer never
+        exists (VERDICT r4 item 4). Eligibility (else silent dense
+        fallback, bit-identical for SGD since scatter-add is how XLA
+        derives the dense grad anyway):
+          - every reader of W (except its optimizer op) is a lookup_table
+            with is_sparse=True;
+          - W@GRAD is consumed by exactly one sgd/adagrad/adam op and
+            produced only by autodiff (no clip/regularizer rewriting it),
+            is not persistable and not fetched;
+          - the step is unsharded (self.mesh is None): under dp/tp the
+            dense grad IS the right thing — XLA all-reduces it — and
+            SelectedRows never distributed in the reference either.
+        Returns {w_name: {'lookups': [(op_idx, ids_name, padding_idx)],
+                          'gname': str}}."""
+        if self.ad_idx is None or self.mesh is not None:
+            return {}
+        ad = self.ops[self.ad_idx]
+        gnames = dict(zip(ad.attrs['param_names'], ad.attrs['grad_names']))
+        persistable = {v.name for v in program.list_vars() if v.persistable}
+        readers = {}   # var name -> [op index]
+        writers = {}
+        for i, op in enumerate(self.ops):
+            if i == self.ad_idx:
+                continue
+            for n in op.input_arg_names:
+                readers.setdefault(n, []).append(i)
+            for n in op.output_arg_names:
+                writers.setdefault(n, []).append(i)
+        plan = {}
+        for w, gname in gnames.items():
+            lookups = []
+            opt_idx = None
+            ok = gname not in self.fetch_names and gname not in persistable
+            for i in set(readers.get(w, [])):
+                op = self.ops[i]
+                if (op.type == 'lookup_table' and op.attrs.get('is_sparse')
+                        and op.inputs['W'][0].name == w):
+                    lookups.append(
+                        (i, op.inputs['Ids'][0].name,
+                         op.attrs.get('padding_idx', -1)))
+                elif (op.type in self._SPARSE_OPTS and opt_idx is None
+                      and any(v.name == gname
+                              for v in op.inputs.get('Grad', []))):
+                    opt_idx = i
+                else:
+                    ok = False
+            grad_readers = set(readers.get(gname, []))
+            grad_writers = set(writers.get(gname, []))
+            if (ok and lookups and opt_idx is not None
+                    and grad_readers <= {opt_idx} and not grad_writers):
+                plan[w] = {'lookups': sorted(lookups), 'gname': gname}
+        return plan
+
+    @staticmethod
+    def _tap_name(w, op_idx):
+        return '%s@SPTAP%d' % (w, op_idx)
+
     def _grad_setup(self, env, ad):
-        """Split env into trainable params vs everything else for jax.grad."""
+        """Split env into trainable params vs everything else for jax.grad.
+
+        Sparse-embedding params (self.sparse_plan) are NOT differentiated
+        directly: a zero tap per lookup joins `trainable` instead, whose
+        gradient is the per-occurrence row gradient (see
+        _sparse_embedding_plan). Returns (pnames, gnames, trainable, base,
+        taps) where taps maps lookup op index -> (tap name, out var name)
+        for _run_ops to inject."""
         pnames = [n for n in ad.attrs['param_names'] if n in env]
         gnames = dict(zip(ad.attrs['param_names'], ad.attrs['grad_names']))
-        trainable = {n: env[n] for n in pnames}
+        taps = {}
+        sparse_active = {}
+        for w, plan in self.sparse_plan.items():
+            if w not in env:
+                continue
+            # ids must be resolvable BEFORE the forward runs to size the
+            # zero taps: feed/persist vars only (intermediate id tensors
+            # fall back to the dense path)
+            if not all(ids in env for _, ids, _ in plan['lookups']):
+                continue
+            sparse_active[w] = plan
+        trainable = {n: env[n] for n in pnames if n not in sparse_active}
+        for w, plan in sparse_active.items():
+            d = env[w].shape[-1]
+            for op_idx, ids_name, _pad in plan['lookups']:
+                ids = lowering.data_of(env[ids_name])
+                shp = ids.shape[:-1] if (ids.ndim and ids.shape[-1] == 1) \
+                    else ids.shape
+                op = self.ops[op_idx]
+                taps[op_idx] = (self._tap_name(w, op_idx),
+                                op.outputs['Out'][0].name)
+                trainable[self._tap_name(w, op_idx)] = jnp.zeros(
+                    tuple(shp) + (d,), env[w].dtype)
+        self._sparse_active = sparse_active
+        pnames = [n for n in pnames if n not in sparse_active]
         base = {k: v for k, v in env.items() if k not in trainable}
-        return pnames, gnames, trainable, base
+        return pnames, gnames, trainable, base, taps
 
-    def _make_fwd(self, base, ad, key):
+    def _make_fwd(self, base, ad, key, taps=None):
         """The differentiable forward closure: trainable -> (loss, env)."""
         def fwd(tr):
             e = dict(base)
             e.update(tr)
-            self._run_ops(e, 0, self.ad_idx, key, grad_mode=True)
+            self._run_ops(e, 0, self.ad_idx, key, grad_mode=True,
+                          taps=taps)
             loss = e[ad.attrs['loss_name']]
             return jnp.sum(loss.astype(jnp.float32)), e
         return fwd
@@ -275,7 +392,9 @@ class _CompiledStep(object):
     def _apply_grads(self, grads, env, ad, pnames, gnames,
                      check_nan_inf=False):
         """Scale/cast gradients into env under their @GRAD names. Shared by
-        the jitted step and debug_step so both paths compute identically."""
+        the jitted step and debug_step so both paths compute identically.
+        Sparse-embedding params bind a lowering.SparseRows under their
+        @GRAD name instead of a dense vocab-sized buffer."""
         scale = ad.attrs.get('loss_scale', 1.0)
         for n in pnames:
             g = grads[n]
@@ -287,10 +406,37 @@ class _CompiledStep(object):
                     "NaN/Inf in gradient %r (of parameter %r)"
                     % (gnames[n], n))
             env[gnames[n]] = g
+        for w, plan in getattr(self, '_sparse_active', {}).items():
+            d = env[w].shape[-1]
+            ids_parts, row_parts = [], []
+            for op_idx, ids_name, pad in plan['lookups']:
+                ids = lowering.data_of(env[ids_name]).astype(
+                    jnp.int32).reshape((-1,))
+                rows = grads[self._tap_name(w, op_idx)].reshape((-1, d))
+                if pad is not None and pad >= 0:
+                    # the dense grad's padding_idx row is zeroed by the
+                    # lookup rule's w.at[pad].set(0); mirror that here
+                    rows = jnp.where((ids == pad)[:, None], 0.0, rows)
+                ids_parts.append(ids)
+                row_parts.append(rows)
+            rows = jnp.concatenate(row_parts, axis=0)
+            if scale != 1.0:
+                rows = rows * scale
+            rows = rows.astype(env[w].dtype)
+            if check_nan_inf and not bool(jnp.isfinite(rows).all()):
+                raise FloatingPointError(
+                    "NaN/Inf in gradient %r (of parameter %r)"
+                    % (gnames[w], w))
+            env[gnames[w]] = lowering.SparseRows(
+                jnp.concatenate(ids_parts, axis=0), rows, env[w].shape)
 
-    def _run_ops(self, env, lo, hi, key, grad_mode=False, on_op=None):
+    def _run_ops(self, env, lo, hi, key, grad_mode=False, on_op=None,
+                 taps=None):
         """Execute ops [lo, hi); on_op(i, op, seconds, env) — when set, each
-        op is synchronized and timed (debug/profiling path, eager only)."""
+        op is synchronized and timed (debug/profiling path, eager only).
+        taps: {op_index: (tap_name, out_var_name)} — after the op at
+        op_index runs, the zero tap joins its output so jax.grad yields the
+        per-row gradient there (sparse embedding path)."""
         pipe = self.pipe
         for i in range(lo, hi):
             if pipe is not None and on_op is None \
@@ -315,6 +461,11 @@ class _CompiledStep(object):
                         for v in vs if env.get(v.name) is not None]
                 jax.block_until_ready(outs)
                 on_op(i, op, time.perf_counter() - t0, env)
+            if taps is not None and i in taps:
+                tname, oname = taps[i]
+                v = env[oname]
+                env[oname] = lowering.like(
+                    v, lowering.data_of(v) + env[tname])
             if grad_mode:
                 for vs in op.outputs.values():
                     for v in vs:
@@ -323,6 +474,11 @@ class _CompiledStep(object):
                                 jax.lax.stop_gradient, env[v.name])
 
     def _run_pipeline_region(self, env, key, grad_mode=False):
+        with jax.named_scope('pipeline_region_%d' % self.pipe['region'][0]):
+            return self._run_pipeline_region_impl(env, key,
+                                                  grad_mode=grad_mode)
+
+    def _run_pipeline_region_impl(self, env, key, grad_mode=False):
         """Execute the PipelineTranspiler region as ONE GPipe call.
 
         Per-stage parameters are stacked [S, ...] on the fly (grad of
@@ -353,9 +509,30 @@ class _CompiledStep(object):
                     'expected the batch size %d' % (n, e.shape[0],
                                                     x.shape[0]))
             streamed.append(e.reshape((M, mb) + e.shape[1:]))
-        stacked = {
-            n0: jnp.stack([env[cfg['param_names'][k][j]] for k in range(S)])
-            for j, n0 in enumerate(cfg['param_names'][0])}
+        # Stack each stage's weights [S, ...] and PIN the stack's sharding:
+        # dim 0 over the pp axis, trailing dims keeping the per-stage
+        # weight's own (tp) spec. Without the constraint GSPMD has to
+        # transition from the stacked per-stage shardings to the
+        # shard_map's pp layout on its own and falls back to
+        # replicate-then-repartition ("Involuntary full rematerialization",
+        # MULTICHIP_r04 tail) — a full weight-stack all-gather every step.
+        from jax.sharding import NamedSharding, PartitionSpec as _PS
+        stacked, stacked_specs = {}, {}
+        for j, n0 in enumerate(cfg['param_names'][0]):
+            leaves = [env[cfg['param_names'][k][j]] for k in range(S)]
+            if self.mesh is not None:
+                # pin each element to an explicit replicated layout before
+                # stacking: without this GSPMD back-propagates shardings
+                # from inside the pipeline shard_map onto the stack and
+                # falls back to replicate-then-repartition per step
+                # ("Involuntary full rematerialization", MULTICHIP_r04)
+                rep = NamedSharding(self.mesh, _PS())
+                leaves = [jax.lax.with_sharding_constraint(x, rep)
+                          for x in leaves]
+            stacked[n0] = jnp.stack(leaves)
+            base_sh = self.persist_shardings.get(n0)
+            stacked_specs[n0] = (tuple(base_sh.spec)
+                                 if base_sh is not None else ())
         mbs = x.reshape((M, mb) + x.shape[1:])
         lo0, hi0 = cfg['stage0']
         stage_ops = self.ops[lo0:hi0]
@@ -391,7 +568,8 @@ class _CompiledStep(object):
         out = parallel.pipeline_apply(stage_fn, stacked, mbs, self.mesh,
                                       axis=cfg['axis'], extras=extras,
                                       extras_streamed=tuple(streamed),
-                                      n_virtual=cfg.get('n_virtual', 1))
+                                      n_virtual=cfg.get('n_virtual', 1),
+                                      param_specs=stacked_specs)
         env[cfg['output_var']] = out.reshape((-1,) + out.shape[2:])
 
     def debug_step(self, persist, feed, key, check_nan_inf=False, on_op=None):
@@ -415,10 +593,11 @@ class _CompiledStep(object):
             self._run_ops(env, 0, len(ops), key, on_op=hook)
         else:
             ad = ops[self.ad_idx]
-            pnames, gnames, trainable, base = self._grad_setup(env, ad)
+            pnames, gnames, trainable, base, taps = \
+                self._grad_setup(env, ad)
             # eager, hooked forward pass (this is the per-op signal)
             self._run_ops(env, 0, self.ad_idx, key, on_op=hook)
-            grads, _ = jax.grad(self._make_fwd(base, ad, key),
+            grads, _ = jax.grad(self._make_fwd(base, ad, key, taps=taps),
                                 has_aux=True)(trainable)
             self._apply_grads(grads, env, ad, pnames, gnames,
                               check_nan_inf=check_nan_inf)
@@ -668,24 +847,11 @@ class Executor(object):
                 "paddle.batch(..., drop_last=True))" % (name, dv.shape[0], dp))
         return jax.device_put(dv, parallel.data_sharding(mesh, 'dp', dv.ndim))
 
-    def run(self,
-            program=None,
-            feed=None,
-            fetch_list=None,
-            feed_var_name='feed',
-            fetch_var_name='fetch',
-            scope=None,
-            return_numpy=True,
-            use_program_cache=True):
-        if program is None:
-            program = default_main_program()
-        if feed is None:
-            feed = {}
-        if fetch_list is None:
-            fetch_list = []
-        if scope is None:
-            scope = global_scope()
-
+    def _prepare(self, program, feed, fetch_list, scope,
+                 use_program_cache=True):
+        """Shared front half of run()/lowered_hlo(): device-place the feed,
+        resolve the (program, feed-sig, fetch) cache key, and build or fetch
+        the _CompiledStep. Returns (compiled, feed_vals, persist)."""
         dist_mesh = self._ensure_dist_placement(program, scope)
 
         feed_vals = {}
@@ -739,6 +905,29 @@ class Executor(object):
                 self._cache[key] = compiled
 
         persist = {n: scope._chain_get(n) for n in compiled.persist_in}
+        return compiled, feed_vals, persist
+
+    def run(self,
+            program=None,
+            feed=None,
+            fetch_list=None,
+            feed_var_name='feed',
+            fetch_var_name='fetch',
+            scope=None,
+            return_numpy=True,
+            use_program_cache=True):
+        if program is None:
+            program = default_main_program()
+        if feed is None:
+            feed = {}
+        if fetch_list is None:
+            fetch_list = []
+        if scope is None:
+            scope = global_scope()
+
+        compiled, feed_vals, persist = self._prepare(
+            program, feed, fetch_list, scope,
+            use_program_cache=use_program_cache)
         self._run_counter += 1
         rng = jax.random.key(np.uint32(
             ((program.random_seed or 0) * 2654435761 + self._run_counter)
@@ -774,6 +963,28 @@ class Executor(object):
                 v = _cast_back(v)
                 out.append(np.asarray(v) if return_numpy else v)
         return out
+
+    def lowered_hlo(self, program=None, feed=None, fetch_list=None,
+                    scope=None, optimized=False):
+        """HLO text of the EXACT fused step run() would execute for this
+        (program, feed, fetch) combination — each instruction's metadata
+        op_name carries the `<fluid_op_type>_<index>` named scope stamped
+        by lowering.run_op, so profiler traces and this dump attribute the
+        compiled module back to Fluid ops (the reference's per-op tracer
+        attributes the real run; profiler.py:81-130). optimized=True
+        returns post-XLA-pass HLO (what actually executes, fusions and
+        all); False returns the stable pre-optimization module."""
+        if program is None:
+            program = default_main_program()
+        if scope is None:
+            scope = global_scope()
+        compiled, feed_vals, persist = self._prepare(
+            program, feed or {}, fetch_list or [], scope)
+        rng = jax.random.key(0)
+        lowered = compiled._jitted.lower(persist, feed_vals, rng)
+        if optimized:
+            return lowered.compile().as_text()
+        return lowered.as_text()
 
     def close(self):
         """Release compiled executables and drop cached jit state

@@ -90,13 +90,28 @@ class LoDTensor(object):
     def from_seq_value(sv):
         data = np.asarray(sv.data)
         lens = np.asarray(sv.lengths)
+        outer = [np.asarray(lv) for lv in (sv.outer_lengths or ())]
+        if len(outer) == 1 and int(outer[0].sum()) < len(lens) \
+                and len(lens) % len(outer[0]) == 0:
+            # capacity-form 2-level value (the LoD beam decoder,
+            # ops_impl/lod_beam.py): each source owns a fixed block of
+            # len(lens)/n_src row slots with only the first outer[s] live —
+            # compact to the reference's ragged LoD layout
+            n_src = len(outer[0])
+            k = len(lens) // n_src
+            keep = np.concatenate(
+                [np.arange(s * k, s * k + int(outer[0][s]))
+                 for s in range(n_src)]).astype(int) \
+                if int(outer[0].sum()) else np.zeros((0,), int)
+            data = data[keep]
+            lens = lens[keep]
         rows = []
         for i, l in enumerate(lens):
             rows.append(data[i, :int(l)])
         flat = np.concatenate(rows, axis=0) if rows else data.reshape((0,) + data.shape[2:])
         lengths = [list(int(l) for l in lens)]
-        for lv in reversed(sv.outer_lengths or ()):
-            lengths = [list(int(l) for l in np.asarray(lv))] + lengths
+        for lv in reversed(outer):
+            lengths = [list(int(l) for l in lv)] + lengths
         return LoDTensor(flat, lengths)
 
 
@@ -186,7 +201,20 @@ def create_lod_tensor(data, recursive_seq_lens, place=None):
         # contributes one LoD level (reference create_lod_tensor derives
         # the recursive structure from the list shape).
         levels, flat = _nested_levels(data)
+        if recursive_seq_lens is not None:
+            # the reference asserts the caller's lens against the ones the
+            # nesting derives ("data and recursive_seq_lens do not match");
+            # accepting a mismatched feed silently would change lengths
+            given = [list(lv) for lv in recursive_seq_lens]
+            if given != [list(lv) for lv in levels]:
+                raise ValueError(
+                    "data and recursive_seq_lens do not match: the nested "
+                    "list derives %r but recursive_seq_lens is %r"
+                    % (levels, given))
         arr = np.concatenate(flat, axis=0)
+        if arr.dtype.kind in 'iu':
+            # reference create_lod_tensor flattens list data to int64
+            arr = arr.astype(np.int64)
         return LoDTensor(arr, levels)
     arr = np.asarray(data)
     t = LoDTensor(arr, recursive_seq_lens)

@@ -153,6 +153,45 @@ jax.tree_util.register_pytree_node(
     lambda aux, ch: SeqValue.tree_unflatten(aux, ch))
 
 
+class SparseRows(object):
+    """Sparse gradient of an embedding table: the rows actually touched.
+
+    TPU-native analogue of the reference's SelectedRows
+    (paddle/fluid/framework/selected_rows.h; lookup_table_op.cc emits one
+    as the table grad when is_sparse=True). `ids` int32[N] are the looked-up
+    row indices (duplicates allowed, in lookup order), `rows` [N, D] the
+    corresponding per-occurrence gradients; the equivalent dense gradient
+    is scatter-add(zeros(dense_shape), ids, rows). Optimizer rules
+    (ops_impl/optim_ops.py) consume it with index-based row updates, so the
+    vocab-sized dense @GRAD buffer never materializes in HBM. Static shapes
+    throughout (N = batch positions, not unique count) keep XLA happy."""
+
+    __slots__ = ('ids', 'rows', 'dense_shape')
+
+    def __init__(self, ids, rows, dense_shape):
+        self.ids = ids
+        self.rows = rows
+        self.dense_shape = tuple(dense_shape)
+
+    @property
+    def dtype(self):
+        return self.rows.dtype
+
+    def astype(self, dtype):
+        return SparseRows(self.ids, self.rows.astype(dtype),
+                          self.dense_shape)
+
+    def to_dense(self):
+        out = jnp.zeros(self.dense_shape, self.rows.dtype)
+        return out.at[self.ids].add(self.rows)
+
+
+jax.tree_util.register_pytree_node(
+    SparseRows,
+    lambda s: ((s.ids, s.rows), s.dense_shape),
+    lambda shape, ch: SparseRows(ch[0], ch[1], shape))
+
+
 def data_of(v):
     return v.data if isinstance(v, SeqValue) else v
 
@@ -172,13 +211,22 @@ def first_seq(*vals):
 
 
 def run_op(op, env, ctx):
-    """Resolve an op's inputs from env, apply its rule, bind outputs."""
-    if op.type in _BLOCK_RULES:
-        _BLOCK_RULES[op.type](op, env, ctx)
-        return
-    rule = get_rule(op.type)
-    ins = {slot: [env[v.name] for v in vs] for slot, vs in op.inputs.items()}
-    outs = rule(ins, op.attrs, ctx)
+    """Resolve an op's inputs from env, apply its rule, bind outputs.
+
+    Each rule traces under jax.named_scope('<op.type>_<op_index>'), so the
+    XLA module's per-instruction metadata op_name carries the Fluid op it
+    came from: profiler traces and HLO dumps of the COMPILED fused step map
+    back to program ops (the reference's per-op C++ event tracer,
+    profiler.py:81-130, attributes the real run the same way — here the
+    attribution survives fusion instead of requiring the eager path)."""
+    with jax.named_scope('%s_%d' % (op.type, ctx.op_index)):
+        if op.type in _BLOCK_RULES:
+            _BLOCK_RULES[op.type](op, env, ctx)
+            return
+        rule = get_rule(op.type)
+        ins = {slot: [env[v.name] for v in vs]
+               for slot, vs in op.inputs.items()}
+        outs = rule(ins, op.attrs, ctx)
     _bind_outputs(op, outs, env)
 
 
@@ -210,19 +258,154 @@ class ArrayValue(object):
     ring of `capacity` slots [capacity, *elem] plus a live-length scalar;
     writes are lax.dynamic_update_slice, reads dynamic_index_in_dim. This
     makes arrays legal lax.while_loop carries.
-    """
 
-    __slots__ = ('buffer', 'length')
+    Elements may be LoD-carrying SeqValues (the book's beam-search decoder
+    stores 2-level selected_ids/scores in arrays): `buffer` is then a TUPLE
+    of stacked leaf buffers (data, lengths, *outer_lengths) and `n_outer`
+    (static) says how many trailing buffers are outer LoD levels; -1 marks
+    a plain dense element."""
 
-    def __init__(self, buffer, length):
+    __slots__ = ('buffer', 'length', 'n_outer')
+
+    def __init__(self, buffer, length, n_outer=-1):
         self.buffer = buffer
         self.length = length
+        self.n_outer = n_outer
+
+    @property
+    def is_seq(self):
+        return self.n_outer >= 0
+
+    def read(self, i):
+        """Element at slot i (rebuilds the SeqValue for seq-backed arrays)."""
+        take = lambda b: jax.lax.dynamic_index_in_dim(b, i, axis=0,
+                                                      keepdims=False)
+        if not self.is_seq:
+            return take(self.buffer)
+        leaves = tuple(take(b) for b in self.buffer)
+        outer = leaves[2:2 + self.n_outer] if self.n_outer else None
+        return SeqValue(leaves[0], leaves[1], outer)
+
+    @staticmethod
+    def _grow_rows(buf, rows_new):
+        """[cap, r_old, ...] -> [cap, rows_new, ...]: row i moves to
+        i * stride (the LoD beam capacity convention — each source's rows
+        must land at the START of its capacity block; see
+        ops_impl/lod_beam.py)."""
+        r_old = buf.shape[1]
+        if rows_new == r_old:
+            return buf
+        if rows_new % r_old:
+            raise ValueError(
+                'array_write: element rows grew %d -> %d; capacity '
+                'widening needs an integer stride' % (r_old, rows_new))
+        out = jnp.zeros((buf.shape[0], rows_new) + buf.shape[2:],
+                        buf.dtype)
+        return out.at[:, ::rows_new // r_old].set(buf)
+
+    def _grown_to(self, x):
+        """Widen/convert the buffers so a write of `x` fits (the book's
+        decode idiom writes one row per source before the While, beam_size
+        rows per source inside it)."""
+        if isinstance(x, SeqValue):
+            n_outer = len(x.outer_lengths or ())
+            if not self.is_seq:
+                data = self._grow_rows(self.buffer, x.data.shape[0])
+                stride = x.data.shape[0] // self.buffer.shape[1]
+                lens = jnp.zeros((data.shape[0], x.data.shape[0]),
+                                 jnp.int32)
+                lens = lens.at[:, ::stride].set(1)
+                outer = tuple(
+                    jnp.ones((data.shape[0],) + o.shape, o.dtype)
+                    for o in (x.outer_lengths or ()))
+                return ArrayValue((data, lens) + outer, self.length,
+                                  n_outer)
+            d0 = self.buffer[0]
+            if d0.ndim == x.data.ndim + 2 and d0.shape[2] == 1:
+                # padded 2-level feed slots [B, max_len=1, ...] -> flat rows
+                d0 = d0.reshape(d0.shape[:2] + d0.shape[3:])
+            data = self._grow_rows(d0, x.data.shape[0])
+            lens = self._grow_rows(self.buffer[1], x.lengths.shape[0])
+            return ArrayValue((data, lens) + self.buffer[2:], self.length,
+                              self.n_outer)
+        if not self.is_seq:
+            return ArrayValue(self._grow_rows(self.buffer,
+                                              data_of(x).shape[0]),
+                              self.length, -1)
+        return self
+
+    def _elem_fits(self, x):
+        if isinstance(x, SeqValue):
+            return (self.is_seq
+                    and self.n_outer == len(x.outer_lengths or ())
+                    and self.buffer[0].shape[1:] == x.data.shape
+                    and self.buffer[1].shape[1:] == x.lengths.shape)
+        return (not self.is_seq
+                and self.buffer.shape[1:] == data_of(x).shape)
+
+    def write(self, i, x):
+        """New ArrayValue with slot i <- x; the buffers grow (capacity
+        convention) when x is wider than the current slots."""
+        if not isinstance(x, SeqValue) and self.is_seq:
+            # dense write into an LoD array (e.g. an encoder state fed to
+            # the decode idiom's state array): adopt one full-length group
+            # per row
+            x = SeqValue(data_of(x),
+                         jnp.ones((data_of(x).shape[0],), jnp.int32),
+                         tuple(jnp.ones(b.shape[1:], b.dtype)
+                               for b in self.buffer[2:2 + self.n_outer])
+                         or None)
+        if isinstance(x, SeqValue) and not self._elem_fits(x):
+            slot = self.buffer[0] if self.is_seq else self.buffer
+            if (x.data.ndim == slot.ndim and x.data.shape[1] == 1
+                    and slot.shape[1:] != x.data.shape):
+                # [rows, max_len=1, ...] padded element vs flat-row slots
+                # (the decode idiom's pre-loop feeds): drop the singleton
+                # time dim before fitting/growing
+                x = SeqValue(x.data[:, 0], x.lengths, x.outer_lengths)
+        if not self._elem_fits(x):
+            grown = self._grown_to(x)
+            if not grown._elem_fits(x):
+                def shp(v):
+                    if isinstance(v, SeqValue):
+                        return ('seq', v.data.shape, v.lengths.shape,
+                                tuple(o.shape
+                                      for o in (v.outer_lengths or ())))
+                    return getattr(v, 'shape', v)
+                raise TypeError(
+                    'array_write: element %r does not fit (and cannot '
+                    'grow to fit) array slots %r'
+                    % (shp(x), [b.shape for b in grown.buffer]
+                       if grown.is_seq else grown.buffer.shape))
+            return grown.write(i, x)
+        put = lambda b, v: jax.lax.dynamic_update_index_in_dim(
+            b, v.astype(b.dtype), i, axis=0)
+        if isinstance(x, SeqValue):
+            leaves = (x.data, x.lengths) + tuple(x.outer_lengths or ())
+            assert len(leaves) == len(self.buffer)  # _elem_fits checked
+            buf = tuple(put(b, v) for b, v in zip(self.buffer, leaves))
+        else:
+            buf = put(self.buffer, x)
+        cap = (self.buffer[0] if self.is_seq else self.buffer).shape[0]
+        length = jnp.minimum(jnp.maximum(self.length, i + 1), cap)
+        return ArrayValue(buf, length, self.n_outer)
+
+    @classmethod
+    def fresh(cls, x, capacity):
+        """Empty array sized for elements shaped like x."""
+        z = lambda v: jnp.zeros((capacity,) + tuple(v.shape), v.dtype)
+        if isinstance(x, SeqValue):
+            leaves = (x.data, x.lengths) + tuple(x.outer_lengths or ())
+            return cls(tuple(z(v) for v in leaves),
+                       jnp.asarray(0, jnp.int32),
+                       len(x.outer_lengths or ()))
+        return cls(z(x), jnp.asarray(0, jnp.int32), -1)
 
 
 jax.tree_util.register_pytree_node(
     ArrayValue,
-    lambda a: ((a.buffer, a.length), None),
-    lambda aux, ch: ArrayValue(ch[0], ch[1]))
+    lambda a: ((a.buffer, a.length), a.n_outer),
+    lambda aux, ch: ArrayValue(ch[0], ch[1], aux))
 
 
 def _bind_outputs(op, outs, env):

@@ -58,37 +58,34 @@ def _pred_where(cond, a, b):
 
 @register('array_write')
 def _array_write(ins, attrs, ctx):
-    x = data_of(ins['X'][0])
+    x = ins['X'][0]
+    if not isinstance(x, SeqValue):
+        x = data_of(x)
     i = jnp.reshape(data_of(ins['I'][0]), (-1,))[0].astype(jnp.int32)
     arrs = ins.get('Array', [])
     if arrs and isinstance(arrs[0], ArrayValue):
         arr = arrs[0]
-        buf, length = arr.buffer, arr.length
     else:
         cap = int(attrs.get('capacity', DEFAULT_ARRAY_CAPACITY))
-        buf = jnp.zeros((cap,) + tuple(x.shape), x.dtype)
-        length = jnp.asarray(0, jnp.int32)
+        arr = ArrayValue.fresh(x, cap)
     # Writes past capacity clamp to the last slot (dynamic_update_index
     # semantics); length is clamped too so reads stay in range. Size the
     # array via create_array/array_write(capacity=) for longer loops.
-    cap = buf.shape[0]
+    cap = (arr.buffer[0] if arr.is_seq else arr.buffer).shape[0]
     lax.cond(i >= cap,
              lambda: jax.debug.print(
                  'WARNING: array_write index {i} >= capacity {c}; write '
                  'clamped to the last slot — pass capacity= to '
                  'create_array/array_write for longer loops', i=i, c=cap),
              lambda: None)
-    buf = lax.dynamic_update_index_in_dim(buf, x.astype(buf.dtype), i, axis=0)
-    length = jnp.minimum(jnp.maximum(length, i + 1), cap)
-    return {'Out': ArrayValue(buf, length)}
+    return {'Out': arr.write(i, x)}
 
 
 @register('array_read')
 def _array_read(ins, attrs, ctx):
     arr = ins['Array'][0]
     i = jnp.reshape(data_of(ins['I'][0]), (-1,))[0].astype(jnp.int32)
-    return {'Out': lax.dynamic_index_in_dim(arr.buffer, i, axis=0,
-                                            keepdims=False)}
+    return {'Out': arr.read(i)}
 
 
 @register('array_length')
@@ -104,12 +101,87 @@ def _array_stack(ins, attrs, ctx):
     LoDTensorArray on the host instead). Slots never written are zeros —
     size the array's capacity to the loop trip count."""
     arr = ins['Array'][0]
-    return {'Out': arr.buffer}
+    return {'Out': arr.buffer[0] if arr.is_seq else arr.buffer}
 
 
 # ---------------------------------------------------------------------------
 # while
 # ---------------------------------------------------------------------------
+
+# single source of truth for the stride-widening convention (rows move to
+# block starts): ArrayValue._grow_rows in lowering.py
+_widen_rows = ArrayValue._grow_rows
+
+
+def _widen_array(a, target):
+    """Widen an initial ArrayValue to the shapes/structure the loop body
+    produces (`target` is the eval_shape result, an ArrayValue of
+    ShapeDtypeStructs)."""
+    if target.is_seq and not a.is_seq:
+        # the pre-loop write was dense (e.g. the encoder state fed into
+        # state_array); the body writes LoD values. Adopt the seq layout
+        # with the dense rows as 1-row-per-source groups.
+        data_t, len_t = target.buffer[0], target.buffer[1]
+        data = _widen_rows(a.buffer, data_t.shape[1])
+        stride = data_t.shape[1] // a.buffer.shape[1]
+        lens = jnp.zeros(len_t.shape, len_t.dtype)
+        lens = lens.at[:, ::stride].set(
+            jnp.ones((len_t.shape[0], a.buffer.shape[1]), len_t.dtype))
+        outer = tuple(
+            jnp.ones(ob.shape, ob.dtype)
+            for ob in target.buffer[2:2 + target.n_outer])
+        return ArrayValue((data, lens) + outer, a.length, target.n_outer)
+    if a.is_seq:
+        data_t = target.buffer[0]
+        d0 = a.buffer[0]
+        if d0.ndim == data_t.ndim + 1 and d0.shape[2] == 1:
+            # padded 2-level feed form [B, max_len=1, ...] (the book's
+            # init_ids/init_scores) -> flat capacity row form [B, ...]
+            d0 = d0.reshape(d0.shape[:2] + d0.shape[3:])
+        if d0.shape != data_t.shape:
+            data = _widen_rows(d0, data_t.shape[1])
+            lens = _widen_rows(a.buffer[1], target.buffer[1].shape[1])
+            outer = a.buffer[2:]
+            return ArrayValue((data, lens) + outer, a.length, a.n_outer)
+        if d0 is not a.buffer[0]:
+            return ArrayValue((d0,) + a.buffer[1:], a.length, a.n_outer)
+        return a
+    if a.buffer.shape != target.buffer.shape:
+        return ArrayValue(_widen_rows(a.buffer, target.buffer.shape[1]),
+                          a.length, -1)
+    return a
+
+
+def _widen_carry_to_body(init, body_env):
+    """Fixed-point capacity widening (the book's LoD beam decoder idiom):
+    pre-loop writes may be narrower than what the body produces — e.g.
+    init_ids holds one row per source, beam_search emits beam_size per
+    source. lax.while_loop demands identical carry shapes, so abstractly
+    evaluate the body and widen the INITIAL arrays to the body's shapes
+    (rows redistributed per the beam-block convention) until stable."""
+    for _ in range(4):
+        try:
+            target = jax.eval_shape(body_env, init)
+        except Exception:
+            return init, False  # let the real trace surface the error
+        changed = False
+        out = {}
+        for n, v in init.items():
+            t = target.get(n)
+            if isinstance(v, ArrayValue) and isinstance(t, ArrayValue):
+                w = _widen_array(v, t)
+                changed = changed or (w is not v)
+                out[n] = w
+            else:
+                out[n] = v
+        init = out
+        if not changed:
+            return init, True
+    raise ValueError(
+        'While: loop-carried shapes did not stabilize after capacity '
+        'widening — the body grows an array on every iteration, which '
+        'XLA cannot compile; restructure the loop with static shapes')
+
 
 @register_block_op('while')
 def _while(op, env, ctx):
@@ -141,6 +213,11 @@ def _while(op, env, ctx):
         new = {n: e[n] for n in carry_names}
         new[ITER] = t + 1
         return new
+
+    if any(isinstance(env[n], ArrayValue) for n in carry_names):
+        init2, ok = _widen_carry_to_body(init, body_env)
+        if ok:
+            init = init2
 
     max_iters = op.attrs.get('max_iters')
     if max_iters:

@@ -1,0 +1,275 @@
+"""Verbatim LoD beam search: the book's While-loop decoder idiom.
+
+Reference: operators/beam_search_op.cc (SelectTopBeamSizeItems / ToMap /
+PruneEndBeams) + operators/beam_search_decode_op.h (Backtrace +
+ConvertSentenceVectorToLodTensor), driven by
+tests/book/test_machine_translation.py:decode_main. There the number of
+live beam rows per source changes every While iteration and lives in the
+2-level LoD of selected_ids/scores. XLA needs static shapes, so this
+module runs the SAME algorithm at fixed CAPACITY:
+
+  - every step tensor is a SeqValue with data [B*K, ...] — source s owns
+    the row block [s*K, (s+1)*K), its live rows compacted to the front;
+  - `lengths` (int32[B*K]) is the reference's lod[1] at capacity: entry
+    s*K + p = number of selected children of parent group p (a row of the
+    PREVIOUS step); dead slots hold 0;
+  - `outer_lengths[0]` (int32[B]) is lod[0]: parent groups per source.
+
+The capacity form is produced by the While capacity-widening pass
+(ops_impl/block_ops.py:_widen_carry_to_body) from the narrower pre-loop
+feeds, and consumed/emitted by the beam_search / sequence_expand /
+lod_reset / is_empty branches below. `beam_search_decode` backtraces the
+LoDTensorArrays exactly like the reference's host walk, on device.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..lowering import SeqValue, ArrayValue, data_of
+
+NEG = -1e30
+
+
+def is_beam_form(v):
+    """Capacity-form 2-level SeqValue: outer length vector shorter than the
+    row dim (a standard padded 2-level feed has them equal)."""
+    return (isinstance(v, SeqValue) and v.outer_lengths
+            and v.outer_lengths[0].shape[0] != v.data.shape[0]
+            and v.data.shape[0] % v.outer_lengths[0].shape[0] == 0)
+
+
+def blocks(v):
+    """(B, K) of a capacity-form SeqValue."""
+    b = v.outer_lengths[0].shape[0]
+    return b, v.data.shape[0] // b
+
+
+def rows_live(v):
+    """[B] live row count per source block = sum of lod[1] lengths."""
+    b, k = blocks(v)
+    return v.lengths.reshape(b, k).sum(axis=1)
+
+
+def _compact_order(sel, key):
+    """Per-source compaction: argsort putting selected entries first in
+    `key` order. sel/key: [B, N]. Returns indices [B, N]."""
+    n = sel.shape[1]
+    rank = jnp.where(sel, key, n * n + key)
+    return jnp.argsort(rank, axis=1)
+
+
+def _rows_per_source_narrow(v):
+    """Rows per source of a NARROW (pre-capacity) 2-level SeqValue: level-0
+    lengths count level-1 groups, and in the decode idiom each group is one
+    row (data [rows, 1, ...] or [rows, ...])."""
+    return v.outer_lengths[0].astype(jnp.int32)
+
+
+def normalize_capacity(pre_ids, pre_scores, ids, scores, beam_size):
+    """Bring a beam step's inputs to capacity form [B*K, ...].
+
+    The While capacity-widening pass (block_ops) normally does this before
+    the loop ever runs; this in-rule fallback serves its OWN abstract
+    probe (the first eval_shape of the body sees the narrow pre-loop
+    shapes) and direct eager calls on feed-shaped values."""
+    if is_beam_form(pre_scores):
+        return pre_ids, pre_scores, data_of(ids), data_of(scores)
+    B = pre_scores.outer_lengths[0].shape[0]
+    K = int(beam_size)
+    rows = _rows_per_source_narrow(pre_scores)
+    n_rows = data_of(pre_scores).shape[0]
+    # source of each narrow row + its position within the source block
+    ends = jnp.cumsum(rows)
+    src = jnp.searchsorted(ends, jnp.arange(n_rows), side='right')
+    src = jnp.minimum(src, B - 1)
+    pos = jnp.arange(n_rows) - jnp.where(src > 0, ends[src - 1], 0)
+    dest = src * K + pos                                  # [n_rows]
+
+    def scatter(flat, fill=0):
+        flat = data_of(flat)
+        if flat.ndim >= 2 and flat.shape[1] == 1 and flat.ndim > 2:
+            flat = flat[:, 0]                             # drop pad-time dim
+        out = jnp.full((B * K,) + flat.shape[1:], fill, flat.dtype)
+        return out.at[dest].set(flat)
+
+    l1 = jnp.zeros((B * K,), jnp.int32).at[dest].set(1)
+    mk = lambda v: SeqValue(scatter(v), l1, (rows,))
+    return (mk(pre_ids), mk(pre_scores), scatter(ids), scatter(scores))
+
+
+def beam_search_step(pre_ids, pre_scores, ids, scores, beam_size, end_id):
+    """One reference beam_search step on capacity-form values.
+
+    pre_ids/pre_scores: SeqValue [B*K, 1]; ids/scores: [B*K, topk] dense.
+    Returns (selected_ids SeqValue, selected_scores SeqValue,
+    parent_rows int32[B*K] global parent row per output row, -1 dead).
+    """
+    K = int(beam_size)
+    B, Kcap = blocks(pre_scores)
+    R = B * Kcap
+    pid = data_of(pre_ids).reshape(R).astype(jnp.int32)
+    psc = data_of(pre_scores).reshape(R).astype(jnp.float32)
+    cid = data_of(ids).reshape(R, -1).astype(jnp.int32)
+    csc = data_of(scores).reshape(R, -1).astype(jnp.float32)
+    topk = cid.shape[1]
+
+    live = ((jnp.arange(R) % Kcap).reshape(B, Kcap)
+            < rows_live(pre_scores)[:, None]).reshape(R)
+    ended = live & (pid == end_id)
+
+    # candidate table [R, topk]: ended rows contribute ONE candidate
+    # (end_id, pre_score) in slot 0 (reference NextItemSet); dead rows
+    # contribute none
+    slot0 = jnp.arange(topk)[None, :] == 0
+    cand_sc = jnp.where(ended[:, None],
+                        jnp.where(slot0, psc[:, None], NEG), csc)
+    cand_id = jnp.where(ended[:, None], end_id, cid)
+    cand_sc = jnp.where(live[:, None], cand_sc, NEG)
+
+    # top beam_size per SOURCE over its Kcap*topk candidates
+    flat_sc = cand_sc.reshape(B, Kcap * topk)
+    top_sc, top_pos = lax.top_k(flat_sc, K)              # [B, K]
+    sel_valid = top_sc > NEG / 2
+    # PruneEndBeams: a source whose LIVE rows are all ended selects only
+    # end-repeats -> emits nothing further (reference clears its items)
+    finished = (rows_live(pre_scores) > 0) & \
+        ((~live | ended).reshape(B, Kcap).all(axis=1))
+    sel_valid = sel_valid & ~finished[:, None]
+
+    # group output rows by parent row ascending, then candidate slot
+    # ascending (reference writes items per offset in encounter order)
+    sel_mask = jnp.zeros((B, Kcap * topk), bool)
+    sel_mask = sel_mask.at[jnp.arange(B)[:, None], top_pos].set(sel_valid)
+    order = _compact_order(sel_mask, jnp.arange(Kcap * topk)[None, :])
+    ordered_pos = order[:, :Kcap]                        # [B, Kcap]
+    ordered_ok = jnp.take_along_axis(sel_mask, ordered_pos, axis=1)
+    parent_local = ordered_pos // topk                   # [B, Kcap]
+    out_id = jnp.take_along_axis(cand_id.reshape(B, Kcap * topk),
+                                 ordered_pos, axis=1)
+    out_sc = jnp.take_along_axis(cand_sc.reshape(B, Kcap * topk),
+                                 ordered_pos, axis=1)
+    out_id = jnp.where(ordered_ok, out_id, 0)
+    out_sc = jnp.where(ordered_ok, out_sc, 0.0)
+
+    # lod[1]: children per parent slot; lod[0]: parent groups per source
+    # (the input's row count — reference copies high_level verbatim)
+    l1 = jax.vmap(lambda pl, ok: jnp.zeros(
+        (Kcap,), jnp.int32).at[pl].add(ok.astype(jnp.int32)))(
+            parent_local, ordered_ok)
+    l0 = rows_live(pre_scores).astype(jnp.int32)
+
+    parent_rows = jnp.where(
+        ordered_ok,
+        parent_local + (jnp.arange(B) * Kcap)[:, None], -1)
+    sel_ids = SeqValue(out_id.reshape(R, 1).astype(jnp.int64),
+                       l1.reshape(R), (l0,))
+    sel_scores = SeqValue(out_sc.reshape(R, 1), l1.reshape(R), (l0,))
+    return sel_ids, sel_scores, parent_rows.reshape(R)
+
+
+def sequence_expand_beam(x, y):
+    """x row for parent group p of source s sits at x.data[s*K + p] (the
+    previous step's children ARE this step's parent groups); output row
+    (s, child c) copies x[parent_of(c)] (reference sequence_expand over
+    the 2-level LoD)."""
+    B, Kcap = blocks(y)
+    xd = data_of(x)
+    if xd.ndim > 2 and xd.shape[1] == 1:
+        xd = xd[:, 0]
+    # parent group of each child row: child rows are compacted per source
+    # in parent order, so parent(c) = searchsorted(cumsum(l1), c)
+    l1 = y.lengths.reshape(B, Kcap)
+    ends = jnp.cumsum(l1, axis=1)                        # [B, Kcap]
+    child_pos = jnp.arange(Kcap)[None, :]
+    parent = jax.vmap(
+        lambda e: jnp.searchsorted(e, child_pos[0], side='right'))(ends)
+    parent = jnp.minimum(parent, Kcap - 1)
+    rows = parent + jnp.arange(B)[:, None] * Kcap        # [B, Kcap] global
+    out = xd[rows.reshape(-1)]
+    # emit [rows, 1, ...]: each output row is a one-token level-1 group,
+    # and downstream fc ops were shape-inferred for the padded 3-D layout
+    return SeqValue(out[:, None], y.lengths, y.outer_lengths)
+
+
+def is_empty_beam(v):
+    return (rows_live(v).sum() == 0).reshape(())
+
+
+def beam_search_decode_arrays(ids_arr, scores_arr, beam_size, end_id):
+    """Backtrace the step arrays into sentences (reference Backtrace +
+    ConvertSentenceVectorToLodTensor with reverse=true, the op defaults).
+
+    Returns (sentence_ids SeqValue [B*K, T_cap] int64, sentence_scores
+    SeqValue same shape float32): lengths = tokens per hypothesis, outer =
+    hypotheses per source.
+    """
+    data_ids = ids_arr.buffer[0]            # [T_cap, R, 1]
+    lens = ids_arr.buffer[1]                # [T_cap, R]
+    data_sc = scores_arr.buffer[0]
+    T_cap, R = lens.shape
+    n_src = ids_arr.buffer[2].shape[1]
+    B, Kcap = n_src, R // n_src
+    T_live = ids_arr.length                  # traced scalar
+
+    l1 = lens.reshape(T_cap, B, Kcap)
+    child_cnt = l1.sum(axis=2)               # [T_cap, B] live children
+    step_ok = (jnp.arange(T_cap)[:, None] < T_live) & (child_cnt > 0)
+    # seed step per source: the LAST step with any children (a source
+    # finished+pruned earlier seeds at its own last nonempty step —
+    # reference's "be finished and pruned at this step" branch)
+    t_seed = jnp.where(step_ok, jnp.arange(T_cap)[:, None], -1).max(0)
+
+    ends = jnp.cumsum(l1, axis=2)            # [T_cap, B, Kcap]
+
+    def parent_of(t, child):                 # child [B, K] local indices
+        e = ends[t]                          # [B, Kcap]
+        return jax.vmap(
+            lambda ee, cc: jnp.minimum(
+                jnp.searchsorted(ee, cc, side='right'), Kcap - 1))(e, child)
+
+    n_hyp = jnp.take_along_axis(
+        child_cnt, jnp.maximum(t_seed, 0)[None, :], axis=0)[0]
+    n_hyp = jnp.minimum(n_hyp, Kcap)
+
+    hyp = jnp.broadcast_to(jnp.arange(Kcap)[None, :], (B, Kcap))
+
+    def step_back(carry, t):
+        ptr, started = carry                 # [B, K] row ptr, bool active
+        start_now = (t == t_seed)[:, None] & \
+            (hyp < n_hyp[:, None])
+        ptr = jnp.where(start_now, hyp, ptr)
+        started = started | start_now
+        gidx = (jnp.arange(B)[:, None] * Kcap + ptr).reshape(-1)
+        tok = data_ids[t].reshape(R)[gidx].reshape(B, Kcap)
+        sc = data_sc[t].reshape(R)[gidx].reshape(B, Kcap)
+        valid = started
+        new_ptr = jnp.where(started, parent_of(t, ptr), ptr)
+        return (new_ptr, started), (tok, sc, valid)
+
+    (_, _), (toks, scs, valids) = lax.scan(
+        step_back, (jnp.zeros((B, Kcap), jnp.int32),
+                    jnp.zeros((B, Kcap), bool)),
+        jnp.arange(T_cap - 1, -1, -1))
+    # toks: [T_cap, B, K] backward order (seed first)
+
+    # "skip redundant end tokens": drop end_id unless it is the first
+    # (seed-position) token of the hypothesis
+    first = jnp.cumsum(valids.astype(jnp.int32), axis=0) == 1
+    keep = valids & (first | (toks.astype(jnp.int32) != end_id))
+
+    # forward order with left-compaction per hypothesis
+    def fix_one(tk, sc, kp):
+        # tk/sc/kp: [T_cap] backward; output forward-compacted [T_cap]
+        n = kp.sum()
+        order = jnp.argsort(jnp.where(kp, -jnp.arange(T_cap), T_cap))
+        return tk[order], sc[order], n
+
+    flat = lambda a: jnp.moveaxis(a, 0, -1).reshape(B * Kcap, T_cap)
+    tok_f, sc_f, nt = jax.vmap(fix_one)(flat(toks), flat(scs), flat(keep))
+    hyp_valid = (jnp.arange(Kcap)[None, :] < n_hyp[:, None]).reshape(-1)
+    nt = jnp.where(hyp_valid, nt, 0).astype(jnp.int32)
+    sent_ids = SeqValue(tok_f.astype(jnp.int64), nt,
+                        (n_hyp.astype(jnp.int32),))
+    sent_scores = SeqValue(sc_f.astype(jnp.float32), nt,
+                           (n_hyp.astype(jnp.int32),))
+    return sent_ids, sent_scores

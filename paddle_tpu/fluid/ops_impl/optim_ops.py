@@ -6,20 +6,49 @@ fused into the same XLA module as forward+backward, so the whole train step
 is one device launch (the reference dispatches one CUDA kernel per param per
 optimizer op).
 """
+import jax
 import jax.numpy as jnp
 
-from ..lowering import register, data_of
+from ..lowering import register, data_of, SparseRows
 
 
 def _lr(ins):
     return data_of(ins['LearningRate'][0]).reshape(())
 
 
+def _merge_sparse(g):
+    """Merge duplicate ids of a SparseRows grad (reference MergeAdd,
+    operators/math/selected_rows_functor.cc): nonlinear updates (adagrad's
+    g^2, adam's moments) must see each touched row ONCE with its summed
+    gradient. Static shapes: sort the N occurrences, segment-sum into at
+    most N merged rows, and return (uids int32[N], merged [N, D],
+    valid bool[N]) where invalid slots carry zero rows and id 0 — callers
+    mask their update deltas with `valid` so the padding rows are no-ops."""
+    ids, rows = g.ids, g.rows
+    n = ids.shape[0]
+    order = jnp.argsort(ids)
+    sid = ids[order]
+    srows = rows[order]
+    is_first = jnp.concatenate(
+        [jnp.ones((1,), bool), sid[1:] != sid[:-1]])
+    seg = jnp.cumsum(is_first) - 1                  # [N] segment per row
+    merged = jax.ops.segment_sum(srows, seg, num_segments=n)
+    uids = jnp.zeros((n,), sid.dtype).at[seg].set(sid)
+    valid = jnp.arange(n) < seg[-1] + 1
+    return uids, merged, valid
+
+
 @register('sgd')
 def _sgd(ins, attrs, ctx):
     p = data_of(ins['Param'][0])
-    g = data_of(ins['Grad'][0])
-    return {'ParamOut': p - _lr(ins) * g}
+    g = ins['Grad'][0]
+    if isinstance(g, SparseRows):
+        # index-based row update (reference sgd_op.h SelectedRows branch):
+        # scatter-add handles duplicate ids exactly like the dense path
+        # (SGD is linear in the gradient), and the vocab-sized dense grad
+        # buffer never exists
+        return {'ParamOut': p.at[g.ids].add(-_lr(ins) * g.rows)}
+    return {'ParamOut': p - _lr(ins) * data_of(g)}
 
 
 @register('momentum')
@@ -40,18 +69,32 @@ def _momentum(ins, attrs, ctx):
 @register('adagrad')
 def _adagrad(ins, attrs, ctx):
     p = data_of(ins['Param'][0])
-    g = data_of(ins['Grad'][0])
+    g = ins['Grad'][0]
     m = data_of(ins['Moment'][0])
     eps = attrs.get('epsilon', 1e-6)
+    lr = _lr(ins)
+    if isinstance(g, SparseRows):
+        # touched-rows-only update on merged duplicates (reference
+        # adagrad_op.h SelectedRows branch: MergeAdd then per-row update).
+        # Deltas (not absolute values) are scattered so the zero-padded
+        # invalid merge slots are exact no-ops under duplicate indices.
+        uids, gm, valid = _merge_sparse(g)
+        vm = valid[:, None].astype(gm.dtype)
+        m_rows = m[uids]
+        m_new = m_rows + gm * gm
+        p_delta = -lr * gm / (jnp.sqrt(m_new) + eps) * vm
+        return {'ParamOut': p.at[uids].add(p_delta),
+                'MomentOut': m.at[uids].add((m_new - m_rows) * vm)}
+    g = data_of(g)
     m_out = m + g * g
-    p_out = p - _lr(ins) * g / (jnp.sqrt(m_out) + eps)
+    p_out = p - lr * g / (jnp.sqrt(m_out) + eps)
     return {'ParamOut': p_out, 'MomentOut': m_out}
 
 
 @register('adam')
 def _adam(ins, attrs, ctx):
     p = data_of(ins['Param'][0])
-    g = data_of(ins['Grad'][0])
+    g = ins['Grad'][0]
     m1 = data_of(ins['Moment1'][0])
     m2 = data_of(ins['Moment2'][0])
     b1p = data_of(ins['Beta1Pow'][0]).reshape(())
@@ -59,9 +102,25 @@ def _adam(ins, attrs, ctx):
     b1 = attrs.get('beta1', 0.9)
     b2 = attrs.get('beta2', 0.999)
     eps = attrs.get('epsilon', 1e-8)
+    lr = _lr(ins) * jnp.sqrt(1 - b2p) / (1 - b1p)
+    if isinstance(g, SparseRows):
+        # lazy SelectedRows semantics (reference adam_op.h sparse branch):
+        # only touched rows' moments decay/update; duplicates are merged
+        # first so the nonlinear moment math sees each row's summed grad
+        # once. Scattered as deltas — padding slots from the merge are
+        # exact no-ops.
+        uids, gm, valid = _merge_sparse(g)
+        vm = valid[:, None].astype(gm.dtype)
+        m1_rows, m2_rows = m1[uids], m2[uids]
+        m1_new = b1 * m1_rows + (1 - b1) * gm
+        m2_new = b2 * m2_rows + (1 - b2) * gm * gm
+        p_delta = -lr * m1_new / (jnp.sqrt(m2_new) + eps) * vm
+        return {'ParamOut': p.at[uids].add(p_delta),
+                'Moment1Out': m1.at[uids].add((m1_new - m1_rows) * vm),
+                'Moment2Out': m2.at[uids].add((m2_new - m2_rows) * vm)}
+    g = data_of(g)
     m1_out = b1 * m1 + (1 - b1) * g
     m2_out = b2 * m2 + (1 - b2) * g * g
-    lr = _lr(ins) * jnp.sqrt(1 - b2p) / (1 - b1p)
     p_out = p - lr * m1_out / (jnp.sqrt(m2_out) + eps)
     return {'ParamOut': p_out, 'Moment1Out': m1_out, 'Moment2Out': m2_out}
 

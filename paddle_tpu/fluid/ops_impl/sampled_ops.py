@@ -102,7 +102,23 @@ def _beam_search(ins, attrs, ctx):
     """One beam step on dense [batch*beam, K] candidates: joint top-k over
     beam*K per source, with explicit parent pointers instead of the
     reference's LoD lineage. Finished beams (pre_id == end_id) contribute a
-    single end_id candidate carrying their accumulated score forward."""
+    single end_id candidate carrying their accumulated score forward.
+
+    When the inputs are capacity-form 2-level SeqValues — the book's
+    While-loop LoD decoder running verbatim — the step instead follows the
+    reference beam_search_op.cc algorithm exactly (ops_impl/lod_beam.py)."""
+    from ..lowering import SeqValue
+    from .lod_beam import normalize_capacity, beam_search_step
+    psc = ins['pre_scores'][0] if ins.get('pre_scores') else None
+    if isinstance(psc, SeqValue) and psc.outer_lengths:
+        p_ids, p_sc, cids, csc = normalize_capacity(
+            ins['pre_ids'][0], psc, ins['ids'][0], ins['scores'][0],
+            int(attrs['beam_size']))
+        sel_ids, sel_scores, parents = beam_search_step(
+            p_ids, p_sc, cids, csc, int(attrs['beam_size']),
+            int(attrs['end_id']))
+        return {'selected_ids': sel_ids, 'selected_scores': sel_scores,
+                'parent_idx': parents.astype(jnp.int64)}
     pre_ids = data_of(ins['pre_ids'][0]).astype(jnp.int32)   # [B*b, 1]
     ids = data_of(ins['ids'][0]).astype(jnp.int32)           # [B*b, K]
     scores = data_of(ins['scores'][0]).astype(jnp.float32)   # [B*b, K]
@@ -243,7 +259,25 @@ def _beam_search_decode(ins, attrs, ctx):
     Dense contract (replaces the reference's LoDTensorArray walk): Ids and
     Scores are [T, batch, beam]; Parents [T, batch, beam] gives each
     step's source beam. Emits SentenceIds [batch, beam, T] (end_id padded)
-    and SentenceScores [batch, beam] final accumulated scores."""
+    and SentenceScores [batch, beam] final accumulated scores.
+
+    Passed the LoDTensorArrays themselves (the book's While-loop decoder
+    verbatim), it backtraces them with the reference Backtrace algorithm
+    instead (ops_impl/lod_beam.py) and emits 2-level LoD sentences."""
+    from ..lowering import ArrayValue
+    if isinstance(ins['Ids'][0], ArrayValue):
+        if not ins['Ids'][0].is_seq:
+            raise TypeError(
+                'beam_search_decode on a LoDTensorArray requires LoD '
+                '(beam_search-written) elements; for dense per-step beams '
+                'pass stacked [T, batch, beam] tensors + parents instead '
+                '(layers.beam_search_decode dense contract)')
+        from .lod_beam import beam_search_decode_arrays
+        sent_ids, sent_scores = beam_search_decode_arrays(
+            ins['Ids'][0], ins['Scores'][0],
+            int(attrs.get('beam_size', 0) or 0),
+            int(attrs.get('end_id', 0)))
+        return {'SentenceIds': sent_ids, 'SentenceScores': sent_scores}
     ids = data_of(ins['Ids'][0]).astype(jnp.int32)        # [T, B, beam]
     scores = data_of(ins['Scores'][0]).astype(jnp.float32)
     T, B, beam = ids.shape

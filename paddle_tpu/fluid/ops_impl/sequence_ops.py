@@ -30,6 +30,12 @@ def _lookup_table(ins, attrs, ctx):
         pad = attrs['padding_idx']
         w = w.at[pad].set(0.0)
     out = jnp.take(w, ids, axis=0)
+    from .lod_beam import is_beam_form
+    if is_beam_form(ids_v) and out.ndim == ids.ndim + 1:
+        # capacity-form beam rows [R] embed to [R, 1, E]: each row is a
+        # one-token level-1 group and downstream fc ops were
+        # shape-inferred for the padded 3-D layout (decode idiom)
+        out = out[:, None]
     return {'Out': like(ids_v, out)}
 
 
@@ -113,6 +119,11 @@ def _sequence_expand(ins, attrs, ctx):
     """Broadcast per-row x over y's time steps (reference
     operators/sequence_expand_op.cc, ref_level=-1 common case)."""
     xv = ins['X'][0]
+    from .lod_beam import is_beam_form, sequence_expand_beam
+    if is_beam_form(ins['Y'][0]):
+        # the book's LoD beam decoder: replicate each parent state over
+        # its selected children (capacity form, lod_beam.py)
+        return {'Out': sequence_expand_beam(xv, ins['Y'][0])}
     y = _seq(ins['Y'][0])
     x = data_of(xv)
     t = y.data.shape[1]
@@ -167,6 +178,10 @@ def _lod_reset(ins, attrs, ctx):
     data = data_of(xv)
     if ins.get('Y') and ins['Y']:
         y = ins['Y'][0]
+        from .lod_beam import is_beam_form
+        if is_beam_form(y):
+            # beam decode idiom: adopt Y's full 2-level capacity LoD
+            return {'Out': SeqValue(data, y.lengths, y.outer_lengths)}
         lens = y.lengths if isinstance(y, SeqValue) else data_of(y).reshape(-1).astype(jnp.int32)
         if lens.shape[0] != data.shape[0]:
             raise ValueError(

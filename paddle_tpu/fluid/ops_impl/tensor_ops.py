@@ -311,6 +311,11 @@ def _increment(ins, attrs, ctx):
 
 @register('is_empty')
 def _is_empty(ins, attrs, ctx):
+    from .lod_beam import is_beam_form, is_empty_beam
+    if is_beam_form(ins['X'][0]):
+        # beam decode: "empty" is a RUNTIME property (all sources pruned),
+        # the While-loop's stop condition in the book decoder
+        return {'Out': is_empty_beam(ins['X'][0])}
     x = data_of(ins['X'][0])
     return {'Out': jnp.asarray(x.size == 0)}
 

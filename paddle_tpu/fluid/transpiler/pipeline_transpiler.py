@@ -42,6 +42,106 @@ __all__ = ['PipelineTranspiler']
 
 _STAGE_PREFIX = 'pipe:'
 
+# --------------------------------------------------------------------------
+# pp x sp sequence-locality contract.
+#
+# Under a pp x sp mesh the pipeline region runs inside a shard_map that is
+# MANUAL over 'sp': every stage body sees only its sequence shard, and only
+# the flash_attention lowering consults ctx.manual_axes to run a per-shard
+# ring/ulysses collective. Any other op that mixes or reduces ACROSS
+# sequence positions (an unfused q@k^T matmul, sequence_pool, an in-region
+# reduce/mean/loss) would silently compute shard-local values and the
+# out-spec gather would return wrong numbers. So when both transpilers are
+# applied, every stage op must be sequence-LOCAL: it may not combine values
+# from different positions of any non-feature dimension, except through
+# flash_attention.
+#
+# `_SP_LOCAL_SAFE` lists op types whose lowerings are positionwise
+# (elementwise/activation/layout/feature-dim-only ops). matmul/mul are safe
+# only when the Y operand is a Parameter (contraction over feature dims of
+# a weight replicated across sp); layer_norm only when it normalizes the
+# trailing feature dim. Anything else raises at transpile time — the
+# loud-failure contract the pre-round-4 pp+sp rejection used to provide.
+# Escape hatch for custom ops the analysis cannot see through: stamp
+# `op.attrs['sp_local_safe'] = True`.
+#
+# Known limit (documented, not detected): the axis checks assume the
+# activation keeps a [batch, seq, features...] layout at axis-sensitive ops
+# (softmax/layer_norm normalize the LAST dim); a transpose that moves the
+# sequence dim into the last position before one of them defeats the check.
+_SP_LOCAL_SAFE = frozenset([
+    # elementwise binaries / unaries (ops_impl/math_ops.py)
+    'elementwise_add', 'elementwise_sub', 'elementwise_mul',
+    'elementwise_div', 'elementwise_max', 'elementwise_min',
+    'elementwise_pow', 'logical_and', 'logical_or', 'logical_xor',
+    'logical_not', 'clip', 'scale', 'cast', 'sign', 'minus', 'pow',
+    'relu', 'prelu', 'label_smooth', 'dropout', 'hard_shrink',
+    'thresholded_relu', 'isfinite', 'sum',
+    # pure layout / view ops — reindex, never combine positions
+    'transpose', 'reshape', 'squeeze', 'unsqueeze', 'flatten',
+    # positionwise lookups / constants
+    'lookup_table', 'one_hot', 'assign', 'fill_constant',
+    'fill_zeros_like', 'shape',
+    # normalizes/softmaxes the trailing feature dim only (lowering is
+    # axis=-1; layer_norm handled separately via begin_norm_axis)
+    'softmax',
+    # consults ctx.manual_axes and runs the per-shard ring/ulysses body
+    'flash_attention',
+])
+
+
+def _sp_local_safe_types():
+    from ..layers.ops import __activations__
+    return _SP_LOCAL_SAFE | frozenset(__activations__)
+
+
+def validate_sp_sequence_local(stage_ops):
+    """Raise unless every pipeline-stage op is sequence-local-safe under an
+    sp mesh (see the contract comment above). Called by both transpilers
+    (whichever runs second sees both configs) and by the Executor as a
+    backstop when it builds a pp x sp step."""
+    safe = _sp_local_safe_types()
+    for op in stage_ops:
+        t = op.type
+        if t in safe or op.attrs.get('sp_local_safe'):
+            continue
+        if t in ('mul', 'matmul'):
+            ys = op.inputs.get('Y', [])
+            if ys and all(isinstance(v, Parameter) or v.persistable
+                          for v in ys):
+                continue  # x @ W: contraction over feature dims of a
+                          # weight replicated across sp
+            raise ValueError(
+                "pp x sp: stage op '%s' contracts two activations — under "
+                "sequence parallelism that mixes sequence positions across "
+                "shards (a hand-written attention score matrix, for "
+                "example) and would silently compute shard-local values. "
+                "Use fluid.layers.fused_attention (the flash_attention "
+                "lowering rides the sp ring), or stamp "
+                "attrs['sp_local_safe']=True if the contraction provably "
+                "never touches the sequence dim." % t)
+        if t == 'layer_norm':
+            x = op.inputs['X'][0]
+            rank = len(x.shape) if x.shape is not None else None
+            if rank is not None \
+                    and op.attrs.get('begin_norm_axis', 1) == rank - 1:
+                continue  # trailing-feature-dim norm is positionwise
+            raise ValueError(
+                "pp x sp: layer_norm in a pipeline stage must normalize "
+                "only the trailing feature dim (begin_norm_axis == rank-1, "
+                "got %r for rank %r) — normalizing across the sequence dim "
+                "would mix positions that live on different sp shards."
+                % (op.attrs.get('begin_norm_axis', 1), rank))
+        raise ValueError(
+            "pp x sp: op '%s' inside the pipeline region is not known to "
+            "be sequence-local. Under an sp mesh every stage body runs on "
+            "a sequence SHARD; ops that mix or reduce across sequence "
+            "positions (sequence_*, reduce_*, pooling, conv over seq, "
+            "in-region losses) would silently produce shard-local values. "
+            "Move the op outside the device_guard('pipe:K') region, or — "
+            "if it provably never combines different sequence positions — "
+            "stamp attrs['sp_local_safe']=True on it." % t)
+
 
 def _stage_of(op):
     dev = op.attrs.get('op_device')
@@ -325,6 +425,12 @@ class PipelineTranspiler(object):
                 'rounds of the device count %d; n_micro=%d is not a '
                 'multiple' % (self.n_virtual, S // self.n_virtual,
                               self.n_micro))
+
+        if base.get('sp_size'):
+            # SequenceParallelTranspiler already ran: stage bodies will run
+            # sequence-local inside the manual shard_map — enforce the
+            # locality contract now, loudly
+            validate_sp_sequence_local(seg_ops[0])
 
         program._pipeline_config = {
             'axis': self.axis,

@@ -62,6 +62,15 @@ class SequenceParallelTranspiler(object):
             for op in blk.ops:
                 if op.type == 'flash_attention':
                     op.attrs['sp_strategy'] = self.strategy
+        pipe = getattr(program, '_pipeline_config', None)
+        if pipe is not None:
+            # PipelineTranspiler already ran: its stage bodies will run
+            # sequence-local under this sp mesh — enforce the locality
+            # contract (see pipeline_transpiler.validate_sp_sequence_local)
+            from .pipeline_transpiler import validate_sp_sequence_local
+            lo0, hi0 = pipe['stage0']
+            validate_sp_sequence_local(
+                program.global_block().ops[lo0:hi0])
         from ._mesh_axes import rebuild_mesh_axes
         base = dict(getattr(program, '_dist_config', None) or {})
         base['sp_size'] = self.sp

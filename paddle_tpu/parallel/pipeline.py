@@ -52,7 +52,8 @@ stack_stage_params = stack_unit_params
 
 
 def pipeline_apply(stage_fn, stacked_params, microbatches, mesh, axis='pp',
-                   extras=(), extras_streamed=(), n_virtual=1):
+                   extras=(), extras_streamed=(), n_virtual=1,
+                   param_specs=None):
     """Run the pipeline.
 
     stage_fn(params, x, *extras_streamed_mb, *extras) -> y
@@ -105,6 +106,44 @@ def pipeline_apply(stage_fn, stacked_params, microbatches, mesh, axis='pp',
     # phase-indexed chunk block [v, 1, ...]
     stacked_params = jax.tree_util.tree_map(
         lambda w: w.reshape((v, S) + w.shape[1:]), stacked_params)
+    if param_specs is not None:
+        # pin the reshaped stack's layout: phase dim replicated, stage dim
+        # over the pipeline axis, trailing dims keeping each weight's own
+        # (tp) spec — GSPMD otherwise invents the transition from the
+        # per-stage persisted shardings and falls back to full remat
+        stacked_params = jax.tree_util.tree_map(
+            lambda w, sp: lax.with_sharding_constraint(
+                w, jax.sharding.NamedSharding(
+                    mesh, P(None, axis, *sp))),
+            stacked_params, param_specs)
+
+    # Axes left AUTOMATIC inside the shard_map (tp): the per-tick
+    # dynamic-slice of the microbatch stack, the scan carry, and the ring
+    # ppermute output carry no natural tp sharding, so GSPMD used to
+    # invent transitions for them — "Involuntary full rematerialization"
+    # (replicate-then-repartition every tick; MULTICHIP_r04 tail). The
+    # Megatron layout is unambiguous: ACTIVATIONS are replicated over tp,
+    # only weights are tp-sharded (the column-split matmul consumes a
+    # replicated x; the row-split one psums back to replicated). Pin that
+    # with explicit constraints — specs mention no manual axis, so they
+    # are legal inside the manual shard_map.
+    manual_set = pipeline_manual_axes(mesh, axis)
+    auto_axes = [a for a in mesh.shape if a not in manual_set]
+    if auto_axes:
+        # NamedSharding over a mesh whose axis types MATCH the shard_map
+        # context (dp/pp/sp Manual, tp Auto): the raw all-Auto mesh fails
+        # the context-mesh check when jax transposes the constraint in the
+        # backward pass, and a bare PartitionSpec is too weak to stop the
+        # partitioner's replicate-then-repartition on the matmul cotangent
+        from jax.sharding import AxisType, Mesh as _Mesh, NamedSharding
+        pin_mesh = _Mesh(
+            mesh.devices, mesh.axis_names,
+            axis_types=tuple(AxisType.Manual if n in manual_set
+                             else AxisType.Auto for n in mesh.axis_names))
+        _tp_replicated = lambda t: lax.with_sharding_constraint(
+            t, NamedSharding(pin_mesh, P()))
+    else:
+        _tp_replicated = lambda t: t
 
     def body(params, mbs, *ex):
         stream, glob = ex[:n_stream], ex[n_stream:]
@@ -130,12 +169,17 @@ def pipeline_apply(stage_fn, stacked_params, microbatches, mesh, axis='pp',
             mb_c = jnp.clip(mb, 0, n_micro - 1)
             # first device ingests a fresh microbatch on phase 0; on later
             # phases it consumes the wrap-around activation from the ring
-            fresh = lax.dynamic_index_in_dim(mbs, mb_c, axis=0,
-                                             keepdims=False)
+            fresh = _tp_replicated(
+                lax.dynamic_index_in_dim(mbs, mb_c, axis=0, keepdims=False))
             ingest = is_first if v == 1 else (is_first & (p == 0))
-            x = jnp.where(ingest, fresh, held)
-            sex = [lax.dynamic_index_in_dim(e, mb_c, axis=0,
-                                            keepdims=False) for e in stream]
+            # constraining x (not just fresh) matters for the BACKWARD:
+            # with_sharding_constraint transposes to itself, so dx — the
+            # stage matmul's input cotangent, the one tensor GSPMD used to
+            # re-lay-out involuntarily — is pinned tp-replicated too
+            x = _tp_replicated(jnp.where(ingest, fresh, held))
+            sex = [_tp_replicated(
+                lax.dynamic_index_in_dim(e, mb_c, axis=0, keepdims=False))
+                for e in stream]
             if v > 1:
                 chunk = jax.tree_util.tree_map(
                     lambda w: lax.dynamic_index_in_dim(
@@ -149,6 +193,7 @@ def pipeline_apply(stage_fn, stacked_params, microbatches, mesh, axis='pp',
             # everyone passes its output to the next device; the wraparound
             # (last -> first) either advances the phase or is ignored by
             # the first device's ingest above
+            y = _tp_replicated(y)
             handed = lax.ppermute(y, axis, perm)
             return handed, (y, emit_idx)
 

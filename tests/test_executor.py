@@ -165,3 +165,17 @@ def test_return_numpy_false_returns_device_arrays():
         res = exe.run(main, feed={'x': np.ones((2, 4), 'float32')},
                       fetch_list=[out], return_numpy=False)[0]
     assert isinstance(res, jax.Array)
+
+
+def test_tensor_handle_array_copy_false_raises():
+    """NumPy 2 __array__ contract: a device array can never satisfy a
+    no-copy conversion, so copy=False must raise, not silently copy."""
+    from paddle_tpu.fluid.executor import Scope
+    import numpy as np
+    import pytest
+    scope = Scope()
+    scope.vars['v'] = np.arange(4.0)
+    handle = scope.find_var('v').get_tensor()
+    np.testing.assert_array_equal(np.asarray(handle), np.arange(4.0))
+    with pytest.raises(ValueError, match='copy=False'):
+        handle.__array__(copy=False)

@@ -232,3 +232,16 @@ def test_fetch_lod_output_returns_unpadded():
     # flattened [total_tokens, d] like the reference LoDTensor
     assert np.asarray(out).shape == (5, 2)
     np.testing.assert_allclose(np.asarray(out), 2.0)
+
+
+def test_create_lod_tensor_list_validates_given_lens():
+    """The list branch must honor recursive_seq_lens like the reference:
+    a mismatched feed raises instead of silently deriving other lengths,
+    and scalar list data lands as int64 (round-4 advisor)."""
+    from paddle_tpu.fluid.lod_tensor import create_lod_tensor
+    data = [[1, 2, 3], [4, 5]]
+    t = create_lod_tensor(data, [[3, 2]])
+    assert t.data.dtype == np.int64
+    assert t.recursive_sequence_lengths() == [[3, 2]]
+    with pytest.raises(ValueError, match='do not match'):
+        create_lod_tensor(data, [[2, 3]])

@@ -10,13 +10,15 @@ claim — a reference user's training script works unchanged on TPU
 Each case runs in a subprocess: the alias must not leak into other tests,
 and the scripts write model dirs into their cwd (a tmp dir here).
 
-Known verbatim boundary: test_machine_translation.py's decode_main — the
-reference's While-loop beam search stores LoD tensors in LoDTensorArrays
-and REGROUPS the beam per iteration (dynamic per-step LoD), which the
-static-shape design intentionally replaces with the dense beam
-(layers.beam_search / beam_search_decode, exercised by
-examples/machine_translation.py and tests/test_ops_sampled.py). Its
-train_main runs verbatim below.
+All FIFTEEN reference book files run verbatim, including
+test_machine_translation.py's decode_main — the While-loop LoD beam
+search whose per-iteration beam REGROUPING (dynamic per-step LoD) runs
+here at fixed capacity: the While capacity-widening pass
+(ops_impl/block_ops.py) + the capacity-form LoD beam ops
+(ops_impl/lod_beam.py, A/B-tested against a numpy transcription of the
+reference algorithm in tests/test_lod_beam.py). The dense fixed-trip
+beam (layers.beam_search with explicit parents) remains the TPU-first
+path for new code (examples/machine_translation.py).
 """
 import os
 import subprocess
@@ -97,6 +99,20 @@ def test_reference_machine_translation_train_runs_verbatim(tmp_path):
               funcname='train_main',
               kwargs={'use_cuda': False, 'is_sparse': False},
               timeout=1200)
+
+
+def test_reference_machine_translation_decode_runs_verbatim(tmp_path):
+    """The book's While-loop LoD beam-search decoder (decode_main:
+    array_write/read + sequence_expand + lod_reset + beam_search +
+    beam_search_decode over 2-level LoD), verbatim — the last of the 15
+    reference book files. Runs at fixed capacity via the While
+    capacity-widening pass and the lod_beam capacity-form ops; the step
+    algorithm itself is A/B-tested against a numpy transcription of
+    beam_search_op.cc in tests/test_lod_beam.py."""
+    _run_case(tmp_path, 'test_machine_translation.py',
+              funcname='decode_main',
+              kwargs={'use_cuda': False, 'is_sparse': False},
+              timeout=1500)
 
 
 def test_reference_image_classification_vgg_runs_verbatim(tmp_path):

@@ -213,3 +213,51 @@ def test_async_manifest_commits_last(tmp_path):
         for sh in entry['shards']:
             assert sh['bytes'] == os.path.getsize(
                 os.path.join(d, sh['file']))
+
+
+def test_async_save_rejects_overlapping_same_dir(tmp_path, monkeypatch):
+    """A second async save to a dir with one in flight raises instead of
+    interleaving identically-named shard files (round-4 advisor)."""
+    import threading
+    mesh = _mesh()
+    state = _state(mesh)
+    d = str(tmp_path / 'overlap_ck')
+    gate = threading.Event()
+    orig = ck._write_all
+
+    def slow_write(*a, **kw):
+        gate.wait(timeout=30)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(ck, '_write_all', slow_write)
+    h = ck.save_sharded_async(d, state, step=1)
+    try:
+        with pytest.raises(RuntimeError, match='in flight'):
+            ck.save_sharded_async(d, state, step=2)
+    finally:
+        gate.set()
+        h.wait()
+    # completed: the same dir is writable again
+    ck.save_sharded_async(d, state, step=3).wait()
+
+
+def test_async_save_warns_when_failure_unobserved(tmp_path):
+    """Background write failures surface as a RuntimeWarning even when the
+    caller never wait()s (round-4 advisor: silent missing checkpoint)."""
+    import warnings as _warnings
+    mesh = _mesh()
+    state = _state(mesh)
+    blocker = tmp_path / 'not_a_dir2'
+    blocker.write_text('file where the ckpt dir should go')
+    with _warnings.catch_warnings(record=True) as rec:
+        _warnings.simplefilter('always')
+        h = ck.save_sharded_async(str(blocker), state, step=1)
+        deadline = 30.0
+        import time as _time
+        while not h.done() and deadline > 0:
+            _time.sleep(0.05)
+            deadline -= 0.05
+    assert h.done()
+    assert any(issubclass(w.category, RuntimeWarning)
+               and 'FAILED in the background' in str(w.message)
+               for w in rec)

@@ -243,3 +243,66 @@ def test_three_way_dp_tp_sp_composition():
     got = run(True)
     assert base[0] != base[1]   # the step actually updated parameters
     np.testing.assert_allclose(got, base, rtol=2e-4)
+
+
+def test_pp_sp_rejects_sequence_mixing_stage_op():
+    """A stage-body op that reduces over the sequence dim must be rejected
+    loudly under pp x sp: the stage runs sequence-local inside the manual
+    shard_map and only flash_attention knows how to cross shards (round-4
+    advisor finding on parallel/pipeline.py)."""
+    from paddle_tpu.fluid import layers
+
+    def build(order):
+        with fresh_program() as (main, startup):
+            x = layers.data(name='x', shape=[8, 16], dtype='float32')
+            h = x
+            for k in range(2):
+                with fluid.device_guard('pipe:%d' % k):
+                    h = layers.fc(input=h, size=16, num_flatten_dims=2,
+                                  bias_attr=False)
+                    # reduce over the sequence dim inside the stage: the
+                    # canonical sequence-MIXING op the validator must catch
+                    pooled = layers.reduce_mean(h, dim=1, keep_dim=True)
+                    h = layers.elementwise_add(h, pooled)
+            # an attention op so the sp transpiler accepts the program
+            q = layers.reshape(h, shape=[0, 0, 2, 8])
+            q = layers.transpose(q, perm=[0, 2, 1, 3])
+            ctx = layers.fused_attention(q, q, q)
+            loss = layers.mean(ctx)
+            transpilers = [
+                lambda: fluid.PipelineTranspiler(n_micro=2).transpile(main),
+                lambda: fluid.SequenceParallelTranspiler(
+                    sp=2).transpile(main),
+            ]
+            if order == 'sp_first':
+                transpilers.reverse()
+            for t in transpilers:
+                t()
+
+    for order in ('pp_first', 'sp_first'):
+        with pytest.raises(ValueError, match='not known to be '
+                           'sequence-local'):
+            build(order)
+
+
+def test_pp_sp_rejects_activation_activation_matmul():
+    """A hand-written q@k^T (matmul of two activations) inside a pipeline
+    stage mixes sequence positions across sp shards — rejected."""
+    from paddle_tpu.fluid import layers
+
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[8, 16], dtype='float32')
+        h = x
+        for k in range(2):
+            with fluid.device_guard('pipe:%d' % k):
+                h = layers.fc(input=h, size=16, num_flatten_dims=2,
+                              bias_attr=False)
+                scores = layers.matmul(h, h, transpose_y=True)
+                h = layers.matmul(scores, h)
+        q = layers.reshape(h, shape=[0, 0, 2, 8])
+        q = layers.transpose(q, perm=[0, 2, 1, 3])
+        ctx = layers.fused_attention(q, q, q)
+        loss = layers.mean(ctx)
+        fluid.PipelineTranspiler(n_micro=2).transpile(main)
+        with pytest.raises(ValueError, match='contracts two activations'):
+            fluid.SequenceParallelTranspiler(sp=2).transpile(main)
