@@ -197,7 +197,8 @@ def is_empty_beam(v):
 
 def beam_search_decode_arrays(ids_arr, scores_arr, beam_size, end_id):
     """Backtrace the step arrays into sentences (reference Backtrace +
-    ConvertSentenceVectorToLodTensor with reverse=true, the op defaults).
+    ConvertSentenceVectorToLodTensor with reverse=true sort_by_score=true,
+    the op defaults — hypotheses per source ordered by accumulated score).
 
     Returns (sentence_ids SeqValue [B*K, T_cap] int64, sentence_scores
     SeqValue same shape float32): lengths = tokens per hypothesis, outer =
@@ -268,6 +269,17 @@ def beam_search_decode_arrays(ids_arr, scores_arr, beam_size, end_id):
     tok_f, sc_f, nt = jax.vmap(fix_one)(flat(toks), flat(scs), flat(keep))
     hyp_valid = (jnp.arange(Kcap)[None, :] < n_hyp[:, None]).reshape(-1)
     nt = jnp.where(hyp_valid, nt, 0).astype(jnp.int32)
+
+    # sort_by_score (reference ConvertSentenceVectorToLodTensor default):
+    # hypotheses within a source ordered by their accumulated score — the
+    # seed (last-step) score, since beam scores accumulate — descending;
+    # ties keep beam-slot order (argsort is stable)
+    seed_sc = (scs * first.astype(scs.dtype)).sum(0)      # [B, Kcap]
+    seed_key = jnp.where(hyp < n_hyp[:, None], -seed_sc, jnp.inf)
+    perm = jnp.argsort(seed_key, axis=1)
+    rows = (jnp.arange(B)[:, None] * Kcap + perm).reshape(-1)
+    tok_f, sc_f, nt = tok_f[rows], sc_f[rows], nt[rows]
+
     sent_ids = SeqValue(tok_f.astype(jnp.int64), nt,
                         (n_hyp.astype(jnp.int32),))
     sent_scores = SeqValue(sc_f.astype(jnp.float32), nt,

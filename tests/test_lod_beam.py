@@ -249,13 +249,21 @@ def test_backtrace_matches_reference_algorithm():
 
     n_hyp = np.asarray(sent_ids.outer_lengths[0])
     toks = np.asarray(sent_ids.data)
+    scs = np.asarray(sent_scores.data)
     lens = np.asarray(sent_ids.lengths).reshape(B, K)
-    got = []
+    got, got_sc = [], []
     for s in range(B):
-        hyps = []
+        hyps, hsc = [], []
         for h in range(n_hyp[s]):
             L = lens[s, h]
             hyps.append(list(toks[s * K + h, :L]))
-        got.append(sorted(hyps))
-    want = [sorted(ws) for ws in want_toks]
-    assert got == want
+            hsc.append([round(float(v), 5) for v in scs[s * K + h, :L]])
+        got.append(hyps)
+        got_sc.append(hsc)
+    # reference sort_by_score: hypotheses per source by accumulated
+    # (last-token) score descending; scores rows permute WITH their ids
+    want = [sorted(zip(ws, cs), key=lambda p: -p[1][-1])
+            for ws, cs in zip(want_toks, want_scs)]
+    assert got == [[list(w) for w, _ in ws] for ws in want]
+    assert got_sc == [[[round(float(v), 5) for v in c] for _, c in ws]
+                      for ws in want]
