@@ -209,9 +209,10 @@ def pad_batch(batch, max_length):
 
 def get_model(batch_size=16, max_length=64, n_layer=6, d_model=512,
               n_head=8, d_inner=2048, dict_size=10000, learning_rate=2.0,
-              warmup_steps=4000):
+              warmup_steps=4000, pp_decoder=False):
     avg_cost, token_count, feeds = transformer(
-        dict_size, dict_size, max_length, n_layer, d_model, n_head, d_inner)
+        dict_size, dict_size, max_length, n_layer, d_model, n_head, d_inner,
+        pp_decoder=pp_decoder)
     lr = layers.learning_rate_scheduler.noam_decay(d_model, warmup_steps)
     lr = layers.scale(lr, scale=float(learning_rate))
     opt = fluid.optimizer.Adam(learning_rate=lr, beta1=0.9, beta2=0.98,

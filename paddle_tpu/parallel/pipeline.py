@@ -169,17 +169,23 @@ def pipeline_apply(stage_fn, stacked_params, microbatches, mesh, axis='pp',
             mb_c = jnp.clip(mb, 0, n_micro - 1)
             # first device ingests a fresh microbatch on phase 0; on later
             # phases it consumes the wrap-around activation from the ring
-            fresh = _tp_replicated(
-                lax.dynamic_index_in_dim(mbs, mb_c, axis=0, keepdims=False))
+            # slice with keepdims and pin the 4-D [1, mb, ...] slice
+            # BEFORE dropping the unit dim: the transpose of this chain is
+            # a dynamic-update-slice of exactly that [1, mb, ...] cotangent
+            # chunk, so the pin sits next to the scatter input. (One
+            # degenerate cotangent transition in the dp x pp x tp segment
+            # still draws a partitioner warning — docs/distributed.md,
+            # "Known partitioner residue".)
+            def slice_mb(t):
+                s = _tp_replicated(
+                    lax.dynamic_slice_in_dim(t, mb_c, 1, axis=0))
+                return s[0]
+            fresh = slice_mb(mbs)
             ingest = is_first if v == 1 else (is_first & (p == 0))
-            # constraining x (not just fresh) matters for the BACKWARD:
-            # with_sharding_constraint transposes to itself, so dx — the
-            # stage matmul's input cotangent, the one tensor GSPMD used to
-            # re-lay-out involuntarily — is pinned tp-replicated too
+            # constraining x (not just fresh) matters for the BACKWARD
+            # too: dx, the stage matmul's input cotangent, inherits the pin
             x = _tp_replicated(jnp.where(ingest, fresh, held))
-            sex = [_tp_replicated(
-                lax.dynamic_index_in_dim(e, mb_c, axis=0, keepdims=False))
-                for e in stream]
+            sex = [slice_mb(e) for e in stream]
             if v > 1:
                 chunk = jax.tree_util.tree_map(
                     lambda w: lax.dynamic_index_in_dim(
