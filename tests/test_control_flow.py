@@ -266,3 +266,70 @@ def test_loop_dropout_varies_per_step():
     masks = (out != 0).reshape(T, -1)
     # distinct iterations must draw distinct dropout masks
     assert any(not np.array_equal(masks[0], masks[t]) for t in range(1, T))
+
+
+def test_while_capacity_widening_for_lod_beam_arrays():
+    """The decode idiom writes one row per source into LoD arrays BEFORE a
+    While whose body writes beam_size rows per source: the widening pass
+    (block_ops._widen_carry_to_body + ArrayValue grow-on-write) must bring
+    the pre-loop slots to capacity with each source's rows at its block
+    start. Regression guard for the book decode_main path independent of
+    the reference file."""
+    from paddle_tpu.fluid.lod_tensor import create_lod_tensor
+    B, K, V = 2, 2, 12
+    with fresh_program() as (main, startup):
+        init_ids = layers.data(name='init_ids', shape=[1], dtype='int64',
+                               lod_level=2)
+        init_scores = layers.data(name='init_scores', shape=[1],
+                                  dtype='float32', lod_level=2)
+        emb_w = layers.create_parameter([V, 8], 'float32', name='bm_emb')
+        counter = layers.zeros(shape=[1], dtype='int64', force_cpu=True)
+        max_len = layers.fill_constant(shape=[1], dtype='int64', value=4)
+        ids_arr = layers.create_array('int64')
+        sc_arr = layers.create_array('float32')
+        layers.array_write(init_ids, array=ids_arr, i=counter)
+        layers.array_write(init_scores, array=sc_arr, i=counter)
+        cond = layers.less_than(x=counter, y=max_len)
+        w = layers.While(cond=cond)
+        with w.block():
+            pre_ids = layers.array_read(array=ids_arr, i=counter)
+            pre_sc = layers.array_read(array=sc_arr, i=counter)
+            emb = layers.embedding(pre_ids, size=[V, 8],
+                                   param_attr=fluid.ParamAttr(name='bm_emb'))
+            score = layers.fc(input=emb, size=V, num_flatten_dims=2,
+                              act='softmax')
+            tk_sc, tk_idx = layers.topk(score, k=K)
+            accu = layers.elementwise_add(
+                x=layers.log(tk_sc),
+                y=layers.reshape(pre_sc, shape=[-1]), axis=0)
+            sel_ids, sel_sc = layers.beam_search(
+                pre_ids, pre_sc, tk_idx, accu, K, end_id=0, level=0)
+            layers.increment(x=counter, value=1, in_place=True)
+            layers.array_write(sel_ids, array=ids_arr, i=counter)
+            layers.array_write(sel_sc, array=sc_arr, i=counter)
+            layers.logical_and(
+                x=layers.less_than(x=counter, y=max_len),
+                y=layers.logical_not(layers.is_empty(x=sel_ids)), out=cond)
+        tr_ids, tr_sc = layers.beam_search_decode(ids_arr, sc_arr,
+                                                  beam_size=K, end_id=0)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        feed = {
+            'init_ids': create_lod_tensor(
+                np.ones((B, 1), 'int64'), [[1] * B, [1] * B]),
+            'init_scores': create_lod_tensor(
+                np.ones((B, 1), 'float32'), [[1] * B, [1] * B]),
+        }
+        out_ids, out_sc = exe.run(main, feed=feed,
+                                  fetch_list=[tr_ids, tr_sc],
+                                  return_numpy=False)
+    lens = out_ids.recursive_sequence_lengths()
+    # 2-level LoD: up to K hypotheses per source, each a non-empty
+    # token sequence bounded by the loop length
+    assert len(lens) == 2 and len(lens[0]) == B
+    assert all(1 <= h <= K for h in lens[0])
+    assert all(1 <= L <= 5 for L in lens[1])
+    assert sum(lens[1]) == np.asarray(out_ids.data).shape[0]
+    # scores regroup in lockstep with ids
+    assert out_sc.recursive_sequence_lengths() == lens
+    assert np.asarray(out_sc.data).shape[0] == sum(lens[1])
