@@ -48,4 +48,21 @@ run python tools/tune_flash.py --seq 1024 --batch 16 --heads 8 --dim 64 \
 # 4. transformer seq-length scaling
 run env BENCH_SEQ=512 BENCH_TBATCH=32 python bench.py
 
+# 5. GPipe bubble curve (needs >= 2 chips: pp shards the decoder stack).
+#    Bubble fraction = (S-1)/(M+S-1); this measures where real overlap
+#    diverges from the formula. Skipped on the single-chip tunnel.
+# count REAL accelerator devices only — a mid-sweep tunnel drop with
+# xla_force_host_platform_device_count exported would otherwise pass the
+# gate on 8 virtual CPU devices and contaminate the log with CPU timings
+NDEV=$(timeout 60 python -c "
+import jax
+d = jax.devices()
+print(len(d) if d and d[0].platform != 'cpu' else 0)" 2>/dev/null || echo 1)
+if [ "${NDEV:-1}" -ge 2 ]; then
+  for M in 2 4 8 16; do
+    run python benchmark/fluid_benchmark.py --model transformer \
+        --device TPU --use_fake_data --iterations 20 --pp 2 --n_micro "$M"
+  done
+fi
+
 echo "sweep complete; see $LOG" | tee -a "$LOG"
