@@ -104,11 +104,21 @@ def _beam_inputs(seed, B=2, K=3, topk=3, end_frac=0.3):
     return src_rows, pre_ids, pre_scores, ids, scores
 
 
-@pytest.mark.parametrize('seed', [0, 1, 2, 3, 4, 5, 6, 7])
-def test_beam_step_matches_reference_algorithm(seed):
-    B, K, topk, end_id = 2, 3, 3, 10
-    src_rows, pre_ids, pre_scores, ids, scores = _beam_inputs(seed, B, K,
-                                                              topk)
+@pytest.mark.parametrize('seed,B,K,topk,end_frac', [
+    # default shape across 8 seeds
+    *[(s, 2, 3, 3, 0.3) for s in range(8)],
+    (11, 3, 2, 4, 0.0),   # never-ending: pure top-k selection
+    (12, 1, 4, 2, 0.5),   # single source, heavy ending
+    (13, 4, 3, 3, 0.9),   # nearly all ended: PruneEndBeams fires
+    (14, 2, 5, 5, 0.3),
+])
+def test_beam_step_matches_reference_algorithm(seed, B, K, topk, end_frac):
+    """One A/B harness across seeds, beam widths, source counts, topk
+    sizes and end-token densities (exercises the ended-row candidate and
+    PruneEndBeams branches)."""
+    end_id = 10
+    src_rows, pre_ids, pre_scores, ids, scores = _beam_inputs(
+        seed, B, K, topk, end_frac)
     want_ids, want_sc, want_l0, want_l1, want_par = np_beam_search(
         pre_ids, pre_scores, ids, scores, src_rows, K, end_id)
 
@@ -131,7 +141,6 @@ def test_beam_step_matches_reference_algorithm(seed):
     got_sc_rows, _ = _from_capacity(sv_scores, B, K)
     # flat l1 comparison: capacity slots for live parents
     flat_l1 = []
-    off = 0
     l1cap = np.asarray(sv_ids.lengths).reshape(B, K)
     for s, n in enumerate(src_rows):
         flat_l1.extend(l1cap[s, :n])

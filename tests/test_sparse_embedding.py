@@ -18,37 +18,44 @@ from util import fresh_program
 VOCAB, DIM = 50, 8
 
 
+def _default_model(is_sparse):
+    """ids -> embedding(is_sparse) -> fc -> mean((pred - 1)^2)."""
+    ids = layers.data(name='ids', shape=[4, 1], dtype='int64')
+    emb = layers.embedding(ids, size=[VOCAB, DIM], is_sparse=is_sparse,
+                           param_attr=fluid.ParamAttr(name='emb_w'))
+    pred = layers.fc(input=emb, size=1, num_flatten_dims=2,
+                     bias_attr=False,
+                     param_attr=fluid.ParamAttr(name='fc_w'))
+    return layers.mean(layers.square(pred - 1.0)), 'emb_w'
+
+
 def _run_model(optimizer, is_sparse, ids_batches, seed=7, fetch_grad=False,
-               dp=0):
-    """Tiny embedding regression; returns (losses, table, extra_scope_vars).
-    The model: ids -> embedding(is_sparse) -> fc -> mean((pred - 1)^2)."""
+               dp=0, build=None):
+    """Run a tiny embedding regression; returns (losses, table, plans,
+    extra_scope_vars). `build(is_sparse) -> (loss, table_name)` swaps the
+    model (default: _default_model)."""
     with fresh_program() as (main, startup):
         main.random_seed = seed
         startup.random_seed = seed
-        ids = layers.data(name='ids', shape=[4, 1], dtype='int64')
-        emb = layers.embedding(ids, size=[VOCAB, DIM], is_sparse=is_sparse,
-                               param_attr=fluid.ParamAttr(name='emb_w'))
-        pred = layers.fc(input=emb, size=1, num_flatten_dims=2,
-                         bias_attr=False,
-                         param_attr=fluid.ParamAttr(name='fc_w'))
-        loss = layers.mean(layers.square(pred - 1.0))
+        loss, table_name = (build or _default_model)(is_sparse)
         optimizer().minimize(loss)
         if dp:
             fluid.DistributeTranspiler().transpile(trainer_id=0,
                                                    trainers=dp)
         exe = fluid.Executor(fluid.CPUPlace())
         exe.run(startup)
-        fetch = [loss] + (['emb_w@GRAD'] if fetch_grad else [])
+        fetch = [loss] + (['%s@GRAD' % table_name] if fetch_grad else [])
         losses = []
         for b in ids_batches:
-            out = exe.run(main, feed={'ids': b}, fetch_list=fetch)
+            feed = b if isinstance(b, dict) else {'ids': b}
+            out = exe.run(main, feed=feed, fetch_list=fetch)
             losses.append(float(np.asarray(out[0])))
         from paddle_tpu.fluid.executor import global_scope
         scope = global_scope()
-        table = np.asarray(scope.find_var('emb_w').get_tensor())
+        table = np.asarray(scope.find_var(table_name).get_tensor())
         plans = [s.sparse_plan for s in exe._cache.values()]
         extras = {n: np.asarray(scope.find_var(n).get_tensor())
-                  for n in scope.vars if 'moment' in n or 'emb_w' == n}
+                  for n in scope.vars if 'moment' in n or table_name == n}
         return losses, table, plans, extras
 
 
@@ -157,3 +164,43 @@ def test_sparse_grad_never_materializes_dense_buffer():
         # sparse update touches [24, DIM] row blocks instead
         assert 'subtract(f32[%d,%d]' % (VOCAB, DIM) not in hlo.replace(
             ' ', '')
+
+
+def test_sparse_handles_multiple_lookups_of_one_table():
+    """A table read by TWO is_sparse lookups (shared embedding, e.g. the
+    book's tied 'vemb') still takes the sparse path: both taps' rows
+    concatenate into one SparseRows and the update matches dense."""
+    sgd = lambda: fluid.optimizer.SGD(learning_rate=0.1)
+
+    def build(is_sparse):
+        a = layers.data(name='a', shape=[3, 1], dtype='int64')
+        b = layers.data(name='b', shape=[2, 1], dtype='int64')
+        ea = layers.embedding(a, size=[VOCAB, DIM], is_sparse=is_sparse,
+                              param_attr=fluid.ParamAttr(name='shared_w'))
+        eb = layers.embedding(b, size=[VOCAB, DIM], is_sparse=is_sparse,
+                              param_attr=fluid.ParamAttr(name='shared_w'))
+        pa = layers.fc(input=ea, size=1, num_flatten_dims=2,
+                       bias_attr=False,
+                       param_attr=fluid.ParamAttr(name='fa'))
+        pb = layers.fc(input=eb, size=1, num_flatten_dims=2,
+                       bias_attr=False,
+                       param_attr=fluid.ParamAttr(name='fb'))
+        loss = layers.mean(layers.square(pa - 1.0)) + \
+            layers.mean(layers.square(pb + 1.0))
+        return loss, 'shared_w'
+
+    rng = np.random.RandomState(5)
+    batches = [{
+        'a': rng.randint(0, VOCAB, size=(4, 3, 1)).astype('int64'),
+        'b': rng.randint(0, VOCAB, size=(4, 2, 1)).astype('int64'),
+    } for _ in range(3)]
+    dl, dt, dplans, _ = _run_model(sgd, False, batches, seed=11,
+                                   build=build)
+    sl, st, splans, _ = _run_model(sgd, True, batches, seed=11, build=build)
+    assert not any(p for p in dplans if p)
+    assert any('shared_w' in p for p in splans if p)
+    # both lookups listed under the one plan entry
+    plan = next(p for p in splans if p)['shared_w']
+    assert len(plan['lookups']) == 2
+    np.testing.assert_allclose(sl, dl, rtol=1e-5)
+    np.testing.assert_allclose(st, dt, rtol=1e-4, atol=1e-6)
