@@ -333,3 +333,20 @@ def test_while_capacity_widening_for_lod_beam_arrays():
     # scores regroup in lockstep with ids
     assert out_sc.recursive_sequence_lengths() == lens
     assert np.asarray(out_sc.data).shape[0] == sum(lens[1])
+
+    # the full decode program (While sub-block, beam ops, LoD arrays)
+    # survives the desc round-trip bit-identically (the protobuf
+    # guarantee test_program_fuzz.py checks for flat graphs)
+    from paddle_tpu.fluid import framework
+    from paddle_tpu.fluid.executor import Scope, _switch_scope
+    main2 = framework.Program._from_dict(main._to_dict())
+    _switch_scope(Scope())
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    exe2.run(startup)
+    out_ids2, = exe2.run(
+        main2, feed=feed,
+        fetch_list=[main2.global_block().var(tr_ids.name)],
+        return_numpy=False)
+    assert out_ids2.recursive_sequence_lengths() == lens
+    np.testing.assert_array_equal(np.asarray(out_ids2.data),
+                                  np.asarray(out_ids.data))
