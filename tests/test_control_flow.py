@@ -338,15 +338,20 @@ def test_while_capacity_widening_for_lod_beam_arrays():
     # survives the desc round-trip bit-identically (the protobuf
     # guarantee test_program_fuzz.py checks for flat graphs)
     from paddle_tpu.fluid import framework
-    from paddle_tpu.fluid.executor import Scope, _switch_scope
+    from paddle_tpu.fluid.executor import Scope, scope_guard
     main2 = framework.Program._from_dict(main._to_dict())
-    _switch_scope(Scope())
-    exe2 = fluid.Executor(fluid.CPUPlace())
-    exe2.run(startup)
-    out_ids2, = exe2.run(
-        main2, feed=feed,
-        fetch_list=[main2.global_block().var(tr_ids.name)],
-        return_numpy=False)
+    assert main2._to_dict() == main._to_dict()
+    with scope_guard(Scope()):
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        exe2.run(startup)
+        out_ids2, out_sc2 = exe2.run(
+            main2, feed=feed,
+            fetch_list=[main2.global_block().var(tr_ids.name),
+                        main2.global_block().var(tr_sc.name)],
+            return_numpy=False)
     assert out_ids2.recursive_sequence_lengths() == lens
     np.testing.assert_array_equal(np.asarray(out_ids2.data),
                                   np.asarray(out_ids.data))
+    assert out_sc2.recursive_sequence_lengths() == lens
+    np.testing.assert_array_equal(np.asarray(out_sc2.data),
+                                  np.asarray(out_sc.data))
