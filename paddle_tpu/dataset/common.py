@@ -29,16 +29,47 @@ def data_path(module_name, filename):
     return os.path.join(DATA_HOME, module_name, filename)
 
 
-def download(url, module_name, md5sum, save_name=None):
-    """Zero-egress: never fetches. Returns the cache path if the file was
-    pre-seeded, else None (callers fall back to synthetic data)."""
+def download(url, module_name, md5sum, save_name=None, fetcher=None,
+             retries=3, deadline=None, _sleep=None):
+    """Zero-egress by default: with no `fetcher`, returns the cache path
+    if the file was pre-seeded, else None (callers fall back to synthetic
+    data).
+
+    fetcher(url, dest_path): optional transport hook (an environment that
+    IS allowed egress, or a test harness). It runs under
+    utils.retry.retry_call — exponential backoff + jitter, bounded
+    attempts, optional wall-clock deadline — and each attempt's result is
+    md5-verified before the atomic rename into the cache, so a torn or
+    corrupted transfer is retried instead of poisoning the cache."""
     dirname = os.path.join(DATA_HOME, module_name)
     must_mkdirs(dirname)
     filename = os.path.join(dirname,
                             save_name or url.split('/')[-1])
     if os.path.exists(filename):
         return filename
-    return None
+    if fetcher is None:
+        return None
+
+    from ..utils.retry import retry_call
+
+    def attempt():
+        tmp = filename + '.part'
+        try:
+            fetcher(url, tmp)
+            if md5sum is not None and md5file(tmp) != md5sum:
+                raise IOError(
+                    'download %r: md5 mismatch (corrupted transfer)' % url)
+            os.replace(tmp, filename)  # atomic: cache never holds a tear
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+        return filename
+
+    import time
+    return retry_call(attempt, retries=retries, deadline=deadline,
+                      retry_on=(IOError, OSError),
+                      sleep=time.sleep if _sleep is None else _sleep,
+                      describe='download %r' % url)
 
 
 def synthetic_rng(tag, seed=1234):

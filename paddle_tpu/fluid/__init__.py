@@ -8,7 +8,8 @@ from .framework import Program, Operator, Parameter, Variable, \
     default_startup_program, default_main_program, program_guard, \
     name_scope, device_guard, get_var
 from . import executor
-from .executor import Executor, global_scope, scope_guard, _switch_scope, Scope
+from .executor import Executor, global_scope, scope_guard, _switch_scope, \
+    Scope, anomaly_guard
 from . import layers
 from . import initializer
 from . import optimizer

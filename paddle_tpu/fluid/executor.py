@@ -33,7 +33,37 @@ from .lowering import SeqValue, Ctx
 # the product path on tiny models.
 _ZERO_MIN_SIZE = 1024
 
-__all__ = ['Executor', 'global_scope', 'scope_guard', '_switch_scope', 'Scope']
+__all__ = ['Executor', 'global_scope', 'scope_guard', '_switch_scope',
+           'Scope', 'anomaly_guard']
+
+
+def anomaly_guard(program=None, enable=True, max_consecutive_skips=None):
+    """Enable the COMPILED-path anomaly guard (`check_nan_inf` for the
+    one-module world): the jitted step computes a cheap health vector
+    inside the XLA module — finiteness of the loss and of every gradient,
+    plus the global grad-norm — and, when the step is unhealthy, SKIPS it:
+    every persistable output (params, optimizer state, BN stats) is
+    `where(healthy, new, old)`-selected back to its pre-step value, the
+    same policy AMP loss-scaling uses for overflowed steps. No eager
+    fallback, no extra launch: the guard is a few fused reductions on
+    values the backward pass already produced.
+
+    The reference's FLAGS_check_nan_inf aborted the process from the C++
+    interpreter loop; that loop no longer exists on the compiled path, and
+    a long-running job is better served by skip-and-continue. The eager
+    per-op attribution mode is still available via
+    fluid.debugger.check_nan_inf().
+
+    After each guarded run, `exe.last_step_health` holds the numpy health
+    vector and `exe.skipped_steps` counts skips. With
+    max_consecutive_skips=N, the N-th consecutive unhealthy step raises
+    FloatingPointError on the host (divergence, not a transient)."""
+    if program is None:
+        program = default_main_program()
+    program._anomaly_guard = bool(enable)
+    program._anomaly_guard_max_skips = max_consecutive_skips
+    program._bump_version()
+    return program
 
 
 class _VarHolder(object):
@@ -183,11 +213,15 @@ class _CompiledStep(object):
 
     def __init__(self, program, block, feed_names, fetch_names, persist_in,
                  amp=False, platform='cpu', persist_shardings=None,
-                 mesh=None):
+                 mesh=None, guard=False):
         self.program = program
         self.amp = amp
         self.platform = platform
         self.mesh = mesh
+        # in-graph anomaly guard (see anomaly_guard()): only meaningful on
+        # training steps — without an autodiff op there are no gradients
+        # to check and no optimizer update to skip
+        self.guard = bool(guard)
         # GPipe region from PipelineTranspiler: only active when a mesh
         # with the pp axis exists; otherwise the stamped ops run
         # sequentially (identical semantics, which tests compare against)
@@ -244,6 +278,7 @@ class _CompiledStep(object):
         def step(persist, feed, key):
             env = dict(persist)
             env.update(feed)
+            health = None
             if self.ad_idx is None:
                 run_range(env, 0, len(ops), key)
             else:
@@ -258,14 +293,18 @@ class _CompiledStep(object):
                     fwd = jax.checkpoint(fwd)
                 grads, env = jax.grad(fwd, has_aux=True)(trainable)
                 self._apply_grads(grads, env, ad, pnames, gnames)
+                if self.guard:
+                    health = self._step_health(env, ad, pnames, gnames)
                 run_range(env, self.ad_idx + 1, len(ops), key)
             fetches = [env[n] for n in self.fetch_names]
             new_persist = {n: env[n] for n in self.persist_out if n in env}
+            if health is not None:
+                self._select_healthy(health['healthy'], new_persist, persist)
             for n, sh in self.persist_shardings.items():
                 if n in new_persist and not isinstance(new_persist[n], SeqValue):
                     new_persist[n] = jax.lax.with_sharding_constraint(
                         new_persist[n], sh)
-            return fetches, new_persist
+            return fetches, new_persist, health
 
         self._step = step  # pure, un-jitted (re-jittable with shardings)
         self._jitted = jax.jit(step, donate_argnums=(0,))
@@ -430,6 +469,52 @@ class _CompiledStep(object):
             env[gnames[w]] = lowering.SparseRows(
                 jnp.concatenate(ids_parts, axis=0), rows, env[w].shape)
 
+    def _step_health(self, env, ad, pnames, gnames):
+        """Per-step health vector, computed INSIDE the compiled module on
+        values the backward pass already produced: finiteness of the loss
+        and of every gradient (dense and sparse-row), and the global
+        grad-norm. A few fused reductions — no extra launch, no eager
+        fallback (contrast debugger.check_nan_inf, the op-by-op eager
+        attribution mode)."""
+        loss = lowering.data_of(env[ad.attrs['loss_name']])
+        loss_finite = jnp.isfinite(loss.astype(jnp.float32)).all()
+        grads_finite = jnp.asarray(True)
+        sq = jnp.asarray(0.0, jnp.float32)
+        names = list(pnames) + list(getattr(self, '_sparse_active', {}))
+        for n in names:
+            g = env.get(gnames[n])
+            if g is None:
+                continue
+            gl = g.rows if isinstance(g, lowering.SparseRows) \
+                else lowering.data_of(g)
+            gf = gl.astype(jnp.float32)
+            grads_finite = grads_finite & jnp.isfinite(gf).all()
+            sq = sq + jnp.sum(gf * gf)
+        grad_norm = jnp.sqrt(sq)
+        return {'healthy': loss_finite & grads_finite,
+                'loss_finite': loss_finite,
+                'grads_finite': grads_finite,
+                'grad_norm': grad_norm}
+
+    def _select_healthy(self, healthy, new_persist, persist):
+        """Step-skip policy (the AMP loss-scaling skip, generalized): when
+        the step is unhealthy, every persistable output rolls back to its
+        pre-step value via a predicated select, so params / optimizer
+        state / BN stats are bit-identical to before the step. Runs inside
+        the jitted module; with donation the select aliases in place."""
+        for n in list(new_persist):
+            old = persist.get(n)
+            new = new_persist[n]
+            if old is None:
+                continue
+            if jax.tree_util.tree_structure(old) != \
+                    jax.tree_util.tree_structure(new):
+                continue  # layout changed this step; nothing to roll back to
+            new_persist[n] = jax.tree_util.tree_map(
+                lambda a, b: a if getattr(a, 'shape', None) != getattr(
+                    b, 'shape', None) else jnp.where(healthy, a, b),
+                new, old)
+
     def _run_ops(self, env, lo, hi, key, grad_mode=False, on_op=None,
                  taps=None):
         """Execute ops [lo, hi); on_op(i, op, seconds, env) — when set, each
@@ -589,6 +674,7 @@ class _CompiledStep(object):
         ops = self.ops
         env = dict(persist)
         env.update(feed)
+        health = None
         if self.ad_idx is None:
             self._run_ops(env, 0, len(ops), key, on_op=hook)
         else:
@@ -601,10 +687,17 @@ class _CompiledStep(object):
                                 has_aux=True)(trainable)
             self._apply_grads(grads, env, ad, pnames, gnames,
                               check_nan_inf=check_nan_inf)
+            if self.guard:
+                # the guard stays armed on the eager path too (profiler
+                # hook / debugger active): same health vector, same
+                # skip-with-rollback — the jnp ops just run un-jitted
+                health = self._step_health(env, ad, pnames, gnames)
             self._run_ops(env, self.ad_idx + 1, len(ops), key, on_op=hook)
         fetches = [env[n] for n in self.fetch_names]
         new_persist = {n: env[n] for n in self.persist_out if n in env}
-        return fetches, new_persist
+        if health is not None:
+            self._select_healthy(health['healthy'], new_persist, persist)
+        return fetches, new_persist, health
 
     def __call__(self, persist, feed, key):
         return self._jitted(persist, feed, key)
@@ -634,6 +727,12 @@ class Executor(object):
         self.place = place
         self._cache = {}
         self._run_counter = 0
+        # anomaly-guard observability (see anomaly_guard()): health of the
+        # most recent guarded step, total skipped steps, and the running
+        # consecutive-skip count backing max_consecutive_skips
+        self.last_step_health = None
+        self.skipped_steps = 0
+        self._consecutive_skips = 0
 
     def _device(self):
         return self.place.jax_device()
@@ -879,6 +978,7 @@ class Executor(object):
             and v.name not in feed_vals))
         from . import amp as amp_mod
         amp = amp_mod.is_amp(program)
+        guard = bool(getattr(program, '_anomaly_guard', False))
         from jax.sharding import NamedSharding
         persist_shardings = {}
         for n in persist_in:
@@ -890,7 +990,7 @@ class Executor(object):
                                  for n, s in persist_shardings.items()))
         key = (program._uid, program._version, feed_sig, tuple(fetch_names),
                persist_in, amp, bool(getattr(program, '_use_remat', False)),
-               shard_sig, dist_mesh)
+               shard_sig, dist_mesh, guard)
         compiled = self._cache.get(key) if use_program_cache else None
         if compiled is None:
             # place is None under ParallelExecutor (mesh placement via
@@ -900,7 +1000,7 @@ class Executor(object):
             compiled = _CompiledStep(program, block, list(feed_vals), fetch_names,
                                      persist_in, amp=amp, platform=plat,
                                      persist_shardings=persist_shardings,
-                                     mesh=dist_mesh)
+                                     mesh=dist_mesh, guard=guard)
             if use_program_cache:
                 self._cache[key] = compiled
 
@@ -937,12 +1037,14 @@ class Executor(object):
         check = _dbg.nan_inf_check_active()
         op_hook = _prof.op_event_hook()
         if check or op_hook is not None:
-            fetches, new_persist = compiled.debug_step(
+            fetches, new_persist, health = compiled.debug_step(
                 persist, feed_vals, rng, check_nan_inf=check, on_op=op_hook)
         else:
-            fetches, new_persist = compiled(persist, feed_vals, rng)
+            fetches, new_persist, health = compiled(persist, feed_vals, rng)
         for n, v in new_persist.items():
             scope._chain_set(n, v)
+        if health is not None:
+            self._observe_health(program, health)
 
         fetch_f32 = bool(getattr(program, '_fetch_f32', False))
 
@@ -963,6 +1065,33 @@ class Executor(object):
                 v = _cast_back(v)
                 out.append(np.asarray(v) if return_numpy else v)
         return out
+
+    def _observe_health(self, program, health):
+        """Host side of the anomaly guard: record the health vector, count
+        skips, warn per skipped step, and escalate persistent divergence
+        (max_consecutive_skips) to a FloatingPointError."""
+        h = {k: np.asarray(v) for k, v in health.items()}
+        self.last_step_health = h
+        if bool(h['healthy']):
+            self._consecutive_skips = 0
+            return
+        self.skipped_steps += 1
+        self._consecutive_skips += 1
+        import warnings
+        warnings.warn(
+            'anomaly guard: step %d skipped (loss_finite=%s '
+            'grads_finite=%s grad_norm=%s) — parameters and optimizer '
+            'state were rolled back' % (
+                self._run_counter, bool(h['loss_finite']),
+                bool(h['grads_finite']), float(h['grad_norm'])),
+            RuntimeWarning, stacklevel=3)
+        max_skips = getattr(program, '_anomaly_guard_max_skips', None)
+        if max_skips is not None and self._consecutive_skips >= max_skips:
+            raise FloatingPointError(
+                'anomaly guard: %d consecutive unhealthy steps (limit %d) '
+                '— the run has diverged, not hit a transient; last health: '
+                '%r' % (self._consecutive_skips, max_skips,
+                        {k: v.tolist() for k, v in h.items()}))
 
     def lowered_hlo(self, program=None, feed=None, fetch_list=None,
                     scope=None, optimized=False):

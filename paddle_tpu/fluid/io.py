@@ -150,19 +150,32 @@ def load_inference_model(dirname, executor, model_filename=None,
     return [program, meta['feed_names'], fetch_vars]
 
 
+def _file_crc32(path):
+    # single CRC implementation for both checkpoint formats
+    from ..utils.checkpoint import _crc32_file
+    return _crc32_file(path)
+
+
 def save_checkpoint(executor, checkpoint_dir, trainer_id=0, main_program=None,
                     step=0, max_num_checkpoints=3, trainer_args=None):
     """Failure-recovery checkpoint: persistables + step counter + optional
     trainer args like {'epoch_id', 'step_id'} (reference io.py checkpoint
     utilities / trainer.py:641 save_checkpoint)."""
     serial_dir = os.path.join(checkpoint_dir, 'checkpoint_%d' % step)
-    save_persistables(executor, serial_dir, main_program)
+    params_path = save_persistables(executor, serial_dir, main_program)
     # meta written atomically and LAST: its presence marks a complete
-    # snapshot (reference writes a _SUCCESS marker, trainer.py:1190)
+    # snapshot (reference writes a _SUCCESS marker, trainer.py:1190). It
+    # records the params file's size AND content CRC32, so load_checkpoint
+    # can tell a torn/bit-rotted snapshot from an intact one and the
+    # Trainer can fall back to the previous serial instead of silently
+    # resuming from corrupted weights.
     tmp = os.path.join(serial_dir, 'meta.json.tmp')
     with open(tmp, 'w') as f:
         json.dump({'step': step, 'trainer_id': trainer_id,
-                   'trainer_args': trainer_args or {}}, f)
+                   'trainer_args': trainer_args or {},
+                   'params_file': os.path.basename(params_path),
+                   'params_bytes': os.path.getsize(params_path),
+                   'params_crc32': _file_crc32(params_path)}, f)
     os.replace(tmp, os.path.join(serial_dir, 'meta.json'))
     # prune old checkpoints
     for s in list_checkpoint_serials(checkpoint_dir)[:-max_num_checkpoints]:
@@ -194,5 +207,29 @@ def load_checkpoint(executor, checkpoint_dir, serial=None, main_program=None):
     serial_dir = os.path.join(checkpoint_dir, 'checkpoint_%d' % serial)
     with open(os.path.join(serial_dir, 'meta.json')) as f:
         meta = json.load(f)
+    # integrity gate BEFORE any value reaches the scope: a truncated or
+    # bit-rotted params file raises here (the Trainer's resume loop
+    # catches it and falls back to the previous serial, loudly)
+    if meta.get('params_crc32') is not None:
+        params_path = os.path.join(serial_dir,
+                                   meta.get('params_file') or _PARAMS_FILE)
+        if not os.path.exists(params_path):
+            raise RuntimeError(
+                'checkpoint serial %d: params file %r is missing'
+                % (serial, params_path))
+        want_bytes = meta.get('params_bytes')
+        if want_bytes is not None \
+                and os.path.getsize(params_path) != want_bytes:
+            raise RuntimeError(
+                'checkpoint serial %d is corrupt: params file %r holds %d '
+                'bytes, meta recorded %d (truncated write?)'
+                % (serial, params_path, os.path.getsize(params_path),
+                   want_bytes))
+        got = _file_crc32(params_path)
+        if got != meta['params_crc32']:
+            raise RuntimeError(
+                'checkpoint serial %d is corrupt: params CRC32 %08x does '
+                'not match the meta record %08x'
+                % (serial, got, meta['params_crc32']))
     load_persistables(executor, serial_dir, main_program)
     return meta

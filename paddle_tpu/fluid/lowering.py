@@ -111,11 +111,20 @@ class SeqValue(object):
     measured in units of level k+1's sequences, and the innermost level
     (`lengths`) is measured in tokens/rows. A bare array is accepted for
     the common 2-level case and normalised to a 1-tuple.
+
+    `beam_cap` marks the CAPACITY form of the LoD beam-search decoder
+    (ops_impl/lod_beam.py): data [B*K, ...] with each source's live rows
+    compacted to the front of its K-row block. The flag is static pytree
+    aux — it survives jit/while_loop round trips — and is set ONLY by
+    normalize_capacity, the While capacity-widening pass, and the beam
+    ops themselves, so ordinary 2-level LoD data whose shapes happen to
+    look capacity-like (uniform group counts) can never be misrouted onto
+    the beam path (round-5 ADVICE, lod_beam.is_beam_form).
     """
 
-    __slots__ = ('data', 'lengths', 'outer_lengths')
+    __slots__ = ('data', 'lengths', 'outer_lengths', 'beam_cap')
 
-    def __init__(self, data, lengths, outer_lengths=None):
+    def __init__(self, data, lengths, outer_lengths=None, beam_cap=False):
         self.data = data
         self.lengths = lengths
         if outer_lengths is not None and not isinstance(outer_lengths, tuple):
@@ -124,6 +133,7 @@ class SeqValue(object):
             else:
                 outer_lengths = (outer_lengths,)
         self.outer_lengths = outer_lengths or None
+        self.beam_cap = bool(beam_cap)
 
     @property
     def max_len(self):
@@ -135,16 +145,15 @@ class SeqValue(object):
         return (jnp.arange(t)[None, :] < self.lengths[:, None]).astype(dtype)
 
     def tree_flatten(self):
-        if self.outer_lengths is None:
-            return (self.data, self.lengths), 0
-        return (self.data, self.lengths) + self.outer_lengths, \
-            len(self.outer_lengths)
+        n_outer = len(self.outer_lengths) if self.outer_lengths else 0
+        return (self.data, self.lengths) + (self.outer_lengths or ()), \
+            (n_outer, self.beam_cap)
 
     @classmethod
-    def tree_unflatten(cls, n_outer, children):
-        if n_outer:
-            return cls(children[0], children[1], tuple(children[2:2 + n_outer]))
-        return cls(children[0], children[1])
+    def tree_unflatten(cls, aux, children):
+        n_outer, beam_cap = aux if isinstance(aux, tuple) else (aux, False)
+        outer = tuple(children[2:2 + n_outer]) if n_outer else None
+        return cls(children[0], children[1], outer, beam_cap=beam_cap)
 
 
 jax.tree_util.register_pytree_node(
@@ -199,7 +208,8 @@ def data_of(v):
 def like(template, new_data):
     """Wrap new_data with template's sequence structure (if any)."""
     if isinstance(template, SeqValue):
-        return SeqValue(new_data, template.lengths, template.outer_lengths)
+        return SeqValue(new_data, template.lengths, template.outer_lengths,
+                        beam_cap=template.beam_cap)
     return new_data
 
 
@@ -263,14 +273,17 @@ class ArrayValue(object):
     stores 2-level selected_ids/scores in arrays): `buffer` is then a TUPLE
     of stacked leaf buffers (data, lengths, *outer_lengths) and `n_outer`
     (static) says how many trailing buffers are outer LoD levels; -1 marks
-    a plain dense element."""
+    a plain dense element. `beam` (static aux, like SeqValue.beam_cap)
+    records that the stored elements are capacity-form beam values, so
+    array_read rebuilds them with the flag intact."""
 
-    __slots__ = ('buffer', 'length', 'n_outer')
+    __slots__ = ('buffer', 'length', 'n_outer', 'beam')
 
-    def __init__(self, buffer, length, n_outer=-1):
+    def __init__(self, buffer, length, n_outer=-1, beam=False):
         self.buffer = buffer
         self.length = length
         self.n_outer = n_outer
+        self.beam = bool(beam)
 
     @property
     def is_seq(self):
@@ -284,14 +297,20 @@ class ArrayValue(object):
             return take(self.buffer)
         leaves = tuple(take(b) for b in self.buffer)
         outer = leaves[2:2 + self.n_outer] if self.n_outer else None
-        return SeqValue(leaves[0], leaves[1], outer)
+        return SeqValue(leaves[0], leaves[1], outer, beam_cap=self.beam)
 
     @staticmethod
-    def _grow_rows(buf, rows_new):
+    def _grow_rows(buf, rows_new, n_sources=None):
         """[cap, r_old, ...] -> [cap, rows_new, ...]: row i moves to
         i * stride (the LoD beam capacity convention — each source's rows
         must land at the START of its capacity block; see
-        ops_impl/lod_beam.py)."""
+        ops_impl/lod_beam.py). That placement is only correct when every
+        source owns exactly ONE narrow row (r_old == number of sources);
+        a multi-row-per-source init would be scattered at stride intervals
+        INSIDE each block, silently breaking the rows-compacted-to-front
+        invariant that rows_live/the live-mask assume — so when the caller
+        knows the source count, widening anything else raises loudly
+        (round-5 ADVICE)."""
         r_old = buf.shape[1]
         if rows_new == r_old:
             return buf
@@ -299,6 +318,13 @@ class ArrayValue(object):
             raise ValueError(
                 'array_write: element rows grew %d -> %d; capacity '
                 'widening needs an integer stride' % (r_old, rows_new))
+        if n_sources is not None and r_old != n_sources:
+            raise ValueError(
+                'array_write: cannot widen %d rows to capacity %d for %d '
+                'sources — stride placement is only valid from one row '
+                'per source (%d rows); compact the init to one row per '
+                'source before the loop' % (r_old, rows_new, n_sources,
+                                            n_sources))
         out = jnp.zeros((buf.shape[0], rows_new) + buf.shape[2:],
                         buf.dtype)
         return out.at[:, ::rows_new // r_old].set(buf)
@@ -306,11 +332,16 @@ class ArrayValue(object):
     def _grown_to(self, x):
         """Widen/convert the buffers so a write of `x` fits (the book's
         decode idiom writes one row per source before the While, beam_size
-        rows per source inside it)."""
+        rows per source inside it). Widening follows the beam capacity
+        convention, so the result is beam-flagged; the source count from
+        x's outer LoD gates _grow_rows' one-row-per-source check."""
         if isinstance(x, SeqValue):
             n_outer = len(x.outer_lengths or ())
+            n_src = (x.outer_lengths[0].shape[0]
+                     if x.outer_lengths else None)
             if not self.is_seq:
-                data = self._grow_rows(self.buffer, x.data.shape[0])
+                data = self._grow_rows(self.buffer, x.data.shape[0],
+                                       n_sources=n_src)
                 stride = x.data.shape[0] // self.buffer.shape[1]
                 lens = jnp.zeros((data.shape[0], x.data.shape[0]),
                                  jnp.int32)
@@ -319,19 +350,21 @@ class ArrayValue(object):
                     jnp.ones((data.shape[0],) + o.shape, o.dtype)
                     for o in (x.outer_lengths or ()))
                 return ArrayValue((data, lens) + outer, self.length,
-                                  n_outer)
+                                  n_outer, beam=True)
             d0 = self.buffer[0]
             if d0.ndim == x.data.ndim + 2 and d0.shape[2] == 1:
                 # padded 2-level feed slots [B, max_len=1, ...] -> flat rows
                 d0 = d0.reshape(d0.shape[:2] + d0.shape[3:])
-            data = self._grow_rows(d0, x.data.shape[0])
-            lens = self._grow_rows(self.buffer[1], x.lengths.shape[0])
+            data = self._grow_rows(d0, x.data.shape[0], n_sources=n_src)
+            lens = self._grow_rows(self.buffer[1], x.lengths.shape[0],
+                                   n_sources=n_src)
             return ArrayValue((data, lens) + self.buffer[2:], self.length,
-                              self.n_outer)
+                              self.n_outer,
+                              beam=self.beam or data is not d0)
         if not self.is_seq:
             return ArrayValue(self._grow_rows(self.buffer,
                                               data_of(x).shape[0]),
-                              self.length, -1)
+                              self.length, -1, beam=self.beam)
         return self
 
     def _elem_fits(self, x):
@@ -354,7 +387,7 @@ class ArrayValue(object):
                          jnp.ones((data_of(x).shape[0],), jnp.int32),
                          tuple(jnp.ones(b.shape[1:], b.dtype)
                                for b in self.buffer[2:2 + self.n_outer])
-                         or None)
+                         or None, beam_cap=self.beam)
         if isinstance(x, SeqValue) and not self._elem_fits(x):
             slot = self.buffer[0] if self.is_seq else self.buffer
             if (x.data.ndim == slot.ndim and x.data.shape[1] == 1
@@ -362,7 +395,8 @@ class ArrayValue(object):
                 # [rows, max_len=1, ...] padded element vs flat-row slots
                 # (the decode idiom's pre-loop feeds): drop the singleton
                 # time dim before fitting/growing
-                x = SeqValue(x.data[:, 0], x.lengths, x.outer_lengths)
+                x = SeqValue(x.data[:, 0], x.lengths, x.outer_lengths,
+                             beam_cap=x.beam_cap)
         if not self._elem_fits(x):
             grown = self._grown_to(x)
             if not grown._elem_fits(x):
@@ -388,7 +422,8 @@ class ArrayValue(object):
             buf = put(self.buffer, x)
         cap = (self.buffer[0] if self.is_seq else self.buffer).shape[0]
         length = jnp.minimum(jnp.maximum(self.length, i + 1), cap)
-        return ArrayValue(buf, length, self.n_outer)
+        return ArrayValue(buf, length, self.n_outer,
+                          beam=self.beam or getattr(x, 'beam_cap', False))
 
     @classmethod
     def fresh(cls, x, capacity):
@@ -398,14 +433,16 @@ class ArrayValue(object):
             leaves = (x.data, x.lengths) + tuple(x.outer_lengths or ())
             return cls(tuple(z(v) for v in leaves),
                        jnp.asarray(0, jnp.int32),
-                       len(x.outer_lengths or ()))
+                       len(x.outer_lengths or ()),
+                       beam=x.beam_cap)
         return cls(z(x), jnp.asarray(0, jnp.int32), -1)
 
 
 jax.tree_util.register_pytree_node(
     ArrayValue,
-    lambda a: ((a.buffer, a.length), a.n_outer),
-    lambda aux, ch: ArrayValue(ch[0], ch[1], aux))
+    lambda a: ((a.buffer, a.length), (a.n_outer, a.beam)),
+    lambda aux, ch: ArrayValue(ch[0], ch[1], aux[0], beam=aux[1])
+    if isinstance(aux, tuple) else ArrayValue(ch[0], ch[1], aux))
 
 
 def _bind_outputs(op, outs, env):

@@ -116,13 +116,17 @@ _widen_rows = ArrayValue._grow_rows
 def _widen_array(a, target):
     """Widen an initial ArrayValue to the shapes/structure the loop body
     produces (`target` is the eval_shape result, an ArrayValue of
-    ShapeDtypeStructs)."""
+    ShapeDtypeStructs). The result adopts target's beam flag: widening IS
+    the capacity-form conversion, and lax.while_loop demands the carry's
+    static aux (which the flag is part of) match the body's output."""
+    n_src = (target.buffer[2].shape[1]
+             if target.is_seq and target.n_outer >= 1 else None)
     if target.is_seq and not a.is_seq:
         # the pre-loop write was dense (e.g. the encoder state fed into
         # state_array); the body writes LoD values. Adopt the seq layout
         # with the dense rows as 1-row-per-source groups.
         data_t, len_t = target.buffer[0], target.buffer[1]
-        data = _widen_rows(a.buffer, data_t.shape[1])
+        data = _widen_rows(a.buffer, data_t.shape[1], n_sources=n_src)
         stride = data_t.shape[1] // a.buffer.shape[1]
         lens = jnp.zeros(len_t.shape, len_t.dtype)
         lens = lens.at[:, ::stride].set(
@@ -130,7 +134,8 @@ def _widen_array(a, target):
         outer = tuple(
             jnp.ones(ob.shape, ob.dtype)
             for ob in target.buffer[2:2 + target.n_outer])
-        return ArrayValue((data, lens) + outer, a.length, target.n_outer)
+        return ArrayValue((data, lens) + outer, a.length, target.n_outer,
+                          beam=target.beam)
     if a.is_seq:
         data_t = target.buffer[0]
         d0 = a.buffer[0]
@@ -139,16 +144,19 @@ def _widen_array(a, target):
             # init_ids/init_scores) -> flat capacity row form [B, ...]
             d0 = d0.reshape(d0.shape[:2] + d0.shape[3:])
         if d0.shape != data_t.shape:
-            data = _widen_rows(d0, data_t.shape[1])
-            lens = _widen_rows(a.buffer[1], target.buffer[1].shape[1])
+            data = _widen_rows(d0, data_t.shape[1], n_sources=n_src)
+            lens = _widen_rows(a.buffer[1], target.buffer[1].shape[1],
+                               n_sources=n_src)
             outer = a.buffer[2:]
-            return ArrayValue((data, lens) + outer, a.length, a.n_outer)
-        if d0 is not a.buffer[0]:
-            return ArrayValue((d0,) + a.buffer[1:], a.length, a.n_outer)
+            return ArrayValue((data, lens) + outer, a.length, a.n_outer,
+                              beam=target.beam)
+        if d0 is not a.buffer[0] or a.beam != target.beam:
+            return ArrayValue((d0,) + a.buffer[1:], a.length, a.n_outer,
+                              beam=target.beam)
         return a
     if a.buffer.shape != target.buffer.shape:
         return ArrayValue(_widen_rows(a.buffer, target.buffer.shape[1]),
-                          a.length, -1)
+                          a.length, -1, beam=target.beam)
     return a
 
 
@@ -172,6 +180,15 @@ def _widen_carry_to_body(init, body_env):
                 w = _widen_array(v, t)
                 changed = changed or (w is not v)
                 out[n] = w
+            elif (isinstance(v, SeqValue) and isinstance(t, SeqValue)
+                  and v.beam_cap != t.beam_cap):
+                # the beam flag is static pytree aux: a directly-carried
+                # SeqValue the body turns capacity-form must enter the
+                # loop with the same aux or lax.while_loop rejects the
+                # carry structure
+                out[n] = SeqValue(v.data, v.lengths, v.outer_lengths,
+                                  beam_cap=t.beam_cap)
+                changed = True
             else:
                 out[n] = v
         init = out

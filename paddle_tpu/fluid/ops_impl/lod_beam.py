@@ -31,11 +31,18 @@ NEG = -1e30
 
 
 def is_beam_form(v):
-    """Capacity-form 2-level SeqValue: outer length vector shorter than the
-    row dim (a standard padded 2-level feed has them equal)."""
-    return (isinstance(v, SeqValue) and v.outer_lengths
-            and v.outer_lengths[0].shape[0] != v.data.shape[0]
-            and v.data.shape[0] % v.outer_lengths[0].shape[0] == 0)
+    """Capacity-form 2-level SeqValue, detected by the EXPLICIT beam_cap
+    flag (static pytree aux) that only normalize_capacity, the While
+    capacity-widening pass, and the beam ops themselves set. The old
+    shape heuristic (outer vector shorter than the row dim + divisibility)
+    misrouted ordinary 2-level data with uniform group counts — e.g. 2
+    sources x 3 groups = 6 rows — onto the beam path, silently producing
+    wrong values (round-5 ADVICE, medium). The structural conditions are
+    kept as an AND so a mis-propagated flag on a value that cannot be
+    capacity form still falls through to the ordinary path."""
+    return bool(isinstance(v, SeqValue) and v.beam_cap and v.outer_lengths
+                and v.outer_lengths[0].shape[0] != v.data.shape[0]
+                and v.data.shape[0] % v.outer_lengths[0].shape[0] == 0)
 
 
 def blocks(v):
@@ -93,7 +100,7 @@ def normalize_capacity(pre_ids, pre_scores, ids, scores, beam_size):
         return out.at[dest].set(flat)
 
     l1 = jnp.zeros((B * K,), jnp.int32).at[dest].set(1)
-    mk = lambda v: SeqValue(scatter(v), l1, (rows,))
+    mk = lambda v: SeqValue(scatter(v), l1, (rows,), beam_cap=True)
     return (mk(pre_ids), mk(pre_scores), scatter(ids), scatter(scores))
 
 
@@ -162,8 +169,9 @@ def beam_search_step(pre_ids, pre_scores, ids, scores, beam_size, end_id):
         ordered_ok,
         parent_local + (jnp.arange(B) * Kcap)[:, None], -1)
     sel_ids = SeqValue(out_id.reshape(R, 1).astype(jnp.int64),
-                       l1.reshape(R), (l0,))
-    sel_scores = SeqValue(out_sc.reshape(R, 1), l1.reshape(R), (l0,))
+                       l1.reshape(R), (l0,), beam_cap=True)
+    sel_scores = SeqValue(out_sc.reshape(R, 1), l1.reshape(R), (l0,),
+                          beam_cap=True)
     return sel_ids, sel_scores, parent_rows.reshape(R)
 
 
@@ -188,7 +196,8 @@ def sequence_expand_beam(x, y):
     out = xd[rows.reshape(-1)]
     # emit [rows, 1, ...]: each output row is a one-token level-1 group,
     # and downstream fc ops were shape-inferred for the padded 3-D layout
-    return SeqValue(out[:, None], y.lengths, y.outer_lengths)
+    return SeqValue(out[:, None], y.lengths, y.outer_lengths,
+                    beam_cap=True)
 
 
 def is_empty_beam(v):
@@ -281,7 +290,7 @@ def beam_search_decode_arrays(ids_arr, scores_arr, beam_size, end_id):
     tok_f, sc_f, nt = tok_f[rows], sc_f[rows], nt[rows]
 
     sent_ids = SeqValue(tok_f.astype(jnp.int64), nt,
-                        (n_hyp.astype(jnp.int32),))
+                        (n_hyp.astype(jnp.int32),), beam_cap=True)
     sent_scores = SeqValue(sc_f.astype(jnp.float32), nt,
-                           (n_hyp.astype(jnp.int32),))
+                           (n_hyp.astype(jnp.int32),), beam_cap=True)
     return sent_ids, sent_scores

@@ -181,7 +181,9 @@ def _lod_reset(ins, attrs, ctx):
         from .lod_beam import is_beam_form
         if is_beam_form(y):
             # beam decode idiom: adopt Y's full 2-level capacity LoD
-            return {'Out': SeqValue(data, y.lengths, y.outer_lengths)}
+            # (including its beam flag — the output IS capacity form)
+            return {'Out': SeqValue(data, y.lengths, y.outer_lengths,
+                                    beam_cap=True)}
         lens = y.lengths if isinstance(y, SeqValue) else data_of(y).reshape(-1).astype(jnp.int32)
         if lens.shape[0] != data.shape[0]:
             raise ValueError(

@@ -121,6 +121,14 @@ class Trainer(object):
         (or SequenceParallel/Pipeline; TPU extension, the reference's
         Trainer had only the pserver path)."""
         self.__stop = False
+        # preemption (SIGTERM/SIGINT while train() runs): the handler only
+        # sets _preempt_requested; the loop finishes the in-flight step,
+        # flushes an emergency checkpoint, and returns cleanly with
+        # self.preempted = True. A fresh Trainer over the same checkpoint
+        # dir resumes at the exact next step.
+        self._preempt_requested = False
+        self._preempt_signum = None
+        self.preempted = False
         self.parallel = parallel
         self.trainer_id = 0
         self.checkpoint_cfg = checkpoint_config
@@ -182,15 +190,22 @@ class Trainer(object):
         cfg = self.checkpoint_cfg
         if not os.path.isdir(cfg.checkpoint_dir):
             return
-        # Newest first; a serial with a torn meta.json / missing shard
-        # (crash mid-save) falls back to the previous intact one.
+        # Newest first; a serial with a torn meta.json / missing or
+        # CRC-mismatched params file (crash mid-save, bit rot) falls back
+        # to the previous intact one — LOUDLY, because silently replaying
+        # steps from an older snapshot is a surprise worth explaining.
         for serial in io.list_checkpoint_serials(cfg.checkpoint_dir)[::-1]:
             try:
                 with self._prog_and_scope_guard():
                     meta = io.load_checkpoint(self.exe, cfg.checkpoint_dir,
                                               serial=serial,
                                               main_program=self.train_program)
-            except (RuntimeError, OSError, ValueError, KeyError):
+            except (RuntimeError, OSError, ValueError, KeyError) as e:
+                import warnings
+                warnings.warn(
+                    'checkpoint serial %d in %r failed to load (%s) — '
+                    'falling back to the previous serial'
+                    % (serial, cfg.checkpoint_dir, e), RuntimeWarning)
                 continue
             args = meta.get('trainer_args') or {}
             cfg.load_serial = meta.get('step', 0)
@@ -213,6 +228,92 @@ class Trainer(object):
                     trainer_args={'epoch_id': epoch_id, 'step_id': step_id},
                     max_num_checkpoints=cfg.max_num_checkpoints)
 
+    def _save_emergency_checkpoint(self, epoch_id, step_id):
+        """Preemption flush: unconditional (interval-ignoring) snapshot
+        recording the exact (epoch, step) just completed, so a successor
+        Trainer resumes at step_id + 1 — the reference's crash-recovery
+        dirs never had a clean-shutdown writer; SIGTERM simply killed the
+        process and lost everything since the last periodic snapshot."""
+        cfg = self.checkpoint_cfg
+        if not cfg:
+            return None
+        self._serial += 1
+        with self._prog_and_scope_guard():
+            return io.save_checkpoint(
+                self.exe, cfg.checkpoint_dir,
+                trainer_id=self.trainer_id,
+                main_program=self.train_program,
+                step=self._serial,
+                trainer_args={'epoch_id': epoch_id, 'step_id': step_id,
+                              'preempted': True},
+                max_num_checkpoints=cfg.max_num_checkpoints)
+
+    # -- preemption -------------------------------------------------------
+
+    def _on_preempt_signal(self, signum, frame):
+        # absolutely minimal: flag only. The loop (not the signal frame)
+        # owns checkpointing — saving from here could re-enter numpy/jax
+        # mid-step.
+        self._preempt_requested = True
+        self._preempt_signum = signum
+
+    @contextlib.contextmanager
+    def _preemption_handlers(self):
+        """Install SIGTERM/SIGINT handlers for the duration of train(),
+        restoring the previous handlers after. Signals can only be bound
+        from the main thread; elsewhere (tests driving trainers from
+        worker threads) preemption still works via request_preemption()."""
+        import signal as _signal
+        import threading
+        installed = {}
+        if threading.current_thread() is threading.main_thread():
+            for sig in (_signal.SIGTERM, _signal.SIGINT):
+                try:
+                    installed[sig] = _signal.signal(
+                        sig, self._on_preempt_signal)
+                except (ValueError, OSError):
+                    pass
+        try:
+            yield
+        finally:
+            for sig, prev in installed.items():
+                try:
+                    _signal.signal(sig, prev)
+                except (ValueError, OSError):
+                    pass
+
+    def request_preemption(self):
+        """Programmatic preemption (what the SIGTERM handler does): finish
+        the in-flight step, flush an emergency checkpoint, return from
+        train() cleanly with self.preempted = True."""
+        self._preempt_requested = True
+
+    def _finish_preemption(self, last_done):
+        """Flush the emergency checkpoint for the last COMPLETED step (if
+        any completed this run — otherwise prior checkpoints already
+        reflect the state) and mark the trainer preempted."""
+        import warnings
+        cfg = self.checkpoint_cfg
+        saved = False
+        if last_done is not None and cfg:
+            self._save_emergency_checkpoint(*last_done)
+            saved = True
+        self.preempted = True
+        where = ('at epoch %d step %d' % last_done if last_done is not None
+                 else 'before any step completed')
+        if saved:
+            detail = 'emergency checkpoint flushed'
+        elif cfg:
+            detail = ('no emergency checkpoint needed (prior serials '
+                      'already reflect the state)')
+        else:
+            detail = ('emergency checkpoint SKIPPED (no CheckpointConfig '
+                      '— progress is lost)')
+        warnings.warn(
+            'preemption (%s) %s: %s; train() returning cleanly'
+            % (self._preempt_signum or 'requested', where, detail),
+            RuntimeWarning)
+
     def _clean_checkpoint(self):
         # Remove only the checkpoint_<n> serial subdirs we created — the
         # configured dir may be (and defaults to) the user's cwd.
@@ -231,14 +332,22 @@ class Trainer(object):
         self.__stop = True
 
     def train(self, num_epochs, event_handler, reader=None, feed_order=None):
-        """reference trainer.py:379."""
-        if self.parallel:
-            with self._prog_and_scope_guard():
-                pe = self._get_or_create_parallel_executor()
-            self._train_loop(pe, num_epochs, event_handler, reader, feed_order)
-        else:
-            self._train_loop(self.exe, num_epochs, event_handler, reader,
-                             feed_order)
+        """reference trainer.py:379. While the loop runs, SIGTERM/SIGINT
+        mean PREEMPTION, not crash: the in-flight step completes, an
+        emergency checkpoint flushes, and train() returns cleanly with
+        self.preempted = True (resume by constructing a new Trainer over
+        the same checkpoint dir)."""
+        self.preempted = False
+        self._preempt_requested = False
+        with self._preemption_handlers():
+            if self.parallel:
+                with self._prog_and_scope_guard():
+                    pe = self._get_or_create_parallel_executor()
+                self._train_loop(pe, num_epochs, event_handler, reader,
+                                 feed_order)
+            else:
+                self._train_loop(self.exe, num_epochs, event_handler, reader,
+                                 feed_order)
 
     def test(self, reader, feed_order=None):
         """reference trainer.py:409 — mean of train_func outputs over the
@@ -299,12 +408,24 @@ class Trainer(object):
             fetch = [v.name for v in self.train_func_outputs]
             cfg = self.checkpoint_cfg
             start_epoch = cfg.epoch_id if cfg and cfg.load_serial else 0
+            # (epoch, step) of the last COMPLETED step this run — what an
+            # emergency checkpoint must record when preemption is noticed
+            # while the reader blocks / between steps, i.e. before another
+            # exe.run ever happens
+            last_done = None
             for epoch_id in range(start_epoch, num_epochs):
                 event_handler(BeginEpochEvent(epoch_id))
                 for step_id, data in enumerate(reader()):
                     if self.__stop:
                         if cfg:
                             self._clean_checkpoint()
+                        return
+                    if self._preempt_requested:
+                        # signal landed while the reader was producing
+                        # this batch (which can block for a long time):
+                        # flush NOW from the consistent between-step
+                        # state instead of paying for one more step
+                        self._finish_preemption(last_done)
                         return
                     if (cfg and cfg.load_serial
                             and epoch_id == cfg.epoch_id
@@ -319,9 +440,22 @@ class Trainer(object):
                         metrics = exe.run(program=self.train_program,
                                           feed=feeder.feed(data),
                                           fetch_list=want)
+                    last_done = (epoch_id, step_id)
+                    if self._preempt_requested:
+                        # the step above COMPLETED (run() synchronizes on
+                        # its fetches); record it and leave. No
+                        # _clean_checkpoint: the whole point is resuming.
+                        self._finish_preemption(last_done)
+                        event_handler(EndStepEvent(epoch_id, step_id,
+                                                   metrics))
+                        return
                     if cfg:
                         self._save_checkpoint(epoch_id, step_id)
                     event_handler(EndStepEvent(epoch_id, step_id, metrics))
                 event_handler(EndEpochEvent(epoch_id))
+                if self._preempt_requested:
+                    # between epochs: same flush, no extra step
+                    self._finish_preemption(last_done)
+                    return
             if cfg:
                 self._clean_checkpoint()

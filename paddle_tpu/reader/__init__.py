@@ -7,7 +7,7 @@ from threading import Condition, Thread
 __all__ = [
     'map_readers', 'buffered', 'compose', 'chain', 'shuffle',
     'ComposeNotAligned', 'firstn', 'xmap_readers', 'Fake', 'cache',
-    'PipeReader',
+    'PipeReader', 'fault_tolerant',
 ]
 
 from . import pipeline  # noqa: F401
@@ -189,6 +189,60 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
             else:
                 yield item
     return xreader
+
+
+def fault_tolerant(reader, max_retries=3, retry_on=(IOError, OSError),
+                   base_delay=0.05, max_delay=2.0, seed=None,
+                   sleep=None):
+    """Make a reader survive transient source failures (flaky NFS / GCS /
+    preempted sidecar): when iterating the stream raises a `retry_on`
+    exception, the source reader is re-opened (with utils.retry's
+    exponential-backoff schedule) and fast-forwarded past the samples
+    already emitted, so the consumer sees no duplicates and no gaps.
+    After `max_retries` re-opens the stream DEGRADES instead of dying: a
+    loud RuntimeWarning reports how many samples were delivered and the
+    epoch ends early — a multi-hour training job keeps its progress and
+    checkpoints rather than crashing on a bad input shard.
+
+    REQUIRES a deterministic source: the fast-forward skips the first
+    `emitted` samples of the re-opened stream by INDEX, which only
+    reproduces the already-delivered prefix if the reader yields the same
+    order every time. Wrap the deterministic base reader and put
+    nondeterministic decorators (shuffle) OUTSIDE:
+    `shuffle(fault_tolerant(base), buf)` — wrapping `shuffle` itself
+    would silently duplicate/drop samples across a retry.
+
+    sleep is injectable for tests (None = time.sleep)."""
+    import time as _time
+    import warnings
+
+    from ..utils.retry import backoff_delays
+
+    def fault_tolerant_reader():
+        emitted = 0
+        delays = backoff_delays(max_retries, base_delay=base_delay,
+                                max_delay=max_delay, seed=seed)
+        do_sleep = _time.sleep if sleep is None else sleep
+        while True:
+            try:
+                for i, sample in enumerate(reader()):
+                    if i < emitted:
+                        continue  # fast-forward past a replayed prefix
+                    yield sample
+                    emitted += 1
+                return
+            except retry_on as e:
+                delay = next(delays, None)
+                if delay is None:
+                    warnings.warn(
+                        'fault_tolerant reader: source failed %d times '
+                        '(last: %r); degrading to skip — stream ends '
+                        'after %d sample(s) instead of raising'
+                        % (max_retries + 1, e, emitted), RuntimeWarning)
+                    return
+                do_sleep(delay)
+
+    return fault_tolerant_reader
 
 
 def cache(reader):

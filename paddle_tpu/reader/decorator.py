@@ -6,10 +6,11 @@ re-exports them anyway); this module mirrors the reference layout so
 `from paddle.reader.decorator import shuffle`-style imports port verbatim.
 """
 from . import (Fake, ComposeNotAligned, PipeReader, buffered, cache, chain,
-               compose, firstn, map_readers, shuffle, xmap_readers)
+               compose, fault_tolerant, firstn, map_readers, shuffle,
+               xmap_readers)
 
 __all__ = [
     'map_readers', 'buffered', 'compose', 'chain', 'shuffle',
     'ComposeNotAligned', 'firstn', 'xmap_readers', 'Fake', 'cache',
-    'PipeReader',
+    'PipeReader', 'fault_tolerant',
 ]

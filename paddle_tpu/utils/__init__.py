@@ -1,3 +1,7 @@
-"""Host-side utilities: native runtime bindings (utils.native) and
-mesh-sharded checkpointing (utils.checkpoint)."""
+"""Host-side utilities: native runtime bindings (utils.native),
+mesh-sharded checkpointing (utils.checkpoint), retry/backoff primitives
+(utils.retry), and the deterministic fault-injection harness
+(utils.faults)."""
 from . import checkpoint  # noqa: F401
+from . import faults  # noqa: F401
+from . import retry  # noqa: F401

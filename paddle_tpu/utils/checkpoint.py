@@ -22,11 +22,28 @@ import json
 import os
 import re
 import threading
+import zlib
 
 import numpy as np
 
 __all__ = ['save_sharded', 'save_sharded_async', 'load_sharded',
-           'latest_step', 'AsyncSave']
+           'load_latest_verified', 'verify_sharded', 'latest_step',
+           'AsyncSave']
+
+# transient-IO retry shape shared by shard reads/writes (utils.retry):
+# 2 extra attempts, short base delay — a genuinely corrupt file fails all
+# attempts identically and surfaces as the CRC/size RuntimeError below
+_IO_RETRIES = 2
+_IO_BASE_DELAY = 0.05
+
+
+def _crc32_file(path, chunk=1 << 20):
+    """CRC32 of a file's bytes, streamed (never loads a shard whole)."""
+    crc = 0
+    with open(path, 'rb') as f:
+        for block in iter(lambda: f.read(chunk), b''):
+            crc = zlib.crc32(block, crc)
+    return crc & 0xFFFFFFFF
 
 _MANIFEST = 'manifest.json'
 # dirs with an async save in flight: overlapping saves to one dir would
@@ -127,13 +144,24 @@ def _write_manifest(ckpt_dir, manifest):
     return ckpt_dir
 
 
+def _write_shard(fpath, data, sh):
+    """Write one shard (retried on transient IO errors) and record its
+    integrity triple — byte size AND content CRC32 — in the manifest
+    entry. The CRC catches what the size check cannot: a same-length
+    bit-rotted or overwritten file."""
+    from .retry import retry_call
+    retry_call(np.save, args=(fpath, data), retries=_IO_RETRIES,
+               base_delay=_IO_BASE_DELAY,
+               describe='write shard %r' % fpath)
+    sh['bytes'] = os.path.getsize(fpath)
+    sh['crc32'] = _crc32_file(fpath)
+
+
 def _write_all(ckpt_dir, manifest, writes):
     """Deferred writer (async path): shard files first, manifest last."""
     os.makedirs(ckpt_dir, exist_ok=True)
     for fname, data, sh in writes:
-        fpath = os.path.join(ckpt_dir, fname)
-        np.save(fpath, data)
-        sh['bytes'] = os.path.getsize(fpath)
+        _write_shard(os.path.join(ckpt_dir, fname), data, sh)
     return _write_manifest(ckpt_dir, manifest)
 
 
@@ -156,9 +184,8 @@ def save_sharded(ckpt_dir, arrays, step=0, extra_meta=None):
         os.makedirs(ckpt_dir, exist_ok=True)
 
         def sink(fname, shard_data, sh):
-            fpath = os.path.join(ckpt_dir, fname)
-            np.save(fpath, np.asarray(shard_data))
-            sh['bytes'] = os.path.getsize(fpath)
+            _write_shard(os.path.join(ckpt_dir, fname),
+                         np.asarray(shard_data), sh)
 
         manifest, _ = _collect_shards(arrays, step, extra_meta, sink=sink)
         return _write_manifest(ckpt_dir, manifest)
@@ -167,40 +194,74 @@ def save_sharded(ckpt_dir, arrays, step=0, extra_meta=None):
             _INFLIGHT_DIRS.discard(key)
 
 
+def _warn_unobserved_failure(state):
+    """Warn that a background save failed with nobody left to observe it.
+    Called from the AsyncSave finalizer (handle GC'd / interpreter exit)
+    AND from the future's done-callback — whichever learns LAST that the
+    handle is dead and the write failed; `state['lock']`+`'warned'` make
+    the warning fire exactly once. `state` is a plain dict (never the
+    handle itself, which a finalizer must not keep alive)."""
+    with state['lock']:
+        if state['observed'] or state['exc'] is None or state['warned']:
+            return
+        if not state['dead']:
+            return  # the handle is alive: the caller can still wait()
+        state['warned'] = True
+    import warnings
+    warnings.warn(
+        'async sharded checkpoint to %r FAILED in the background (%r) '
+        'and its handle was never wait()ed — the checkpoint is missing '
+        'or partial' % (state['ckpt_dir'], state['exc']), RuntimeWarning)
+
+
 class AsyncSave(object):
     """Handle for an in-flight save_sharded_async, wrapping the writer
     Future: wait() blocks and re-raises any IO error with its original
-    traceback; done() polls."""
+    traceback; done() polls.
+
+    A caller that never observes the handle must still learn the
+    checkpoint is missing/partial — but a caller that WILL wait() must
+    not be pre-warned from the pool thread the moment the write fails
+    (round-5 ADVICE: the old done-callback warned eagerly even when
+    wait() followed and re-raised). The warning is therefore deferred to
+    handle finalization (GC/atexit via weakref.finalize), the first point
+    where "never observed" is actually decided."""
 
     def __init__(self, future, ckpt_dir):
+        import weakref
         self._future = future
         self.ckpt_dir = ckpt_dir
-        self._observed = False
-        # a caller that never wait()s (or crashes first) must still learn
-        # the checkpoint is missing/partial: surface unobserved failures
-        future.add_done_callback(self._warn_unobserved)
+        self._state = {'observed': False, 'exc': None, 'dead': False,
+                       'warned': False, 'lock': threading.Lock(),
+                       'ckpt_dir': ckpt_dir}
+        state = self._state  # the callbacks must not capture self
 
-    def _warn_unobserved(self, future):
-        if self._observed:
-            return
-        exc = future.exception()
-        if exc is not None:
-            import warnings
-            warnings.warn(
-                'async sharded checkpoint to %r FAILED in the background '
-                '(%r) — the checkpoint is missing or partial; call '
-                '.wait() to re-raise with the full traceback'
-                % (self.ckpt_dir, exc), RuntimeWarning)
+        def record(fut):
+            # runs in the pool thread when the write finishes; if the
+            # handle was ALREADY dropped (GC'd before the write failed),
+            # this is the last chance to surface the failure
+            state['exc'] = fut.exception()
+            _warn_unobserved_failure(state)
+        future.add_done_callback(record)
+
+        def finalize():
+            state['dead'] = True
+            _warn_unobserved_failure(state)
+        self._finalizer = weakref.finalize(self, finalize)
 
     def done(self):
         return self._future.done()
 
     def wait(self, timeout=None):
-        self._observed = True
+        import concurrent.futures
+        self._state['observed'] = True
         try:
             return self._future.result(timeout=timeout)
-        except TimeoutError:
-            self._observed = False  # the write is still in flight
+        except (TimeoutError, concurrent.futures.TimeoutError):
+            # futures.TimeoutError is NOT builtins.TimeoutError before
+            # Python 3.11 — catch both or a timed-out wait() would leave
+            # observed=True and suppress the unobserved-failure warning
+            self._state['observed'] = False  # the write is still in flight
             raise
 
 
@@ -245,13 +306,11 @@ def save_sharded_async(ckpt_dir, arrays, step=0, extra_meta=None):
     return AsyncSave(future, ckpt_dir)
 
 
-def _load_shard(ckpt_dir, sh):
-    """np.load with corruption detection: a missing or size-mismatched
-    (truncated / partially-written) shard file raises a RuntimeError naming
-    the file instead of a cryptic numpy parse error (reference io.py's
-    load_persistables raises per-var on missing files the same way)."""
-    path = os.path.join(ckpt_dir, sh['file'] if isinstance(sh, dict) else sh)
-    meta = sh if isinstance(sh, dict) else {}
+def _shard_meta_check(path, meta):
+    """Existence/size gate against a manifest shard entry — the SINGLE
+    implementation shared by _load_shard and verify_sharded so the two
+    can never diverge on what counts as corrupt. Raises RuntimeError;
+    returns the manifest CRC32 (or None when the manifest predates it)."""
     if not os.path.exists(path):
         raise RuntimeError(
             'sharded checkpoint shard %r is missing (deleted or never '
@@ -262,26 +321,60 @@ def _load_shard(ckpt_dir, sh):
             'sharded checkpoint shard %r is corrupt: %d bytes on disk, '
             'manifest recorded %d (truncated write?)'
             % (path, os.path.getsize(path), want))
+    return meta.get('crc32')
+
+
+def _crc_check(path, got_crc, want_crc):
+    """Shared CRC comparison (same wording from every checker)."""
+    if want_crc is not None and got_crc != want_crc:
+        raise RuntimeError(
+            'sharded checkpoint shard %r is corrupt: content CRC32 '
+            '%08x does not match the manifest record %08x (bit rot or '
+            'a partially-overwritten file)' % (path, got_crc, want_crc))
+
+
+def _load_shard(ckpt_dir, sh, verify_crc=True):
+    """np.load with corruption detection: a missing, size-mismatched
+    (truncated / partially-written), or CRC-mismatched (bit-rotted /
+    overwritten) shard file raises a RuntimeError naming the file instead
+    of a cryptic numpy parse error or — worse — silently wrong values
+    (reference io.py's load_persistables raises per-var on missing files
+    the same way). The file is read from disk exactly ONCE: the CRC runs
+    over the in-memory bytes np.load then parses. Reads are retried on
+    transient IO errors first, so only a persistent mismatch reaches the
+    corruption verdict."""
+    import io as _io
+    path = os.path.join(ckpt_dir, sh['file'] if isinstance(sh, dict) else sh)
+    meta = sh if isinstance(sh, dict) else {}
+    want_crc = _shard_meta_check(path, meta)
+    from .retry import RetryError, retry_call
+
+    def read():
+        with open(path, 'rb') as f:
+            return f.read()
+
     try:
-        return np.load(path)
+        buf = retry_call(read, retries=_IO_RETRIES,
+                         base_delay=_IO_BASE_DELAY,
+                         describe='read shard %r' % path)
+    except RetryError as e:
+        raise RuntimeError(
+            'sharded checkpoint shard %r is unreadable: %r'
+            % (path, e.last_exception))
+    if verify_crc:
+        _crc_check(path, zlib.crc32(buf) & 0xFFFFFFFF, want_crc)
+    try:
+        return np.load(_io.BytesIO(buf))
     except Exception as e:
         raise RuntimeError(
             'sharded checkpoint shard %r is unreadable: %r' % (path, e))
 
 
-def load_sharded(ckpt_dir, mesh=None):
-    """Restore {name: jax.Array} with the saved shardings.
-
-    mesh: the Mesh to restore onto; None re-creates one per-array from the
-    manifest's (mesh_axes, mesh_shape) over jax.devices(). Returns
-    (arrays, meta) where meta has 'step' and 'extra'.
-    """
-    import jax
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
+def _merged_manifest(ckpt_dir):
+    """Process 0's manifest with every other host's shard listings merged
+    into the arrays table."""
     with open(os.path.join(ckpt_dir, _MANIFEST)) as f:
         manifest = json.load(f)
-    # merge other hosts' shard listings into the arrays table
     for d in sorted(os.listdir(ckpt_dir)):
         if re.fullmatch(r'manifest\.p\d+\.json', d):
             with open(os.path.join(ckpt_dir, d)) as f:
@@ -291,6 +384,92 @@ def load_sharded(ckpt_dir, mesh=None):
                     manifest['arrays'][name]['shards'].extend(entry['shards'])
                 else:
                     manifest['arrays'][name] = entry
+    return manifest
+
+
+def verify_sharded(ckpt_dir):
+    """Integrity-check every shard of a sharded checkpoint against its
+    manifest records (existence, byte size, content CRC32) WITHOUT loading
+    the arrays. Returns a list of human-readable problems — empty means
+    the checkpoint is bit-exact as written. Used by load_latest_verified
+    to decide whether a serial is safe to restore from."""
+    problems = []
+    try:
+        manifest = _merged_manifest(ckpt_dir)
+    except (OSError, ValueError, KeyError) as e:
+        return ['manifest unreadable in %r: %r' % (ckpt_dir, e)]
+    for name, entry in manifest.get('arrays', {}).items():
+        for sh in entry.get('shards', []):
+            try:
+                path = os.path.join(ckpt_dir, sh['file'])
+                want_crc = _shard_meta_check(path, sh)
+                if want_crc is not None:
+                    _crc_check(path, _crc32_file(path), want_crc)
+            except (RuntimeError, OSError, KeyError, TypeError) as e:
+                problems.append('%s: %s' % (name, e))
+    return problems
+
+
+def load_latest_verified(base_dir, prefix='sharded_', mesh=None):
+    """Restore the NEWEST intact serial under base_dir/<prefix><step>.
+
+    Serials are tried newest-first; one that fails integrity verification
+    (torn write, truncated or bit-rotted shard, missing manifest) is
+    skipped with a LOUD warning and the previous serial is tried — losing
+    a few steps of progress is recoverable, silently training from
+    corrupted weights is not. Raises RuntimeError when no intact serial
+    remains. Returns (arrays, meta) like load_sharded."""
+    import warnings
+    steps = []
+    if os.path.isdir(base_dir):
+        for d in os.listdir(base_dir):
+            if d.startswith(prefix):
+                try:
+                    steps.append(int(d[len(prefix):]))
+                except ValueError:
+                    continue
+    if not steps:
+        raise RuntimeError('no %r serials under %r' % (prefix, base_dir))
+    tried = []
+    for step in sorted(steps, reverse=True):
+        ckpt_dir = os.path.join(base_dir, '%s%d' % (prefix, step))
+        problems = verify_sharded(ckpt_dir)
+        if not problems:
+            try:
+                # verify_sharded just hashed every shard; don't re-CRC
+                # each file during the load (size/readability still check)
+                return load_sharded(ckpt_dir, mesh=mesh, verify_crc=False)
+            except (RuntimeError, OSError, ValueError, KeyError,
+                    TypeError) as e:
+                # a structurally-torn manifest (missing 'shape'/'spec'
+                # fields) raises Key/Type/ValueError past verify_sharded's
+                # integrity checks — still fall back, loudly, like the
+                # Trainer's serial loop does
+                problems = ['%s: %s' % (type(e).__name__, e)]
+        tried.append((step, problems))
+        warnings.warn(
+            'sharded checkpoint serial %d at %r FAILED verification '
+            '(%s) — falling back to the previous serial'
+            % (step, ckpt_dir, '; '.join(problems[:3])), RuntimeWarning)
+    raise RuntimeError(
+        'no intact sharded checkpoint under %r: %s'
+        % (base_dir, '; '.join('serial %d: %s' % (s, p[0])
+                               for s, p in tried)))
+
+
+def load_sharded(ckpt_dir, mesh=None, verify_crc=True):
+    """Restore {name: jax.Array} with the saved shardings.
+
+    mesh: the Mesh to restore onto; None re-creates one per-array from the
+    manifest's (mesh_axes, mesh_shape) over jax.devices(). Returns
+    (arrays, meta) where meta has 'step' and 'extra'. verify_crc=False
+    skips the per-shard content CRC (size/readability still checked) —
+    for callers that just ran verify_sharded over the same dir.
+    """
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    manifest = _merged_manifest(ckpt_dir)
 
     mesh_cache = {}
 
@@ -316,7 +495,8 @@ def load_sharded(ckpt_dir, mesh=None):
         def cb(index, _shape=shape, _smap=shard_map, _dtype=dtype):
             key = _index_key(index, _shape)
             if key in _smap:
-                return _load_shard(ckpt_dir, _smap[key]).astype(_dtype)
+                return _load_shard(ckpt_dir, _smap[key],
+                                   verify_crc=verify_crc).astype(_dtype)
             # Restoring onto a different mesh/spec: assemble the requested
             # region from the overlapping saved shards (elastic restore).
             region = np.empty([t - s for s, t in key], dtype=_dtype)
@@ -326,7 +506,7 @@ def load_sharded(ckpt_dir, mesh=None):
                 hi = [min(a[1], b[1]) for a, b in zip(key, skey)]
                 if any(l >= h for l, h in zip(lo, hi)):
                     continue
-                data = _load_shard(ckpt_dir, sh)
+                data = _load_shard(ckpt_dir, sh, verify_crc=verify_crc)
                 src = tuple(slice(l - b[0], h - b[0])
                             for l, h, b in zip(lo, hi, skey))
                 dst = tuple(slice(l - a[0], h - a[0])

@@ -1,0 +1,146 @@
+"""Deterministic fault injection for the robustness test suite.
+
+Every fault-tolerance behavior in the runtime — NaN-step skip (executor
+anomaly guard), checkpoint CRC fallback, reader retry-then-degrade,
+preemption-safe Trainer shutdown — is TESTED through this harness rather
+than asserted in prose. All randomness flows from one seeded RandomState,
+so a failing fault drill reproduces bit-for-bit from its seed.
+
+The injectors deliberately operate at the host boundary (file bytes,
+Python callables, OS signals, feed batches): the compiled XLA step stays
+byte-identical with and without the harness, so the tests exercise the
+SAME code paths production hits.
+"""
+import os
+import signal
+
+import numpy as np
+
+__all__ = ['FaultInjector', 'send_preemption']
+
+
+def send_preemption(sig=signal.SIGTERM, pid=None):
+    """Deliver a preemption signal to this process (default SIGTERM — what
+    a TPU-VM maintenance event or k8s eviction sends). The Trainer's
+    preemption handler finishes the in-flight step, flushes an emergency
+    checkpoint, and returns from train() cleanly."""
+    os.kill(os.getpid() if pid is None else pid, sig)
+
+
+class FaultInjector(object):
+    """Seeded source of faults. One instance per test; every choice
+    (which byte to flip, which call to fail, where to poison) derives from
+    `seed`, so drills are reproducible."""
+
+    def __init__(self, seed=0):
+        self.seed = int(seed)
+        self.rng = np.random.RandomState(self.seed)
+
+    # -- callable faults ---------------------------------------------------
+
+    def flaky(self, fn, fail_times=1, exc_factory=None):
+        """Wrap fn to raise on its first `fail_times` calls, then succeed.
+        Models transient I/O: the retry layer should absorb exactly
+        `fail_times` failures."""
+        if exc_factory is None:
+            exc_factory = lambda i: IOError('injected transient failure #%d'
+                                            % (i + 1))
+        state = {'calls': 0}
+
+        def wrapper(*args, **kwargs):
+            i = state['calls']
+            state['calls'] += 1
+            if i < fail_times:
+                raise exc_factory(i)
+            return fn(*args, **kwargs)
+
+        wrapper.calls = lambda: state['calls']
+        return wrapper
+
+    def flaky_reader(self, reader, fail_at, fail_times=1, exc_factory=None):
+        """Decorate a paddle-style reader creator: each of the first
+        `fail_times` iterations raises just before yielding sample index
+        `fail_at`. With paddle_tpu.reader.fault_tolerant around it, the
+        stream should heal without duplicating or dropping samples (until
+        retries are exhausted, when it degrades to skip-with-warning)."""
+        if exc_factory is None:
+            exc_factory = lambda i: IOError('injected reader failure #%d'
+                                            % (i + 1))
+        state = {'iters': 0}
+
+        def creator():
+            it = state['iters']
+            state['iters'] += 1
+            def gen():
+                for i, sample in enumerate(reader()):
+                    if it < fail_times and i == fail_at:
+                        raise exc_factory(it)
+                    yield sample
+            return gen()
+
+        return creator
+
+    # -- numeric faults ----------------------------------------------------
+
+    def poison_nan(self, batch, rate=1.0):
+        """Return a copy of a feed batch (ndarray, or nested list/tuple/
+        dict of ndarrays) with a seeded fraction of float entries replaced
+        by NaN — the canonical way to force an unhealthy training step
+        through the REAL compiled path (the NaN propagates into loss and
+        gradients; the anomaly guard must skip the step)."""
+        if isinstance(batch, dict):
+            return {k: self.poison_nan(v, rate) for k, v in batch.items()}
+        if isinstance(batch, (list, tuple)):
+            return type(batch)(self.poison_nan(v, rate) for v in batch)
+        arr = np.array(batch, copy=True)
+        if not np.issubdtype(arr.dtype, np.floating):
+            return arr
+        mask = self.rng.rand(*arr.shape) < rate if arr.shape else \
+            np.asarray(self.rng.rand() < rate)
+        flat = arr.reshape(-1)
+        flat[np.asarray(mask).reshape(-1)] = np.nan
+        return flat.reshape(arr.shape)
+
+    # -- file faults -------------------------------------------------------
+
+    def truncate_file(self, path, keep_fraction=None, keep_bytes=None):
+        """Truncate a file in place (a torn write / crashed writer). By
+        default keeps a seeded fraction in [0.25, 0.75) of the bytes."""
+        size = os.path.getsize(path)
+        if keep_bytes is None:
+            frac = (0.25 + 0.5 * self.rng.rand()) if keep_fraction is None \
+                else keep_fraction
+            keep_bytes = int(size * frac)
+        keep_bytes = max(0, min(size - 1, keep_bytes))
+        with open(path, 'r+b') as f:
+            f.truncate(keep_bytes)
+        return keep_bytes
+
+    def corrupt_file(self, path, n_bytes=4):
+        """Flip `n_bytes` seeded bytes in place WITHOUT changing the file
+        size — the case only a content checksum (manifest CRC32) catches;
+        a size check alone passes."""
+        size = os.path.getsize(path)
+        offsets = self.rng.randint(0, size, size=n_bytes)
+        with open(path, 'r+b') as f:
+            for off in offsets:
+                f.seek(int(off))
+                b = f.read(1)
+                f.seek(int(off))
+                f.write(bytes([b[0] ^ 0xFF]))
+        return sorted(int(o) for o in offsets)
+
+    def pick_file(self, directory, suffix='.npy'):
+        """Seeded choice of one file (sorted listing, so the same seed
+        picks the same shard on every run)."""
+        names = sorted(n for n in os.listdir(directory)
+                       if n.endswith(suffix))
+        if not names:
+            raise ValueError('no %r files under %r' % (suffix, directory))
+        return os.path.join(directory, names[self.rng.randint(len(names))])
+
+    # -- process faults ----------------------------------------------------
+
+    def preempt(self, sig=signal.SIGTERM):
+        """Simulated preemption of THIS process (see send_preemption)."""
+        send_preemption(sig)

@@ -3,10 +3,23 @@
 Note: this environment bakes in an `axon` TPU plugin that overrides
 JAX_PLATFORMS env vars, so the switch must go through jax.config.
 """
+import os
+
+# jax < 0.5 has no jax_num_cpu_devices config; the XLA flag is the
+# portable spelling and must be set before any backend initializes
+# (importing this conftest happens before any test module imports jax).
+_flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in _flags:
+    os.environ['XLA_FLAGS'] = (
+        _flags + ' --xla_force_host_platform_device_count=8').strip()
+
 import jax
 
 jax.config.update('jax_platforms', 'cpu')
-jax.config.update('jax_num_cpu_devices', 8)
+try:
+    jax.config.update('jax_num_cpu_devices', 8)
+except AttributeError:
+    pass  # older jax: XLA_FLAGS above already forced 8 CPU devices
 
 # ---------------------------------------------------------------------------
 # slow-test tier: every test measured > 8s on one CPU core (pytest

@@ -1,0 +1,511 @@
+"""Fault drills: every fault-tolerance behavior exercised through the
+seeded injection harness (paddle_tpu.utils.faults), never just asserted.
+
+Covers the robustness PR's acceptance criteria end to end:
+  - a seeded NaN injection triggers step-skip on the COMPILED path
+    (params bit-identical for that step, training continues after);
+  - a truncated/bit-rotted shard is detected via the manifest CRC and
+    restore falls back to the previous serial with a loud warning;
+  - a simulated SIGTERM mid-epoch yields an emergency checkpoint from
+    which a fresh Trainer resumes at the exact next step;
+  - a flaky reader retries (no duplicates, no gaps) then degrades to
+    skip-with-warning once retries are exhausted;
+  - is_beam_form no longer misclassifies ordinary 2-level LoD data with
+    uniform group counts.
+All tests run on the 8-virtual-device CPU platform (conftest) and carry
+the `faults` marker so tools/fault_drill.sh can run the suite alone.
+"""
+import os
+import signal
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu
+import paddle_tpu.fluid as fluid
+import paddle_tpu.reader
+from paddle_tpu.utils import checkpoint as ck
+from paddle_tpu.utils import retry as retry_mod
+from paddle_tpu.utils.faults import FaultInjector
+
+pytestmark = pytest.mark.faults
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _toy_regression():
+    """(program, startup, loss, w_names): 1-layer regression whose step is
+    lowered+jitted like any real model."""
+    prog, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, start):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    w_names = sorted(v.name for v in prog.list_vars()
+                     if v.persistable and 'fc' in v.name)
+    return prog, start, loss, w_names
+
+
+def _batch(seed=0, n=8):
+    rng = np.random.RandomState(seed)
+    return (rng.rand(n, 4).astype('float32'),
+            rng.rand(n, 1).astype('float32'))
+
+
+# ---------------------------------------------------------------------------
+# anomaly guard: NaN step-skip on the compiled path
+# ---------------------------------------------------------------------------
+
+def test_nan_step_skipped_params_unchanged_compiled_path():
+    prog, start, loss, w_names = _toy_regression()
+    fluid.anomaly_guard(prog)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(start)
+        xb, yb = _batch()
+        exe.run(prog, feed={'x': xb, 'y': yb}, fetch_list=[loss])
+        assert bool(exe.last_step_health['healthy'])
+        assert np.isfinite(float(exe.last_step_health['grad_norm']))
+        before = {n: np.asarray(scope.vars[n]) for n in w_names}
+
+        inj = FaultInjector(seed=3)
+        bad = inj.poison_nan(xb, rate=0.5)
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter('always')
+            exe.run(prog, feed={'x': bad, 'y': yb}, fetch_list=[loss])
+        # the step was SKIPPED inside the jitted module: params/optimizer
+        # state rolled back bit-exactly, and the host observed it
+        assert exe.skipped_steps == 1
+        assert not bool(exe.last_step_health['healthy'])
+        assert any('anomaly guard' in str(w.message) for w in rec)
+        after = {n: np.asarray(scope.vars[n]) for n in w_names}
+        for n in w_names:
+            np.testing.assert_array_equal(before[n], after[n])
+
+        # a healthy step right after still trains (no sticky skip state)
+        exe.run(prog, feed={'x': xb, 'y': yb}, fetch_list=[loss])
+        assert exe._consecutive_skips == 0
+        assert any((np.asarray(scope.vars[n]) != before[n]).any()
+                   for n in w_names)
+
+
+def test_consecutive_skips_escalate_to_floating_point_error():
+    prog, start, loss, _ = _toy_regression()
+    fluid.anomaly_guard(prog, max_consecutive_skips=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(start)
+        xb, yb = _batch()
+        bad = FaultInjector(seed=5).poison_nan(xb, rate=1.0)
+        with pytest.raises(FloatingPointError, match='consecutive'):
+            for _ in range(4):
+                with warnings.catch_warnings():
+                    warnings.simplefilter('ignore')
+                    exe.run(prog, feed={'x': bad, 'y': yb},
+                            fetch_list=[loss])
+
+
+def test_guard_stays_armed_on_eager_debug_path(tmp_path):
+    """With the profiler's per-op hook active, Executor.run takes the
+    eager debug_step branch — the guard must still skip/rollback there,
+    not silently disarm."""
+    prog, start, loss, w_names = _toy_regression()
+    fluid.anomaly_guard(prog)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    from paddle_tpu.fluid import profiler as prof
+    with fluid.scope_guard(scope):
+        exe.run(start)
+        xb, yb = _batch()
+        exe.run(prog, feed={'x': xb, 'y': yb}, fetch_list=[loss])
+        before = {n: np.asarray(scope.vars[n]) for n in w_names}
+        bad = FaultInjector(seed=3).poison_nan(xb, rate=0.5)
+        with warnings.catch_warnings():
+            warnings.simplefilter('ignore')
+            with prof.profiler(profile_path=str(tmp_path / 'p'),
+                               op_detail=True):
+                exe.run(prog, feed={'x': bad, 'y': yb}, fetch_list=[loss])
+        assert exe.skipped_steps == 1
+        assert not bool(exe.last_step_health['healthy'])
+        for n in w_names:
+            np.testing.assert_array_equal(before[n],
+                                          np.asarray(scope.vars[n]))
+
+
+def test_async_save_failure_warns_even_if_handle_dropped_early(tmp_path):
+    """GC'ing the AsyncSave handle BEFORE the background write fails must
+    not lose the failure notification (the done-callback warns when the
+    handle is already dead)."""
+    import gc
+    import threading
+    import time
+    gate = threading.Event()
+    orig = ck._write_all
+
+    def slow_fail(*a, **kw):
+        gate.wait(10)
+        raise IOError('injected late failure')
+    ck._write_all = slow_fail
+    try:
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter('always')
+            h = ck.save_sharded_async(str(tmp_path / 'ck'),
+                                      _sharded_state(), step=1)
+            state = h._state
+            del h           # handle dropped while the write is in flight
+            gc.collect()
+            gate.set()      # NOW the write fails, with nobody to wait()
+            deadline = time.monotonic() + 10
+            while state['exc'] is None and time.monotonic() < deadline:
+                time.sleep(0.02)
+        assert any('FAILED in the background' in str(w.message)
+                   for w in rec), [str(w.message) for w in rec]
+    finally:
+        ck._write_all = orig
+
+
+def test_guard_off_by_default_keeps_two_tuple_semantics():
+    """Without anomaly_guard the step reports no health and never warns —
+    the guard is strictly opt-in."""
+    prog, start, loss, _ = _toy_regression()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(start)
+        xb, yb = _batch()
+        exe.run(prog, feed={'x': xb, 'y': yb}, fetch_list=[loss])
+        assert exe.last_step_health is None
+        assert exe.skipped_steps == 0
+
+
+# ---------------------------------------------------------------------------
+# checkpoint integrity: CRC detection + fallback to the previous serial
+# ---------------------------------------------------------------------------
+
+def _sharded_state(delta=0.0):
+    return {'w': jnp.asarray(np.arange(64, dtype=np.float32).reshape(8, 8)
+                             + delta),
+            'b': jnp.asarray(np.ones((8,), np.float32) + delta)}
+
+
+def test_truncated_shard_detected_and_previous_serial_restored(tmp_path):
+    base = str(tmp_path)
+    ck.save_sharded(os.path.join(base, 'sharded_1'), _sharded_state(0.0),
+                    step=1)
+    ck.save_sharded(os.path.join(base, 'sharded_2'), _sharded_state(1.0),
+                    step=2)
+    inj = FaultInjector(seed=11)
+    victim = inj.pick_file(os.path.join(base, 'sharded_2'))
+    inj.truncate_file(victim)
+
+    problems = ck.verify_sharded(os.path.join(base, 'sharded_2'))
+    assert problems and 'truncated' in problems[0]
+    assert ck.verify_sharded(os.path.join(base, 'sharded_1')) == []
+
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter('always')
+        got, meta = ck.load_latest_verified(base)
+    assert meta['step'] == 1        # fell back past the torn serial
+    assert any('FAILED verification' in str(w.message) for w in rec)
+    np.testing.assert_array_equal(np.asarray(got['w']),
+                                  np.asarray(_sharded_state(0.0)['w']))
+
+
+def test_same_size_bit_rot_caught_by_crc_only(tmp_path):
+    """Flipping bytes WITHOUT changing the size defeats the bytes check;
+    only the manifest CRC32 catches it."""
+    d = str(tmp_path / 'sharded_1')
+    ck.save_sharded(d, _sharded_state(), step=1)
+    inj = FaultInjector(seed=23)
+    inj.corrupt_file(inj.pick_file(d), n_bytes=4)
+    problems = ck.verify_sharded(d)
+    assert problems and 'CRC32' in problems[0]
+    with pytest.raises(RuntimeError, match='CRC32'):
+        ck.load_sharded(d)
+
+
+def test_trainer_checkpoint_crc_fallback(tmp_path):
+    """fluid.io checkpoints carry a params CRC in meta.json; a corrupted
+    newest serial makes load_checkpoint raise so the resume loop falls
+    back to the previous serial."""
+    prog, start, loss, w_names = _toy_regression()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    d = str(tmp_path)
+    with fluid.scope_guard(scope):
+        exe.run(start)
+        fluid.io.save_checkpoint(exe, d, main_program=prog, step=1)
+        xb, yb = _batch()
+        exe.run(prog, feed={'x': xb, 'y': yb}, fetch_list=[loss])
+        fluid.io.save_checkpoint(exe, d, main_program=prog, step=2)
+    inj = FaultInjector(seed=7)
+    inj.corrupt_file(os.path.join(d, 'checkpoint_2', '__params__.npz'),
+                     n_bytes=8)
+    with fluid.scope_guard(scope):
+        with pytest.raises(RuntimeError, match='corrupt'):
+            fluid.io.load_checkpoint(exe, d, serial=2, main_program=prog)
+        meta = fluid.io.load_checkpoint(exe, d, serial=1, main_program=prog)
+    assert meta['step'] == 1
+
+
+# ---------------------------------------------------------------------------
+# preemption: SIGTERM -> emergency checkpoint -> exact-step resume
+# ---------------------------------------------------------------------------
+
+def _trainer_parts(ckpt_dir):
+    def train_func():
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        pred = fluid.layers.fc(input=x, size=1)
+        return fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+
+    def optimizer_func():
+        return fluid.optimizer.SGD(learning_rate=0.01)
+
+    def make_reader():
+        rng = np.random.RandomState(0)
+        data = [(rng.rand(4).astype('float32'),
+                 rng.rand(1).astype('float32')) for _ in range(16)]
+
+        def r():
+            for d in data:
+                yield d
+        return paddle_tpu.batch(r, batch_size=4)
+
+    cfg = fluid.CheckpointConfig(checkpoint_dir=ckpt_dir, epoch_interval=1,
+                                 step_interval=100)
+    return train_func, optimizer_func, make_reader, cfg
+
+
+def test_sigterm_mid_epoch_emergency_checkpoint_and_exact_resume(tmp_path):
+    ckpt = str(tmp_path)
+    train_func, optimizer_func, make_reader, cfg = _trainer_parts(ckpt)
+
+    crash_at = (1, 2)
+    seen = []
+
+    def handler(ev):
+        if isinstance(ev, fluid.BeginStepEvent):
+            seen.append((ev.epoch, ev.step))
+            if (ev.epoch, ev.step) == crash_at:
+                FaultInjector(seed=0).preempt(signal.SIGTERM)
+
+    t = fluid.Trainer(train_func, optimizer_func, place=fluid.CPUPlace(),
+                      checkpoint_config=cfg)
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter('always')
+        t.train(num_epochs=3, event_handler=handler, reader=make_reader(),
+                feed_order=['x', 'y'])
+    assert t.preempted
+    assert seen[-1] == crash_at      # the in-flight step completed, then exit
+    assert any('emergency checkpoint flushed' in str(w.message)
+               for w in rec)
+    # SIGTERM handler restored after train()
+    assert signal.getsignal(signal.SIGTERM) != t._on_preempt_signal
+    serials = fluid.io.list_checkpoint_serials(ckpt)
+    assert serials, 'emergency checkpoint missing'
+
+    # a FRESH trainer over the same dir resumes at exactly the next step
+    seen2 = []
+
+    def handler2(ev):
+        if isinstance(ev, fluid.BeginStepEvent):
+            seen2.append((ev.epoch, ev.step))
+
+    train_func2, optimizer_func2, make_reader2, cfg2 = _trainer_parts(ckpt)
+    t2 = fluid.Trainer(train_func2, optimizer_func2, place=fluid.CPUPlace(),
+                       checkpoint_config=cfg2)
+    t2.train(num_epochs=3, event_handler=handler2, reader=make_reader2(),
+             feed_order=['x', 'y'])
+    assert seen2[0] == (crash_at[0], crash_at[1] + 1), seen2[:4]
+    assert not t2.preempted
+    # finished cleanly: checkpoints cleaned up
+    assert fluid.io.list_checkpoint_serials(ckpt) == []
+
+
+def test_preemption_while_reader_blocks_flushes_without_extra_step(tmp_path):
+    """SIGTERM landing while the READER is blocked must flush the
+    emergency checkpoint from the between-step state immediately — not
+    after paying for one more (potentially 40s) step."""
+    ckpt = str(tmp_path)
+    train_func, optimizer_func, make_reader, cfg = _trainer_parts(ckpt)
+    t = fluid.Trainer(train_func, optimizer_func, place=fluid.CPUPlace(),
+                      checkpoint_config=cfg)
+    base = make_reader()
+
+    def preempting_reader():
+        for i, b in enumerate(base()):
+            if i == 2:          # "signal" arrives mid-read of batch 2
+                t.request_preemption()
+            yield b
+
+    seen = []
+
+    def handler(ev):
+        if isinstance(ev, fluid.BeginStepEvent):
+            seen.append((ev.epoch, ev.step))
+
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter('always')
+        t.train(num_epochs=2, event_handler=handler,
+                reader=preempting_reader, feed_order=['x', 'y'])
+    assert t.preempted
+    assert seen == [(0, 0), (0, 1)]     # step 2 never ran
+    assert any('emergency checkpoint flushed' in str(w.message)
+               for w in rec)
+    # resume continues at exactly the never-run step
+    seen2 = []
+    train_func2, optimizer_func2, make_reader2, cfg2 = _trainer_parts(ckpt)
+    t2 = fluid.Trainer(train_func2, optimizer_func2, place=fluid.CPUPlace(),
+                       checkpoint_config=cfg2)
+    t2.train(num_epochs=2, event_handler=lambda ev: seen2.append(
+        (ev.epoch, ev.step)) if isinstance(ev, fluid.BeginStepEvent)
+        else None, reader=make_reader2(), feed_order=['x', 'y'])
+    assert seen2[0] == (0, 2), seen2[:4]
+
+
+def test_request_preemption_without_signal(tmp_path):
+    """The programmatic path (worker threads can't bind signals) follows
+    the same finish-step -> flush -> clean-return contract."""
+    ckpt = str(tmp_path)
+    train_func, optimizer_func, make_reader, cfg = _trainer_parts(ckpt)
+    t = fluid.Trainer(train_func, optimizer_func, place=fluid.CPUPlace(),
+                      checkpoint_config=cfg)
+
+    def handler(ev):
+        if isinstance(ev, fluid.BeginStepEvent) and (ev.epoch, ev.step) \
+                == (0, 1):
+            t.request_preemption()
+
+    with warnings.catch_warnings():
+        warnings.simplefilter('ignore')
+        t.train(num_epochs=1, event_handler=handler, reader=make_reader(),
+                feed_order=['x', 'y'])
+    assert t.preempted
+    assert fluid.io.list_checkpoint_serials(ckpt)
+
+
+# ---------------------------------------------------------------------------
+# reader fault tolerance: retry-then-degrade
+# ---------------------------------------------------------------------------
+
+def test_reader_heals_without_duplicates_or_gaps():
+    inj = FaultInjector(seed=13)
+    flaky = inj.flaky_reader(lambda: iter(range(10)), fail_at=4,
+                             fail_times=2)
+    got = list(paddle_tpu.reader.fault_tolerant(
+        flaky, max_retries=3, sleep=lambda d: None)())
+    assert got == list(range(10))
+
+
+def test_reader_degrades_to_skip_with_warning_after_retries():
+    inj = FaultInjector(seed=13)
+    flaky = inj.flaky_reader(lambda: iter(range(10)), fail_at=4,
+                             fail_times=99)
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter('always')
+        got = list(paddle_tpu.reader.fault_tolerant(
+            flaky, max_retries=2, sleep=lambda d: None)())
+    assert got == [0, 1, 2, 3]       # progress kept, stream ended early
+    assert any('degrading to skip' in str(w.message) for w in rec)
+
+
+def test_retry_backoff_is_deterministic_and_deadline_bounded():
+    assert list(retry_mod.backoff_delays(5, seed=42)) \
+        == list(retry_mod.backoff_delays(5, seed=42))
+    inj = FaultInjector(seed=1)
+    always_fails = inj.flaky(lambda: None, fail_times=100)
+    slept = []
+    with pytest.raises(retry_mod.RetryError, match='deadline'):
+        retry_mod.retry_call(always_fails, retries=10, base_delay=1.0,
+                             deadline=0.5, sleep=slept.append)
+    assert not slept                 # first delay already blows the budget
+
+
+def test_download_fetcher_retries_and_md5_gates(tmp_path, monkeypatch):
+    from paddle_tpu.dataset import common
+    import hashlib
+    monkeypatch.setattr(common, 'DATA_HOME', str(tmp_path))
+    payload = b'dataset-bytes'
+    md5 = hashlib.md5(payload).hexdigest()
+    inj = FaultInjector(seed=2)
+
+    def fetch(url, dest):
+        with open(dest, 'wb') as f:
+            f.write(payload)
+
+    flaky_fetch = inj.flaky(fetch, fail_times=2)
+    p = common.download('http://x/y.bin', 'mod', md5, fetcher=flaky_fetch,
+                        _sleep=lambda d: None)
+    assert p and open(p, 'rb').read() == payload
+
+    def bad_fetch(url, dest):
+        with open(dest, 'wb') as f:
+            f.write(b'corrupted')
+
+    with pytest.raises(retry_mod.RetryError):
+        common.download('http://x/z.bin', 'mod', md5, fetcher=bad_fetch,
+                        retries=1, _sleep=lambda d: None)
+    # zero-egress default unchanged: no fetcher -> None, nothing written
+    assert common.download('http://x/w.bin', 'mod', md5) is None
+
+
+# ---------------------------------------------------------------------------
+# beam-form flag (round-5 ADVICE medium)
+# ---------------------------------------------------------------------------
+
+def test_is_beam_form_rejects_uniform_two_level_lod():
+    """2 sources x 3 uniform groups = 6 rows satisfied the old shape
+    heuristic; the explicit beam_cap flag (set only by the beam machinery)
+    now gates the beam path."""
+    from paddle_tpu.fluid.lowering import SeqValue
+    from paddle_tpu.fluid.ops_impl import lod_beam
+    v = SeqValue(jnp.arange(12.).reshape(6, 2), jnp.ones((6,), jnp.int32),
+                 (jnp.full((2,), 3, jnp.int32),))
+    assert not lod_beam.is_beam_form(v)
+    vb = SeqValue(jnp.arange(12.).reshape(6, 2), jnp.ones((6,), jnp.int32),
+                  (jnp.full((2,), 3, jnp.int32),), beam_cap=True)
+    assert lod_beam.is_beam_form(vb)
+    # the flag is static pytree aux: it survives jit and tree_map
+    out = jax.jit(lambda s: jax.tree_util.tree_map(lambda x: x + 1, s))(vb)
+    assert lod_beam.is_beam_form(out)
+
+
+def test_sequence_expand_uniform_lod_takes_ordinary_path():
+    """The op that motivated the ADVICE item: sequence_expand over an
+    ordinary uniform 2-level Y must broadcast over time steps, not run the
+    beam parent-expansion."""
+    from paddle_tpu.fluid.lowering import SeqValue, Ctx
+    from paddle_tpu.fluid.ops_impl.sequence_ops import _sequence_expand
+    x = jnp.arange(6.).reshape(6, 1)
+    y = SeqValue(jnp.zeros((6, 4, 1)), jnp.full((6,), 4, jnp.int32),
+                 (jnp.full((2,), 3, jnp.int32),))
+    out = _sequence_expand({'X': [x], 'Y': [y]}, {},
+                           Ctx(jax.random.key(0)))['Out']
+    # ordinary path: [6, 4, 1] broadcast of x over y's time dim
+    assert out.data.shape == (6, 4, 1)
+    np.testing.assert_allclose(np.asarray(out.data[:, 0, 0]),
+                               np.arange(6.))
+
+
+def test_grow_rows_raises_on_multi_row_per_source_widening():
+    from paddle_tpu.fluid.lowering import ArrayValue
+    with pytest.raises(ValueError, match='one row'):
+        ArrayValue._grow_rows(jnp.zeros((3, 4, 2)), 8, n_sources=2)
+    # one row per source still widens to block starts
+    w = ArrayValue._grow_rows(jnp.ones((3, 2, 2)), 8, n_sources=2)
+    assert w.shape == (3, 8, 2)
+    np.testing.assert_array_equal(np.asarray(w[0, :, 0]),
+                                  [1, 0, 0, 0, 1, 0, 0, 0])

@@ -206,7 +206,19 @@ def np_backtrace(step_ids, step_scores, step_l0s, step_l1s, end_id):
 
 
 def test_backtrace_matches_reference_algorithm():
-    """Two sources, three steps, uneven beams, one source ends early."""
+    """Two sources, three steps, uneven beams, one source ends early.
+
+    INTENTIONAL LoD deviation from the reference (documented here, next
+    to the A/B comparison, and in docs/robustness.md): the reference's
+    Backtrace initializes SentenceVector(beam_size_), so a source pruned
+    below beam_size still contributes beam_size lod[0] entries, the
+    missing ones as zero-length sentences — and its
+    ConvertSentenceVectorToLodTensor then reads scores.front() of those
+    EMPTY sentences under sort_by_score=true, which is undefined
+    behavior. beam_search_decode_arrays instead emits exactly n_hyp live
+    hypotheses per source (lod[0][s] = hypotheses actually alive at the
+    seed step); the np_backtrace oracle below builds the same live-only
+    structure, so the A/B holds on the well-defined subset."""
     B, K, end_id = 2, 2, 10
     # step 0 (init): 1 parent, 1 child per source; tokens = start id 1
     # step 1: parents = step-0 children (1/source); children: 2 for s0,

@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Fault drill: run the seeded fault-injection suite (tests/test_faults.py,
+# marker `faults`) on the CPU platform — the robustness gate for the
+# anomaly guard, checkpoint CRC fallback, preemption/resume round trip,
+# and reader retry-then-degrade. Fast by construction (everything is
+# seeded and sleep-free); anything slow must carry the `slow` marker so
+# this stays a pre-merge check, not a nightly.
+#
+# Usage: tools/fault_drill.sh [extra pytest args]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m pytest -m 'faults and not slow' \
+    -q -p no:cacheprovider "$@" tests/test_faults.py
