@@ -52,9 +52,44 @@ def _budget_left():
     return BUDGET_S - (time.time() - _T0)
 
 
-def _emit(obj):
+_OBS = []
+
+
+def _obs():
+    """paddle_tpu.obs, loaded standalone through tools/obs_report.py's
+    loader (no paddle_tpu/jax import in the parent process — the parent
+    deliberately never touches jax so a hung tunnel can't wedge it).
+    None when loading fails; cached after the first call."""
+    if not _OBS:
+        mod = None
+        try:
+            import importlib.util
+            here = os.path.dirname(os.path.abspath(__file__))
+            spec = importlib.util.spec_from_file_location(
+                '_bench_obs_report',
+                os.path.join(here, 'tools', 'obs_report.py'))
+            m = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(m)
+            mod = m.load_obs()
+        except Exception as e:
+            _log('obs unavailable: %r' % e)
+        _OBS.append(mod)
+    return _OBS[0]
+
+
+def _emit(obj, mirror=True):
+    """Print one metric line; with PADDLE_TPU_OBS_DIR set, mirror it into
+    the structured run log as a bench.metric event — BENCH_*.json
+    trajectories and run logs share one JSONL event schema instead of
+    being two dialects. mirror=False for lines merely relayed from a
+    phase child (the child already recorded them in its own run log)."""
     print(json.dumps(obj))
     sys.stdout.flush()
+    if mirror and os.environ.get('PADDLE_TPU_OBS_DIR'):
+        obs = _obs()
+        if obs is not None:
+            fields = {k: v for k, v in obj.items() if k != 'metrics'}
+            obs.event('bench.metric', **fields)
 
 
 def _log(msg):
@@ -455,7 +490,7 @@ def _run_phase_subprocess(phase, platform, timeout_s, metrics, seen_names):
                 metrics.append(obj)
             if obj.get('metric'):
                 seen_names.add(obj['metric'])
-            _emit(obj)
+            _emit(obj, mirror=False)  # the child already logged it
 
     th = threading.Thread(target=pump, daemon=True)
     th.start()

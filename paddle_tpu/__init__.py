@@ -9,13 +9,15 @@ over the ICI mesh rather than NCCL/pserver.
 from .version import full_version as __version__  # noqa: E402
 from .version import commit as __git_commit__  # noqa: E402
 
+from . import obs  # noqa: F401  (stdlib-only; must precede fluid, which
+#                                  instruments its hot paths through it)
 from . import fluid  # noqa: F401
 from . import reader  # noqa: F401
 from . import dataset  # noqa: F401
 from . import compat  # noqa: F401
 from .batch import batch  # noqa: F401
 
-__all__ = ['fluid', 'reader', 'dataset', 'compat', 'batch',
+__all__ = ['fluid', 'obs', 'reader', 'dataset', 'compat', 'batch',
            'install_as_paddle']
 
 

@@ -21,6 +21,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import obs
 from . import core
 from . import lowering
 from . import ops_impl  # noqa: F401  (registers all rules)
@@ -718,6 +719,19 @@ def _nan_inf_hook(i, op, dt, env):
                         (v.name, i, op.type))
 
 
+# Process-wide executor telemetry (docs/observability.md). Shared,
+# UNLABELED instruments: per-executor labels would grow the registry
+# without bound under executor churn (tests, notebooks); the
+# per-instance view lives in plain ints behind exe.cache_stats.
+_C_HITS = obs.counter('executor.cache.hits')
+_C_MISSES = obs.counter('executor.cache.misses')
+_C_EVICTIONS = obs.counter('executor.cache.evictions')
+_C_FEED_BYTES = obs.counter('executor.feed.bytes')
+_G_LAST_COMPILE = obs.gauge('executor.last_compile.seconds')
+_C_SKIPPED = obs.counter('anomaly.skipped_steps')
+_G_GRAD_NORM = obs.gauge('anomaly.grad_norm')
+
+
 class Executor(object):
     """Parity: reference python/paddle/fluid/executor.py:256."""
 
@@ -733,6 +747,13 @@ class Executor(object):
         self.last_step_health = None
         self.skipped_steps = 0
         self._consecutive_skips = 0
+        # per-instance compile-cache stats (process-wide aggregates go to
+        # the registry counters above)
+        self._cache_hits = 0
+        self._cache_misses = 0
+        self._cache_evictions = 0
+        self._last_compile_s = None
+        self._last_cache_lookup = None   # {'outcome', 'key', 'entries'}
 
     def _device(self):
         return self.place.jax_device()
@@ -991,18 +1012,46 @@ class Executor(object):
         key = (program._uid, program._version, feed_sig, tuple(fetch_names),
                persist_in, amp, bool(getattr(program, '_use_remat', False)),
                shard_sig, dist_mesh, guard)
+        # short stable-within-process id naming this compiled module in
+        # telemetry (step spans, compiled_op_table's header)
+        key_id = '%08x' % (hash(key) & 0xFFFFFFFF)
         compiled = self._cache.get(key) if use_program_cache else None
         if compiled is None:
+            self._cache_misses += 1
+            _C_MISSES.inc()
             # place is None under ParallelExecutor (mesh placement via
             # shardings); the mesh devices set the platform then
             plat = (self._device().platform if self.place is not None
                     else jax.devices()[0].platform)
-            compiled = _CompiledStep(program, block, list(feed_vals), fetch_names,
-                                     persist_in, amp=amp, platform=plat,
-                                     persist_shardings=persist_shardings,
-                                     mesh=dist_mesh, guard=guard)
+            # the Program -> jittable-step build (op walk, sparse plan,
+            # pipeline region checks); the XLA compile itself happens on
+            # the first call and is timed as executor.compile in run()
+            with obs.span('executor.lowering', key=key_id):
+                compiled = _CompiledStep(program, block, list(feed_vals),
+                                         fetch_names, persist_in, amp=amp,
+                                         platform=plat,
+                                         persist_shardings=persist_shardings,
+                                         mesh=dist_mesh, guard=guard)
             if use_program_cache:
                 self._cache[key] = compiled
+            outcome = 'miss'
+        else:
+            self._cache_hits += 1
+            _C_HITS.inc()
+            outcome = 'hit'
+        self._last_cache_lookup = {'outcome': outcome, 'key': key_id,
+                                   'entries': len(self._cache)}
+        # feed-transfer accounting: nbytes is metadata only (no device
+        # sync); SeqValues carry their dense payload + length vectors
+        fb = 0
+        for dv in feed_vals.values():
+            if isinstance(dv, SeqValue):
+                fb += int(getattr(dv.data, 'nbytes', 0))
+                fb += int(getattr(dv.lengths, 'nbytes', 0))
+            else:
+                fb += int(getattr(dv, 'nbytes', 0))
+        _C_FEED_BYTES.inc(fb)
+        self._last_feed_bytes = fb
 
         persist = {n: scope._chain_get(n) for n in compiled.persist_in}
         return compiled, feed_vals, persist
@@ -1025,45 +1074,74 @@ class Executor(object):
         if scope is None:
             scope = global_scope()
 
-        compiled, feed_vals, persist = self._prepare(
-            program, feed, fetch_list, scope,
-            use_program_cache=use_program_cache)
-        self._run_counter += 1
-        rng = jax.random.key(np.uint32(
-            ((program.random_seed or 0) * 2654435761 + self._run_counter)
-            % (1 << 32)))
-        from . import debugger as _dbg
-        from . import profiler as _prof
-        check = _dbg.nan_inf_check_active()
-        op_hook = _prof.op_event_hook()
-        if check or op_hook is not None:
-            fetches, new_persist, health = compiled.debug_step(
-                persist, feed_vals, rng, check_nan_inf=check, on_op=op_hook)
-        else:
-            fetches, new_persist, health = compiled(persist, feed_vals, rng)
-        for n, v in new_persist.items():
-            scope._chain_set(n, v)
-        if health is not None:
-            self._observe_health(program, health)
-
-        fetch_f32 = bool(getattr(program, '_fetch_f32', False))
-
-        def _cast_back(x):
-            # Float16Transpiler contract: users keep fetching float32
-            if fetch_f32 and hasattr(x, 'dtype') and str(x.dtype) == 'bfloat16':
-                return x.astype(jnp.float32)
-            return x
-
-        out = []
-        for v in fetches:
-            if isinstance(v, SeqValue):
-                from .lod_tensor import LoDTensor
-                lt = LoDTensor.from_seq_value(
-                    SeqValue(_cast_back(v.data), v.lengths, v.outer_lengths))
-                out.append(np.asarray(lt.data) if return_numpy else lt)
+        # Telemetry (docs/observability.md): the step span covers the
+        # whole run — prepare, device dispatch, fetch sync. When
+        # observability is off this is two perf_counter calls and an
+        # in-memory histogram record; no file IO, no device syncs.
+        with obs.span('executor.step') as step_sp:
+            compiled, feed_vals, persist = self._prepare(
+                program, feed, fetch_list, scope,
+                use_program_cache=use_program_cache)
+            self._run_counter += 1
+            look = self._last_cache_lookup or {}
+            step_sp.fields.update(run=self._run_counter,
+                                  cache=look.get('outcome'),
+                                  key=look.get('key'),
+                                  feed_bytes=self._last_feed_bytes)
+            rng = jax.random.key(np.uint32(
+                ((program.random_seed or 0) * 2654435761 + self._run_counter)
+                % (1 << 32)))
+            from . import debugger as _dbg
+            from . import profiler as _prof
+            check = _dbg.nan_inf_check_active()
+            op_hook = _prof.op_event_hook()
+            if check or op_hook is not None:
+                fetches, new_persist, health = compiled.debug_step(
+                    persist, feed_vals, rng, check_nan_inf=check,
+                    on_op=op_hook)
+            elif not getattr(compiled, '_obs_compiled', False):
+                # first jitted call of this cache entry: jax traces and
+                # XLA-compiles synchronously inside it, so this span IS
+                # the compile wall time (plus one step's dispatch)
+                with obs.span('executor.compile',
+                              key=look.get('key')) as csp:
+                    fetches, new_persist, health = compiled(
+                        persist, feed_vals, rng)
+                compiled._obs_compiled = True
+                step_sp.fields['compiled'] = True
+                self._last_compile_s = csp.seconds
+                _G_LAST_COMPILE.set(csp.seconds)
             else:
-                v = _cast_back(v)
-                out.append(np.asarray(v) if return_numpy else v)
+                fetches, new_persist, health = compiled(
+                    persist, feed_vals, rng)
+            for n, v in new_persist.items():
+                scope._chain_set(n, v)
+            if health is not None:
+                self._observe_health(program, health)
+
+            fetch_f32 = bool(getattr(program, '_fetch_f32', False))
+
+            def _cast_back(x):
+                # Float16Transpiler contract: users keep fetching float32
+                if fetch_f32 and hasattr(x, 'dtype') and str(x.dtype) == 'bfloat16':
+                    return x.astype(jnp.float32)
+                return x
+
+            # fetch conversion is where the device-to-host sync happens
+            # (np.asarray blocks on the step's outputs)
+            with obs.span('executor.fetch'):
+                out = []
+                for v in fetches:
+                    if isinstance(v, SeqValue):
+                        from .lod_tensor import LoDTensor
+                        lt = LoDTensor.from_seq_value(
+                            SeqValue(_cast_back(v.data), v.lengths,
+                                     v.outer_lengths))
+                        out.append(np.asarray(lt.data) if return_numpy
+                                   else lt)
+                    else:
+                        v = _cast_back(v)
+                        out.append(np.asarray(v) if return_numpy else v)
         return out
 
     def _observe_health(self, program, health):
@@ -1072,11 +1150,20 @@ class Executor(object):
         (max_consecutive_skips) to a FloatingPointError."""
         h = {k: np.asarray(v) for k, v in health.items()}
         self.last_step_health = h
+        # telemetry from the health vector ALREADY on the host — reusing
+        # it costs no extra device sync (the guard's design invariant)
+        _G_GRAD_NORM.set(float(h['grad_norm']))
         if bool(h['healthy']):
             self._consecutive_skips = 0
             return
         self.skipped_steps += 1
         self._consecutive_skips += 1
+        _C_SKIPPED.inc()
+        obs.event('anomaly.skip', run=self._run_counter,
+                  grad_norm=float(h['grad_norm']),
+                  loss_finite=bool(h['loss_finite']),
+                  grads_finite=bool(h['grads_finite']),
+                  consecutive=self._consecutive_skips)
         import warnings
         warnings.warn(
             'anomaly guard: step %d skipped (loss_finite=%s '
@@ -1115,10 +1202,25 @@ class Executor(object):
             return lowered.compile().as_text()
         return lowered.as_text()
 
+    @property
+    def cache_stats(self):
+        """THIS executor's compile-cache statistics
+        (docs/observability.md): hits/misses/entries, evictions (close()
+        drops), and the last XLA compile's wall seconds (None until
+        something compiled). Process-wide aggregates of the same series
+        live in the registry (executor.cache.*)."""
+        return {'hits': self._cache_hits,
+                'misses': self._cache_misses,
+                'entries': len(self._cache),
+                'evictions': self._cache_evictions,
+                'last_compile_seconds': self._last_compile_s}
+
     def close(self):
         """Release compiled executables and drop cached jit state
         (reference executor.py:close tears down the C++ scope/comm; here
         the compiled-step cache holds the device buffers XLA pinned)."""
+        self._cache_evictions += len(self._cache)
+        _C_EVICTIONS.inc(len(self._cache))
         for step in self._cache.values():
             fn = getattr(step, '_jitted', None)
             if hasattr(fn, 'clear_cache'):

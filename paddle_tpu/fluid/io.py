@@ -12,6 +12,7 @@ import os
 
 import numpy as np
 
+from .. import obs
 from .framework import Program, Parameter, Variable, default_main_program
 from .executor import global_scope
 
@@ -162,7 +163,8 @@ def save_checkpoint(executor, checkpoint_dir, trainer_id=0, main_program=None,
     trainer args like {'epoch_id', 'step_id'} (reference io.py checkpoint
     utilities / trainer.py:641 save_checkpoint)."""
     serial_dir = os.path.join(checkpoint_dir, 'checkpoint_%d' % step)
-    params_path = save_persistables(executor, serial_dir, main_program)
+    with obs.span('checkpoint.save', serial=step):
+        params_path = save_persistables(executor, serial_dir, main_program)
     # meta written atomically and LAST: its presence marks a complete
     # snapshot (reference writes a _SUCCESS marker, trainer.py:1190). It
     # records the params file's size AND content CRC32, so load_checkpoint
@@ -209,27 +211,33 @@ def load_checkpoint(executor, checkpoint_dir, serial=None, main_program=None):
         meta = json.load(f)
     # integrity gate BEFORE any value reaches the scope: a truncated or
     # bit-rotted params file raises here (the Trainer's resume loop
-    # catches it and falls back to the previous serial, loudly)
+    # catches it and falls back to the previous serial, loudly). The
+    # verify duration and outcome land in checkpoint.verify telemetry.
     if meta.get('params_crc32') is not None:
-        params_path = os.path.join(serial_dir,
-                                   meta.get('params_file') or _PARAMS_FILE)
-        if not os.path.exists(params_path):
-            raise RuntimeError(
-                'checkpoint serial %d: params file %r is missing'
-                % (serial, params_path))
-        want_bytes = meta.get('params_bytes')
-        if want_bytes is not None \
-                and os.path.getsize(params_path) != want_bytes:
-            raise RuntimeError(
-                'checkpoint serial %d is corrupt: params file %r holds %d '
-                'bytes, meta recorded %d (truncated write?)'
-                % (serial, params_path, os.path.getsize(params_path),
-                   want_bytes))
-        got = _file_crc32(params_path)
-        if got != meta['params_crc32']:
-            raise RuntimeError(
-                'checkpoint serial %d is corrupt: params CRC32 %08x does '
-                'not match the meta record %08x'
-                % (serial, got, meta['params_crc32']))
+        with obs.span('checkpoint.verify', serial=serial):
+            params_path = os.path.join(
+                serial_dir, meta.get('params_file') or _PARAMS_FILE)
+            if not os.path.exists(params_path):
+                obs.counter('checkpoint.crc_verify', outcome='fail').inc()
+                raise RuntimeError(
+                    'checkpoint serial %d: params file %r is missing'
+                    % (serial, params_path))
+            want_bytes = meta.get('params_bytes')
+            if want_bytes is not None \
+                    and os.path.getsize(params_path) != want_bytes:
+                obs.counter('checkpoint.crc_verify', outcome='fail').inc()
+                raise RuntimeError(
+                    'checkpoint serial %d is corrupt: params file %r '
+                    'holds %d bytes, meta recorded %d (truncated write?)'
+                    % (serial, params_path, os.path.getsize(params_path),
+                       want_bytes))
+            got = _file_crc32(params_path)
+            if got != meta['params_crc32']:
+                obs.counter('checkpoint.crc_verify', outcome='fail').inc()
+                raise RuntimeError(
+                    'checkpoint serial %d is corrupt: params CRC32 %08x '
+                    'does not match the meta record %08x'
+                    % (serial, got, meta['params_crc32']))
+            obs.counter('checkpoint.crc_verify', outcome='ok').inc()
     load_persistables(executor, serial_dir, main_program)
     return meta

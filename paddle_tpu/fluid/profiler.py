@@ -22,8 +22,10 @@ _state = {'active': False, 'trace_dir': None, 't0': None,
 
 @contextlib.contextmanager
 def cuda_profiler(output_file, output_mode=None, config=None):
-    """Compat shim (no CUDA on TPU): behaves like profiler()."""
-    with profiler('All', 'default', output_file):
+    """Compat shim (no CUDA on TPU): behaves like profiler(), with the
+    report explicitly routed to `output_file` (the reference wrote the
+    nvprof capture there; here it receives the profiler report)."""
+    with profiler('All', 'default', profile_path=output_file):
         yield
 
 
@@ -99,8 +101,16 @@ def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
     try:
         with open(profile_path, 'w') as f:
             f.write(report)
-    except Exception:
-        pass
+    except Exception as e:
+        # losing the report file silently meant profiled runs "vanished"
+        # when profile_path pointed at an unwritable location; the report
+        # still prints below, so warn-and-continue is the right severity
+        import warnings
+        warnings.warn(
+            'profiler report could not be written to %r (%s: %s); the '
+            'report was only printed to stdout'
+            % (profile_path, type(e).__name__, e), RuntimeWarning,
+            stacklevel=2)
     print(report)
     _state['active'] = False
     _state['op_detail'] = False
@@ -149,6 +159,10 @@ def compiled_op_table(exe, program=None, feed=None, fetch_list=None,
 
     Returns (table_text, rows) where rows maps op_type ->
     {'sites': distinct program ops, 'instructions': HLO instruction count}.
+    The table is headed by the executor's compile-cache view (exe.cache_stats
+    + the lookup this call just made), so the output states WHICH cached
+    module it attributed — two tables from different feed signatures are
+    different modules, and the key makes that visible.
     """
     text = exe.lowered_hlo(program, feed, fetch_list, optimized=optimized)
     rows = {}
@@ -167,7 +181,15 @@ def compiled_op_table(exe, program=None, feed=None, fetch_list=None,
         r['sites'] = len(r['sites'])
     order = sorted(rows.items(),
                    key=lambda kv: kv[1].get(sorted_key, 0), reverse=True)
-    lines = ['%-28s %8s %14s' % ('Fluid op', 'Sites', 'HLO instrs')]
+    lines = []
+    look = getattr(exe, '_last_cache_lookup', None)
+    stats = getattr(exe, 'cache_stats', None)
+    if look is not None and stats is not None:
+        lines.append(
+            'compiled module: cache %s key=%s | entries=%d hits=%d '
+            'misses=%d' % (look['outcome'], look['key'], stats['entries'],
+                           stats['hits'], stats['misses']))
+    lines.append('%-28s %8s %14s' % ('Fluid op', 'Sites', 'HLO instrs'))
     for name, r in order:
         lines.append('%-28s %8d %14d' % (name, r['sites'],
                                          r['instructions']))
@@ -183,5 +205,11 @@ def profiler(state='All', sorted_key='default', profile_path='/tmp/profile',
     Executor.run to eager op-by-op dispatch, which is much slower and is a
     different program than the fused step."""
     start_profiler(state, op_detail=op_detail)
-    yield
-    stop_profiler(sorted_key, profile_path)
+    try:
+        yield
+    finally:
+        # stop even when the profiled body raises: the partial report is
+        # exactly what a crashed run needs, and a still-armed profiler
+        # would silently force every later Executor.run onto the eager
+        # op-by-op path
+        stop_profiler(sorted_key, profile_path)

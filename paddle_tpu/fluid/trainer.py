@@ -16,6 +16,7 @@ import contextlib
 import os
 import re
 
+from .. import obs
 from . import core
 from . import framework
 from . import io
@@ -197,11 +198,15 @@ class Trainer(object):
         for serial in io.list_checkpoint_serials(cfg.checkpoint_dir)[::-1]:
             try:
                 with self._prog_and_scope_guard():
-                    meta = io.load_checkpoint(self.exe, cfg.checkpoint_dir,
-                                              serial=serial,
-                                              main_program=self.train_program)
+                    with obs.span('trainer.checkpoint.load', serial=serial):
+                        meta = io.load_checkpoint(
+                            self.exe, cfg.checkpoint_dir, serial=serial,
+                            main_program=self.train_program)
             except (RuntimeError, OSError, ValueError, KeyError) as e:
                 import warnings
+                obs.counter('trainer.resume.fallbacks').inc()
+                obs.event('trainer.resume.fallback', serial=serial,
+                          error='%s: %s' % (type(e).__name__, e))
                 warnings.warn(
                     'checkpoint serial %d in %r failed to load (%s) — '
                     'falling back to the previous serial'
@@ -220,13 +225,17 @@ class Trainer(object):
                 and step_id % cfg.step_interval == 0:
             self._serial += 1
             with self._prog_and_scope_guard():
-                io.save_checkpoint(
-                    self.exe, cfg.checkpoint_dir,
-                    trainer_id=self.trainer_id,
-                    main_program=self.train_program,
-                    step=self._serial,
-                    trainer_args={'epoch_id': epoch_id, 'step_id': step_id},
-                    max_num_checkpoints=cfg.max_num_checkpoints)
+                with obs.span('trainer.checkpoint.save',
+                              serial=self._serial, epoch=epoch_id,
+                              step=step_id):
+                    io.save_checkpoint(
+                        self.exe, cfg.checkpoint_dir,
+                        trainer_id=self.trainer_id,
+                        main_program=self.train_program,
+                        step=self._serial,
+                        trainer_args={'epoch_id': epoch_id,
+                                      'step_id': step_id},
+                        max_num_checkpoints=cfg.max_num_checkpoints)
 
     def _save_emergency_checkpoint(self, epoch_id, step_id):
         """Preemption flush: unconditional (interval-ignoring) snapshot
@@ -239,14 +248,17 @@ class Trainer(object):
             return None
         self._serial += 1
         with self._prog_and_scope_guard():
-            return io.save_checkpoint(
-                self.exe, cfg.checkpoint_dir,
-                trainer_id=self.trainer_id,
-                main_program=self.train_program,
-                step=self._serial,
-                trainer_args={'epoch_id': epoch_id, 'step_id': step_id,
-                              'preempted': True},
-                max_num_checkpoints=cfg.max_num_checkpoints)
+            with obs.span('trainer.checkpoint.emergency_flush',
+                          serial=self._serial, epoch=epoch_id,
+                          step=step_id):
+                return io.save_checkpoint(
+                    self.exe, cfg.checkpoint_dir,
+                    trainer_id=self.trainer_id,
+                    main_program=self.train_program,
+                    step=self._serial,
+                    trainer_args={'epoch_id': epoch_id, 'step_id': step_id,
+                                  'preempted': True},
+                    max_num_checkpoints=cfg.max_num_checkpoints)
 
     # -- preemption -------------------------------------------------------
 
@@ -299,6 +311,12 @@ class Trainer(object):
             self._save_emergency_checkpoint(*last_done)
             saved = True
         self.preempted = True
+        obs.counter('trainer.preemptions').inc()
+        obs.event('trainer.preempted',
+                  signum=self._preempt_signum or 'requested',
+                  epoch=last_done[0] if last_done else None,
+                  step=last_done[1] if last_done else None,
+                  emergency_checkpoint=saved)
         where = ('at epoch %d step %d' % last_done if last_done is not None
                  else 'before any step completed')
         if saved:
@@ -434,12 +452,20 @@ class Trainer(object):
                     begin = BeginStepEvent(epoch_id, step_id)
                     event_handler(begin)
                     want = fetch if begin.fetch_metrics else []
-                    if is_pe:
-                        metrics = exe.run(want, feed=feeder.feed(data))
-                    else:
-                        metrics = exe.run(program=self.train_program,
-                                          feed=feeder.feed(data),
-                                          fetch_list=want)
+                    # trainer.step nests the executor.step span and, when
+                    # observability is on, marks the XLA trace with
+                    # StepTraceAnnotation so Perfetto groups device
+                    # activity per training step
+                    self._steps_run = getattr(self, '_steps_run', 0) + 1
+                    with obs.span('trainer.step',
+                                  step_num=self._steps_run,
+                                  epoch=epoch_id, step=step_id):
+                        if is_pe:
+                            metrics = exe.run(want, feed=feeder.feed(data))
+                        else:
+                            metrics = exe.run(program=self.train_program,
+                                              feed=feeder.feed(data),
+                                              fetch_list=want)
                     last_done = (epoch_id, step_id)
                     if self._preempt_requested:
                         # the step above COMPLETED (run() synchronizes on

@@ -1,0 +1,263 @@
+"""paddle_tpu.obs — the runtime telemetry layer.
+
+Three pieces (docs/observability.md has the full catalog):
+
+  * a process-wide METRICS REGISTRY (obs.metrics): counters, gauges,
+    fixed-bucket histograms. Always armed — an increment is a lock and an
+    add, cheap enough for the executor hot path — so `exe.cache_stats`
+    and the fault-drill assertions work with no environment set up.
+  * a STRUCTURED RUN LOG: JSONL, one event per record, written under
+    $PADDLE_TPU_OBS_DIR (or obs.enable(dir)). Created lazily on the first
+    record; when observability is disabled there is NO file IO at all.
+  * a SPAN API: `with obs.span("executor.step"): ...` nests via a
+    thread-local stack, records wall time into the registry histogram
+    `<name>.seconds`, appends a span record to the run log, and forwards
+    to jax.profiler.TraceAnnotation (StepTraceAnnotation when step_num is
+    given) so the same names appear in Perfetto/XLA traces.
+
+Disabled-mode contract (the default): spans still time into the in-memory
+registry, but no file is written, no event is recorded, and jax is never
+imported — this module is stdlib-only and only *reuses* jax.profiler when
+the host program already imported jax AND observability is on. Tests load
+the package standalone (importlib, no paddle_tpu parent) to enforce that.
+"""
+import itertools
+import os
+import sys
+import threading
+import time
+
+from . import metrics  # noqa: F401
+from . import report  # noqa: F401
+from . import runlog as _runlog
+from .metrics import REGISTRY, counter, gauge, histogram  # noqa: F401
+
+__all__ = ['metrics', 'report', 'REGISTRY', 'counter', 'gauge', 'histogram',
+           'enabled', 'obs_dir', 'enable', 'disable', 'event', 'span',
+           'run_log_path', 'ENV_DIR']
+
+ENV_DIR = 'PADDLE_TPU_OBS_DIR'
+# Optional: pin the run-log to an EXACT file path instead of a fresh
+# run-<stamp>-<pid>.jsonl — how tools/perf_sweep.sh collects one sweep's
+# events (its own + every child bench's) into a single run file.
+ENV_RUN_FILE = 'PADDLE_TPU_OBS_RUN_FILE'
+
+_state = {
+    'override': None,      # None = follow env; (True, dir) / (False, None)
+    'runlog': None,
+    'runlog_dir': None,
+    'failed_dir': None,    # dir whose run-log creation failed (warn once)
+    'lock': threading.RLock(),
+}
+_span_ids = itertools.count(1)
+_local = threading.local()
+# span-name -> registry histogram, so the per-span fast path skips the
+# registry's label-normalizing lookup (hot: 3 spans per executor step)
+_span_hists = {}
+
+
+def obs_dir():
+    """The active observability directory, or None when disabled.
+    obs.enable()/disable() override the PADDLE_TPU_OBS_DIR environment."""
+    ov = _state['override']
+    if ov is not None:
+        return ov[1] if ov[0] else None
+    return os.environ.get(ENV_DIR) or None
+
+
+def enabled():
+    return obs_dir() is not None
+
+
+def enable(dir_path):
+    """Force observability on, writing a fresh run log under dir_path
+    (tests and notebooks; production uses the environment variable)."""
+    with _state['lock']:
+        _close_runlog_locked()
+        _state['override'] = (True, str(dir_path))
+
+
+def disable():
+    """Force observability off regardless of the environment; closes the
+    current run log. Call enable()/disable(None-reset) via _reset() in
+    tests to return to env-driven behavior."""
+    with _state['lock']:
+        _close_runlog_locked()
+        _state['override'] = (False, None)
+
+
+def _reset():
+    """Back to environment-driven state with no open run log (tests)."""
+    with _state['lock']:
+        _close_runlog_locked()
+        _state['override'] = None
+        _span_hists.clear()   # drop handles detached by REGISTRY.reset()
+
+
+def _close_runlog_locked():
+    rl = _state['runlog']
+    if rl is not None:
+        rl.close()
+    _state['runlog'] = None
+    _state['runlog_dir'] = None
+    _state['failed_dir'] = None
+
+
+def _run_log():
+    """The current run's RunLog, created lazily; None when disabled. A
+    change of directory (enable() with a new path, env flip) starts a new
+    run file. A directory whose run log cannot be created (unwritable
+    path, full disk) is warned about ONCE and then skipped — telemetry
+    must never take down the step it observes."""
+    d = obs_dir()
+    if d is None:
+        return None
+    rl = _state['runlog']
+    if rl is not None and _state['runlog_dir'] == d:
+        return rl
+    if _state['failed_dir'] == d:
+        return None
+    with _state['lock']:
+        rl = _state['runlog']
+        if rl is None or _state['runlog_dir'] != d:
+            _close_runlog_locked()
+            # the env pin only applies in env-driven mode: an explicit
+            # obs.enable(dir) (tests isolating a run) must not be
+            # silently redirected into a leaked shared run file
+            pinned = (os.environ.get(ENV_RUN_FILE)
+                      if _state['override'] is None else None)
+            path = pinned or _runlog.new_run_path(d)
+            try:
+                rl = _runlog.RunLog(path)
+            except Exception as e:
+                _state['failed_dir'] = d
+                import warnings
+                warnings.warn(
+                    'obs run log unavailable under %r (%s: %s); telemetry '
+                    'file output disabled until the directory changes'
+                    % (d, type(e).__name__, e), RuntimeWarning)
+                return None
+            _state['runlog'] = rl
+            _state['runlog_dir'] = d
+    return rl
+
+
+def run_log_path():
+    """Path of the current run's JSONL file (None when disabled or when
+    nothing has been recorded yet — the file is created lazily)."""
+    rl = _state['runlog']
+    return rl.path if rl is not None and _state['runlog_dir'] == obs_dir() \
+        else None
+
+
+def _span_stack():
+    st = getattr(_local, 'stack', None)
+    if st is None:
+        st = _local.stack = []
+    return st
+
+
+def current_span_id():
+    st = getattr(_local, 'stack', None)
+    return st[-1].id if st else None
+
+
+def event(name, **fields):
+    """Record a one-shot event (no-op when disabled). Returns the record
+    dict when written, else None — handy for tests."""
+    rl = _run_log()
+    if rl is None:
+        return None
+    rec = {'ts': time.monotonic(), 'kind': 'event', 'name': name,
+           'span': current_span_id(), 'fields': fields}
+    rl.write(rec)
+    return rec
+
+
+class Span(object):
+    """Context manager created by obs.span(). After __exit__, `.seconds`
+    holds the wall time. `.fields` may be mutated inside the span — the
+    run-log record is emitted at exit."""
+    __slots__ = ('name', 'fields', 'step_num', 'id', 'parent', 't0',
+                 'seconds', '_trace', '_entered')
+
+    def __init__(self, name, step_num=None, **fields):
+        self.name = name
+        self.fields = fields
+        self.step_num = step_num
+        self.id = None
+        self.parent = None
+        self.t0 = None
+        self.seconds = None
+        self._trace = None
+        self._entered = False
+
+    def __enter__(self):
+        st = _span_stack()
+        self.parent = st[-1].id if st else None
+        self.id = next(_span_ids)
+        st.append(self)
+        self._entered = True
+        if enabled():
+            self._enter_trace()
+        self.t0 = time.perf_counter()
+        return self
+
+    def _enter_trace(self):
+        # Forward to the XLA trace ONLY via an already-imported jax: the
+        # disabled-mode (and jax-less) contract is "no jax import", and
+        # sys.modules.get never triggers one.
+        jaxmod = sys.modules.get('jax')
+        if jaxmod is None:
+            return
+        try:
+            prof = jaxmod.profiler
+            if self.step_num is not None:
+                self._trace = prof.StepTraceAnnotation(
+                    self.name, step_num=int(self.step_num))
+            else:
+                self._trace = prof.TraceAnnotation(self.name)
+            self._trace.__enter__()
+        except Exception:
+            self._trace = None
+
+    def __exit__(self, exc_type, exc, tb):
+        self.seconds = time.perf_counter() - self.t0
+        if self._trace is not None:
+            try:
+                self._trace.__exit__(exc_type, exc, tb)
+            except Exception:
+                pass
+            self._trace = None
+        st = _span_stack()
+        if self._entered and st and st[-1] is self:
+            st.pop()
+        elif self._entered and self in st:   # mis-nested exit; stay sane
+            st.remove(self)
+        self._entered = False
+        h = _span_hists.get(self.name)
+        if h is None:
+            h = REGISTRY.histogram(self.name + '.seconds')
+            _span_hists[self.name] = h
+        h.observe(self.seconds)
+        rl = _run_log()
+        if rl is not None:
+            fields = dict(self.fields)
+            if exc_type is not None:
+                fields['error'] = '%s: %s' % (exc_type.__name__, exc)
+            if self.step_num is not None:
+                fields.setdefault('step_num', self.step_num)
+            rl.write({'ts': time.monotonic(), 'kind': 'span',
+                      'name': self.name, 'span': self.id,
+                      'parent': self.parent,
+                      'dur_s': self.seconds, 'fields': fields})
+        return False
+
+
+def span(name, step_num=None, **fields):
+    """Open a nested wall-time span. Always records `<name>.seconds` into
+    the registry histogram; when observability is enabled it also appends
+    a span record to the run log and brackets the region with
+    jax.profiler.TraceAnnotation (StepTraceAnnotation when `step_num` is
+    given), so Perfetto shows the same names the run log does."""
+    return Span(name, step_num=step_num, **fields)

@@ -1,0 +1,303 @@
+"""Run-log analysis: load/validate JSONL event records and print the
+diagnosis summary tools/obs_report.py serves (step-time percentiles,
+compile breakdown, cache hit ratio, anomaly skips, retries, reader
+degradation, checkpoint timeline) — a run is explainable without
+TensorBoard or a Perfetto trace.
+
+stdlib-only (see metrics.py for why).
+"""
+import json
+import os
+
+__all__ = ['validate_record', 'load_events', 'collect_events',
+           'summarize', 'latest_run', 'percentile_exact']
+
+_KINDS = ('meta', 'event', 'span')
+
+
+def validate_record(obj):
+    """None when `obj` is a well-formed event record, else a short reason
+    string (the --check contract)."""
+    if not isinstance(obj, dict):
+        return 'record is not a JSON object'
+    ts = obj.get('ts')
+    if not isinstance(ts, (int, float)) or isinstance(ts, bool):
+        return 'missing/non-numeric "ts"'
+    name = obj.get('name')
+    if not isinstance(name, str) or not name:
+        return 'missing/empty "name"'
+    kind = obj.get('kind')
+    if kind not in _KINDS:
+        return 'bad "kind" %r (want one of %s)' % (kind, '/'.join(_KINDS))
+    if 'fields' in obj and not isinstance(obj['fields'], dict):
+        return '"fields" is not an object'
+    if kind == 'span':
+        dur = obj.get('dur_s')
+        if not isinstance(dur, (int, float)) or isinstance(dur, bool):
+            return 'span record missing numeric "dur_s"'
+    sp = obj.get('span')
+    if sp is not None and not isinstance(sp, int):
+        return '"span" is neither null nor an integer id'
+    return None
+
+
+def load_events(path):
+    """Parse one JSONL file -> (events, errors) where errors is a list of
+    (line_number, reason, raw_line) for malformed records. Blank lines are
+    ignored; nothing raises on bad input — that is what errors is for."""
+    events, errors = [], []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError as e:
+                errors.append((i, 'not JSON: %s' % e, line[:120]))
+                continue
+            reason = validate_record(obj)
+            if reason is not None:
+                errors.append((i, reason, line[:120]))
+                continue
+            events.append(obj)
+    return events, errors
+
+
+def latest_run(obs_dir):
+    """Newest run-*.jsonl under obs_dir, or None."""
+    cands = [os.path.join(obs_dir, d) for d in os.listdir(obs_dir)
+             if d.endswith('.jsonl')] if os.path.isdir(obs_dir) else []
+    return max(cands, key=os.path.getmtime) if cands else None
+
+
+def collect_events(path, merge_dir=False):
+    """Load events from a .jsonl file, or from a directory (newest run
+    only unless merge_dir=True, which concatenates every run file).
+    Returns (events, errors, files_read)."""
+    if os.path.isdir(path):
+        files = sorted(os.path.join(path, d) for d in os.listdir(path)
+                       if d.endswith('.jsonl'))
+        if not merge_dir:
+            latest = latest_run(path)
+            files = [latest] if latest else []
+    else:
+        files = [path]
+    events, errors = [], []
+    for f in files:
+        ev, er = load_events(f)
+        events.extend(ev)
+        errors.extend((('%s:%d' % (os.path.basename(f), ln)), why, raw)
+                      for ln, why, raw in er)
+    return events, errors, files
+
+
+def percentile_exact(values, p):
+    """Exact percentile of a small list (nearest-rank with interpolation);
+    None on empty input."""
+    if not values:
+        return None
+    vs = sorted(values)
+    if len(vs) == 1:
+        return vs[0]
+    idx = (p / 100.0) * (len(vs) - 1)
+    lo = int(idx)
+    hi = min(lo + 1, len(vs) - 1)
+    return vs[lo] + (vs[hi] - vs[lo]) * (idx - lo)
+
+
+def _spans(events, name):
+    return [e for e in events if e.get('kind') == 'span'
+            and e.get('name') == name]
+
+
+def _events(events, name):
+    return [e for e in events if e.get('kind') == 'event'
+            and e.get('name') == name]
+
+
+def _fmt_s(v):
+    if v is None:
+        return '-'
+    if v >= 1.0:
+        return '%.3fs' % v
+    return '%.1fms' % (v * 1e3)
+
+
+def summarize(events):
+    """Human-readable summary string for one run's event list."""
+    lines = ['================ obs report ================']
+    meta = [e for e in events if e.get('kind') == 'meta'
+            and e.get('name') == 'run_start']
+    if meta:
+        f = meta[0].get('fields', {})
+        lines.append('run started %s (pid %s); %d records'
+                     % (f.get('time', '?'), f.get('pid', '?'), len(events)))
+    else:
+        lines.append('%d records (no run_start meta — partial log?)'
+                     % len(events))
+
+    # -- steps ----------------------------------------------------------
+    steps = _spans(events, 'executor.step')
+    compiled_steps = [s for s in steps
+                      if s.get('fields', {}).get('compiled')]
+    steady = [s['dur_s'] for s in steps
+              if not s.get('fields', {}).get('compiled')]
+    lines.append('')
+    lines.append('-- steps --')
+    if steps:
+        lines.append('executor steps: %d total, %d carried a compile'
+                     % (len(steps), len(compiled_steps)))
+        if steady:
+            lines.append(
+                'steady-state step time: p50 %s  p95 %s  max %s  (n=%d)'
+                % (_fmt_s(percentile_exact(steady, 50)),
+                   _fmt_s(percentile_exact(steady, 95)),
+                   _fmt_s(max(steady)), len(steady)))
+        alldur = [s['dur_s'] for s in steps]
+        lines.append('all-step time:          p50 %s  p95 %s  max %s'
+                     % (_fmt_s(percentile_exact(alldur, 50)),
+                        _fmt_s(percentile_exact(alldur, 95)),
+                        _fmt_s(max(alldur))))
+    else:
+        lines.append('no executor.step spans recorded')
+
+    # -- compile / lowering breakdown -----------------------------------
+    lowering = _spans(events, 'executor.lowering')
+    compiles = _spans(events, 'executor.compile')
+    lines.append('')
+    lines.append('-- compile --')
+    if lowering or compiles:
+        per_key = {}
+        for s in lowering:
+            k = s.get('fields', {}).get('key', '?')
+            per_key.setdefault(k, [0.0, 0.0])[0] += s['dur_s']
+        for s in compiles:
+            k = s.get('fields', {}).get('key', '?')
+            per_key.setdefault(k, [0.0, 0.0])[1] += s['dur_s']
+        tot_low = sum(v[0] for v in per_key.values())
+        tot_cmp = sum(v[1] for v in per_key.values())
+        lines.append('lowering %s + compile(+first step) %s over %d '
+                     'cache key(s)'
+                     % (_fmt_s(tot_low), _fmt_s(tot_cmp), len(per_key)))
+        for k, (lo, cm) in sorted(per_key.items(),
+                                  key=lambda kv: -(kv[1][0] + kv[1][1])):
+            lines.append('  key %-10s lowering %-9s compile %s'
+                         % (k, _fmt_s(lo), _fmt_s(cm)))
+        steady_total = sum(steady) if steady else 0.0
+        denom = steady_total + tot_low + tot_cmp
+        if denom > 0:
+            lines.append('compile share of instrumented wall time: %.1f%%'
+                         % (100.0 * (tot_low + tot_cmp) / denom))
+    else:
+        lines.append('no lowering/compile spans (every lookup hit the '
+                     'cache, or the run predates instrumentation)')
+
+    # -- cache ----------------------------------------------------------
+    hits = sum(1 for s in steps if s.get('fields', {}).get('cache') == 'hit')
+    misses = sum(1 for s in steps
+                 if s.get('fields', {}).get('cache') == 'miss')
+    lines.append('')
+    lines.append('-- compile cache --')
+    if hits + misses:
+        lines.append('lookups: %d hits / %d misses (hit ratio %.1f%%)'
+                     % (hits, misses, 100.0 * hits / (hits + misses)))
+    else:
+        lines.append('no cache lookups recorded')
+
+    # -- anomaly guard ---------------------------------------------------
+    skips = _events(events, 'anomaly.skip')
+    lines.append('')
+    lines.append('-- anomaly guard --')
+    if skips:
+        last = skips[-1].get('fields', {})
+        lines.append('skipped steps: %d (last: run=%s grad_norm=%s '
+                     'loss_finite=%s grads_finite=%s)'
+                     % (len(skips), last.get('run', '?'),
+                        last.get('grad_norm', '?'),
+                        last.get('loss_finite', '?'),
+                        last.get('grads_finite', '?')))
+    else:
+        lines.append('skipped steps: 0')
+
+    # -- retries ---------------------------------------------------------
+    retries = _events(events, 'retry.attempt')
+    deadline = _events(events, 'retry.deadline_exceeded')
+    exhausted = _events(events, 'retry.exhausted')
+    lines.append('')
+    lines.append('-- retries --')
+    if retries or deadline or exhausted:
+        by_site = {}
+        for e in retries:
+            f = e.get('fields', {})
+            s = by_site.setdefault(f.get('site', '?'), [0, 0.0])
+            s[0] += 1
+            s[1] += float(f.get('delay_s', 0.0) or 0.0)
+        for site, (n, backoff) in sorted(by_site.items()):
+            lines.append('  %-32s %3d retr%s, %s backoff'
+                         % (site, n, 'y' if n == 1 else 'ies',
+                            _fmt_s(backoff)))
+        if deadline:
+            lines.append('  deadline exceeded: %d' % len(deadline))
+        if exhausted:
+            lines.append('  attempts exhausted: %d' % len(exhausted))
+    else:
+        lines.append('no retries')
+
+    # -- reader ----------------------------------------------------------
+    r_retries = _events(events, 'reader.retry')
+    degrades = _events(events, 'reader.degrade')
+    lines.append('')
+    lines.append('-- reader --')
+    if r_retries or degrades:
+        lines.append('source re-opens: %d; degraded-to-skip streams: %d'
+                     % (len(r_retries), len(degrades)))
+        for e in degrades:
+            f = e.get('fields', {})
+            lines.append('  degrade after %s sample(s): %s'
+                         % (f.get('emitted', '?'),
+                            str(f.get('error', ''))[:80]))
+    else:
+        lines.append('no reader faults')
+
+    # -- checkpoints ------------------------------------------------------
+    ck = [e for e in events
+          if e.get('name', '').startswith(('trainer.checkpoint.',
+                                           'checkpoint.',
+                                           'trainer.resume.',
+                                           'trainer.preempted'))]
+    lines.append('')
+    lines.append('-- checkpoint timeline --')
+    if ck:
+        t0 = min(e['ts'] for e in events)
+        for e in sorted(ck, key=lambda e: e['ts']):
+            f = e.get('fields', {})
+            extra = ' '.join('%s=%s' % (k, f[k]) for k in sorted(f)
+                             if k not in ('error',))
+            err = (' ERROR: %s' % str(f['error'])[:60]) if 'error' in f \
+                else ''
+            dur = (' [%s]' % _fmt_s(e['dur_s'])) if 'dur_s' in e else ''
+            lines.append('  +%8.3fs %-34s%s %s%s'
+                         % (e['ts'] - t0, e['name'], dur, extra, err))
+    else:
+        lines.append('no checkpoint activity')
+
+    # -- bench ------------------------------------------------------------
+    bench = _events(events, 'bench.metric') \
+        + _events(events, 'bench.sweep.cmd')
+    if bench:
+        lines.append('')
+        lines.append('-- bench --')
+        for e in bench:
+            f = e.get('fields', {})
+            if e['name'] == 'bench.metric':
+                lines.append('  %-52s %s %s'
+                             % (f.get('metric', '?'), f.get('value', '-'),
+                                f.get('unit', '')))
+            else:
+                lines.append('  sweep cmd rc=%s %s: %s'
+                             % (f.get('rc', '?'),
+                                _fmt_s(f.get('dur_s')),
+                                str(f.get('cmd', ''))[:70]))
+    lines.append('============================================')
+    return '\n'.join(lines)

@@ -1,0 +1,109 @@
+"""Structured JSONL run log: one event per record, append-only, flushed.
+
+Each record is a single JSON object on its own line:
+
+    {"ts": <monotonic seconds, float>,
+     "kind": "meta" | "event" | "span",
+     "name": "<dotted event name>",
+     "span": <enclosing span id or null>,
+     "parent": <parent span id, span records only>,
+     "dur_s": <wall seconds, span records only>,
+     "fields": {...}}
+
+`ts` is time.monotonic() so intervals are immune to wall-clock jumps; the
+run_start meta record carries the wall-clock anchor ("time" ISO-8601) for
+humans correlating against external logs. Writes are flushed per record so
+a crash (or a driver timeout) loses at most the in-flight line, and
+tools/obs_report.py can read a log while the run is still going.
+
+stdlib-only (see metrics.py for why).
+"""
+import json
+import os
+import threading
+import time
+
+__all__ = ['RunLog', 'new_run_path']
+
+_SEQ_LOCK = threading.Lock()
+_SEQ = [0]
+
+
+def _json_default(o):
+    """Fields may carry numpy scalars / device-array leftovers; fall back
+    to .item() (exact for numpy scalars) then str(). Never raises — a
+    telemetry write must not take down the training step it observes."""
+    item = getattr(o, 'item', None)
+    if callable(item):
+        try:
+            return item()
+        except Exception:
+            pass
+    return str(o)
+
+
+def new_run_path(obs_dir):
+    """A collision-free run-log path under obs_dir:
+    run-<utc stamp>-p<pid>-<seq>.jsonl (seq disambiguates multiple runs
+    started within one second of one process)."""
+    with _SEQ_LOCK:
+        _SEQ[0] += 1
+        seq = _SEQ[0]
+    stamp = time.strftime('%Y%m%dT%H%M%S', time.gmtime())
+    return os.path.join(obs_dir,
+                        'run-%s-p%d-%d.jsonl' % (stamp, os.getpid(), seq))
+
+
+class RunLog(object):
+    """Append-only JSONL writer. The file (and its directory) is created
+    on construction; callers create RunLogs lazily so an enabled-but-idle
+    process leaves no output file behind."""
+
+    def __init__(self, path):
+        self.path = path
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._lock = threading.Lock()
+        is_new = not os.path.exists(path) or os.path.getsize(path) == 0
+        self._f = open(path, 'a')
+        if is_new:
+            # several processes may share one pinned run file
+            # (PADDLE_TPU_OBS_RUN_FILE); only the creator stamps run_start
+            self.write({'ts': time.monotonic(), 'kind': 'meta',
+                        'name': 'run_start', 'span': None,
+                        'fields': {'pid': os.getpid(),
+                                   'time': time.strftime(
+                                       '%Y-%m-%dT%H:%M:%S%z')}})
+
+    def write(self, record):
+        try:
+            line = json.dumps(record, separators=(',', ':'),
+                              default=_json_default)
+        except Exception:
+            return  # telemetry must never crash the instrumented code
+        with self._lock:
+            if self._f is None:
+                return
+            try:
+                self._f.write(line + '\n')
+                self._f.flush()
+            except Exception as e:
+                # disk full / fd revoked mid-run: the instrumented step
+                # must survive. Disable THIS run log and say so once.
+                try:
+                    self._f.close()
+                except Exception:
+                    pass
+                self._f = None
+                import warnings
+                warnings.warn(
+                    'obs run log %r became unwritable (%s: %s); telemetry '
+                    'file output disabled for the rest of this run'
+                    % (self.path, type(e).__name__, e), RuntimeWarning)
+
+    def close(self):
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None

@@ -212,10 +212,18 @@ def fault_tolerant(reader, max_retries=3, retry_on=(IOError, OSError),
     `shuffle(fault_tolerant(base), buf)` — wrapping `shuffle` itself
     would silently duplicate/drop samples across a retry.
 
-    sleep is injectable for tests (None = time.sleep)."""
+    sleep is injectable for tests (None = time.sleep).
+
+    Telemetry (docs/observability.md): every source re-open bumps the
+    reader.retries counter and records a reader.retry event; a degrade
+    bumps reader.degraded and records reader.degrade with how many
+    samples survived; per-sample production latency feeds the
+    reader.batch.seconds histogram — a slow input pipeline shows up in
+    obs_report next to the step times it is starving."""
     import time as _time
     import warnings
 
+    from .. import obs
     from ..utils.retry import backoff_delays
 
     def fault_tolerant_reader():
@@ -223,23 +231,39 @@ def fault_tolerant(reader, max_retries=3, retry_on=(IOError, OSError),
         delays = backoff_delays(max_retries, base_delay=base_delay,
                                 max_delay=max_delay, seed=seed)
         do_sleep = _time.sleep if sleep is None else sleep
+        latency = obs.histogram('reader.batch.seconds')
         while True:
             try:
-                for i, sample in enumerate(reader()):
+                src = enumerate(reader())
+                while True:
+                    t0 = _time.perf_counter()
+                    try:
+                        i, sample = next(src)
+                    except StopIteration:
+                        return
                     if i < emitted:
                         continue  # fast-forward past a replayed prefix
+                    # observed only for DELIVERED samples: replayed
+                    # prefixes (usually page-cache fast) would skew the
+                    # latency histogram low after a retry
+                    latency.observe(_time.perf_counter() - t0)
                     yield sample
                     emitted += 1
-                return
             except retry_on as e:
                 delay = next(delays, None)
                 if delay is None:
+                    obs.counter('reader.degraded').inc()
+                    obs.event('reader.degrade', emitted=emitted,
+                              attempts=max_retries + 1, error=repr(e))
                     warnings.warn(
                         'fault_tolerant reader: source failed %d times '
                         '(last: %r); degrading to skip — stream ends '
                         'after %d sample(s) instead of raising'
                         % (max_retries + 1, e, emitted), RuntimeWarning)
                     return
+                obs.counter('reader.retries').inc()
+                obs.event('reader.retry', emitted=emitted,
+                          delay_s=delay, error=repr(e))
                 do_sleep(delay)
 
     return fault_tolerant_reader

@@ -26,6 +26,8 @@ import zlib
 
 import numpy as np
 
+from .. import obs
+
 __all__ = ['save_sharded', 'save_sharded_async', 'load_sharded',
            'load_latest_verified', 'verify_sharded', 'latest_step',
            'AsyncSave']
@@ -152,9 +154,12 @@ def _write_shard(fpath, data, sh):
     from .retry import retry_call
     retry_call(np.save, args=(fpath, data), retries=_IO_RETRIES,
                base_delay=_IO_BASE_DELAY,
-               describe='write shard %r' % fpath)
+               describe='write shard %r' % fpath,
+               site='checkpoint.write_shard')
     sh['bytes'] = os.path.getsize(fpath)
     sh['crc32'] = _crc32_file(fpath)
+    obs.counter('checkpoint.shard.writes').inc()
+    obs.counter('checkpoint.shard.bytes').inc(sh['bytes'])
 
 
 def _write_all(ckpt_dir, manifest, writes):
@@ -187,8 +192,11 @@ def save_sharded(ckpt_dir, arrays, step=0, extra_meta=None):
             _write_shard(os.path.join(ckpt_dir, fname),
                          np.asarray(shard_data), sh)
 
-        manifest, _ = _collect_shards(arrays, step, extra_meta, sink=sink)
-        return _write_manifest(ckpt_dir, manifest)
+        with obs.span('checkpoint.save_sharded', step=step,
+                      dir=os.path.basename(ckpt_dir), arrays=len(arrays)):
+            manifest, _ = _collect_shards(arrays, step, extra_meta,
+                                          sink=sink)
+            return _write_manifest(ckpt_dir, manifest)
     finally:
         with _INFLIGHT_LOCK:
             _INFLIGHT_DIRS.discard(key)
@@ -310,13 +318,18 @@ def _shard_meta_check(path, meta):
     """Existence/size gate against a manifest shard entry — the SINGLE
     implementation shared by _load_shard and verify_sharded so the two
     can never diverge on what counts as corrupt. Raises RuntimeError;
-    returns the manifest CRC32 (or None when the manifest predates it)."""
+    returns the manifest CRC32 (or None when the manifest predates it).
+    Missing/truncated verdicts count into checkpoint.crc_verify{fail}
+    alongside CRC mismatches — the counter tracks the whole integrity
+    gate, not only the hash compare."""
     if not os.path.exists(path):
+        obs.counter('checkpoint.crc_verify', outcome='fail').inc()
         raise RuntimeError(
             'sharded checkpoint shard %r is missing (deleted or never '
             'fully written)' % path)
     want = meta.get('bytes')
     if want is not None and os.path.getsize(path) != want:
+        obs.counter('checkpoint.crc_verify', outcome='fail').inc()
         raise RuntimeError(
             'sharded checkpoint shard %r is corrupt: %d bytes on disk, '
             'manifest recorded %d (truncated write?)'
@@ -325,12 +338,21 @@ def _shard_meta_check(path, meta):
 
 
 def _crc_check(path, got_crc, want_crc):
-    """Shared CRC comparison (same wording from every checker)."""
-    if want_crc is not None and got_crc != want_crc:
+    """Shared CRC comparison (same wording from every checker). Every
+    verdict lands in the checkpoint.crc_verify counter, labeled by
+    outcome, so an operator can see integrity checks happening (and
+    failing) without scraping warnings."""
+    if want_crc is None:
+        return
+    if got_crc != want_crc:
+        obs.counter('checkpoint.crc_verify', outcome='fail').inc()
+        obs.event('checkpoint.crc_fail', file=os.path.basename(path),
+                  got='%08x' % got_crc, want='%08x' % want_crc)
         raise RuntimeError(
             'sharded checkpoint shard %r is corrupt: content CRC32 '
             '%08x does not match the manifest record %08x (bit rot or '
             'a partially-overwritten file)' % (path, got_crc, want_crc))
+    obs.counter('checkpoint.crc_verify', outcome='ok').inc()
 
 
 def _load_shard(ckpt_dir, sh, verify_crc=True):
@@ -356,7 +378,8 @@ def _load_shard(ckpt_dir, sh, verify_crc=True):
     try:
         buf = retry_call(read, retries=_IO_RETRIES,
                          base_delay=_IO_BASE_DELAY,
-                         describe='read shard %r' % path)
+                         describe='read shard %r' % path,
+                         site='checkpoint.read_shard')
     except RetryError as e:
         raise RuntimeError(
             'sharded checkpoint shard %r is unreadable: %r'
@@ -394,19 +417,23 @@ def verify_sharded(ckpt_dir):
     the checkpoint is bit-exact as written. Used by load_latest_verified
     to decide whether a serial is safe to restore from."""
     problems = []
-    try:
-        manifest = _merged_manifest(ckpt_dir)
-    except (OSError, ValueError, KeyError) as e:
-        return ['manifest unreadable in %r: %r' % (ckpt_dir, e)]
-    for name, entry in manifest.get('arrays', {}).items():
-        for sh in entry.get('shards', []):
-            try:
-                path = os.path.join(ckpt_dir, sh['file'])
-                want_crc = _shard_meta_check(path, sh)
-                if want_crc is not None:
-                    _crc_check(path, _crc32_file(path), want_crc)
-            except (RuntimeError, OSError, KeyError, TypeError) as e:
-                problems.append('%s: %s' % (name, e))
+    with obs.span('checkpoint.verify', dir=os.path.basename(ckpt_dir)) \
+            as sp:
+        try:
+            manifest = _merged_manifest(ckpt_dir)
+        except (OSError, ValueError, KeyError) as e:
+            sp.fields['problems'] = 1
+            return ['manifest unreadable in %r: %r' % (ckpt_dir, e)]
+        for name, entry in manifest.get('arrays', {}).items():
+            for sh in entry.get('shards', []):
+                try:
+                    path = os.path.join(ckpt_dir, sh['file'])
+                    want_crc = _shard_meta_check(path, sh)
+                    if want_crc is not None:
+                        _crc_check(path, _crc32_file(path), want_crc)
+                except (RuntimeError, OSError, KeyError, TypeError) as e:
+                    problems.append('%s: %s' % (name, e))
+        sp.fields['problems'] = len(problems)
     return problems
 
 
@@ -447,6 +474,9 @@ def load_latest_verified(base_dir, prefix='sharded_', mesh=None):
                 # Trainer's serial loop does
                 problems = ['%s: %s' % (type(e).__name__, e)]
         tried.append((step, problems))
+        obs.counter('checkpoint.serial_fallbacks').inc()
+        obs.event('checkpoint.serial_fallback', serial=step,
+                  problems=len(problems), first=str(problems[0])[:200])
         warnings.warn(
             'sharded checkpoint serial %d at %r FAILED verification '
             '(%s) — falling back to the previous serial'
@@ -466,8 +496,14 @@ def load_sharded(ckpt_dir, mesh=None, verify_crc=True):
     skips the per-shard content CRC (size/readability still checked) —
     for callers that just ran verify_sharded over the same dir.
     """
+    with obs.span('checkpoint.load_sharded',
+                  dir=os.path.basename(ckpt_dir)):
+        return _load_sharded_impl(ckpt_dir, mesh, verify_crc)
+
+
+def _load_sharded_impl(ckpt_dir, mesh, verify_crc):
     import jax
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.sharding import Mesh, NamedSharding
 
     manifest = _merged_manifest(ckpt_dir)
 

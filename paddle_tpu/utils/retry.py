@@ -41,10 +41,17 @@ def backoff_delays(retries, base_delay=0.1, factor=2.0, max_delay=30.0,
         yield max(d, 0.0)
 
 
+def _obs():
+    # Lazy: the success path pays nothing, and utils.retry stays
+    # importable standalone (paddle_tpu.obs is stdlib-only by contract).
+    from .. import obs
+    return obs
+
+
 def retry_call(fn, args=(), kwargs=None, retries=3, base_delay=0.1,
                factor=2.0, max_delay=30.0, jitter=0.5, deadline=None,
                retry_on=(OSError, IOError), seed=None, sleep=time.sleep,
-               on_retry=None, describe=None):
+               on_retry=None, describe=None, site=None):
     """Call fn(*args, **kwargs), retrying on `retry_on` exceptions.
 
     retries:   additional attempts after the first (so retries=3 means at
@@ -56,8 +63,16 @@ def retry_call(fn, args=(), kwargs=None, retries=3, base_delay=0.1,
     sleep:     injectable for tests (the fault suite passes a recorder so
                no real time is spent).
     on_retry:  on_retry(attempt_index, exception, delay) observer hook.
+    site:      LOW-CARDINALITY call-site tag for telemetry — the
+               retry.attempts / retry.backoff.seconds /
+               retry.deadline_exceeded / retry.exhausted counters are
+               labeled with it (docs/observability.md). Unlike
+               `describe`, which may embed paths, `site` must be a stable
+               name like 'checkpoint.write_shard'. Defaults to the
+               callable's __name__.
     Raises RetryError (chaining the last exception) when attempts or the
     deadline are exhausted. Non-retryable exceptions propagate untouched.
+    A first-try success records no telemetry at all.
     """
     kwargs = kwargs or {}
     t0 = time.monotonic()
@@ -65,6 +80,7 @@ def retry_call(fn, args=(), kwargs=None, retries=3, base_delay=0.1,
                             max_delay=max_delay, jitter=jitter, seed=seed)
     last = None
     attempts = 0
+    site = site or getattr(fn, '__name__', 'call')
     for attempt in range(retries + 1):
         try:
             return fn(*args, **kwargs)
@@ -76,15 +92,29 @@ def retry_call(fn, args=(), kwargs=None, retries=3, base_delay=0.1,
                 break
             if deadline is not None \
                     and time.monotonic() - t0 + delay > deadline:
+                obs = _obs()
+                obs.counter('retry.deadline_exceeded', site=site).inc()
+                obs.event('retry.deadline_exceeded', site=site,
+                          attempts=attempts, deadline_s=deadline,
+                          error=repr(e))
                 raise RetryError(
                     '%s: deadline of %.3fs would be exceeded after %d '
                     'attempt(s): %r'
                     % (describe or getattr(fn, '__name__', 'call'),
                        deadline, attempts, e),
                     last_exception=e, attempts=attempts) from e
+            obs = _obs()
+            obs.counter('retry.attempts', site=site).inc()
+            obs.counter('retry.backoff.seconds', site=site).inc(delay)
+            obs.event('retry.attempt', site=site, attempt=attempt,
+                      delay_s=delay, error=repr(e))
             if on_retry is not None:
                 on_retry(attempt, e, delay)
             sleep(delay)
+    obs = _obs()
+    obs.counter('retry.exhausted', site=site).inc()
+    obs.event('retry.exhausted', site=site, attempts=attempts,
+              error=repr(last))
     raise RetryError(
         '%s: all %d attempt(s) failed: %r'
         % (describe or getattr(fn, '__name__', 'call'), attempts, last),

@@ -28,11 +28,34 @@ import jax.numpy as jnp
 import paddle_tpu
 import paddle_tpu.fluid as fluid
 import paddle_tpu.reader
+from paddle_tpu import obs
+from paddle_tpu.obs import report as obs_report
 from paddle_tpu.utils import checkpoint as ck
 from paddle_tpu.utils import retry as retry_mod
 from paddle_tpu.utils.faults import FaultInjector
 
 pytestmark = pytest.mark.faults
+
+
+@pytest.fixture
+def obs_events(tmp_path):
+    """Force the run log on for a drill and hand back a reader: the drills
+    verify BEHAVIOR; these assertions verify an OPERATOR could have seen
+    it happen (docs/observability.md)."""
+    obs.enable(str(tmp_path / 'obs'))
+
+    def read(name=None):
+        path = obs.run_log_path()
+        if path is None:
+            return []
+        events, errors = obs_report.load_events(path)
+        assert errors == [], errors
+        return [e for e in events if name is None or e['name'] == name]
+
+    try:
+        yield read
+    finally:
+        obs._reset()
 
 
 # ---------------------------------------------------------------------------
@@ -65,11 +88,12 @@ def _batch(seed=0, n=8):
 # anomaly guard: NaN step-skip on the compiled path
 # ---------------------------------------------------------------------------
 
-def test_nan_step_skipped_params_unchanged_compiled_path():
+def test_nan_step_skipped_params_unchanged_compiled_path(obs_events):
     prog, start, loss, w_names = _toy_regression()
     fluid.anomaly_guard(prog)
     exe = fluid.Executor(fluid.CPUPlace())
     scope = fluid.Scope()
+    skips_before = obs.REGISTRY.total('anomaly.skipped_steps')
     with fluid.scope_guard(scope):
         exe.run(start)
         xb, yb = _batch()
@@ -88,6 +112,15 @@ def test_nan_step_skipped_params_unchanged_compiled_path():
         assert exe.skipped_steps == 1
         assert not bool(exe.last_step_health['healthy'])
         assert any('anomaly guard' in str(w.message) for w in rec)
+        # ... and telemetry recorded it: the counter moved and the run log
+        # carries a machine-readable anomaly.skip event with the health
+        # fields, not just a transient warning
+        assert obs.REGISTRY.total('anomaly.skipped_steps') \
+            == skips_before + 1
+        skips = obs_events('anomaly.skip')
+        assert len(skips) == 1
+        assert skips[0]['fields']['loss_finite'] is False \
+            or skips[0]['fields']['grads_finite'] is False
         after = {n: np.asarray(scope.vars[n]) for n in w_names}
         for n in w_names:
             np.testing.assert_array_equal(before[n], after[n])
@@ -199,8 +232,10 @@ def _sharded_state(delta=0.0):
             'b': jnp.asarray(np.ones((8,), np.float32) + delta)}
 
 
-def test_truncated_shard_detected_and_previous_serial_restored(tmp_path):
-    base = str(tmp_path)
+def test_truncated_shard_detected_and_previous_serial_restored(
+        tmp_path, obs_events):
+    base = str(tmp_path / 'ckpts')
+    fallbacks_before = obs.REGISTRY.total('checkpoint.serial_fallbacks')
     ck.save_sharded(os.path.join(base, 'sharded_1'), _sharded_state(0.0),
                     step=1)
     ck.save_sharded(os.path.join(base, 'sharded_2'), _sharded_state(1.0),
@@ -220,6 +255,15 @@ def test_truncated_shard_detected_and_previous_serial_restored(tmp_path):
     assert any('FAILED verification' in str(w.message) for w in rec)
     np.testing.assert_array_equal(np.asarray(got['w']),
                                   np.asarray(_sharded_state(0.0)['w']))
+    # the fallback was RECORDED, not just warned: counter + run-log event
+    # naming the rejected serial, and the verify spans carry their verdicts
+    assert obs.REGISTRY.total('checkpoint.serial_fallbacks') \
+        == fallbacks_before + 1
+    fb = obs_events('checkpoint.serial_fallback')
+    assert len(fb) == 1 and fb[0]['fields']['serial'] == 2
+    verifies = obs_events('checkpoint.verify')
+    assert any(e['fields'].get('problems', 0) > 0 for e in verifies)
+    assert any(e['fields'].get('problems') == 0 for e in verifies)
 
 
 def test_same_size_bit_rot_caught_by_crc_only(tmp_path):
@@ -252,11 +296,20 @@ def test_trainer_checkpoint_crc_fallback(tmp_path):
     inj = FaultInjector(seed=7)
     inj.corrupt_file(os.path.join(d, 'checkpoint_2', '__params__.npz'),
                      n_bytes=8)
+    fail_before = obs.REGISTRY.counter('checkpoint.crc_verify',
+                                       outcome='fail').value
+    ok_before = obs.REGISTRY.counter('checkpoint.crc_verify',
+                                     outcome='ok').value
     with fluid.scope_guard(scope):
         with pytest.raises(RuntimeError, match='corrupt'):
             fluid.io.load_checkpoint(exe, d, serial=2, main_program=prog)
         meta = fluid.io.load_checkpoint(exe, d, serial=1, main_program=prog)
     assert meta['step'] == 1
+    # both CRC verdicts were counted, labeled by outcome
+    assert obs.REGISTRY.counter('checkpoint.crc_verify',
+                                outcome='fail').value == fail_before + 1
+    assert obs.REGISTRY.counter('checkpoint.crc_verify',
+                                outcome='ok').value == ok_before + 1
 
 
 # ---------------------------------------------------------------------------
@@ -401,17 +454,26 @@ def test_request_preemption_without_signal(tmp_path):
 # reader fault tolerance: retry-then-degrade
 # ---------------------------------------------------------------------------
 
-def test_reader_heals_without_duplicates_or_gaps():
+def test_reader_heals_without_duplicates_or_gaps(obs_events):
     inj = FaultInjector(seed=13)
+    retries_before = obs.REGISTRY.total('reader.retries')
     flaky = inj.flaky_reader(lambda: iter(range(10)), fail_at=4,
                              fail_times=2)
     got = list(paddle_tpu.reader.fault_tolerant(
         flaky, max_retries=3, sleep=lambda d: None)())
     assert got == list(range(10))
+    # both re-opens were recorded: counter delta + one reader.retry event
+    # per re-open carrying the backoff delay and the underlying error
+    assert obs.REGISTRY.total('reader.retries') == retries_before + 2
+    evs = obs_events('reader.retry')
+    assert len(evs) == 2
+    assert all('delay_s' in e['fields'] and 'error' in e['fields']
+               for e in evs)
 
 
-def test_reader_degrades_to_skip_with_warning_after_retries():
+def test_reader_degrades_to_skip_with_warning_after_retries(obs_events):
     inj = FaultInjector(seed=13)
+    degraded_before = obs.REGISTRY.total('reader.degraded')
     flaky = inj.flaky_reader(lambda: iter(range(10)), fail_at=4,
                              fail_times=99)
     with warnings.catch_warnings(record=True) as rec:
@@ -420,18 +482,34 @@ def test_reader_degrades_to_skip_with_warning_after_retries():
             flaky, max_retries=2, sleep=lambda d: None)())
     assert got == [0, 1, 2, 3]       # progress kept, stream ended early
     assert any('degrading to skip' in str(w.message) for w in rec)
+    # the degrade is an event an operator can query, not only a warning
+    assert obs.REGISTRY.total('reader.degraded') == degraded_before + 1
+    evs = obs_events('reader.degrade')
+    assert len(evs) == 1
+    assert evs[0]['fields']['emitted'] == 4
+    # batch-production latency fed the histogram while the stream lived
+    assert obs.histogram('reader.batch.seconds').count > 0
 
 
-def test_retry_backoff_is_deterministic_and_deadline_bounded():
+def test_retry_backoff_is_deterministic_and_deadline_bounded(obs_events):
     assert list(retry_mod.backoff_delays(5, seed=42)) \
         == list(retry_mod.backoff_delays(5, seed=42))
     inj = FaultInjector(seed=1)
     always_fails = inj.flaky(lambda: None, fail_times=100)
     slept = []
+    deadline_before = obs.REGISTRY.total('retry.deadline_exceeded')
     with pytest.raises(retry_mod.RetryError, match='deadline'):
         retry_mod.retry_call(always_fails, retries=10, base_delay=1.0,
-                             deadline=0.5, sleep=slept.append)
+                             deadline=0.5, sleep=slept.append,
+                             site='faults.drill')
     assert not slept                 # first delay already blows the budget
+    # the refusal-to-wait is counted per call site and logged as an event
+    assert obs.REGISTRY.total('retry.deadline_exceeded') \
+        == deadline_before + 1
+    assert obs.REGISTRY.counter('retry.deadline_exceeded',
+                                site='faults.drill').value >= 1
+    evs = obs_events('retry.deadline_exceeded')
+    assert len(evs) == 1 and evs[0]['fields']['site'] == 'faults.drill'
 
 
 def test_download_fetcher_retries_and_md5_gates(tmp_path, monkeypatch):

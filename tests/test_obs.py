@@ -1,0 +1,435 @@
+"""Runtime telemetry layer (paddle_tpu.obs + tools/obs_report.py).
+
+Covers the observability PR's acceptance criteria:
+  - metrics registry semantics: counters/gauges/histograms, labels,
+    percentile estimation, thread-safety smoke;
+  - span nesting + the JSONL run-log schema round-trip (every record
+    validates, ids link children to parents);
+  - disabled mode is a TRUE no-op: no output file, and the obs package —
+    loaded standalone in a subprocess — never imports jax, enabled or not;
+  - an end-to-end fit_a_line-shaped training run whose obs_report shows
+    the compile-vs-step split, the compile-cache hit ratio, and the
+    anomaly-guard skip count;
+  - exe.cache_stats + the compiled_op_table cache header;
+  - profiler satellites: stop_profiler warns on an unwritable
+    profile_path, cuda_profiler routes output_file, the context manager
+    stops on exceptions;
+  - obs_report --check exits nonzero on malformed records;
+  - bench.py mirrors its metric lines into the same JSONL schema.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import obs
+from paddle_tpu.obs import report as obs_report_mod
+
+from util import fresh_program
+
+pytestmark = pytest.mark.obs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLI = os.path.join(REPO, 'tools', 'obs_report.py')
+
+
+@pytest.fixture
+def obs_dir(tmp_path):
+    """Observability forced ON into a per-test directory; always reset."""
+    d = str(tmp_path / 'obs')
+    obs.enable(d)
+    try:
+        yield d
+    finally:
+        obs._reset()
+
+
+@pytest.fixture(autouse=True)
+def _obs_reset_guard():
+    yield
+    obs._reset()
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_histogram_semantics():
+    c = obs.counter('t.reg.counter', site='a')
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    # same (name, labels) -> same instrument; different labels -> distinct
+    assert obs.counter('t.reg.counter', site='a') is c
+    c2 = obs.counter('t.reg.counter', site='b')
+    assert c2 is not c
+    c2.inc(1.5)
+    assert obs.REGISTRY.total('t.reg.counter') == 5.0
+
+    g = obs.gauge('t.reg.gauge')
+    assert g.value is None
+    g.set(7)
+    g.set(4.25)
+    assert g.value == 4.25
+
+    h = obs.histogram('t.reg.hist')
+    assert h.percentile(50) is None
+    for _ in range(95):
+        h.observe(0.01)
+    for _ in range(5):
+        h.observe(2.0)
+    assert h.count == 100
+    assert h.min == 0.01 and h.max == 2.0
+    assert h.percentile(50) <= 0.025          # inside the 10ms bucket
+    assert h.percentile(99) > 0.5             # the tail is visible
+    snap = h.snapshot()
+    assert snap['count'] == 100 and snap['kind'] == 'histogram'
+    assert sum(c for _, c in snap['buckets']) == 100
+
+    # kind conflicts are loud, not silent corruption
+    with pytest.raises(TypeError):
+        obs.gauge('t.reg.counter', site='a')
+
+
+def test_registry_thread_safety_smoke():
+    c = obs.counter('t.threads.counter')
+    h = obs.histogram('t.threads.hist')
+    n_threads, per = 8, 500
+
+    def work():
+        for i in range(per):
+            c.inc()
+            h.observe(0.001 * (i % 7))
+
+    ts = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert c.value == n_threads * per
+    assert h.count == n_threads * per
+
+
+# ---------------------------------------------------------------------------
+# spans + JSONL schema
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_and_jsonl_schema_roundtrip(obs_dir):
+    with obs.span('t.outer', step_num=4, tag='x') as outer:
+        obs.event('t.note', detail='inside-outer')
+        with obs.span('t.inner') as inner:
+            pass
+    assert outer.seconds is not None and inner.seconds is not None
+    # span wall time landed in the registry histogram
+    assert obs.histogram('t.outer.seconds').count >= 1
+
+    path = obs.run_log_path()
+    assert path and os.path.exists(path)
+    events, errors = obs_report_mod.load_events(path)
+    assert errors == [], errors
+    for e in events:
+        assert obs_report_mod.validate_record(e) is None
+    by_name = {e['name']: e for e in events}
+    assert by_name['run_start']['kind'] == 'meta'
+    out_rec, in_rec = by_name['t.outer'], by_name['t.inner']
+    assert out_rec['kind'] == in_rec['kind'] == 'span'
+    assert in_rec['parent'] == out_rec['span']      # nesting round-trips
+    assert by_name['t.note']['span'] == out_rec['span']
+    assert out_rec['dur_s'] >= in_rec['dur_s'] >= 0
+    assert out_rec['fields']['tag'] == 'x'
+    assert out_rec['fields']['step_num'] == 4
+
+
+def test_disabled_mode_writes_nothing(tmp_path):
+    obs.disable()
+    with obs.span('t.disabled'):
+        assert obs.event('t.never') is None
+    assert obs.run_log_path() is None
+    assert list(tmp_path.iterdir()) == []
+    # the registry still counts (cache_stats et al. work with obs off)
+    assert obs.histogram('t.disabled.seconds').count >= 1
+
+
+def test_unwritable_obs_dir_warns_once_never_raises(tmp_path):
+    """Telemetry must never take down the step it observes: an obs dir
+    that cannot be created warns ONCE and disables file output; spans and
+    events keep working in-memory."""
+    obs.enable(str(tmp_path / 'plainfile' / 'obs'))
+    (tmp_path / 'plainfile').write_text('not a directory')
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter('always')
+        with obs.span('t.unwritable'):
+            assert obs.event('t.swallowed') is None
+        obs.event('t.swallowed2')
+    warns = [w for w in rec if 'run log unavailable' in str(w.message)]
+    assert len(warns) == 1, [str(w.message) for w in rec]
+    assert obs.run_log_path() is None
+    assert obs.histogram('t.unwritable.seconds').count >= 1
+
+
+def test_pinned_run_file_env(tmp_path, monkeypatch):
+    """PADDLE_TPU_OBS_RUN_FILE pins the exact run-log path (how
+    perf_sweep.sh collects a whole sweep into one file), and a second
+    writer appends without re-stamping run_start."""
+    pinned = tmp_path / 'obs' / 'run-pinned.jsonl'
+    monkeypatch.setenv('PADDLE_TPU_OBS_DIR', str(tmp_path / 'obs'))
+    monkeypatch.setenv('PADDLE_TPU_OBS_RUN_FILE', str(pinned))
+    obs._reset()
+    obs.event('t.pin.first')
+    assert obs.run_log_path() == str(pinned)
+    obs._reset()          # simulate a second process opening the same file
+    obs.event('t.pin.second')
+    events, errors = obs_report_mod.load_events(str(pinned))
+    assert errors == []
+    names = [e['name'] for e in events]
+    assert names.count('run_start') == 1
+    assert 't.pin.first' in names and 't.pin.second' in names
+    # an explicit enable() (a test isolating its run) must NOT be
+    # silently redirected into the leaked pinned file
+    obs.enable(str(tmp_path / 'isolated'))
+    obs.event('t.pin.isolated')
+    assert obs.run_log_path() != str(pinned)
+    iso_events, _ = obs_report_mod.load_events(obs.run_log_path())
+    assert any(e['name'] == 't.pin.isolated' for e in iso_events)
+    pinned_events, _ = obs_report_mod.load_events(str(pinned))
+    assert not any(e['name'] == 't.pin.isolated' for e in pinned_events)
+
+
+def test_standalone_obs_never_imports_jax(tmp_path):
+    """The package, loaded WITHOUT paddle_tpu, must not import jax in
+    disabled mode (contract) nor even in enabled mode (it only forwards
+    to an already-imported jax)."""
+    code = '''
+import importlib.util, os, sys
+pkg = os.path.join(%r, 'paddle_tpu', 'obs')
+spec = importlib.util.spec_from_file_location(
+    'ptobs', os.path.join(pkg, '__init__.py'),
+    submodule_search_locations=[pkg])
+obs = importlib.util.module_from_spec(spec)
+sys.modules['ptobs'] = obs
+spec.loader.exec_module(obs)
+os.environ.pop('PADDLE_TPU_OBS_DIR', None)
+
+watch = sys.argv[1]
+with obs.span('a', x=1):
+    with obs.span('b'):
+        obs.event('never')
+obs.counter('c').inc()
+assert obs.run_log_path() is None
+assert os.listdir(watch) == [], os.listdir(watch)   # disabled: no file
+
+obs.enable(os.path.join(watch, 'on'))
+with obs.span('c2'):
+    obs.event('now-recorded', k=1)
+assert obs.run_log_path() is not None
+
+assert 'jax' not in sys.modules, 'obs imported jax'
+print('NOOP-OK')
+''' % (REPO,)
+    r = subprocess.run([sys.executable, '-c', code, str(tmp_path)],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert 'NOOP-OK' in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# executor cache stats + compiled_op_table header
+# ---------------------------------------------------------------------------
+
+def _fit_a_line_graph():
+    x = fluid.layers.data(name='x', shape=[13], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+    pred = fluid.layers.fc(input=x, size=1, act=None)
+    loss = fluid.layers.mean(
+        fluid.layers.square_error_cost(input=pred, label=y))
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    return loss
+
+
+def _housing_batch(seed=0, n=16, poison=False):
+    rng = np.random.RandomState(seed)
+    xb = rng.rand(n, 13).astype('float32')
+    if poison:
+        xb[0, 0] = np.nan
+    return xb, rng.rand(n, 1).astype('float32')
+
+
+def test_cache_stats_and_table_header():
+    with fresh_program() as (main, startup):
+        loss = _fit_a_line_graph()
+        exe = fluid.Executor(fluid.CPUPlace())
+        assert exe.cache_stats == {'hits': 0, 'misses': 0, 'entries': 0,
+                                   'evictions': 0,
+                                   'last_compile_seconds': None}
+        exe.run(startup)
+        xb, yb = _housing_batch()
+        for _ in range(3):
+            exe.run(main, feed={'x': xb, 'y': yb}, fetch_list=[loss])
+        st = exe.cache_stats
+        assert st['misses'] == 2            # startup + train signatures
+        assert st['hits'] == 2
+        assert st['entries'] == 2
+        assert st['last_compile_seconds'] > 0
+
+        from paddle_tpu.fluid import profiler
+        table, rows = profiler.compiled_op_table(
+            exe, main, {'x': xb, 'y': yb}, [loss])
+        head = table.splitlines()[0]
+        # the header names the cached module the table attributed
+        assert head.startswith('compiled module: cache hit key=')
+        assert 'hits=' in head and 'misses=' in head
+        assert exe._last_cache_lookup['key'] in head
+        assert 'mul' in rows                 # the table itself still works
+
+        exe.close()
+        assert exe.cache_stats['entries'] == 0
+        assert exe.cache_stats['evictions'] == 2
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: train, then diagnose from the run log alone
+# ---------------------------------------------------------------------------
+
+def test_end_to_end_fit_a_line_report(tmp_path, monkeypatch):
+    # the acceptance-criteria path: the ENV VAR switches the layer on
+    monkeypatch.setenv('PADDLE_TPU_OBS_DIR', str(tmp_path / 'obs'))
+    obs._reset()
+    with fresh_program() as (main, startup):
+        loss = _fit_a_line_graph()
+        fluid.anomaly_guard(main)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for i in range(6):
+            xb, yb = _housing_batch(seed=i)
+            exe.run(main, feed={'x': xb, 'y': yb}, fetch_list=[loss])
+        xb, yb = _housing_batch(seed=99, poison=True)
+        with warnings.catch_warnings():
+            warnings.simplefilter('ignore')
+            exe.run(main, feed={'x': xb, 'y': yb}, fetch_list=[loss])
+
+    path = obs.run_log_path()
+    assert path and os.path.exists(path)
+
+    # the CLI (standalone load, no jax) both validates and summarizes
+    chk = subprocess.run([sys.executable, CLI, path, '--check'],
+                         capture_output=True, text=True, timeout=60)
+    assert chk.returncode == 0, chk.stdout + chk.stderr
+
+    rep = subprocess.run([sys.executable, CLI, path],
+                         capture_output=True, text=True, timeout=60)
+    assert rep.returncode == 0, rep.stdout + rep.stderr
+    out = rep.stdout
+    # compile vs step split
+    assert 'carried a compile' in out
+    assert 'steady-state step time: p50' in out
+    assert 'lowering' in out and 'compile(+first step)' in out
+    # cache hit ratio: 8 runs, 2 misses (startup + train)
+    assert 'hit ratio' in out
+    assert '6 hits / 2 misses' in out
+    # anomaly-guard skip is visible to the operator
+    assert 'skipped steps: 1' in out
+
+
+def test_obs_report_check_flags_malformed_records(tmp_path):
+    p = tmp_path / 'run-bad.jsonl'
+    good = {'ts': 1.0, 'kind': 'event', 'name': 'ok', 'span': None,
+            'fields': {}}
+    p.write_text(json.dumps(good) + '\n'
+                 + 'this is not json\n'
+                 + json.dumps({'ts': 'late', 'kind': 'event',
+                               'name': 'bad-ts'}) + '\n'
+                 + json.dumps({'ts': 2.0, 'kind': 'span',
+                               'name': 'no-dur'}) + '\n')
+    r = subprocess.run([sys.executable, CLI, str(p), '--check'],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 2
+    assert 'MALFORMED' in r.stderr
+    assert '3 malformed record(s)' in r.stderr
+
+    ok = tmp_path / 'run-ok.jsonl'
+    ok.write_text(json.dumps(good) + '\n')
+    r2 = subprocess.run([sys.executable, CLI, str(ok), '--check'],
+                        capture_output=True, text=True, timeout=60)
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+
+
+# ---------------------------------------------------------------------------
+# profiler satellites
+# ---------------------------------------------------------------------------
+
+def test_stop_profiler_warns_on_unwritable_profile_path(tmp_path, capsys):
+    from paddle_tpu.fluid import profiler
+    bad = str(tmp_path / 'no' / 'such' / 'dir' / 'profile')
+    profiler.start_profiler('All')
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter('always')
+        profiler.stop_profiler(profile_path=bad)
+    assert any('could not be written' in str(w.message) for w in rec), \
+        [str(w.message) for w in rec]
+    # the report still reached stdout
+    assert 'paddle_tpu profiler' in capsys.readouterr().out
+
+
+def test_cuda_profiler_routes_output_file(tmp_path):
+    from paddle_tpu.fluid import profiler
+    out_file = str(tmp_path / 'cuda_profile.txt')
+    with fresh_program() as (main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        out = fluid.layers.mean(fluid.layers.relu(x))
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        with profiler.cuda_profiler(out_file):
+            exe.run(main, feed={'x': np.ones((2, 4), 'float32')},
+                    fetch_list=[out])
+    assert os.path.exists(out_file)
+    assert 'paddle_tpu profiler' in open(out_file).read()
+
+
+def test_profiler_context_stops_on_exception(tmp_path):
+    from paddle_tpu.fluid import profiler
+    path = str(tmp_path / 'profile')
+    with pytest.raises(RuntimeError, match='boom'):
+        with profiler.profiler('All', profile_path=path):
+            raise RuntimeError('boom')
+    # profiler disarmed AND the partial report was written
+    assert not profiler._state['active']
+    assert os.path.exists(path)
+
+
+# ---------------------------------------------------------------------------
+# bench mirrors its metrics into the same schema
+# ---------------------------------------------------------------------------
+
+def test_bench_emit_mirrors_into_run_log(tmp_path, monkeypatch, capsys):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        '_bench_under_test', os.path.join(REPO, 'bench.py'))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    d = str(tmp_path / 'obs')
+    monkeypatch.setenv('PADDLE_TPU_OBS_DIR', d)
+    obs._reset()            # follow the env again
+    try:
+        bench._emit({'metric': 'unit.test.metric', 'value': 12.5,
+                     'unit': 'widgets/sec', 'metrics': [{'nested': 1}]})
+        bench._emit({'metric': 'relayed', 'value': 1}, mirror=False)
+    finally:
+        capsys.readouterr()
+        obs._reset()
+    runs = [f for f in os.listdir(d) if f.endswith('.jsonl')]
+    assert len(runs) == 1
+    events, errors = obs_report_mod.load_events(os.path.join(d, runs[0]))
+    assert errors == []
+    bench_evs = [e for e in events if e['name'] == 'bench.metric']
+    assert len(bench_evs) == 1          # the relayed line is NOT re-logged
+    f = bench_evs[0]['fields']
+    assert f['metric'] == 'unit.test.metric' and f['value'] == 12.5
+    assert 'metrics' not in f           # the nested trajectory stays out

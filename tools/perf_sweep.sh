@@ -18,10 +18,35 @@ assert devs and devs[0].platform not in ('cpu',), devs
 " >> "$LOG" 2>&1
 }
 
+# One run log for the WHOLE sweep: pin the run-file path so every
+# obs_event below AND every child bench.py lands in the same JSONL file
+# (each python startup would otherwise open its own run-<pid> file and
+# `obs_report` with no args would summarize only the last fragment).
+# See docs/observability.md.
+if [ -n "${PADDLE_TPU_OBS_DIR:-}" ]; then
+  export PADDLE_TPU_OBS_RUN_FILE="${PADDLE_TPU_OBS_DIR}/run-sweep-$(date -u +%Y%m%dT%H%M%S)-p$$.jsonl"
+fi
+
+obs_event() {
+  # mirror one sweep timing into the structured run log (same JSONL
+  # schema as Executor/bench events; see docs/observability.md) — only
+  # when the operator exported PADDLE_TPU_OBS_DIR. obs_report --emit
+  # loads the obs package standalone, so this costs a stdlib-only
+  # python startup, not a jax import.
+  [ -n "${PADDLE_TPU_OBS_DIR:-}" ] || return 0
+  python tools/obs_report.py --emit bench.sweep.cmd "$@" >/dev/null 2>&1 \
+    || true
+}
+
 run() {
   echo "=== $* ===" | tee -a "$LOG"
+  local t0 t1 rc
+  t0=$(date +%s.%N)
   timeout "${T:-600}" "$@" >> "$LOG" 2>&1
-  echo "rc=$?" | tee -a "$LOG"
+  rc=$?
+  t1=$(date +%s.%N)
+  echo "rc=$rc" | tee -a "$LOG"
+  obs_event "cmd=$*" "rc=$rc" "dur_s=$(awk "BEGIN{printf \"%.3f\", $t1-$t0}")"
 }
 
 echo "== tunnel probe ==" | tee -a "$LOG"
