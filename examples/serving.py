@@ -1,0 +1,81 @@
+"""Serving engine demo: train a small regressor, save it, then serve it
+through paddle_tpu.serving — bucketed micro-batching, warmup, futures.
+
+Shows the production shape end to end: warmup() pre-compiles every
+batch-size bucket (steady state never compiles), concurrent clients
+submit single rows and get `concurrent.futures.Future`s back, and
+shutdown() drains cleanly. docs/serving.md is the full story.
+
+    python examples/serving.py [--requests 64] [--device CPU|TPU]
+"""
+from common import example_args, force_platform, fresh_session
+
+
+def main():
+    args = example_args(epochs=3, extra=lambda p: p.add_argument(
+        '--requests', type=int, default=64))
+    force_platform(args)
+    fresh_session()
+
+    import threading
+    import time
+
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import inference, serving
+
+    # -- train + save (fit_a_line shape, synthetic data) ------------------
+    x = fluid.layers.data(name='x', shape=[13], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+    pred = fluid.layers.fc(input=x, size=1)
+    cost = fluid.layers.mean(fluid.layers.square_error_cost(input=pred,
+                                                            label=y))
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(cost)
+
+    place = fluid.CPUPlace() if args.device == 'CPU' else fluid.TPUPlace(0)
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    xv = rng.rand(64, 13).astype('float32')
+    yv = xv.sum(1, keepdims=True).astype('float32')
+    for _ in range(args.epochs):
+        exe.run(feed={'x': xv, 'y': yv}, fetch_list=[cost])
+    fluid.io.save_inference_model(args.save_dir, ['x'], [pred], exe)
+
+    # -- serve ------------------------------------------------------------
+    predictor = inference.Predictor(args.save_dir, place=place)
+    engine = serving.ServingEngine(predictor, serving.ServingConfig(
+        max_batch_size=16, max_queue_delay_ms=2))
+    print('warmed up buckets:', engine.warmup())
+
+    results = []
+    lock = threading.Lock()
+
+    def client(wid, n):
+        crng = np.random.RandomState(wid)
+        for _ in range(n):
+            row = crng.rand(1, 13).astype('float32')
+            out, = engine.predict({'x': row}, timeout=30)
+            with lock:
+                results.append(float(out[0, 0]))
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(w, args.requests // 8))
+               for w in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    stats = engine.stats
+    engine.shutdown()
+    print('served %d requests in %d micro-batch(es), %.0f req/s'
+          % (stats['completed'], stats['batches'],
+             stats['completed'] / wall))
+    mean_pred = float(np.mean(results))
+    print('mean prediction: %.4f' % mean_pred)
+    return mean_pred
+
+
+if __name__ == '__main__':
+    main()

@@ -264,14 +264,25 @@ class _CompiledStep(object):
         assert len(ad_idxs) <= 1, "at most one append_backward per program"
         self.ad_idx = ad_idxs[0] if ad_idxs else None
         self.sparse_plan = self._sparse_embedding_plan(program)
-        # names that will exist in env and are persistable -> written back
-        produced = set(self.persist_in)
+        # Which persistables do the ops actually WRITE? Only a mutating
+        # step (training: optimizer updates, BN stats, LR counters)
+        # donates its persist buffers — in-place HBM updates — and must
+        # then re-expose EVERY donated input as an output so the scope
+        # keeps valid arrays. A read-only step (inference) donates
+        # nothing and writes nothing back: donation there would
+        # invalidate the param buffers under concurrent runs (the
+        # serving engine / multi-threaded Predictors) and the
+        # passthrough outputs would be a full param copy per step.
+        produced = set()
         persistable = {v.name for v in program.list_vars() if v.persistable}
         for op in ops:
             for vs in op.outputs.values():
                 for v in vs:
                     if v.name in persistable:
                         produced.add(v.name)
+        self.mutates_persist = bool(produced)
+        if self.mutates_persist:
+            produced |= set(self.persist_in)
         self.persist_out = sorted(produced)
 
         run_range = self._run_ops
@@ -308,7 +319,8 @@ class _CompiledStep(object):
             return fetches, new_persist, health
 
         self._step = step  # pure, un-jitted (re-jittable with shardings)
-        self._jitted = jax.jit(step, donate_argnums=(0,))
+        self._jitted = jax.jit(
+            step, donate_argnums=(0,) if self.mutates_persist else ())
 
     # optimizer ops with a SparseRows (SelectedRows-analogue) grad branch
     # in ops_impl/optim_ops.py

@@ -1,14 +1,20 @@
 """High-level Inferencer API. Parity: reference python/paddle/fluid/
 inferencer.py:31 — builds the inference program from infer_func, loads
 params saved by Trainer.save_params, and runs feeds through the Executor
-(one jitted XLA module per feed signature)."""
-import contextlib
+(one jitted XLA module per feed signature).
 
+There is ONE inference execution path: the Executor with this
+Inferencer's private scope passed explicitly (the same contract as
+paddle_tpu.inference.Predictor — no global scope_guard on the run path,
+so inferencers are thread-safe). The reference's `parallel=True`
+ParallelExecutor branch is retired: on TPU a single-feed inference step
+gains nothing from the dp mesh, and batched/concurrent serving belongs
+to paddle_tpu.serving (docs/serving.md, docs/migration.md).
+"""
 from . import framework
 from . import io
-from . import parallel_executor
 from . import unique_name
-from .executor import Executor, Scope, scope_guard
+from .executor import Executor, Scope
 from .trainer import check_and_get_place
 
 __all__ = ['Inferencer']
@@ -18,9 +24,16 @@ class Inferencer(object):
     """reference inferencer.py:31."""
 
     def __init__(self, infer_func, param_path, place=None, parallel=False):
+        if parallel:
+            import warnings
+            warnings.warn(
+                'Inferencer(parallel=True) is deprecated and ignored: '
+                'inference runs through the single Executor path; for '
+                'high-throughput concurrent inference use '
+                'paddle_tpu.serving.ServingEngine (docs/serving.md)',
+                DeprecationWarning, stacklevel=2)
         self.param_path = param_path
         self.scope = Scope()
-        self.parallel = parallel
         self.place = check_and_get_place(place)
 
         self.inference_program = framework.Program()
@@ -28,35 +41,17 @@ class Inferencer(object):
             with unique_name.guard():
                 self.predict_var = infer_func()
 
-        with self._prog_and_scope_guard():
-            io.load_params(Executor(self.place), param_path,
-                           main_program=self.inference_program)
+        self.exe = Executor(self.place)
+        io.load_params(self.exe, param_path,
+                       main_program=self.inference_program, scope=self.scope)
 
         self.inference_program = self.inference_program.clone(for_test=True)
-
-        if parallel:
-            with self._prog_and_scope_guard():
-                self.exe = parallel_executor.ParallelExecutor(
-                    use_cuda=False, loss_name=self.predict_var.name,
-                    main_program=self.inference_program, scope=self.scope)
-        else:
-            self.exe = Executor(self.place)
 
     def infer(self, inputs, return_numpy=True):
         """reference inferencer.py:79."""
         if not isinstance(inputs, dict):
             raise ValueError(
                 "inputs should be a map of {'input_name': input_var}")
-        with scope_guard(self.scope):
-            if self.parallel:
-                return self.exe.run([self.predict_var.name], feed=inputs,
-                                    return_numpy=return_numpy)
-            return self.exe.run(self.inference_program, feed=inputs,
-                                fetch_list=[self.predict_var],
-                                return_numpy=return_numpy)
-
-    @contextlib.contextmanager
-    def _prog_and_scope_guard(self):
-        with framework.program_guard(main_program=self.inference_program):
-            with scope_guard(self.scope):
-                yield
+        return self.exe.run(self.inference_program, feed=inputs,
+                            fetch_list=[self.predict_var],
+                            return_numpy=return_numpy, scope=self.scope)

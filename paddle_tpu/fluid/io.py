@@ -79,8 +79,11 @@ def save_persistables(executor, dirname, main_program=None, filename=None):
 
 
 def load_vars(executor, dirname, main_program=None, vars=None,
-              predicate=None, filename=None):
-    """reference io.py:load_vars."""
+              predicate=None, filename=None, scope=None):
+    """reference io.py:load_vars. `scope` defaults to the process-global
+    scope (the compat path); callers that own a private Scope — Predictor,
+    Inferencer, the serving engine — pass it explicitly so concurrent
+    loads never race on the global scope_guard."""
     if vars is None:
         if main_program is None:
             main_program = default_main_program()
@@ -88,7 +91,8 @@ def load_vars(executor, dirname, main_program=None, vars=None,
     import jax.numpy as jnp
     path = os.path.join(dirname, filename or _PARAMS_FILE)
     data = np.load(path)
-    scope = global_scope()
+    if scope is None:
+        scope = global_scope()
     for var in vars:
         name = var.name if isinstance(var, Variable) else str(var)
         if name not in data:
@@ -96,12 +100,16 @@ def load_vars(executor, dirname, main_program=None, vars=None,
         scope.vars[name] = jnp.asarray(data[name])
 
 
-def load_params(executor, dirname, main_program=None, filename=None):
-    load_vars(executor, dirname, main_program, None, is_parameter, filename)
+def load_params(executor, dirname, main_program=None, filename=None,
+                scope=None):
+    load_vars(executor, dirname, main_program, None, is_parameter, filename,
+              scope=scope)
 
 
-def load_persistables(executor, dirname, main_program=None, filename=None):
-    load_vars(executor, dirname, main_program, None, is_persistable, filename)
+def load_persistables(executor, dirname, main_program=None, filename=None,
+                      scope=None):
+    load_vars(executor, dirname, main_program, None, is_persistable, filename,
+              scope=scope)
 
 
 def get_inference_program(target_vars, main_program=None):
@@ -139,13 +147,15 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
 
 
 def load_inference_model(dirname, executor, model_filename=None,
-                         params_filename=None):
+                         params_filename=None, scope=None):
     """reference io.py:load_inference_model -> (program, feed_names,
-    fetch_vars)."""
+    fetch_vars). `scope` as in load_vars: None keeps the global-scope
+    compat behavior; Predictor passes its private scope."""
     with open(os.path.join(dirname, model_filename or _PROGRAM_FILE)) as f:
         meta = json.load(f)
     program = Program._from_dict(meta['program'])
-    load_persistables(executor, dirname, program, params_filename)
+    load_persistables(executor, dirname, program, params_filename,
+                      scope=scope)
     fetch_vars = [program.global_block()._var_recursive(n)
                   for n in meta['fetch_names']]
     return [program, meta['feed_names'], fetch_vars]

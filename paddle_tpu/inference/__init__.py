@@ -25,17 +25,23 @@ _META = '__model__.meta.json'
 
 class Predictor(object):
     """Load + run a saved inference model (reference: NativePaddlePredictor,
-    inference/api/api_impl.cc)."""
+    inference/api/api_impl.cc).
+
+    Thread-safe: the model's variables live in a PRIVATE scope that is
+    passed explicitly through `Executor.run(scope=...)` — never via the
+    process-global `scope_guard`, which two predictors (or two threads
+    on one predictor) would race on. The serving engine
+    (paddle_tpu.serving) relies on this."""
 
     def __init__(self, dirname, place=None):
         from ..fluid import core, io
-        from ..fluid.executor import Executor, Scope, scope_guard
+        from ..fluid.executor import Executor, Scope
         self._scope = Scope()
         self._place = place or (core.TPUPlace(0) if core.is_compiled_with_tpu()
                                 else core.CPUPlace())
         self._exe = Executor(self._place)
-        with scope_guard(self._scope):
-            prog, feeds, fetches = io.load_inference_model(dirname, self._exe)
+        prog, feeds, fetches = io.load_inference_model(dirname, self._exe,
+                                                       scope=self._scope)
         self._program = prog
         self.feed_names = feeds
         self._fetch_vars = fetches
@@ -44,12 +50,23 @@ class Predictor(object):
     def fetch_names(self):
         return [v.name for v in self._fetch_vars]
 
+    @property
+    def input_spec(self):
+        """{feed name: (shape, dtype str)} from the loaded program; the
+        leading batch dim is -1 (any). The serving engine's warmup uses
+        this to build per-bucket feeds without an example."""
+        blk = self._program.global_block()
+        spec = {}
+        for n in self.feed_names:
+            v = blk.vars.get(n)
+            if v is not None:
+                spec[n] = (tuple(int(d) for d in v.shape), str(v.dtype))
+        return spec
+
     def run(self, feed):
         """feed: dict name -> ndarray/LoDTensor. Returns list of ndarrays."""
-        from ..fluid.executor import scope_guard
-        with scope_guard(self._scope):
-            return self._exe.run(self._program, feed=feed,
-                                 fetch_list=self._fetch_vars)
+        return self._exe.run(self._program, feed=feed,
+                             fetch_list=self._fetch_vars, scope=self._scope)
 
 
 def export_compiled(dirname, feed_example, target_vars, executor,
@@ -66,6 +83,9 @@ def export_compiled(dirname, feed_example, target_vars, executor,
 
     import jax
     import jax.numpy as jnp
+    # jax>=0.4.30 ships export as a real submodule that must be imported
+    # explicitly (the bare `jax.export` attribute was removed)
+    from jax import export as jax_export
 
     from ..fluid import framework
     from ..fluid.executor import global_scope
@@ -112,35 +132,91 @@ def export_compiled(dirname, feed_example, target_vars, executor,
         return [f.data if isinstance(f, SeqValue) else f for f in fetches]
 
     args = [jnp.asarray(feed_example[n]) for n in feed_names]
-    exported = jax.export.export(jax.jit(fn))(*args)
+    exported = jax_export.export(jax.jit(fn))(*args)
     os.makedirs(dirname, exist_ok=True)
     path = os.path.join(dirname, _ARTIFACT)
     with open(path, 'wb') as f:
         f.write(exported.serialize())
+    # per-input shapes/dtypes AS EXPORTED (post jnp.asarray, so an int64
+    # example records the int32 the x64-disabled module actually takes):
+    # load_compiled validates feeds against these instead of letting jax
+    # fail deep inside exported.call
+    inputs = {n: {'shape': list(a.shape), 'dtype': str(a.dtype)}
+              for n, a in zip(feed_names, args)}
     with open(os.path.join(dirname, _META), 'w') as f:
         json.dump({'feed_names': feed_names, 'fetch_names': fetch_names,
+                   'inputs': inputs,
                    'stablehlo': exported.mlir_module()[:10000]}, f)
     return path
 
 
 def load_compiled(dirname):
-    """Load an export_compiled artifact -> callable(feed dict) -> [np]."""
+    """Load an export_compiled artifact -> callable(feed dict) -> [np].
+
+    Feeds are validated against the per-input shapes/dtypes recorded in
+    `__model__.meta.json` at export time: a missing/unknown name, a
+    wrong shape (the exported module is FIXED-shape, batch dim
+    included), or an unsafely-cast dtype raises a ValueError naming the
+    offending input instead of failing deep inside `exported.call`.
+    Artifacts exported before the meta carried `inputs` skip the
+    shape/dtype checks."""
     import json
 
-    import jax
     import jax.numpy as jnp
+    from jax import export as jax_export
 
     with open(os.path.join(dirname, _ARTIFACT), 'rb') as f:
-        exported = jax.export.deserialize(f.read())
+        exported = jax_export.deserialize(f.read())
     with open(os.path.join(dirname, _META)) as f:
         meta = json.load(f)
     feed_names = meta['feed_names']
+    inputs = meta.get('inputs') or {}
+
+    def _validated(name, val):
+        a = np.asarray(val)
+        spec = inputs.get(name)
+        if spec is None:
+            return jnp.asarray(a)
+        want_shape = tuple(spec['shape'])
+        want_dtype = np.dtype(spec['dtype'])
+        if a.dtype != want_dtype:
+            # accept safe casts plus WITHIN-kind narrowing (int64->int32,
+            # float64->float32: what jnp.asarray already applied silently
+            # under disabled x64); reject kind-crossing unsafe casts
+            # (int32 fed to a float32 input is a client bug worth naming)
+            if np.can_cast(a.dtype, want_dtype, 'safe') or (
+                    a.dtype.kind == want_dtype.kind
+                    and np.can_cast(a.dtype, want_dtype, 'same_kind')):
+                a = a.astype(want_dtype)
+            else:
+                raise ValueError(
+                    'input %r: dtype %s cannot safely cast to the '
+                    'exported dtype %s' % (name, a.dtype, want_dtype))
+        if tuple(a.shape) != want_shape:
+            raise ValueError(
+                'input %r: shape %r does not match the exported shape %r '
+                '(the compiled artifact is fixed-shape; pad/bucket the '
+                'feed, e.g. via paddle_tpu.serving)'
+                % (name, tuple(a.shape), want_shape))
+        return jnp.asarray(a)
 
     def run(feed):
-        args = [jnp.asarray(np.asarray(feed[n])) for n in feed_names]
+        missing = [n for n in feed_names if n not in feed]
+        if missing:
+            raise ValueError(
+                'missing input(s) %r; the artifact expects exactly %r'
+                % (missing, feed_names))
+        extra = sorted(set(feed) - set(feed_names))
+        if extra:
+            raise ValueError(
+                'unknown input(s) %r; the artifact expects exactly %r'
+                % (extra, feed_names))
+        args = [_validated(n, feed[n]) for n in feed_names]
         out = exported.call(*args)
         return [np.asarray(o) for o in out]
 
     run.feed_names = feed_names
     run.fetch_names = meta['fetch_names']
+    run.input_spec = {n: (tuple(s['shape']), s['dtype'])
+                      for n, s in inputs.items()}
     return run

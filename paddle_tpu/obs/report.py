@@ -282,6 +282,64 @@ def summarize(events):
     else:
         lines.append('no checkpoint activity')
 
+    # -- serving ----------------------------------------------------------
+    sv_batches = _spans(events, 'serving.batch')
+    sv_warm = _spans(events, 'serving.warmup')
+    sv_rejects = _events(events, 'serving.reject')
+    sv_sheds = _events(events, 'serving.shed')
+    sv_errors = _events(events, 'serving.batch.error')
+    sv_down = _events(events, 'serving.shutdown')
+    if sv_batches or sv_warm or sv_rejects or sv_sheds or sv_errors \
+            or sv_down:
+        lines.append('')
+        lines.append('-- serving --')
+        if sv_warm:
+            per_bucket = ', '.join(
+                'b%s %s' % (s.get('fields', {}).get('bucket', '?'),
+                            _fmt_s(s['dur_s']))
+                for s in sorted(sv_warm, key=lambda s: s.get(
+                    'fields', {}).get('bucket', 0)))
+            lines.append('warmup: %d bucket(s) pre-compiled (%s)'
+                         % (len(sv_warm), per_bucket))
+        if sv_batches:
+            sizes = [s.get('fields', {}).get('batch_size', 0)
+                     for s in sv_batches]
+            pads = [s.get('fields', {}).get('padded', 0)
+                    for s in sv_batches]
+            waits = [s.get('fields', {}).get('wait_max_s')
+                     for s in sv_batches]
+            waits = [w for w in waits if isinstance(w, (int, float))]
+            execs = [s['dur_s'] for s in sv_batches]
+            rows = sum(sizes)
+            lines.append('batches: %d (%d row(s); batch size p50 %s max %s; '
+                         'padding overhead %.1f%%)'
+                         % (len(sv_batches), rows,
+                            percentile_exact(sizes, 50), max(sizes),
+                            100.0 * sum(pads) / max(rows + sum(pads), 1)))
+            lines.append('exec latency: p50 %s  p95 %s  max %s'
+                         % (_fmt_s(percentile_exact(execs, 50)),
+                            _fmt_s(percentile_exact(execs, 95)),
+                            _fmt_s(max(execs))))
+            if waits:
+                lines.append('queue wait (batch max): p50 %s  max %s'
+                             % (_fmt_s(percentile_exact(waits, 50)),
+                                _fmt_s(max(waits))))
+        if sv_rejects or sv_sheds:
+            lines.append('overload: %d rejected, %d shed past deadline'
+                         % (len(sv_rejects), len(sv_sheds)))
+        for e in sv_errors:
+            f = e.get('fields', {})
+            lines.append('  batch ERROR (%s request(s)): %s'
+                         % (f.get('requests', '?'),
+                            str(f.get('error', ''))[:80]))
+        for e in sv_down:
+            f = e.get('fields', {})
+            lines.append('shutdown: drained=%s clean=%s completed=%s '
+                         'shed=%s' % (f.get('drained', '?'),
+                                      f.get('clean', '?'),
+                                      f.get('completed', '?'),
+                                      f.get('shed', '?')))
+
     # -- bench ------------------------------------------------------------
     bench = _events(events, 'bench.metric') \
         + _events(events, 'bench.sweep.cmd')

@@ -1,0 +1,25 @@
+"""jax version compatibility for the parallel layer.
+
+jax >= 0.6 exposes `jax.shard_map` with `axis_names=` (the MANUAL axis
+set) and `check_vma=`; earlier releases only have
+`jax.experimental.shard_map.shard_map` with the complementary `auto=`
+frozenset and `check_rep=`. One shim so every shard_map call site in
+this package writes the modern signature.
+"""
+try:  # jax>=0.6
+    from jax import shard_map  # noqa: F401
+except ImportError:  # pragma: no cover — depends on the installed jax
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs, axis_names=None,
+                  check_vma=True):
+        names = mesh.axis_names if axis_names is None else axis_names
+        auto = frozenset(mesh.axis_names) - frozenset(names)
+        # check_vma maps onto check_rep, except that partially-auto maps
+        # cannot check at all in this jax; an explicit check_vma=False
+        # (pallas bodies whose ShapeDtypeStructs carry no replication
+        # info) must stay honored
+        return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs,
+                              check_rep=bool(check_vma) and not auto,
+                              auto=auto)

@@ -1,0 +1,28 @@
+"""paddle_tpu.serving — dynamic micro-batching serving engine.
+
+The inference counterpart of the fault-tolerant training runtime: wraps
+a loaded model (`paddle_tpu.inference.Predictor` or a `load_compiled`
+StableHLO runner) behind an async request API with continuous
+micro-batching, shape buckets (a closed set of compiled signatures +
+startup warmup = zero steady-state compiles), admission control with
+typed overload errors, per-request deadlines, and a draining shutdown.
+See docs/serving.md; run the serving test tier with `pytest -m serving`.
+
+    from paddle_tpu import inference, serving
+
+    pred = inference.Predictor(model_dir)
+    eng = serving.ServingEngine(pred, serving.ServingConfig(
+        max_batch_size=32, max_queue_delay_ms=5))
+    eng.warmup()                       # pre-compile every bucket
+    fut = eng.submit({'x': batch})     # concurrent.futures.Future
+    probs, = fut.result()
+    eng.shutdown()                     # drains in-flight requests
+"""
+from . import buckets  # noqa: F401
+from .buckets import default_buckets, pad_rows, pick_bucket  # noqa: F401
+from .engine import (DeadlineExceeded, ServerClosed,  # noqa: F401
+                     ServerOverloaded, ServingConfig, ServingEngine)
+
+__all__ = ['ServingEngine', 'ServingConfig', 'ServerOverloaded',
+           'ServerClosed', 'DeadlineExceeded', 'buckets',
+           'default_buckets', 'pick_bucket', 'pad_rows']

@@ -59,3 +59,9 @@ def test_high_level_api_example(tmp_path):
 def test_parallelism_example():
     loss = _run_example('parallelism', ['--steps', '2'])
     assert np.isfinite(loss)
+
+
+def test_serving_example(tmp_path):
+    pred = _run_example('serving', ['--requests', '32',
+                                    '--save_dir', str(tmp_path)])
+    assert np.isfinite(pred)

@@ -1,6 +1,9 @@
 """Inference deployment: Predictor (program bundle) + compiled StableHLO
 artifact (jax.export). Parity: reference inference/api tests + capi."""
+import threading
+
 import numpy as np
+import pytest
 
 import paddle_tpu.fluid as fluid
 import paddle_tpu.fluid.layers as layers
@@ -40,11 +43,82 @@ def test_predictor_matches_training_graph(tmp_path):
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
 
 
+def test_predictor_concurrent_threads_no_global_scope_race(tmp_path):
+    """Two Predictors over DIFFERENT weights running on different threads
+    must not race on the process-global scope: each run passes its
+    private scope explicitly through Executor.run(scope=...) (the old
+    scope_guard entry mutated the global and corrupted concurrent
+    runs). Regression test for the serving PR's thread-safety fix."""
+    from paddle_tpu.fluid.executor import global_scope
+    dirs, wants = [], []
+    xv = np.random.RandomState(0).rand(4, 8).astype('float32')
+    for k in range(2):
+        d = tmp_path / ('m%d' % k)
+        with fresh_program() as (main, startup):
+            x = layers.data(name='x', shape=[8])
+            pred = layers.fc(
+                input=x, size=1,
+                param_attr=fluid.ParamAttr(
+                    initializer=fluid.initializer.Constant(float(k + 1))))
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            fluid.io.save_inference_model(str(d), ['x'], [pred], exe,
+                                          main_program=main)
+            want, = exe.run(main.clone(for_test=True).prune([pred]),
+                            feed={'x': xv}, fetch_list=[pred])
+        dirs.append(str(d))
+        wants.append(want)
+    base_scope = global_scope()
+    preds = [inference.Predictor(d, place=fluid.CPUPlace()) for d in dirs]
+    errors = []
+
+    def hammer(k):
+        try:
+            for _ in range(20):
+                got, = preds[k].run({'x': xv})
+                np.testing.assert_allclose(got, wants[k], rtol=1e-5,
+                                           atol=1e-6)
+        except Exception as e:  # noqa: BLE001 — surface in the main thread
+            errors.append((k, e))
+
+    ts = [threading.Thread(target=hammer, args=(k,)) for k in (0, 1, 0, 1)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    assert errors == []
+    # the predictors' private vars never leaked into the global scope
+    assert global_scope() is base_scope
+    assert all(n not in base_scope.vars for p in preds
+               for n in p._scope.vars)
+
+
 def test_compiled_artifact_round_trip(tmp_path):
     xv, want = _build_and_save(tmp_path, compiled=True)
     run = inference.load_compiled(str(tmp_path))
     assert run.feed_names == ['x']
     got, = run({'x': xv})
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_compiled_artifact_validates_feeds(tmp_path):
+    """load_compiled checks names/dtypes/shapes against the exported
+    meta and names the offending input, instead of failing deep inside
+    exported.call."""
+    xv, want = _build_and_save(tmp_path, compiled=True)
+    run = inference.load_compiled(str(tmp_path))
+    assert run.input_spec == {'x': ((4, 8), 'float32')}
+    with pytest.raises(ValueError, match="missing input.*'x'"):
+        run({})
+    with pytest.raises(ValueError, match="unknown input.*'bogus'"):
+        run({'x': xv, 'bogus': xv})
+    with pytest.raises(ValueError, match="input 'x'.*shape.*exported"):
+        run({'x': xv[:2]})
+    with pytest.raises(ValueError, match="input 'x'.*dtype"):
+        run({'x': xv.astype('int32')})
+    # same-kind narrowing stays accepted (float64 fed what was exported
+    # as float32 — the narrowing jnp.asarray always applied)
+    got, = run({'x': xv.astype('float64')})
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
 
 

@@ -1,0 +1,487 @@
+"""Serving-engine drills: micro-batching, shape buckets, warmup,
+admission control, deadlines, retry/degrade, and draining shutdown —
+each fault drill driven through the seeded injection harness
+(paddle_tpu.utils.faults) and asserting the matching obs events were
+recorded, the PR-2 pattern from tests/test_faults.py.
+
+All tests run on the CPU platform; the engine is host-side threading
+around the ordinary executor path, so nothing here is TPU-specific.
+Marker: `serving` (pytest -m serving).
+"""
+import signal
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.fluid.layers as layers
+from paddle_tpu import inference, obs, serving
+from paddle_tpu.obs import report as obs_report
+from paddle_tpu.utils.faults import FaultInjector, send_preemption
+from paddle_tpu.utils.retry import RetryError
+
+from util import fresh_program
+
+pytestmark = pytest.mark.serving
+
+
+@pytest.fixture
+def obs_events(tmp_path):
+    """Run-log reader: drills verify behavior AND that an operator could
+    have seen it happen (docs/serving.md event catalog)."""
+    obs.enable(str(tmp_path / 'obs'))
+
+    def read(name=None):
+        path = obs.run_log_path()
+        if path is None:
+            return []
+        events, errors = obs_report.load_events(path)
+        assert errors == [], errors
+        return [e for e in events if name is None or e['name'] == name]
+
+    try:
+        yield read
+    finally:
+        obs._reset()
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _save_model(dirname, in_dim=8, out_dim=3):
+    """Train-a-little + save an inference bundle; returns (x, want_fn)
+    where want_fn maps a feed batch to the expected prediction."""
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[in_dim])
+        y = layers.data(name='y', shape=[1], dtype='int64')
+        h = layers.fc(input=x, size=16, act='relu')
+        pred = layers.fc(input=h, size=out_dim, act='softmax')
+        loss = layers.mean(layers.cross_entropy(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        xv = rng.rand(16, in_dim).astype('float32')
+        yv = rng.randint(0, out_dim, (16, 1)).astype('int64')
+        exe.run(main, feed={'x': xv, 'y': yv}, fetch_list=[loss])
+        fluid.io.save_inference_model(str(dirname), ['x'], [pred], exe,
+                                      main_program=main)
+    return xv
+
+
+class _FakeModel(object):
+    """Host-side stand-in: `run` is any callable over the batched feed —
+    how the fault drills inject flaky/stalling behavior without touching
+    the compiled path."""
+    feed_names = ['x']
+
+    def __init__(self, fn=None):
+        self._fn = fn or (lambda feed: [np.asarray(feed['x']) * 2.0])
+        self.calls = 0
+
+    def run(self, feed):
+        self.calls += 1
+        return self._fn(feed)
+
+
+class _GatedModel(_FakeModel):
+    """Blocks every batch on an Event — freezes the batcher so drills
+    can fill the queue / expire deadlines deterministically."""
+
+    def __init__(self):
+        super(_GatedModel, self).__init__()
+        self.gate = threading.Event()
+        self.entered = threading.Event()
+
+    def run(self, feed):
+        self.entered.set()
+        assert self.gate.wait(30), 'drill deadlock: gate never opened'
+        return super(_GatedModel, self).run(feed)
+
+
+# ---------------------------------------------------------------------------
+# buckets
+# ---------------------------------------------------------------------------
+
+def test_default_buckets_powers_of_two():
+    assert serving.default_buckets(32) == (1, 2, 4, 8, 16, 32)
+    assert serving.default_buckets(24) == (1, 2, 4, 8, 16, 24)
+    assert serving.default_buckets(1) == (1,)
+
+
+def test_pick_bucket_and_pad_rows():
+    bs = serving.default_buckets(8)
+    assert serving.pick_bucket(1, bs) == 1
+    assert serving.pick_bucket(3, bs) == 4
+    assert serving.pick_bucket(8, bs) == 8
+    with pytest.raises(ValueError):
+        serving.pick_bucket(9, bs)
+    a = np.arange(6, dtype='float32').reshape(3, 2)
+    p = serving.pad_rows(a, 4)
+    assert p.shape == (4, 2)
+    # padding repeats the LAST row (keeps int ids in-vocabulary)
+    np.testing.assert_array_equal(p[3], a[2])
+    assert serving.pad_rows(a, 3) is a
+
+
+# ---------------------------------------------------------------------------
+# correctness: engine output == direct Predictor.run
+# ---------------------------------------------------------------------------
+
+def test_engine_matches_predictor(tmp_path):
+    xv = _save_model(tmp_path)
+    pred = inference.Predictor(str(tmp_path), place=fluid.CPUPlace())
+    want, = pred.run({'x': xv})
+    eng = serving.ServingEngine(pred, serving.ServingConfig(
+        max_batch_size=8, max_queue_delay_ms=2))
+    try:
+        # variable request sizes scatter back to exactly their own rows
+        futs, offs = [], []
+        off = 0
+        for n in (1, 3, 2, 4, 1, 5):
+            futs.append(eng.submit({'x': xv[off:off + n]}))
+            offs.append((off, n))
+            off += n
+        for fut, (off, n) in zip(futs, offs):
+            got, = fut.result(30)
+            np.testing.assert_allclose(got, want[off:off + n],
+                                       rtol=1e-5, atol=1e-6)
+    finally:
+        assert eng.shutdown()
+
+
+def test_batches_coalesce_under_concurrency(tmp_path):
+    xv = _save_model(tmp_path)
+    pred = inference.Predictor(str(tmp_path), place=fluid.CPUPlace())
+    eng = serving.ServingEngine(pred, serving.ServingConfig(
+        max_batch_size=16, max_queue_delay_ms=20))
+    try:
+        eng.warmup()
+        futs = [eng.submit({'x': xv[i:i + 1]}) for i in range(16)]
+        for f in futs:
+            f.result(30)
+        stats = eng.stats
+        assert stats['completed'] == 16
+        # the whole burst must NOT have run request-at-a-time
+        assert stats['batches'] < 16
+    finally:
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# warmup: closed signature set, zero steady-state compiles
+# ---------------------------------------------------------------------------
+
+def test_warmup_then_zero_steady_state_compiles(tmp_path, obs_events):
+    xv = _save_model(tmp_path)
+    pred = inference.Predictor(str(tmp_path), place=fluid.CPUPlace())
+    eng = serving.ServingEngine(pred, serving.ServingConfig(
+        max_batch_size=8, max_queue_delay_ms=1))
+    try:
+        # warmup derives per-bucket feeds from Predictor.input_spec
+        assert eng.warmup() == [1, 2, 4, 8]
+        assert eng.stats['warm']
+        misses0 = pred._exe.cache_stats['misses']
+        compiles0 = len([e for e in obs_events('executor.compile')])
+        for n in (1, 2, 3, 4, 5, 6, 7, 8, 3, 1):   # every bucket, twice+
+            eng.predict({'x': xv[:n]}, timeout=30)
+        # steady state: ZERO new lowered signatures and ZERO compile
+        # events in the run log — the acceptance criterion
+        assert pred._exe.cache_stats['misses'] == misses0
+        assert len(obs_events('executor.compile')) == compiles0
+        warm = obs_events('serving.warmup')
+        assert sorted(e['fields']['bucket'] for e in warm) == [1, 2, 4, 8]
+        batches = obs_events('serving.batch')
+        assert batches and all(e['fields']['warm'] for e in batches)
+    finally:
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# admission control: overflow policies
+# ---------------------------------------------------------------------------
+
+def _engine_with_full_queue(model, overflow, capacity=2):
+    eng = serving.ServingEngine(model, serving.ServingConfig(
+        max_batch_size=1, max_queue_delay_ms=0, queue_capacity=capacity,
+        overflow=overflow))
+    first = eng.submit({'x': np.zeros((1, 2), 'float32')})
+    assert model.entered.wait(10)   # batcher is now stalled inside run()
+    queued = [eng.submit({'x': np.zeros((1, 2), 'float32')})
+              for _ in range(capacity)]
+    return eng, first, queued
+
+
+def test_queue_overflow_reject_policy(obs_events):
+    model = _GatedModel()
+    eng, first, queued = _engine_with_full_queue(model, 'reject')
+    try:
+        rejected0 = obs.REGISTRY.total('serving.rejected')
+        with pytest.raises(serving.ServerOverloaded):
+            eng.submit({'x': np.zeros((1, 2), 'float32')})
+        assert obs.REGISTRY.total('serving.rejected') == rejected0 + 1
+        rej = obs_events('serving.reject')
+        assert rej and rej[-1]['fields']['capacity'] == 2
+        # never deadlocks: the stalled engine still drains cleanly
+        model.gate.set()
+        assert eng.shutdown(timeout=30)
+        for f in [first] + queued:
+            assert f.result(30)  # every admitted future completed
+    finally:
+        model.gate.set()
+        eng.shutdown()
+
+
+def test_queue_overflow_block_policy():
+    model = _GatedModel()
+    eng, first, queued = _engine_with_full_queue(model, 'block')
+    try:
+        late = {}
+
+        def blocked_submit():
+            late['fut'] = eng.submit({'x': np.zeros((1, 2), 'float32')})
+
+        t = threading.Thread(target=blocked_submit)
+        t.start()
+        t.join(0.15)
+        assert t.is_alive()          # submit is blocking on a full queue
+        model.gate.set()             # space opens as batches drain
+        t.join(30)
+        assert not t.is_alive()
+        assert late['fut'].result(30)
+        for f in [first] + queued:
+            assert f.result(30)
+    finally:
+        model.gate.set()
+        eng.shutdown()
+
+
+def test_block_policy_submit_timeout():
+    model = _GatedModel()
+    eng, first, queued = _engine_with_full_queue(model, 'block',
+                                                 capacity=1)
+    try:
+        with pytest.raises(serving.ServerOverloaded):
+            eng.submit({'x': np.zeros((1, 2), 'float32')}, timeout=0.05)
+    finally:
+        model.gate.set()
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# deadlines: expired work is shed before batching
+# ---------------------------------------------------------------------------
+
+def test_deadline_expired_requests_shed(obs_events):
+    model = _GatedModel()
+    eng = serving.ServingEngine(model, serving.ServingConfig(
+        max_batch_size=4, max_queue_delay_ms=0))
+    try:
+        first = eng.submit({'x': np.zeros((1, 2), 'float32')})
+        assert model.entered.wait(10)
+        doomed = eng.submit({'x': np.zeros((1, 2), 'float32')},
+                            deadline_ms=20)
+        alive = eng.submit({'x': np.zeros((1, 2), 'float32')})
+        time.sleep(0.08)             # the deadline passes while queued
+        shed0 = obs.REGISTRY.total('serving.shed')
+        model.gate.set()
+        with pytest.raises(serving.DeadlineExceeded):
+            doomed.result(30)
+        assert first.result(30) and alive.result(30)
+        assert obs.REGISTRY.total('serving.shed') == shed0 + 1
+        shed = obs_events('serving.shed')
+        assert shed and shed[-1]['fields']['waited_s'] >= 0.02
+        assert eng.stats['shed'] == 1
+    finally:
+        model.gate.set()
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# faults: flaky model callable — retry, then degrade
+# ---------------------------------------------------------------------------
+
+def test_flaky_model_retries_then_succeeds(obs_events):
+    inj = FaultInjector(seed=7)
+    ok = lambda feed: [np.asarray(feed['x']) + 1.0]
+    model = _FakeModel(inj.flaky(ok, fail_times=2))
+    eng = serving.ServingEngine(model, serving.ServingConfig(
+        max_batch_size=4, max_queue_delay_ms=0, max_retries=3,
+        retry_base_delay_ms=1.0))
+    try:
+        got, = eng.predict({'x': np.zeros((2, 3), 'float32')}, timeout=30)
+        np.testing.assert_allclose(got, np.ones((2, 3), 'float32'))
+        # the retry layer absorbed exactly the injected failures, and
+        # telemetry shows WHERE: site=serving.batch
+        attempts = [e for e in obs_events('retry.attempt')
+                    if e['fields']['site'] == 'serving.batch']
+        assert len(attempts) == 2
+        assert eng.stats['batch_errors'] == 0
+    finally:
+        eng.shutdown()
+
+
+def test_flaky_model_exhausts_retries_and_degrades(obs_events):
+    # retries=1 -> 2 calls per batch: the first batch burns calls 1-2 and
+    # exhausts; the next request heals on its own retry (calls 3 fails,
+    # 4 succeeds)
+    inj = FaultInjector(seed=8)
+    ok = lambda feed: [np.asarray(feed['x']) + 1.0]
+    model = _FakeModel(inj.flaky(ok, fail_times=3))
+    eng = serving.ServingEngine(model, serving.ServingConfig(
+        max_batch_size=4, max_queue_delay_ms=0, max_retries=1,
+        retry_base_delay_ms=1.0))
+    try:
+        errors0 = obs.REGISTRY.total('serving.batch.errors')
+        fut = eng.submit({'x': np.zeros((1, 3), 'float32')})
+        with pytest.raises(RetryError):
+            fut.result(30)
+        # DEGRADED, not dead: the failed batch's futures got the error,
+        # the engine keeps serving (flaky heals at call #6)
+        got, = eng.predict({'x': np.zeros((1, 3), 'float32')}, timeout=30)
+        np.testing.assert_allclose(got, np.ones((1, 3), 'float32'))
+        assert obs.REGISTRY.total('serving.batch.errors') == errors0 + 1
+        errs = obs_events('serving.batch.error')
+        assert errs and 'injected transient failure' in \
+            errs[-1]['fields']['error']
+    finally:
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# shutdown: drain semantics + SIGTERM (the Trainer preemption pattern)
+# ---------------------------------------------------------------------------
+
+def test_shutdown_drains_no_lost_futures(obs_events):
+    model = _FakeModel(lambda feed: (time.sleep(0.002),
+                                     [np.asarray(feed['x'])])[1])
+    eng = serving.ServingEngine(model, serving.ServingConfig(
+        max_batch_size=2, max_queue_delay_ms=0))
+    futs = [eng.submit({'x': np.zeros((1, 2), 'float32')})
+            for _ in range(12)]
+    assert eng.shutdown(drain=True, timeout=60)
+    for f in futs:
+        assert f.result(1) is not None   # already resolved: drained
+    with pytest.raises(serving.ServerClosed):
+        eng.submit({'x': np.zeros((1, 2), 'float32')})
+    down = obs_events('serving.shutdown')
+    assert down and down[-1]['fields']['drained'] \
+        and down[-1]['fields']['clean']
+    assert eng.stats['completed'] == 12
+
+
+def test_shutdown_without_drain_fails_queued_futures():
+    model = _GatedModel()
+    eng = serving.ServingEngine(model, serving.ServingConfig(
+        max_batch_size=1, max_queue_delay_ms=0, queue_capacity=8))
+    first = eng.submit({'x': np.zeros((1, 2), 'float32')})
+    assert model.entered.wait(10)
+    queued = [eng.submit({'x': np.zeros((1, 2), 'float32')})
+              for _ in range(3)]
+    t = threading.Thread(target=lambda: (time.sleep(0.05),
+                                         model.gate.set()))
+    t.start()
+    assert eng.shutdown(drain=False, timeout=30)
+    t.join()
+    assert first.result(30)              # in-flight batch still finished
+    for f in queued:                     # queued ones failed typed, not lost
+        with pytest.raises(serving.ServerClosed):
+            f.result(1)
+
+
+def test_sigterm_during_drain(obs_events):
+    """SIGTERM while requests are in flight: the handler (flag-only,
+    like Trainer preemption) closes admission; shutdown() drains every
+    queued request — no future is ever lost."""
+    model = _FakeModel(lambda feed: (time.sleep(0.002),
+                                     [np.asarray(feed['x'])])[1])
+    eng = serving.ServingEngine(model, serving.ServingConfig(
+        max_batch_size=2, max_queue_delay_ms=0))
+    futs = [eng.submit({'x': np.zeros((1, 2), 'float32')})
+            for _ in range(16)]
+    prev = signal.signal(signal.SIGTERM,
+                         lambda sig, frame: eng.request_shutdown())
+    try:
+        send_preemption(signal.SIGTERM)
+        # admission is (or is about to be) closed; draining still works
+        assert eng.shutdown(drain=True, timeout=60)
+        for f in futs:
+            assert f.result(1) is not None
+        with pytest.raises(serving.ServerClosed):
+            eng.submit({'x': np.zeros((1, 2), 'float32')})
+        down = obs_events('serving.shutdown')
+        assert down and down[-1]['fields']['completed'] == 16
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+# ---------------------------------------------------------------------------
+# compiled artifact path + feed validation
+# ---------------------------------------------------------------------------
+
+def test_engine_over_compiled_artifact(tmp_path):
+    """A load_compiled StableHLO runner serves through the engine with
+    its ONE exported batch size as the single bucket."""
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[6])
+        pred = layers.fc(input=x, size=2)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        xv = np.random.RandomState(3).rand(4, 6).astype('float32')
+        inference.export_compiled(str(tmp_path), {'x': xv}, [pred], exe,
+                                  main_program=main)
+        want, = exe.run(main.clone(for_test=True).prune([pred]),
+                        feed={'x': xv}, fetch_list=[pred])
+    run = inference.load_compiled(str(tmp_path))
+    assert run.input_spec['x'] == ((4, 6), 'float32')
+    eng = serving.ServingEngine(run, serving.ServingConfig(
+        max_batch_size=4, buckets=[4], max_queue_delay_ms=5))
+    try:
+        eng.warmup()                 # zeros feed from the exported spec
+        futs = [eng.submit({'x': xv[i:i + 2]}) for i in (0, 2)]
+        got = np.concatenate([f.result(30)[0] for f in futs], axis=0)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    finally:
+        eng.shutdown()
+
+
+def test_submit_validates_feed():
+    eng = serving.ServingEngine(_FakeModel(), serving.ServingConfig(
+        max_batch_size=4))
+    try:
+        with pytest.raises(ValueError, match='feed names'):
+            eng.submit({'wrong': np.zeros((1, 2), 'float32')})
+        with pytest.raises(ValueError, match='exceeds max_batch_size'):
+            eng.submit({'x': np.zeros((9, 2), 'float32')})
+        with pytest.raises(ValueError, match='scalar'):
+            eng.submit({'x': np.float32(1.0)})
+        with pytest.raises(ValueError, match='0 rows'):
+            eng.submit({'x': np.zeros((0, 2), 'float32')})
+    finally:
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# obs_report renders the serving section
+# ---------------------------------------------------------------------------
+
+def test_obs_report_serving_section(tmp_path, obs_events):
+    xv = _save_model(tmp_path)
+    pred = inference.Predictor(str(tmp_path), place=fluid.CPUPlace())
+    eng = serving.ServingEngine(pred, serving.ServingConfig(
+        max_batch_size=8, max_queue_delay_ms=1))
+    try:
+        eng.warmup()
+        for n in (1, 3, 8):
+            eng.predict({'x': xv[:n]}, timeout=30)
+    finally:
+        eng.shutdown()
+    text = obs_report.summarize(obs_events())
+    assert '-- serving --' in text
+    assert 'warmup: 4 bucket(s) pre-compiled' in text
+    assert 'batches:' in text and 'exec latency:' in text
+    assert 'shutdown: drained=True' in text

@@ -90,4 +90,15 @@ if [ "${NDEV:-1}" -ge 2 ]; then
   done
 fi
 
+# 6. serving engine vs sequential Predictor (opt-in: SERVE=1). Closed
+#    loop at the acceptance concurrency, then an open-loop arrival test;
+#    --check-compiles fails the command if steady state compiled, which
+#    the obs_event rc then records in the sweep run log.
+if [ "${SERVE:-0}" = 1 ]; then
+  run python tools/serve_bench.py --model mnist --concurrency 8 \
+      --requests 512 --check-compiles
+  run python tools/serve_bench.py --model mnist --mode open --qps 200 \
+      --duration 3 --check-compiles
+fi
+
 echo "sweep complete; see $LOG" | tee -a "$LOG"

@@ -1,0 +1,267 @@
+#!/usr/bin/env python
+"""serve_bench: load-generate against the serving engine vs sequential
+Predictor.run and report latency/throughput.
+
+    python tools/serve_bench.py                          # closed loop, mnist
+    python tools/serve_bench.py --model fit_a_line --concurrency 8
+    python tools/serve_bench.py --mode open --qps 200 --duration 3
+
+Builds a small inference model in-process (mnist MLP or fit_a_line
+regression), saves it, then drives it two ways:
+
+  * SEQUENTIAL baseline: one thread, one `Predictor.run` per request
+    (today's synchronous path);
+  * ENGINE: `serving.ServingEngine` with bucketed micro-batching —
+    closed loop (N workers, each submit+wait in a loop) or open loop
+    (requests arrive on a fixed-rate schedule regardless of completions,
+    the production regime where queueing delay shows up).
+
+Reports p50/p99 latency and throughput for both as JSON lines on stdout
+and — when PADDLE_TPU_OBS_DIR is set — as `bench.metric` events in the
+structured run log (one schema with bench.py; `tools/obs_report.py`
+summarizes a serving run, docs/serving.md). Also verifies the warmup
+contract: after `warmup()` the steady-state phase must perform ZERO XLA
+compiles (`serve.steady_compiles` in the output; rc=1 with
+--check-compiles if any happened).
+
+CPU-safe: run under JAX_PLATFORMS=cpu for a functional check; numbers
+only mean something on the real accelerator (tools/perf_sweep.sh wires
+this in behind SERVE=1).
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _emit(obj):
+    print(json.dumps(obj))
+    sys.stdout.flush()
+    if os.environ.get('PADDLE_TPU_OBS_DIR'):
+        from paddle_tpu import obs
+        obs.event('bench.metric', **obj)
+
+
+def _pctl(values, p):
+    from paddle_tpu.obs import report
+    return report.percentile_exact(values, p)
+
+
+def build_model(kind, save_dir):
+    """Train `kind` for a few steps and save an inference bundle.
+    Returns (feed_name, one_row_example)."""
+    import paddle_tpu.fluid as fluid
+    import paddle_tpu.fluid.layers as layers
+    from paddle_tpu.fluid import framework, unique_name
+    from paddle_tpu.fluid.executor import Scope, _switch_scope
+
+    rng = np.random.RandomState(0)
+    main, startup, scope = (framework.Program(), framework.Program(),
+                            Scope())
+    prev = _switch_scope(scope)
+    try:
+        with unique_name.guard():
+            with framework.program_guard(main, startup):
+                if kind == 'mnist':
+                    img = layers.data(name='img', shape=[784])
+                    label = layers.data(name='label', shape=[1],
+                                        dtype='int64')
+                    h = layers.fc(input=img, size=64, act='relu')
+                    pred = layers.fc(input=h, size=10, act='softmax')
+                    loss = layers.mean(layers.cross_entropy(
+                        input=pred, label=label))
+                    feed = {'img': rng.rand(32, 784).astype('float32'),
+                            'label': rng.randint(0, 10, (32, 1))
+                            .astype('int64')}
+                    feed_name, example = 'img', feed['img'][:1]
+                else:  # fit_a_line
+                    x = layers.data(name='x', shape=[13])
+                    y = layers.data(name='y', shape=[1])
+                    pred = layers.fc(input=x, size=1)
+                    loss = layers.mean(layers.square_error_cost(
+                        input=pred, label=y))
+                    feed = {'x': rng.rand(32, 13).astype('float32'),
+                            'y': rng.rand(32, 1).astype('float32')}
+                    feed_name, example = 'x', feed['x'][:1]
+                fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                for _ in range(3):
+                    exe.run(main, feed=feed, fetch_list=[loss])
+                fluid.io.save_inference_model(
+                    save_dir, [feed_name], [pred], exe, main_program=main)
+    finally:
+        _switch_scope(prev)
+    return feed_name, example
+
+
+def _request_rows(example, rng):
+    return np.ascontiguousarray(
+        example + rng.rand(*example.shape).astype(example.dtype) * 0.01)
+
+
+def run_sequential(save_dir, feed_name, example, n_requests):
+    from paddle_tpu import inference
+    pred = inference.Predictor(save_dir)
+    rng = np.random.RandomState(1)
+    rows = [_request_rows(example, rng) for _ in range(n_requests)]
+    pred.run({feed_name: rows[0]})  # compile outside the timed window
+    lat = []
+    t0 = time.perf_counter()
+    for r in rows:
+        s = time.perf_counter()
+        pred.run({feed_name: r})
+        lat.append(time.perf_counter() - s)
+    wall = time.perf_counter() - t0
+    return lat, n_requests / wall
+
+
+def _steady_compile_counter():
+    from paddle_tpu import obs
+    return obs.REGISTRY.total('executor.cache.misses')
+
+
+def run_engine(save_dir, feed_name, example, args):
+    from paddle_tpu import inference, serving
+    pred = inference.Predictor(save_dir)
+    cfg = serving.ServingConfig(max_batch_size=args.max_batch,
+                                max_queue_delay_ms=args.delay_ms,
+                                queue_capacity=args.queue_capacity)
+    eng = serving.ServingEngine(pred, cfg)
+    eng.warmup(example_feed={feed_name: example})
+    compiles0 = _steady_compile_counter()
+    lat, lock = [], threading.Lock()
+
+    def record(dt):
+        with lock:
+            lat.append(dt)
+
+    t0 = time.perf_counter()
+    if args.mode == 'closed':
+        per = args.requests // args.concurrency
+
+        def worker(wid):
+            rng = np.random.RandomState(100 + wid)
+            for _ in range(per):
+                r = _request_rows(example, rng)
+                s = time.perf_counter()
+                eng.predict({feed_name: r}, timeout=60)
+                record(time.perf_counter() - s)
+
+        ts = [threading.Thread(target=worker, args=(i,))
+              for i in range(args.concurrency)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        n_done = per * args.concurrency
+    else:  # open loop: fixed-rate arrivals, latency includes queueing
+        rng = np.random.RandomState(2)
+        period = 1.0 / args.qps
+        futs = []
+        t_end = t0 + args.duration
+        i = 0
+        while time.perf_counter() < t_end:
+            target = t0 + i * period
+            now = time.perf_counter()
+            if now < target:
+                time.sleep(target - now)
+            r = _request_rows(example, rng)
+            s = time.perf_counter()
+            try:
+                f = eng.submit({feed_name: r})
+                # latency stamps at COMPLETION, not at the later gather —
+                # gathering after the arrival loop would inflate p50
+                f.add_done_callback(
+                    lambda f, s=s: record(time.perf_counter() - s))
+                futs.append(f)
+            except serving.ServerOverloaded:
+                futs.append(None)
+            i += 1
+        dropped = sum(1 for f in futs if f is None)
+        for f in futs:
+            if f is not None:
+                f.result(60)
+        n_done = len(futs) - dropped
+        if dropped:
+            _emit({'metric': 'serve.open.dropped', 'value': dropped})
+    wall = time.perf_counter() - t0
+    steady_compiles = _steady_compile_counter() - compiles0
+    eng.shutdown()
+    return lat, n_done / wall, steady_compiles, eng.stats
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog='serve_bench',
+                                 description=__doc__.splitlines()[0])
+    ap.add_argument('--model', choices=('mnist', 'fit_a_line'),
+                    default='mnist')
+    ap.add_argument('--mode', choices=('closed', 'open'), default='closed')
+    ap.add_argument('--concurrency', type=int, default=8)
+    ap.add_argument('--requests', type=int, default=256,
+                    help='total requests (closed loop)')
+    ap.add_argument('--qps', type=float, default=200.0,
+                    help='arrival rate (open loop)')
+    ap.add_argument('--duration', type=float, default=3.0,
+                    help='seconds of open-loop arrivals')
+    ap.add_argument('--max-batch', type=int, default=32)
+    ap.add_argument('--delay-ms', type=float, default=2.0)
+    ap.add_argument('--queue-capacity', type=int, default=1024)
+    ap.add_argument('--seq-requests', type=int, default=None,
+                    help='sequential-baseline request count '
+                         '(default: --requests)')
+    ap.add_argument('--no-baseline', action='store_true')
+    ap.add_argument('--check-compiles', action='store_true',
+                    help='exit 1 if the steady-state phase compiled')
+    args = ap.parse_args(argv)
+
+    save_dir = tempfile.mkdtemp(prefix='serve_bench_')
+    feed_name, example = build_model(args.model, save_dir)
+    _emit({'metric': 'serve.model', 'value': args.model,
+           'mode': args.mode, 'concurrency': args.concurrency})
+
+    seq_rps = None
+    if not args.no_baseline:
+        lat, seq_rps = run_sequential(save_dir, feed_name, example,
+                                      args.seq_requests or args.requests)
+        _emit({'metric': 'serve.seq.throughput', 'value': round(seq_rps, 2),
+               'unit': 'req/s'})
+        _emit({'metric': 'serve.seq.p50_ms',
+               'value': round(1e3 * _pctl(lat, 50), 3), 'unit': 'ms'})
+        _emit({'metric': 'serve.seq.p99_ms',
+               'value': round(1e3 * _pctl(lat, 99), 3), 'unit': 'ms'})
+
+    lat, rps, steady_compiles, stats = run_engine(save_dir, feed_name,
+                                                  example, args)
+    _emit({'metric': 'serve.engine.throughput', 'value': round(rps, 2),
+           'unit': 'req/s'})
+    if lat:
+        _emit({'metric': 'serve.engine.p50_ms',
+               'value': round(1e3 * _pctl(lat, 50), 3), 'unit': 'ms'})
+        _emit({'metric': 'serve.engine.p99_ms',
+               'value': round(1e3 * _pctl(lat, 99), 3), 'unit': 'ms'})
+    _emit({'metric': 'serve.engine.batches', 'value': stats['batches']})
+    _emit({'metric': 'serve.engine.padded_rows',
+           'value': stats['padded_rows']})
+    _emit({'metric': 'serve.steady_compiles', 'value': int(steady_compiles)})
+    if seq_rps:
+        _emit({'metric': 'serve.speedup',
+               'value': round(rps / seq_rps, 3), 'unit': 'x'})
+    if args.check_compiles and steady_compiles:
+        print('serve_bench: %d compile(s) happened AFTER warmup — the '
+              'bucket set does not cover the traffic' % steady_compiles,
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
