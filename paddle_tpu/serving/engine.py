@@ -35,6 +35,7 @@ the executor/jit caches behave exactly as documented in
 docs/architecture.md.
 """
 import collections
+import concurrent.futures
 import threading
 import time
 
@@ -152,13 +153,33 @@ class ServingEngine(object):
     the fault drills wrap flaky callables this way. The engine starts
     its batcher thread immediately and is a context manager
     (`with ServingEngine(p) as eng: ...` drains on exit).
+
+    `per_row_outputs` declares which fetch-list positions are batched
+    per-row (everything else replicates whole to each request in the
+    batch). Without it the engine falls back to a HEURISTIC — an output
+    is per-row iff its leading dim equals the padded bucket size —
+    which silently mis-slices a batch-level aggregate whose leading dim
+    coincidentally equals the bucket. Declare the set whenever any
+    fetch output is not batched on axis 0 (docs/serving.md).
     """
 
-    def __init__(self, model, config=None):
+    def __init__(self, model, config=None, per_row_outputs=None):
         self.config = config or ServingConfig()
         self._model_fn = model.run if hasattr(model, 'run') else model
         self.feed_names = list(model.feed_names)
         self._input_spec = getattr(model, 'input_spec', None)
+        self._per_row_outputs = None if per_row_outputs is None \
+            else frozenset(int(i) for i in per_row_outputs)
+        if self._per_row_outputs is not None:
+            fetch_names = getattr(model, 'fetch_names', None)
+            n_out = len(fetch_names) if fetch_names is not None else None
+            bad = sorted(i for i in self._per_row_outputs
+                         if i < 0 or (n_out is not None and i >= n_out))
+            if bad:
+                raise ValueError(
+                    'per_row_outputs %r out of range: indices must be '
+                    '>= 0%s' % (bad, '' if n_out is None else
+                                ' and < %d fetch output(s)' % n_out))
         self.buckets = self.config.buckets
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
@@ -222,7 +243,6 @@ class ServingEngine(object):
         config.default_deadline_ms) sheds the request with
         DeadlineExceeded if it is still queued when the deadline
         passes."""
-        import concurrent.futures
         arrays, n, sig = self._normalize_feed(feed)
         if n > self.config.max_batch_size:
             raise ValueError(
@@ -275,12 +295,34 @@ class ServingEngine(object):
     def predict(self, feed, deadline_ms=None, timeout=None):
         """Synchronous convenience: submit + wait. `timeout` is ONE
         wall-clock budget covering both admission (a 'block' overflow
-        wait on a full queue) and the result."""
+        wait on a full queue) and the result, and its expiry raises the
+        typed DeadlineExceeded (never a raw
+        concurrent.futures.TimeoutError). A still-queued request is
+        cancelled — dropped at dequeue time without consuming a batch
+        slot; one already mid-batch cannot be recalled: its batch
+        completes and the result is discarded."""
         t0 = time.monotonic()
         fut = self.submit(feed, deadline_ms=deadline_ms, timeout=timeout)
         remaining = None if timeout is None else \
             max(0.0, timeout - (time.monotonic() - t0))
-        return fut.result(remaining)
+        try:
+            return fut.result(remaining)
+        except concurrent.futures.TimeoutError:
+            if fut.done():
+                # the future resolved in the race window after result()
+                # expired — return the just-arrived result (or re-raise
+                # the model's own exception, including a genuine model
+                # TimeoutError) instead of discarding it
+                return fut.result()
+            if fut.cancel():
+                raise DeadlineExceeded(
+                    'no result within the %.3fs predict() timeout; the '
+                    'queued request was cancelled and will not execute'
+                    % timeout)
+            raise DeadlineExceeded(
+                'no result within the %.3fs predict() timeout; the '
+                'request is already executing — its batch completes but '
+                'the result is discarded' % timeout)
 
     # -- warmup ------------------------------------------------------------
 
@@ -386,6 +428,14 @@ class ServingEngine(object):
         """Resolve shed requests' futures (lock NOT held)."""
         now = time.monotonic()
         for req in shed:
+            # a request can be cancelled while queued (predict()'s
+            # timeout path) and ALSO pass its deadline before the
+            # batcher reaches it: set_exception on a cancelled future
+            # raises InvalidStateError, which would kill the batcher
+            # thread. This transition claims the future atomically —
+            # False means cancelled, and nobody is waiting for it.
+            if not req.future.set_running_or_notify_cancel():
+                continue
             self._n_shed += 1
             _C_SHED.inc()
             waited = now - req.t_submit
@@ -417,18 +467,29 @@ class ServingEngine(object):
         batch, rows = [first], first.n
         horizon = time.monotonic() + self.config.max_queue_delay_ms / 1000.0
         while rows < self.config.max_batch_size:
-            shed, req, closed = [], None, False
+            shed, req, closed, sealed = [], None, False, False
             with self._lock:
                 if self._queue:
-                    head = self._queue[0]
-                    if head.sig != first.sig \
-                            or rows + head.n > self.config.max_batch_size:
-                        break  # incompatible head starts the next batch
                     req = self._pop_live_locked(time.monotonic(), shed)
+                    if req is not None and (
+                            req.sig != first.sig or
+                            rows + req.n > self.config.max_batch_size):
+                        # expired heads are shed INSIDE the pop, so the
+                        # request it returns need not be the head that
+                        # was visible beforehand — compatibility must be
+                        # checked after popping, never before. A request
+                        # with a different signature (np.concatenate
+                        # would fail or promote dtypes) or one that
+                        # overflows the row budget (pick_bucket would
+                        # raise) goes back to the front and opens the
+                        # NEXT batch instead.
+                        self._queue.appendleft(req)
+                        _G_QDEPTH.set(len(self._queue))
+                        req, sealed = None, True
                 elif self._shutdown:
                     closed = True  # draining: don't wait for more traffic
             self._fail_shed(shed)
-            if closed:
+            if sealed or closed:
                 break
             if req is not None:
                 if req.future.set_running_or_notify_cancel():
@@ -455,7 +516,22 @@ class ServingEngine(object):
                     req.future.set_exception(ServerClosed(
                         'serving engine shut down without draining'))
                 continue
-            self._execute(batch)
+            try:
+                self._execute(batch)
+            except BaseException as e:  # noqa: BLE001 — thread last resort
+                # _execute routes model/assembly errors to the batch's
+                # futures itself; anything escaping it is an engine bug.
+                # Fail the batch rather than letting the exception kill
+                # the batcher thread silently — a dead batcher strands
+                # every queued future and blocks all later submits.
+                self._n_batch_errors += 1
+                _C_BATCH_ERRORS.inc()
+                obs.event('serving.batch.error', requests=len(batch),
+                          error='batcher guard: %s: %s'
+                                % (type(e).__name__, e))
+                for req in batch:
+                    if not req.future.done():
+                        req.future.set_exception(e)
 
     def _run_with_retry(self, feed):
         if self.config.max_retries <= 0:
@@ -471,27 +547,39 @@ class ServingEngine(object):
     def _execute(self, batch):
         now = time.monotonic()
         rows = sum(r.n for r in batch)
-        bucket = _buckets.pick_bucket(rows, self.buckets)
         waits = [now - r.t_submit for r in batch]
-        for w in waits:
-            _H_QWAIT.observe(w)
-        _H_BATCH_SIZE.observe(rows)
-        self._n_batches += 1
-        self._n_padded_rows += bucket - rows
-        _C_BATCHES.inc()
-        _C_PAD_ROWS.inc(bucket - rows)
-        feed = {}
-        for name in self.feed_names:
-            merged = np.concatenate([r.feed[name] for r in batch], axis=0) \
-                if len(batch) > 1 else batch[0].feed[name]
-            feed[name] = _buckets.pad_rows(merged, bucket)
+        # batch ASSEMBLY failures (bucket lookup, concat, padding) must
+        # resolve the futures exactly like model failures do — an
+        # exception escaping here would kill the batcher thread
         try:
+            bucket = _buckets.pick_bucket(rows, self.buckets)
+            for w in waits:
+                _H_QWAIT.observe(w)
+            _H_BATCH_SIZE.observe(rows)
+            self._n_batches += 1
+            self._n_padded_rows += bucket - rows
+            _C_BATCHES.inc()
+            _C_PAD_ROWS.inc(bucket - rows)
+            feed = {}
+            for name in self.feed_names:
+                merged = np.concatenate(
+                    [r.feed[name] for r in batch], axis=0) \
+                    if len(batch) > 1 else batch[0].feed[name]
+                feed[name] = _buckets.pad_rows(merged, bucket)
             with obs.span('serving.batch', requests=len(batch),
                           batch_size=rows, bucket=bucket,
                           padded=bucket - rows,
                           wait_max_s=max(waits)) as sp:
                 outs = self._run_with_retry(feed)
                 sp.fields['warm'] = self._warm
+            outs = [np.asarray(o) for o in outs]
+            if self._per_row_outputs is not None:
+                bad = sorted(i for i in self._per_row_outputs
+                             if i >= len(outs))
+                if bad:
+                    raise ValueError(
+                        'per_row_outputs %r out of range: the model '
+                        'returned %d output(s)' % (bad, len(outs)))
         except Exception as e:  # noqa: BLE001 — the batch's futures own it
             self._n_batch_errors += 1
             _C_BATCH_ERRORS.inc()
@@ -501,14 +589,17 @@ class ServingEngine(object):
             for req in batch:
                 req.future.set_exception(e)
             return
-        outs = [np.asarray(o) for o in outs]
+        per_row = self._per_row_outputs
         off = 0
         for req in batch:
-            # per-row outputs scatter back to their request; outputs
-            # without the padded leading dim (batch-level aggregates)
-            # replicate to every request in the batch
+            # declared per-row outputs scatter back to their request's
+            # rows; undeclared engines fall back to the leading-dim
+            # heuristic (see the class docstring for its failure mode);
+            # everything else (batch-level aggregates) replicates whole
             req.future.set_result([
-                o[off:off + req.n] if o.ndim and o.shape[0] == bucket
-                else o for o in outs])
+                o[off:off + req.n]
+                if (i in per_row if per_row is not None
+                    else (o.ndim and o.shape[0] == bucket))
+                else o for i, o in enumerate(outs)])
             off += req.n
             self._n_completed += 1

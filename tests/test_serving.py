@@ -301,6 +301,171 @@ def test_deadline_expired_requests_shed(obs_events):
         eng.shutdown()
 
 
+def test_expired_head_cannot_poison_a_batch(obs_events):
+    """The coalescing pop sheds expired heads and returns the request
+    BEHIND them — which may carry a different feed signature. It must
+    be validated after the pop and pushed back, not appended blind: a
+    mismatched signature would poison np.concatenate for the whole
+    batch (and an unvalidated row count could overflow pick_bucket)."""
+    model = _GatedModel()
+    eng = serving.ServingEngine(model, serving.ServingConfig(
+        max_batch_size=4, max_queue_delay_ms=0, queue_capacity=8))
+    try:
+        stall = eng.submit({'x': np.zeros((1, 2), 'float32')})
+        assert model.entered.wait(10)   # batcher held inside run()
+        live_a = eng.submit({'x': np.ones((1, 2), 'float32')})
+        doomed = eng.submit({'x': np.ones((1, 2), 'float32')},
+                            deadline_ms=20)
+        live_b = eng.submit({'x': np.ones((1, 3), 'float32')})  # other sig
+        time.sleep(0.08)                # doomed expires while queued
+        model.gate.set()
+        # live_a opens a batch; shedding doomed exposes live_b, which is
+        # sig-incompatible and must be served in its OWN batch
+        got_a, = live_a.result(30)
+        assert got_a.shape == (1, 2)
+        got_b, = live_b.result(30)
+        assert got_b.shape == (1, 3)
+        with pytest.raises(serving.DeadlineExceeded):
+            doomed.result(30)
+        assert stall.result(30)
+        assert eng.stats['batch_errors'] == 0
+        assert obs_events('serving.batch.error') == []
+    finally:
+        model.gate.set()
+        eng.shutdown()
+
+
+def test_predict_timeout_is_typed_and_cancels():
+    """predict() translates a result-wait expiry into the typed
+    DeadlineExceeded and cancels the request, so a timed-out caller
+    never leaves a zombie request consuming a batch slot."""
+    model = _GatedModel()
+    eng = serving.ServingEngine(model, serving.ServingConfig(
+        max_batch_size=1, max_queue_delay_ms=0))
+    try:
+        first = eng.submit({'x': np.zeros((1, 2), 'float32')})
+        assert model.entered.wait(10)   # batcher stalled: next rq queues
+        with pytest.raises(serving.DeadlineExceeded):
+            eng.predict({'x': np.zeros((1, 2), 'float32')}, timeout=0.05)
+        model.gate.set()
+        assert first.result(30)
+        assert eng.shutdown(timeout=30)
+        assert model.calls == 1         # the cancelled request never ran
+    finally:
+        model.gate.set()
+        eng.shutdown()
+
+
+def test_cancelled_then_expired_request_does_not_kill_batcher():
+    """A request can be cancelled while queued (predict()'s timeout
+    path) and THEN pass its deadline: shedding must skip the cancelled
+    future — set_exception on it raises InvalidStateError inside the
+    batcher thread, which would strand every later submit."""
+    model = _GatedModel()
+    eng = serving.ServingEngine(model, serving.ServingConfig(
+        max_batch_size=2, max_queue_delay_ms=0))
+    try:
+        stall = eng.submit({'x': np.zeros((1, 2), 'float32')})
+        assert model.entered.wait(10)
+        doomed = eng.submit({'x': np.zeros((1, 2), 'float32')},
+                            deadline_ms=20)
+        assert doomed.cancel()
+        time.sleep(0.08)                # ...then the deadline passes too
+        live = eng.submit({'x': np.ones((1, 2), 'float32')})
+        model.gate.set()
+        assert stall.result(30) and live.result(30)
+        assert eng.stats['shed'] == 0   # cancelled requests are not shed
+        assert eng.shutdown(timeout=30)
+    finally:
+        model.gate.set()
+        eng.shutdown()
+
+
+def test_per_row_outputs_validated():
+    """Bad per_row_outputs indices must fail loudly — an ignored index
+    silently reproduces the mis-scatter the declaration exists to
+    prevent. Range-checked at construction when the model publishes
+    fetch_names, and against the real output count at execution."""
+    with pytest.raises(ValueError, match='per_row_outputs'):
+        serving.ServingEngine(_FakeModel(), serving.ServingConfig(),
+                              per_row_outputs=[-1])
+
+    class _Named(_FakeModel):
+        fetch_names = ['out']
+
+    with pytest.raises(ValueError, match='per_row_outputs'):
+        serving.ServingEngine(_Named(), serving.ServingConfig(),
+                              per_row_outputs=[1])
+    # _FakeModel has no fetch_names: the bad index surfaces per-batch
+    eng = serving.ServingEngine(_FakeModel(), serving.ServingConfig(
+        max_batch_size=2, max_queue_delay_ms=0), per_row_outputs=[5])
+    try:
+        fut = eng.submit({'x': np.zeros((1, 2), 'float32')})
+        with pytest.raises(ValueError, match='out of range'):
+            fut.result(30)
+    finally:
+        eng.shutdown()
+
+
+def test_per_row_outputs_declaration():
+    """An aggregate output whose leading dim coincidentally equals the
+    bucket would be mis-sliced by the default heuristic; declaring
+    per_row_outputs scatters only the declared positions and replicates
+    everything else verbatim."""
+    model = _GatedModel()
+    model._fn = lambda feed: [
+        np.asarray(feed['x']) * 2.0,                      # per-row
+        np.arange(feed['x'].shape[0], dtype='float32')]   # aggregate with
+    # the heuristic-trap shape: leading dim == bucket
+    eng = serving.ServingEngine(
+        model,
+        serving.ServingConfig(max_batch_size=2, max_queue_delay_ms=0),
+        per_row_outputs=[0])
+    try:
+        stall = eng.submit({'x': np.zeros((1, 2), 'float32')})
+        assert model.entered.wait(10)
+        a = eng.submit({'x': np.ones((1, 2), 'float32')})
+        b = eng.submit({'x': np.full((1, 2), 3.0, 'float32')})
+        model.gate.set()                # a+b coalesce into one batch of 2
+        rows_a, agg_a = a.result(30)
+        rows_b, agg_b = b.result(30)
+        np.testing.assert_allclose(rows_a, np.full((1, 2), 2.0))
+        np.testing.assert_allclose(rows_b, np.full((1, 2), 6.0))
+        # the aggregate replicates WHOLE to every request in the batch
+        np.testing.assert_array_equal(agg_a, [0.0, 1.0])
+        np.testing.assert_array_equal(agg_b, [0.0, 1.0])
+        assert stall.result(30)
+    finally:
+        model.gate.set()
+        eng.shutdown()
+
+
+def test_batcher_survives_execute_bug(obs_events):
+    """Last-resort guard: an exception escaping _execute (an engine
+    bug, not a model error) fails that batch's futures instead of
+    silently killing the batcher thread — later submits still serve."""
+    model = _FakeModel()
+    eng = serving.ServingEngine(model, serving.ServingConfig(
+        max_batch_size=2, max_queue_delay_ms=0))
+    try:
+        def broken_execute(batch):
+            del eng._execute            # break exactly ONE batch
+            raise RuntimeError('injected engine bug')
+
+        eng._execute = broken_execute
+        fut = eng.submit({'x': np.zeros((1, 2), 'float32')})
+        with pytest.raises(RuntimeError, match='injected engine bug'):
+            fut.result(30)
+        # the batcher thread is alive and the engine keeps serving
+        got, = eng.predict({'x': np.zeros((1, 2), 'float32')}, timeout=30)
+        np.testing.assert_allclose(got, np.zeros((1, 2), 'float32'))
+        assert eng.stats['batch_errors'] == 1
+        errs = obs_events('serving.batch.error')
+        assert errs and 'batcher guard' in errs[-1]['fields']['error']
+    finally:
+        eng.shutdown()
+
+
 # ---------------------------------------------------------------------------
 # faults: flaky model callable — retry, then degrade
 # ---------------------------------------------------------------------------
