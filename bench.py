@@ -159,8 +159,14 @@ def _setup_jax(force_cpu):
     import jax
     if force_cpu:
         jax.config.update('jax_platforms', 'cpu')
-    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             '.jax_cache')
+    cache_dir = os.environ.get(
+        'PADDLE_TPU_COMPILE_CACHE',
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     '.jax_cache'))
+    # publish the dir through the executor's own env knob too, so every
+    # Executor arms its persistent-hit probe (cache_stats.persistent_hits,
+    # executor.compile.persistent_hit run-log events) on warm re-runs
+    os.environ.setdefault('PADDLE_TPU_COMPILE_CACHE', cache_dir)
     try:
         jax.config.update('jax_compilation_cache_dir', cache_dir)
         jax.config.update('jax_persistent_cache_min_compile_time_secs', 0.0)
@@ -168,6 +174,14 @@ def _setup_jax(force_cpu):
     except Exception as e:  # older jax without the knobs: cache is optional
         _log('compilation cache unavailable: %r' % e)
     return jax
+
+
+def _scalar(x):
+    """First element of a fetched metric as a python float. NumPy >= 1.25
+    deprecates float() on an ndim>0 array (the BENCH_r05 tail warning), so
+    extract the scalar explicitly before any finiteness assert."""
+    a = np.asarray(x)
+    return float(a.reshape(-1)[0])
 
 
 def _fresh():
@@ -238,7 +252,7 @@ def bench_resnet50(batch_size=1024, warmup=3, iters=12, use_amp=True,
             for _ in range(iters):
                 loss, = exe.run(main, feed=feed, fetch_list=[avg_cost])
             dt = time.time() - t0
-            assert np.isfinite(float(loss)), float(loss)
+            assert np.isfinite(_scalar(loss)), _scalar(loss)
             return batch_size * iters / dt
 
 
@@ -283,9 +297,87 @@ def bench_transformer(batch_size=64, seq_len=256, warmup=3, iters=12,
             for _ in range(iters):
                 loss, = exe.run(main, feed=feed, fetch_list=[avg_cost])
             dt = time.time() - t0
-            assert np.isfinite(float(loss)), float(loss)
+            assert np.isfinite(_scalar(loss)), _scalar(loss)
             tps = batch_size * 2 * seq_len * iters / dt  # src + tgt tokens
             return tps, n_params
+
+
+def bench_bundle(steps=None, bundle_steps=None, batch_size=64, warmup=1):
+    """Pipelined hot loop on a small (host-bound) model: the fit_a_line
+    regression net trained two ways over IDENTICAL data — the seed path
+    (one Executor.run per step: Python prepare + dispatch + blocking
+    fetch every step) vs Executor.run_bundle(K) (one lax.scan-compiled
+    module, one dispatch and one host round-trip per K steps). Small
+    models are where the host overhead dominates, so this is the
+    acceptance metric for K-step bundling (docs/perf.md). Runs fine on
+    CPU — the contract number is a CPU one. Returns
+    (steps/sec unbundled, steps/sec bundled, K, params equal)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework, unique_name
+    from paddle_tpu.fluid.executor import global_scope
+
+    if steps is None:
+        steps = int(os.environ.get('BENCH_BUNDLE_ITERS', '192'))
+    if bundle_steps is None:
+        bundle_steps = int(os.environ.get('BENCH_BUNDLE_STEPS', '8'))
+    K = max(1, int(bundle_steps))
+    steps = max(K, (steps // K) * K)   # whole bundles only
+
+    def build():
+        main, startup = _fresh()
+        with unique_name.guard():
+            with framework.program_guard(main, startup):
+                x = fluid.layers.data(name='x', shape=[13], dtype='float32')
+                y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+                pred = fluid.layers.fc(input=x, size=1, act=None)
+                cost = fluid.layers.mean(
+                    fluid.layers.square_error_cost(input=pred, label=y))
+                fluid.optimizer.SGD(learning_rate=0.01).minimize(cost)
+                exe = fluid.Executor()
+                exe.run(startup)
+        return main, cost, exe
+
+    rng = np.random.RandomState(0)
+    feeds = [{'x': rng.rand(batch_size, 13).astype('float32'),
+              'y': rng.rand(batch_size, 1).astype('float32')}
+             for _ in range(steps)]
+
+    # seed path: one run() per step. Warm with 2K steps so both paths
+    # enter their timed loop fully steady AND having consumed the same
+    # training prefix (params stay comparable afterwards).
+    main, cost, exe = build()
+    for f in (feeds[:K] + feeds[:K]):   # compile + warm outside the timing
+        exe.run(main, feed=f, fetch_list=[cost])
+    t0 = time.time()
+    for f in feeds:
+        loss, = exe.run(main, feed=f, fetch_list=[cost])
+    dt_unbundled = time.time() - t0
+    assert np.isfinite(_scalar(loss)), _scalar(loss)
+    w_name = sorted(n for n in global_scope().vars
+                    if n.endswith('.w_0'))[0]
+    w_unbundled = np.asarray(global_scope().vars[w_name]).copy()
+
+    # bundled path: one run_bundle() per K steps, same data. TWO warm
+    # calls: the first compiles the scan, the second pays the one-time
+    # donation/layout re-specialization — the timed loop is the steady
+    # state a real training run lives in.
+    main, cost, exe = build()
+    for _ in range(2):
+        exe.run_bundle(main, feeds=feeds[:K], fetch_list=[cost])
+    t0 = time.time()
+    for i in range(0, steps, K):
+        stacked = exe.run_bundle(main, feeds=feeds[i:i + K],
+                                 fetch_list=[cost])
+    dt_bundled = time.time() - t0
+    assert np.isfinite(_scalar(np.asarray(stacked[0])[-1]))
+    w_bundled = np.asarray(global_scope().vars[w_name]).copy()
+
+    # scan-of-K vs the standalone step module may round a reduction a
+    # ulp apart (docs/perf.md); K-vs-K' bundles are bit-identical and
+    # tests/test_bundle.py asserts that exactly. Here: same trajectory
+    # within float32 noise.
+    max_diff = float(np.abs(w_unbundled - w_bundled).max())
+    return (steps / dt_unbundled, steps / dt_bundled, K, max_diff)
 
 
 def bench_flash_longcontext(seq_len=32768, heads=8, dim=64, warmup=1,
@@ -360,8 +452,9 @@ NAME_T = 'transformer_base_train_tokens_per_sec_per_chip'
 NAME_R = 'resnet50_train_images_per_sec_per_chip'
 NAME_L = 'transformer_base_seq1024_train_tokens_per_sec_per_chip'
 NAME_F = 'flash_causal_seq32768_tokens_per_sec_per_chip'
-PHASES = ('transformer', 'resnet', 'longseq', 'longctx')
-PHASE_NAMES = {'transformer': NAME_T, 'resnet': NAME_R,
+NAME_B = 'fit_a_line_bundled_train_steps_per_sec'
+PHASES = ('transformer', 'resnet', 'bundle', 'longseq', 'longctx')
+PHASE_NAMES = {'transformer': NAME_T, 'resnet': NAME_R, 'bundle': NAME_B,
                'longseq': NAME_L, 'longctx': NAME_F}
 
 
@@ -431,6 +524,23 @@ def run_phase(phase, platform):
         except Exception as e:
             _log('resnet50 bench failed: %r' % e)
             _emit({'metric': NAME_R, 'skipped': True,
+                   'error': str(e)[:300]})
+    elif phase == 'bundle':
+        # hot-loop pipelining contract metric (ISSUE 4): K-step bundling
+        # must beat the seed per-step loop >= 1.3x on a small model. A
+        # CPU number is VALID here — the win is amortized host overhead,
+        # not device speed — so this phase never skips off-chip.
+        try:
+            sps_u, sps_b, K, max_diff = bench_bundle()
+            _emit({'metric': NAME_B, 'value': round(sps_b, 2),
+                   'unit': 'steps/sec', 'bundle_steps': K,
+                   'unbundled_steps_per_sec': round(sps_u, 2),
+                   'speedup_vs_unbundled': round(sps_b / sps_u, 3),
+                   'params_max_abs_diff_vs_unbundled': max_diff,
+                   'platform': platform, 'batch': 64})
+        except Exception as e:
+            _log('%s failed: %r' % (NAME_B, e))
+            _emit({'metric': NAME_B, 'skipped': True,
                    'error': str(e)[:300]})
     elif phase == 'longseq':
         _transformer_metric(NAME_L, 8, 1024, t['iters'], t['use_amp'],
@@ -589,7 +699,11 @@ def main():
     # headline LAST so a line-by-line parser and a last-line parser agree;
     # it is ALWAYS the ResNet-50 series (round-1 continuity) — when that
     # phase failed, the headline says so explicitly rather than silently
-    # switching series to whatever did complete
+    # switching series to whatever did complete. ONE FLAT record: every
+    # metric already streamed as its own flat line above (BENCH_r05's
+    # tail nested a `metrics` list inside a duplicated resnet record,
+    # which parsers had to special-case), so the summary only carries the
+    # headline value plus which series completed/skipped.
     resnet = [m for m in metrics if m['metric'] == NAME_R]
     if resnet:
         out = dict(resnet[0])
@@ -598,7 +712,9 @@ def main():
                'vs_baseline': None,
                'error': 'resnet phase did not complete (accelerator '
                         'unreachable, OOM, or budget exhausted)'}
-    out['metrics'] = metrics
+    out['summary'] = True
+    out['completed'] = sorted(m['metric'] for m in metrics)
+    out['skipped'] = sorted(emitted - {m['metric'] for m in metrics})
     _emit(out)
 
 

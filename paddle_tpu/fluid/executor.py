@@ -13,8 +13,17 @@ updates — into one module: one device launch per step vs hundreds.
 Persistable variables (parameters, optimizer state, BN stats) live in the
 Scope as device arrays and are donated to each step, so updates are
 in-place in HBM.
+
+Pipelined hot loop (docs/perf.md): `run_bundle` scans K steps inside ONE
+compiled module (one dispatch + one host round-trip per K steps),
+`run(sync='async')` returns lazy FetchHandles so the host runs ahead of
+the device, and PADDLE_TPU_COMPILE_CACHE reuses XLA executables across
+processes (zero cold compiles on restart).
 """
 import collections
+import os
+import threading
+import time
 
 import numpy as np
 
@@ -34,8 +43,15 @@ from .lowering import SeqValue, Ctx
 # the product path on tiny models.
 _ZERO_MIN_SIZE = 1024
 
-__all__ = ['Executor', 'global_scope', 'scope_guard', '_switch_scope',
-           'Scope', 'anomaly_guard']
+__all__ = ['Executor', 'FetchHandle', 'global_scope', 'scope_guard',
+           '_switch_scope', 'Scope', 'anomaly_guard']
+
+# Persistent XLA compilation cache (docs/perf.md): point this env var at a
+# directory and every Executor in the process wires
+# jax_compilation_cache_dir at construction, so a RESTARTED process
+# (Trainer resume after preemption, serving warmup) deserializes compiled
+# modules instead of re-compiling them.
+ENV_COMPILE_CACHE = 'PADDLE_TPU_COMPILE_CACHE'
 
 
 def anomaly_guard(program=None, enable=True, max_consecutive_skips=None):
@@ -321,6 +337,41 @@ class _CompiledStep(object):
         self._step = step  # pure, un-jitted (re-jittable with shardings)
         self._jitted = jax.jit(
             step, donate_argnums=(0,) if self.mutates_persist else ())
+        # K -> jitted K-step lax.scan over the SAME step body (run_bundle)
+        self._bundles = {}
+
+    def bundle(self, K):
+        """The K-step bundled executable: ONE jitted lax.scan whose body is
+        the exact `step` the unbundled path jits — one device dispatch and
+        one host round-trip per K steps instead of per step. Carry is the
+        persist dict (donated, so persistables stay in-place in HBM across
+        ALL K inner steps); xs are the stacked feeds plus per-step uint32
+        seeds — the RNG key is created INSIDE the body from the same seed
+        integer run() would pass to jax.random.key on the host, so
+        per-step randomness is bit-identical to K unbundled runs. ys are
+        the per-step fetches (stacked on a leading K axis) and, when the
+        anomaly guard is armed, the per-step health vectors (rollback
+        already applied in-graph by `step`, per inner step)."""
+        K = int(K)
+        fn = self._bundles.get(K)
+        if fn is None:
+            step = self._step
+
+            def body(carry, xs):
+                feed, seed = xs
+                fetches, new_persist, health = step(
+                    carry, feed, jax.random.key(seed))
+                nxt = dict(carry)
+                nxt.update(new_persist)
+                return nxt, (fetches, health)
+
+            def bundled(persist, feeds, seeds):
+                return jax.lax.scan(body, persist, (feeds, seeds))
+
+            fn = jax.jit(bundled,
+                         donate_argnums=(0,) if self.mutates_persist else ())
+            self._bundles[K] = fn
+        return fn
 
     # optimizer ops with a SparseRows (SelectedRows-analogue) grad branch
     # in ops_impl/optim_ops.py
@@ -738,10 +789,123 @@ def _nan_inf_hook(i, op, dt, env):
 _C_HITS = obs.counter('executor.cache.hits')
 _C_MISSES = obs.counter('executor.cache.misses')
 _C_EVICTIONS = obs.counter('executor.cache.evictions')
+_C_PERSISTENT_HITS = obs.counter('executor.cache.persistent_hits')
 _C_FEED_BYTES = obs.counter('executor.feed.bytes')
 _G_LAST_COMPILE = obs.gauge('executor.last_compile.seconds')
 _C_SKIPPED = obs.counter('anomaly.skipped_steps')
 _G_GRAD_NORM = obs.gauge('anomaly.grad_norm')
+# async-fetch pipeline (docs/perf.md): how many run(sync='async') fetch
+# handles are outstanding (dispatched, not yet host-synced), and the
+# executor.host_stall.seconds histogram (recorded via obs.span in
+# FetchHandle.block) measuring time the host actually BLOCKED on the
+# device — the number that proves (or disproves) the overlap.
+_G_INFLIGHT = obs.gauge('executor.inflight')
+_C_BUNDLED_STEPS = obs.counter('executor.bundle.steps')
+
+# RLock: FetchHandle.__del__ may run from a GC pass triggered INSIDE an
+# _inflight_delta call on the same thread (allocation under the lock);
+# a plain Lock would self-deadlock. The instrument locks in obs.metrics
+# are reentrant for the same reason.
+_inflight_lock = threading.RLock()
+_inflight_n = 0
+
+
+def _inflight_delta(d):
+    global _inflight_n
+    with _inflight_lock:
+        _inflight_n += d
+        _G_INFLIGHT.set(_inflight_n)
+
+
+class FetchHandle(object):
+    """Lazy fetch from `run(sync='async')`: wraps the step's device-side
+    output so the device-to-host sync happens at FIRST READ
+    (np.asarray / float() / .block()), not inside run(). The host can
+    dispatch the next step(s) while the device still works on this one —
+    the async dispatch window that hides host latency.
+
+    Contract:
+      * `np.asarray(handle)` (or `float(handle)` for one-element fetches)
+        blocks until the value is on the host; the wait is recorded in the
+        `executor.host_stall.seconds` histogram, and the result is cached.
+      * `.ready` is a non-blocking completion probe.
+      * deferred errors: a step that fails ON DEVICE (or a conversion that
+        fails) raises at the first read — and again at every later read —
+        not at run() time (docs/migration.md).
+      * the `executor.inflight` gauge counts handles created minus handles
+        synced (or garbage-collected unread)."""
+
+    __slots__ = ('_value', '_materialize', '_result', '_synced')
+
+    def __init__(self, value, materialize=None):
+        self._value = value
+        self._materialize = materialize if materialize is not None \
+            else (lambda v=value: np.asarray(v))
+        self._result = None
+        self._synced = False
+        _inflight_delta(1)
+
+    @property
+    def ready(self):
+        """Non-blocking: has the device finished producing this value?"""
+        if self._synced:
+            return True
+        try:
+            return bool(self._value.is_ready())
+        except AttributeError:
+            return True
+
+    def block(self):
+        """Materialize on the host (cached). Records the blocking wait as
+        executor.host_stall; re-raises a deferred device error on every
+        read."""
+        if not self._synced:
+            was_ready = self.ready
+            try:
+                with obs.span('executor.host_stall', ready=was_ready):
+                    self._result = (True, self._materialize())
+            except BaseException as e:
+                self._result = (False, e)
+            finally:
+                self._synced = True
+                self._value = None
+                self._materialize = None
+                _inflight_delta(-1)
+        ok, payload = self._result
+        if ok:
+            return payload
+        raise payload
+
+    def __array__(self, dtype=None, copy=None):
+        a = np.asarray(self.block())
+        if dtype is not None and a.dtype != np.dtype(dtype):
+            a = a.astype(dtype)
+        elif copy:
+            a = a.copy()
+        return a
+
+    def __float__(self):
+        a = np.asarray(self.block())
+        if a.size != 1:
+            raise TypeError(
+                'float() on a fetch handle of shape %r — only one-element '
+                'fetches convert to a scalar' % (a.shape,))
+        return float(a.reshape(-1)[0])
+
+    def __del__(self):
+        # never-read handle: release its inflight slot so the gauge does
+        # not drift (the device work itself completes regardless)
+        if not getattr(self, '_synced', True):
+            self._synced = True
+            try:
+                _inflight_delta(-1)
+            except Exception:
+                pass   # interpreter shutdown: registry may be gone
+
+    def __repr__(self):
+        state = 'synced' if self._synced else (
+            'ready' if self.ready else 'pending')
+        return 'FetchHandle(%s)' % state
 
 
 class Executor(object):
@@ -764,8 +928,33 @@ class Executor(object):
         self._cache_hits = 0
         self._cache_misses = 0
         self._cache_evictions = 0
+        self._persistent_hits = 0
         self._last_compile_s = None
         self._last_cache_lookup = None   # {'outcome', 'key', 'entries'}
+        # Persistent XLA compilation cache: PADDLE_TPU_COMPILE_CACHE=<dir>
+        # wires jax's on-disk executable cache at construction, so a
+        # restarted process (Trainer resume, serving warmup) deserializes
+        # already-built modules — zero cold compiles on the second run.
+        # The min-compile-time/min-entry-size floors are zeroed so EVERY
+        # executable persists; the hit/miss probe below relies on a miss
+        # always writing a new cache entry.
+        self._compile_cache_dir = None
+        cc = os.environ.get(ENV_COMPILE_CACHE)
+        if cc:
+            try:
+                jax.config.update('jax_compilation_cache_dir', cc)
+                jax.config.update(
+                    'jax_persistent_cache_min_compile_time_secs', 0.0)
+                jax.config.update(
+                    'jax_persistent_cache_min_entry_size_bytes', 0)
+                self._compile_cache_dir = cc
+            except Exception as e:
+                import warnings
+                warnings.warn(
+                    '%s=%r: persistent compilation cache unavailable in '
+                    'this jax (%s: %s) — compiles stay per-process'
+                    % (ENV_COMPILE_CACHE, cc, type(e).__name__, e),
+                    RuntimeWarning)
 
     def _device(self):
         return self.place.jax_device()
@@ -979,13 +1168,10 @@ class Executor(object):
                 "paddle.batch(..., drop_last=True))" % (name, dv.shape[0], dp))
         return jax.device_put(dv, parallel.data_sharding(mesh, 'dp', dv.ndim))
 
-    def _prepare(self, program, feed, fetch_list, scope,
-                 use_program_cache=True):
-        """Shared front half of run()/lowered_hlo(): device-place the feed,
-        resolve the (program, feed-sig, fetch) cache key, and build or fetch
-        the _CompiledStep. Returns (compiled, feed_vals, persist)."""
-        dist_mesh = self._ensure_dist_placement(program, scope)
-
+    def _place_feed(self, program, feed, dist_mesh):
+        """Device-place one step's feed dict (dtype coercion, LoD wrapping,
+        mesh sharding). Shared by _prepare and run_bundle's per-step
+        stacker."""
         feed_vals = {}
         block = program.global_block()
         for name, val in feed.items():
@@ -1002,6 +1188,16 @@ class Executor(object):
             if dist_mesh is not None:
                 dv = self._dist_shard_feed(name, dv, dist_mesh)
             feed_vals[name] = dv
+        return feed_vals
+
+    def _prepare(self, program, feed, fetch_list, scope,
+                 use_program_cache=True):
+        """Shared front half of run()/lowered_hlo(): device-place the feed,
+        resolve the (program, feed-sig, fetch) cache key, and build or fetch
+        the _CompiledStep. Returns (compiled, feed_vals, persist)."""
+        dist_mesh = self._ensure_dist_placement(program, scope)
+        feed_vals = self._place_feed(program, feed, dist_mesh)
+        block = program.global_block()
 
         fetch_names = [_as_fetch_name(f) for f in fetch_list]
         feed_sig = tuple(sorted(_feed_signature(n, v) for n, v in feed_vals.items()))
@@ -1068,6 +1264,59 @@ class Executor(object):
         persist = {n: scope._chain_get(n) for n in compiled.persist_in}
         return compiled, feed_vals, persist
 
+    # -- persistent-compile-cache probe -----------------------------------
+
+    def _cc_entry_count(self):
+        """Number of cache entries in the persistent compilation cache
+        dir, or None when the cache is not wired. A cold compile writes
+        exactly one new entry (the min-compile-time/min-size floors are
+        zeroed at construction), so no-new-entries across a first jitted
+        call means the executable was DESERIALIZED — a persistent hit.
+        Cost: one flat scandir (jax's cache is a flat directory), and
+        only on FIRST calls — never in the steady-state loop. `-atime`
+        sidecars are excluded (reads may touch them). Caveats (stats,
+        not correctness): a concurrent writer inside the probe window
+        can make a hit look like a compile, and a compile jax declines
+        to serialize (cache-write error, uncacheable executable) against
+        an already non-empty dir would read as a hit."""
+        d = self._compile_cache_dir
+        if not d:
+            return None
+        if not os.path.isdir(d):
+            return 0
+        try:
+            with os.scandir(d) as it:
+                return sum(1 for e in it if not e.name.endswith('-atime'))
+        except OSError:
+            return 0
+
+    def _timed_first_call(self, fn, args, key_id, **fields):
+        """Run the first jitted call of a cache entry (trace + XLA compile
+        OR persistent-cache deserialize happen synchronously inside it),
+        classify which one happened, and record it: a real cold compile
+        emits the `executor.compile` span; a persistent hit emits an
+        `executor.compile.persistent_hit` event instead — so a warm-cache
+        restart's run log shows ZERO compile spans for already-cached
+        keys (docs/perf.md)."""
+        pre = self._cc_entry_count()
+        t0 = time.perf_counter()
+        out = fn(*args)
+        dt = time.perf_counter() - t0
+        hit = (pre is not None and pre > 0
+               and self._cc_entry_count() == pre)
+        if hit:
+            self._persistent_hits += 1
+            _C_PERSISTENT_HITS.inc()
+            if self._last_cache_lookup is not None:
+                self._last_cache_lookup['outcome'] = 'persistent_hit'
+            obs.event('executor.compile.persistent_hit', key=key_id,
+                      seconds=round(dt, 6), **fields)
+        else:
+            obs.span_record('executor.compile', dt, key=key_id, **fields)
+            self._last_compile_s = dt
+            _G_LAST_COMPILE.set(dt)
+        return out, ('persistent_hit' if hit else 'compile')
+
     def run(self,
             program=None,
             feed=None,
@@ -1076,7 +1325,27 @@ class Executor(object):
             fetch_var_name='fetch',
             scope=None,
             return_numpy=True,
-            use_program_cache=True):
+            use_program_cache=True,
+            sync='auto'):
+        """sync (docs/perf.md):
+          'auto'  — current default behavior: fetches are materialized on
+                    the host before run() returns (blocking); reserved to
+                    let the executor pick the mode per call site.
+          'block' — explicit blocking fetch (same as 'auto' today).
+          'async' — return lazy FetchHandle objects immediately after
+                    dispatch; the device-to-host sync happens at first
+                    read (np.asarray/float), recorded as
+                    executor.host_stall. Device errors defer to first
+                    read. return_numpy decides what .block() yields for
+                    sequence fetches (ndarray vs LoDTensor). NOTE: an
+                    armed anomaly_guard needs a host decision per step,
+                    so it syncs on the health vector before returning —
+                    the wait is recorded as a host_stall
+                    (cause=anomaly_guard) and mostly serializes the
+                    async window."""
+        if sync not in ('auto', 'block', 'async'):
+            raise ValueError(
+                "sync must be 'auto', 'block' or 'async', got %r" % (sync,))
         if program is None:
             program = default_main_program()
         if feed is None:
@@ -1113,55 +1382,290 @@ class Executor(object):
                     on_op=op_hook)
             elif not getattr(compiled, '_obs_compiled', False):
                 # first jitted call of this cache entry: jax traces and
-                # XLA-compiles synchronously inside it, so this span IS
-                # the compile wall time (plus one step's dispatch)
-                with obs.span('executor.compile',
-                              key=look.get('key')) as csp:
-                    fetches, new_persist, health = compiled(
-                        persist, feed_vals, rng)
+                # XLA-compiles (or persistent-cache-deserializes)
+                # synchronously inside it; _timed_first_call measures it
+                # and records executor.compile ONLY for real cold
+                # compiles (plus one step's dispatch either way)
+                (fetches, new_persist, health), outcome = \
+                    self._timed_first_call(
+                        compiled, (persist, feed_vals, rng),
+                        look.get('key'))
                 compiled._obs_compiled = True
-                step_sp.fields['compiled'] = True
-                self._last_compile_s = csp.seconds
-                _G_LAST_COMPILE.set(csp.seconds)
+                step_sp.fields['compiled'] = (outcome == 'compile')
+                if outcome == 'persistent_hit':
+                    step_sp.fields['cache'] = 'persistent_hit'
             else:
                 fetches, new_persist, health = compiled(
                     persist, feed_vals, rng)
             for n, v in new_persist.items():
                 scope._chain_set(n, v)
             if health is not None:
-                self._observe_health(program, health)
+                # the guard's contract is a HOST decision per step, so
+                # this syncs on the (tiny) health vector — which waits
+                # for the step itself. Under sync='async' that wait is
+                # the step's real host stall: record it, or the overlap
+                # histogram would read ~0 and lie (the guard largely
+                # serializes the async window; docs/perf.md).
+                if sync == 'async':
+                    with obs.span('executor.host_stall',
+                                  cause='anomaly_guard'):
+                        self._observe_health(program, health)
+                else:
+                    self._observe_health(program, health)
 
             fetch_f32 = bool(getattr(program, '_fetch_f32', False))
 
-            def _cast_back(x):
-                # Float16Transpiler contract: users keep fetching float32
-                if fetch_f32 and hasattr(x, 'dtype') and str(x.dtype) == 'bfloat16':
-                    return x.astype(jnp.float32)
-                return x
-
             # fetch conversion is where the device-to-host sync happens
-            # (np.asarray blocks on the step's outputs)
-            with obs.span('executor.fetch'):
+            # (np.asarray blocks on the step's outputs) — unless
+            # sync='async', which wraps each output in a lazy FetchHandle
+            # and returns without waiting on the device
+            with obs.span('executor.fetch', sync=sync):
+                out = [self._convert_fetch(v, fetch_f32, return_numpy,
+                                           sync == 'async')
+                       for v in fetches]
+        return out
+
+    def _convert_fetch(self, v, fetch_f32, return_numpy, lazy):
+        """One fetched value -> what run()/run_bundle() hand back: numpy /
+        device array / LoDTensor, or a lazy FetchHandle over the same
+        conversion when lazy."""
+        def _cast_back(x):
+            # Float16Transpiler contract: users keep fetching float32
+            if fetch_f32 and hasattr(x, 'dtype') and str(x.dtype) == 'bfloat16':
+                return x.astype(jnp.float32)
+            return x
+
+        if isinstance(v, SeqValue):
+            from .lod_tensor import LoDTensor
+            sv = SeqValue(_cast_back(v.data), v.lengths, v.outer_lengths)
+
+            def mat(sv=sv):
+                lt = LoDTensor.from_seq_value(sv)
+                return np.asarray(lt.data) if return_numpy else lt
+
+            if lazy:
+                return FetchHandle(sv.data, mat)
+            return mat()
+        v = _cast_back(v)
+        if lazy:
+            if return_numpy:
+                return FetchHandle(v)
+            # return_numpy=False keeps the value ON DEVICE in blocking
+            # mode; the async handle honors that — block() waits for
+            # completion but hands back the device array, no host copy
+            return FetchHandle(v, lambda v=v: jax.block_until_ready(v))
+        return np.asarray(v) if return_numpy else v
+
+    def run_bundle(self, program=None, feeds=None, fetch_list=None,
+                   steps=None, scope=None, return_numpy=True,
+                   use_program_cache=True, sync='auto'):
+        """Run K training steps as ONE compiled XLA module: a lax.scan of
+        the exact step body run() jits, amortizing the Python prepare
+        pass, the device dispatch, and the host round-trip over K steps —
+        the hot-loop pipelining lever for small/host-bound models
+        (docs/perf.md).
+
+        feeds: a list of K per-step feed dicts with identical signatures
+        (shapes/dtypes); they are stacked on a new leading axis and
+        scanned over. steps, when given, must equal len(feeds).
+
+        Semantics vs K unbundled run() calls — identical by construction:
+          * per-step RNG seeds advance exactly as run()'s counter does
+            (a dropout mask at bundled step j equals unbundled run j);
+          * the anomaly guard (when armed) evaluates health PER inner
+            step, rolls back that step's persistables in-graph, and skips
+            are observed/escalated per step on the host afterwards;
+          * persistables land back in the scope once, at bundle end.
+        One documented divergence: max_consecutive_skips escalation
+        raises AFTER the bundle's module ran — inner steps past the
+        escalation point already executed in-graph (each unhealthy one
+        individually rolled back), so the scope holds bundle-end state,
+        whereas K unbundled runs would have stopped at the raising step.
+        Divergence is a stop-the-run condition either way; the state is
+        consistent, just K-j steps further along.
+
+        Returns one entry per fetch, STACKED per step: ndarray/device
+        array with a leading K axis (sequence fetches: a list of K
+        LoDTensors), or lazy FetchHandles over the same when
+        sync='async'."""
+        if sync not in ('auto', 'block', 'async'):
+            raise ValueError(
+                "sync must be 'auto', 'block' or 'async', got %r" % (sync,))
+        if program is None:
+            program = default_main_program()
+        if fetch_list is None:
+            fetch_list = []
+        if scope is None:
+            scope = global_scope()
+        feeds = list(feeds or [])
+        if not feeds:
+            raise ValueError('run_bundle needs a non-empty list of '
+                             'per-step feed dicts')
+        K = len(feeds)
+        if steps is not None and int(steps) != K:
+            raise ValueError('steps=%d but %d feed dicts were given'
+                             % (steps, K))
+        with obs.span('executor.bundle', steps=K) as bsp:
+            compiled, feed0, persist = self._prepare(
+                program, feeds[0], fetch_list, scope,
+                use_program_cache=use_program_cache)
+            look = self._last_cache_lookup or {}
+            bsp.fields.update(cache=look.get('outcome'),
+                              key=look.get('key'))
+            extras = [n for n in compiled.persist_out
+                      if n not in compiled.persist_in]
+            if extras:
+                raise ValueError(
+                    'run_bundle: persistable output(s) %r have no value '
+                    'in the scope yet, so they cannot thread through the '
+                    'scan carry; run the startup program (or one '
+                    'unbundled step) first so every persistable is '
+                    'initialized' % (sorted(extras),))
+            mesh = compiled.mesh
+            names0 = set(feed0)
+            for j, f in enumerate(feeds[1:], start=1):
+                if set(f) != names0:
+                    raise ValueError(
+                        'run_bundle feed %d has names %r, expected %r — '
+                        'a bundle is ONE compiled module over a uniform '
+                        'feed set' % (j, sorted(f), sorted(names0)))
+            stacked = {}
+            slow_names = []
+            for name, v0 in feed0.items():
+                # fast path (the hot Trainer/bench case): K host ndarrays,
+                # no mesh, no sequence structure — ONE np.stack and ONE
+                # device transfer per feed name instead of K device_puts
+                # plus a device-side stack
+                if (mesh is None and not isinstance(v0, SeqValue)
+                        and all(isinstance(f[name], np.ndarray)
+                                for f in feeds)):
+                    vals = []
+                    for j, f in enumerate(feeds):
+                        a = f[name]
+                        if a.shape != v0.shape:
+                            raise ValueError(
+                                'run_bundle feed %d input %r has shape '
+                                '%r, expected %r (step 0) — a bundle is '
+                                'ONE compiled module over uniform shapes'
+                                % (j, name, a.shape, tuple(v0.shape)))
+                        vals.append(a)
+                    arr = np.stack(vals)
+                    if arr.dtype != v0.dtype:
+                        arr = arr.astype(v0.dtype)
+                    stacked[name] = jax.device_put(
+                        arr, self._device() if self.place is not None
+                        else None)
+                else:
+                    slow_names.append(name)
+            if slow_names:
+                # general path: place each step's feed like run() would
+                # and stack leaf-wise on device (SeqValue is a pytree, so
+                # sequence feeds stack their data and length planes
+                # together; mesh feeds keep their sharding pipeline)
+                sig0 = tuple(sorted(_feed_signature(n, feed0[n])
+                                    for n in slow_names))
+                per_step = [{n: feed0[n] for n in slow_names}]
+                for j, f in enumerate(feeds[1:], start=1):
+                    fv = self._place_feed(
+                        program, {n: f[n] for n in slow_names}, mesh)
+                    sig = tuple(sorted(_feed_signature(n, v)
+                                       for n, v in fv.items()))
+                    if sig != sig0:
+                        raise ValueError(
+                            'run_bundle feed %d has signature %r, '
+                            'expected every step to match step 0 (%r) — '
+                            'a bundle is ONE compiled module over '
+                            'uniform shapes' % (j, sig, sig0))
+                    per_step.append(fv)
+                stacked.update(jax.tree_util.tree_map(
+                    lambda *xs: jnp.stack(xs), *per_step))
+            # feed-transfer accounting: _prepare counted ONLY step 0's
+            # payload (its placed feed also pays one duplicate small
+            # transfer — the price of sharing run()'s signature/cache
+            # path); top up the counter to the full stacked volume so
+            # executor.feed.bytes doesn't under-report bundles K-fold
+            fb = sum(int(getattr(leaf, 'nbytes', 0))
+                     for leaf in jax.tree_util.tree_leaves(stacked))
+            _C_FEED_BYTES.inc(max(0, fb - self._last_feed_bytes))
+            self._last_feed_bytes = fb
+            # per-step RNG seeds: exactly the integers K successive run()
+            # calls would derive from the shared counter
+            base = (program.random_seed or 0) * 2654435761
+            seeds = np.asarray(
+                [(base + self._run_counter + j + 1) % (1 << 32)
+                 for j in range(K)], np.uint32)
+            run_base = self._run_counter
+            self._run_counter += K
+            _C_BUNDLED_STEPS.inc(K)
+            bundle_fn = compiled.bundle(K)
+            obs_key = ('bundle', K)
+            if obs_key not in getattr(compiled, '_obs_bundles', set()):
+                (new_persist, (fetches, healths)), outcome = \
+                    self._timed_first_call(
+                        bundle_fn, (persist, stacked, seeds),
+                        look.get('key'), bundle_steps=K)
+                if not hasattr(compiled, '_obs_bundles'):
+                    compiled._obs_bundles = set()
+                compiled._obs_bundles.add(obs_key)
+                bsp.fields['compiled'] = (outcome == 'compile')
+                if outcome == 'persistent_hit':
+                    bsp.fields['cache'] = 'persistent_hit'
+            else:
+                new_persist, (fetches, healths) = bundle_fn(
+                    persist, stacked, seeds)
+            for n, v in new_persist.items():
+                scope._chain_set(n, v)
+            if healths is not None:
+                # ONE host sync of the tiny [K] health matrix; skips are
+                # then observed (and escalated) per inner step, exactly
+                # as K unbundled runs would have. Under sync='async' the
+                # wait on the bundle's outputs happens HERE — record it
+                # as the host stall it is.
+                if sync == 'async':
+                    with obs.span('executor.host_stall',
+                                  cause='anomaly_guard', steps=K):
+                        h_np = {k: np.asarray(v)
+                                for k, v in healths.items()}
+                else:
+                    h_np = {k: np.asarray(v) for k, v in healths.items()}
+                for j in range(K):
+                    self._observe_health(
+                        program, {k: v[j] for k, v in h_np.items()},
+                        run_id=run_base + j + 1)
+
+            fetch_f32 = bool(getattr(program, '_fetch_f32', False))
+            with obs.span('executor.fetch', sync=sync, steps=K):
                 out = []
                 for v in fetches:
                     if isinstance(v, SeqValue):
-                        from .lod_tensor import LoDTensor
-                        lt = LoDTensor.from_seq_value(
-                            SeqValue(_cast_back(v.data), v.lengths,
-                                     v.outer_lengths))
-                        out.append(np.asarray(lt.data) if return_numpy
-                                   else lt)
+                        # stacked [K, batch, ...] sequence fetch -> K
+                        # per-step values (LoDTensor conversion is
+                        # per-step by construction)
+                        def mat_steps(v=v):
+                            return [self._convert_fetch(
+                                SeqValue(v.data[j], v.lengths[j],
+                                         tuple(o[j] for o in
+                                               v.outer_lengths)
+                                         if v.outer_lengths else None),
+                                fetch_f32, return_numpy, False)
+                                for j in range(K)]
+                        if sync == 'async':
+                            out.append(FetchHandle(v.data, mat_steps))
+                        else:
+                            out.append(mat_steps())
                     else:
-                        v = _cast_back(v)
-                        out.append(np.asarray(v) if return_numpy else v)
+                        out.append(self._convert_fetch(
+                            v, fetch_f32, return_numpy, sync == 'async'))
         return out
 
-    def _observe_health(self, program, health):
+    def _observe_health(self, program, health, run_id=None):
         """Host side of the anomaly guard: record the health vector, count
         skips, warn per skipped step, and escalate persistent divergence
         (max_consecutive_skips) to a FloatingPointError."""
         h = {k: np.asarray(v) for k, v in health.items()}
         self.last_step_health = h
+        if run_id is None:
+            run_id = self._run_counter
         # telemetry from the health vector ALREADY on the host — reusing
         # it costs no extra device sync (the guard's design invariant)
         _G_GRAD_NORM.set(float(h['grad_norm']))
@@ -1171,7 +1675,7 @@ class Executor(object):
         self.skipped_steps += 1
         self._consecutive_skips += 1
         _C_SKIPPED.inc()
-        obs.event('anomaly.skip', run=self._run_counter,
+        obs.event('anomaly.skip', run=run_id,
                   grad_norm=float(h['grad_norm']),
                   loss_finite=bool(h['loss_finite']),
                   grads_finite=bool(h['grads_finite']),
@@ -1181,7 +1685,7 @@ class Executor(object):
             'anomaly guard: step %d skipped (loss_finite=%s '
             'grads_finite=%s grad_norm=%s) — parameters and optimizer '
             'state were rolled back' % (
-                self._run_counter, bool(h['loss_finite']),
+                run_id, bool(h['loss_finite']),
                 bool(h['grads_finite']), float(h['grad_norm'])),
             RuntimeWarning, stacklevel=3)
         max_skips = getattr(program, '_anomaly_guard_max_skips', None)
@@ -1225,6 +1729,8 @@ class Executor(object):
                 'misses': self._cache_misses,
                 'entries': len(self._cache),
                 'evictions': self._cache_evictions,
+                'persistent_hits': self._persistent_hits,
+                'compile_cache_dir': self._compile_cache_dir,
                 'last_compile_seconds': self._last_compile_s}
 
     def close(self):
@@ -1234,9 +1740,11 @@ class Executor(object):
         self._cache_evictions += len(self._cache)
         _C_EVICTIONS.inc(len(self._cache))
         for step in self._cache.values():
-            fn = getattr(step, '_jitted', None)
-            if hasattr(fn, 'clear_cache'):
-                fn.clear_cache()
+            for fn in [getattr(step, '_jitted', None)] + \
+                    list(getattr(step, '_bundles', {}).values()):
+                if hasattr(fn, 'clear_cache'):
+                    fn.clear_cache()
+            step._bundles = {}
         self._cache.clear()
         import gc
         gc.collect()

@@ -115,12 +115,50 @@ class Trainer(object):
 
     def __init__(self, train_func, optimizer_func, param_path=None,
                  place=None, parallel=False, checkpoint_config=None,
-                 transpiler_fn=None):
+                 transpiler_fn=None, bundle_steps=1, sync='auto',
+                 async_window=2):
         """transpiler_fn(train_program): optional hook applied after
         minimize — the high-level entry for the Program transpilers, e.g.
         lambda p: fluid.TensorParallelTranspiler(tp=2).transpile(p)
         (or SequenceParallel/Pipeline; TPU extension, the reference's
-        Trainer had only the pserver path)."""
+        Trainer had only the pserver path).
+
+        Hot-loop pipelining (docs/perf.md):
+          bundle_steps=K (K>1) runs K reader batches per device dispatch
+          through Executor.run_bundle — one lax.scan-compiled module, one
+          host round-trip per K steps. Begin/EndStepEvents still fire per
+          logical step (End events carry that step's own metrics sliced
+          from the bundle); BeginStepEvent.fetch_metrics is honored per
+          BUNDLE (the first step's decision — a bundle is one compiled
+          module with one fetch set). Periodic checkpoints are taken at
+          bundle boundaries (the scope holds bundle-end state only).
+          sync='async' (unbundled path) fetches metrics as lazy
+          FetchHandles and keeps up to `async_window` steps in flight:
+          the loss is only synced when the event handler reads it (or
+          when the window evicts its oldest step), overlapping host
+          bookkeeping with device execution."""
+        if bundle_steps < 1:
+            raise ValueError('bundle_steps must be >= 1, got %r'
+                             % (bundle_steps,))
+        if sync not in ('auto', 'block', 'async'):
+            raise ValueError("sync must be 'auto', 'block' or 'async', "
+                             "got %r" % (sync,))
+        if parallel and (bundle_steps > 1 or sync == 'async'):
+            raise ValueError(
+                'bundle_steps/sync="async" pipeline the single-program '
+                'Executor hot loop; parallel=True (ParallelExecutor) '
+                'does not compose with them — express dp via '
+                'transpiler_fn instead')
+        if bundle_steps > 1 and sync == 'async':
+            raise ValueError(
+                'bundle_steps=%d already amortizes the host round-trip '
+                'over the bundle, and the bundled loop slices per-step '
+                'metrics for its EndStepEvents (a blocking read); '
+                "sync='async' applies to the unbundled loop — pick one"
+                % bundle_steps)
+        self.bundle_steps = int(bundle_steps)
+        self.sync = sync
+        self.async_window = max(1, int(async_window))
         self.__stop = False
         # preemption (SIGTERM/SIGINT while train() runs): the handler only
         # sets _preempt_requested; the loop finishes the in-flight step,
@@ -219,10 +257,14 @@ class Trainer(object):
             self._serial = int(meta.get('step', 0))
             return
 
-    def _save_checkpoint(self, epoch_id, step_id):
+    def _save_checkpoint(self, epoch_id, step_id, force=False):
+        """force=True skips the interval modulo gate — the bundled loop
+        applies its own range-crossing gate (a bundle boundary rarely
+        lands exactly ON an interval multiple) and records the bundle's
+        LAST step, the state the scope actually holds."""
         cfg = self.checkpoint_cfg
-        if epoch_id % cfg.epoch_interval == 0 \
-                and step_id % cfg.step_interval == 0:
+        if force or (epoch_id % cfg.epoch_interval == 0
+                     and step_id % cfg.step_interval == 0):
             self._serial += 1
             with self._prog_and_scope_guard():
                 with obs.span('trainer.checkpoint.save',
@@ -418,6 +460,23 @@ class Trainer(object):
                 main_program=self.train_program, scope=self.scope)
         return self.parallel_executor
 
+    @staticmethod
+    def _bundle_feed_sig(fed):
+        """Shape/dtype signature of one fed batch — bundles may only
+        group batches that share it (one compiled module)."""
+        from .executor import _feed_signature
+        return tuple(sorted(_feed_signature(n, v) for n, v in fed.items()))
+
+    def _drain_async_window(self, window, n_keep=0):
+        """Sync the oldest in-flight steps until at most n_keep remain.
+        Each block records executor.host_stall — the histogram that shows
+        how much device time the async window actually hid."""
+        from .executor import FetchHandle
+        while len(window) > n_keep:
+            for h in window.popleft():
+                if isinstance(h, FetchHandle):
+                    h.block()
+
     def _train_loop(self, exe, num_epochs, event_handler, reader, feed_order):
         with self._prog_and_scope_guard():
             feed_vars = build_feed_var_list(self.train_program, feed_order)
@@ -426,6 +485,13 @@ class Trainer(object):
             fetch = [v.name for v in self.train_func_outputs]
             cfg = self.checkpoint_cfg
             start_epoch = cfg.epoch_id if cfg and cfg.load_serial else 0
+            if self.bundle_steps > 1 and not is_pe:
+                self._train_loop_bundled(exe, num_epochs, event_handler,
+                                         reader, feeder, fetch)
+                return
+            use_async = self.sync == 'async' and not is_pe
+            import collections
+            window = collections.deque()   # in-flight async fetch handles
             # (epoch, step) of the last COMPLETED step this run — what an
             # emergency checkpoint must record when preemption is noticed
             # while the reader blocks / between steps, i.e. before another
@@ -435,6 +501,7 @@ class Trainer(object):
                 event_handler(BeginEpochEvent(epoch_id))
                 for step_id, data in enumerate(reader()):
                     if self.__stop:
+                        self._drain_async_window(window)
                         if cfg:
                             self._clean_checkpoint()
                         return
@@ -443,6 +510,7 @@ class Trainer(object):
                         # this batch (which can block for a long time):
                         # flush NOW from the consistent between-step
                         # state instead of paying for one more step
+                        self._drain_async_window(window)
                         self._finish_preemption(last_done)
                         return
                     if (cfg and cfg.load_serial
@@ -462,15 +530,29 @@ class Trainer(object):
                                   epoch=epoch_id, step=step_id):
                         if is_pe:
                             metrics = exe.run(want, feed=feeder.feed(data))
+                        elif use_async:
+                            metrics = exe.run(program=self.train_program,
+                                              feed=feeder.feed(data),
+                                              fetch_list=want,
+                                              sync='async')
                         else:
                             metrics = exe.run(program=self.train_program,
                                               feed=feeder.feed(data),
                                               fetch_list=want)
                     last_done = (epoch_id, step_id)
+                    if use_async:
+                        # bounded dispatch window: the handler below may
+                        # read (sync) its step's metrics or not — either
+                        # way at most async_window steps stay un-synced
+                        window.append(metrics)
+                        self._drain_async_window(window,
+                                                 n_keep=self.async_window)
                     if self._preempt_requested:
                         # the step above COMPLETED (run() synchronizes on
-                        # its fetches); record it and leave. No
-                        # _clean_checkpoint: the whole point is resuming.
+                        # its fetches; async handles sync on read); record
+                        # it and leave. No _clean_checkpoint: the whole
+                        # point is resuming.
+                        self._drain_async_window(window)
                         self._finish_preemption(last_done)
                         event_handler(EndStepEvent(epoch_id, step_id,
                                                    metrics))
@@ -481,7 +563,125 @@ class Trainer(object):
                 event_handler(EndEpochEvent(epoch_id))
                 if self._preempt_requested:
                     # between epochs: same flush, no extra step
+                    self._drain_async_window(window)
                     self._finish_preemption(last_done)
                     return
+            self._drain_async_window(window)
             if cfg:
                 self._clean_checkpoint()
+
+    def _train_loop_bundled(self, exe, num_epochs, event_handler, reader,
+                            feeder, fetch):
+        """K-step bundled hot loop: buffer K reader batches, run them as
+        ONE Executor.run_bundle dispatch, then fire the K EndStepEvents
+        with per-step metric slices. Stop/preemption are honored at
+        bundle boundaries (a partial buffer is flushed first, so no
+        consumed batch is silently dropped); periodic checkpoints are
+        taken after a bundle for its LAST step — the scope only ever
+        holds bundle-end state."""
+        import numpy as np
+        K = self.bundle_steps
+        cfg = self.checkpoint_cfg
+        start_epoch = cfg.epoch_id if cfg and cfg.load_serial else 0
+        last_done = None
+
+        def bundle_checkpoint(first_step, done):
+            """Periodic-checkpoint gate for a just-flushed bundle: save
+            when ANY step in [first_step, last_step] crossed a
+            step_interval mark — the boundary itself rarely lands on a
+            multiple (K=8, interval=10 never does), so the unbundled
+            modulo gate would silently never fire. Records the bundle's
+            last step: that is the state the scope holds."""
+            if not cfg or done is None:
+                return
+            epoch_id, last_step = done
+            if epoch_id % cfg.epoch_interval:
+                return
+            if any(s % cfg.step_interval == 0
+                   for s in range(first_step, last_step + 1)):
+                self._save_checkpoint(epoch_id, last_step, force=True)
+
+        def run_bundle_buf(buf, epoch_id):
+            """Execute buffered (step_id, feed, want) entries; returns the
+            last (epoch, step) done."""
+            if not buf:
+                return None
+            want = buf[0][2]   # fetch_metrics decided per bundle
+            feeds = [b[1] for b in buf]
+            self._steps_run = getattr(self, '_steps_run', 0) + len(buf)
+            with obs.span('trainer.step', step_num=self._steps_run,
+                          epoch=epoch_id, step=buf[-1][0],
+                          bundle_steps=len(buf)):
+                stacked = exe.run_bundle(program=self.train_program,
+                                         feeds=feeds, fetch_list=want)
+            for j, (step_id, _f, _w) in enumerate(buf):
+                if want:
+                    metrics = [m[j] if isinstance(m, list)
+                               else np.asarray(m)[j] for m in stacked]
+                else:
+                    metrics = []
+                event_handler(EndStepEvent(epoch_id, step_id, metrics))
+            return (epoch_id, buf[-1][0])
+
+        for epoch_id in range(start_epoch, num_epochs):
+            event_handler(BeginEpochEvent(epoch_id))
+            buf = []   # (step_id, feed_dict, want) awaiting one dispatch
+            buf_sig = None
+            for step_id, data in enumerate(reader()):
+                if self.__stop:
+                    done = run_bundle_buf(buf, epoch_id)
+                    last_done = done or last_done
+                    if cfg:
+                        self._clean_checkpoint()
+                    return
+                if self._preempt_requested:
+                    done = run_bundle_buf(buf, epoch_id)
+                    last_done = done or last_done
+                    self._finish_preemption(last_done)
+                    return
+                if (cfg and cfg.load_serial
+                        and epoch_id == cfg.epoch_id
+                        and step_id <= cfg.step_id):
+                    continue  # already done before the crash
+                begin = BeginStepEvent(epoch_id, step_id)
+                event_handler(begin)
+                fed = feeder.feed(data)
+                sig = self._bundle_feed_sig(fed)
+                if buf and sig != buf_sig:
+                    # batch shape changed mid-stream (classically: the
+                    # reader's short last batch) — a bundle is one
+                    # compiled module over uniform shapes, so flush what
+                    # is buffered and start a new bundle
+                    first = buf[0][0]
+                    done = run_bundle_buf(buf, epoch_id)
+                    last_done = done or last_done
+                    buf = []
+                    if not self._preempt_requested:
+                        bundle_checkpoint(first, done)
+                buf_sig = sig
+                # fetch set is per BUNDLE (one compiled module): the first
+                # buffered step's fetch_metrics decision wins
+                want = (buf[0][2] if buf
+                        else (fetch if begin.fetch_metrics else []))
+                buf.append((step_id, fed, want))
+                if len(buf) == K:
+                    first = buf[0][0]
+                    done = run_bundle_buf(buf, epoch_id)
+                    last_done = done or last_done
+                    buf = []
+                    if self._preempt_requested:
+                        self._finish_preemption(last_done)
+                        return
+                    bundle_checkpoint(first, done)
+            if buf:   # partial bundle at epoch end
+                first = buf[0][0]
+                done = run_bundle_buf(buf, epoch_id)
+                last_done = done or last_done
+                if not self._preempt_requested:
+                    bundle_checkpoint(first, done)
+            event_handler(EndEpochEvent(epoch_id))
+            if self._preempt_requested:
+                self._finish_preemption(last_done)
+                return
+        if cfg:
+            self._clean_checkpoint()

@@ -34,7 +34,7 @@ from .metrics import REGISTRY, counter, gauge, histogram  # noqa: F401
 
 __all__ = ['metrics', 'report', 'REGISTRY', 'counter', 'gauge', 'histogram',
            'enabled', 'obs_dir', 'enable', 'disable', 'event', 'span',
-           'run_log_path', 'ENV_DIR']
+           'span_record', 'run_log_path', 'ENV_DIR']
 
 ENV_DIR = 'PADDLE_TPU_OBS_DIR'
 # Optional: pin the run-log to an EXACT file path instead of a fresh
@@ -261,3 +261,29 @@ def span(name, step_num=None, **fields):
     jax.profiler.TraceAnnotation (StepTraceAnnotation when `step_num` is
     given), so Perfetto shows the same names the run log does."""
     return Span(name, step_num=step_num, **fields)
+
+
+def span_record(name, seconds, **fields):
+    """Record a span POST-HOC: the caller timed the region itself and only
+    afterwards knows whether (and under which name) it should be recorded.
+    The executor needs this for `executor.compile` — a first jitted call
+    is timed, then classified as a real cold compile (span recorded) or a
+    persistent-cache hit (an `executor.compile.persistent_hit` event
+    instead), so a warm-cache restart shows ZERO compile spans. Feeds the
+    same registry histogram and run-log span schema as span(); no trace
+    annotation (the region is already over). Returns the record dict when
+    written to the run log, else None."""
+    seconds = float(seconds)
+    h = _span_hists.get(name)
+    if h is None:
+        h = REGISTRY.histogram(name + '.seconds')
+        _span_hists[name] = h
+    h.observe(seconds)
+    rl = _run_log()
+    if rl is None:
+        return None
+    rec = {'ts': time.monotonic(), 'kind': 'span', 'name': name,
+           'span': next(_span_ids), 'parent': current_span_id(),
+           'dur_s': seconds, 'fields': dict(fields)}
+    rl.write(rec)
+    return rec

@@ -32,7 +32,11 @@ class _Instrument(object):
     def __init__(self, name, labels=()):
         self.name = name
         self.labels = dict(labels)
-        self._lock = threading.Lock()
+        # reentrant: an instrument update may allocate, allocation may
+        # trigger GC, and a destructor (executor.FetchHandle.__del__)
+        # may re-enter instrument code on the SAME thread — a plain Lock
+        # would self-deadlock there
+        self._lock = threading.RLock()
 
     def _base_snapshot(self):
         return {'kind': self.kind, 'name': self.name,

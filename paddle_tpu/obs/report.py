@@ -204,6 +204,35 @@ def summarize(events):
                      % (hits, misses, 100.0 * hits / (hits + misses)))
     else:
         lines.append('no cache lookups recorded')
+    # persistent (on-disk, cross-process) cache: a first jitted call that
+    # DESERIALIZED instead of compiling emits this event and NO
+    # executor.compile span — on a warm restart the compile section above
+    # should be empty and this line nonzero (docs/perf.md)
+    phits = _events(events, 'executor.compile.persistent_hit')
+    if phits:
+        lines.append('persistent cache: %d executable(s) deserialized '
+                     '(zero cold compiles for those keys)' % len(phits))
+
+    # -- bundling --------------------------------------------------------
+    bundles = _spans(events, 'executor.bundle')
+    if bundles:
+        bsteps = sum(int(s.get('fields', {}).get('steps', 0))
+                     for s in bundles)
+        bdur = [s['dur_s'] for s in bundles]
+        lines.append('')
+        lines.append('-- bundling --')
+        lines.append('%d bundle dispatch(es) covering %d steps '
+                     '(p50 %s p95 %s per bundle)'
+                     % (len(bundles), bsteps,
+                        _fmt_s(percentile_exact(bdur, 50)),
+                        _fmt_s(percentile_exact(bdur, 95))))
+    stalls = _spans(events, 'executor.host_stall')
+    if stalls:
+        sdur = [s['dur_s'] for s in stalls]
+        lines.append('async fetch: %d host stall(s), total %s '
+                     '(p95 %s) — time the host actually blocked on the '
+                     'device' % (len(stalls), _fmt_s(sum(sdur)),
+                                 _fmt_s(percentile_exact(sdur, 95))))
 
     # -- anomaly guard ---------------------------------------------------
     skips = _events(events, 'anomaly.skip')

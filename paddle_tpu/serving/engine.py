@@ -165,6 +165,7 @@ class ServingEngine(object):
 
     def __init__(self, model, config=None, per_row_outputs=None):
         self.config = config or ServingConfig()
+        self._model = model
         self._model_fn = model.run if hasattr(model, 'run') else model
         self.feed_names = list(model.feed_names)
         self._input_spec = getattr(model, 'input_spec', None)
@@ -332,7 +333,13 @@ class ServingEngine(object):
         `exe.cache_stats` or the absence of executor.compile events in
         the run log). Builds a feed per bucket by tiling `example_feed`
         (any row count >= 1) — or, when the model publishes a fully
-        static `input_spec`, a zeros feed. Returns the bucket list."""
+        static `input_spec`, a zeros feed. Returns the bucket list.
+
+        With PADDLE_TPU_COMPILE_CACHE set (docs/perf.md), a RESTARTED
+        server's warmup deserializes every bucket's executable from the
+        persistent cache instead of re-compiling: each serving.warmup
+        span then carries cache='persistent_hit' and the run log shows
+        zero executor.compile spans — warm in seconds, not minutes."""
         template = {}
         if example_feed is not None:
             arrays, _, _ = self._normalize_feed(example_feed)
@@ -348,10 +355,14 @@ class ServingEngine(object):
                 shape, dtype = sp
                 template[name] = np.zeros((1,) + tuple(
                     int(d) for d in shape[1:]), dtype=np.dtype(dtype))
+        exe = getattr(self._model, '_exe', None)
         for b in self.buckets:
             feed = {n: _buckets.pad_rows(a, b) for n, a in template.items()}
-            with obs.span('serving.warmup', bucket=b):
+            with obs.span('serving.warmup', bucket=b) as sp:
                 self._model_fn(feed)
+                if exe is not None:
+                    look = getattr(exe, '_last_cache_lookup', None) or {}
+                    sp.fields['cache'] = look.get('outcome')
         self._warm = True
         return list(self.buckets)
 

@@ -267,7 +267,8 @@ def test_cache_stats_and_table_header():
         loss = _fit_a_line_graph()
         exe = fluid.Executor(fluid.CPUPlace())
         assert exe.cache_stats == {'hits': 0, 'misses': 0, 'entries': 0,
-                                   'evictions': 0,
+                                   'evictions': 0, 'persistent_hits': 0,
+                                   'compile_cache_dir': None,
                                    'last_compile_seconds': None}
         exe.run(startup)
         xb, yb = _housing_batch()

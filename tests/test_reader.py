@@ -224,3 +224,88 @@ def test_source_reader_exception_propagates():
         with pytest.raises(IOError, match='shard corrupt'):
             list(reader.xmap_readers(lambda x: x, broken, 2, 4,
                                      order=order)())
+
+
+# ---------------------------------------------------------------------------
+# reader.pipeline.prefetch / bundle (the run_bundle feed pipeline)
+# ---------------------------------------------------------------------------
+
+def test_prefetch_worker_exception_propagates():
+    """A reader crash must surface in the CONSUMER — the old
+    `finally: put(_END)` shape turned it into a silent short epoch."""
+    import pytest
+    from paddle_tpu.reader.pipeline import prefetch
+
+    def broken():
+        yield 1
+        yield 2
+        raise IOError('reader shard corrupt')
+
+    got = []
+    with pytest.raises(IOError, match='shard corrupt'):
+        for item in prefetch(lambda: broken(), depth=2)():
+            got.append(item)
+    assert got == [1, 2]   # everything before the crash was delivered
+
+
+def test_prefetch_early_close_unblocks_worker():
+    """A consumer that stops early must release the worker thread, which
+    would otherwise block on q.put forever (depth-1 queue guarantees the
+    worker IS blocked mid-put when the consumer walks away)."""
+    import threading
+    import time
+    from paddle_tpu.reader.pipeline import prefetch
+
+    produced = []
+
+    def infinite():
+        i = 0
+        while True:
+            produced.append(i)
+            yield i
+            i += 1
+
+    before = threading.active_count()
+    it = prefetch(lambda: infinite(), depth=1)()
+    assert next(it) == 0
+    assert next(it) == 1
+    it.close()   # GeneratorExit -> stop event + queue drain
+    deadline = time.monotonic() + 5.0
+    while threading.active_count() > before and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert threading.active_count() <= before, \
+        'prefetch worker thread still alive after consumer close'
+    n_after_close = len(produced)
+    time.sleep(0.2)
+    assert len(produced) == n_after_close   # worker really stopped
+
+
+def test_prefetch_transform_runs_in_worker():
+    """transform (the device-put staging hook) is applied to every item,
+    off the consumer thread."""
+    import threading
+    from paddle_tpu.reader.pipeline import prefetch
+
+    main = threading.get_ident()
+    seen_threads = set()
+
+    def stage(x):
+        seen_threads.add(threading.get_ident())
+        return x * 10
+
+    got = list(prefetch(lambda: iter(range(5)), depth=2,
+                        transform=stage)())
+    assert got == [0, 10, 20, 30, 40]
+    assert main not in seen_threads
+
+
+def test_bundle_groups_batches():
+    from paddle_tpu.reader.pipeline import bundle
+    assert list(bundle(lambda: iter(range(7)), 3)()) \
+        == [[0, 1, 2], [3, 4, 5], [6]]
+    assert list(bundle(lambda: iter(range(7)), 3, drop_last=True)()) \
+        == [[0, 1, 2], [3, 4, 5]]
+    assert list(bundle(lambda: iter([]), 3)()) == []
+    import pytest
+    with pytest.raises(ValueError):
+        bundle(lambda: iter(range(3)), 0)

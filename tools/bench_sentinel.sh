@@ -26,6 +26,75 @@ probe() {
         2>/dev/null | grep -o 'PLATFORM=.*' | cut -d= -f2
 }
 
+compare_prev() {
+    # Regression sentinel: compare the fresh capture's per-metric
+    # steps/sec (value field) against the NEWEST committed BENCH round
+    # and warn on >10% drops — a slow tunnel day or a perf regression
+    # both deserve a loud line in the log before the driver sees it.
+    python - BENCH_TPU_SENTINEL.json <<'EOF' >> "$LOG" 2>&1
+import glob, json, re, sys
+
+def add(out, obj):
+    # Accepts all three record shapes: a per-metric line, the legacy
+    # nested summary ('metrics' list inside the headline record), and
+    # the flat summary (summary:true, headline metric/value only —
+    # a driver wrapper keeps just that last line). setdefault keeps the
+    # per-metric line's value when both were seen.
+    if not isinstance(obj, dict):
+        return
+    for m in obj.get('metrics') or []:       # legacy nested summary
+        add(out, m)
+    if obj.get('metric') and obj.get('value') is not None:
+        out.setdefault(obj['metric'], float(obj['value']))
+
+def metrics_of(path):
+    """Per-metric values from either format: raw bench stdout (one JSON
+    record per line) or a driver BENCH_r*.json wrapper ({'parsed': ...}
+    holding the bench's last line, possibly the legacy nested shape)."""
+    out = {}
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError:
+        return out
+    try:
+        whole = json.loads(text)
+    except ValueError:
+        whole = None
+    if isinstance(whole, dict):
+        add(out, whole.get('parsed') if 'parsed' in whole else whole)
+        return out
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith('{'):
+            try:
+                add(out, json.loads(line))
+            except ValueError:
+                pass
+    return out
+
+new = metrics_of(sys.argv[1])
+rounds = sorted(glob.glob('BENCH_r*.json'),
+                key=lambda p: int(re.search(r'r(\d+)', p).group(1)))
+if not rounds or not new:
+    print('[compare] nothing to compare (rounds=%d new=%d)'
+          % (len(rounds), len(new)))
+    raise SystemExit(0)
+prev_path = rounds[-1]
+prev = metrics_of(prev_path)
+for name in sorted(set(new) & set(prev)):
+    ratio = new[name] / prev[name] if prev[name] else float('inf')
+    flag = ''
+    if ratio < 0.9:
+        flag = '  <-- WARNING: >10%% regression vs %s' % prev_path
+    print('[compare] %s: %.2f vs %.2f (x%.3f)%s'
+          % (name, new[name], prev[name], ratio, flag))
+only = sorted(set(prev) - set(new))
+if only:
+    print('[compare] previously measured but missing now: %s' % only)
+EOF
+}
+
 capture() {
     log "TPU answered; running bench.py"
     BENCH_PLATFORM=tpu BENCH_BUDGET_S=2400 \
@@ -33,6 +102,7 @@ capture() {
     rc=$?
     log "bench.py rc=$rc"
     tail -c 400 BENCH_TPU_SENTINEL.json >> "$LOG"
+    compare_prev
     grep -q '"platform": "tpu"' BENCH_TPU_SENTINEL.json || return 1
     timeout 1200 python tools/tune_flash.py --seq 1024 --iters 10 \
         > tools/flash_tuned_sentinel.json 2>> "$LOG" \

@@ -90,7 +90,35 @@ if [ "${NDEV:-1}" -ge 2 ]; then
   done
 fi
 
-# 6. serving engine vs sequential Predictor (opt-in: SERVE=1). Closed
+# 6. K-step bundling sweep (opt-in: BUNDLE=1, or BUNDLE=K for one K):
+#    pipelined hot-loop steps/sec at several scan lengths via the bundle
+#    bench phase — the small-model host-bound case where dispatch
+#    amortization shows (docs/perf.md). Runs regardless of platform:
+#    the bundling win is host-side.
+if [ "${BUNDLE:-0}" != 0 ]; then
+  if [ "${BUNDLE}" = 1 ]; then KS="1 4 8 16"; else KS="$BUNDLE"; fi
+  for K in $KS; do
+    run env BENCH_BUNDLE_STEPS="$K" python bench.py --phase bundle \
+        --platform "${BENCH_PLATFORM:-tpu}"
+  done
+fi
+
+# 7. persistent compile-cache sweep (opt-in: CACHE_SWEEP=1): a cold run
+#    into a FRESH cache dir, then a SECOND PROCESS over the same dir.
+#    The second run's log must show zero executor.compile spans for the
+#    cached keys (executor.compile.persistent_hit events instead) — the
+#    restart-warmup contract (docs/perf.md). The obs_event rc records
+#    both runs' wall clock in the sweep run log for the delta.
+if [ "${CACHE_SWEEP:-0}" = 1 ]; then
+  CDIR=$(mktemp -d -t paddle_tpu_cc.XXXXXX)
+  run env PADDLE_TPU_COMPILE_CACHE="$CDIR" python bench.py --phase bundle \
+      --platform "${BENCH_PLATFORM:-tpu}"
+  run env PADDLE_TPU_COMPILE_CACHE="$CDIR" python bench.py --phase bundle \
+      --platform "${BENCH_PLATFORM:-tpu}"
+  rm -rf "$CDIR"
+fi
+
+# 8. serving engine vs sequential Predictor (opt-in: SERVE=1). Closed
 #    loop at the acceptance concurrency, then an open-loop arrival test;
 #    --check-compiles fails the command if steady state compiled, which
 #    the obs_event rc then records in the sweep run log.
