@@ -33,6 +33,8 @@ from .lod_tensor import LoDTensor, LoDTensorArray, create_lod_tensor, \
 from .layers.math_op_patch import monkey_patch_variable
 from . import unique_name
 from . import amp
+from . import analysis
+from .analysis import ProgramVerifyError
 from . import annotations
 from . import concurrency
 from . import default_scope_funcs

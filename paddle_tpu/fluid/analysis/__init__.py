@@ -1,0 +1,185 @@
+"""fluid.analysis — ahead-of-lowering static analysis of Fluid IR.
+
+The TPU path lowers a whole Program into ONE XLA module, so there is no
+per-op InferShape interpreter to reject a malformed graph at dispatch time
+(the reference's C++ executor validated every op as it ran). This package
+is that validation, moved to BUILD time: multi-pass static analysis over
+the Program/Block/Operator IR that returns structured `Finding`s with op
+provenance, before jit ever sees the graph.
+
+Passes (docs/analysis.md has the catalog):
+  1. dataflow/def-use      — dangling inputs, writes to feeds, dead ops,
+                             unreachable fetches, use-before-write of
+                             persistables (incl. run_bundle's scan carry);
+  2. shape/dtype inference — ShapeDtypeStruct propagation through every
+                             block via the per-op infer-rule registry
+                             (defaulting to eval_shape over the lowering
+                             rules — one definition of op semantics);
+  3. donation safety       — the persistable write-set vs the executor's
+                             buffer-donation decision (the PR-3
+                             donated-read-only-step bug class);
+  4. concurrency           — scope races: persistable writes in programs
+                             declared to run concurrently over a shared
+                             scope (serving Predictors, async windows).
+
+Entry points:
+  * analyze(program, ...)        -> [Finding]   (pure, never raises)
+  * Program.verify(level=...)    -> [Finding]   (raises/warns per level)
+  * maybe_verify(...)            — the PADDLE_TPU_VERIFY={off,warn,error}
+    gate the Executor and Predictor call once per program key; records the
+    `analysis.verify` obs span and the `analysis.findings` counter.
+  * tools/program_lint.py        — the same analysis over a saved
+    __model__ artifact.
+"""
+import os
+
+from ... import obs
+from . import concurrency as _concurrency
+from . import dataflow as _dataflow
+from . import donation as _donation
+from . import shapes as _shapes
+from .donation import executor_donates, executor_write_set, \
+    persistable_write_set  # noqa: F401  (re-export: executor uses these)
+from .findings import (Finding, ProgramVerifyError, SEV_ERROR, SEV_WARNING,
+                       sort_findings)
+from .shapes import register_infer  # noqa: F401
+
+__all__ = [
+    'analyze', 'maybe_verify', 'report_findings', 'verify_mode',
+    'Finding', 'ProgramVerifyError', 'SEV_ERROR', 'SEV_WARNING',
+    'executor_donates', 'executor_write_set', 'persistable_write_set',
+    'register_infer', 'ENV_VERIFY',
+]
+
+# PADDLE_TPU_VERIFY wires analyze() into Executor.run / Predictor load,
+# once per program key:
+#   off   (default) — no analysis on the run path;
+#   warn            — findings become warnings, the run proceeds;
+#   error           — error-severity findings raise ProgramVerifyError
+#                     BEFORE lowering (warnings still warn).
+ENV_VERIFY = 'PADDLE_TPU_VERIFY'
+
+_C_FINDINGS = obs.counter('analysis.findings')
+_C_VERIFIED = obs.counter('analysis.programs_verified')
+
+
+def verify_mode():
+    v = os.environ.get(ENV_VERIFY, 'off').strip().lower()
+    if v in ('', '0', 'off', 'false', 'no', 'none'):
+        return 'off'
+    if v in ('warn', 'warning'):
+        return 'warn'
+    if v in ('error', 'raise', '1', 'on', 'true'):
+        return 'error'
+    raise ValueError(
+        '%s must be one of off|warn|error, got %r' % (ENV_VERIFY, v))
+
+
+def analyze(program, startup=None, feeds=None, fetches=None,
+            initialized=None, concurrent=False, donates=None, bundle=False,
+            dead_ops=True, stats=None):
+    """Run every pass over `program`; returns sorted [Finding]. Pure: the
+    program is never mutated and nothing is raised for findings.
+
+    startup     — the matching startup Program; enables the
+                  use-before-write check (which persistables it
+                  initializes is unknowable without it).
+    feeds       — iterable of names actually fed (None: every is_data var
+                  counts as feedable).
+    fetches     — fetch target names; enables unreachable-fetch and
+                  dead-op detection (None: any terminal output may be a
+                  fetch, so neither check can fire).
+    initialized — names holding scope values at step entry (the executor
+                  passes its persist_in + feed names for a precise env
+                  model; None: assume every persistable is initialized).
+    concurrent  — the program will run concurrently over a shared scope
+                  (serving); arms the scope-race pass.
+    donates     — the executor's actual donation decision to cross-check
+                  (None: re-derive from the executor's own rule).
+    bundle      — the step will run under run_bundle's scan carry.
+    dead_ops    — False skips DeadOp liveness; the executor passes False
+                  because one run's fetch subset is not dead-code
+                  evidence (another call may fetch the rest). Lint and
+                  standalone contexts keep it on.
+    stats       — optional dict receiving shape-pass coverage counts.
+    """
+    findings = []
+    findings += _dataflow.run_pass(program, feeds=feeds, fetches=fetches,
+                                   initialized=initialized, startup=startup,
+                                   bundle=bundle, dead_ops=dead_ops)
+    findings += _shapes.run_pass(program, feeds=feeds, stats=stats)
+    findings += _donation.run_pass(program, donates=donates)
+    findings += _concurrency.run_pass(program, concurrent=concurrent)
+    return sort_findings(findings)
+
+
+def report_findings(findings, mode='warn', where=None):
+    """Uniform disposition of a finding list: 'warn' warns each finding;
+    'error' raises ProgramVerifyError when any error-severity finding
+    exists (warnings still warn). Returns the findings."""
+    import warnings
+    if not findings:
+        return findings
+    errors = [f for f in findings if f.severity == SEV_ERROR]
+    tag = ' (%s)' % where if where else ''
+    if mode == 'error' and errors:
+        raise ProgramVerifyError(
+            'program verification failed%s: %d error finding(s) '
+            '(%d total)\n%s' % (
+                tag, len(errors), len(findings),
+                '\n'.join('  %s' % f for f in findings)), findings)
+    for f in findings:
+        warnings.warn('program verifier%s: %s' % (tag, f), UserWarning,
+                      stacklevel=3)
+    return findings
+
+
+# once-per-program-key memo for the run-path gate; bounded — program
+# version bumps create new keys, so runaway program mutation is capped
+_seen = set()
+_SEEN_CAP = 8192
+
+
+def maybe_verify(program, key=None, where=None, **ctx):
+    """The run-path verification gate: no-op unless PADDLE_TPU_VERIFY is
+    warn/error, and at most ONE analysis per (program uid, version,
+    context) key — steady-state steps never re-analyze. Records the
+    `analysis.verify` span (with findings count) and the
+    analysis.findings counter. Returns the findings, or None when gated
+    off / already verified."""
+    mode = verify_mode()
+    if mode == 'off':
+        return None
+    if key is None:
+        key = (program._uid, program._version,
+               tuple(sorted(ctx.get('feeds') or ())),
+               tuple(ctx.get('fetches') or ()),
+               bool(ctx.get('concurrent')), ctx.get('donates'),
+               bool(ctx.get('bundle')))
+    # the memo is per (mode, key): escalating PADDLE_TPU_VERIFY from warn
+    # to error mid-process must re-judge already-seen programs, not skip
+    key = (mode, key)
+    if key in _seen:
+        return None
+    if len(_seen) > _SEEN_CAP:
+        _seen.clear()
+    with obs.span('analysis.verify', mode=mode,
+                  where=where or 'executor') as sp:
+        findings = analyze(program, **ctx)
+        sp.fields['findings'] = len(findings)
+        sp.fields['errors'] = sum(
+            1 for f in findings if f.severity == SEV_ERROR)
+    _C_VERIFIED.inc()
+    _C_FINDINGS.inc(len(findings))
+    if findings:
+        obs.event('analysis.findings',
+                  where=where or 'executor', mode=mode,
+                  kinds=sorted({f.kind for f in findings}),
+                  count=len(findings))
+    # may raise (mode=error): memoize ONLY a verification that passed, so
+    # a rejected program stays rejected on every retry of the same key —
+    # otherwise the second attempt would bypass the verifier and run the
+    # broken (or unsafe: scope-race, donation-gap) step anyway
+    report_findings(findings, mode=mode, where=where)
+    _seen.add(key)
+    return findings

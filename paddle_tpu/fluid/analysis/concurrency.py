@@ -1,0 +1,38 @@
+"""Pass 4 — scope-race detection for concurrent execution.
+
+A Program that WRITES persistables is only safe to run from one thread at
+a time against one scope: two concurrent steps would race on the shared
+parameter buffers (and, since a mutating step donates them, one step's
+write invalidates the buffer the other step is still reading — worse than
+a stale read). The serving engine and multi-threaded `Predictor`s run
+read-only programs by construction; this pass is the build-time guard
+that keeps it that way.
+
+The pass only fires when the caller declares the program WILL run
+concurrently over a shared scope (`analyze(..., concurrent=True)` — the
+serving/Predictor wiring passes it; a single-threaded trainer does not),
+so ordinary training programs report zero findings.
+"""
+from .donation import persistable_write_set, executor_write_set
+from .findings import Finding, SEV_ERROR, SCOPE_RACE
+
+__all__ = ['run_pass']
+
+
+def run_pass(program, concurrent=False):
+    if not concurrent:
+        return []
+    writes = persistable_write_set(program, recursive=True)
+    if not writes:
+        return []
+    donating = bool(executor_write_set(program))
+    return [Finding(
+        SCOPE_RACE, SEV_ERROR,
+        'program writes persistable(s) %r but is declared to run '
+        'CONCURRENTLY over a shared scope — steps would race on the '
+        'parameter buffers%s; serve a clone(for_test=True)-pruned '
+        'inference program, or give each runner a private scope'
+        % (sorted(writes),
+           ' (and the mutating step donates them, so a concurrent reader '
+           'sees invalidated memory)' if donating else ''),
+        var_names=sorted(writes))]

@@ -1,0 +1,107 @@
+"""Structured analyzer findings (docs/analysis.md).
+
+Every pass reports through the same Finding shape so the executor hook,
+`Program.verify`, and tools/program_lint.py can rank, print, and count them
+uniformly. A Finding names the op (block + index + type), the variables
+involved, and — when op provenance is on (framework.ENV_PROVENANCE) — the
+user-code callsite that built the op, so a build-time rejection reads
+"the fc you built at train.py:42", not an XLA trace dump.
+"""
+
+__all__ = [
+    'Finding', 'ProgramVerifyError',
+    'SEV_ERROR', 'SEV_WARNING',
+    'DANGLING_INPUT', 'WRITE_TO_FEED', 'DEAD_OP', 'UNREACHABLE_FETCH',
+    'USE_BEFORE_WRITE', 'SHAPE_MISMATCH', 'DTYPE_MISMATCH',
+    'DONATION_UNSAFE', 'SCOPE_RACE',
+]
+
+SEV_ERROR = 'error'       # the program cannot run correctly as lowered
+SEV_WARNING = 'warning'   # suspicious but executable (XLA DCEs dead ops)
+
+# finding kinds (one per checkable contract; the catalog lives in
+# docs/analysis.md)
+DANGLING_INPUT = 'DanglingInput'        # op input never defined at its use
+WRITE_TO_FEED = 'WriteToFeed'           # op output overwrites a feed var
+DEAD_OP = 'DeadOp'                      # op's outputs reach no fetch/persist
+UNREACHABLE_FETCH = 'UnreachableFetch'  # fetch name nothing defines
+USE_BEFORE_WRITE = 'UseBeforeWrite'     # persistable read before any write
+SHAPE_MISMATCH = 'ShapeMismatch'        # declared vs inferred shape conflict
+DTYPE_MISMATCH = 'DtypeMismatch'        # declared vs inferred dtype conflict
+DONATION_UNSAFE = 'DonationUnsafe'      # write-set vs donation decision
+SCOPE_RACE = 'ScopeRace'                # persistable writes + shared scope
+
+_SEV_ORDER = {SEV_ERROR: 0, SEV_WARNING: 1}
+
+
+class Finding(object):
+    """One analyzer verdict: what is wrong, where in the program, and where
+    in the user's code the offending op was built."""
+
+    __slots__ = ('kind', 'severity', 'message', 'block', 'op_index',
+                 'op_type', 'var_names', 'callsite')
+
+    def __init__(self, kind, severity, message, block=0, op_index=None,
+                 op_type=None, var_names=(), callsite=None):
+        self.kind = kind
+        self.severity = severity
+        self.message = message
+        self.block = block
+        self.op_index = op_index
+        self.op_type = op_type
+        self.var_names = tuple(var_names)
+        self.callsite = callsite
+
+    @classmethod
+    def for_op(cls, kind, severity, message, op, var_names=()):
+        """Finding anchored on an Operator: block/index/type/provenance are
+        derived from the op itself."""
+        blk = op.block
+        try:
+            idx = blk.ops.index(op)
+        except ValueError:
+            idx = None
+        return cls(kind, severity, message, block=blk.idx, op_index=idx,
+                   op_type=op.type, var_names=var_names,
+                   callsite=getattr(op, 'callsite', None))
+
+    def to_dict(self):
+        return {'kind': self.kind, 'severity': self.severity,
+                'message': self.message, 'block': self.block,
+                'op_index': self.op_index, 'op_type': self.op_type,
+                'var_names': list(self.var_names), 'callsite': self.callsite}
+
+    def _where(self):
+        parts = []
+        if self.op_index is not None:
+            parts.append('block %d op #%d (%s)'
+                         % (self.block, self.op_index, self.op_type))
+        elif self.op_type is not None:
+            parts.append('op %s' % self.op_type)
+        if self.callsite:
+            parts.append('built at %s' % self.callsite)
+        return ', '.join(parts)
+
+    def __repr__(self):
+        where = self._where()
+        return '[%s] %s: %s%s' % (self.severity, self.kind, self.message,
+                                  ' [%s]' % where if where else '')
+
+    __str__ = __repr__
+
+
+def sort_findings(findings):
+    """Errors first, then by (block, op index) program order."""
+    return sorted(findings, key=lambda f: (
+        _SEV_ORDER.get(f.severity, 9), f.block,
+        -1 if f.op_index is None else f.op_index))
+
+
+class ProgramVerifyError(ValueError):
+    """Raised by Program.verify(level='error') / PADDLE_TPU_VERIFY=error
+    when the analyzer reports error-severity findings. `.findings` carries
+    every finding (including warnings) for programmatic inspection."""
+
+    def __init__(self, message, findings):
+        super(ProgramVerifyError, self).__init__(message)
+        self.findings = list(findings)

@@ -1,0 +1,215 @@
+"""Pass 2 — whole-program shape/dtype inference.
+
+A per-op-type INFER RULE registry mirroring lowering.py's rule registry:
+an explicit rule can be registered with @register_infer('op'), and every
+op that has a lowering rule but no explicit infer rule gets the default —
+jax.eval_shape over its lowering rule (lowering.abstract_eval), so one
+definition of op semantics serves execution, build-time inference, AND
+static analysis. The pass PROPAGATES ShapeDtypeStructs through the block
+(sub-block bodies included): each op is abstract-evaluated on the specs
+its producers actually inferred, not on declared metadata, so a corrupted
+declaration is caught at the op that exposes it, with the op's build-time
+callsite.
+
+Findings: ShapeMismatch / DtypeMismatch when an op's inferred output
+contradicts the variable's declared metadata (per-dim: -1 on either side
+is compatible — the dynamic batch dim; rank conflicts and concrete-dim
+conflicts flag). Ops whose rules cannot abstract-eval (value-dependent
+control flow, LoDTensorArray plumbing with undeclared element shapes) are
+skipped, never guessed: the pass reports what it can prove.
+"""
+from .. import core
+from .. import lowering
+from .findings import (Finding, SEV_ERROR, SHAPE_MISMATCH, DTYPE_MISMATCH)
+
+__all__ = ['run_pass', 'register_infer', 'has_infer_rule', 'infer_rule']
+
+_INFER_RULES = {}
+
+
+def register_infer(op_type):
+    """Register an explicit analysis infer rule:
+    fn(op, in_specs) -> {slot: [spec | SeqValue | None]} (specs are
+    jax.ShapeDtypeStructs). Ops without one fall back to abstract-eval of
+    their lowering rule, so the registry covers every op with a lowering
+    rule by construction."""
+    def deco(fn):
+        _INFER_RULES[op_type] = fn
+        return fn
+    return deco
+
+
+def has_infer_rule(op_type):
+    return op_type in _INFER_RULES or lowering.has_rule(op_type)
+
+
+def infer_rule(op_type):
+    if op_type in _INFER_RULES:
+        return _INFER_RULES[op_type]
+    if lowering.has_rule(op_type):
+        return lowering.abstract_eval   # (op, in_specs) -> outs
+    raise lowering.NoRuleError('no infer rule for op %r' % op_type)
+
+
+@register_infer('autodiff')
+def _infer_autodiff(op, in_specs):
+    """Gradients mirror their parameters: @GRAD specs come from the
+    declared grad vars (backward.append_backward sized them)."""
+    return {'Grads': [lowering.spec_of(v)
+                      for v in op.outputs.get('Grads', [])]}
+
+
+def _declared_shape(var):
+    return tuple(var.shape) if var.shape is not None else None
+
+
+def _compatible_shape(declared, inferred):
+    """Per-dim comparison; -1 (dynamic) on either side matches anything.
+    A rank difference or a concrete-dim conflict is a mismatch."""
+    if len(declared) != len(inferred):
+        return False
+    for d, i in zip(declared, inferred):
+        if d == -1 or i == -1:
+            continue
+        if int(d) != int(i):
+            return False
+    return True
+
+
+# Declared 64-bit vars execute as their 32-bit counterparts on device
+# (jax x64 disabled — the TPU default; pytest.ini documents the same policy
+# for the per-cast truncation warning), so a declared/inferred difference
+# that is EXACTLY that truncation is not a finding.
+_X64_NARROWING = {'int64': 'int32', 'uint64': 'uint32', 'float64': 'float32'}
+
+
+def _canon_dtype(dt):
+    try:
+        import jax
+        if jax.config.jax_enable_x64:
+            return dt
+    except Exception:
+        pass
+    return _X64_NARROWING.get(dt, dt)
+
+
+def _check_output(op, var, spec, findings):
+    """Compare one inferred output spec against the var's declaration."""
+    data = spec.data if isinstance(spec, lowering.SeqValue) else spec
+    inferred_shape = lowering.shape_from_spec(data)
+    declared = _declared_shape(var)
+    if declared is not None and not _compatible_shape(declared,
+                                                      inferred_shape):
+        findings.append(Finding.for_op(
+            SHAPE_MISMATCH, SEV_ERROR,
+            'output %r declares shape %s but the op infers %s'
+            % (var.name, list(declared), list(inferred_shape)), op,
+            var_names=(var.name,)))
+    inferred_dtype = core.convert_dtype(data.dtype)
+    if var.dtype is not None and \
+            _canon_dtype(inferred_dtype) != _canon_dtype(var.dtype):
+        findings.append(Finding.for_op(
+            DTYPE_MISMATCH, SEV_ERROR,
+            'output %r declares dtype %s but the op infers %s'
+            % (var.name, var.dtype, inferred_dtype), op,
+            var_names=(var.name,)))
+
+
+def _in_specs(op, env):
+    """Per-slot input specs for an op: the propagated spec when a producer
+    ran, else the declared spec. Returns None (skip the op) when any input
+    has no usable spec."""
+    specs = {}
+    for slot, vs in op.inputs.items():
+        row = []
+        for v in vs:
+            s = env.get(v.name)
+            if s is None:
+                s = lowering.spec_of(v)
+            if s is None:
+                return None
+            row.append(s)
+        specs[slot] = row
+    return specs
+
+
+def _bind_declared(op, env):
+    for vs in op.outputs.values():
+        for v in vs:
+            s = lowering.spec_of(v)
+            if s is not None and v.name not in env:
+                env[v.name] = s
+
+
+def _walk(program, block, env, findings, stats, seen_blocks=None):
+    from .dataflow import sub_block_indices
+    if seen_blocks is None:
+        seen_blocks = set()
+    seen_blocks = seen_blocks | {block.idx}
+    for op in block.ops:
+        idxs = sub_block_indices(op, program)
+        if idxs or op.type in lowering._BLOCK_RULES:
+            # structured control flow: propagate through each body with a
+            # private env copy (branches/iterations do not leak), then
+            # trust the block op's declared outputs
+            for bi in idxs:
+                if bi in seen_blocks:
+                    continue
+                sub_env = dict(env)
+                _walk(program, program.block(bi), sub_env, findings, stats,
+                      seen_blocks=seen_blocks)
+            _bind_declared(op, env)
+            continue
+        try:
+            rule = infer_rule(op.type)
+        except lowering.NoRuleError:
+            stats['no_rule'] += 1
+            _bind_declared(op, env)
+            continue
+        in_specs = _in_specs(op, env)
+        if in_specs is None:
+            stats['skipped'] += 1
+            _bind_declared(op, env)
+            continue
+        try:
+            outs = rule(op, in_specs)
+        except Exception:
+            # value-dependent rule (concrete-index reads, host branching):
+            # nothing provable here — skip, never guess
+            stats['failed'] += 1
+            _bind_declared(op, env)
+            continue
+        stats['inferred'] += 1
+        for slot, vs in op.outputs.items():
+            vals = outs.get(slot) if hasattr(outs, 'get') else None
+            if vals is None:
+                continue
+            if not isinstance(vals, (list, tuple)):
+                vals = [vals]
+            for var, val in zip(vs, vals):
+                if val is None:
+                    continue
+                _check_output(op, var, val, findings)
+                env[var.name] = val
+
+
+def run_pass(program, feeds=None, stats=None):
+    """Propagate specs through every block from the feed/persistable
+    frontier; returns findings. `stats` (optional dict) receives
+    inferred/skipped/failed/no_rule op counts."""
+    findings = []
+    if stats is None:
+        stats = {}
+    for k in ('inferred', 'skipped', 'failed', 'no_rule'):
+        stats.setdefault(k, 0)
+    feed_names = set(feeds) if feeds is not None else None
+    env = {}
+    for v in program.list_vars():
+        fed = (v.name in feed_names if feed_names is not None
+               else getattr(v, 'is_data', False))
+        if fed or v.persistable:
+            s = lowering.spec_of(v)
+            if s is not None:
+                env[v.name] = s
+    _walk(program, program.global_block(), env, findings, stats)
+    return findings

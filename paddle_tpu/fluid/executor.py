@@ -289,13 +289,10 @@ class _CompiledStep(object):
         # invalidate the param buffers under concurrent runs (the
         # serving engine / multi-threaded Predictors) and the
         # passthrough outputs would be a full param copy per step.
-        produced = set()
-        persistable = {v.name for v in program.list_vars() if v.persistable}
-        for op in ops:
-            for vs in op.outputs.values():
-                for v in vs:
-                    if v.name in persistable:
-                        produced.add(v.name)
+        # The write-set computation is shared with fluid.analysis so the
+        # static verifier cross-checks THIS decision, not a copy of it.
+        from . import analysis
+        produced = set(analysis.executor_write_set(program))
         self.mutates_persist = bool(produced)
         if self.mutates_persist:
             produced |= set(self.persist_in)
@@ -1191,7 +1188,7 @@ class Executor(object):
         return feed_vals
 
     def _prepare(self, program, feed, fetch_list, scope,
-                 use_program_cache=True):
+                 use_program_cache=True, verify_bundle=False):
         """Shared front half of run()/lowered_hlo(): device-place the feed,
         resolve the (program, feed-sig, fetch) cache key, and build or fetch
         the _CompiledStep. Returns (compiled, feed_vals, persist)."""
@@ -1249,6 +1246,20 @@ class Executor(object):
             outcome = 'hit'
         self._last_cache_lookup = {'outcome': outcome, 'key': key_id,
                                    'entries': len(self._cache)}
+        # Ahead-of-lowering program verification (docs/analysis.md):
+        # PADDLE_TPU_VERIFY={off,warn,error}, ONE analysis per cache key —
+        # the steady-state loop never re-analyzes, so verify overhead
+        # amortizes to zero (the analysis.verify span is the proof). The
+        # env model is exact for this step: the real feed names, the real
+        # scope-initialized persistables, and the _CompiledStep's actual
+        # donation decision to cross-check.
+        from . import analysis
+        analysis.maybe_verify(
+            program, key=('verify', verify_bundle) + key, where='executor',
+            feeds=set(feed_vals), fetches=fetch_names,
+            initialized=set(persist_in) | set(feed_vals),
+            donates=compiled.mutates_persist, bundle=verify_bundle,
+            dead_ops=False)
         # feed-transfer accounting: nbytes is metadata only (no device
         # sync); SeqValues carry their dense payload + length vectors
         fb = 0
@@ -1508,7 +1519,7 @@ class Executor(object):
         with obs.span('executor.bundle', steps=K) as bsp:
             compiled, feed0, persist = self._prepare(
                 program, feeds[0], fetch_list, scope,
-                use_program_cache=use_program_cache)
+                use_program_cache=use_program_cache, verify_bundle=True)
             look = self._last_cache_lookup or {}
             bsp.fields.update(cache=look.get('outcome'),
                               key=look.get('key'))

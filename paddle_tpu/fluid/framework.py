@@ -14,6 +14,8 @@ lowering rules, so there is exactly one definition of every op's semantics.
 import collections
 import contextlib
 import copy
+import os
+import sys
 
 import numpy as np
 
@@ -24,6 +26,7 @@ __all__ = [
     'Program', 'Operator', 'Parameter', 'Variable', 'Block',
     'default_startup_program', 'default_main_program', 'program_guard',
     'name_scope', 'device_guard', 'get_var', 'grad_var_name',
+    'strict_infer_shape',
 ]
 
 GRAD_VAR_SUFFIX = "@GRAD"
@@ -45,6 +48,67 @@ DYN_DIM = 999983
 
 def grad_var_name(name):
     return name + GRAD_VAR_SUFFIX
+
+
+# -- op provenance (docs/analysis.md) ---------------------------------------
+# Every Operator records the user-code callsite that built it (the first
+# stack frame OUTSIDE paddle_tpu/fluid), so analyzer findings and strict
+# shape-inference errors can say "the op you built at train.py:42" instead
+# of naming an anonymous temp var. The sys._getframe walk costs ~1us per op
+# at BUILD time only (never on the run path); PADDLE_TPU_PROVENANCE=0
+# disables it for build-latency-critical embedders.
+ENV_PROVENANCE = 'PADDLE_TPU_PROVENANCE'
+_FLUID_DIR = os.path.dirname(os.path.abspath(__file__)) + os.sep
+
+
+def provenance_enabled():
+    return os.environ.get(ENV_PROVENANCE, '1').lower() not in (
+        '0', 'off', 'false', 'no')
+
+
+def _capture_callsite():
+    """file:line of the nearest stack frame outside paddle_tpu/fluid (the
+    layer call that created the op), or None when disabled/not found."""
+    if not provenance_enabled():
+        return None
+    try:
+        f = sys._getframe(2)
+    except ValueError:
+        return None
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not os.path.abspath(fn).startswith(_FLUID_DIR):
+            return '%s:%d' % (fn, f.f_lineno)
+        f = f.f_back
+    return None
+
+
+# -- strict shape inference --------------------------------------------------
+# Default: append_op's build-time inference is best-effort (a rule that
+# cannot abstract-eval leaves the declared shapes alone). Under strict mode
+# a FAILING rule raises lowering.InferShapeError naming the op type and its
+# build callsite — the loud contract layers opt into and tests drill.
+ENV_STRICT_INFER = 'PADDLE_TPU_STRICT_INFER'
+_strict_infer_override = []   # stack of bools from strict_infer_shape()
+
+
+def strict_infer_enabled():
+    if _strict_infer_override:
+        return _strict_infer_override[-1]
+    return os.environ.get(ENV_STRICT_INFER, '').lower() in (
+        '1', 'on', 'true', 'yes')
+
+
+@contextlib.contextmanager
+def strict_infer_shape(enable=True):
+    """Within this context, append_op(infer_shape=True) failures raise
+    lowering.InferShapeError (op type + provenance) instead of silently
+    leaving shapes undeclared."""
+    _strict_infer_override.append(bool(enable))
+    try:
+        yield
+    finally:
+        _strict_infer_override.pop()
 
 
 class Variable(object):
@@ -145,11 +209,23 @@ class Operator(object):
     lowering rule registered for `type` in ops_impl/.
     """
 
-    def __init__(self, block, type, inputs=None, outputs=None, attrs=None):
+    # default sentinel: capture the callsite. Callers that already KNOW the
+    # op's provenance (clone, _from_dict) pass the preserved value instead
+    # — a thousand-op artifact load must not pay a thousand stack walks
+    # for values it would immediately overwrite.
+    _CAPTURE = object()
+
+    def __init__(self, block, type, inputs=None, outputs=None, attrs=None,
+                 callsite=_CAPTURE):
         self.block = block
         self.type = type
         self.inputs = {}
         self.outputs = {}
+        # user-code file:line that built this op (None when provenance is
+        # disabled); clone()/prune()/_from_dict carry the original through
+        # the callsite kwarg, so findings keep pointing at the layer call
+        self.callsite = (_capture_callsite()
+                         if callsite is Operator._CAPTURE else callsite)
         self.attrs = dict(attrs or {})
         self.attrs.setdefault('op_role', ROLE_FORWARD)
         if _device_guard_stack and _device_guard_stack[-1] is not None:
@@ -209,12 +285,21 @@ class Operator(object):
                                        if k not in ('op_role',)})
 
     def _to_dict(self):
-        return dict(
+        d = dict(
             type=self.type,
             inputs={k: [v.name for v in vs] for k, vs in self.inputs.items()},
             outputs={k: [v.name for v in vs] for k, vs in self.outputs.items()},
             attrs={k: v for k, v in self.attrs.items()},
         )
+        if self.callsite:
+            # provenance survives save/load so program_lint findings on a
+            # saved artifact still name the original layer call — but as
+            # basename:line, not the absolute build-machine path: an
+            # artifact must not leak local filesystem layout, and two
+            # checkouts of the same tree must serialize byte-identically
+            path, _, line = self.callsite.rpartition(':')
+            d['callsite'] = '%s:%s' % (os.path.basename(path), line)
+        return d
 
 
 class Block(object):
@@ -263,14 +348,15 @@ class Block(object):
         return Parameter(self, *args, **kwargs)
 
     def append_op(self, type=None, inputs=None, outputs=None, attrs=None,
-                  infer_shape=True):
-        op = Operator(self, type=type, inputs=inputs, outputs=outputs, attrs=attrs)
+                  infer_shape=True, callsite=Operator._CAPTURE):
+        op = Operator(self, type=type, inputs=inputs, outputs=outputs,
+                      attrs=attrs, callsite=callsite)
         self.ops.append(op)
         self.program._bump_version()
         if infer_shape:
             try:
                 from . import lowering
-                lowering.infer_op_shapes(op)
+                lowering.infer_op_shapes(op, strict=strict_infer_enabled())
             except lowering.NoRuleError:
                 pass
         return op
@@ -404,8 +490,9 @@ class Program(object):
                 attrs = copy.deepcopy(op.attrs)
                 if for_test and 'is_test' in attrs:
                     attrs['is_test'] = True
-                nb.append_op(type=op.type, inputs=ins, outputs=outs, attrs=attrs,
-                             infer_shape=False)
+                nb.append_op(type=op.type, inputs=ins, outputs=outs,
+                             attrs=attrs, infer_shape=False,
+                             callsite=op.callsite)
         p.current_block_idx = 0
         self._retranspile_pipeline(p)
         p._bump_version()
@@ -431,6 +518,31 @@ class Program(object):
 
     def inference_optimize(self):
         return self.clone(for_test=True)
+
+    def verify(self, level='error', startup=None, feeds=None, fetches=None,
+               concurrent=False):
+        """Static analysis of this program BEFORE lowering (docs/analysis.md):
+        dataflow/def-use, shape/dtype inference, donation safety and
+        scope-race checks over every block. Returns the list of
+        analysis.Finding objects.
+
+        level: 'error' raises analysis.ProgramVerifyError when any
+        error-severity finding exists (warnings are warned); 'warn' warns
+        for every finding; 'off' skips analysis and returns [].
+        startup/feeds/fetches/concurrent refine the context exactly as
+        fluid.analysis.analyze does."""
+        if level not in ('off', 'warn', 'error'):
+            raise ValueError(
+                "verify level must be 'off', 'warn' or 'error', got %r"
+                % (level,))
+        if level == 'off':
+            return []
+        from . import analysis
+        findings = analysis.analyze(self, startup=startup, feeds=feeds,
+                                    fetches=fetches, concurrent=concurrent)
+        analysis.report_findings(findings, mode=level,
+                                 where='Program.verify')
+        return findings
 
     def prune(self, targets):
         """Backward-slice the program to the ops needed to compute
@@ -496,8 +608,11 @@ class Program(object):
                        for k, vs in od['inputs'].items()}
                 outs = {k: [blk._var_recursive(n) for n in vs]
                         for k, vs in od['outputs'].items()}
+                # the serialized build site (or None) — never the
+                # deserialization frame, which would mislabel every finding
                 blk.append_op(type=od['type'], inputs=ins, outputs=outs,
-                              attrs=od['attrs'], infer_shape=False)
+                              attrs=od['attrs'], infer_shape=False,
+                              callsite=od.get('callsite'))
         p._bump_version()
         return p
 

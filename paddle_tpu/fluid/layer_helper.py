@@ -128,12 +128,15 @@ class LayerHelper(object):
 
     def _append_norm_except_dim(self, block, v, dim, out):
         """Append ops computing ||v|| over every axis except `dim` (all
-        axes when dim is None), keepdims, into var `out`."""
+        axes when dim is None), keepdims, into var `out`. The ops run with
+        real shape inference (square/reduce_sum/sqrt all have lowering
+        rules), so the wn temps carry inferred shapes/dtypes and the
+        analysis shape pass can check the whole reparameterization."""
         sq = block.create_var(
             name=unique_name.generate(self.name + '.wn_sq'),
             shape=None, dtype=v.dtype)
         block.append_op(type='square', inputs={'X': [v]},
-                        outputs={'Out': [sq]}, infer_shape=False)
+                        outputs={'Out': [sq]})
         red = block.create_var(
             name=unique_name.generate(self.name + '.wn_red'),
             shape=None, dtype=v.dtype)
@@ -141,10 +144,9 @@ class LayerHelper(object):
         axes = [i for i in range(ndim) if dim is None or i != dim]
         block.append_op(type='reduce_sum', inputs={'X': [sq]},
                         outputs={'Out': [red]},
-                        attrs={'dim': axes, 'keep_dim': True},
-                        infer_shape=False)
+                        attrs={'dim': axes, 'keep_dim': True})
         block.append_op(type='sqrt', inputs={'X': [red]},
-                        outputs={'Out': [out]}, infer_shape=False)
+                        outputs={'Out': [out]})
         return out
 
     def _create_weight_normalize(self, attr, shape, dtype):
@@ -184,12 +186,10 @@ class LayerHelper(object):
             name=unique_name.generate(self.name + '.wn_scale'),
             shape=None, dtype=dtype)
         blk.append_op(type='elementwise_div', inputs={'X': [g], 'Y': [norm]},
-                      outputs={'Out': [scale]}, attrs={'axis': -1},
-                      infer_shape=False)
+                      outputs={'Out': [scale]}, attrs={'axis': -1})
         w = blk.create_var(name=attr.name, shape=shape, dtype=dtype)
         blk.append_op(type='elementwise_mul', inputs={'X': [v], 'Y': [scale]},
-                      outputs={'Out': [w]}, attrs={'axis': -1},
-                      infer_shape=False)
+                      outputs={'Out': [w]}, attrs={'axis': -1})
         return w
 
     def get_or_create_parameter(self, name, shape, dtype, is_bias=False):

@@ -27,6 +27,12 @@ class NoRuleError(KeyError):
     pass
 
 
+class InferShapeError(ValueError):
+    """A lowering rule failed to abstract-eval at program-build time under
+    strict inference (framework.strict_infer_shape / PADDLE_TPU_STRICT_INFER)
+    — the message names the op type and the user callsite that built it."""
+
+
 def register(op_type):
     def deco(fn):
         _RULES[op_type] = fn
@@ -457,29 +463,32 @@ def _bind_outputs(op, outs, env):
                 env[var.name] = val
 
 
-def infer_op_shapes(op):
-    """Build-time shape/dtype inference by abstract-evaluating the rule.
+def spec_of(var):
+    """Build-time abstract value of a Variable: a jax.ShapeDtypeStruct (the
+    dynamic batch dim stood in by DYN_DIM), a SeqValue of specs for
+    lod_level>0 vars, or None when the shape is undeclared. Shared by
+    append_op's inference and the fluid.analysis shape pass."""
+    if var.shape is None:
+        return None
+    s = var._spec()
+    if var.lod_level and var.lod_level > 0:
+        # padded layout [batch, time, ...]; shape already carries both
+        # dynamic dims (see layers/io.py:data)
+        batch = s.shape[0]
+        lens = jax.ShapeDtypeStruct((batch,), np.int32)
+        if var.lod_level > 1:
+            return SeqValue(s, lens, jax.ShapeDtypeStruct((batch,), np.int32))
+        return SeqValue(s, lens)
+    return s
 
-    The dynamic batch dim (-1) is stood in by DYN_DIM and mapped back; this
-    replaces the reference's per-op C++ InferShape functions.
-    """
+
+def abstract_eval(op, in_specs):
+    """Abstract-evaluate op's lowering rule over per-slot input specs
+    ({slot: [spec | SeqValue-of-specs | None, ...]}) via jax.eval_shape.
+    Returns the rule's output structure with ShapeDtypeStructs for arrays.
+    Raises NoRuleError for unregistered ops and whatever the rule raises
+    when the specs are inconsistent (the caller decides strictness)."""
     rule = get_rule(op.type)
-
-    def spec_of(var):
-        if var.shape is None:
-            return None
-        s = var._spec()
-        if var.lod_level and var.lod_level > 0:
-            # padded layout [batch, time, ...]; shape already carries both
-            # dynamic dims (see layers/io.py:data)
-            batch = s.shape[0]
-            lens = jax.ShapeDtypeStruct((batch,), np.int32)
-            if var.lod_level > 1:
-                return SeqValue(s, lens, jax.ShapeDtypeStruct((batch,), np.int32))
-            return SeqValue(s, lens)
-        return s
-
-    ins = {slot: [spec_of(v) for v in vs] for slot, vs in op.inputs.items()}
 
     def f():
         key = jax.random.key(0)
@@ -488,9 +497,9 @@ def infer_op_shapes(op):
             slot: [jnp.zeros(s.data.shape, s.data.dtype) if isinstance(s, SeqValue)
                    else (jnp.zeros(s.shape, s.dtype) if s is not None else None)
                    for s in vs]
-            for slot, vs in ins.items()}
+            for slot, vs in in_specs.items()}
         # re-wrap SeqValues
-        for slot, vs in ins.items():
+        for slot, vs in in_specs.items():
             for i, s in enumerate(vs):
                 if isinstance(s, SeqValue):
                     concrete_ins[slot][i] = SeqValue(
@@ -498,9 +507,42 @@ def infer_op_shapes(op):
                         jnp.ones(s.lengths.shape, s.lengths.dtype))
         return rule(concrete_ins, op.attrs, ctx)
 
+    return jax.eval_shape(f)
+
+
+def shape_from_spec(spec):
+    """Declared-shape view of an inferred ShapeDtypeStruct: DYN_DIM is
+    prime, so any multiple of it can only have come from the dynamic batch
+    dim (tiled/merged by expand/reshape) and maps back to -1."""
+    return tuple(-1 if d % DYN_DIM == 0 and d > 0 else int(d)
+                 for d in spec.shape)
+
+
+def infer_op_shapes(op, strict=False):
+    """Build-time shape/dtype inference by abstract-evaluating the rule.
+
+    The dynamic batch dim (-1) is stood in by DYN_DIM and mapped back; this
+    replaces the reference's per-op C++ InferShape functions. Best-effort
+    by default (a failing rule leaves declared shapes alone); with
+    strict=True a failure raises InferShapeError naming the op type and
+    the callsite that built it (framework.strict_infer_shape)."""
+    ins = {slot: [spec_of(v) for v in vs] for slot, vs in op.inputs.items()}
+
     try:
-        outs = jax.eval_shape(f)
-    except Exception:
+        outs = abstract_eval(op, ins)
+    except NoRuleError:
+        raise
+    except Exception as e:
+        if strict:
+            site = getattr(op, 'callsite', None)
+            raise InferShapeError(
+                "shape inference failed for op %r%s: %s: %s (inputs: %s)"
+                % (op.type,
+                   ' built at %s' % site if site else '',
+                   type(e).__name__, e,
+                   {k: [getattr(s, 'shape', None) if not isinstance(s, SeqValue)
+                        else ('seq', s.data.shape) for s in vs]
+                    for k, vs in ins.items()}))
         return  # shape inference is best-effort at build time
 
     for slot, vs in op.outputs.items():
@@ -513,11 +555,7 @@ def infer_op_shapes(op):
             if val is None:
                 continue
             spec = val.data if isinstance(val, SeqValue) else val
-            # DYN_DIM is prime, so any multiple of it can only have come
-            # from the dynamic batch dim (tiled/merged by expand/reshape)
-            shape = tuple(-1 if d % DYN_DIM == 0 and d > 0 else int(d)
-                          for d in spec.shape)
-            var.shape = shape
+            var.shape = shape_from_spec(spec)
             from . import core
             var.dtype = core.convert_dtype(spec.dtype)
             if isinstance(val, SeqValue) and var.lod_level == 0:

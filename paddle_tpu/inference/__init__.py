@@ -42,6 +42,15 @@ class Predictor(object):
         self._exe = Executor(self._place)
         prog, feeds, fetches = io.load_inference_model(dirname, self._exe,
                                                        scope=self._scope)
+        # Ahead-of-lowering verification (PADDLE_TPU_VERIFY, docs/
+        # analysis.md): a Predictor's program runs CONCURRENTLY against one
+        # scope (multi-threaded run(), the serving engine), so a saved
+        # artifact that still writes persistables is a scope race — reject
+        # it at load time, not as corrupted params under load.
+        from ..fluid import analysis
+        analysis.maybe_verify(
+            prog, where='predictor', feeds=list(feeds),
+            fetches=[v.name for v in fetches], concurrent=True)
         self._program = prog
         self.feed_names = feeds
         self._fetch_vars = fetches

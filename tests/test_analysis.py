@@ -1,0 +1,533 @@
+"""Program verifier drills (docs/analysis.md): every pass, the executor's
+PADDLE_TPU_VERIFY wiring, op provenance, strict inference, and the
+zero-findings sweep over every book model."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import analysis, framework, layers, lowering
+from paddle_tpu.fluid.analysis import donation
+from paddle_tpu.fluid.analysis.findings import (
+    DANGLING_INPUT, DEAD_OP, DONATION_UNSAFE, DTYPE_MISMATCH,
+    SCOPE_RACE, SHAPE_MISMATCH, UNREACHABLE_FETCH, USE_BEFORE_WRITE,
+    WRITE_TO_FEED)
+
+from util import fresh_program
+
+pytestmark = pytest.mark.analysis
+
+
+def _simple(depth=2):
+    """x -> relu -> scale chain; returns the terminal var."""
+    x = layers.data(name='x', shape=[8], dtype='float32')
+    h = layers.relu(x)
+    out = layers.scale(h, scale=2.0)
+    return x, h, out
+
+
+def _training():
+    x = layers.data(name='x', shape=[8], dtype='float32')
+    y = layers.data(name='y', shape=[1], dtype='float32')
+    pred = layers.fc(input=x, size=1)
+    cost = layers.mean(layers.square_error_cost(input=pred, label=y))
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(cost)
+    return cost
+
+
+def _kinds(findings):
+    return [f.kind for f in findings]
+
+
+# ---------------------------------------------------------------- dataflow
+
+def test_clean_programs_have_zero_findings():
+    with fresh_program() as (main, startup):
+        _, _, out = _simple()
+        assert analysis.analyze(main, startup=startup,
+                                fetches=[out.name]) == []
+        assert analysis.analyze(startup) == []
+
+
+def test_training_program_clean_and_not_a_race_single_threaded():
+    with fresh_program() as (main, startup):
+        cost = _training()
+        assert analysis.analyze(main, startup=startup,
+                                fetches=[cost.name]) == []
+
+
+def test_dangling_input_with_provenance():
+    with fresh_program() as (main, _):
+        _, _, out = _simple()
+        blk = main.global_block()
+        ghost = framework.Variable(blk, name='ghost', shape=[-1, 8],
+                                   dtype='float32')
+        blk.ops[1].inputs['X'] = [ghost]
+        fs = analysis.analyze(main)
+        assert _kinds(fs) == [DANGLING_INPUT]
+        f = fs[0]
+        assert f.severity == analysis.SEV_ERROR
+        assert 'ghost' in f.var_names
+        assert f.op_index == 1
+        assert f.callsite and 'test_analysis.py' in f.callsite
+
+
+def test_dropped_output_var_caught_downstream():
+    with fresh_program() as (main, _):
+        _, h, out = _simple()
+        del main.global_block().ops[0].outputs['Out']
+        fs = analysis.analyze(main)
+        assert DANGLING_INPUT in _kinds(fs)
+        assert any(h.name in f.var_names for f in fs)
+
+
+def test_write_to_feed_flagged():
+    with fresh_program() as (main, _):
+        x, _, out = _simple()
+        blk = main.global_block()
+        # redirect the scale op's output onto the feed var
+        blk.ops[1].outputs['Out'] = [x]
+        fs = analysis.analyze(main)
+        assert WRITE_TO_FEED in _kinds(fs)
+        assert any(x.name in f.var_names for f in fs)
+        # with an EXACT feed set that does not include x, the write is to
+        # an ordinary intermediate — no finding (the executor passes the
+        # real feed names, so an unfed data var must not false-positive)
+        fs2 = analysis.analyze(main, feeds=['other'])
+        assert WRITE_TO_FEED not in _kinds(fs2)
+
+
+def test_unreachable_fetch_and_dead_op():
+    with fresh_program() as (main, _):
+        _, _, out = _simple()
+        layers.sigmoid(out)   # unread, unfetched -> dead
+        fs = analysis.analyze(main, fetches=['no_such_var'])
+        kinds = _kinds(fs)
+        assert UNREACHABLE_FETCH in kinds
+        dead = [f for f in fs if f.kind == DEAD_OP]
+        assert dead and all(f.severity == analysis.SEV_WARNING for f in dead)
+        # with the real fetch only the sigmoid is dead
+        fs2 = analysis.analyze(main, fetches=[out.name])
+        assert _kinds(fs2) == [DEAD_OP]
+        assert fs2[0].op_type == 'sigmoid'
+
+
+def test_use_before_write_needs_startup_knowledge():
+    with fresh_program() as (main, startup):
+        x, _, out = _simple()
+        blk = main.global_block()
+        ctr = blk.create_var(name='ctr', shape=[1], dtype='float32',
+                             persistable=True)
+        layers.elementwise_add(out, ctr)
+        # without the startup program the check cannot judge: quiet
+        assert analysis.analyze(main) == []
+        fs = analysis.analyze(main, startup=startup)
+        assert _kinds(fs) == [USE_BEFORE_WRITE]
+        assert 'ctr' in fs[0].var_names
+        # a startup that initializes it silences the finding
+        startup.global_block().create_var(name='ctr', shape=[1],
+                                          dtype='float32', persistable=True)
+        startup.global_block().append_op(
+            type='fill_constant',
+            outputs={'Out': [startup.global_block().var('ctr')]},
+            attrs={'shape': [1], 'value': 0.0, 'dtype': 'float32'})
+        assert analysis.analyze(main, startup=startup) == []
+
+
+# ------------------------------------------------------------ shape/dtype
+
+def test_dtype_corruption_caught_at_declaration():
+    with fresh_program() as (main, _):
+        _, _, out = _simple()
+        main.global_block().var(out.name).dtype = 'int32'
+        fs = analysis.analyze(main)
+        assert _kinds(fs) == [DTYPE_MISMATCH]
+        assert out.name in fs[0].var_names
+        assert fs[0].callsite and 'test_analysis.py' in fs[0].callsite
+
+
+def test_shape_corruption_caught():
+    with fresh_program() as (main, _):
+        _, _, out = _simple()
+        main.global_block().var(out.name).shape = (4, 4)
+        fs = analysis.analyze(main)
+        assert _kinds(fs) == [SHAPE_MISMATCH]
+
+
+def test_declared_int64_runs_as_int32_is_not_a_finding():
+    with fresh_program() as (main, _):
+        x = layers.data(name='ids', shape=[1], dtype='int64')
+        layers.cast(x, 'int64')
+        assert analysis.analyze(main) == []
+
+
+def test_shape_pass_propagates_through_sub_blocks():
+    with fresh_program() as (main, _):
+        x = layers.data(name='x', shape=[8], dtype='float32')
+        limit = layers.fill_constant(shape=[1], dtype='int32', value=3)
+        i = layers.fill_constant(shape=[1], dtype='int32', value=0)
+        acc = layers.fill_constant(shape=[1, 8], dtype='float32', value=0.0)
+        cond = layers.less_than(i, limit)
+        w = layers.While(cond=cond)
+        with w.block():
+            nxt = layers.elementwise_add(acc, acc)
+            layers.assign(nxt, acc)
+            layers.assign(layers.increment(i, in_place=False), i)
+            layers.less_than(i, limit, cond=cond)
+        # corrupt a declaration INSIDE the loop body
+        sub = main.blocks[1]
+        name = sub.ops[0].outputs['Out'][0].name
+        sub.vars[name].dtype = 'int32'
+        fs = analysis.analyze(main)
+        assert DTYPE_MISMATCH in _kinds(fs)
+        assert any(f.block == 1 for f in fs)
+
+
+# --------------------------------------------------------- donation/races
+
+def test_donation_unsafe_cross_check_pr3_class():
+    """The PR-3 bug shape: a read-only inference step whose buffers the
+    executor would donate. The analyzer recomputes the write-set and
+    rejects the donation decision."""
+    with fresh_program() as (main, _):
+        _, _, out = _simple()
+        fs = donation.run_pass(main, donates=True)
+        assert _kinds(fs) == [DONATION_UNSAFE]
+        # and the inverse: writes that would neither donate nor write back
+        cost = None
+    with fresh_program() as (main, _):
+        _training()
+        fs = donation.run_pass(main, donates=False)
+        assert _kinds(fs) == [DONATION_UNSAFE]
+        # the executor's real decision is consistent: no finding
+        assert donation.run_pass(
+            main, donates=analysis.executor_donates(main)) == []
+
+
+def test_donation_subblock_only_write_flagged():
+    """A persistable written ONLY inside a loop body (a stat var local to
+    the sub-block, so it is not a While carry) never reaches the scope —
+    the executor's donation scan reads top-level outputs only."""
+    with fresh_program() as (main, _):
+        x = layers.data(name='x', shape=[4], dtype='float32')
+        limit = layers.fill_constant(shape=[1], dtype='int32', value=1)
+        i = layers.fill_constant(shape=[1], dtype='int32', value=0)
+        cond = layers.less_than(i, limit)
+        w = layers.While(cond=cond)
+        with w.block():
+            sub = main.current_block()
+            stat = sub.create_var(name='stat', shape=[-1, 4],
+                                  dtype='float32', persistable=True)
+            sub.append_op(type='assign', inputs={'X': [x]},
+                          outputs={'Out': [stat]})
+            layers.less_than(i, limit, cond=cond)
+        fs = [f for f in donation.run_pass(main)
+              if f.kind == DONATION_UNSAFE]
+        assert fs and 'stat' in fs[0].var_names
+        assert fs[0].op_type == 'assign' and fs[0].block == 1
+
+
+def test_orphaned_sub_block_writes_do_not_count():
+    """prune()/clone(for_test) drop ops but keep every Block, so a pruned
+    inference artifact can carry a dead While body that wrote a
+    persistable — an orphaned block never runs and must not trigger
+    ScopeRace/DonationUnsafe (a valid read-only artifact would be
+    rejected at Predictor load)."""
+    with fresh_program() as (main, _):
+        x = layers.data(name='x', shape=[4], dtype='float32')
+        limit = layers.fill_constant(shape=[1], dtype='int32', value=1)
+        i = layers.fill_constant(shape=[1], dtype='int32', value=0)
+        cond = layers.less_than(i, limit)
+        w = layers.While(cond=cond)
+        with w.block():
+            sub = main.current_block()
+            stat = sub.create_var(name='stat', shape=[-1, 4],
+                                  dtype='float32', persistable=True)
+            sub.append_op(type='assign', inputs={'X': [x]},
+                          outputs={'Out': [stat]})
+            layers.less_than(i, limit, cond=cond)
+        out = layers.relu(x)
+        pruned = main.clone(for_test=True).prune([out.name])
+        # the While op is gone but its body block remains, orphaned
+        assert pruned.num_blocks > 1
+        assert all(op.type != 'while'
+                   for op in pruned.global_block().ops)
+        assert donation.persistable_write_set(pruned) == set()
+        assert analysis.analyze(pruned, concurrent=True) == []
+
+
+def test_scope_race_only_when_concurrent():
+    with fresh_program() as (main, startup):
+        cost = _training()
+        infer = main.clone(for_test=True)
+        assert analysis.analyze(main) == []          # single-threaded: fine
+        race = analysis.analyze(main, concurrent=True)
+        assert SCOPE_RACE in _kinds(race)
+        assert all(f.severity == analysis.SEV_ERROR
+                   for f in race if f.kind == SCOPE_RACE)
+        # the pruned inference clone is race-free
+        assert analysis.analyze(infer, concurrent=True) == []
+
+
+# --------------------------------------------------- verify surfaces/knob
+
+def test_program_verify_levels():
+    with fresh_program() as (main, _):
+        _, _, out = _simple()
+        del main.global_block().ops[0].outputs['Out']
+        assert main.verify(level='off') == []
+        with pytest.warns(UserWarning, match='DanglingInput'):
+            fs = main.verify(level='warn')
+        assert fs
+        with pytest.raises(fluid.ProgramVerifyError) as ei:
+            main.verify()
+        assert any(f.kind == DANGLING_INPUT for f in ei.value.findings)
+        with pytest.raises(ValueError):
+            main.verify(level='loud')
+
+
+def test_executor_verify_env_knob_and_once_per_key(monkeypatch):
+    monkeypatch.setenv(analysis.ENV_VERIFY, 'error')
+    analysis._seen.clear()
+    from paddle_tpu import obs
+    hist = obs.REGISTRY.histogram('analysis.verify.seconds')
+    with fresh_program() as (main, startup):
+        _, _, out = _simple()
+        exe = fluid.Executor(fluid.CPUPlace())
+        feed = {'x': np.ones((2, 8), 'float32')}
+        before = hist.snapshot()['count']
+        exe.run(main, feed=feed, fetch_list=[out])
+        exe.run(main, feed=feed, fetch_list=[out])
+        # ONE analysis.verify span for two runs of the same key
+        assert hist.snapshot()['count'] == before + 1
+        # break the program: the run dies as a typed verifier error with
+        # provenance, not an XLA trace failure
+        blk = main.global_block()
+        ghost = framework.Variable(blk, name='ghost', shape=[-1, 8],
+                                   dtype='float32')
+        blk.ops[1].inputs['X'] = [ghost]
+        main._bump_version()
+        with pytest.raises(fluid.ProgramVerifyError) as ei:
+            exe.run(main, feed=feed, fetch_list=[out])
+        f = ei.value.findings[0]
+        assert f.kind == DANGLING_INPUT and f.callsite
+
+
+def test_executor_verify_rejects_on_every_retry(monkeypatch):
+    """A rejected program stays rejected: the once-per-key memo records
+    only PASSED verifications, so retrying the same broken step cannot
+    slip past the verifier into the raw lowering failure."""
+    monkeypatch.setenv(analysis.ENV_VERIFY, 'error')
+    analysis._seen.clear()
+    with fresh_program() as (main, _):
+        _, _, out = _simple()
+        blk = main.global_block()
+        ghost = framework.Variable(blk, name='ghost', shape=[-1, 8],
+                                   dtype='float32')
+        blk.ops[1].inputs['X'] = [ghost]
+        exe = fluid.Executor(fluid.CPUPlace())
+        for _ in range(3):
+            with pytest.raises(fluid.ProgramVerifyError):
+                exe.run(main, feed={'x': np.ones((2, 8), 'float32')},
+                        fetch_list=[out])
+
+
+def test_analyze_survives_corrupt_sub_block_attrs():
+    """program_lint feeds analyze() untrusted artifacts: cyclic or
+    out-of-range sub_block indices must produce findings (or nothing),
+    never a RecursionError/IndexError."""
+    with fresh_program() as (main, _):
+        _, _, out = _simple()
+        op = main.global_block().ops[0]
+        op.attrs['sub_block'] = 0          # claims its own block as body
+        analysis.analyze(main, fetches=[out.name])
+        op.attrs['sub_block'] = 99         # out of range
+        analysis.analyze(main, fetches=[out.name])
+        op.attrs['sub_blocks'] = [0, 99]   # both, plural form
+        analysis.analyze(main, fetches=[out.name])
+        op.attrs['sub_blocks'] = [None, 'x', 1.5]   # non-int corruption
+        analysis.analyze(main, fetches=[out.name])
+
+
+def test_provenance_survives_serialization_round_trip():
+    """_from_dict must restore the serialized build site — never
+    re-capture the deserializing frame, which would stamp every finding
+    on a loaded artifact with the loader's file:line. Serialized form is
+    basename:line (artifacts must not leak absolute build-machine
+    paths)."""
+    with fresh_program() as (main, _):
+        _, _, out = _simple()
+        orig = main.global_block().ops[0].callsite
+        assert orig and 'test_analysis.py' in orig
+        blob = main._to_dict()
+        got = blob['blocks'][0]['ops'][0]['callsite']
+        assert got == 'test_analysis.py:%s' % orig.rsplit(':', 1)[1]
+        assert os.sep not in got
+        clone = fluid.Program._from_dict(blob)
+        assert clone.global_block().ops[0].callsite == got
+
+
+def test_verify_mode_escalation_rejudges_seen_programs(monkeypatch):
+    """The once-per-key memo is per (mode, key): flipping the knob from
+    warn to error mid-process must re-judge an already-seen program."""
+    monkeypatch.setenv(analysis.ENV_VERIFY, 'warn')
+    analysis._seen.clear()
+    with fresh_program() as (main, _):
+        _, _, out = _simple()
+        blk = main.global_block()
+        ghost = framework.Variable(blk, name='ghost', shape=[-1, 8],
+                                   dtype='float32')
+        blk.ops[1].inputs['X'] = [ghost]
+        exe = fluid.Executor(fluid.CPUPlace())
+        feed = {'x': np.ones((2, 8), 'float32')}
+        with pytest.warns(UserWarning, match='DanglingInput'):
+            with pytest.raises(Exception):   # lowering still fails (warn)
+                exe.run(main, feed=feed, fetch_list=[out])
+        monkeypatch.setenv(analysis.ENV_VERIFY, 'error')
+        with pytest.raises(fluid.ProgramVerifyError):
+            exe.run(main, feed=feed, fetch_list=[out])
+
+
+def test_executor_verify_off_by_default(monkeypatch):
+    monkeypatch.delenv(analysis.ENV_VERIFY, raising=False)
+    with fresh_program() as (main, _):
+        _, _, out = _simple()
+        blk = main.global_block()
+        ghost = framework.Variable(blk, name='ghost', shape=[-1, 8],
+                                   dtype='float32')
+        blk.ops[1].inputs['X'] = [ghost]
+        exe = fluid.Executor(fluid.CPUPlace())
+        # without the knob the failure is the raw lowering KeyError
+        with pytest.raises(Exception) as ei:
+            exe.run(main, feed={'x': np.ones((2, 8), 'float32')},
+                    fetch_list=[out])
+        assert not isinstance(ei.value, fluid.ProgramVerifyError)
+
+
+def test_run_bundle_carry_gap_is_a_verify_finding(monkeypatch):
+    monkeypatch.setenv(analysis.ENV_VERIFY, 'error')
+    analysis._seen.clear()
+    with fresh_program() as (main, startup):
+        cost = _training()
+        exe = fluid.Executor(fluid.CPUPlace())
+        feeds = [{'x': np.ones((2, 8), 'float32'),
+                  'y': np.ones((2, 1), 'float32')} for _ in range(2)]
+        # startup never ran: the scan carry has no persistable values
+        with pytest.raises(fluid.ProgramVerifyError) as ei:
+            exe.run_bundle(main, feeds=feeds, fetch_list=[cost], steps=2)
+        assert USE_BEFORE_WRITE in _kinds(ei.value.findings)
+        # initialized scope: verify is clean and the bundle runs
+        exe.run(startup)
+        out, = exe.run_bundle(main, feeds=feeds, fetch_list=[cost], steps=2)
+        assert np.asarray(out).shape[0] == 2
+
+
+def test_predictor_load_rejects_scope_race(tmp_path, monkeypatch):
+    monkeypatch.setenv(analysis.ENV_VERIFY, 'error')
+    analysis._seen.clear()
+    from paddle_tpu.inference import Predictor
+    with fresh_program() as (main, startup):
+        cost = _training()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        # a GOOD artifact (pruned inference program) loads clean
+        good = str(tmp_path / 'good')
+        pred = main.global_block().ops[1].outputs['Out'][0]
+        fluid.io.save_inference_model(good, ['x'], [pred], exe, main)
+        Predictor(good)
+        # a BAD artifact: the raw TRAINING program saved as if servable
+        bad = str(tmp_path / 'bad')
+        os.makedirs(bad, exist_ok=True)
+        meta = {'program': main._to_dict(), 'feed_names': ['x', 'y'],
+                'fetch_names': [cost.name]}
+        with open(os.path.join(bad, '__model__.json'), 'w') as f:
+            json.dump(meta, f)
+        fluid.io.save_persistables(exe, bad, main)
+        with pytest.raises(fluid.ProgramVerifyError) as ei:
+            Predictor(bad)
+        assert SCOPE_RACE in _kinds(ei.value.findings)
+
+
+# ------------------------------------------------- provenance + strictness
+
+def test_op_provenance_capture_and_flag(monkeypatch):
+    with fresh_program() as (main, _):
+        _, _, out = _simple()
+        site = main.global_block().ops[0].callsite
+        assert site and 'test_analysis.py' in site
+    monkeypatch.setenv(framework.ENV_PROVENANCE, '0')
+    with fresh_program() as (main, _):
+        _, _, out = _simple()
+        assert main.global_block().ops[0].callsite is None
+
+
+def test_clone_preserves_provenance():
+    with fresh_program() as (main, _):
+        _, _, out = _simple()
+        clone = main.clone(for_test=True)
+        assert (clone.global_block().ops[0].callsite
+                == main.global_block().ops[0].callsite)
+
+
+def test_strict_infer_shape_raises_with_op_and_callsite():
+    with fresh_program():
+        a = layers.data(name='a', shape=[8], dtype='float32')
+        b = layers.data(name='b', shape=[7], dtype='float32')
+        with framework.strict_infer_shape():
+            with pytest.raises(lowering.InferShapeError) as ei:
+                layers.elementwise_add(a, b)
+        msg = str(ei.value)
+        assert 'elementwise_add' in msg
+        assert 'test_analysis.py' in msg
+        # outside the context the same build is best-effort again
+        layers.elementwise_add(a, layers.relu(b))
+
+
+def test_weight_norm_temps_get_inferred_shapes():
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[6], dtype='float32')
+        layers.fc(input=x, size=4,
+                  param_attr=fluid.WeightNormParamAttr(dim=1, name='wn_w'))
+        for prog in (main, startup):
+            wn = [v for v in prog.list_vars() if '.wn_' in v.name]
+            assert wn, 'expected weight-norm temps in %r' % prog
+            for v in wn:
+                assert v.shape is not None, v.name
+        assert analysis.analyze(main, startup=startup) == []
+
+
+# ----------------------------------------------------------- model sweep
+
+_SMALL = {
+    'transformer': dict(batch_size=2, max_length=8, n_layer=1, d_model=32),
+    'machine_translation': dict(batch_size=2, embedding_dim=16,
+                                encoder_size=16),
+    'stacked_dynamic_lstm': dict(batch_size=2, lstm_size=16, emb_dim=16),
+    'se_resnext': dict(batch_size=2, class_dim=4),
+    'resnet': dict(depth=8, batch_size=2),
+    'vgg': dict(batch_size=2),
+    'deepfm': dict(batch_size=4, embed_dim=4),
+    'recommender_system': dict(batch_size=4, emb_dim=8, tower_dim=16),
+}
+
+
+def _model_names():
+    from paddle_tpu import models
+    return models.model_list
+
+
+@pytest.mark.parametrize('name', _model_names())
+def test_every_book_model_verifies_clean(name):
+    """Acceptance: verify() reports zero findings on every book-example
+    program (main AND startup), with full shape-pass coverage."""
+    from paddle_tpu import models
+    mod = models.get_model_module(name)
+    with fresh_program() as (main, startup):
+        mod.get_model(**_SMALL.get(name, {}))
+        stats = {}
+        fs = analysis.analyze(main, startup=startup, stats=stats)
+        assert fs == [], '%s main program: %s' % (name, fs)
+        assert analysis.analyze(startup) == [], '%s startup' % name
+        assert stats['no_rule'] == 0, stats

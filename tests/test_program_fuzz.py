@@ -1,11 +1,16 @@
 """Differential fuzz: random small op graphs must survive Program
 serialize → deserialize → re-execution bit-identically (the desc
 round-trip the reference guarantees through protobuf; here _to_dict/
-_from_dict, framework.py)."""
+_from_dict, framework.py) — and, since PR 5, every VALID random program
+must pass the static verifier with zero findings while every seeded
+mutation is caught with the right finding kind and op provenance
+(docs/analysis.md)."""
 import numpy as np
 
 import paddle_tpu.fluid as fluid
-from paddle_tpu.fluid import layers
+from paddle_tpu.fluid import analysis, framework, layers
+from paddle_tpu.fluid.analysis.findings import (
+    DANGLING_INPUT, DTYPE_MISMATCH, UNREACHABLE_FETCH)
 
 from util import fresh_program
 
@@ -95,3 +100,118 @@ def test_serialize_roundtrip_training_graph():
                                            fetch_list=[cost.name])[0]))
                   for _ in range(3)]
     np.testing.assert_allclose(orig, cloned, rtol=1e-6)
+
+
+def test_fuzz_valid_programs_verify_clean():
+    """No false positives: every randomly generated valid program (and its
+    serialization round-trip) passes verify() with zero findings."""
+    for seed in range(8):
+        rng = np.random.RandomState(seed)
+        with fresh_program() as (main, startup):
+            x = fluid.layers.data(name='x', shape=[8], dtype='float32')
+            out = _random_graph(rng, x)
+            # a random DAG legitimately grows unused branches; fetching
+            # every sink makes the whole graph live, so ANY finding —
+            # dead-op warnings included — is a false positive
+            blk = main.global_block()
+            consumed = {n for op in blk.ops for n in op.input_arg_names}
+            sinks = [v.name for op in blk.ops
+                     for vs in op.outputs.values() for v in vs
+                     if v.name not in consumed]
+            assert out.name in sinks
+            assert analysis.analyze(main, startup=startup,
+                                    fetches=sinks) == [], 'seed %d' % seed
+            clone = fluid.Program._from_dict(main._to_dict())
+            assert analysis.analyze(clone, fetches=sinks) == [], \
+                'seed %d after round-trip' % seed
+            assert main.verify(fetches=sinks) == []
+
+
+def test_fuzz_training_program_verifies_clean():
+    with fresh_program() as (main, startup):
+        x = fluid.layers.data(name='x', shape=[8], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        pred = layers.fc(input=layers.fc(input=x, size=16, act='relu'),
+                         size=1)
+        cost = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(cost)
+        assert analysis.analyze(main, startup=startup,
+                                fetches=[cost.name]) == []
+
+
+def _fuzzed(seed):
+    rng = np.random.RandomState(seed)
+    x = fluid.layers.data(name='x', shape=[8], dtype='float32')
+    return _random_graph(rng, x)
+
+
+def test_fuzz_mutation_dangling_input():
+    """Seeded mutation: an op's input is re-pointed at a var nothing
+    produces — caught as DanglingInput with the op's build callsite."""
+    for seed in range(4):
+        with fresh_program() as (main, _):
+            out = _fuzzed(seed)
+            blk = main.global_block()
+            rng = np.random.RandomState(1000 + seed)
+            i = int(rng.randint(len(blk.ops)))
+            ghost = framework.Variable(blk, name='ghost_%d' % seed,
+                                       shape=[-1, 8], dtype='float32')
+            slot = sorted(blk.ops[i].inputs)[0]
+            blk.ops[i].inputs[slot] = [ghost]
+            fs = analysis.analyze(main)
+            hits = [f for f in fs if f.kind == DANGLING_INPUT]
+            assert hits, 'seed %d: %s' % (seed, fs)
+            assert hits[0].op_index == i
+            assert hits[0].callsite and 'test_program_fuzz' in hits[0].callsite
+
+
+def test_fuzz_mutation_dropped_output_var():
+    """Seeded mutation: a producer loses its output binding — every
+    downstream reader reports the orphaned name."""
+    for seed in range(4):
+        with fresh_program() as (main, _):
+            out = _fuzzed(seed)
+            blk = main.global_block()
+            # drop the first op whose output is actually consumed later
+            consumed = {n for op in blk.ops for n in op.input_arg_names}
+            idx, slot = next(
+                (i, s) for i, op in enumerate(blk.ops)
+                for s, vs in op.outputs.items()
+                if {v.name for v in vs} & consumed)
+            victim = next(v.name for v in blk.ops[idx].outputs[slot]
+                          if v.name in consumed)
+            del blk.ops[idx].outputs[slot]
+            fs = analysis.analyze(main)
+            hits = [f for f in fs if f.kind == DANGLING_INPUT
+                    and victim in f.var_names]
+            assert hits, 'seed %d: %s' % (seed, fs)
+            assert hits[0].callsite
+
+
+def test_fuzz_mutation_dtype_corruption():
+    """Seeded mutation: one intermediate declaration flips dtype — caught
+    as DtypeMismatch at the producing op."""
+    for seed in range(4):
+        with fresh_program() as (main, _):
+            out = _fuzzed(seed)
+            blk = main.global_block()
+            rng = np.random.RandomState(2000 + seed)
+            produced = [v for op in blk.ops
+                        for vs in op.outputs.values() for v in vs]
+            victim = produced[int(rng.randint(len(produced)))]
+            victim.dtype = 'int32'
+            fs = analysis.analyze(main)
+            hits = [f for f in fs if f.kind == DTYPE_MISMATCH
+                    and victim.name in f.var_names]
+            assert hits, 'seed %d: %s' % (seed, fs)
+            assert hits[0].op_type is not None and hits[0].callsite
+
+
+def test_fuzz_mutation_dead_fetch():
+    for seed in range(4):
+        with fresh_program() as (main, _):
+            _fuzzed(seed)
+            fs = analysis.analyze(main, fetches=['never_produced'])
+            assert any(f.kind == UNREACHABLE_FETCH
+                       and 'never_produced' in f.var_names for f in fs)

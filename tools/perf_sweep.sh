@@ -49,6 +49,24 @@ run() {
   obs_event "cmd=$*" "rc=$rc" "dur_s=$(awk "BEGIN{printf \"%.3f\", $t1-$t0}")"
 }
 
+# 0. lint gate (opt-in: LINT=1): the static-check step (compileall +
+# pyflakes when installed + program_lint over a fresh mnist export,
+# docs/analysis.md) before burning chip time on a broken tree.
+if [ "${LINT:-0}" = 1 ]; then
+  echo "== lint ==" | tee -a "$LOG"
+  # direct invocation, not run(): run()'s rc is function-local and it
+  # never aborts (benches may fail individually) — the lint GATE must
+  # actually gate, so a broken tree doesn't get chip time
+  if bash tools/lint.sh >> "$LOG" 2>&1; then
+    echo "lint OK" | tee -a "$LOG"
+    obs_event "cmd=lint" "rc=0"
+  else
+    echo "LINT FAILED — aborting sweep" | tee -a "$LOG"
+    obs_event "cmd=lint" "rc=1"
+    exit 1
+  fi
+fi
+
 echo "== tunnel probe ==" | tee -a "$LOG"
 if ! probe; then
   echo "TUNNEL DOWN — aborting" | tee -a "$LOG"

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""program_lint: static analysis of a saved Fluid program artifact.
+
+    python tools/program_lint.py MODEL_DIR            # dir with __model__.json
+    python tools/program_lint.py path/to/__model__.json
+    python tools/program_lint.py MODEL_DIR --json     # machine-readable
+    python tools/program_lint.py MODEL_DIR --fetch y_out --fetch probs
+    python tools/program_lint.py MODEL_DIR --concurrent   # serving context
+
+Rebuilds the Program from the artifact (the save_inference_model JSON —
+the TPU equivalent of a ProgramDesc) and runs every fluid.analysis pass
+over it the way obs_report.py reads run logs: dataflow/def-use,
+shape/dtype propagation, donation safety, and (with --concurrent, the
+serving default posture) the scope-race check. Feed/fetch names default
+to the artifact's own meta.
+
+Exit codes: 0 clean (warnings allowed with --strict unset), 1 findings at
+the failing severity, 2 unreadable artifact. Unlike obs_report this CLI
+DOES import paddle_tpu (shape propagation needs the lowering rules, hence
+jax); run it with JAX_PLATFORMS=cpu on machines without accelerators.
+"""
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _load_meta(path):
+    if os.path.isdir(path):
+        path = os.path.join(path, '__model__.json')
+    with open(path) as f:
+        return json.load(f), path
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog='program_lint', description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument('artifact', help='model dir or __model__.json path')
+    ap.add_argument('--json', action='store_true',
+                    help='emit findings as a JSON array')
+    ap.add_argument('--fetch', action='append', default=None,
+                    help='fetch target name (repeatable; default: the '
+                         'artifact\'s fetch_names)')
+    ap.add_argument('--concurrent', action='store_true',
+                    help='lint for concurrent shared-scope serving '
+                         '(arms the scope-race pass)')
+    ap.add_argument('--strict', action='store_true',
+                    help='exit 1 on warnings too, not just errors')
+    args = ap.parse_args(argv)
+
+    try:
+        meta, path = _load_meta(args.artifact)
+        from paddle_tpu.fluid.framework import Program
+        program = Program._from_dict(meta['program'])
+    except Exception as e:
+        print('program_lint: cannot load %r: %s: %s'
+              % (args.artifact, type(e).__name__, e), file=sys.stderr)
+        return 2
+
+    from paddle_tpu.fluid import analysis
+    feeds = meta.get('feed_names') or None
+    fetches = args.fetch or meta.get('fetch_names') or None
+    stats = {}
+    findings = analysis.analyze(program, feeds=feeds, fetches=fetches,
+                                concurrent=args.concurrent, stats=stats)
+
+    if args.json:
+        print(json.dumps([f.to_dict() for f in findings], indent=2))
+    else:
+        nops = sum(len(b.ops) for b in program.blocks)
+        print('%s: %d block(s), %d op(s); feeds=%s fetches=%s'
+              % (path, program.num_blocks, nops, feeds, fetches))
+        print('shape pass: %(inferred)d inferred, %(skipped)d skipped, '
+              '%(failed)d failed, %(no_rule)d without rules' % stats)
+        if not findings:
+            print('clean: no findings')
+        for f in findings:
+            print('  %s' % f)
+
+    errors = sum(1 for f in findings if f.severity == analysis.SEV_ERROR)
+    bad = len(findings) if args.strict else errors
+    return 1 if bad else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
