@@ -54,6 +54,15 @@ def _budget_left():
 
 _OBS = []
 
+# Resolved platform context, stamped into EVERY emitted record (metric,
+# skip marker, summary): `platform` is what the benches actually ran on,
+# `fallback` is True when an accelerator was wanted but the run fell
+# back to CPU — BENCH_r01 (1548 img/s, accelerator) vs BENCH_r05
+# (0.41 img/s, silent CPU fallback) must never again read as a
+# regression. Children inherit the flag via BENCH_FALLBACK.
+_PLATFORM = [None]
+_FALLBACK = [None]
+
 
 def _obs():
     """paddle_tpu.obs, loaded standalone through tools/obs_report.py's
@@ -82,7 +91,13 @@ def _emit(obj, mirror=True):
     the structured run log as a bench.metric event — BENCH_*.json
     trajectories and run logs share one JSONL event schema instead of
     being two dialects. mirror=False for lines merely relayed from a
-    phase child (the child already recorded them in its own run log)."""
+    phase child (the child already recorded them in its own run log).
+    Every record is stamped with the resolved platform + fallback flag
+    (setdefault: a child's own stamps win on relay)."""
+    if _PLATFORM[0] is not None:
+        obj.setdefault('platform', _PLATFORM[0])
+    if _FALLBACK[0] is not None:
+        obj.setdefault('fallback', _FALLBACK[0])
     print(json.dumps(obj))
     sys.stdout.flush()
     if mirror and os.environ.get('PADDLE_TPU_OBS_DIR'):
@@ -499,7 +514,24 @@ def run_phase(phase, platform):
     """Child-process entry: run ONE phase inline and emit its metric
     line(s). Isolation means a tunnel hang mid-phase kills only this
     process — the parent's timeout fires, and later phases still run."""
-    _setup_jax(force_cpu=platform != 'tpu')
+    _PLATFORM[0] = platform
+    _FALLBACK[0] = os.environ.get('BENCH_FALLBACK') == '1'
+    jax = _setup_jax(force_cpu=platform != 'tpu')
+    # stamp what jax ACTUALLY gives us, not the CLI claim: a direct
+    # `--phase X --platform tpu` invocation (perf_sweep) on a chipless
+    # machine silently lands on CPU, and labeling those records 'tpu'
+    # would defeat the sentinel's cross-platform refusal with false
+    # provenance
+    try:
+        actual = jax.devices()[0].platform
+    except Exception:
+        actual = platform
+    if actual != platform:
+        _log('*** WARNING: phase %s asked for platform=%s but jax backs '
+             'it with %s — records carry the REAL platform and '
+             '"fallback": true ***' % (phase, platform, actual))
+        _PLATFORM[0] = platform = actual
+        _FALLBACK[0] = True
     t = _tier(platform)
     if phase == 'transformer':
         fb = max(4, t['tbatch'] // 4)
@@ -600,6 +632,20 @@ def _run_phase_subprocess(phase, platform, timeout_s, metrics, seen_names):
                 metrics.append(obj)
             if obj.get('metric'):
                 seen_names.add(obj['metric'])
+            if obj.get('fallback') and obj.get('platform') \
+                    and not _FALLBACK[0]:
+                # the child re-probed jax and landed on a different
+                # backend than the parent believes in (BENCH_PLATFORM
+                # forced past the probe on a chipless machine): adopt
+                # its verdict, or the parent's own records — skip lines
+                # and a failed-resnet summary — would carry false
+                # accelerator provenance
+                _log('*** phase %s reports platform=%s fallback — '
+                     'parent records now carry it too ***'
+                     % (phase, obj['platform']))
+                _PLATFORM[0] = obj['platform']
+                _FALLBACK[0] = True
+                os.environ['BENCH_FALLBACK'] = '1'
             _emit(obj, mirror=False)  # the child already logged it
 
     th = threading.Thread(target=pump, daemon=True)
@@ -636,6 +682,22 @@ def main():
     if platform != 'tpu' and platform != 'cpu':
         _log('unrecognized platform %r: treating as cpu' % platform)
         platform = 'cpu'
+    # Fallback detection: unless the operator explicitly asked for CPU
+    # (BENCH_PLATFORM=cpu), an accelerator was the goal — landing on CPU
+    # is a FALLBACK that every record must carry and the log must shout
+    # about, so a 0.4 img/s CPU number can never be silently compared
+    # against a 1500 img/s accelerator round (bench_sentinel refuses the
+    # comparison outright on mismatched platforms).
+    requested = os.environ.get('BENCH_PLATFORM', '').strip().lower() or 'tpu'
+    fallback = (platform == 'cpu' and requested != 'cpu')
+    _PLATFORM[0] = platform
+    _FALLBACK[0] = fallback
+    os.environ['BENCH_FALLBACK'] = '1' if fallback else '0'
+    if fallback:
+        _log('*** WARNING: accelerator -> CPU platform FALLBACK ***')
+        _log('*** numbers below are CPU tiny-shape numbers; they are NOT '
+             'comparable to accelerator rounds and every record carries '
+             '"fallback": true ***')
     _log('platform=%s budget=%.0fs' % (platform, BUDGET_S))
 
     metrics = []
@@ -693,8 +755,12 @@ def main():
                 p2 = _probe_backend_once(90)
                 if p2 != 'tpu':
                     _log('accelerator gone (probe=%r) — remaining phases '
-                         'fall back to CPU tiny shapes' % (p2,))
+                         'fall back to CPU tiny shapes; their records '
+                         'carry "fallback": true' % (p2,))
                     platform = 'cpu'
+                    _PLATFORM[0] = platform
+                    _FALLBACK[0] = True
+                    os.environ['BENCH_FALLBACK'] = '1'
 
     # headline LAST so a line-by-line parser and a last-line parser agree;
     # it is ALWAYS the ResNet-50 series (round-1 continuity) — when that

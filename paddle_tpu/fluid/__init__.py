@@ -35,6 +35,7 @@ from . import unique_name
 from . import amp
 from . import analysis
 from .analysis import ProgramVerifyError
+from . import passes
 from . import annotations
 from . import concurrency
 from . import default_scope_funcs

@@ -38,6 +38,7 @@ from . import concurrency as _concurrency
 from . import dataflow as _dataflow
 from . import donation as _donation
 from . import shapes as _shapes
+from .dataflow import live_mask  # noqa: F401  (re-export: passes.dce)
 from .donation import executor_donates, executor_write_set, \
     persistable_write_set  # noqa: F401  (re-export: executor uses these)
 from .findings import (Finding, ProgramVerifyError, SEV_ERROR, SEV_WARNING,
@@ -48,7 +49,7 @@ __all__ = [
     'analyze', 'maybe_verify', 'report_findings', 'verify_mode',
     'Finding', 'ProgramVerifyError', 'SEV_ERROR', 'SEV_WARNING',
     'executor_donates', 'executor_write_set', 'persistable_write_set',
-    'register_infer', 'ENV_VERIFY',
+    'live_mask', 'register_infer', 'ENV_VERIFY',
 ]
 
 # PADDLE_TPU_VERIFY wires analyze() into Executor.run / Predictor load,

@@ -31,7 +31,8 @@ from .findings import (Finding, SEV_ERROR, SEV_WARNING, DANGLING_INPUT,
                        WRITE_TO_FEED, DEAD_OP, UNREACHABLE_FETCH,
                        USE_BEFORE_WRITE)
 
-__all__ = ['run_pass', 'sub_block_indices', 'op_reads', 'op_writes']
+__all__ = ['run_pass', 'sub_block_indices', 'op_reads', 'op_writes',
+           'live_mask']
 
 
 def sub_block_indices(op, program=None):
@@ -171,10 +172,22 @@ def _walk_block(program, block, defined, feed_names, findings,
             defined.add(n)
 
 
-def _liveness(program, block, fetch_names, findings, cache=None):
-    """Backward liveness over the top-level block: an op is live when any
-    output transitively reaches a fetch or a persistable write. Dead ops
-    are warnings (XLA drops them; they still cost trace time)."""
+def live_mask(program, block, fetch_names, cache=None, keep=None):
+    """Backward liveness over `block`: live[i] is True when op i's outputs
+    transitively reach a fetch or a persistable write — including
+    persistable writes that happen only inside the op's sub-blocks (a
+    While body updating a counter is live even when its carries are not
+    fetched). Shared by the DeadOp finding below and the dead-op
+    ELIMINATION transform (fluid.passes.dce), so the verifier's warning
+    and the optimizer's pruning can never disagree.
+
+    keep — optional predicate forcing ops live regardless of dataflow
+    (DCE passes its keep-effectful rule here, so a retained `print` op's
+    PRODUCERS stay live too; the backward walk propagates its reads like
+    any other live op's)."""
+    if cache is None:
+        cache = {}
+    persistables = {v.name for v in program.list_vars() if v.persistable}
     needed = set(fetch_names)
     live = [False] * len(block.ops)
     for i in range(len(block.ops) - 1, -1, -1):
@@ -183,6 +196,11 @@ def _liveness(program, block, fetch_names, findings, cache=None):
         writes_persist = any(
             getattr(v, 'persistable', False)
             for vs in op.outputs.values() for v in vs)
+        if not writes_persist:
+            writes_persist = any(
+                _block_writes(program, program.block(bi), cache=cache)
+                & persistables
+                for bi in sub_block_indices(op, program))
         if op.type == 'autodiff':
             # live iff any of its grads feed a live consumer
             if writes & needed:
@@ -190,9 +208,17 @@ def _liveness(program, block, fetch_names, findings, cache=None):
                 needed.add(op.attrs.get('loss_name', ''))
                 needed.update(op.input_arg_names)
             continue
-        if writes_persist or (writes & needed):
+        forced = keep is not None and keep(op)
+        if forced or writes_persist or (writes & needed):
             live[i] = True
             needed.update(op_reads(program, op, cache=cache))
+    return live
+
+
+def _liveness(program, block, fetch_names, findings, cache=None):
+    """DeadOp findings from live_mask: dead ops are warnings (XLA drops
+    them; they still cost trace time)."""
+    live = live_mask(program, block, fetch_names, cache=cache)
     for i, op in enumerate(block.ops):
         if not live[i]:
             findings.append(Finding.for_op(

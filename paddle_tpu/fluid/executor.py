@@ -280,28 +280,33 @@ class _CompiledStep(object):
         assert len(ad_idxs) <= 1, "at most one append_backward per program"
         self.ad_idx = ad_idxs[0] if ad_idxs else None
         self.sparse_plan = self._sparse_embedding_plan(program)
-        # Which persistables do the ops actually WRITE? Only a mutating
-        # step (training: optimizer updates, BN stats, LR counters)
-        # donates its persist buffers — in-place HBM updates — and must
-        # then re-expose EVERY donated input as an output so the scope
-        # keeps valid arrays. A read-only step (inference) donates
-        # nothing and writes nothing back: donation there would
-        # invalidate the param buffers under concurrent runs (the
-        # serving engine / multi-threaded Predictors) and the
-        # passthrough outputs would be a full param copy per step.
-        # The write-set computation is shared with fluid.analysis so the
-        # static verifier cross-checks THIS decision, not a copy of it.
-        from . import analysis
-        produced = set(analysis.executor_write_set(program))
-        self.mutates_persist = bool(produced)
-        if self.mutates_persist:
-            produced |= set(self.persist_in)
-        self.persist_out = sorted(produced)
+        # Donation/memory plan (fluid.passes.memplan): which persistables
+        # the ops actually WRITE decides donation. A mutating step
+        # (training: optimizer updates, BN stats, LR counters) donates
+        # EXACTLY its written buffers — in-place HBM updates, re-exposed
+        # as outputs — while read-only persistable inputs (frozen
+        # weights, inference BN stats) are neither donated nor carried
+        # through the module's output list: their scope buffers stay
+        # valid, and XLA stops paying a passthrough copy per step. A
+        # fully read-only step (inference) donates nothing at all:
+        # donation there would invalidate the param buffers under
+        # concurrent runs (the serving engine / multi-threaded
+        # Predictors). The plan derives from the SAME write-set
+        # fluid.analysis verifies, so the static donation-safety pass
+        # cross-checks THIS decision, not a copy of it; run_bundle and
+        # the serving warmup consume the same plan object.
+        from .passes import memory_plan
+        self.plan = memory_plan(program)
+        self.mutates_persist = self.plan.donates
+        self.donate_names = self.plan.donate_names(self.persist_in)
+        self.readonly_names = self.plan.readonly_names(self.persist_in)
+        self.persist_out = self.plan.persist_out()
 
         run_range = self._run_ops
 
-        def step(persist, feed, key):
-            env = dict(persist)
+        def step(donated, readonly, feed, key):
+            env = dict(readonly)
+            env.update(donated)
             env.update(feed)
             health = None
             if self.ad_idx is None:
@@ -324,18 +329,26 @@ class _CompiledStep(object):
             fetches = [env[n] for n in self.fetch_names]
             new_persist = {n: env[n] for n in self.persist_out if n in env}
             if health is not None:
-                self._select_healthy(health['healthy'], new_persist, persist)
+                self._select_healthy(health['healthy'], new_persist,
+                                     donated)
             for n, sh in self.persist_shardings.items():
                 if n in new_persist and not isinstance(new_persist[n], SeqValue):
                     new_persist[n] = jax.lax.with_sharding_constraint(
                         new_persist[n], sh)
             return fetches, new_persist, health
 
-        self._step = step  # pure, un-jitted (re-jittable with shardings)
+        self._step_fn = step  # pure, un-jitted, split (donated, readonly)
         self._jitted = jax.jit(
             step, donate_argnums=(0,) if self.mutates_persist else ())
         # K -> jitted K-step lax.scan over the SAME step body (run_bundle)
         self._bundles = {}
+
+    def _step(self, persist, feed, key):
+        """Un-jitted step over a FULL persist dict (the pre-plan
+        signature; export_compiled and the transpiler drills trace
+        through this)."""
+        donated, readonly = self.plan.split(persist)
+        return self._step_fn(donated, readonly, feed, key)
 
     def bundle(self, K):
         """The K-step bundled executable: ONE jitted lax.scan whose body is
@@ -352,18 +365,20 @@ class _CompiledStep(object):
         K = int(K)
         fn = self._bundles.get(K)
         if fn is None:
-            step = self._step
+            step = self._step_fn
 
-            def body(carry, xs):
-                feed, seed = xs
-                fetches, new_persist, health = step(
-                    carry, feed, jax.random.key(seed))
-                nxt = dict(carry)
-                nxt.update(new_persist)
-                return nxt, (fetches, health)
+            def bundled(donated, readonly, feeds, seeds):
+                # carry = the plan's donated (written) set only; the
+                # read-only persistables ride along as a plain argument,
+                # invariant across the scan
+                def body(carry, xs):
+                    feed, seed = xs
+                    fetches, new_persist, health = step(
+                        carry, readonly, feed, jax.random.key(seed))
+                    nxt = {n: new_persist.get(n, carry[n]) for n in carry}
+                    return nxt, (fetches, health)
 
-            def bundled(persist, feeds, seeds):
-                return jax.lax.scan(body, persist, (feeds, seeds))
+                return jax.lax.scan(body, donated, (feeds, seeds))
 
             fn = jax.jit(bundled,
                          donate_argnums=(0,) if self.mutates_persist else ())
@@ -593,14 +608,20 @@ class _CompiledStep(object):
             op = self.ops[i]
             if op.type == 'autodiff':
                 continue
+            # RNG stream id: the op's ORIGINAL build index when the
+            # optimizer stamped one (passes.OP_SEQ_ATTR) — op removal
+            # must never shift another op's dropout mask — else the
+            # list position (unoptimized programs, bit-for-bit the old
+            # behavior)
+            seq = op.attrs.get('op_seq', i)
             if on_op is None:
-                lowering.run_op(op, env, Ctx(key, i, amp=self.amp,
+                lowering.run_op(op, env, Ctx(key, seq, amp=self.amp,
                                              platform=self.platform,
                                              mesh=self.mesh))
             else:
                 import time
                 t0 = time.perf_counter()
-                lowering.run_op(op, env, Ctx(key, i, amp=self.amp,
+                lowering.run_op(op, env, Ctx(key, seq, amp=self.amp,
                                              platform=self.platform,
                                              mesh=self.mesh))
                 outs = [env[v.name] for vs in op.outputs.values()
@@ -761,7 +782,8 @@ class _CompiledStep(object):
         return fetches, new_persist, health
 
     def __call__(self, persist, feed, key):
-        return self._jitted(persist, feed, key)
+        donated, readonly = self.plan.split(persist)
+        return self._jitted(donated, readonly, feed, key)
 
 
 def _nan_inf_hook(i, op, dt, env):
@@ -1214,9 +1236,11 @@ class Executor(object):
                 persist_shardings[n] = v.sharding
         shard_sig = tuple(sorted((n, str(s.spec), s.mesh)
                                  for n, s in persist_shardings.items()))
+        from . import passes as passes_mod
+        opt = passes_mod.opt_mode()
         key = (program._uid, program._version, feed_sig, tuple(fetch_names),
                persist_in, amp, bool(getattr(program, '_use_remat', False)),
-               shard_sig, dist_mesh, guard)
+               shard_sig, dist_mesh, guard, opt)
         # short stable-within-process id naming this compiled module in
         # telemetry (step spans, compiled_op_table's header)
         key_id = '%08x' % (hash(key) & 0xFFFFFFFF)
@@ -1228,15 +1252,82 @@ class Executor(object):
             # shardings); the mesh devices set the platform then
             plat = (self._device().platform if self.place is not None
                     else jax.devices()[0].platform)
+            # Ahead-of-lowering optimization (docs/passes.md):
+            # PADDLE_TPU_OPT={off,default,aggressive}, applied ONCE per
+            # compiled-step cache key exactly like verify — the steady
+            # state re-optimizes nothing. The ORIGINAL program is never
+            # mutated; the _CompiledStep lowers the optimized clone. An
+            # optimizer failure must never take down a training run:
+            # fall back to the unoptimized lowering, loudly.
+            run_program, run_block = program, block
+            if opt != 'off':
+                try:
+                    run_program, _opt_report = passes_mod.optimize(
+                        program, feeds=set(feed_vals),
+                        fetches=fetch_names, level=opt, where='executor')
+                    run_block = run_program.global_block()
+                except Exception as e:
+                    import warnings
+                    warnings.warn(
+                        '%s=%s: program optimization failed (%s: %s) — '
+                        'lowering the unoptimized program'
+                        % (passes_mod.ENV_OPT, opt, type(e).__name__, e),
+                        RuntimeWarning)
+                    obs.event('passes.error', key=key_id,
+                              error='%s: %s' % (type(e).__name__, e))
+                    run_program, run_block = program, block
+            # the amp ctx flag dies for IR-rewritten programs: their
+            # casts are explicit ops now (passes.amp_pass), even when
+            # the global amp_guard armed the flag
+            step_amp = amp and not getattr(run_program, '_amp_ir', False)
             # the Program -> jittable-step build (op walk, sparse plan,
             # pipeline region checks); the XLA compile itself happens on
-            # the first call and is timed as executor.compile in run()
+            # the first call and is timed as executor.compile in run().
+            # When the OPTIMIZED clone fails to build (a pass bug the
+            # optimizer's own self-check missed), fall back to the
+            # unoptimized program rather than killing the run.
             with obs.span('executor.lowering', key=key_id):
-                compiled = _CompiledStep(program, block, list(feed_vals),
-                                         fetch_names, persist_in, amp=amp,
-                                         platform=plat,
-                                         persist_shardings=persist_shardings,
-                                         mesh=dist_mesh, guard=guard)
+                try:
+                    compiled = _CompiledStep(
+                        run_program, run_block, list(feed_vals),
+                        fetch_names, persist_in, amp=step_amp,
+                        platform=plat,
+                        persist_shardings=persist_shardings,
+                        mesh=dist_mesh, guard=guard)
+                    if run_program is not program:
+                        # PROBE the optimized step by tracing it now
+                        # (.lower() = trace to StableHLO, no XLA compile,
+                        # no execution, no donation): a pass bug that
+                        # slipped the optimizer's def-use self-check —
+                        # e.g. a rule resolving env by attr name — must
+                        # surface HERE, where the fallback below catches
+                        # it, not on the first run() call where nothing
+                        # does. Costs one extra trace per optimized
+                        # cache key, a small slice of the XLA compile
+                        # the key pays anyway.
+                        probe_persist = {
+                            n: scope._chain_get(n)
+                            for n in compiled.persist_in}
+                        compiled._jitted.lower(
+                            *compiled.plan.split(probe_persist),
+                            feed_vals, jax.random.key(0))
+                except Exception as e:
+                    if run_program is program:
+                        raise
+                    import warnings
+                    warnings.warn(
+                        '%s=%s: lowering the optimized program failed '
+                        '(%s: %s) — lowering the unoptimized program'
+                        % (passes_mod.ENV_OPT, opt, type(e).__name__, e),
+                        RuntimeWarning)
+                    obs.event('passes.error', key=key_id, stage='lowering',
+                              error='%s: %s' % (type(e).__name__, e))
+                    compiled = _CompiledStep(
+                        program, block, list(feed_vals),
+                        fetch_names, persist_in, amp=amp,
+                        platform=plat,
+                        persist_shardings=persist_shardings,
+                        mesh=dist_mesh, guard=guard)
             if use_program_cache:
                 self._cache[key] = compiled
             outcome = 'miss'
@@ -1523,8 +1614,7 @@ class Executor(object):
             look = self._last_cache_lookup or {}
             bsp.fields.update(cache=look.get('outcome'),
                               key=look.get('key'))
-            extras = [n for n in compiled.persist_out
-                      if n not in compiled.persist_in]
+            extras = compiled.plan.uninitialized(compiled.persist_in)
             if extras:
                 raise ValueError(
                     'run_bundle: persistable output(s) %r have no value '
@@ -1609,11 +1699,12 @@ class Executor(object):
             self._run_counter += K
             _C_BUNDLED_STEPS.inc(K)
             bundle_fn = compiled.bundle(K)
+            donated, readonly = compiled.plan.split(persist)
             obs_key = ('bundle', K)
             if obs_key not in getattr(compiled, '_obs_bundles', set()):
                 (new_persist, (fetches, healths)), outcome = \
                     self._timed_first_call(
-                        bundle_fn, (persist, stacked, seeds),
+                        bundle_fn, (donated, readonly, stacked, seeds),
                         look.get('key'), bundle_steps=K)
                 if not hasattr(compiled, '_obs_bundles'):
                     compiled._obs_bundles = set()
@@ -1623,7 +1714,7 @@ class Executor(object):
                     bsp.fields['cache'] = 'persistent_hit'
             else:
                 new_persist, (fetches, healths) = bundle_fn(
-                    persist, stacked, seeds)
+                    donated, readonly, stacked, seeds)
             for n, v in new_persist.items():
                 scope._chain_set(n, v)
             if healths is not None:
@@ -1724,7 +1815,8 @@ class Executor(object):
         compiled, feed_vals, persist = self._prepare(
             program, feed or {}, fetch_list or [], scope)
         rng = jax.random.key(0)
-        lowered = compiled._jitted.lower(persist, feed_vals, rng)
+        donated, readonly = compiled.plan.split(persist)
+        lowered = compiled._jitted.lower(donated, readonly, feed_vals, rng)
         if optimized:
             return lowered.compile().as_text()
         return lowered.as_text()

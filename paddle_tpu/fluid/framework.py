@@ -437,9 +437,10 @@ class Program(object):
         Program.clone + inference_optimize)."""
         p = Program()
         p.random_seed = self.random_seed
-        # execution flags travel with the program: amp mode, the
-        # Float16Transpiler fetch contract, rematerialisation
-        for flag in ('_amp', '_fetch_f32', '_use_remat'):
+        # execution flags travel with the program: amp mode (incl. the
+        # passes.amp_pass IR-rewrite marker), the Float16Transpiler
+        # fetch contract, rematerialisation
+        for flag in ('_amp', '_amp_ir', '_fetch_f32', '_use_remat'):
             if hasattr(self, flag):
                 setattr(p, flag, getattr(self, flag))
         if getattr(self, '_dist_config', None) is not None:
@@ -543,6 +544,29 @@ class Program(object):
         analysis.report_findings(findings, mode=level,
                                  where='Program.verify')
         return findings
+
+    def optimize(self, level='default', feeds=None, fetches=None):
+        """Ahead-of-lowering optimization (docs/passes.md): returns a NEW
+        Program rewritten by the fluid.passes pipeline — AMP cast
+        insertion, constant folding, CSE, and (when `fetches` is given)
+        dead-op elimination. This program is never mutated. The
+        PassReport lands on the result as `_opt_report`.
+
+        The Executor applies the same pipeline automatically behind
+        PADDLE_TPU_OPT={off,default,aggressive}, once per compiled-step
+        cache key; this method is the manual/offline surface (e.g.
+        optimizing before save_inference_model)."""
+        from . import passes
+        p, report = passes.optimize(self, feeds=feeds, fetches=fetches,
+                                    level=level)
+        if p is self:
+            # passes.optimize returns the input itself when nothing can
+            # run (level='off', pipeline-transpiled) — the executor wants
+            # that aliasing, but THIS method promises a program the
+            # caller owns and may mutate
+            p = self.clone(for_test=False)
+            p._opt_report = report
+        return p
 
     def prune(self, targets):
         """Backward-slice the program to the ops needed to compute

@@ -1,0 +1,156 @@
+"""Common subexpression elimination over the top-level block.
+
+Two ops compute the same value when they have the same type, the same
+attrs (modulo positional metadata: op_seq/op_role/op_device), and their
+inputs refer to the same VALUES — not just the same names: the block is
+not SSA, so each name carries a version number bumped at every write,
+and the hash key uses (name, version) pairs resolved through the alias
+map of merges already made (so chains of duplicates collapse in one
+walk).
+
+Def-use safety — a duplicate is merged only when:
+  * the op is pure (passes.is_pure: plain rule, no RNG — two dropouts
+    are never "the same computation");
+  * every output name is written exactly ONCE program-wide (merging a
+    name that is later rewritten would redirect reads across the
+    rewrite) — true for the unique_name temps that make up virtually
+    every duplicate in practice;
+  * no output is a feed, a fetch target, a persistable, or a name an
+    `autodiff` op references by attr (loss/param/grad names are string
+    references the rename walk cannot see).
+
+A merged op is REMOVED and every later read of its outputs (sub-blocks
+included — bodies legally read outer names) is redirected to the kept
+op's outputs. RNG streams are unaffected by the removal: the executor
+reads op_seq stamps, not list positions.
+"""
+from ... import obs
+from . import OP_SEQ_ATTR, is_pure
+
+__all__ = ['run']
+
+_C_MERGED = obs.counter('passes.cse.ops_merged')
+
+_KEY_SKIP_ATTRS = frozenset([OP_SEQ_ATTR, 'op_role', 'op_device',
+                             'op_namescope'])
+
+
+def _freeze(v):
+    if isinstance(v, dict):
+        return ('d',) + tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    if isinstance(v, (list, tuple)):
+        return ('l',) + tuple(_freeze(x) for x in v)
+    if isinstance(v, (set, frozenset)):
+        return ('s',) + tuple(sorted(_freeze(x) for x in v))
+    return v
+
+
+def run(program, report, feeds=None, fetches=None):
+    """Merge duplicate pure ops in place. Returns ops merged."""
+    from . import write_counts as _write_counts
+    from . import written_names as _written_names
+    block = program.global_block()
+    var_names = {v.name for v in program.list_vars()}
+    persistables = {v.name for v in program.list_vars() if v.persistable}
+    protected = set(fetches or ()) | set(feeds or ())
+    write_counts = _write_counts(program)
+    # Attr-level string references the rename walk cannot see: autodiff
+    # (loss/param/grad names) is the famous one, but control-flow rules
+    # read env by attr name too (switch cond_names, static_rnn step_ins/
+    # mems, dynamic_rnn slots). Rather than enumerate rule internals,
+    # protect EVERY attr string (and string inside an attr list) that
+    # names a program variable — over-protection only costs a missed
+    # merge, never a dangling name.
+    def _collect(v):
+        if isinstance(v, str):
+            if v in var_names:
+                protected.add(v)
+        elif isinstance(v, dict):
+            for x in v.values():
+                _collect(x)
+        elif isinstance(v, (list, tuple, set, frozenset)):
+            for x in v:
+                _collect(x)
+
+    for blk in program.blocks:
+        for op in blk.ops:
+            for v in op.attrs.values():
+                _collect(v)
+
+    version = {}   # name -> write version at the walk's current position
+    alias = {}     # merged name -> surviving name
+
+    def resolve(n):
+        while n in alias:
+            n = alias[n]
+        return n
+
+    seen = {}      # value key -> op
+    merged_ops = set()
+    merged = 0
+    bw_cache = {}  # _block_writes memo for the version bumps below
+    for op in block.ops:
+        out_names = op.output_arg_names
+        mergeable = (
+            is_pure(op) and out_names
+            and all(write_counts.get(n, 0) == 1 for n in out_names)
+            and not any(n in persistables or n in protected
+                        for n in out_names))
+        if mergeable:
+            key = (op.type,
+                   _freeze({k: v for k, v in op.attrs.items()
+                            if k not in _KEY_SKIP_ATTRS}),
+                   tuple(sorted(
+                       (slot, tuple((resolve(v.name),
+                                     version.get(resolve(v.name), 0))
+                                    for v in vs))
+                       for slot, vs in op.inputs.items())))
+            kept = seen.get(key)
+            if kept is not None:
+                ok = True
+                for slot, vs in op.outputs.items():
+                    kvs = kept.outputs.get(slot, [])
+                    if len(kvs) != len(vs):
+                        ok = False
+                        break
+                if ok and set(op.outputs) == set(kept.outputs):
+                    for slot, vs in op.outputs.items():
+                        for dup_v, kept_v in zip(vs, kept.outputs[slot]):
+                            alias[dup_v.name] = kept_v.name
+                    merged_ops.add(id(op))
+                    merged += 1
+                    continue
+            else:
+                seen[key] = op
+        # bump UNDECLARED sub-block writes too: a while body updating an
+        # outer name the while op never lists as an output still changes
+        # the value later reads see
+        for n in _written_names(program, op, cache=bw_cache):
+            version[n] = version.get(n, 0) + 1
+
+    if not merged:
+        report.note('cse', ops_merged=0)
+        return 0
+
+    block.ops = [op for op in block.ops if id(op) not in merged_ops]
+    # redirect every read of a merged name (all blocks: sub-block bodies
+    # read outer names) to the surviving producer's variable
+    for blk in program.blocks:
+        for op in blk.ops:
+            for slot, vs in op.inputs.items():
+                changed = False
+                new_vs = []
+                for v in vs:
+                    tgt = resolve(v.name)
+                    if tgt != v.name:
+                        new_vs.append(block.vars.get(tgt) or
+                                      blk._var_recursive(tgt))
+                        changed = True
+                    else:
+                        new_vs.append(v)
+                if changed:
+                    op.inputs[slot] = new_vs
+    program._bump_version()
+    _C_MERGED.inc(merged)
+    report.note('cse', ops_merged=merged)
+    return merged

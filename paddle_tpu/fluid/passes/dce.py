@@ -1,0 +1,67 @@
+"""Dead-op elimination: the verifier's DeadOp finding, promoted from a
+warning to a pruning transform.
+
+`analysis.live_mask` — the exact liveness the DeadOp finding is built on
+(an op is live when its outputs transitively reach a fetch or a
+persistable write, sub-block persistable writes included) — decides what
+to drop. The executor's verify wiring deliberately SKIPS the DeadOp
+finding because one run's fetch subset is not dead-code evidence for the
+program in general; here it is exactly the right evidence, because the
+optimized clone is cached per (feed-sig, fetch) key: a different fetch
+list gets its own clone with its own liveness.
+
+Beyond liveness, the transform keeps:
+  * effectful ops (print and friends) and ops with no lowering rule —
+    removing an op the lowering would have rejected silently changes a
+    loud failure into a quiet success;
+  * ops with sub-blocks whose liveness says dead — they ARE dead (the
+    mask accounts for their persistable writes), and dropping them drops
+    the trace cost of the whole body.
+
+Bit-exactness: removal never reindexes another op's RNG stream — the
+executor reads each op's `op_seq` stamp (passes.OP_SEQ_ATTR), not its
+list position.
+"""
+from ... import obs
+from .. import lowering
+from ..analysis.dataflow import live_mask, op_writes
+
+__all__ = ['run']
+
+_C_REMOVED = obs.counter('passes.dce.ops_removed')
+
+# ops whose execution is the point, whatever dataflow says
+_KEEP = frozenset(['print'])
+
+
+def _must_keep(op):
+    if op.type in _KEEP:
+        return True
+    if op.type == 'autodiff':
+        # the liveness walk itself decides autodiff (live iff a grad
+        # feeds a live consumer); never force-keep it here
+        return False
+    return not (lowering.has_rule(op.type)
+                or op.type in lowering._BLOCK_RULES)
+
+
+def run(program, report, fetches):
+    """Drop dead top-level ops from `program` (in place — `program` is
+    optimize()'s private clone). Returns the number removed."""
+    block = program.global_block()
+    # _must_keep rides INSIDE the liveness walk (not as a post-filter):
+    # a retained print op's producers must stay live too, or the kept op
+    # would read a name nothing defines at lowering time
+    live = live_mask(program, block, set(fetches), keep=_must_keep)
+    keep, dropped = [], []
+    for op, l in zip(block.ops, live):
+        if l:
+            keep.append(op)
+        else:
+            dropped.append(op)
+    if dropped:
+        block.ops = keep
+        program._bump_version()
+        _C_REMOVED.inc(len(dropped))
+    report.note('dce', ops_removed=len(dropped))
+    return len(dropped)

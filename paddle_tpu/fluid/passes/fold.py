@@ -1,0 +1,211 @@
+"""Constant folding: evaluate constant subgraphs at optimization time.
+
+Constant sources are `fill_constant` and `assign_value` ops (no inputs,
+value fully determined by attrs). Any op that is FOLDABLE
+(passes.is_foldable: pure, no RNG, no platform/mesh branching) and whose
+inputs are all constants is evaluated through ITS OWN lowering rule —
+the same function the compiled step traces, so there is exactly one
+definition of op semantics — and replaced by an `assign_value` op
+carrying the result. The now-unconsumed constant producers are left for
+DCE to sweep.
+
+Budget: results larger than the level's element cap are not folded (the
+folded values live in op attrs — a weights-sized constant would bloat
+the program and pin memory twice). `default` caps at 4096 elements,
+`aggressive` at 262144.
+
+Also hosted here: `fold_batch_norm` — the conv+BN weight fold the
+deprecated InferenceTranspiler now delegates to (it rewrites SCOPE
+weights, not graph constants, so it lives beside — not inside — the
+attrs-level folding above).
+
+Bit-exactness caveat (docs/passes.md): evaluation happens eagerly on the
+host's default backend; an op folded here but executed inside the fused
+module on another backend could differ in the last ulp for
+transcendentals. The fold runs only context-free rules and the A/B
+suite pins the guarantee on the platform it runs on.
+"""
+import numpy as np
+
+import jax.numpy as jnp
+
+from ... import obs
+from .. import lowering
+from ..framework import Operator
+from . import is_foldable
+
+__all__ = ['run', 'fold_batch_norm']
+
+_C_FOLDED = obs.counter('passes.fold.ops_folded')
+
+_CAPS = {'default': 4096, 'aggressive': 1 << 18}
+
+# value-from-attrs constant producers (seed the lattice; no inputs)
+_SOURCES = frozenset(['fill_constant', 'assign_value'])
+
+# sources larger than this are never even MATERIALIZED into the constant
+# lattice — a startup program's vocab-sized zero accumulators must not
+# cost the optimizer hundreds of MB of eager allocations it would throw
+# away (the replacement cap above is separate and much smaller)
+_SOURCE_CAP = 1 << 20
+
+
+def _source_size(op):
+    shape = op.attrs.get('shape') or ()
+    n = 1
+    for d in shape:
+        n *= max(int(d), 1) if isinstance(d, int) else 1
+    return n
+
+
+def _eval_rule(op, const_vals):
+    """Run the op's lowering rule on concrete constant inputs. Returns
+    {slot: [array, ...]} or None when the result is unusable (SeqValue /
+    None outputs)."""
+    import jax
+    ins = {slot: [const_vals[v.name] for v in vs]
+           for slot, vs in op.inputs.items()}
+    ctx = lowering.Ctx(jax.random.key(0), op_index=0)
+    outs = lowering.get_rule(op.type)(ins, op.attrs, ctx)
+    result = {}
+    for slot, vs in op.outputs.items():
+        vals = outs.get(slot) if hasattr(outs, 'get') else None
+        if vals is None:
+            return None
+        if not isinstance(vals, (list, tuple)):
+            vals = [vals]
+        if len(vals) < len(vs):
+            return None
+        row = []
+        for val in vals[:len(vs)]:
+            if not hasattr(val, 'shape') or isinstance(val, lowering.SeqValue):
+                return None
+            row.append(jnp.asarray(val))
+        result[slot] = row
+    return result
+
+
+def _const_op(block, var, value, src_op):
+    """An assign_value op binding `var` to the folded `value`, carrying
+    the folded op's provenance and RNG seq stamp."""
+    arr = np.asarray(value)
+    dtype = ('bfloat16' if arr.dtype == jnp.bfloat16
+             else str(arr.dtype))
+    if arr.dtype == jnp.bfloat16:
+        arr = arr.astype(np.float32)   # tolist()-able; exact (bf16 ⊂ f32)
+    attrs = {'values': arr.tolist(), 'shape': list(arr.shape),
+             'dtype': dtype}
+    for carry in ('op_seq', 'op_role'):
+        if carry in src_op.attrs:
+            attrs[carry] = src_op.attrs[carry]
+    return Operator(block, type='assign_value', inputs={},
+                    outputs={'Out': [var]}, attrs=attrs,
+                    callsite=src_op.callsite)
+
+
+def run(program, report, level='default'):
+    """Fold constant top-level subgraphs in place. Returns ops folded."""
+    from . import write_counts as _write_counts
+    cap = _CAPS.get(level, _CAPS['default'])
+    block = program.global_block()
+    write_counts = _write_counts(program)
+
+    const_vals = {}   # name -> concrete value (producers written once)
+    folded = 0
+    for i, op in enumerate(block.ops):
+        out_names = op.output_arg_names
+        ssa = all(write_counts.get(n, 0) == 1 for n in out_names)
+        if (op.type in _SOURCES and ssa and not op.inputs
+                and _source_size(op) <= _SOURCE_CAP):
+            try:
+                vals = _eval_rule(op, const_vals)
+            except Exception:
+                vals = None
+            if vals is not None:
+                for slot, vs in op.outputs.items():
+                    for v, val in zip(vs, vals[slot]):
+                        const_vals[v.name] = val
+            continue
+        if (ssa and out_names and is_foldable(op) and op.inputs
+                and all(v.name in const_vals
+                        for vs in op.inputs.values() for v in vs)):
+            try:
+                vals = _eval_rule(op, const_vals)
+            except Exception:
+                vals = None
+            if vals is not None and all(
+                    v.size <= cap for row in vals.values() for v in row):
+                # single-output ops fold to ONE assign_value; multi-output
+                # ops would need one per output — rare enough to skip
+                slots = [(s, vs) for s, vs in op.outputs.items() if vs]
+                if len(slots) == 1 and len(slots[0][1]) == 1:
+                    slot, var = slots[0][0], slots[0][1][0]
+                    val = vals[slot][0]
+                    block.ops[i] = _const_op(block, var, val, op)
+                    const_vals[var.name] = val
+                    folded += 1
+                    continue
+                # not replaced, but the VALUE is still known — later
+                # consumers can fold through it
+                for slot, vs in op.outputs.items():
+                    for v, val in zip(vs, vals[slot]):
+                        const_vals[v.name] = val
+            continue
+        for n in out_names:
+            const_vals.pop(n, None)   # overwritten: no longer constant
+    if folded:
+        program._bump_version()
+        _C_FOLDED.inc(folded)
+    report.note('fold', ops_folded=folded)
+    return folded
+
+
+def fold_batch_norm(program, scope):
+    """Fold `batch_norm` (is_test) into a preceding `conv2d` whose output
+    has no other consumer: the conv weights are rescaled in the SCOPE by
+    the BN statistics and the BN op becomes a bias `elementwise_add` —
+    the reference inference_transpiler's transform, now owned by the
+    passes layer (the transpiler is a deprecated shim over this)."""
+    block = program.global_block()
+    folded = 0
+    i = 0
+    while i < len(block.ops) - 1:
+        op = block.ops[i]
+        nxt = block.ops[i + 1]
+        if op.type == 'conv2d' and nxt.type == 'batch_norm' and \
+                nxt.inputs['X'][0].name == op.outputs['Output'][0].name:
+            scale_v = scope.vars.get(nxt.inputs['Scale'][0].name)
+            bias_v = scope.vars.get(nxt.inputs['Bias'][0].name)
+            mean_v = scope.vars.get(nxt.inputs['Mean'][0].name)
+            var_v = scope.vars.get(nxt.inputs['Variance'][0].name)
+            w_name = op.inputs['Filter'][0].name
+            w = scope.vars.get(w_name)
+            if any(v is None for v in (scale_v, bias_v, mean_v, var_v, w)):
+                i += 1
+                continue
+            eps = nxt.attrs.get('epsilon', 1e-5)
+            scale = np.asarray(scale_v)
+            bias = np.asarray(bias_v)
+            mean = np.asarray(mean_v)
+            var = np.asarray(var_v)
+            wnp = np.asarray(w)
+            inv = scale / np.sqrt(var + eps)
+            scope.vars[w_name] = jnp.asarray(wnp * inv[:, None, None, None])
+            new_bias = bias - mean * inv
+            bias_var = block.create_var(
+                name=w_name + '.bnfold_bias', shape=list(new_bias.shape),
+                dtype='float32', persistable=True)
+            scope.vars[bias_var.name] = jnp.asarray(new_bias)
+            bn_out = nxt.outputs['Y'][0]
+            # channel axis follows the conv's layout
+            ch_axis = (-1 if op.attrs.get('data_format', 'NCHW') == 'NHWC'
+                       else 1)
+            block.ops[i + 1] = Operator(
+                block, type='elementwise_add',
+                inputs={'X': op.outputs['Output'], 'Y': [bias_var]},
+                outputs={'Out': [bn_out]}, attrs={'axis': ch_axis},
+                callsite=nxt.callsite)
+            program._bump_version()
+            folded += 1
+        i += 1
+    return folded

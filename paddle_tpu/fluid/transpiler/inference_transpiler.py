@@ -1,12 +1,14 @@
-"""Inference transpiler.
+"""Inference transpiler — DEPRECATED shim over fluid.passes.
 
-Parity: reference transpiler/inference_transpiler.py — fuses batch_norm into
-the preceding conv for inference. On TPU, XLA already fuses BN-scale into
-convolutions at compile time, so the transform is mostly redundant; we still
-perform the graph-level fold (conv+BN -> conv with adjusted weights) so the
-resulting program is smaller and matches reference behavior.
+Parity: reference transpiler/inference_transpiler.py — fuses batch_norm
+into the preceding conv for inference. The graph walk now lives in
+`fluid.passes.fold.fold_batch_norm` (the constant-folding pass's
+scope-weight sibling); this class remains as the reference-API surface
+and simply delegates (docs/migration.md). For the rest of what the
+reference transpiler family did ahead of execution — dead-op pruning,
+constant folding, CSE — use `PADDLE_TPU_OPT` / `Program.optimize()`.
 """
-import numpy as np
+import warnings
 
 __all__ = ['InferenceTranspiler']
 
@@ -15,55 +17,15 @@ class InferenceTranspiler(object):
     def transpile(self, program, place=None, scope=None):
         """Fold batch_norm (is_test) into a preceding conv2d when the conv
         output has no other consumer. Mutates program in place."""
+        warnings.warn(
+            'InferenceTranspiler is deprecated: the conv+BN fold lives in '
+            'fluid.passes.fold.fold_batch_norm, and the general '
+            'ahead-of-lowering optimizations in PADDLE_TPU_OPT / '
+            'Program.optimize(). See docs/migration.md.',
+            DeprecationWarning, stacklevel=2)
         from ..executor import global_scope
-        import jax.numpy as jnp
+        from ..passes.fold import fold_batch_norm
         if scope is None:
             scope = global_scope()
-        block = program.global_block()
-        i = 0
-        while i < len(block.ops) - 1:
-            op = block.ops[i]
-            nxt = block.ops[i + 1]
-            if op.type == 'conv2d' and nxt.type == 'batch_norm' and \
-                    nxt.inputs['X'][0].name == op.outputs['Output'][0].name:
-                scale_v = scope.vars.get(nxt.inputs['Scale'][0].name)
-                bias_v = scope.vars.get(nxt.inputs['Bias'][0].name)
-                mean_v = scope.vars.get(nxt.inputs['Mean'][0].name)
-                var_v = scope.vars.get(nxt.inputs['Variance'][0].name)
-                w_name = op.inputs['Filter'][0].name
-                w = scope.vars.get(w_name)
-                if any(v is None for v in (scale_v, bias_v, mean_v, var_v, w)):
-                    i += 1
-                    continue
-                eps = nxt.attrs.get('epsilon', 1e-5)
-                scale = np.asarray(scale_v)
-                bias = np.asarray(bias_v)
-                mean = np.asarray(mean_v)
-                var = np.asarray(var_v)
-                wnp = np.asarray(w)
-                inv = scale / np.sqrt(var + eps)
-                scope.vars[w_name] = jnp.asarray(
-                    wnp * inv[:, None, None, None])
-                # new bias var feeding an elementwise_add after conv
-                new_bias = bias - mean * inv
-                bias_var = block.create_var(
-                    name=w_name + '.bnfold_bias', shape=list(new_bias.shape),
-                    dtype='float32', persistable=True)
-                scope.vars[bias_var.name] = jnp.asarray(new_bias)
-                bn_out = nxt.outputs['Y'][0]
-                op.outputs['Output'] = [op.outputs['Output'][0]]
-                block.ops[i + 1] = block.ops[i + 1]
-                # replace bn op with add op
-                from ..framework import Operator
-                # channel axis follows the conv's layout
-                ch_axis = (-1 if op.attrs.get('data_format',
-                                              'NCHW') == 'NHWC' else 1)
-                add_op = Operator(block, type='elementwise_add',
-                                  inputs={'X': op.outputs['Output'],
-                                          'Y': [bias_var]},
-                                  outputs={'Out': [bn_out]},
-                                  attrs={'axis': ch_axis})
-                block.ops[i + 1] = add_op
-                program._bump_version()
-            i += 1
+        fold_batch_norm(program, scope)
         return program

@@ -1,20 +1,33 @@
-"""Memory-optimization transpiler.
+"""Memory-optimization transpiler — DEPRECATED shim over fluid.passes.
 
-Parity: reference transpiler/memory_optimization_transpiler.py, which does
-liveness analysis over the ProgramDesc and reuses var buffers.
+Parity: reference transpiler/memory_optimization_transpiler.py, which did
+liveness analysis over the ProgramDesc and reused var buffers.
 
-TPU-first redesign: XLA's buffer assignment already performs liveness-based
-reuse inside the fused step, so per-op buffer aliasing is moot. What still
-matters on TPU is *activation memory across the fwd/bwd boundary* — the
-equivalent lever is rematerialisation: memory_optimize() flags the program
-so the Executor wraps the forward trace in jax.checkpoint, trading FLOPs
-for HBM exactly where the reference traded buffer reuse.
+The TPU-native equivalents now live elsewhere (docs/migration.md):
+  * per-op buffer reuse — XLA's buffer assignment inside the fused step,
+    plus the per-program donation/memory plan (`fluid.passes.memory_plan`)
+    that donates exactly the written persistables so updates alias in
+    place in HBM;
+  * activation memory across the fwd/bwd boundary — rematerialisation:
+    this shim still flags the program so the Executor wraps the forward
+    trace in jax.checkpoint, trading FLOPs for HBM exactly where the
+    reference traded buffer reuse;
+  * dead-op/liveness pruning — `PADDLE_TPU_OPT` / `Program.optimize()`
+    (fluid.passes.dce), which retired this module's graph walk.
 """
+import warnings
+
 __all__ = ['memory_optimize', 'release_memory']
 
 
 def memory_optimize(input_program, skip_opt_set=None, print_log=False,
                     level=0):
+    warnings.warn(
+        'memory_optimize() is deprecated: buffer reuse is owned by the '
+        'donation/memory plan (fluid.passes.memory_plan) and dead-op '
+        'pruning by PADDLE_TPU_OPT / Program.optimize(); this call now '
+        'only flags the forward for rematerialisation (jax.checkpoint). '
+        'See docs/migration.md.', DeprecationWarning, stacklevel=2)
     input_program._use_remat = True
     if print_log:
         print("memory_optimize: forward will be rematerialised "

@@ -234,6 +234,33 @@ def summarize(events):
                      'device' % (len(stalls), _fmt_s(sum(sdur)),
                                  _fmt_s(percentile_exact(sdur, 95))))
 
+    # -- optimizer passes ------------------------------------------------
+    # passes.optimize spans carry ops_before/ops_after + per-pass sums
+    # (docs/passes.md): the attribution trail for op-count wins
+    opt_spans = _spans(events, 'passes.optimize')
+    if opt_spans:
+        before = sum(int(s.get('fields', {}).get('ops_before', 0))
+                     for s in opt_spans)
+        after = sum(int(s.get('fields', {}).get('ops_after', 0))
+                    for s in opt_spans)
+        lines.append('')
+        lines.append('-- optimizer passes --')
+        lines.append('%d program(s) optimized: %d -> %d top-level op(s)'
+                     % (len(opt_spans), before, after))
+        per = {}
+        for name in ('dce', 'fold', 'cse', 'amp'):
+            tot = sum(int(s.get('fields', {}).get(name, 0))
+                      for s in opt_spans)
+            if tot:
+                per[name] = tot
+        if per:
+            lines.append('per pass: ' + ', '.join(
+                '%s=%d' % kv for kv in sorted(per.items())))
+        errs = _events(events, 'passes.error')
+        if errs:
+            lines.append('%d optimizer failure(s) fell back to the '
+                         'unoptimized lowering' % len(errs))
+
     # -- anomaly guard ---------------------------------------------------
     skips = _events(events, 'anomaly.skip')
     lines.append('')

@@ -356,9 +356,37 @@ class ServingEngine(object):
                 template[name] = np.zeros((1,) + tuple(
                     int(d) for d in shape[1:]), dtype=np.dtype(dtype))
         exe = getattr(self._model, '_exe', None)
+        # Donation/memory plan (fluid.passes.memplan): the engine runs
+        # batches concurrently with callers holding the same scope, so a
+        # model whose plan DONATES (writes persistables) is a serving
+        # hazard — the Predictor's load-time verify already rejects it as
+        # a ScopeRace under PADDLE_TPU_VERIFY; the plan is recorded here
+        # either way so warmup spans document the decision.
+        plan = None
+        prog = getattr(self._model, '_program', None)
+        if prog is not None:
+            try:
+                from ..fluid.passes import memory_plan
+                plan = memory_plan(prog)
+            except Exception:
+                plan = None
+        if plan is not None:
+            obs.event('serving.memory_plan', donates=plan.donates,
+                      writes=len(plan.write_set))
+            if plan.donates:
+                import warnings
+                warnings.warn(
+                    'serving warmup: the model writes persistable(s) %r — '
+                    'its step would donate parameter buffers, which is '
+                    'unsafe under concurrent serving; load a '
+                    'clone(for_test=True)/pruned inference artifact '
+                    '(PADDLE_TPU_VERIFY=error rejects this at load)'
+                    % sorted(plan.write_set), RuntimeWarning)
         for b in self.buckets:
             feed = {n: _buckets.pad_rows(a, b) for n, a in template.items()}
             with obs.span('serving.warmup', bucket=b) as sp:
+                if plan is not None:
+                    sp.fields['donates'] = plan.donates
                 self._model_fn(feed)
                 if exe is not None:
                     look = getattr(exe, '_last_cache_lookup', None) or {}

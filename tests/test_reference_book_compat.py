@@ -54,6 +54,18 @@ print('REF-BOOK-COMPAT OK:', os.path.basename(path))
 """
 
 
+# These tests execute the reference's OWN book files, which live in a
+# read-only checkout OUTSIDE this repo. A container without that checkout
+# cannot run them at all — that is an environment gap, not a parity
+# regression, so the suite reads skipped-with-reason instead of failed
+# (triage note, PR 6: all three "failures" at the seed were exactly this).
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(_REF_BOOK),
+    reason='reference checkout not present at %s (the verbatim-book '
+           'parity tier needs the read-only reference tree mounted)'
+           % _REF_BOOK)
+
+
 def _run_case(tmp_path, fname, kwargs=None, funcname='main', timeout=900):
     import json
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -39,13 +39,15 @@ def add(out, obj):
     # nested summary ('metrics' list inside the headline record), and
     # the flat summary (summary:true, headline metric/value only —
     # a driver wrapper keeps just that last line). setdefault keeps the
-    # per-metric line's value when both were seen.
+    # per-metric line's value when both were seen. Each entry carries
+    # (value, platform) so cross-platform comparisons can be refused.
     if not isinstance(obj, dict):
         return
     for m in obj.get('metrics') or []:       # legacy nested summary
         add(out, m)
     if obj.get('metric') and obj.get('value') is not None:
-        out.setdefault(obj['metric'], float(obj['value']))
+        out.setdefault(obj['metric'],
+                       (float(obj['value']), obj.get('platform')))
 
 def metrics_of(path):
     """Per-metric values from either format: raw bench stdout (one JSON
@@ -83,12 +85,22 @@ if not rounds or not new:
 prev_path = rounds[-1]
 prev = metrics_of(prev_path)
 for name in sorted(set(new) & set(prev)):
-    ratio = new[name] / prev[name] if prev[name] else float('inf')
+    nv, nplat = new[name]
+    pv, pplat = prev[name]
+    if nplat and pplat and nplat != pplat:
+        # a CPU-fallback round vs an accelerator round is not a perf
+        # signal — refuse the comparison instead of printing a bogus
+        # 1000x "regression" (BENCH_r01 accelerator vs BENCH_r05 CPU)
+        print('[compare] %s: REFUSED — platform mismatch (%s vs %s from '
+              '%s); values are not comparable' % (name, nplat, pplat,
+                                                  prev_path))
+        continue
+    ratio = nv / pv if pv else float('inf')
     flag = ''
     if ratio < 0.9:
         flag = '  <-- WARNING: >10%% regression vs %s' % prev_path
     print('[compare] %s: %.2f vs %.2f (x%.3f)%s'
-          % (name, new[name], prev[name], ratio, flag))
+          % (name, nv, pv, ratio, flag))
 only = sorted(set(prev) - set(new))
 if only:
     print('[compare] previously measured but missing now: %s' % only)

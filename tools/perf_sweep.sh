@@ -136,7 +136,19 @@ if [ "${CACHE_SWEEP:-0}" = 1 ]; then
   rm -rf "$CDIR"
 fi
 
-# 8. serving engine vs sequential Predictor (opt-in: SERVE=1). Closed
+# 8. optimizer-pass A/B (opt-in: OPT=1): the bundle bench phase run with
+#    PADDLE_TPU_OPT=off then =default — same shapes, same platform, so
+#    the two bench.metric records in the sweep run log give the
+#    off-vs-default steps/s delta the pass pipeline buys (passes.*
+#    spans/counters in the same log attribute it per pass; docs/passes.md).
+if [ "${OPT:-0}" = 1 ]; then
+  run env PADDLE_TPU_OPT=off python bench.py --phase bundle \
+      --platform "${BENCH_PLATFORM:-tpu}"
+  run env PADDLE_TPU_OPT=default python bench.py --phase bundle \
+      --platform "${BENCH_PLATFORM:-tpu}"
+fi
+
+# 9. serving engine vs sequential Predictor (opt-in: SERVE=1). Closed
 #    loop at the acceptance concurrency, then an open-loop arrival test;
 #    --check-compiles fails the command if steady state compiled, which
 #    the obs_event rc then records in the sweep run log.

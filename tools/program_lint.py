@@ -50,6 +50,13 @@ def main(argv=None):
                          '(arms the scope-race pass)')
     ap.add_argument('--strict', action='store_true',
                     help='exit 1 on warnings too, not just errors')
+    ap.add_argument('--optimize', nargs='?', const='default',
+                    choices=['default', 'aggressive'], default=None,
+                    help='additionally report what the fluid.passes '
+                         'pipeline (PADDLE_TPU_OPT) would do to this '
+                         'artifact: per-pass op deltas + the donation/'
+                         'memory plan (read-only: the artifact is not '
+                         'rewritten)')
     args = ap.parse_args(argv)
 
     try:
@@ -68,8 +75,34 @@ def main(argv=None):
     findings = analysis.analyze(program, feeds=feeds, fetches=fetches,
                                 concurrent=args.concurrent, stats=stats)
 
+    opt_payload = None
+    if args.optimize:
+        from paddle_tpu.fluid import passes
+        try:
+            _opt, report = passes.optimize(program, feeds=feeds,
+                                           fetches=fetches,
+                                           level=args.optimize)
+            plan = passes.memory_plan(program)
+            opt_payload = (report, plan)
+        except Exception as e:
+            # lint must still report its findings when the optimizer
+            # chokes on an artifact (the executor path has the same
+            # fall-back-to-unoptimized posture)
+            print('program_lint: --optimize failed: %s: %s'
+                  % (type(e).__name__, e), file=sys.stderr)
+
     if args.json:
-        print(json.dumps([f.to_dict() for f in findings], indent=2))
+        # ONE parseable document: a bare findings array (the historical
+        # shape) unless --optimize adds its report, in which case both
+        # ride one object
+        if opt_payload is None:
+            print(json.dumps([f.to_dict() for f in findings], indent=2))
+        else:
+            report, plan = opt_payload
+            print(json.dumps({
+                'findings': [f.to_dict() for f in findings],
+                'optimize': report.to_dict(),
+                'memory_plan': plan.to_dict()}, indent=2))
     else:
         nops = sum(len(b.ops) for b in program.blocks)
         print('%s: %d block(s), %d op(s); feeds=%s fetches=%s'
@@ -80,6 +113,20 @@ def main(argv=None):
             print('clean: no findings')
         for f in findings:
             print('  %s' % f)
+
+    if opt_payload is not None and not args.json:
+        report, plan = opt_payload
+        if report.skipped:
+            print('optimize[%s]: skipped (%s)'
+                  % (args.optimize, report.skipped))
+        else:
+            print('optimize[%s]: %d -> %d top-level op(s)'
+                  % (args.optimize, report.ops_before, report.ops_after))
+            for name, stats in sorted(report.passes.items()):
+                print('  %s: %s' % (name, ' '.join(
+                    '%s=%d' % kv for kv in sorted(stats.items()))))
+        print('  memory plan: donates=%s, %d persistable write(s)'
+              % (plan.donates, len(plan.write_set)))
 
     errors = sum(1 for f in findings if f.severity == analysis.SEV_ERROR)
     bad = len(findings) if args.strict else errors
