@@ -395,6 +395,126 @@ def bench_bundle(steps=None, bundle_steps=None, batch_size=64, warmup=1):
     return (steps / dt_unbundled, steps / dt_bundled, K, max_diff)
 
 
+def bench_gspmd(model, warmup=2, iters=None):
+    """Pod-scale GSPMD phase (docs/parallel.md): the SAME Fluid Program
+    run two ways — single device vs dp=N over every visible device via
+    the first-class sharding annotation (`program.set_mesh({'dp': N})`,
+    plain Executor.run, no strategy wrapper). Returns
+    (dp steps/s, single steps/s, mesh axes dict, batch, loss gap).
+
+    models:
+      fit_a_line — the book regression net at batch 128*N; host-bound,
+        so this records how much dispatch overhead the mesh adds on a
+        tiny model (expected ~1x or below off-chip; honesty metric).
+      mnist_mlp  — a deep narrow MLP over mnist shapes (784 -> 8x256
+        -> 10) at batch 1024*N (BENCH_GSPMD_BATCH per device; large so
+        the per-step gradient all-reduce amortizes): batch-bound, the
+        scale-out demonstration — >= 2x at dp=8 on any host whose cores
+        match its devices (and near-linear on a real pod).
+    Every record carries mesh shape, platform AND host_cores: on an
+    oversubscribed CPU mesh the wall-clock ratio is capped by the
+    PHYSICAL core count, not the 8 virtual devices — and measured
+    tighter still, because the single-device leg cannot be capped to
+    one chip's capacity: the thunk-runtime XLA ignores
+    --xla_cpu_multi_thread_eigen and exposes no intra-op-pool knob, so
+    the 1-device leg uses the whole host (~1.5 cores observed on the
+    2-core CI box, capping the honest dp=8 ratio near 1.5x there).
+    >= 2x therefore needs host_cores >= 4; the honest number with its
+    context beats a rigged one — the cross-round sentinel refuses
+    comparisons across platforms and mesh shapes either way."""
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework, unique_name
+
+    ndev = len(jax.devices())
+    if iters is None:
+        iters = int(os.environ.get('BENCH_GSPMD_ITERS', '12'))
+
+    if model == 'fit_a_line':
+        batch = 128 * ndev
+
+        def build():
+            x = fluid.layers.data(name='x', shape=[13], dtype='float32')
+            y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+            pred = fluid.layers.fc(input=x, size=1, act=None)
+            cost = fluid.layers.mean(
+                fluid.layers.square_error_cost(input=pred, label=y))
+            fluid.optimizer.SGD(learning_rate=0.01).minimize(cost)
+            return cost
+
+        rng = np.random.RandomState(0)
+        feed = {'x': rng.rand(batch, 13).astype('float32'),
+                'y': rng.rand(batch, 1).astype('float32')}
+    elif model == 'mnist_mlp':
+        batch = int(os.environ.get("BENCH_GSPMD_BATCH", "1024")) * ndev
+
+        def build():
+            x = fluid.layers.data(name='img', shape=[784],
+                                  dtype='float32')
+            y = fluid.layers.data(name='label', shape=[1], dtype='int64')
+            h = x
+            for _ in range(8):
+                h = fluid.layers.fc(input=h, size=256, act='relu')
+            pred = fluid.layers.fc(input=h, size=10, act='softmax')
+            cost = fluid.layers.mean(
+                fluid.layers.cross_entropy(input=pred, label=y))
+            fluid.optimizer.SGD(learning_rate=0.01).minimize(cost)
+            return cost
+
+        rng = np.random.RandomState(0)
+        feed = {'img': rng.rand(batch, 784).astype('float32'),
+                'label': rng.randint(0, 10, size=(batch, 1))
+                .astype('int64')}
+    else:
+        raise ValueError('unknown gspmd model %r' % model)
+
+    def timed(mesh_axes):
+        main, startup = _fresh()
+        with unique_name.guard():
+            with framework.program_guard(main, startup):
+                cost = build()
+                if mesh_axes:
+                    main.set_mesh(mesh_axes)
+                exe = fluid.Executor()
+                exe.run(startup)
+                # stage the feed on device once (same pattern as the
+                # resnet phase): steps then measure device/step time,
+                # not a per-step host->device copy of the same batch
+                if mesh_axes:
+                    from paddle_tpu import parallel
+                    from jax.sharding import NamedSharding, \
+                        PartitionSpec as P
+                    mesh = parallel.make_mesh(mesh_axes)
+                    dev_feed = {
+                        k: parallel.global_batch(
+                            NamedSharding(mesh, P('dp')), v)
+                        for k, v in feed.items()}
+                else:
+                    dev_feed = {k: exe._to_device(v)
+                                for k, v in feed.items()}
+                for _ in range(warmup):
+                    exe.run(main, feed=dev_feed, fetch_list=[cost])
+                t0 = time.time()
+                for _ in range(iters):
+                    loss, = exe.run(main, feed=dev_feed,
+                                    fetch_list=[cost])
+                dt = time.time() - t0
+        val = _scalar(np.asarray(loss))
+        assert np.isfinite(val), val
+        return iters / dt, val
+
+    _log('gspmd %s: single-device leg (batch %d)...' % (model, batch))
+    sps_1, loss_1 = timed(None)
+    _log('gspmd %s: dp=%d leg...' % (model, ndev))
+    sps_dp, loss_dp = timed({'dp': ndev})
+    # equivalence guard: the two legs consumed identical data from the
+    # same warm state count, so their final losses must agree to float
+    # noise — a silent divergence would make the speedup meaningless
+    gap = abs(loss_dp - loss_1) / max(1e-12, abs(loss_1))
+    assert gap < 1e-3, (loss_1, loss_dp)
+    return sps_dp, sps_1, {'dp': ndev}, batch, gap
+
+
 def bench_flash_longcontext(seq_len=32768, heads=8, dim=64, warmup=1,
                             iters=2):
     """Causal flash attention fwd+bwd at 32k context on ONE chip — the
@@ -468,9 +588,11 @@ NAME_R = 'resnet50_train_images_per_sec_per_chip'
 NAME_L = 'transformer_base_seq1024_train_tokens_per_sec_per_chip'
 NAME_F = 'flash_causal_seq32768_tokens_per_sec_per_chip'
 NAME_B = 'fit_a_line_bundled_train_steps_per_sec'
-PHASES = ('transformer', 'resnet', 'bundle', 'longseq', 'longctx')
+NAME_G_FAL = 'fit_a_line_gspmd_steps_per_sec'
+NAME_G_MLP = 'mnist_mlp_gspmd_steps_per_sec'
+PHASES = ('transformer', 'resnet', 'bundle', 'gspmd', 'longseq', 'longctx')
 PHASE_NAMES = {'transformer': NAME_T, 'resnet': NAME_R, 'bundle': NAME_B,
-               'longseq': NAME_L, 'longctx': NAME_F}
+               'gspmd': NAME_G_MLP, 'longseq': NAME_L, 'longctx': NAME_F}
 
 
 def _tier(platform):
@@ -516,6 +638,23 @@ def run_phase(phase, platform):
     process — the parent's timeout fires, and later phases still run."""
     _PLATFORM[0] = platform
     _FALLBACK[0] = os.environ.get('BENCH_FALLBACK') == '1'
+    if phase == 'gspmd' and platform != 'tpu':
+        # the 8-device CPU mesh (the same platform the MULTICHIP dryruns
+        # and tests use), with per-device eigen threading off so each
+        # virtual device approximates a fixed-capacity chip. Must land
+        # in the env BEFORE jax initializes its backend (that is why
+        # only this phase CHILD sets it, never the parent).
+        flags = os.environ.get('XLA_FLAGS', '')
+        if '--xla_force_host_platform_device_count' not in flags:
+            flags += ' --xla_force_host_platform_device_count=8'
+        if '--xla_cpu_multi_thread_eigen' not in flags:
+            flags += ' --xla_cpu_multi_thread_eigen=false'
+        os.environ['XLA_FLAGS'] = flags.strip()
+        # same fixed-capacity model for BLAS/OpenMP kernels (newer XLA
+        # thunk runtimes ignore the eigen flag): one thread per virtual
+        # chip, both legs — the single-device leg is ONE chip's worth of
+        # compute, not the whole host
+        os.environ.setdefault('OMP_NUM_THREADS', '1')
     jax = _setup_jax(force_cpu=platform != 'tpu')
     # stamp what jax ACTUALLY gives us, not the CLI claim: a direct
     # `--phase X --platform tpu` invocation (perf_sweep) on a chipless
@@ -574,6 +713,34 @@ def run_phase(phase, platform):
             _log('%s failed: %r' % (NAME_B, e))
             _emit({'metric': NAME_B, 'skipped': True,
                    'error': str(e)[:300]})
+    elif phase == 'gspmd':
+        # pod-scale GSPMD contract metric (ISSUE 7): the annotated
+        # Program at dp=N through plain Executor.run vs 1 device —
+        # >= 2x on the batch-bound model wherever devices add real
+        # capacity (TPU pod, many-core host). Runs on the CPU mesh too,
+        # so the phase never skips off-chip; every record carries mesh
+        # shape + host_cores so an oversubscribed-host ratio can never
+        # masquerade as a chip-scaling number.
+        ncores = os.cpu_count()
+        for mname, metric in (('fit_a_line', NAME_G_FAL),
+                              ('mnist_mlp', NAME_G_MLP)):
+            try:
+                sps_dp, sps_1, mesh, batch, gap = bench_gspmd(mname)
+                _emit({'metric': metric, 'value': round(sps_dp, 2),
+                       'unit': 'steps/sec',
+                       'mesh': mesh,
+                       'mesh_shape': 'x'.join(
+                           '%s=%d' % kv for kv in sorted(mesh.items())),
+                       'single_device_steps_per_sec': round(sps_1, 2),
+                       'speedup_vs_single_device':
+                           round(sps_dp / sps_1, 3),
+                       'loss_rel_gap_vs_single_device': round(gap, 8),
+                       'host_cores': ncores, 'platform': platform,
+                       'batch': batch})
+            except Exception as e:
+                _log('%s failed: %r' % (metric, e))
+                _emit({'metric': metric, 'skipped': True,
+                       'error': str(e)[:300]})
     elif phase == 'longseq':
         _transformer_metric(NAME_L, 8, 1024, t['iters'], t['use_amp'],
                             platform)

@@ -38,6 +38,7 @@ from . import concurrency as _concurrency
 from . import dataflow as _dataflow
 from . import donation as _donation
 from . import shapes as _shapes
+from . import sharding as _sharding
 from .dataflow import live_mask  # noqa: F401  (re-export: passes.dce)
 from .donation import executor_donates, executor_write_set, \
     persistable_write_set  # noqa: F401  (re-export: executor uses these)
@@ -78,7 +79,7 @@ def verify_mode():
 
 def analyze(program, startup=None, feeds=None, fetches=None,
             initialized=None, concurrent=False, donates=None, bundle=False,
-            dead_ops=True, stats=None):
+            dead_ops=True, stats=None, mesh_axes=None):
     """Run every pass over `program`; returns sorted [Finding]. Pure: the
     program is never mutated and nothing is raised for findings.
 
@@ -103,6 +104,9 @@ def analyze(program, startup=None, feeds=None, fetches=None,
                   evidence (another call may fetch the rest). Lint and
                   standalone contexts keep it on.
     stats       — optional dict receiving shape-pass coverage counts.
+    mesh_axes   — {'dp': 8}-style mesh override for the sharding-
+                  consistency pass (program_lint --mesh); None uses the
+                  program's own set_mesh() spec.
     """
     findings = []
     findings += _dataflow.run_pass(program, feeds=feeds, fetches=fetches,
@@ -111,6 +115,7 @@ def analyze(program, startup=None, feeds=None, fetches=None,
     findings += _shapes.run_pass(program, feeds=feeds, stats=stats)
     findings += _donation.run_pass(program, donates=donates)
     findings += _concurrency.run_pass(program, concurrent=concurrent)
+    findings += _sharding.run_pass(program, mesh_axes=mesh_axes)
     return sort_findings(findings)
 
 

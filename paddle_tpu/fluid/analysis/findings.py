@@ -13,7 +13,8 @@ __all__ = [
     'SEV_ERROR', 'SEV_WARNING',
     'DANGLING_INPUT', 'WRITE_TO_FEED', 'DEAD_OP', 'UNREACHABLE_FETCH',
     'USE_BEFORE_WRITE', 'SHAPE_MISMATCH', 'DTYPE_MISMATCH',
-    'DONATION_UNSAFE', 'SCOPE_RACE',
+    'DONATION_UNSAFE', 'SCOPE_RACE', 'SHARDING_INVALID',
+    'SHARDING_UNTILEABLE', 'SHARDING_RESHARD',
 ]
 
 SEV_ERROR = 'error'       # the program cannot run correctly as lowered
@@ -30,6 +31,9 @@ SHAPE_MISMATCH = 'ShapeMismatch'        # declared vs inferred shape conflict
 DTYPE_MISMATCH = 'DtypeMismatch'        # declared vs inferred dtype conflict
 DONATION_UNSAFE = 'DonationUnsafe'      # write-set vs donation decision
 SCOPE_RACE = 'ScopeRace'                # persistable writes + shared scope
+SHARDING_INVALID = 'ShardingInvalid'        # annotation vs mesh spec
+SHARDING_UNTILEABLE = 'ShardingUntileable'  # mesh cannot tile the dim
+SHARDING_RESHARD = 'ShardingReshard'        # resharding implied mid-pipeline
 
 _SEV_ORDER = {SEV_ERROR: 0, SEV_WARNING: 1}
 

@@ -1,0 +1,165 @@
+"""Pass 5 — GSPMD sharding-annotation consistency (docs/parallel.md).
+
+The annotation surface (`Program.set_mesh` + per-tensor
+`ParamAttr(sharding=...)`/`Variable.sharding`) is declared at build time
+but only CONSUMED at lowering, where a bad spec degrades into a runtime
+warning-and-replicate (or an XLA error deep inside jit). This pass is the
+ahead-of-lowering check, the same posture as donation safety: every
+annotation is validated against the mesh spec statically and reported as
+a structured Finding with the producer op's build-site provenance.
+
+Checks:
+  * ShardingInvalid  — an annotation names a mesh axis the spec does not
+                       declare, uses one axis twice in a spec, or has
+                       more entries than the tensor has dims; also (as a
+                       warning) annotations on a Program with NO mesh
+                       spec at all — they are inert until set_mesh().
+  * ShardingUntileable — a statically-known dim is not divisible by the
+                       product of the axis sizes assigned to it: the
+                       mesh cannot tile the var, and the executor would
+                       fall back to replicating it (forfeiting the
+                       memory/compute scaling the annotation asked for).
+                       Dynamic (-1) dims are skipped — the feed's batch
+                       divisibility is a runtime check.
+  * ShardingReshard  — resharding implied mid-pipeline: in a
+                       pipeline-transpiled program, stage k's copy of a
+                       stacked parameter carries a different spec than
+                       stage 0's, so the per-stage weight stack would
+                       transition layouts between stages — exactly the
+                       involuntary-rematerialization class the executor's
+                       consistent in/out shardings exist to prevent.
+
+The pass only inspects metadata (no jax import) and never mutates the
+program. `mesh_axes` overrides the program's own spec — that is how
+`tools/program_lint.py --mesh dpx8,tpx2` lints a saved artifact against a
+deployment mesh it was not annotated with.
+"""
+from .findings import (Finding, SEV_ERROR, SEV_WARNING, SHARDING_INVALID,
+                       SHARDING_RESHARD, SHARDING_UNTILEABLE)
+
+__all__ = ['run_pass']
+
+
+def _annotated_vars(program):
+    seen = set()
+    for blk in program.blocks:
+        for v in blk.vars.values():
+            spec = getattr(v, 'sharding', None)
+            if spec and v.name not in seen:
+                seen.add(v.name)
+                yield v
+
+
+def _var_finding(kind, sev, msg, v):
+    """Finding anchored on an annotated Variable: provenance is the
+    layer call that declared the annotation (captured at Variable build,
+    since parameters have no producer op in the main program), falling
+    back to the producer op's build site."""
+    op = getattr(v, 'op', None)
+    callsite = getattr(v, '_annot_callsite', None) \
+        or getattr(op, 'callsite', None)
+    return Finding(kind, sev, msg, var_names=(v.name,),
+                   op_type=getattr(op, 'type', None),
+                   callsite=callsite)
+
+
+def _axes_of_entry(entry):
+    return entry if isinstance(entry, tuple) else (entry,)
+
+
+def run_pass(program, mesh_axes=None):
+    """mesh_axes: {'dp': 8}-style override (program_lint --mesh); None
+    uses the program's own set_mesh() spec. Returns [Finding]."""
+    findings = []
+    if mesh_axes is None:
+        axes_items = getattr(program, '_mesh_axes', None)
+        axes = dict(axes_items) if axes_items else None
+    else:
+        axes = dict(mesh_axes)
+
+    annotated = list(_annotated_vars(program))
+    if axes is None:
+        for v in annotated:
+            findings.append(_var_finding(
+                SHARDING_INVALID, SEV_WARNING,
+                'sharding annotation %r on %r but the program declares no '
+                'mesh (Program.set_mesh) — the annotation is inert and '
+                'the var will not be sharded' % (v.sharding, v.name), v))
+        return findings
+
+    for v in annotated:
+        spec = v.sharding
+        ndim = len(v.shape) if v.shape is not None else None
+        if ndim is not None and len(spec) > ndim:
+            findings.append(_var_finding(
+                SHARDING_INVALID, SEV_ERROR,
+                'sharding annotation %r on %r has %d entries but the var '
+                'is %d-dimensional' % (spec, v.name, len(spec), ndim), v))
+            continue
+        used = set()
+        bad = False
+        for entry in spec:
+            if entry is None:
+                continue
+            for ax in _axes_of_entry(entry):
+                if ax not in axes:
+                    findings.append(_var_finding(
+                        SHARDING_INVALID, SEV_ERROR,
+                        'sharding annotation %r on %r names mesh axis %r '
+                        'but the mesh declares only %r'
+                        % (spec, v.name, ax, sorted(axes)), v))
+                    bad = True
+                elif ax in used:
+                    findings.append(_var_finding(
+                        SHARDING_INVALID, SEV_ERROR,
+                        'sharding annotation %r on %r uses mesh axis %r '
+                        'on more than one dim' % (spec, v.name, ax), v))
+                    bad = True
+                used.add(ax)
+        if bad or v.shape is None:
+            continue
+        for d, entry in enumerate(spec):
+            if entry is None:
+                continue
+            dim = v.shape[d]
+            if dim < 0:
+                continue   # dynamic batch dim: runtime divisibility check
+            tile = 1
+            for ax in _axes_of_entry(entry):
+                tile *= axes[ax]
+            if dim % tile:
+                findings.append(_var_finding(
+                    SHARDING_UNTILEABLE, SEV_ERROR,
+                    'sharding annotation %r on %r: dim %d of size %d is '
+                    'not divisible by the assigned mesh extent %d (%s) — '
+                    'the mesh cannot tile it and the executor would '
+                    'replicate instead'
+                    % (spec, v.name, d, dim, tile,
+                       'x'.join('%s=%d' % (ax, axes[ax])
+                                for ax in _axes_of_entry(entry))), v))
+
+    # mid-pipeline consistency: a pipeline-transpiled program stacks the
+    # per-stage copies of each parameter into ONE tensor — stage copies
+    # whose annotations disagree would force a layout transition between
+    # stages (the MULTICHIP_r05 involuntary-remat class)
+    pipe = getattr(program, '_pipeline_config', None)
+    if pipe and pipe.get('param_names'):
+        blk = program.global_block()
+        stage0 = pipe['param_names'][0]
+        for j, n0 in enumerate(stage0):
+            v0 = blk.vars.get(n0)
+            spec0 = getattr(v0, 'sharding', None)
+            for k, names in enumerate(pipe['param_names'][1:], start=1):
+                vk = blk.vars.get(names[j])
+                speck = getattr(vk, 'sharding', None)
+                if speck != spec0:
+                    findings.append(_var_finding(
+                        SHARDING_RESHARD, SEV_WARNING,
+                        'pipeline stage %d parameter %r is annotated %r '
+                        'but its stage-0 peer %r is annotated %r — the '
+                        'per-stage weight stack would reshard mid-'
+                        'pipeline (involuntary rematerialization); '
+                        'annotate every stage copy identically'
+                        % (k, names[j], speck, n0, spec0),
+                        vk if vk is not None else v0))
+    return findings

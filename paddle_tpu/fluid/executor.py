@@ -53,6 +53,95 @@ __all__ = ['Executor', 'FetchHandle', 'global_scope', 'scope_guard',
 # modules instead of re-compiling them.
 ENV_COMPILE_CACHE = 'PADDLE_TPU_COMPILE_CACHE'
 
+# Compile-time stderr capture for XLA partitioner diagnostics
+# (docs/parallel.md): the SPMD partitioner reports "Involuntary full
+# rematerialization" — a sharding transition it can only do by
+# replicating the whole tensor — through C++ logging on fd 2, invisible
+# to Python warnings and absent from any API. PADDLE_TPU_REMAT_CAPTURE=0
+# disables the fd redirection for embedders whose stderr is not dup-able.
+ENV_REMAT_CAPTURE = 'PADDLE_TPU_REMAT_CAPTURE'
+_REMAT_MARKER = b'Involuntary full rematerialization'
+
+
+def _remat_capture_enabled():
+    return os.environ.get(ENV_REMAT_CAPTURE, '1').lower() not in (
+        '0', 'off', 'false', 'no')
+
+
+import contextlib as _contextlib
+
+
+import threading as _threading
+
+# fd 2 is process-global state: two overlapping captures (two Executors
+# compiling on different threads) would interleave dup2 save/restore and
+# could leave stderr pointing at a deleted temp file forever. One capture
+# at a time; a contended compile simply runs uncaptured (missing one
+# remat detection beats corrupting fd 2).
+_CAPTURE_FD2_LOCK = _threading.Lock()
+
+
+@_contextlib.contextmanager
+def _capture_fd2(sink):
+    """Tee C++-level stderr (fd 2) into `sink` (a list of bytes) for the
+    duration, re-emitting everything to the real stderr afterwards —
+    capture must never swallow a diagnostic, only OBSERVE it. This is the
+    only hook that sees XLA's C++ log lines (glog writes straight to the
+    fd); Python-level warnings hooks never fire for them. Degrades to a
+    no-op when the fd cannot be duplicated (exotic embedders) or when
+    another thread is already capturing."""
+    import io
+    import sys as _sys
+    import tempfile
+    if not _CAPTURE_FD2_LOCK.acquire(blocking=False):
+        yield
+        return
+    try:
+        try:
+            _sys.stderr.flush()
+        except Exception:
+            pass
+        old = tmp = None
+        try:
+            old = os.dup(2)
+            tmp = tempfile.TemporaryFile()
+            os.dup2(tmp.fileno(), 2)
+        except (OSError, ValueError, io.UnsupportedOperation):
+            # partial setup must not leak per compile: close whatever
+            # succeeded before degrading to a no-op
+            if old is not None:
+                try:
+                    os.close(old)
+                except OSError:
+                    pass
+            if tmp is not None:
+                try:
+                    tmp.close()
+                except Exception:
+                    pass
+            yield
+            return
+        try:
+            yield
+        finally:
+            try:
+                _sys.stderr.flush()
+            except Exception:
+                pass
+            os.dup2(old, 2)
+            os.close(old)
+            try:
+                tmp.seek(0)
+                data = tmp.read()
+                tmp.close()
+                if data:
+                    sink.append(data)
+                    os.write(2, data)
+            except Exception:
+                pass
+    finally:
+        _CAPTURE_FD2_LOCK.release()
+
 
 def anomaly_guard(program=None, enable=True, max_consecutive_skips=None):
     """Enable the COMPILED-path anomaly guard (`check_nan_inf` for the
@@ -218,6 +307,14 @@ def _as_fetch_name(f):
     return str(f)
 
 
+def _is_annotated(program):
+    """True for a Program on the first-class GSPMD annotation path:
+    a `set_mesh()` spec and no legacy transpiler `_dist_config` (the
+    transpilers keep their own mesh build until fully retired)."""
+    return (getattr(program, '_mesh_axes', None) is not None
+            and getattr(program, '_dist_config', None) is None)
+
+
 def _feed_signature(name, val):
     if isinstance(val, SeqValue):
         return (name, 'seq', tuple(val.data.shape), str(val.data.dtype))
@@ -230,7 +327,7 @@ class _CompiledStep(object):
 
     def __init__(self, program, block, feed_names, fetch_names, persist_in,
                  amp=False, platform='cpu', persist_shardings=None,
-                 mesh=None, guard=False):
+                 mesh=None, guard=False, jit_shardings=None):
         self.program = program
         self.amp = amp
         self.platform = platform
@@ -301,6 +398,29 @@ class _CompiledStep(object):
         self.donate_names = self.plan.donate_names(self.persist_in)
         self.readonly_names = self.plan.readonly_names(self.persist_in)
         self.persist_out = self.plan.persist_out()
+        # GSPMD annotation path (docs/parallel.md): explicit jit in/out
+        # sharding trees derived by the memory plan from the ACTUAL
+        # placed shardings — donated inputs and persistable outputs
+        # share one NamedSharding object per name, so the compiled
+        # step's state layout is a fixed point (no inter-step
+        # resharding, no involuntary rematerialization at scan/carry
+        # boundaries). jit_shardings: {'persist': name->sharding|None,
+        # 'feed': name->sharding|None, 'specs': name->annotation}.
+        self._annot_sh = None
+        if jit_shardings is not None and mesh is not None:
+            from jax.sharding import NamedSharding as _NS, \
+                PartitionSpec as _PS
+            repl = _NS(mesh, _PS())
+            don_sh, ro_sh, out_sh = self.plan.sharding_plan(
+                self.persist_in, jit_shardings['persist'])
+            for n in out_sh:
+                if out_sh[n] is None and n not in jit_shardings['persist']:
+                    # persistable the step CREATES (startup programs):
+                    # its annotation decides the birth layout
+                    spec = jit_shardings['specs'].get(n)
+                    out_sh[n] = _NS(mesh, _PS(*spec)) if spec else repl
+            self._annot_sh = (don_sh, ro_sh,
+                              dict(jit_shardings['feed']), out_sh)
 
         run_range = self._run_ops
 
@@ -338,8 +458,18 @@ class _CompiledStep(object):
             return fetches, new_persist, health
 
         self._step_fn = step  # pure, un-jitted, split (donated, readonly)
-        self._jitted = jax.jit(
-            step, donate_argnums=(0,) if self.mutates_persist else ())
+        # the donation vector comes from the memory plan for BOTH paths
+        # (one definition: donate exactly the written-persistables arg)
+        donate = self.plan.donate_argnums(self.persist_in)
+        if self._annot_sh is not None:
+            don_sh, ro_sh, feed_sh, out_sh = self._annot_sh
+            self._jitted = jax.jit(
+                step,
+                in_shardings=(don_sh, ro_sh, feed_sh, None),
+                out_shardings=(None, out_sh, None),
+                donate_argnums=donate)
+        else:
+            self._jitted = jax.jit(step, donate_argnums=donate)
         # K -> jitted K-step lax.scan over the SAME step body (run_bundle)
         self._bundles = {}
 
@@ -380,8 +510,25 @@ class _CompiledStep(object):
 
                 return jax.lax.scan(body, donated, (feeds, seeds))
 
-            fn = jax.jit(bundled,
-                         donate_argnums=(0,) if self.mutates_persist else ())
+            donate = self.plan.donate_argnums(self.persist_in)
+            if self._annot_sh is not None:
+                # same sharding fixed point as the unbundled jit: the
+                # scan carry's in- and out-shardings are the SAME
+                # objects, feeds gain a leading (scanned) K dim
+                from jax.sharding import NamedSharding as _NS, \
+                    PartitionSpec as _PS
+                don_sh, ro_sh, feed_sh, _out = self._annot_sh
+                stacked_sh = {
+                    n: (_NS(sh.mesh, _PS(None, *sh.spec))
+                        if isinstance(sh, _NS) else None)
+                    for n, sh in feed_sh.items()}
+                fn = jax.jit(
+                    bundled,
+                    in_shardings=(don_sh, ro_sh, stacked_sh, None),
+                    out_shardings=(don_sh, None),
+                    donate_argnums=donate)
+            else:
+                fn = jax.jit(bundled, donate_argnums=donate)
             self._bundles[K] = fn
         return fn
 
@@ -737,7 +884,27 @@ class _CompiledStep(object):
                                       extras_streamed=tuple(streamed),
                                       n_virtual=cfg.get('n_virtual', 1),
                                       param_specs=stacked_specs)
-        env[cfg['output_var']] = out.reshape((-1,) + out.shape[2:])
+        res = out.reshape((-1,) + out.shape[2:])
+        if self.mesh is not None:
+            # Pin the region boundary to the batch-sharded layout the
+            # surrounding (dp/sp-partitioned) ops use. The constraint
+            # transposes to ITSELF, so the backward cotangent entering
+            # the region carries the same explicit sharding — without it
+            # GSPMD has to invent the transition from the downstream
+            # layout to the region's microbatched one and falls back to
+            # replicate-then-repartition ("Involuntary full
+            # rematerialization", MULTICHIP_r05 tail).
+            from jax.sharding import NamedSharding as _NS, \
+                PartitionSpec as _PS
+            entries = [None] * res.ndim
+            if 'dp' in self.mesh.shape:
+                entries[0] = 'dp'
+            if 'sp' in self.mesh.shape and res.ndim >= 2:
+                entries[1] = 'sp'
+            if any(entries):
+                res = jax.lax.with_sharding_constraint(
+                    res, _NS(self.mesh, _PS(*entries)))
+        env[cfg['output_var']] = res
 
     def debug_step(self, persist, feed, key, check_nan_inf=False, on_op=None):
         """Eager op-by-op execution: per-op NaN/Inf checks (reference C++
@@ -820,6 +987,11 @@ _G_GRAD_NORM = obs.gauge('anomaly.grad_norm')
 # device — the number that proves (or disproves) the overlap.
 _G_INFLIGHT = obs.gauge('executor.inflight')
 _C_BUNDLED_STEPS = obs.counter('executor.bundle.steps')
+# involuntary-rematerialization detections during compile (the MULTICHIP
+# blind spot: the warning only ever lived in dryrun stderr tails; now it
+# is an executor.remat_detected event + this counter, so a sharding
+# regression shows up in obs_report)
+_C_REMAT = obs.counter('executor.remat_detected')
 
 # RLock: FetchHandle.__del__ may run from a GC pass triggered INSIDE an
 # _inflight_delta call on the same thread (allocation under the lock);
@@ -950,6 +1122,10 @@ class Executor(object):
         self._persistent_hits = 0
         self._last_compile_s = None
         self._last_cache_lookup = None   # {'outcome', 'key', 'entries'}
+        # involuntary-rematerialization detections across this
+        # executor's compiles (see _scan_remat); tests assert 0 on the
+        # pipeline compositions that used to warn (MULTICHIP_r05 tail)
+        self.remat_detected = 0
         # Persistent XLA compilation cache: PADDLE_TPU_COMPILE_CACHE=<dir>
         # wires jax's on-disk executable cache at construction, so a
         # restarted process (Trainer resume, serving warmup) deserializes
@@ -997,22 +1173,82 @@ class Executor(object):
         arr = np.asarray(val)
         return jax.device_put(arr, self._device())
 
+    def _host_stage(self, val):
+        """Host-side feed normalization WITHOUT device placement (the
+        annotated path's counterpart to _to_device): LoDTensor ->
+        SeqValue, everything else to numpy, leaving already-placed
+        jax.Arrays alone. The mesh placement happens once, in
+        _annot_shard_feed."""
+        if isinstance(val, (jax.Array, SeqValue)):
+            return val
+        from .lod_tensor import LoDTensor
+        if isinstance(val, LoDTensor):
+            return val.to_seq_value()
+        return np.asarray(val)
+
+    def _annot_placement(self, program, scope):
+        """The GSPMD annotation path (docs/parallel.md): a Program that
+        declared its mesh via `set_mesh()` (with per-tensor specs on
+        `ParamAttr(sharding=...)`/`Variable.sharding`) is lowered WITHOUT
+        any strategy wrapper — this places every scope-initialized
+        persistable on the mesh per its annotation (replicated when
+        un-annotated), caches the built Mesh on the program, and returns
+        it. The compiled step then runs with explicit in/out shardings
+        and the memory plan's donation vector (_prepare)."""
+        import collections as _c
+        from .. import parallel
+        axes = _c.OrderedDict(program._mesh_axes)
+        mesh = parallel.make_mesh(axes)
+        program._dist_mesh = mesh
+        program._annot_axes = program._mesh_axes
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        for v in program.list_vars():
+            if not v.persistable:
+                continue
+            val = scope._chain_get(v.name)
+            if val is None or isinstance(val, SeqValue):
+                continue
+            spec = P(*v.sharding) if v.sharding else P()
+            try:
+                placed = jax.device_put(val, NamedSharding(mesh, spec))
+            except ValueError as e:
+                import warnings
+                warnings.warn(
+                    'sharding annotation %r on %r does not fit the mesh '
+                    '%r (%s); replicating instead — program_lint --mesh '
+                    'catches this statically' % (
+                        v.sharding, v.name, dict(axes), e))
+                placed = jax.device_put(val, NamedSharding(mesh, P()))
+            scope._chain_set(v.name, placed)
+        return mesh
+
     def _ensure_dist_placement(self, program, scope):
-        """Consume DistributeTranspiler's `_dist_config` annotation: build
-        the dp mesh (capped at the locally visible devices; multi-host
-        grows it via parallel.init_multihost), place parameters (replicated
-        by default; dp-sharded ZeRO-3/FSDP when shard_parameters is set),
+        """Consume the program's parallelism declaration and return its
+        Mesh (or None). Two sources, one consumer: (a) the first-class
+        GSPMD annotation path — `Program.set_mesh()` + per-tensor
+        sharding annotations (docs/parallel.md); (b) the legacy
+        DistributeTranspiler `_dist_config` — build the dp mesh (capped
+        at the locally visible devices; multi-host grows it via
+        parallel.init_distributed), place parameters (replicated by
+        default; dp-sharded ZeRO-3/FSDP when shard_parameters is set),
         and ZeRO-shard optimizer accumulators over dp (the reference's
-        slice_var_up pserver memory scaling). Returns the mesh or None."""
+        slice_var_up pserver memory scaling)."""
         mesh = getattr(program, '_dist_mesh', None)
+        if mesh is not None and _is_annotated(program) \
+                and getattr(program, '_annot_axes', None) \
+                != program._mesh_axes:
+            mesh = None   # set_mesh changed the spec: rebuild
         if mesh is not None:
-            # Already built from _dist_config, or placed directly by
-            # ParallelExecutor. False sentinel -> single device, no-op.
+            # Already built from annotations/_dist_config, or placed
+            # directly by ParallelExecutor. False sentinel -> single
+            # device, no-op.
             if mesh:
                 self._replace_strays(program, scope, mesh)
             return mesh or None
         dist = getattr(program, '_dist_config', None)
         if dist is None:
+            if _is_annotated(program):
+                return self._annot_placement(program, scope)
             return None
         if not dist.get('sync_mode', True) and not getattr(
                 program, '_async_warned', False):
@@ -1159,13 +1395,72 @@ class Executor(object):
         if len(mesh.devices.flat) <= 1:
             return
         from .. import parallel
+        from jax.sharding import NamedSharding, PartitionSpec as P
         for v in program.list_vars():
             if not v.persistable:
                 continue
             val = scope.vars.get(v.name)
             if (isinstance(val, jax.Array)
                     and len(val.sharding.device_set) == 1):
+                if getattr(v, 'sharding', None):
+                    # annotated var: re-assert ITS declared layout, not a
+                    # blanket replicate (io.load overwrote a sharded
+                    # param; replicating it would silently forfeit the
+                    # annotation until the next cold placement)
+                    try:
+                        scope.vars[v.name] = jax.device_put(
+                            val, NamedSharding(mesh, P(*v.sharding)))
+                        continue
+                    except ValueError:
+                        pass   # misfit: fall through to replicate
                 scope.vars[v.name] = parallel.replicate(mesh, val)
+
+    def _annot_shard_feed(self, name, dv, mesh, program):
+        """Feed placement for the annotation path: an explicitly
+        annotated feed var takes its own spec; otherwise the batch dim
+        shards over the program's data axis (replicated when none is
+        declared or the value is a scalar). On a multi-process mesh the
+        caller feeds its PER-HOST slice and the global array is
+        assembled via parallel.global_batch
+        (jax.make_array_from_process_local_data) — each host transfers
+        only its own rows (docs/parallel.md)."""
+        from .. import parallel
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        if isinstance(dv, SeqValue):
+            return SeqValue(
+                self._annot_shard_feed(name, dv.data, mesh, program),
+                self._annot_shard_feed(name, dv.lengths, mesh, program),
+                dv.outer_lengths)
+        var = program.global_block().vars.get(name)
+        spec = getattr(var, 'sharding', None) if var is not None else None
+        data_axis = getattr(program, '_mesh_data_axis', None)
+        if spec is not None:
+            # trim to the VALUE's rank: a SeqValue feed recurses here for
+            # its rank-1 lengths vector with the data var's multi-dim
+            # spec — only the leading (batch) entries can apply to it
+            sh = NamedSharding(mesh, P(*spec[:dv.ndim]))
+        elif (data_axis is not None and data_axis in mesh.shape
+                and dv.ndim >= 1):
+            n = mesh.shape[data_axis]
+            # multi-process: dv is THIS host's slice, so the divisibility
+            # contract is on the assembled global batch (local rows x
+            # process_count), not on the local rows alone — checking the
+            # local slice against the global axis size would spuriously
+            # reject e.g. 12 local rows on a 2-host dp=8 mesh (global 24,
+            # 3 rows/device: valid)
+            global_rows = dv.shape[0] * jax.process_count()
+            if global_rows % n:
+                raise ValueError(
+                    "feed %r global batch size %d (%d per-host rows x %d "
+                    "processes) is not divisible by the %r mesh axis size "
+                    "%d; drop the remainder (e.g. "
+                    "paddle.batch(..., drop_last=True))"
+                    % (name, global_rows, dv.shape[0], jax.process_count(),
+                       data_axis, n))
+            sh = NamedSharding(mesh, P(data_axis))
+        else:
+            return parallel.replicate(mesh, dv)
+        return parallel.global_batch(sh, dv)
 
     def _dist_shard_feed(self, name, dv, mesh):
         from .. import parallel
@@ -1193,19 +1488,31 @@ class Executor(object):
         stacker."""
         feed_vals = {}
         block = program.global_block()
+        annot = dist_mesh is not None and _is_annotated(program)
         for name, val in feed.items():
             var = block.vars.get(name)
-            dv = self._to_device(val, var)
+            # annotated path: stay on the host — _annot_shard_feed /
+            # parallel.global_batch place the value DIRECTLY into its
+            # mesh sharding; committing the full global batch to one
+            # device first would require single-chip HBM to hold it
+            # (defeating pod-scale batches) and pay a second transfer
+            dv = self._host_stage(val) if annot \
+                else self._to_device(val, var)
             if var is not None and var.lod_level > 0 and not isinstance(dv, SeqValue):
                 # dense feed for a lod var: treat every row as full-length
-                lens = jnp.full((dv.shape[0],), dv.shape[1], jnp.int32)
+                lens = (jnp if isinstance(dv, jax.Array) else np).full(
+                    (dv.shape[0],), dv.shape[1], 'int32')
                 dv = SeqValue(dv, lens)
             if var is not None and not isinstance(dv, SeqValue):
                 want = np.dtype(var.dtype) if var.dtype != 'bfloat16' else jnp.bfloat16
                 if dv.dtype != want:
                     dv = dv.astype(want)
             if dist_mesh is not None:
-                dv = self._dist_shard_feed(name, dv, dist_mesh)
+                if _is_annotated(program):
+                    dv = self._annot_shard_feed(name, dv, dist_mesh,
+                                                program)
+                else:
+                    dv = self._dist_shard_feed(name, dv, dist_mesh)
             feed_vals[name] = dv
         return feed_vals
 
@@ -1236,6 +1543,27 @@ class Executor(object):
                 persist_shardings[n] = v.sharding
         shard_sig = tuple(sorted((n, str(s.spec), s.mesh)
                                  for n, s in persist_shardings.items()))
+        # GSPMD annotation path: jit sharding trees from the ACTUAL
+        # placements (persist values were just mesh-placed by
+        # _annot_placement; feed values by _annot_shard_feed), plus the
+        # raw annotations for persistables the step creates. The
+        # _CompiledStep derives its in/out shardings + donation vector
+        # from these through the memory plan.
+        jit_shardings = None
+        if _is_annotated(program) and dist_mesh is not None:
+            def _sh_of(v):
+                if isinstance(v, jax.Array) and isinstance(
+                        v.sharding, NamedSharding):
+                    return v.sharding
+                return None
+            jit_shardings = {
+                'persist': {n: _sh_of(scope._chain_get(n))
+                            for n in persist_in},
+                'feed': {n: _sh_of(v) for n, v in feed_vals.items()},
+                'specs': {v.name: v.sharding for v in program.list_vars()
+                          if v.persistable and getattr(v, 'sharding',
+                                                       None)},
+            }
         from . import passes as passes_mod
         opt = passes_mod.opt_mode()
         key = (program._uid, program._version, feed_sig, tuple(fetch_names),
@@ -1293,7 +1621,8 @@ class Executor(object):
                         fetch_names, persist_in, amp=step_amp,
                         platform=plat,
                         persist_shardings=persist_shardings,
-                        mesh=dist_mesh, guard=guard)
+                        mesh=dist_mesh, guard=guard,
+                        jit_shardings=jit_shardings)
                     if run_program is not program:
                         # PROBE the optimized step by tracing it now
                         # (.lower() = trace to StableHLO, no XLA compile,
@@ -1327,7 +1656,8 @@ class Executor(object):
                         fetch_names, persist_in, amp=amp,
                         platform=plat,
                         persist_shardings=persist_shardings,
-                        mesh=dist_mesh, guard=guard)
+                        mesh=dist_mesh, guard=guard,
+                        jit_shardings=jit_shardings)
             if use_program_cache:
                 self._cache[key] = compiled
             outcome = 'miss'
@@ -1399,11 +1729,20 @@ class Executor(object):
         emits the `executor.compile` span; a persistent hit emits an
         `executor.compile.persistent_hit` event instead — so a warm-cache
         restart's run log shows ZERO compile spans for already-cached
-        keys (docs/perf.md)."""
+        keys (docs/perf.md). The compile window also tees fd-2 stderr to
+        catch the SPMD partitioner's involuntary-rematerialization
+        diagnostic (_scan_remat) — only on first calls, never in the
+        steady-state loop."""
         pre = self._cc_entry_count()
+        captured = []
         t0 = time.perf_counter()
-        out = fn(*args)
+        if _remat_capture_enabled():
+            with _capture_fd2(captured):
+                out = fn(*args)
+        else:
+            out = fn(*args)
         dt = time.perf_counter() - t0
+        self._scan_remat(captured, key_id)
         hit = (pre is not None and pre > 0
                and self._cc_entry_count() == pre)
         if hit:
@@ -1418,6 +1757,31 @@ class Executor(object):
             self._last_compile_s = dt
             _G_LAST_COMPILE.set(dt)
         return out, ('persistent_hit' if hit else 'compile')
+
+    def _scan_remat(self, captured, key_id):
+        """Turn captured compile-time stderr into the
+        `executor.remat_detected` signal: XLA's SPMD partitioner logged
+        "Involuntary full rematerialization" — it could only satisfy a
+        sharding transition by replicating the tensor and re-partitioning
+        it, a full all-gather the program's annotations did not ask for.
+        Counted per compile (event + counter + exe.remat_detected), so a
+        sharding regression is a number in obs_report, not a line lost in
+        a dryrun's stderr tail."""
+        n = sum(c.count(_REMAT_MARKER) for c in captured)
+        if not n:
+            return
+        self.remat_detected += n
+        _C_REMAT.inc(n)
+        obs.event('executor.remat_detected', key=key_id, count=n)
+        import warnings
+        warnings.warn(
+            'XLA SPMD partitioner reported %d involuntary full '
+            'rematerialization(s) while compiling key %s: a sharding '
+            'transition could only be satisfied by replicate-then-'
+            'repartition (a full all-gather per step). Check the in/out '
+            'sharding consistency of the step (docs/parallel.md); '
+            'program_lint --mesh flags the static cases.' % (n, key_id),
+            RuntimeWarning, stacklevel=3)
 
     def run(self,
             program=None,
@@ -1834,7 +2198,8 @@ class Executor(object):
                 'evictions': self._cache_evictions,
                 'persistent_hits': self._persistent_hits,
                 'compile_cache_dir': self._compile_cache_dir,
-                'last_compile_seconds': self._last_compile_s}
+                'last_compile_seconds': self._last_compile_s,
+                'remat_detected': self.remat_detected}
 
     def close(self):
         """Release compiled executables and drop cached jit state

@@ -26,7 +26,7 @@ __all__ = [
     'Program', 'Operator', 'Parameter', 'Variable', 'Block',
     'default_startup_program', 'default_main_program', 'program_guard',
     'name_scope', 'device_guard', 'get_var', 'grad_var_name',
-    'strict_infer_shape',
+    'strict_infer_shape', 'normalize_sharding',
 ]
 
 GRAD_VAR_SUFFIX = "@GRAD"
@@ -44,6 +44,45 @@ ROLE_METRIC = 32
 # and (b) no plausible user tensor dim collides with it; Variable.__init__
 # rejects the collision outright rather than silently mapping the dim to -1.
 DYN_DIM = 999983
+
+
+def normalize_sharding(spec):
+    """Normalize a sharding annotation into the canonical per-dim tuple.
+
+    A spec names, per tensor dimension, the mesh axis (or axes) that
+    dimension is partitioned over: each entry is an axis name, None
+    (replicated dim), or a tuple of axis names (partitioned over the
+    axes' product). Trailing dims may be omitted (replicated). Examples:
+    ``('model', None)``, ``('dp',)``, ``(('tp', 'dp'), None)``. A bare
+    string means dim 0 over that axis. Returns None for None, else a
+    tuple ready for jax.sharding.PartitionSpec(*spec) — framework.py
+    itself never imports jax; the Executor builds the NamedSharding."""
+    if spec is None:
+        return None
+    if isinstance(spec, str):
+        spec = (spec,)
+    if not isinstance(spec, (list, tuple)):
+        raise ValueError(
+            'sharding must be a tuple of mesh-axis names / None / '
+            'axis-name tuples, got %r' % (spec,))
+    out = []
+    for e in spec:
+        if e is None:
+            out.append(None)
+        elif isinstance(e, str):
+            out.append(e)
+        elif (isinstance(e, (list, tuple)) and e
+              and all(isinstance(a, str) for a in e)):
+            out.append(tuple(e))
+        else:
+            raise ValueError(
+                'bad sharding entry %r in %r: each dim is an axis name, '
+                'None, or a non-empty tuple of axis names' % (e, spec))
+    return tuple(out)
+
+
+def _sharding_to_jsonable(spec):
+    return [list(e) if isinstance(e, tuple) else e for e in spec]
 
 
 def grad_var_name(name):
@@ -129,11 +168,22 @@ class Variable(object):
                  is_data=False,
                  type=None,
                  initializer=None,
+                 sharding=None,
                  **kwargs):
         self.block = block
         if name is None:
             name = unique_name.generate('_generated_var')
         self.name = name
+        # GSPMD sharding annotation (docs/parallel.md): per-dim mesh-axis
+        # names interpreted against the Program's mesh spec (set_mesh).
+        # Static metadata like shape/dtype — the Executor turns it into a
+        # NamedSharding at lowering time; fluid.analysis.sharding checks
+        # consistency ahead of that. Annotated vars capture the layer
+        # call that declared the spec (params have no producer op in the
+        # main program, so op provenance can't name it).
+        self.sharding = normalize_sharding(sharding)
+        self._annot_callsite = (_capture_callsite()
+                                if self.sharding is not None else None)
         self.shape = tuple(int(d) for d in shape) if shape is not None else None
         if self.shape is not None and DYN_DIM in self.shape:
             raise ValueError(
@@ -174,12 +224,17 @@ class Variable(object):
         return jax.ShapeDtypeStruct(shape, np.dtype(dt) if dt != 'bfloat16' else 'bfloat16')
 
     def _to_dict(self):
-        return dict(name=self.name,
-                    shape=list(self.shape) if self.shape is not None else None,
-                    dtype=self.dtype, lod_level=self.lod_level,
-                    persistable=self.persistable, stop_gradient=self.stop_gradient,
-                    is_data=self.is_data, type=self.type,
-                    cls=type(self).__name__)
+        d = dict(name=self.name,
+                 shape=list(self.shape) if self.shape is not None else None,
+                 dtype=self.dtype, lod_level=self.lod_level,
+                 persistable=self.persistable, stop_gradient=self.stop_gradient,
+                 is_data=self.is_data, type=self.type,
+                 cls=type(self).__name__)
+        if self.sharding is not None:
+            # only when annotated: un-annotated programs serialize
+            # byte-identically to pre-sharding artifacts
+            d['sharding'] = _sharding_to_jsonable(self.sharding)
+        return d
 
 
 class Parameter(Variable):
@@ -390,12 +445,79 @@ class Program(object):
         self.random_seed = 0
         self._version = 0
         self._seed_counter = 0
+        # GSPMD mesh spec (docs/parallel.md): ((axis, size), ...) in mesh
+        # layout order + the axis feeds shard their batch dim over. Set by
+        # set_mesh(); consumed by the Executor's annotated-sharding path
+        # and by fluid.analysis.sharding.
+        self._mesh_axes = None
+        self._mesh_data_axis = None
         # id(program) can be recycled after GC, colliding in the Executor's
         # jit cache; a monotonically unique uid cannot.
         self._uid = Program._next_uid
         Program._next_uid += 1
 
     _next_uid = 0
+
+    def set_mesh(self, axes, data_axis=None):
+        """Declare the device mesh this Program's sharding annotations
+        refer to — the program-level half of the annotation surface
+        (docs/parallel.md; the per-tensor half is
+        ``ParamAttr(sharding=...)`` / ``Variable(sharding=...)``).
+
+        axes: {'dp': 8} / {'dp': 2, 'model': 4}-style dict (insertion
+        order = mesh layout, row-major over the visible devices) or an
+        ``((name, size), ...)`` sequence. ``set_mesh(None)`` clears the
+        spec. data_axis: the mesh axis feed batches shard their leading
+        dim over; defaults to ``'dp'`` (then ``'data'``) when present,
+        else feeds replicate.
+
+        The Executor lowers an annotated Program through ONE jitted step
+        with explicit in/out shardings and a donation vector over the
+        sharded persistables — no strategy wrapper involved; plain
+        ``run``/``run_bundle``/``Trainer`` all take this path."""
+        # any spec change invalidates the Executor's cached Mesh build
+        for a in ('_dist_mesh', '_annot_axes'):
+            if hasattr(self, a):
+                delattr(self, a)
+        if axes is None:
+            self._mesh_axes = None
+            self._mesh_data_axis = None
+            self._bump_version()
+            return self
+        items = tuple(axes.items()) if isinstance(axes, dict) \
+            else tuple((str(n), int(s)) for n, s in axes)
+        if not items:
+            raise ValueError('set_mesh needs at least one (axis, size)')
+        seen = set()
+        for name, size in items:
+            if not isinstance(name, str) or not name:
+                raise ValueError('mesh axis name must be a non-empty '
+                                 'string, got %r' % (name,))
+            if name in seen:
+                raise ValueError('duplicate mesh axis %r' % name)
+            seen.add(name)
+            if int(size) < 1:
+                raise ValueError('mesh axis %r has size %r' % (name, size))
+        items = tuple((n, int(s)) for n, s in items)
+        if data_axis is None:
+            for cand in ('dp', 'data'):
+                if cand in seen:
+                    data_axis = cand
+                    break
+        elif data_axis not in seen:
+            raise ValueError('data_axis %r is not a mesh axis (have %r)'
+                             % (data_axis, sorted(seen)))
+        self._mesh_axes = items
+        self._mesh_data_axis = data_axis
+        self._bump_version()
+        return self
+
+    @property
+    def mesh_axes(self):
+        """The declared mesh spec as an ordered dict, or None."""
+        if self._mesh_axes is None:
+            return None
+        return collections.OrderedDict(self._mesh_axes)
 
     def _bump_version(self):
         self._version += 1
@@ -443,6 +565,11 @@ class Program(object):
         for flag in ('_amp', '_amp_ir', '_fetch_f32', '_use_remat'):
             if hasattr(self, flag):
                 setattr(p, flag, getattr(self, flag))
+        # the mesh spec travels with the program exactly like _dist_config:
+        # a clone of an annotated program stays annotated (per-var specs
+        # ride through Variable._to_dict below)
+        p._mesh_axes = self._mesh_axes
+        p._mesh_data_axis = self._mesh_data_axis
         if getattr(self, '_dist_config', None) is not None:
             # mesh annotations travel with the program (the scope's arrays
             # are already mesh-placed; a meshless clone would mix devices)
@@ -605,13 +732,23 @@ class Program(object):
 
     # -- serialization (reference: ProgramDesc protobuf round-trip) --
     def _to_dict(self):
-        return dict(random_seed=self.random_seed,
-                    blocks=[b._to_dict() for b in self.blocks])
+        d = dict(random_seed=self.random_seed,
+                 blocks=[b._to_dict() for b in self.blocks])
+        if self._mesh_axes is not None:
+            # mesh spec survives save/load so program_lint --mesh and a
+            # re-loaded artifact see the same annotation context
+            d['mesh'] = {'axes': [[n, s] for n, s in self._mesh_axes],
+                         'data_axis': self._mesh_data_axis}
+        return d
 
     @staticmethod
     def _from_dict(d):
         p = Program()
         p.random_seed = d.get('random_seed', 0)
+        mesh = d.get('mesh')
+        if mesh:
+            p.set_mesh([(n, s) for n, s in mesh['axes']],
+                       data_axis=mesh.get('data_axis'))
         p.blocks = []
         for bd in d['blocks']:
             blk = Block(p, bd['idx'], bd['parent_idx'])

@@ -9,8 +9,13 @@ __all__ = ['data', 'open_recordio_file', 'open_files', 'read_file',
 
 
 def data(name, shape, append_batch_size=True, dtype='float32', lod_level=0,
-         type=None, stop_gradient=True):
-    """reference layers/io.py:data."""
+         type=None, stop_gradient=True, sharding=None):
+    """reference layers/io.py:data.
+
+    sharding: optional GSPMD annotation for the fed value, e.g.
+    ``('dp', None)`` (docs/parallel.md). Without it, feeds of a
+    mesh-annotated Program shard their batch dim over the mesh's data
+    axis automatically."""
     helper = LayerHelper('data', name=name)
     shape = list(shape)
     if append_batch_size:
@@ -21,7 +26,8 @@ def data(name, shape, append_batch_size=True, dtype='float32', lod_level=0,
         shape = [shape[0], -1] + shape[1:]
     return helper.create_global_variable(
         name=name, shape=shape, dtype=convert_dtype(dtype),
-        lod_level=lod_level, stop_gradient=stop_gradient, is_data=True)
+        lod_level=lod_level, stop_gradient=stop_gradient, is_data=True,
+        sharding=sharding)
 
 
 class _PyReader(object):

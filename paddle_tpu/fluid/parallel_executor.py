@@ -4,24 +4,48 @@ Parity: reference python/paddle/fluid/parallel_executor.py + the C++ SSA
 graph executor (paddle/fluid/framework/details/*) that scatters the batch
 over GPUs and NCCL-allreduces gradients.
 
-TPU-first redesign (GSPMD): the SAME lowered program is jitted once over a
-1-D `dp` jax.sharding.Mesh — the feed is sharded on the batch axis, the
-persistables (params/optimizer state) are replicated, and XLA's SPMD
-partitioner inserts the gradient all-reduce on ICI automatically. No
-per-device program copies, no explicit allreduce graph: scaling to a
-multi-host mesh is the same code with more devices.
+DEPRECATED shim (docs/parallel.md, docs/migration.md): data parallelism is
+a first-class Program concern now — ``program.set_mesh({'dp': N})`` (plus
+``ParamAttr(sharding=...)`` for parameter layouts) and plain
+``Executor.run``/``run_bundle`` lower the annotated Program through ONE
+GSPMD-partitioned XLA module. This class survives as a thin wrapper that
+emits exactly those annotations for the duration of each ``run`` call:
+``BuildStrategy.ReduceStrategy.Reduce`` becomes per-parameter ZeRO-3
+sharding annotations, the feed shards over the mesh's data axis, and the
+compiled step carries explicit in/out shardings + the memory plan's
+donation vector — the same code path ``run_bundle`` and the Trainer use.
 """
+import warnings
+
 import numpy as np
 
 import jax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from . import core
 from .executor import Executor, global_scope
 from .framework import default_main_program
-from .lowering import SeqValue
 
 __all__ = ['ParallelExecutor', 'ExecutionStrategy', 'BuildStrategy']
+
+# ZeRO-3 floor for the Reduce build strategy's emitted annotations —
+# mirrors parallel.fsdp_shard_params(min_size=1024): gather latency on a
+# tiny tensor outweighs the bytes saved.
+_FSDP_MIN_SIZE = 1024
+
+_warned = [False]
+
+
+def _warn_deprecated():
+    if _warned[0]:
+        return
+    _warned[0] = True
+    warnings.warn(
+        "ParallelExecutor is deprecated: declare the mesh on the Program "
+        "instead — program.set_mesh({'dp': N}) (ParamAttr(sharding=...) "
+        "for parameter layouts) and run it through the plain "
+        "Executor.run/run_bundle/Trainer. See docs/parallel.md and "
+        "docs/migration.md.", DeprecationWarning, stacklevel=3)
 
 
 class ExecutionStrategy(object):
@@ -54,20 +78,22 @@ class BuildStrategy(object):
 
 
 class ParallelExecutor(object):
-    """reference parallel_executor.py:ParallelExecutor.
+    """reference parallel_executor.py:ParallelExecutor — now a shim that
+    emits GSPMD annotations (module docstring).
 
     Single-host surface: the dp mesh spans this process's visible devices.
     The reference's `num_trainers`/`trainer_id` multi-node path
     (parallel_executor.py:43-46,74 — one NCCL clique across nodes) is
     accepted for API compatibility but does not grow the mesh here;
-    multi-host scale-out is `parallel.init_multihost()` (jax.distributed)
-    BEFORE building the executor, after which the same GSPMD program spans
-    every host's devices (tests/test_multihost.py)."""
+    multi-host scale-out is `parallel.init_distributed()`
+    (jax.distributed) BEFORE building the executor, after which the same
+    GSPMD program spans every host's devices (tests/test_multihost.py)."""
 
     def __init__(self, use_cuda=None, loss_name=None, main_program=None,
                  share_vars_from=None, exec_strategy=None, build_strategy=None,
                  num_trainers=1, trainer_id=0, scope=None, devices=None,
                  num_devices=None, use_tpu=None, **kwargs):
+        _warn_deprecated()
         self._program = main_program or default_main_program()
         self._loss_name = loss_name
         self._scope = scope or global_scope()
@@ -80,12 +106,9 @@ class ParallelExecutor(object):
             devs = devs[:num_devices]
         self._mesh = Mesh(np.asarray(devs), ('dp',))
         self._ndev = len(devs)
+        self._axes = (('dp', self._ndev),)
         self._exe = Executor(core.TPUPlace(0) if core.is_compiled_with_tpu()
                              else core.CPUPlace())
-        self._exe.place = None  # device placement handled via shardings
-        self._data_sharding = NamedSharding(self._mesh, P('dp'))
-        self._repl_sharding = NamedSharding(self._mesh, P())
-        self._placed = False
         if share_vars_from is not None:
             self._scope = share_vars_from._scope
 
@@ -93,77 +116,64 @@ class ParallelExecutor(object):
     def device_count(self):
         return self._ndev
 
-    def _shard_batch(self, val):
-        def put(x, spec_dims):
-            n = x.shape[0]
-            if n % self._ndev:
-                # Padding by duplicating rows would silently change the
-                # loss/gradients (duplicated examples get double weight).
-                raise ValueError(
-                    "ParallelExecutor feed batch size %d is not divisible "
-                    "by the %d mesh devices; drop the remainder (e.g. wrap "
-                    "the reader in paddle.batch(..., drop_last=True)) or "
-                    "pad+mask it yourself" % (n, self._ndev))
-            sh = NamedSharding(self._mesh, P('dp', *([None] * (x.ndim - 1))))
-            return jax.device_put(jnp_asarray(x), sh)
-
-        import jax.numpy as jnp
-
-        def jnp_asarray(x):
-            return jnp.asarray(np.asarray(x))
-
-        if isinstance(val, SeqValue):
-            return SeqValue(put(val.data, None), put(val.lengths, None),
-                            val.outer_lengths)
-        from .lod_tensor import LoDTensor
-        if isinstance(val, LoDTensor):
-            return self._shard_batch(val.to_seq_value())
-        return put(np.asarray(val), None)
-
-    def _replicate_persistables(self):
-        import jax.numpy as jnp
+    def _emit_annotations(self):
+        """Translate the build strategy into per-tensor sharding
+        annotations: ReduceStrategy.Reduce (the reference's partitioned
+        parameter updates) becomes ZeRO-3 — each large persistable
+        annotated ('dp' on its first divisible dim), exactly
+        parallel.fsdp_shard_params' placement rule. Returns the vars WE
+        annotated so run() can revert them: like the mesh attrs, the
+        annotations are armed per call — they must not leak onto the
+        user's Program (or into its clones / saved artifacts) after this
+        deprecated shim returns."""
         bs = self._build_strategy
-        # reference BuildStrategy.ReduceStrategy.Reduce partitioned each
-        # parameter's update onto one device; the GSPMD equivalent is
-        # ZeRO-3 — shard the parameters themselves over dp
-        fsdp = (bs is not None and bs.reduce_strategy ==
-                BuildStrategy.ReduceStrategy.Reduce)
-        if fsdp:
-            from .. import parallel
-            dense = {n: v for n, v in self._scope.vars.items()
-                     if v is not None and not isinstance(v, SeqValue)}
-            self._scope.vars.update(
-                parallel.fsdp_shard_params(dense, self._mesh))
-            self._placed = True
-            return
-        for name, v in list(self._scope.vars.items()):
-            if v is None or isinstance(v, SeqValue):
+        if bs is None or bs.reduce_strategy != \
+                BuildStrategy.ReduceStrategy.Reduce:
+            return []
+        emitted = []
+        for v in self._program.global_block().vars.values():
+            if not v.persistable or v.sharding or v.shape is None:
                 continue
-            self._scope.vars[name] = jax.device_put(jnp.asarray(v),
-                                                    self._repl_sharding)
-        self._placed = True
+            if any(d < 0 for d in v.shape):
+                continue
+            if int(np.prod(v.shape or (1,))) < _FSDP_MIN_SIZE:
+                continue
+            for d, size in enumerate(v.shape):
+                if size % self._ndev == 0:
+                    v.sharding = (None,) * d + ('dp',)
+                    emitted.append(v)
+                    break
+        return emitted
 
     def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
         """reference parallel_executor.py:run. The feed is ONE global batch
-        (sharded over the mesh), matching feed_dict semantics."""
+        (sharded over the mesh), matching feed_dict semantics.
+
+        Implementation: arm the Program's mesh annotation for THIS call
+        only (a later plain Executor.run on the same program must stay
+        single-device — the scope's mesh-placed params are a separate,
+        documented GSPMD property) and dispatch through the one annotated
+        executor path."""
         feed = feed if feed is not None else feed_dict or {}
-        if not self._placed:
-            self._replicate_persistables()
-        dev_feed = {k: self._shard_batch(v) for k, v in feed.items()}
-        prev = self._exe._to_device
-        self._exe._to_device = lambda v, var=None: v  # already placed
-        # expose the dp mesh to mesh-aware op lowerings (moe_mlp dispatches
-        # experts over this axis) for THIS run only — a later plain
-        # Executor.run on the same program must stay single-device
-        prev_mesh = getattr(self._program, '_dist_mesh', None)
-        self._program._dist_mesh = self._mesh
+        p = self._program
+        emitted = self._emit_annotations()
+        prev = (getattr(p, '_mesh_axes', None),
+                getattr(p, '_mesh_data_axis', None),
+                getattr(p, '_dist_mesh', None),
+                getattr(p, '_annot_axes', None))
+        p._mesh_axes = self._axes
+        p._mesh_data_axis = 'dp'
+        p._dist_mesh = self._mesh   # pre-built: first n devices only
+        p._annot_axes = self._axes
         try:
-            return self._exe.run(self._program, feed=dev_feed,
-                                 fetch_list=fetch_list, scope=self._scope,
+            return self._exe.run(p, feed=feed, fetch_list=fetch_list,
+                                 scope=self._scope,
                                  return_numpy=return_numpy)
         finally:
-            self._exe._to_device = prev
-            self._program._dist_mesh = prev_mesh
+            (p._mesh_axes, p._mesh_data_axis, p._dist_mesh,
+             p._annot_axes) = prev
+            for v in emitted:
+                v.sharding = None
 
     def bcast_params(self):
         """Parity shim: with GSPMD-replicated params there is nothing to

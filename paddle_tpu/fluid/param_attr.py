@@ -16,7 +16,8 @@ class ParamAttr(object):
                  regularizer=None,
                  trainable=True,
                  gradient_clip=None,
-                 do_model_average=None):
+                 do_model_average=None,
+                 sharding=None):
         # do_model_average default None (= averaged): the reference's
         # ParamAttr declares False but its _to_kwargs/Parameter key
         # mismatch makes every default param land as None, and
@@ -29,6 +30,12 @@ class ParamAttr(object):
         self.trainable = trainable
         self.gradient_clip = gradient_clip
         self.do_model_average = do_model_average
+        # GSPMD sharding annotation (docs/parallel.md): per-dim mesh-axis
+        # names, e.g. sharding=('model', None) partitions dim 0 over the
+        # 'model' axis of the Program's set_mesh() spec. Normalized here
+        # so a bad spec fails at the layer call that wrote it.
+        from .framework import normalize_sharding
+        self.sharding = normalize_sharding(sharding)
 
     def set_default_initializer(self, initializer):
         if initializer is None:
@@ -74,6 +81,7 @@ class ParamAttr(object):
             'trainable': self.trainable,
             'gradient_clip_attr': self.gradient_clip,
             'do_model_average': self.do_model_average,
+            'sharding': self.sharding,
         }
         if with_initializer:
             kwargs['initializer'] = self.initializer

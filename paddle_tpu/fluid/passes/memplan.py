@@ -67,6 +67,41 @@ class MemoryPlan(object):
         """Names the compiled step writes back to the scope."""
         return sorted(self.write_set)
 
+    def donation_vector(self, persist_in):
+        """pjit-style donation vector over the compiled step's
+        (donated, readonly, feed, rng_key) argument list: exactly the
+        written-persistables argument is donated, and only when the step
+        writes at all (the pjit `donation_vector`/`rebase_donate_argnums`
+        idiom, collapsed onto the executor's fixed 4-arg signature)."""
+        return (bool(self.donate_names(persist_in)), False, False, False)
+
+    def donate_argnums(self, persist_in):
+        """The donate_argnums tuple jax.jit takes, derived from
+        donation_vector — one definition of the donation decision for
+        both the plain and the GSPMD-annotated jit paths."""
+        return tuple(i for i, d in enumerate(self.donation_vector(persist_in))
+                     if d)
+
+    def sharding_plan(self, persist_in, shardings, default=None):
+        """(donated_in, readonly_in, persist_out) NamedSharding trees for
+        the GSPMD executor path (docs/parallel.md): the donated argument's
+        in-shardings and the persistable outputs' out-shardings are THE
+        SAME objects, so the compiled step's state keeps one stable layout
+        across steps/scan carries — XLA never inserts a resharding (or a
+        full rematerialization) between a step's output and the next
+        step's input.
+
+        shardings: name -> NamedSharding (or None = unconstrained) for
+        values present in the scope; `default` fills persistable outputs
+        the step CREATES (startup programs). Entries missing from both
+        stay None (jit leaves them unconstrained)."""
+        donated = {n: shardings.get(n, default)
+                   for n in self.donate_names(persist_in)}
+        readonly = {n: shardings.get(n, default)
+                    for n in self.readonly_names(persist_in)}
+        out = {n: shardings.get(n, default) for n in self.persist_out()}
+        return donated, readonly, out
+
     def to_dict(self):
         return {'donates': self.donates,
                 'write_set': sorted(self.write_set)}

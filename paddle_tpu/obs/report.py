@@ -261,6 +261,24 @@ def summarize(events):
             lines.append('%d optimizer failure(s) fell back to the '
                          'unoptimized lowering' % len(errs))
 
+    # -- sharding / GSPMD ------------------------------------------------
+    # executor.remat_detected: XLA's SPMD partitioner fell back to
+    # replicate-then-repartition during a compile (an all-gather per step
+    # the program never asked for). Zero is the contract on the shipped
+    # compositions (docs/parallel.md); any nonzero here is a sharding
+    # regression that previously only lived in dryrun stderr tails.
+    remat = _events(events, 'executor.remat_detected')
+    if remat:
+        n = sum(int(e.get('fields', {}).get('count', 1)) for e in remat)
+        keys = sorted({str(e.get('fields', {}).get('key', '?'))
+                       for e in remat})
+        lines.append('')
+        lines.append('-- sharding / GSPMD --')
+        lines.append('involuntary rematerialization: %d detection(s) '
+                     'across compile key(s) %s — a sharding transition '
+                     'XLA could only satisfy by replicating the tensor'
+                     % (n, ', '.join(keys)))
+
     # -- anomaly guard ---------------------------------------------------
     skips = _events(events, 'anomaly.skip')
     lines.append('')

@@ -15,11 +15,12 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ['auto_tp_rules', 'fsdp_shard_params',
+__all__ = ['annotate_tp', 'auto_tp_rules', 'fsdp_shard_params',
            'make_mesh', 'data_sharding', 'replicated', 'shard_batch',
            'replicate', 'shard_params_by_rules', 'psum', 'all_gather',
            'reduce_scatter', 'ppermute', 'shard_optimizer_states',
-           'init_multihost', 'Mesh', 'NamedSharding', 'P',
+           'init_multihost', 'init_distributed', 'process_count',
+           'process_index', 'global_batch', 'Mesh', 'NamedSharding', 'P',
            'ring_attention', 'ring_self_attention',
            'ulysses_attention', 'ulysses_self_attention',
            'pipeline_apply', 'pipeline_manual_axes', 'stack_stage_params',
@@ -27,11 +28,78 @@ __all__ = ['auto_tp_rules', 'fsdp_shard_params',
 
 from .ring_attention import ring_attention, ring_self_attention  # noqa: E402
 from .ulysses import ulysses_attention, ulysses_self_attention  # noqa: E402
-from .tp import auto_tp_rules  # noqa: E402
+from .tp import annotate_tp, auto_tp_rules  # noqa: E402
 from .pipeline import (pipeline_apply, pipeline_manual_axes,  # noqa: E402
                        stack_stage_params)
 from .moe import moe_apply, stack_expert_params  # noqa: E402
 from .local_sgd import LocalSGD  # noqa: E402
+
+
+def init_distributed(coordinator_address=None, num_processes=None,
+                     process_id=None, local_device_ids=None):
+    """Join the multi-process GSPMD runtime (docs/parallel.md): wraps
+    jax.distributed.initialize so every host sees the global device set,
+    after which ONE annotated Program spans every host's chips — the
+    Executor assembles each host's per-host feed slice into the global
+    sharded batch (parallel.global_batch) and XLA places the collectives
+    on ICI/DCN. The production sibling of init_multihost (which keeps the
+    reference's PADDLE_TRAINER_* env compatibility).
+
+    num_processes=1 (or unset, outside any cluster) is the single-process
+    no-op: nothing to initialize, the local devices ARE the mesh. Returns
+    {'num_processes', 'process_id', 'initialized'} so launchers can log
+    what they joined."""
+    if num_processes is None and coordinator_address is None \
+            and process_id is None:
+        num_processes = 1
+    if num_processes is not None and int(num_processes) <= 1:
+        return {'num_processes': 1, 'process_id': 0, 'initialized': False}
+    if coordinator_address is None or process_id is None \
+            or num_processes is None:
+        raise ValueError(
+            'init_distributed needs coordinator_address, num_processes '
+            'and process_id for a %r-process cluster (got %r, %r, %r)'
+            % (num_processes, coordinator_address, num_processes,
+               process_id))
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=int(num_processes), process_id=int(process_id),
+        local_device_ids=local_device_ids)
+    return {'num_processes': int(num_processes),
+            'process_id': int(process_id), 'initialized': True}
+
+
+def process_count():
+    """Number of processes in the (initialized) runtime; 1 single-host."""
+    return jax.process_count()
+
+
+def process_index():
+    """This process's id in the runtime; 0 single-host."""
+    return jax.process_index()
+
+
+def global_batch(sharding, local_data):
+    """Assemble a global sharded array from THIS process's slice of the
+    batch (docs/parallel.md): under a multi-process mesh each host feeds
+    only the rows its devices own (`reader.shard(num_hosts, host_id)`
+    upstream), and jax.make_array_from_process_local_data stitches the
+    per-host slices into one global jax.Array — no host ever
+    materializes (or transfers) the whole batch. Single-process, the
+    local slice IS the global batch and this is a plain device_put."""
+    if jax.process_count() > 1 and hasattr(
+            jax, 'make_array_from_process_local_data'):
+        return jax.make_array_from_process_local_data(
+            sharding, np.asarray(local_data))
+    if not isinstance(local_data, jax.Array):
+        # device_put straight from host memory into the sharded
+        # placement — staging through jnp.asarray would commit the whole
+        # batch to device 0 first
+        local_data = np.asarray(local_data)
+    return jax.device_put(local_data, sharding)
+
+
+_mh_warned = [False]
 
 
 def init_multihost(coordinator_address=None, num_processes=None,
@@ -46,8 +114,25 @@ def init_multihost(coordinator_address=None, num_processes=None,
     reference-style cluster scripts work unchanged; returns False (no-op)
     when neither args nor env describe a cluster — single-host dev keeps
     working without any setup.
+
+    DEPRECATED shim (docs/migration.md): `init_distributed` is the
+    first-class multi-process entry of the GSPMD executor path — explicit
+    cluster arguments, a structured return, and the documented pairing
+    with `reader.shard` + per-host feeds. This wrapper survives for the
+    PADDLE_TRAINER_* env compatibility only.
     """
     import os
+    import warnings
+    if not _mh_warned[0]:
+        _mh_warned[0] = True
+        warnings.warn(
+            'parallel.init_multihost is deprecated: call '
+            'parallel.init_distributed(coordinator_address=..., '
+            'num_processes=..., process_id=...) — the multi-process init '
+            'of the first-class GSPMD path (docs/parallel.md, '
+            'docs/migration.md). init_multihost remains only for '
+            'PADDLE_TRAINER_* env-driven launchers.',
+            DeprecationWarning, stacklevel=2)
     if coordinator_address is None:
         eps = os.environ.get('PADDLE_TRAINER_ENDPOINTS', '')
         if eps:

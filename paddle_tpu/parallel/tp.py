@@ -21,7 +21,7 @@ import re
 
 from jax.sharding import PartitionSpec as P
 
-__all__ = ['auto_tp_rules']
+__all__ = ['auto_tp_rules', 'annotate_tp']
 
 # ops through which a tp-sharded last (hidden) dim propagates unchanged
 _PASSTHRU = {
@@ -92,3 +92,31 @@ def auto_tp_rules(program, axis='tp'):
         # the hidden sharding: its outputs are treated as full
 
     return rules
+
+
+def annotate_tp(program, axis='tp'):
+    """Stamp auto_tp_rules onto the Program as first-class sharding
+    annotations (docs/parallel.md): each matched parameter gets
+    ``var.sharding`` set to its Megatron layout, so the tp strategy is a
+    property of the Program — carried through clone/serialization,
+    checked by ``fluid.analysis.sharding``, and lowered by plain
+    ``Executor.run``/``run_bundle`` once the program declares a mesh with
+    the axis (``program.set_mesh({'dp': N, 'tp': M})``). The
+    array-placement path (shard_params_by_rules over a live scope)
+    remains for scopes loaded outside the Program's lifecycle.
+
+    Returns {param_name: spec tuple} for what was annotated. First
+    matching rule wins, mirroring shard_params_by_rules precedence; an
+    explicit pre-existing annotation is never overwritten."""
+    rules = auto_tp_rules(program, axis=axis)
+    annotated = {}
+    for blk in program.blocks:
+        for v in blk.vars.values():
+            if not getattr(v, 'persistable', False) or v.sharding:
+                continue
+            for pat, spec in rules:
+                if re.search(pat, v.name):
+                    v.sharding = tuple(spec)
+                    annotated[v.name] = v.sharding
+                    break
+    return annotated

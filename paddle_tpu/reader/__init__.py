@@ -7,7 +7,7 @@ from threading import Condition, Thread
 __all__ = [
     'map_readers', 'buffered', 'compose', 'chain', 'shuffle',
     'ComposeNotAligned', 'firstn', 'xmap_readers', 'Fake', 'cache',
-    'PipeReader', 'fault_tolerant',
+    'PipeReader', 'fault_tolerant', 'shard',
 ]
 
 from . import pipeline  # noqa: F401
@@ -36,6 +36,38 @@ def shuffle(reader, buf_size):
             random.shuffle(block)
             yield from block
     return data_reader
+
+
+def shard(reader, num_shards, shard_id):
+    """Per-host reader sharding for the multi-process GSPMD runtime
+    (docs/parallel.md): host `shard_id` of `num_shards` sees every
+    num_shards-th sample (round-robin by stream index), so the hosts'
+    slices partition the stream without coordination and — batched with
+    the same batch size — reassemble into the global batch the Executor
+    builds via `parallel.global_batch`. Deterministic over a
+    deterministic source; compose as
+    ``paddle.batch(reader.shard(base, n_hosts, host_id), bs_per_host)``.
+
+    Samples beyond the last complete round are DROPPED (not yielded to
+    any shard): an uneven tail would give the hosts different step
+    counts, deadlocking the collective at the shorter host's last step.
+    """
+    num_shards = int(num_shards)
+    shard_id = int(shard_id)
+    if num_shards < 1:
+        raise ValueError('num_shards must be >= 1, got %d' % num_shards)
+    if not 0 <= shard_id < num_shards:
+        raise ValueError('shard_id %d out of range for %d shard(s)'
+                         % (shard_id, num_shards))
+
+    def sharded_reader():
+        it = iter(reader())
+        while True:
+            block = list(itertools.islice(it, num_shards))
+            if len(block) < num_shards:
+                return   # incomplete round: dropped on every host
+            yield block[shard_id]
+    return sharded_reader
 
 
 def chain(*readers):

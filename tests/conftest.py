@@ -47,6 +47,7 @@ _SLOW_TESTS = {
     'test_examples.py::test_parallelism_example',
     'test_fluid_benchmark.py::test_transformer_model_with_sequence_parallel',
     'test_parallel.py::test_dryrun_multichip',
+    'test_parallel.py::test_three_way_composition_compiles_remat_free',
     'test_pipeline_fluid.py::test_pipeline_transformer_matches_sequential',
     'test_nhwc.py::test_resnet18_nhwc_matches_nchw',
     'test_pipeline_fluid.py::test_pipeline_multi_layer_stages',

@@ -12,7 +12,8 @@ from paddle_tpu.fluid import analysis, framework, layers, lowering
 from paddle_tpu.fluid.analysis import donation
 from paddle_tpu.fluid.analysis.findings import (
     DANGLING_INPUT, DEAD_OP, DONATION_UNSAFE, DTYPE_MISMATCH,
-    SCOPE_RACE, SHAPE_MISMATCH, UNREACHABLE_FETCH, USE_BEFORE_WRITE,
+    SCOPE_RACE, SHAPE_MISMATCH, SHARDING_INVALID, SHARDING_RESHARD,
+    SHARDING_UNTILEABLE, UNREACHABLE_FETCH, USE_BEFORE_WRITE,
     WRITE_TO_FEED)
 
 from util import fresh_program
@@ -531,3 +532,142 @@ def test_every_book_model_verifies_clean(name):
         assert fs == [], '%s main program: %s' % (name, fs)
         assert analysis.analyze(startup) == [], '%s startup' % name
         assert stats['no_rule'] == 0, stats
+
+
+# ------------------------------------------------------- sharding pass
+
+class TestShardingPass:
+    """fluid.analysis.sharding: GSPMD annotation consistency checked
+    ahead of lowering, the same posture as donation safety
+    (docs/parallel.md)."""
+
+    @staticmethod
+    def _annotated(spec=(None, 'model'), mesh={'dp': 2, 'model': 4}):
+        x = layers.data(name='x', shape=[16], dtype='float32')
+        h = layers.fc(input=x, size=32,
+                      param_attr=fluid.ParamAttr(sharding=spec))
+        prog = fluid.default_main_program()
+        if mesh:
+            prog.set_mesh(mesh)
+        return h
+
+    def test_clean_annotated_program_has_zero_findings(self):
+        with fresh_program() as (main, _):
+            out = self._annotated()
+            assert analysis.analyze(main, fetches=[out.name]) == []
+
+    def test_unknown_axis_is_error_with_annotation_provenance(self):
+        with fresh_program() as (main, _):
+            self._annotated(spec=(None, 'tp'))
+            fs = [f for f in analysis.analyze(main)
+                  if f.kind == SHARDING_INVALID]
+            assert len(fs) == 1 and fs[0].severity == 'error'
+            assert "'tp'" in fs[0].message
+            # provenance: the layer call that declared the spec, not a
+            # producer op (params have none in the main program)
+            assert fs[0].callsite and 'test_analysis.py' in fs[0].callsite
+
+    def test_axis_reuse_and_excess_entries_are_errors(self):
+        with fresh_program() as (main, _):
+            self._annotated(spec=('model', 'model'))
+            assert [f.kind for f in analysis.analyze(main)] \
+                == [SHARDING_INVALID]
+        with fresh_program() as (main, _):
+            self._annotated(spec=(None, 'model', None))   # 2-D var
+            fs = analysis.analyze(main)
+            assert [f.kind for f in fs] == [SHARDING_INVALID]
+            assert '3 entries' in fs[0].message
+
+    def test_untileable_dim_is_error(self):
+        with fresh_program() as (main, _):
+            # fc weight is [16, 32]; 'model' axis size 5 cannot tile 32
+            self._annotated(spec=(None, 'model'),
+                            mesh={'dp': 1, 'model': 5})
+            fs = [f for f in analysis.analyze(main)
+                  if f.kind == SHARDING_UNTILEABLE]
+            assert len(fs) == 1
+            assert 'not divisible' in fs[0].message
+
+    def test_annotation_without_mesh_is_inert_warning(self):
+        with fresh_program() as (main, _):
+            self._annotated(mesh=None)
+            fs = [f for f in analysis.analyze(main)
+                  if f.kind == SHARDING_INVALID]
+            assert len(fs) == 1 and fs[0].severity == 'warning'
+            assert 'declares no' in fs[0].message
+
+    def test_mesh_axes_override_lints_deployment_mesh(self):
+        """program_lint --mesh: the same annotated program is clean on
+        its own mesh but fails against a deployment mesh without the
+        'model' axis."""
+        with fresh_program() as (main, _):
+            out = self._annotated()
+            assert analysis.analyze(main, fetches=[out.name]) == []
+            fs = analysis.analyze(main, fetches=[out.name],
+                                  mesh_axes=[('dp', 8)])
+            assert [f.kind for f in fs] == [SHARDING_INVALID]
+
+    def test_pipeline_stage_annotation_mismatch_is_reshard_warning(self):
+        with fresh_program() as (main, _):
+            x = layers.data(name='x', shape=[8], dtype='float32')
+            a = layers.fc(input=x, size=8, bias_attr=False,
+                          param_attr=fluid.ParamAttr(
+                              name='stage0.w', sharding=('model',)))
+            layers.fc(input=a, size=8, bias_attr=False,
+                      param_attr=fluid.ParamAttr(name='stage1.w'))
+            main.set_mesh({'model': 8})
+            # the pipeline transpiler's stacked-parameter manifest
+            main._pipeline_config = {
+                'param_names': [['stage0.w'], ['stage1.w']]}
+            fs = [f for f in analysis.analyze(main)
+                  if f.kind == SHARDING_RESHARD]
+            assert len(fs) == 1 and fs[0].severity == 'warning'
+            assert 'stage-0 peer' in fs[0].message
+
+
+def test_program_lint_mesh_flag_one_json_document(tmp_path):
+    """tools/program_lint.py --mesh AXESxSIZES: lints a saved artifact's
+    annotations against a deployment mesh; --json stays ONE parseable
+    document carrying the mesh context."""
+    import importlib.util
+    import io as _io
+    from contextlib import redirect_stdout
+
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[16], dtype='float32')
+        pred = layers.fc(input=x, size=32,
+                         param_attr=fluid.ParamAttr(sharding=(None, 'model')))
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        d = str(tmp_path / 'm')
+        fluid.io.save_inference_model(d, ['x'], [pred], exe,
+                                      main_program=main)
+
+    spec = importlib.util.spec_from_file_location(
+        'program_lint', os.path.join(os.path.dirname(__file__), '..',
+                                     'tools', 'program_lint.py'))
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+
+    def run(argv):
+        buf = _io.StringIO()
+        with redirect_stdout(buf):
+            rc = lint.main(argv)
+        return rc, buf.getvalue()
+
+    # fits: dp x model mesh tiles the [16, 32] weight
+    rc, out = run([d, '--mesh', 'dpx2,modelx4', '--json'])
+    doc = json.loads(out)
+    assert rc == 0
+    assert doc['mesh'] == {'dp': 2, 'model': 4}
+    assert doc['findings'] == []
+    # deployment mesh without the axis: structured error finding
+    rc, out = run([d, '--mesh', 'dpx8', '--json'])
+    doc = json.loads(out)
+    assert rc == 1
+    assert [f['kind'] for f in doc['findings']] == [SHARDING_INVALID]
+    # NAME=SIZE spelling accepted; malformed spec is usage error
+    rc, _ = run([d, '--mesh', 'dp=2,model=4'])
+    assert rc == 0
+    rc, _ = run([d, '--mesh', 'dp-8'])
+    assert rc == 2

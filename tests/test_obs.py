@@ -269,7 +269,8 @@ def test_cache_stats_and_table_header():
         assert exe.cache_stats == {'hits': 0, 'misses': 0, 'entries': 0,
                                    'evictions': 0, 'persistent_hits': 0,
                                    'compile_cache_dir': None,
-                                   'last_compile_seconds': None}
+                                   'last_compile_seconds': None,
+                                   'remat_detected': 0}
         exe.run(startup)
         xb, yb = _housing_batch()
         for _ in range(3):

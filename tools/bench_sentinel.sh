@@ -40,14 +40,16 @@ def add(out, obj):
     # the flat summary (summary:true, headline metric/value only —
     # a driver wrapper keeps just that last line). setdefault keeps the
     # per-metric line's value when both were seen. Each entry carries
-    # (value, platform) so cross-platform comparisons can be refused.
+    # (value, platform, mesh_shape) so comparisons across platforms OR
+    # mesh shapes (a dp=8 gspmd number vs a dp=2 one) can be refused.
     if not isinstance(obj, dict):
         return
     for m in obj.get('metrics') or []:       # legacy nested summary
         add(out, m)
     if obj.get('metric') and obj.get('value') is not None:
         out.setdefault(obj['metric'],
-                       (float(obj['value']), obj.get('platform')))
+                       (float(obj['value']), obj.get('platform'),
+                        obj.get('mesh_shape')))
 
 def metrics_of(path):
     """Per-metric values from either format: raw bench stdout (one JSON
@@ -85,14 +87,22 @@ if not rounds or not new:
 prev_path = rounds[-1]
 prev = metrics_of(prev_path)
 for name in sorted(set(new) & set(prev)):
-    nv, nplat = new[name]
-    pv, pplat = prev[name]
+    nv, nplat, nmesh = new[name]
+    pv, pplat, pmesh = prev[name]
     if nplat and pplat and nplat != pplat:
         # a CPU-fallback round vs an accelerator round is not a perf
         # signal — refuse the comparison instead of printing a bogus
         # 1000x "regression" (BENCH_r01 accelerator vs BENCH_r05 CPU)
         print('[compare] %s: REFUSED — platform mismatch (%s vs %s from '
               '%s); values are not comparable' % (name, nplat, pplat,
+                                                  prev_path))
+        continue
+    if nmesh != pmesh:
+        # same rule for mesh shape: a gspmd steps/s at dp=8 vs dp=2 (or
+        # vs a pre-gspmd record with no mesh at all) is a topology
+        # change, not a perf delta
+        print('[compare] %s: REFUSED — mesh mismatch (%s vs %s from '
+              '%s); values are not comparable' % (name, nmesh, pmesh,
                                                   prev_path))
         continue
     ratio = nv / pv if pv else float('inf')

@@ -148,6 +148,17 @@ if [ "${OPT:-0}" = 1 ]; then
       --platform "${BENCH_PLATFORM:-tpu}"
 fi
 
+# 8b. pod-scale GSPMD phase (opt-in: GSPMD=1): the annotated Program at
+#     dp=N over every visible device vs single-device, through plain
+#     Executor.run (no strategy wrapper) — fit_a_line (host-bound
+#     honesty metric) + mnist_mlp (batch-bound scale-out metric), each
+#     record stamped with mesh shape + platform + host_cores
+#     (docs/parallel.md).
+if [ "${GSPMD:-0}" = 1 ]; then
+  run python bench.py --phase gspmd \
+      --platform "${BENCH_PLATFORM:-tpu}"
+fi
+
 # 9. serving engine vs sequential Predictor (opt-in: SERVE=1). Closed
 #    loop at the acceptance concurrency, then an open-loop arrival test;
 #    --check-compiles fails the command if steady state compiled, which

@@ -28,6 +28,25 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
 
+def _parse_mesh(text):
+    """'dpx8,modelx2' (NAMExSIZE) or 'dp=8,model=2' -> ordered
+    [(name, size)] pairs, or None on a malformed spec. Same validity
+    rules as Program.set_mesh (size >= 1, no duplicate axes) — the
+    override bypasses set_mesh, so it must not admit a mesh set_mesh
+    would reject (a 0-size axis ZeroDivisionErrors the tiling check)."""
+    import re
+    out = []
+    seen = set()
+    for tok in text.split(','):
+        tok = tok.strip()
+        m = re.match(r'^([A-Za-z_]\w*?)(?:x|=)(\d+)$', tok)
+        if not m or int(m.group(2)) < 1 or m.group(1) in seen:
+            return None
+        seen.add(m.group(1))
+        out.append((m.group(1), int(m.group(2))))
+    return out or None
+
+
 def _load_meta(path):
     if os.path.isdir(path):
         path = os.path.join(path, '__model__.json')
@@ -48,6 +67,13 @@ def main(argv=None):
     ap.add_argument('--concurrent', action='store_true',
                     help='lint for concurrent shared-scope serving '
                          '(arms the scope-race pass)')
+    ap.add_argument('--mesh', default=None, metavar='AXESxSIZES',
+                    help='lint the sharding annotations against this '
+                         'mesh spec instead of the artifact\'s own, '
+                         'e.g. "dpx8" or "dpx2,modelx4" (NAMExSIZE, '
+                         'comma-separated; NAME=SIZE also accepted) — '
+                         'the deployment mesh a saved Program is about '
+                         'to run on')
     ap.add_argument('--strict', action='store_true',
                     help='exit 1 on warnings too, not just errors')
     ap.add_argument('--optimize', nargs='?', const='default',
@@ -68,12 +94,21 @@ def main(argv=None):
               % (args.artifact, type(e).__name__, e), file=sys.stderr)
         return 2
 
+    mesh_axes = None
+    if args.mesh:
+        mesh_axes = _parse_mesh(args.mesh)
+        if mesh_axes is None:
+            print('program_lint: cannot parse --mesh %r (expected e.g. '
+                  '"dpx8" or "dpx2,modelx4")' % args.mesh, file=sys.stderr)
+            return 2
+
     from paddle_tpu.fluid import analysis
     feeds = meta.get('feed_names') or None
     fetches = args.fetch or meta.get('fetch_names') or None
     stats = {}
     findings = analysis.analyze(program, feeds=feeds, fetches=fetches,
-                                concurrent=args.concurrent, stats=stats)
+                                concurrent=args.concurrent, stats=stats,
+                                mesh_axes=mesh_axes)
 
     opt_payload = None
     if args.optimize:
@@ -93,20 +128,26 @@ def main(argv=None):
 
     if args.json:
         # ONE parseable document: a bare findings array (the historical
-        # shape) unless --optimize adds its report, in which case both
-        # ride one object
-        if opt_payload is None:
+        # shape) unless --optimize/--mesh add their context, in which
+        # case everything rides one object
+        if opt_payload is None and mesh_axes is None:
             print(json.dumps([f.to_dict() for f in findings], indent=2))
         else:
-            report, plan = opt_payload
-            print(json.dumps({
-                'findings': [f.to_dict() for f in findings],
-                'optimize': report.to_dict(),
-                'memory_plan': plan.to_dict()}, indent=2))
+            doc = {'findings': [f.to_dict() for f in findings]}
+            if mesh_axes is not None:
+                doc['mesh'] = {n: s for n, s in mesh_axes}
+            if opt_payload is not None:
+                report, plan = opt_payload
+                doc['optimize'] = report.to_dict()
+                doc['memory_plan'] = plan.to_dict()
+            print(json.dumps(doc, indent=2))
     else:
         nops = sum(len(b.ops) for b in program.blocks)
         print('%s: %d block(s), %d op(s); feeds=%s fetches=%s'
               % (path, program.num_blocks, nops, feeds, fetches))
+        if mesh_axes is not None:
+            print('sharding pass: linted against mesh %s'
+                  % 'x'.join('%s=%d' % a for a in mesh_axes))
         print('shape pass: %(inferred)d inferred, %(skipped)d skipped, '
               '%(failed)d failed, %(no_rule)d without rules' % stats)
         if not findings:
