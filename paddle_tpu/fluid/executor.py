@@ -1099,6 +1099,124 @@ class FetchHandle(object):
         return 'FetchHandle(%s)' % state
 
 
+class StepHandle(object):
+    """Pinned low-overhead driver for ONE compiled (program, feed-sig,
+    fetch) step — the continuous-batching decode engine's hot loop
+    (paddle_tpu.serving.decode) calls the same jitted module thousands of
+    times per second with per-slot donated state, and `run()`'s per-call
+    work (feed placement, cache key derivation, persist re-collection
+    from the scope, fetch conversion, step spans) would dominate the
+    step itself. `Executor.acquire_step` resolves all of that ONCE:
+
+      * the donated (written) persistables live as device arrays INSIDE
+        the handle between calls and are donated to every step — the
+        memory plan's in-place state update, with zero per-call scope
+        walks. The scope is kept in sync after each step, so
+        `save_inference_model`/tools reading the scope always see the
+        live arrays, never a donated (invalidated) buffer;
+      * read-only persistables (weights) and the feed signature are
+        fixed at acquire time; `step()` takes pre-placed feed arrays (or
+        nothing) and returns the raw device-side fetches — the caller
+        decides when to pay the host sync;
+      * the first call still classifies compile-vs-persistent-hit via
+        the executor's timed-first-call probe, so warmup telemetry
+        (executor.compile spans, cache_stats) is identical to run()'s.
+        Steady-state calls record NO per-step run-log events (a decode
+        loop would write thousands of span records per second); the
+        `executor.handle.steps` counter carries the volume instead.
+
+    Programs that CREATE persistables (startup-style) are rejected at
+    acquire: the donated pytree structure must be stable across calls.
+    RNG-consuming ops see a fixed key unless `seed` is passed per call.
+    """
+
+    __slots__ = ('_exe', '_compiled', '_scope', '_program', '_donated',
+                 '_readonly', '_key', '_first', 'steps', 'key_id')
+
+    _C_STEPS = None   # registry counter, created lazily on first handle
+
+    def __init__(self, exe, compiled, scope, program, persist, key_id):
+        self._exe = exe
+        self._compiled = compiled
+        self._scope = scope
+        self._program = program
+        donated, readonly = compiled.plan.split(persist)
+        self._donated = donated
+        self._readonly = readonly
+        self._key = jax.random.key(0)
+        # a compiled step already first-called via run() (warmup) needs
+        # no compile-classification probe here
+        self._first = not getattr(compiled, '_obs_compiled', False)
+        self.steps = 0
+        self.key_id = key_id
+        if StepHandle._C_STEPS is None:
+            StepHandle._C_STEPS = obs.counter('executor.handle.steps')
+
+    @property
+    def state(self):
+        """Merged name -> device array view of the step's persistable
+        state (donated + read-only). Mutate via set_state."""
+        view = dict(self._readonly)
+        view.update(self._donated)
+        return view
+
+    def set_state(self, name, value):
+        """Replace one persistable between steps (the decode engine's
+        slot join: row-scatter a fresh request's state into the pool).
+        Routes to the donated or read-only dict and keeps the scope in
+        sync."""
+        if name in self._donated:
+            self._donated[name] = value
+        elif name in self._readonly:
+            self._readonly[name] = value
+        else:
+            raise KeyError('no persistable %r in this step (have %r)'
+                           % (name, sorted(self._donated)
+                              + sorted(self._readonly)))
+        self._scope._chain_set(name, value)
+
+    def step(self, feed=None, seed=None):
+        """One execution; returns the raw fetch list (device arrays, in
+        acquire-time fetch_list order). `feed` must match the
+        acquire-time signature exactly (pre-placed arrays; None for a
+        feedless step program)."""
+        # the handle OWNS the donated persistables between calls; if
+        # another path (run()/run_bundle/a second handle) drove the same
+        # (program, scope) meanwhile, it re-collected and donated the
+        # scope buffers this handle still points at — the next dispatch
+        # would die with an opaque deleted-buffer error (on real chips)
+        # or silently diverge from the scope (CPU, where donation is a
+        # no-op). Scope identity is the platform-independent tell.
+        for n, v in self._donated.items():
+            if self._scope._chain_get(n) is not v:
+                raise RuntimeError(
+                    'StepHandle state invalidated: persistable %r was '
+                    'rewritten in the scope by another execution path '
+                    '(run()/run_bundle/another handle) since the last '
+                    'step — a pinned handle must be the only driver of '
+                    'its (program, scope); re-acquire_step() to resume'
+                    % n)
+        key = self._key if seed is None else jax.random.key(
+            np.uint32(int(seed) % (1 << 32)))
+        args = (self._donated, self._readonly, feed or {}, key)
+        if self._first:
+            (fetches, new_persist, health), _ = \
+                self._exe._timed_first_call(
+                    self._compiled._jitted, args, self.key_id, handle=True)
+            self._compiled._obs_compiled = True
+            self._first = False
+        else:
+            fetches, new_persist, health = self._compiled._jitted(*args)
+        for n, v in new_persist.items():
+            self._donated[n] = v
+            self._scope._chain_set(n, v)
+        if health is not None:
+            self._exe._observe_health(self._program, health)
+        self.steps += 1
+        StepHandle._C_STEPS.inc()
+        return fetches
+
+
 class Executor(object):
     """Parity: reference python/paddle/fluid/executor.py:256."""
 
@@ -1890,6 +2008,37 @@ class Executor(object):
                                            sync == 'async')
                        for v in fetches]
         return out
+
+    def acquire_step(self, program=None, feed=None, fetch_list=None,
+                     scope=None):
+        """Resolve (program, feed-sig, fetch) ONCE and return a pinned
+        StepHandle whose repeated `.step()` calls skip the per-run
+        prepare pass entirely — the hot-loop entry point for per-step
+        state machines like the continuous-batching decode engine
+        (docs/serving.md). `feed` is an EXAMPLE fixing the signature
+        (may be empty/None for a feedless state-update program); the
+        donated persistable state is held inside the handle between
+        calls (in-place updates per the memory plan) with the scope kept
+        in sync. The compiled module is the same one run() would build
+        and lives in the same cache (warmup via run() or a prior handle
+        carries over; `cache_stats` counts the single lookup)."""
+        if program is None:
+            program = default_main_program()
+        if scope is None:
+            scope = global_scope()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        compiled, feed_vals, persist = self._prepare(
+            program, feed, fetch_list, scope)
+        gap = compiled.plan.uninitialized(compiled.persist_in)
+        if gap:
+            raise ValueError(
+                'acquire_step: program writes persistable(s) %r that have '
+                'no scope value yet — a handle needs a stable donated '
+                'state structure; run the startup program first' % gap)
+        look = self._last_cache_lookup or {}
+        return StepHandle(self, compiled, scope, program, persist,
+                          look.get('key'))
 
     def _convert_fetch(self, v, fetch_f32, return_numpy, lazy):
         """One fetched value -> what run()/run_bundle() hand back: numpy /

@@ -21,6 +21,8 @@ feeds, and consumed/emitted by the beam_search / sequence_expand /
 lod_reset / is_empty branches below. `beam_search_decode` backtraces the
 LoDTensorArrays exactly like the reference's host walk, on device.
 """
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -294,3 +296,102 @@ def beam_search_decode_arrays(ids_arr, scores_arr, beam_size, end_id):
     sent_scores = SeqValue(sc_f.astype(jnp.float32), nt,
                            (n_hyp.astype(jnp.int32),), beam_cap=True)
     return sent_ids, sent_scores
+
+
+# ---------------------------------------------------------------------------
+# step-form decode: the whole-sequence While body factored into ONE reusable
+# beam step (serving/decode.py's continuous-batching engine drives it slot by
+# slot; sampled_ops' attention_lstm_beam_decode scans it whole-sequence — one
+# definition, so the two paths are fetch-equivalent by construction)
+# ---------------------------------------------------------------------------
+
+def beam_init_carry(rows, beam, hidden, start_id, dtype=jnp.float32):
+    """Fresh decode carry for `rows` sources at beam width `beam`, flat
+    [rows*beam, ...] layout: zero LSTM state, start_id everywhere, and only
+    beam 0 live in the accumulated scores so the first top-k doesn't pick
+    `beam` copies of the same candidate."""
+    n = rows * beam
+    neg = jnp.finfo(jnp.float32).min
+    return (jnp.zeros((n, hidden), dtype),
+            jnp.zeros((n, hidden), dtype),
+            jnp.full((n,), start_id, jnp.int32),
+            jnp.where(jnp.arange(n) % beam == 0, 0.0, neg),
+            jnp.zeros((n,), bool))
+
+
+def attention_beam_step(params, enc_t, mask_t, carry, beam, end_id):
+    """One attend -> LSTM cell -> project -> joint top-k -> reorder beam
+    step on flat [B*beam, ...] rows (every row is independent: no
+    cross-row reduction ever mixes two sources, which is what lets the
+    continuous-batching engine pack unrelated slots into one module and
+    mask the dead ones).
+
+    params: (w_dec [E+D,4H], u_dec [H,4H], b_dec, w_q [H,D], w_emb [V,E],
+    w_out [H,V], b_out); enc_t [B*beam, S, D] (source rows repeated per
+    beam); mask_t [B*beam, S] 1/0; carry = (h, c, prev_ids, acc, fin) as
+    built by beam_init_carry. Returns (carry', (sel_ids [B, beam],
+    parent [B, beam] local beam index, top_scores [B, beam]))."""
+    w_dec, u_dec, b_dec, w_q, w_emb, w_out, b_out = params
+    hp, cp, prev_ids, acc, fin = carry
+    Bb = hp.shape[0]
+    B = Bb // beam
+    V = w_out.shape[1]
+    neg = jnp.finfo(jnp.float32).min
+
+    x_t = jnp.take(w_emb, prev_ids, axis=0)          # [Bb, E]
+    q = hp @ w_q
+    scores = jnp.einsum('bd,bsd->bs', q, enc_t)
+    scores = jnp.where(mask_t > 0, scores, neg)
+    alpha = jax.nn.softmax(scores, axis=-1)
+    ctx_vec = jnp.einsum('bs,bsd->bd', alpha, enc_t)
+    g = jnp.concatenate([x_t, ctx_vec], -1) @ w_dec + hp @ u_dec + b_dec
+    gi, gf, gc, go = jnp.split(g, 4, axis=-1)
+    c_new = jax.nn.sigmoid(gf) * cp + \
+        jax.nn.sigmoid(gi) * jnp.tanh(gc)
+    h_new = jax.nn.sigmoid(go) * jnp.tanh(c_new)
+
+    logp = jax.nn.log_softmax(
+        (h_new @ w_out + b_out).astype(jnp.float32), axis=-1)
+    cand = acc[:, None] + logp                        # [Bb, V]
+    # finished beams: single end_id candidate carrying score forward
+    onehot_end = (jnp.arange(V)[None, :] == end_id)
+    cand = jnp.where(fin[:, None],
+                     jnp.where(onehot_end, acc[:, None], neg), cand)
+
+    flat = cand.reshape(B, beam * V)
+    top_scores, top_pos = lax.top_k(flat, beam)       # [B, beam]
+    parent = (top_pos // V).astype(jnp.int32)         # [B, beam]
+    sel_ids = (top_pos % V).astype(jnp.int32)
+    gidx = (parent + beam * jnp.arange(B)[:, None]).reshape(Bb)
+
+    h_new = jnp.take(h_new, gidx, axis=0)
+    c_new = jnp.take(c_new, gidx, axis=0)
+    new_ids = sel_ids.reshape(Bb)
+    new_acc = top_scores.reshape(Bb)
+    new_fin = jnp.take(fin, gidx) | (new_ids == end_id)
+    return (h_new, c_new, new_ids, new_acc, new_fin), \
+        (sel_ids, parent, top_scores)
+
+
+def backtrace_beams(ids_seq, par_seq):
+    """Host-side backtrace of one source's per-step beams — the exact
+    numpy transcription of the whole-sequence op's in-graph `back` scan
+    (sampled_ops._attention_lstm_beam_decode), run per slot by the
+    continuous engine when the slot releases.
+
+    ids_seq/par_seq: [L, beam] selected token / local parent per step.
+    Returns int token matrix [beam, L] in forward order. Steps past the
+    point where every beam finished contribute end_id tokens and identity
+    parents (that is literally what the fused scan emits there — acc is
+    already sorted descending by construction, so its tail top-k is the
+    identity permutation), so truncating at release and padding with
+    end_id reproduces the lockstep output bit for bit."""
+    ids_seq = np.asarray(ids_seq)
+    par_seq = np.asarray(par_seq)
+    L, beam = ids_seq.shape
+    ptr = np.arange(beam)
+    toks = np.empty((L, beam), ids_seq.dtype)
+    for t in range(L - 1, -1, -1):
+        toks[t] = ids_seq[t][ptr]
+        ptr = par_seq[t][ptr]
+    return toks.T

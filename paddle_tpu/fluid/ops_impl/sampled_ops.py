@@ -186,58 +186,24 @@ def _attention_lstm_beam_decode(ins, attrs, ctx):
     end_id = int(attrs['end_id'])
     B, S, D = enc_data.shape
     H = u_dec.shape[0]
-    V = w_out.shape[1]
-    Bb = B * beam
-    neg = jnp.finfo(jnp.float32).min
 
     enc_t = jnp.repeat(enc_data, beam, axis=0)           # [Bb, S, D]
     mask_t = jnp.repeat(enc_mask, beam, axis=0)
+    params = (w_dec, u_dec, b_dec, w_q, w_emb, w_out, b_out)
 
-    h0 = jnp.zeros((Bb, H), enc_data.dtype)
-    c0 = jnp.zeros((Bb, H), enc_data.dtype)
-    ids0 = jnp.full((Bb,), start_id, jnp.int32)
-    # only beam 0 live at t=0 so the first top-k doesn't pick duplicates
-    acc0 = jnp.where(jnp.arange(Bb) % beam == 0, 0.0, neg)
-    fin0 = jnp.zeros((Bb,), bool)
+    # the scan body IS the step-form decode (lod_beam.attention_beam_step)
+    # the continuous-batching engine drives slot by slot — one definition,
+    # so serving/decode.py's per-step path and this fused whole-sequence
+    # scan are fetch-equivalent by construction
+    from .lod_beam import attention_beam_step, beam_init_carry
 
     def step(carry, _):
-        hp, cp, prev_ids, acc, fin = carry
-        x_t = jnp.take(w_emb, prev_ids, axis=0)          # [Bb, E]
-        q = hp @ w_q
-        scores = jnp.einsum('bd,bsd->bs', q, enc_t)
-        scores = jnp.where(mask_t > 0, scores, neg)
-        alpha = jax.nn.softmax(scores, axis=-1)
-        ctx_vec = jnp.einsum('bs,bsd->bd', alpha, enc_t)
-        g = jnp.concatenate([x_t, ctx_vec], -1) @ w_dec + hp @ u_dec + b_dec
-        gi, gf, gc, go = jnp.split(g, 4, axis=-1)
-        c_new = jax.nn.sigmoid(gf) * cp + \
-            jax.nn.sigmoid(gi) * jnp.tanh(gc)
-        h_new = jax.nn.sigmoid(go) * jnp.tanh(c_new)
-
-        logp = jax.nn.log_softmax(
-            (h_new @ w_out + b_out).astype(jnp.float32), axis=-1)
-        cand = acc[:, None] + logp                        # [Bb, V]
-        # finished beams: single end_id candidate carrying score forward
-        onehot_end = (jnp.arange(V)[None, :] == end_id)
-        cand = jnp.where(fin[:, None],
-                         jnp.where(onehot_end, acc[:, None], neg), cand)
-
-        flat = cand.reshape(B, beam * V)
-        top_scores, top_pos = lax.top_k(flat, beam)       # [B, beam]
-        parent = (top_pos // V).astype(jnp.int32)         # [B, beam]
-        sel_ids = (top_pos % V).astype(jnp.int32)
-        gidx = (parent + beam * jnp.arange(B)[:, None]).reshape(Bb)
-
-        h_new = jnp.take(h_new, gidx, axis=0)
-        c_new = jnp.take(c_new, gidx, axis=0)
-        new_ids = sel_ids.reshape(Bb)
-        new_acc = top_scores.reshape(Bb)
-        new_fin = jnp.take(fin, gidx) | (new_ids == end_id)
-        return (h_new, c_new, new_ids, new_acc, new_fin), \
-            (sel_ids, parent, top_scores)
+        return attention_beam_step(params, enc_t, mask_t, carry, beam,
+                                   end_id)
 
     (_, _, _, accN, _), (ids_seq, par_seq, sc_seq) = lax.scan(
-        step, (h0, c0, ids0, acc0, fin0), None, length=max_len)
+        step, beam_init_carry(B, beam, H, start_id, enc_data.dtype),
+        None, length=max_len)
 
     def back(beam_ptr, xs):
         ids_t, par_t = xs                                 # [B, beam]
@@ -250,6 +216,121 @@ def _attention_lstm_beam_decode(ins, attrs, ctx):
     sent = jnp.flip(jnp.transpose(toks_rev, (1, 2, 0)), -1)
     return {'SentenceIds': sent.astype(jnp.int64),
             'SentenceScores': accN.reshape(B, beam)}
+
+
+@register('attention_lstm_beam_decode_step')
+def _attention_lstm_beam_decode_step(ins, attrs, ctx):
+    """A BUNDLE of decode steps (attr `bundle`, default 1) over a fixed
+    pool of independent SLOTS — the step-form factoring of
+    `attention_lstm_beam_decode`'s scan body that the continuous-batching
+    engine (paddle_tpu.serving.decode) drives: sequences join/leave
+    between dispatches on the host while this op advances every ACTIVE
+    slot's beam state in place. bundle>1 runs that many steps inside one
+    XLA module (the PR 4 K-step-bundling move applied to decode: per-call
+    dispatch/sync cost is paid once per bundle, not once per token);
+    slots that finish mid-bundle freeze in-graph — their state, history
+    and step count stop advancing — so results are bit-identical to
+    bundle=1, only the host's release granularity coarsens.
+
+    State inputs (all persistable; written ones re-emitted under *Out so
+    the memory plan donates them — in-place HBM updates per step):
+      H, C        [slots, beam, hidden]   LSTM carry
+      PrevIds     [slots, beam] int32     last selected token per beam
+      Acc         [slots, beam] float32   accumulated log-probs
+      Fin         [slots, beam] bool      beam emitted end_id
+      IdsHist     [slots, max_len, beam]  int32 emitted tokens per step
+      ParHist     [slots, max_len, beam]  int32 parent pointers per step
+      Step        [slots] int32           steps taken by the occupant
+      Active      [slots] bool            slot occupied and decoding
+    Read-only state (not written, so not donated — no per-step copy):
+      Enc [slots, src_cap, D], Mask [slots, src_cap],
+      Limit [slots] int32 (per-request max decode length <= max_len).
+    Weights: same tensors as attention_lstm_beam_decode.
+
+    Outputs additionally expose Done [slots] (slot finished within THIS
+    bundle: all beams ended, its per-request limit hit, or poisoned) and
+    Bad [slots] (NaN detected in the slot's new scores — the
+    anomaly-guard where-select pattern: every state update is masked by
+    Active, so a dead or poisoned slot never perturbs a live one, and a
+    poisoned slot is released alone).
+    """
+    from .lod_beam import attention_beam_step
+
+    h = data_of(ins['H'][0])
+    c = data_of(ins['C'][0])
+    prev_ids = data_of(ins['PrevIds'][0]).astype(jnp.int32)
+    acc = data_of(ins['Acc'][0]).astype(jnp.float32)
+    fin = data_of(ins['Fin'][0]).astype(bool)
+    enc = data_of(ins['Enc'][0])
+    mask = data_of(ins['Mask'][0])
+    ids_hist = data_of(ins['IdsHist'][0]).astype(jnp.int32)
+    par_hist = data_of(ins['ParHist'][0]).astype(jnp.int32)
+    step = data_of(ins['Step'][0]).astype(jnp.int32)
+    limit = data_of(ins['Limit'][0]).astype(jnp.int32)
+    active_in = data_of(ins['Active'][0]).astype(bool)
+    params = (data_of(ins['WDec'][0]), data_of(ins['UDec'][0]),
+              data_of(ins['BDec'][0]) if ins.get('BDec') else 0.0,
+              data_of(ins['WAttnQ'][0]), data_of(ins['WEmb'][0]),
+              data_of(ins['WOut'][0]),
+              data_of(ins['BOut'][0]) if ins.get('BOut') else 0.0)
+
+    slots, beam = prev_ids.shape
+    t_cap = ids_hist.shape[1]
+    end_id = int(attrs['end_id'])
+    bundle = int(attrs.get('bundle', 1))
+
+    enc_t = jnp.repeat(enc, beam, axis=0)            # [slots*beam, S, D]
+    mask_t = jnp.repeat(mask, beam, axis=0)
+    flat = lambda a: a.reshape((slots * beam,) + a.shape[2:])
+    unflat = lambda a: a.reshape((slots, beam) + a.shape[1:])
+
+    def one_step(carry, _):
+        h, c, prev, acc, fin, ids_h, par_h, step, active, bad_acc = carry
+        new_carry, (sel_ids, parent, _top) = attention_beam_step(
+            params, enc_t, mask_t, (h, c, prev, acc, fin), beam, end_id)
+
+        # where-select masking (the anomaly guard's rollback pattern):
+        # only ACTIVE slots advance; everything else keeps its old state
+        # bit for bit, so joins/leaves between dispatches — and slots
+        # that finished EARLIER IN THE BUNDLE — never disturb live ones
+        act_row = jnp.repeat(active, beam)           # [slots*beam]
+        sel = lambda new, old: jnp.where(
+            act_row.reshape((-1,) + (1,) * (new.ndim - 1)), new, old)
+        h2, c2, ids2, acc2, fin2 = (
+            sel(new_carry[0], h), sel(new_carry[1], c),
+            sel(new_carry[2], prev), sel(new_carry[3], acc),
+            sel(new_carry[4], fin))
+
+        # per-slot history write at each slot's OWN step index
+        at_t = ((jnp.arange(t_cap)[None, :] == step[:, None])
+                & active[:, None])                   # [slots, t_cap]
+        ids_h2 = jnp.where(at_t[:, :, None], sel_ids[:, None, :], ids_h)
+        par_h2 = jnp.where(at_t[:, :, None], parent[:, None, :], par_h)
+        step2 = step + active.astype(jnp.int32)
+
+        acc_s = unflat(acc2)
+        fin_s = unflat(fin2)
+        bad_t = active & jnp.isnan(acc_s).any(axis=1)
+        done_t = active & (fin_s.all(axis=1) | (step2 >= limit) | bad_t)
+        return (h2, c2, ids2, acc2, fin2, ids_h2, par_h2, step2,
+                active & ~done_t, bad_acc | bad_t), None
+
+    carry0 = (flat(h), flat(c), flat(prev_ids), flat(acc), flat(fin),
+              ids_hist, par_hist, step, active_in,
+              jnp.zeros((slots,), bool))
+    if bundle == 1:
+        carry, _ = one_step(carry0, None)
+    else:
+        carry, _ = lax.scan(one_step, carry0, None, length=bundle)
+    (h2, c2, ids2, acc2, fin2, ids_hist2, par_hist2, step2, active2,
+     bad) = carry
+
+    return {'HOut': unflat(h2), 'COut': unflat(c2),
+            'PrevIdsOut': unflat(ids2), 'AccOut': unflat(acc2),
+            'FinOut': unflat(fin2), 'IdsHistOut': ids_hist2,
+            'ParHistOut': par_hist2, 'StepOut': step2,
+            'ActiveOut': active2, 'Done': active_in & ~active2,
+            'Bad': bad}
 
 
 @register('beam_search_decode')

@@ -153,6 +153,34 @@ class Histogram(_Instrument):
     def mean(self):
         return self.sum / self.count if self.count else None
 
+    def percentile_window(self, before, after, p):
+        """Estimated p-th percentile over ONLY the observations recorded
+        between two snapshot()s — a windowed view of this cumulative
+        histogram (serve_bench isolates one benchmark rep's TTFT this
+        way). None when the window is empty. Per-window min/max are not
+        tracked, so a rank landing in the overflow (+Inf) bucket reports
+        the last finite bound — a conservative floor — rather than
+        interpolating toward a lifetime max that may belong to an
+        observation OUTSIDE the window."""
+        if not 0 <= p <= 100:
+            raise ValueError('percentile must be in [0, 100], got %r' % p)
+        counts = [a[1] - b[1] for b, a in zip(before['buckets'],
+                                              after['buckets'])]
+        n = sum(counts)
+        if n <= 0:
+            return None
+        target = max(1, int(round(p / 100.0 * n)))
+        cum = 0
+        for i, c in enumerate(counts):
+            if c > 0 and cum + c >= target:
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                if i >= len(self.bounds):      # overflow bucket
+                    return self.bounds[-1]
+                hi = self.bounds[i]
+                return lo + (hi - lo) * ((target - cum) / float(c))
+            cum += c
+        return self.bounds[-1]
+
     def snapshot(self):
         with self._lock:
             s = self._base_snapshot()

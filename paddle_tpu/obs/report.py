@@ -414,6 +414,70 @@ def summarize(events):
                                       f.get('completed', '?'),
                                       f.get('shed', '?')))
 
+    # -- continuous-batching decode + router ------------------------------
+    dc_joins = _events(events, 'decode.join')
+    dc_rel = _events(events, 'decode.release')
+    dc_poison = _events(events, 'decode.poisoned')
+    dc_shed = _events(events, 'decode.shed')
+    dc_rej = _events(events, 'decode.reject')
+    dc_pferr = _events(events, 'decode.prefill.error')
+    dc_warm = _spans(events, 'decode.warmup')
+    dc_down = _events(events, 'decode.shutdown')
+    rt_swap = _events(events, 'router.swap')
+    rt_over = _events(events, 'router.overloaded')
+    if dc_joins or dc_rel or dc_poison or dc_shed or dc_rej or dc_down \
+            or dc_warm or dc_pferr:
+        lines.append('')
+        lines.append('-- decode --')
+        if dc_warm:
+            kinds = {}
+            for s in dc_warm:
+                k = s.get('fields', {}).get('kind', 'join')
+                kinds[k] = kinds.get(k, 0) + 1
+            lines.append('warmup: %s signature(s) pre-compiled'
+                         % ', '.join('%d %s' % (c, k)
+                                     for k, c in sorted(kinds.items())))
+        toks = [e.get('fields', {}).get('steps', 0) for e in dc_rel]
+        lines.append('slot lifecycle: joins: %d  released: %d  '
+                     'poisoned: %d' % (len(dc_joins), len(dc_rel),
+                                       len(dc_poison)))
+        if toks:
+            lines.append('tokens per released request: p50 %s  max %s  '
+                         '(total %d)'
+                         % (percentile_exact(toks, 50), max(toks),
+                            sum(toks)))
+        if dc_shed or dc_rej:
+            lines.append('overload: %d rejected, %d shed past deadline'
+                         % (len(dc_rej), len(dc_shed)))
+        for e in dc_pferr:
+            f = e.get('fields', {})
+            lines.append('  prefill ERROR (%s request(s)): %s'
+                         % (f.get('requests', '?'),
+                            str(f.get('error', ''))[:80]))
+        for e in dc_down:
+            f = e.get('fields', {})
+            lines.append('shutdown: drained=%s clean=%s completed=%s '
+                         'tokens=%s' % (f.get('drained', '?'),
+                                        f.get('clean', '?'),
+                                        f.get('completed', '?'),
+                                        f.get('tokens', '?')))
+    if rt_swap or rt_over:
+        lines.append('')
+        lines.append('-- router --')
+        for e in rt_swap:
+            f = e.get('fields', {})
+            lines.append('swap: model=%s -> version %s (%s replica(s))'
+                         % (f.get('model', '?'), f.get('version', '?'),
+                            f.get('replicas', '?')))
+        if rt_over:
+            by_model = {}
+            for e in rt_over:
+                m = e.get('fields', {}).get('model', '?')
+                by_model[m] = by_model.get(m, 0) + 1
+            lines.append('overloaded: %s'
+                         % ', '.join('%s x%d' % kv
+                                     for kv in sorted(by_model.items())))
+
     # -- bench ------------------------------------------------------------
     bench = _events(events, 'bench.metric') \
         + _events(events, 'bench.sweep.cmd')

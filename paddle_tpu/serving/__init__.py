@@ -20,9 +20,17 @@ See docs/serving.md; run the serving test tier with `pytest -m serving`.
 """
 from . import buckets  # noqa: F401
 from .buckets import default_buckets, pad_rows, pick_bucket  # noqa: F401
+from .decode import (DecodeConfig, DecodeEngine,  # noqa: F401
+                     DecodeSlotPoisoned, LockstepDecoder, mt_weights,
+                     program_prefill)
 from .engine import (DeadlineExceeded, ServerClosed,  # noqa: F401
                      ServerOverloaded, ServingConfig, ServingEngine)
+from .router import (ModelOverloaded, Router,  # noqa: F401
+                     UnknownModel)
 
 __all__ = ['ServingEngine', 'ServingConfig', 'ServerOverloaded',
            'ServerClosed', 'DeadlineExceeded', 'buckets',
-           'default_buckets', 'pick_bucket', 'pad_rows']
+           'default_buckets', 'pick_bucket', 'pad_rows',
+           'DecodeConfig', 'DecodeEngine', 'DecodeSlotPoisoned',
+           'LockstepDecoder', 'mt_weights', 'program_prefill',
+           'Router', 'ModelOverloaded', 'UnknownModel']

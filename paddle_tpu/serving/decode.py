@@ -1,0 +1,964 @@
+"""Continuous batching for autoregressive beam decode.
+
+The serving engine (engine.py) batches INDEPENDENT single-shot requests;
+an autoregressive decode request is a SEQUENCE of coupled steps, and
+whole-batch lockstep decode (`attention_lstm_beam_decode`: one fused
+lax.scan over max_len) makes every request in a batch pay the longest
+request's step count and makes new requests wait for the whole batch to
+drain. This module serves the same decoder with ORCA/vLLM-style
+iteration-level scheduling instead:
+
+  * a fixed-capacity SLOT POOL holds per-sequence decode state (token
+    buffer, beam scores, LSTM cache rows, encoder rows) as persistable
+    device arrays of shape [slots, ...];
+  * ONE jitted decode-step module (`attention_lstm_beam_decode_step`,
+    the lockstep scan body factored into step form — fetch-equivalent by
+    construction) advances every ACTIVE slot per call; active-slot
+    masking (`where`-select, the anomaly-guard pattern) keeps dead and
+    poisoned slots from perturbing live ones;
+  * per-sequence JOIN/LEAVE happens between steps on the host: a
+    finished sequence (all beams ended, or its per-request token limit
+    reached) releases its slot and resolves its Future immediately;
+    queued requests are admitted into free slots mid-flight — no
+    barrier, no lockstep drain;
+  * admission prefill (the encoder) runs in batches padded to
+    power-of-two BUCKETS (serving/buckets.py), and the step module has
+    exactly ONE signature, so the jit-signature set is closed and
+    `warmup()` leaves steady-state serving at ZERO compiles;
+  * the slot state is persistable and WRITTEN by the step op, so
+    `passes.memory_plan` donates exactly the state buffers — in-place
+    HBM updates per step, driven through `Executor.acquire_step`'s
+    pinned StepHandle (no per-step prepare pass).
+
+Observability: decode.slots.occupied / decode.queue.depth gauges,
+decode.step.seconds + decode.ttft.seconds histograms, join/release/
+poison events and token counters — `tools/obs_report.py` renders a
+decode section from them (docs/serving.md has the catalog and the slot
+lifecycle diagram).
+"""
+import collections
+import concurrent.futures
+import threading
+import time
+
+import numpy as np
+
+from .. import obs
+from . import buckets as _buckets
+from .engine import (DeadlineExceeded, ServerClosed, ServerOverloaded,
+                     _POLL_S)
+
+__all__ = ['DecodeConfig', 'DecodeEngine', 'DecodeSlotPoisoned',
+           'LockstepDecoder', 'mt_weights', 'program_prefill']
+
+WEIGHT_KEYS = ('w_dec', 'u_dec', 'b_dec', 'w_q', 'w_emb', 'w_out', 'b_out')
+
+# state carried per slot; written entries are donated in place by the
+# memory plan, read-only ones (enc/mask/limit) keep their buffers
+_WRITTEN_STATE = ('h', 'c', 'prev_ids', 'acc', 'fin', 'ids_hist',
+                  'par_hist', 'step', 'active')
+_READONLY_STATE = ('enc', 'mask', 'limit')
+
+
+class DecodeSlotPoisoned(RuntimeError):
+    """Non-finite values appeared in one slot's beam scores (a poisoned
+    feed / encoder fault). Only that slot's future receives this error;
+    the slot is freed and every other in-flight sequence is untouched
+    (the step's where-select masking isolates rows)."""
+
+
+class DecodeConfig(object):
+    """Slot-pool / admission policy for a DecodeEngine.
+
+    slots:        fixed capacity of the slot pool — the decode step
+                  module's batch dimension. Admission prefill buckets
+                  are the powers of two up to `slots`
+                  (serving/buckets.py), so the signature set is closed.
+    beam_size:    beam width per sequence.
+    max_len:      token-buffer capacity per slot; a request's
+                  max_new_tokens may not exceed it.
+    start_id/end_id: decode vocabulary sentinels (the lockstep op's
+                  attrs).
+    src_cap:      encoder-row capacity per slot ([src_cap, enc_dim]
+                  cache rows); prefill outputs are zero-padded to it.
+    bundle:       decode steps run INSIDE one dispatched module call
+                  (the PR 4 K-step-bundling move applied to decode:
+                  per-call dispatch/sync cost is paid once per bundle).
+                  Slots finishing mid-bundle freeze in-graph, so results
+                  are bit-identical to bundle=1; join/leave and release
+                  granularity coarsen to the bundle boundary (TTFT/
+                  tail-latency vs throughput knob).
+    queue_capacity / overflow / default_deadline_ms: admission control,
+                  same semantics as ServingConfig (typed
+                  ServerOverloaded / DeadlineExceeded).
+    """
+
+    def __init__(self, slots=8, beam_size=3, max_len=32, start_id=0,
+                 end_id=1, src_cap=16, bundle=1, queue_capacity=256,
+                 overflow='block', default_deadline_ms=None):
+        if overflow not in ('block', 'reject'):
+            raise ValueError("overflow must be 'block' or 'reject', got %r"
+                             % (overflow,))
+        if slots < 1:
+            raise ValueError('slots must be >= 1')
+        if max_len < 1 or src_cap < 1 or beam_size < 1:
+            raise ValueError('beam_size, max_len and src_cap must be >= 1')
+        if not 1 <= int(bundle) <= int(max_len):
+            raise ValueError('bundle must be in [1, max_len=%d], got %r'
+                             % (max_len, bundle))
+        self.bundle = int(bundle)
+        self.slots = int(slots)
+        self.beam_size = int(beam_size)
+        self.max_len = int(max_len)
+        self.start_id = int(start_id)
+        self.end_id = int(end_id)
+        self.src_cap = int(src_cap)
+        self.queue_capacity = int(queue_capacity)
+        self.overflow = overflow
+        self.default_deadline_ms = default_deadline_ms
+        self.admit_buckets = _buckets.default_buckets(self.slots)
+
+
+def mt_weights(scope, name='mt'):
+    """Collect the machine_translation decoder's weights from a trained
+    scope into the dict DecodeEngine takes (the step reuses the training
+    parameters by name, like models/machine_translation._beam_decode)."""
+    pick = lambda suffix: np.asarray(scope._chain_get(name + suffix))
+    return {'w_dec': pick('_w_dec'), 'u_dec': pick('_u_dec'),
+            'b_dec': pick('_b_dec'), 'w_q': pick('_w_attnq'),
+            'w_emb': pick('_trg_emb'), 'w_out': pick('_w_out'),
+            'b_out': pick('_b_out')}
+
+
+def program_prefill(executor, program, scope, feed_name, fetch,
+                    token_cap):
+    """Build a DecodeEngine prefill callable from an ENCODER Program
+    (e.g. the machine_translation generating program pruned at
+    `encoded_vector`). Each request feed is {feed_name: int token array
+    [L] or [L, 1]}; tokens are padded to `token_cap` rows so every
+    bucket size has exactly one feed signature. Returns
+    (enc [n, token_cap, D], src_len [n])."""
+    from ..fluid.lowering import SeqValue
+
+    def prefill(feeds):
+        toks, lens = [], []
+        for f in feeds:
+            t = np.asarray(f[feed_name]).reshape(-1)
+            if t.shape[0] > token_cap:
+                raise ValueError(
+                    'source of %d token(s) exceeds the prefill token cap '
+                    '%d' % (t.shape[0], token_cap))
+            lens.append(t.shape[0])
+            toks.append(np.pad(t, (0, token_cap - t.shape[0])))
+        data = np.stack(toks).astype(np.int64)[:, :, None]
+        sv = SeqValue(data, np.asarray(lens, np.int32))
+        out, = executor.run(program, feed={feed_name: sv},
+                            fetch_list=[fetch], scope=scope,
+                            return_numpy=False)
+        from ..fluid.lod_tensor import LoDTensor
+        if isinstance(out, LoDTensor):
+            out = out.to_seq_value(pad_to=token_cap)
+            enc = np.asarray(out.data)
+        else:
+            enc = np.asarray(out)
+        return enc, np.asarray(lens, np.int32)
+
+    return prefill
+
+
+class LockstepDecoder(object):
+    """Whole-batch LOCKSTEP baseline over the same decoder weights: the
+    fused `attention_lstm_beam_decode` op (one lax.scan over max_len)
+    fed pre-computed encoder rows. This is the A/B reference the
+    continuous engine must match token-for-token (tests/test_decode.py)
+    and the baseline `tools/serve_bench.py --workload decode` measures
+    against: every request in a batch pays max_len steps and new
+    requests wait for the whole batch."""
+
+    def __init__(self, weights, beam_size, max_len, src_cap, start_id=0,
+                 end_id=1, place=None):
+        import jax.numpy as jnp
+        from ..fluid import core, framework
+        from ..fluid.executor import Executor, Scope
+
+        self.beam_size = int(beam_size)
+        self.max_len = int(max_len)
+        self.src_cap = int(src_cap)
+        self._scope = Scope()
+        self._exe = Executor(place or core.CPUPlace())
+        enc_dim = int(np.asarray(weights['w_q']).shape[1])
+        prog = framework.Program()
+        blk = prog.global_block()
+        enc = blk.create_var(name='ls_enc', shape=[-1, src_cap, enc_dim],
+                             dtype='float32', lod_level=1, is_data=True)
+        wvars = {}
+        for k in WEIGHT_KEYS:
+            a = np.asarray(weights[k], np.float32)
+            wvars[k] = blk.create_var(name='ls_' + k, shape=list(a.shape),
+                                      dtype='float32', persistable=True)
+            self._scope.vars['ls_' + k] = jnp.asarray(a)
+        ids = blk.create_var(name='ls_sent_ids', shape=None, dtype='int64')
+        scores = blk.create_var(name='ls_sent_scores', shape=None,
+                                dtype='float32')
+        blk.append_op(
+            type='attention_lstm_beam_decode',
+            inputs={'EncOut': [enc], 'WDec': [wvars['w_dec']],
+                    'UDec': [wvars['u_dec']], 'BDec': [wvars['b_dec']],
+                    'WAttnQ': [wvars['w_q']], 'WEmb': [wvars['w_emb']],
+                    'WOut': [wvars['w_out']], 'BOut': [wvars['b_out']]},
+            outputs={'SentenceIds': [ids], 'SentenceScores': [scores]},
+            attrs={'beam_size': self.beam_size, 'max_len': self.max_len,
+                   'start_id': int(start_id), 'end_id': int(end_id)})
+        self._program = prog
+        self._fetch = [ids, scores]
+
+    def run(self, enc, src_len):
+        """enc [n, S<=src_cap, D] float32, src_len [n] -> (sentence_ids
+        [n, beam, max_len] int64, sentence_scores [n, beam] float32)."""
+        from ..fluid.lowering import SeqValue
+        enc = np.asarray(enc, np.float32)
+        if enc.shape[1] < self.src_cap:
+            enc = np.pad(enc, ((0, 0), (0, self.src_cap - enc.shape[1]),
+                               (0, 0)))
+        sv = SeqValue(enc, np.asarray(src_len, np.int32))
+        ids, scores = self._exe.run(self._program, feed={'ls_enc': sv},
+                                    fetch_list=self._fetch,
+                                    scope=self._scope)
+        return np.asarray(ids), np.asarray(scores)
+
+
+class _Request(object):
+    __slots__ = ('feed', 'limit', 'future', 't_submit', 'deadline',
+                 't_join')
+
+    def __init__(self, feed, limit, future, t_submit, deadline):
+        self.feed = feed
+        self.limit = limit
+        self.future = future
+        self.t_submit = t_submit
+        self.deadline = deadline
+        self.t_join = None
+
+
+# process-wide decode telemetry (docs/serving.md); per-engine views live
+# in engine.stats / stats_window()
+_G_SLOTS = obs.gauge('decode.slots.occupied')
+_G_QDEPTH = obs.gauge('decode.queue.depth')
+_H_STEP = obs.histogram('decode.step.seconds')
+_H_TTFT = obs.histogram('decode.ttft.seconds')
+_H_REQ_TOKENS = obs.histogram('decode.request.tokens',
+                              buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256,
+                                       512, 1024))
+_C_REQUESTS = obs.counter('decode.requests')
+_C_TOKENS = obs.counter('decode.tokens')
+_C_JOINS = obs.counter('decode.joins')
+_C_RELEASES = obs.counter('decode.releases')
+_C_POISONED = obs.counter('decode.poisoned')
+_C_SHED = obs.counter('decode.shed')
+_C_REJECTED = obs.counter('decode.rejected')
+_C_STEPS = obs.counter('decode.steps')
+
+
+class DecodeEngine(object):
+    """Slot-based continuous-batching front end over one attention-LSTM
+    beam decoder (module docstring has the architecture).
+
+    weights: dict with keys w_dec/u_dec/b_dec/w_q/w_emb/w_out/b_out
+    (WEIGHT_KEYS) — the decoder tensors the lockstep
+    `attention_lstm_beam_decode` op takes (`mt_weights` collects them
+    from a trained machine_translation scope).
+
+    prefill: optional callable(list of per-request feed dicts) ->
+    (enc [n, S, D] float array with FINITE padding, src_len [n]); it is
+    invoked with the batch count padded up to a power-of-two bucket
+    (trailing feeds repeated), so it must keep one feed signature per
+    bucket size for the zero-compile warmup contract
+    (`program_prefill` builds a compliant one from an encoder Program).
+    Without a prefill, each request feed carries the encoder rows
+    directly: {'enc': [S, D] float array} with S <= config.src_cap.
+
+    Requests enter through `submit(feed, max_new_tokens=...)` and
+    resolve to (sentence_ids int [beam_size, max_new_tokens],
+    sentence_scores float32 [beam_size]) — bit-identical rows to what
+    the whole-batch lockstep op with max_len=max_new_tokens emits for
+    the same encoder rows (tests/test_decode.py drills it under
+    randomized join/leave).
+    """
+
+    def __init__(self, weights, config=None, place=None, prefill=None):
+        from ..fluid import core
+        from ..fluid.executor import Executor, Scope
+
+        self.config = config or DecodeConfig()
+        self._prefill = prefill
+        missing = [k for k in WEIGHT_KEYS if k not in weights]
+        if missing:
+            raise ValueError('decode weights missing %r (need %r)'
+                             % (missing, list(WEIGHT_KEYS)))
+        self._scope = Scope()
+        self._exe = Executor(place or core.CPUPlace())
+        self._hidden = int(np.asarray(weights['u_dec']).shape[0])
+        self._enc_dim = int(np.asarray(weights['w_q']).shape[1])
+        self._build_step_program(weights)
+        self._handle = None          # acquired lazily (first step/warmup)
+        self._warm = False
+
+        self._lock = threading.Lock()
+        self._handle_lock = threading.RLock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+        self._queue = collections.deque()
+        self._shutdown = False
+        self._drain = True
+        # slot table: owned by the decode-loop thread only
+        self._occupant = [None] * self.config.slots
+        self._slot_steps = [0] * self.config.slots
+        # cumulative stats (+ the windowed counterparts stats_window()
+        # reads-and-resets; the router balances on the window)
+        self._n = collections.Counter()
+        self._win = collections.Counter()
+        self._q_high_water = 0
+
+        self._thread = threading.Thread(target=self._loop,
+                                        name='decode-loop', daemon=True)
+        self._thread.start()
+
+    # -- program build -----------------------------------------------------
+
+    def _build_step_program(self, weights):
+        """The step-form decode Program: one
+        `attention_lstm_beam_decode_step` op over persistable slot state
+        + the decoder weights. Exported by `export_step_program` (and
+        linted by tools/lint.sh) as an ordinary __model__ artifact."""
+        import jax.numpy as jnp
+        from ..fluid import framework
+
+        cfg = self.config
+        prog = framework.Program()
+        blk = prog.global_block()
+        C, K, T, S = cfg.slots, cfg.beam_size, cfg.max_len, cfg.src_cap
+        H, D = self._hidden, self._enc_dim
+
+        def pvar(name, shape, dtype):
+            return blk.create_var(name='cbd_' + name, shape=shape,
+                                  dtype=dtype, persistable=True)
+
+        wvars = {}
+        for k in WEIGHT_KEYS:
+            a = np.asarray(weights[k], np.float32)
+            wvars[k] = pvar(k, list(a.shape), 'float32')
+            self._scope.vars['cbd_' + k] = jnp.asarray(a)
+
+        spec = {'h': ([C, K, H], 'float32'), 'c': ([C, K, H], 'float32'),
+                'prev_ids': ([C, K], 'int32'), 'acc': ([C, K], 'float32'),
+                'fin': ([C, K], 'bool'), 'enc': ([C, S, D], 'float32'),
+                'mask': ([C, S], 'float32'),
+                'ids_hist': ([C, T, K], 'int32'),
+                'par_hist': ([C, T, K], 'int32'),
+                'step': ([C], 'int32'), 'limit': ([C], 'int32'),
+                'active': ([C], 'bool')}
+        svars = {}
+        for name, (shape, dtype) in spec.items():
+            svars[name] = pvar(name, shape, dtype)
+            self._scope.vars['cbd_' + name] = jnp.zeros(
+                shape, np.dtype(dtype))
+        done = blk.create_var(name='cbd_done', shape=[C], dtype='bool')
+        bad = blk.create_var(name='cbd_bad', shape=[C], dtype='bool')
+
+        blk.append_op(
+            type='attention_lstm_beam_decode_step',
+            inputs={'H': [svars['h']], 'C': [svars['c']],
+                    'PrevIds': [svars['prev_ids']], 'Acc': [svars['acc']],
+                    'Fin': [svars['fin']], 'Enc': [svars['enc']],
+                    'Mask': [svars['mask']],
+                    'IdsHist': [svars['ids_hist']],
+                    'ParHist': [svars['par_hist']],
+                    'Step': [svars['step']], 'Limit': [svars['limit']],
+                    'Active': [svars['active']],
+                    'WDec': [wvars['w_dec']], 'UDec': [wvars['u_dec']],
+                    'BDec': [wvars['b_dec']], 'WAttnQ': [wvars['w_q']],
+                    'WEmb': [wvars['w_emb']], 'WOut': [wvars['w_out']],
+                    'BOut': [wvars['b_out']]},
+            outputs={'HOut': [svars['h']], 'COut': [svars['c']],
+                     'PrevIdsOut': [svars['prev_ids']],
+                     'AccOut': [svars['acc']], 'FinOut': [svars['fin']],
+                     'IdsHistOut': [svars['ids_hist']],
+                     'ParHistOut': [svars['par_hist']],
+                     'StepOut': [svars['step']],
+                     'ActiveOut': [svars['active']],
+                     'Done': [done], 'Bad': [bad]},
+            attrs={'beam_size': cfg.beam_size, 'end_id': cfg.end_id,
+                   'bundle': cfg.bundle})
+        self._step_program = prog
+        # fetching the state with every step makes a slot release a pure
+        # numpy slice (one host sync per dispatch that released
+        # something) instead of per-release device gathers — on a CPU
+        # box device dispatch costs more than the decode math. Releases
+        # are LEVEL-triggered off Active (occupied slot now inactive;
+        # poisoning detected from NaN in the fetched scores), not off
+        # the per-dispatch Done edge: an extra dispatch (e.g. warmup's
+        # no-op step racing live traffic) can swallow an edge, but a
+        # level can't be lost.
+        self._fetch_vars = [svars['active'], svars['ids_hist'],
+                            svars['par_hist'], svars['acc'],
+                            svars['step']]
+        self._state_names = ['cbd_' + n
+                            for n in _WRITTEN_STATE + _READONLY_STATE]
+        self._join_fn = self._build_join_fn()
+
+    def _build_join_fn(self):
+        """One jitted row-scatter admitting a BUCKET of joining requests
+        into their slots in a single dispatch, state donated so the
+        update is in place. Rows padded past the real join count carry
+        valid=False and scatter to index `slots`, which mode='drop'
+        discards — so the signature set is exactly cfg.admit_buckets
+        (pre-compiled by warmup, like the prefill buckets)."""
+        import jax
+        import jax.numpy as jnp
+        cfg = self.config
+        K, H = cfg.beam_size, self._hidden
+        neg = float(np.finfo(np.float32).min)
+        acc0 = np.full((K,), neg, np.float32)
+        acc0[0] = 0.0
+
+        def join(st, slot_idx, valid, enc, mask, limit):
+            idx = jnp.where(valid, slot_idx, cfg.slots)   # drop padding
+            m = slot_idx.shape[0]
+
+            def put(name, rows):
+                full = 'cbd_' + name
+                st[full] = st[full].at[idx].set(
+                    rows.astype(st[full].dtype), mode='drop')
+
+            put('h', jnp.zeros((m, K, H), jnp.float32))
+            put('c', jnp.zeros((m, K, H), jnp.float32))
+            put('prev_ids', jnp.full((m, K), cfg.start_id, jnp.int32))
+            put('acc', jnp.broadcast_to(jnp.asarray(acc0), (m, K)))
+            put('fin', jnp.zeros((m, K), bool))
+            put('enc', enc)
+            put('mask', mask)
+            put('step', jnp.zeros((m,), jnp.int32))
+            put('limit', limit)
+            put('active', valid)
+            return st
+
+        return jax.jit(join, donate_argnums=(0,))
+
+    def _scatter_join(self, slot_idx, valid, enc, mask, limit):
+        """Run the jitted join over the handle's live state; inputs are
+        bucket-padded host arrays. Serialized with handle creation and
+        the step dispatch via _handle_lock (warmup's bucket probes run
+        on the caller thread)."""
+        handle = self._acquire()
+        with self._handle_lock:
+            st_all = handle.state
+            st = {n: st_all[n] for n in self._state_names}
+            new = self._join_fn(st, np.asarray(slot_idx, np.int32),
+                                np.asarray(valid, bool),
+                                np.asarray(enc, np.float32),
+                                np.asarray(mask, np.float32),
+                                np.asarray(limit, np.int32))
+            for name, val in new.items():
+                handle.set_state(name, val)
+
+    def _acquire(self):
+        # RLock: warmup() runs on the caller thread while the decode
+        # loop may be admitting/stepping — handle creation and every
+        # donated-state mutation (_scatter_join, step) serialize on it
+        with self._handle_lock:
+            if self._handle is None:
+                self._handle = self._exe.acquire_step(
+                    self._step_program, feed=None,
+                    fetch_list=self._fetch_vars, scope=self._scope)
+                plan = self._handle._compiled.plan
+                obs.event('decode.memory_plan', donates=plan.donates,
+                          writes=sorted(plan.write_set))
+            return self._handle
+
+    def export_step_program(self, dirname):
+        """Save the step-form decode Program (+ its weight/state
+        persistables) as an ordinary inference artifact —
+        tools/program_lint.py lints it like any saved __model__
+        (tools/lint.sh wires that in)."""
+        from ..fluid import io
+        from ..fluid.executor import scope_guard
+        # _handle_lock: the decode loop's in-flight dispatch donates the
+        # scope's state buffers mid-step; exporting must not read them
+        with self._handle_lock:
+            with scope_guard(self._scope):
+                io.save_inference_model(dirname, [],
+                                        list(self._fetch_vars),
+                                        self._exe,
+                                        main_program=self._step_program)
+        return dirname
+
+    # -- admission ---------------------------------------------------------
+
+    def submit(self, feed, max_new_tokens=None, deadline_ms=None,
+               timeout=None):
+        """Enqueue one decode request; returns a Future resolving to
+        (sentence_ids [beam, max_new_tokens] int, sentence_scores [beam]
+        float32). Raises ServerClosed after shutdown, ServerOverloaded
+        under the 'reject' policy (or a 'block' admission timeout), and
+        ValueError for malformed feeds. A deadline sheds the request
+        with DeadlineExceeded if it is still QUEUED when it passes (an
+        already-decoding sequence completes)."""
+        cfg = self.config
+        limit = cfg.max_len if max_new_tokens is None else int(max_new_tokens)
+        if not 1 <= limit <= cfg.max_len:
+            raise ValueError(
+                'max_new_tokens=%d out of range [1, %d] (the slot token '
+                'buffer is fixed at engine build)' % (limit, cfg.max_len))
+        if self._prefill is None:
+            if 'enc' not in feed:
+                raise ValueError(
+                    "an engine without a prefill takes encoder rows "
+                    "directly: feed must carry 'enc' (got %r)"
+                    % sorted(feed))
+            enc = np.asarray(feed['enc'], np.float32)
+            if enc.ndim != 2 or not 1 <= enc.shape[0] <= cfg.src_cap \
+                    or enc.shape[1] != self._enc_dim:
+                raise ValueError(
+                    "feed['enc'] must be [1<=S<=%d, %d], got %r"
+                    % (cfg.src_cap, self._enc_dim, enc.shape))
+            feed = {'enc': enc}
+        if deadline_ms is None:
+            deadline_ms = cfg.default_deadline_ms
+        now = time.monotonic()
+        deadline = now + deadline_ms / 1000.0 if deadline_ms is not None \
+            else None
+        fut = concurrent.futures.Future()
+        req = _Request(feed, limit, fut, now, deadline)
+        t_give_up = now + timeout if timeout is not None else None
+        with self._lock:
+            while True:
+                if self._shutdown:
+                    raise ServerClosed('decode engine is shut down')
+                if len(self._queue) < cfg.queue_capacity:
+                    break
+                if cfg.overflow == 'reject':
+                    self._n['rejected'] += 1
+                    self._win['rejected'] += 1
+                    _C_REJECTED.inc()
+                    obs.event('decode.reject',
+                              queue_depth=len(self._queue),
+                              capacity=cfg.queue_capacity)
+                    raise ServerOverloaded(
+                        'decode queue is full (%d request(s), capacity '
+                        '%d) and the overflow policy is reject'
+                        % (len(self._queue), cfg.queue_capacity))
+                remaining = _POLL_S if t_give_up is None else \
+                    min(_POLL_S, t_give_up - time.monotonic())
+                if t_give_up is not None and remaining <= 0:
+                    self._n['rejected'] += 1
+                    self._win['rejected'] += 1
+                    _C_REJECTED.inc()
+                    obs.event('decode.reject',
+                              queue_depth=len(self._queue),
+                              capacity=cfg.queue_capacity,
+                              waited_s=timeout)
+                    raise ServerOverloaded(
+                        'decode queue stayed full for %.3fs (capacity %d)'
+                        % (timeout, cfg.queue_capacity))
+                self._not_full.wait(remaining)
+            self._queue.append(req)
+            self._n['submitted'] += 1
+            self._win['submitted'] += 1
+            depth = len(self._queue)
+            self._q_high_water = max(self._q_high_water, depth)
+            self._win['queue_high_water'] = max(
+                self._win['queue_high_water'], depth)
+            _C_REQUESTS.inc()
+            _G_QDEPTH.set(depth)
+            self._not_empty.notify()
+        return fut
+
+    def predict(self, feed, max_new_tokens=None, deadline_ms=None,
+                timeout=None):
+        """Synchronous convenience: submit + wait, one wall-clock budget
+        for admission and result (ServingEngine.predict semantics)."""
+        t0 = time.monotonic()
+        fut = self.submit(feed, max_new_tokens=max_new_tokens,
+                          deadline_ms=deadline_ms, timeout=timeout)
+        remaining = None if timeout is None else \
+            max(0.0, timeout - (time.monotonic() - t0))
+        try:
+            return fut.result(remaining)
+        except concurrent.futures.TimeoutError:
+            if fut.done():
+                return fut.result()
+            if fut.cancel():
+                raise DeadlineExceeded(
+                    'no result within the %.3fs predict() timeout; the '
+                    'queued decode request was cancelled' % timeout)
+            raise DeadlineExceeded(
+                'no result within the %.3fs predict() timeout; the '
+                'sequence is already decoding — it completes but the '
+                'result is discarded' % timeout)
+
+    # -- warmup ------------------------------------------------------------
+
+    def warmup(self, example_feed=None):
+        """Pre-compile the closed signature set — the ONE decode-step
+        module plus one prefill signature per admission bucket — so
+        steady-state decoding performs zero compiles (assert via
+        `cache_stats`; the acceptance drill does). Returns the bucket
+        list. With a prefill, `example_feed` (any single request feed)
+        seeds the per-bucket probe batches."""
+        cfg = self.config
+        handle = self._acquire()
+        with self._handle_lock:
+            handle.step()             # all slots inactive: a no-op step
+        for b in cfg.admit_buckets:   # join-scatter kernel per bucket
+            with obs.span('decode.warmup', bucket=b, kind='join'):
+                self._scatter_join(
+                    np.zeros(b, np.int32), np.zeros(b, bool),
+                    np.zeros((b, cfg.src_cap, self._enc_dim), np.float32),
+                    np.zeros((b, cfg.src_cap), np.float32),
+                    np.zeros(b, np.int32))
+        if self._prefill is not None:
+            if example_feed is None:
+                raise ValueError(
+                    'warmup() needs example_feed when the engine owns a '
+                    'prefill (it cannot synthesize model inputs)')
+            for b in cfg.admit_buckets:
+                with obs.span('decode.warmup', bucket=b, kind='prefill'):
+                    self._prefill([dict(example_feed)] * b)
+        self._warm = True
+        return list(cfg.admit_buckets)
+
+    # -- decode loop -------------------------------------------------------
+
+    def _pop_live_locked(self, now, shed, cap):
+        """Pop up to `cap` still-wanted requests; expired ones collect
+        into `shed` (failed by the caller OUTSIDE the lock, like the
+        serving engine's batcher)."""
+        out = []
+        while self._queue and len(out) < cap:
+            req = self._queue.popleft()
+            self._not_full.notify()
+            if req.deadline is not None and now > req.deadline:
+                shed.append(req)
+                continue
+            if not req.future.set_running_or_notify_cancel():
+                continue              # cancelled while queued
+            out.append(req)
+        _G_QDEPTH.set(len(self._queue))
+        return out
+
+    def _fail_shed(self, shed):
+        now = time.monotonic()
+        for req in shed:
+            if not req.future.set_running_or_notify_cancel():
+                continue
+            with self._lock:   # _win races stats_window's copy+reset
+                self._n['shed'] += 1
+                self._win['shed'] += 1
+            _C_SHED.inc()
+            waited = now - req.t_submit
+            obs.event('decode.shed', waited_s=waited)
+            req.future.set_exception(DeadlineExceeded(
+                'decode request shed after waiting %.3fs: its deadline '
+                'passed before a slot opened' % waited))
+
+    def _admit(self, joins):
+        """Prefill + scatter the joining requests' slot state in ONE
+        bucket-padded jitted join (loop thread only). A prefill/feed
+        failure fails ONLY the joining futures."""
+        cfg = self.config
+        b = _buckets.pick_bucket(len(joins), cfg.admit_buckets)
+        try:
+            if self._prefill is not None:
+                feeds = [r.feed for r in joins]
+                feeds += [joins[-1].feed] * (b - len(joins))
+                enc, src_len = self._prefill(feeds)
+                enc = np.asarray(enc, np.float32)[:len(joins)]
+                src_len = np.asarray(src_len, np.int32)[:len(joins)]
+                # a short/misshapen prefill return must fail HERE, not
+                # broadcast silently into the batch assembly below
+                if enc.ndim != 3 or enc.shape[0] != len(joins):
+                    raise ValueError(
+                        'prefill returned enc of shape %r for %d '
+                        'request(s) (want [n, S, %d])'
+                        % (getattr(enc, 'shape', None), len(joins),
+                           self._enc_dim))
+                if src_len.shape != (len(joins),):
+                    raise ValueError(
+                        'prefill returned src_len of shape %r for %d '
+                        'request(s)' % (src_len.shape, len(joins)))
+                if enc.shape[1] > cfg.src_cap:
+                    raise ValueError(
+                        'prefill returned %d encoder rows > src_cap=%d'
+                        % (enc.shape[1], cfg.src_cap))
+            else:
+                src_len = np.asarray([r.feed['enc'].shape[0]
+                                      for r in joins], np.int32)
+                enc = np.zeros((len(joins), int(src_len.max()),
+                                self._enc_dim), np.float32)
+                for i, r in enumerate(joins):
+                    enc[i, :src_len[i]] = r.feed['enc']
+            # bucket-padded batch ASSEMBLY stays inside the try: a
+            # malformed prefill product failing here must resolve only
+            # the joining futures, never reach the loop's crash guard
+            pad = b - len(joins)
+            valid = np.asarray([True] * len(joins) + [False] * pad)
+            enc_b = np.zeros((b, cfg.src_cap, self._enc_dim), np.float32)
+            enc_b[:len(joins), :enc.shape[1]] = enc
+            mask_b = np.zeros((b, cfg.src_cap), np.float32)
+            mask_b[:len(joins)] = (np.arange(cfg.src_cap)[None, :]
+                                   < src_len[:, None])
+            limit_b = np.zeros(b, np.int32)
+            limit_b[:len(joins)] = [r.limit for r in joins]
+        except Exception as e:  # noqa: BLE001 — the joiners' futures own it
+            for r in joins:
+                if not r.future.done():
+                    r.future.set_exception(e)
+            obs.event('decode.prefill.error',
+                      requests=len(joins),
+                      error='%s: %s' % (type(e).__name__, e))
+            return
+
+        free = [i for i, occ in enumerate(self._occupant) if occ is None]
+        slot_idx = np.asarray(free[:len(joins)] + [0] * (b - len(joins)),
+                              np.int32)
+        self._scatter_join(slot_idx, valid, enc_b, mask_b, limit_b)
+        now = time.monotonic()
+        for i, req in enumerate(joins):
+            slot = free[i]
+            self._occupant[slot] = req
+            self._slot_steps[slot] = 0
+            req.t_join = now
+            with self._lock:
+                self._n['joins'] += 1
+                self._win['joins'] += 1
+            _C_JOINS.inc()
+            obs.event('decode.join', slot=slot, limit=req.limit,
+                      src_len=int(src_len[i]))
+        _G_SLOTS.set(sum(o is not None for o in self._occupant))
+
+    def _release(self, slot, poisoned, ids_np, par_np, acc_np):
+        """Resolve the slot's future from the step's fetched token
+        history (host arrays — no device traffic here) and free it
+        (loop thread only)."""
+        from ..fluid.ops_impl.lod_beam import backtrace_beams
+        req = self._occupant[slot]
+        self._occupant[slot] = None
+        taken = self._slot_steps[slot]
+        with self._lock:
+            self._n['releases'] += 1
+            self._win['releases'] += 1
+        _C_RELEASES.inc()
+        _G_SLOTS.set(sum(o is not None for o in self._occupant))
+        if req is None:
+            return
+        if poisoned:
+            with self._lock:
+                self._n['poisoned'] += 1
+                self._win['poisoned'] += 1
+            _C_POISONED.inc()
+            obs.event('decode.poisoned', slot=slot, steps=taken)
+            req.future.set_exception(DecodeSlotPoisoned(
+                'slot %d produced non-finite beam scores after %d '
+                'step(s); the request was aborted (other in-flight '
+                'sequences are unaffected)' % (slot, taken)))
+            return
+        acc = acc_np[slot]
+        toks = backtrace_beams(ids_np[slot, :taken],
+                               par_np[slot, :taken])    # [K, taken]
+        if taken < req.limit:
+            # the fused lockstep scan keeps emitting end_id with
+            # identity parents once every beam finished — pad instead
+            # of stepping (lod_beam.backtrace_beams documents why this
+            # is bit-exact)
+            pad = np.full((self.config.beam_size, req.limit - taken),
+                          self.config.end_id, toks.dtype)
+            toks = np.concatenate([toks, pad], axis=1)
+        with self._lock:
+            self._n['completed'] += 1
+            self._win['completed'] += 1
+            self._n['tokens'] += taken
+            self._win['tokens'] += taken
+        _H_REQ_TOKENS.observe(taken)
+        obs.event('decode.release', slot=slot, steps=taken,
+                  finished=taken < req.limit)
+        req.future.set_result((toks.astype(np.int64), acc))
+
+    def _loop(self):
+        """Decode-loop thread wrapper: a loop bug must fail every
+        in-flight and queued future loudly instead of stranding them
+        (the serving batcher's last-resort guard, same rationale)."""
+        try:
+            self._loop_body()
+        except BaseException as e:  # noqa: BLE001 — resolved into futures
+            obs.event('decode.loop.error',
+                      error='%s: %s' % (type(e).__name__, e))
+            with self._lock:
+                self._shutdown = True
+                self._drain = False
+                doomed = [r for r in self._queue]
+                self._queue.clear()
+                _G_QDEPTH.set(0)
+            doomed += [occ for occ in self._occupant if occ is not None]
+            self._occupant = [None] * self.config.slots
+            _G_SLOTS.set(0)
+            for r in doomed:
+                try:
+                    # queued futures are PENDING and must be claimed;
+                    # in-flight ones are already RUNNING and raise here
+                    r.future.set_running_or_notify_cancel()
+                except RuntimeError:
+                    pass
+                if not r.future.done():
+                    r.future.set_exception(e)
+
+    def _loop_body(self):
+        cfg = self.config
+        while True:
+            shed, joins, doomed = [], [], []
+            with self._lock:
+                free = sum(o is None for o in self._occupant)
+                if free:
+                    joins = self._pop_live_locked(time.monotonic(), shed,
+                                                  free)
+                pending = len(self._queue)
+                closing = self._shutdown
+                if closing and not self._drain:
+                    # queued requests fail with ServerClosed; active
+                    # slots still finish. Futures resolve OUTSIDE the
+                    # lock (a done-callback may re-enter the engine)
+                    while self._queue:
+                        doomed.append(self._queue.popleft())
+                    doomed += joins     # claimed but not yet admitted
+                    joins = []
+                    pending = 0
+                    _G_QDEPTH.set(0)
+            for r in doomed:
+                try:
+                    r.future.set_running_or_notify_cancel()
+                except RuntimeError:
+                    pass                # already claimed as a join
+                if not r.future.done():
+                    r.future.set_exception(ServerClosed(
+                        'decode engine shut down without draining'))
+            self._fail_shed(shed)
+            if joins:
+                self._admit(joins)
+            n_active = sum(o is not None for o in self._occupant)
+            if n_active == 0:
+                if closing and pending == 0:
+                    break
+                with self._lock:
+                    if not self._queue and not self._shutdown:
+                        self._not_empty.wait(_POLL_S)
+                continue
+            handle = self._acquire()
+            t0 = time.perf_counter()
+            with self._handle_lock:   # vs warmup's join/step probes
+                active_v, ids_v, par_v, acc_v, step_v = handle.step()
+                # fetch conversion stays INSIDE the lock: the fetched
+                # arrays alias donated state, and a concurrent warmup
+                # dispatch would delete the buffers under us
+                active_np = np.asarray(active_v)
+                steps_np = np.asarray(step_v)
+                finished = [slot for slot, occ
+                            in enumerate(self._occupant)
+                            if occ is not None and not active_np[slot]]
+                if finished:
+                    # one host sync for every release this bundle
+                    ids_np = np.asarray(ids_v)
+                    par_np = np.asarray(par_v)
+                    acc_np = np.asarray(acc_v)
+            dt = time.perf_counter() - t0
+            _H_STEP.observe(dt)
+            _C_STEPS.inc()
+            with self._lock:
+                self._n['steps'] += 1
+                self._win['steps'] += 1
+            now = time.monotonic()
+            for slot, occ in enumerate(self._occupant):
+                if occ is None:
+                    continue
+                prev_steps = self._slot_steps[slot]
+                self._slot_steps[slot] = int(steps_np[slot])
+                _C_TOKENS.inc(self._slot_steps[slot] - prev_steps)
+                if prev_steps == 0 and self._slot_steps[slot] > 0 \
+                        and occ.t_join is not None:
+                    _H_TTFT.observe(now - occ.t_submit)
+                if slot in finished:
+                    self._release(slot,
+                                  bool(np.isnan(acc_np[slot]).any()),
+                                  ids_np, par_np, acc_np)
+
+    # -- lifecycle / stats -------------------------------------------------
+
+    def request_shutdown(self):
+        """Signal-safe: flag only (the Trainer preemption pattern)."""
+        self._shutdown = True
+
+    def shutdown(self, drain=True, timeout=None):
+        """Stop admission; with drain=True every queued request still
+        decodes, else queued futures fail with ServerClosed (in-flight
+        sequences always finish). No future is ever lost."""
+        with self._lock:
+            self._drain = drain
+            self._shutdown = True
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
+        self._thread.join(timeout)
+        done = not self._thread.is_alive()
+        obs.event('decode.shutdown', drained=drain, clean=done,
+                  completed=self._n['completed'],
+                  tokens=self._n['tokens'])
+        return done
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.shutdown(drain=exc_type is None)
+        return False
+
+    @property
+    def stats(self):
+        """Cumulative decode statistics + instantaneous depth/occupancy
+        (the windowed signal the router balances on is stats_window())."""
+        with self._lock:
+            depth = len(self._queue)
+        out = {k: self._n.get(k, 0) for k in
+               ('submitted', 'completed', 'rejected', 'shed', 'poisoned',
+                'joins', 'releases', 'steps', 'tokens')}
+        out['queue_depth'] = depth
+        out['queue_high_water'] = self._q_high_water
+        out['slots'] = self.config.slots
+        out['slots_occupied'] = sum(o is not None for o in self._occupant)
+        out['warm'] = self._warm
+        return out
+
+    def stats_window(self):
+        """Admission-pressure counters SINCE THE LAST CALL — the
+        windowed signal (queue high-water mark, shed/reject counts) the
+        router's least-loaded policy needs; instantaneous depth alone
+        reads zero between bursts (docs/serving.md). Reading resets the
+        window."""
+        with self._lock:
+            win = dict(self._win)
+            self._win.clear()
+            depth = len(self._queue)
+        for k in ('queue_high_water', 'shed', 'rejected', 'submitted',
+                  'completed', 'tokens'):
+            win.setdefault(k, 0)
+        win['queue_depth'] = depth
+        win['inflight'] = sum(o is not None for o in self._occupant)
+        # 'capacity' is the ADMISSION queue capacity on every engine
+        # kind (a consumer normalizing pressure by it must get the same
+        # units from ServingEngine and DecodeEngine replicas); the slot
+        # pool is reported separately
+        win['capacity'] = self.config.queue_capacity
+        win['slots'] = self.config.slots
+        return win
+
+    def cache_stats(self):
+        """The underlying executor's compile/cache counters (the
+        zero-steady-state-compiles assertion reads misses before/after
+        traffic)."""
+        return self._exe.cache_stats

@@ -197,6 +197,12 @@ class ServingEngine(object):
         self._n_batches = 0
         self._n_batch_errors = 0
         self._n_padded_rows = 0
+        self._n_inflight = 0           # rows in the currently-executing batch
+        self._q_high_water = 0         # cumulative queue high-water mark
+        # the windowed counterparts stats_window() reads-and-resets — the
+        # admission-pressure signal the router balances on
+        self._win = {'submitted': 0, 'completed': 0, 'shed': 0,
+                     'rejected': 0, 'queue_high_water': 0}
         self._thread = threading.Thread(target=self._batcher_loop,
                                         name='serving-batcher', daemon=True)
         self._thread.start()
@@ -265,6 +271,7 @@ class ServingEngine(object):
                     break
                 if self.config.overflow == 'reject':
                     self._n_rejected += 1
+                    self._win['rejected'] += 1
                     _C_REJECTED.inc()
                     obs.event('serving.reject',
                               queue_depth=len(self._queue),
@@ -277,6 +284,7 @@ class ServingEngine(object):
                     min(_POLL_S, t_give_up - time.monotonic())
                 if t_give_up is not None and remaining <= 0:
                     self._n_rejected += 1
+                    self._win['rejected'] += 1
                     _C_REJECTED.inc()
                     obs.event('serving.reject',
                               queue_depth=len(self._queue),
@@ -288,8 +296,13 @@ class ServingEngine(object):
                 self._not_full.wait(remaining)
             self._queue.append(req)
             self._n_submitted += 1
+            self._win['submitted'] += 1
+            depth = len(self._queue)
+            self._q_high_water = max(self._q_high_water, depth)
+            self._win['queue_high_water'] = max(
+                self._win['queue_high_water'], depth)
             _C_REQUESTS.inc()
-            _G_QDEPTH.set(len(self._queue))
+            _G_QDEPTH.set(depth)
             self._not_empty.notify()
         return fut
 
@@ -430,8 +443,10 @@ class ServingEngine(object):
 
     @property
     def stats(self):
-        """This engine's serving statistics (process-wide aggregates of
-        the same series live in the obs registry, docs/serving.md)."""
+        """This engine's CUMULATIVE serving statistics (process-wide
+        aggregates of the same series live in the obs registry,
+        docs/serving.md). The windowed admission-pressure signal a
+        router balances on is `stats_window()`."""
         with self._lock:
             depth = len(self._queue)
         return {'submitted': self._n_submitted,
@@ -442,7 +457,27 @@ class ServingEngine(object):
                 'batch_errors': self._n_batch_errors,
                 'padded_rows': self._n_padded_rows,
                 'queue_depth': depth,
+                'queue_high_water': self._q_high_water,
+                'inflight': self._n_inflight,
                 'warm': self._warm}
+
+    def stats_window(self):
+        """Admission-pressure counters SINCE THE LAST CALL — the queue
+        high-water mark plus shed/reject/submit/complete counts of the
+        window, with the instantaneous depth and in-flight rows
+        appended. Instantaneous depth alone is a useless balancing
+        signal (a bursty replica reads 0 between bursts; one that shed
+        work a moment ago looks idle); the router (serving/router.py)
+        is the intended single consumer — reading resets the window."""
+        with self._lock:
+            win = dict(self._win)
+            for k in self._win:
+                self._win[k] = 0
+            depth = len(self._queue)
+        win['queue_depth'] = depth
+        win['inflight'] = self._n_inflight
+        win['capacity'] = self.config.queue_capacity
+        return win
 
     # -- batcher -----------------------------------------------------------
 
@@ -476,6 +511,8 @@ class ServingEngine(object):
             if not req.future.set_running_or_notify_cancel():
                 continue
             self._n_shed += 1
+            with self._lock:   # _win races stats_window's copy+reset
+                self._win['shed'] += 1
             _C_SHED.inc()
             waited = now - req.t_submit
             obs.event('serving.shed', waited_s=waited, rows=req.n)
@@ -564,6 +601,7 @@ class ServingEngine(object):
                 # the batcher thread silently — a dead batcher strands
                 # every queued future and blocks all later submits.
                 self._n_batch_errors += 1
+                self._n_inflight = 0   # _execute died before its reset
                 _C_BATCH_ERRORS.inc()
                 obs.event('serving.batch.error', requests=len(batch),
                           error='batcher guard: %s: %s'
@@ -586,6 +624,7 @@ class ServingEngine(object):
     def _execute(self, batch):
         now = time.monotonic()
         rows = sum(r.n for r in batch)
+        self._n_inflight = rows
         waits = [now - r.t_submit for r in batch]
         # batch ASSEMBLY failures (bucket lookup, concat, padding) must
         # resolve the futures exactly like model failures do — an
@@ -627,6 +666,7 @@ class ServingEngine(object):
                       error='%s: %s' % (type(e).__name__, e))
             for req in batch:
                 req.future.set_exception(e)
+            self._n_inflight = 0
             return
         per_row = self._per_row_outputs
         off = 0
@@ -642,3 +682,6 @@ class ServingEngine(object):
                 else o for i, o in enumerate(outs)])
             off += req.n
             self._n_completed += 1
+            with self._lock:
+                self._win['completed'] += 1
+        self._n_inflight = 0

@@ -1,0 +1,389 @@
+"""Multi-replica serving router: least-loaded dispatch + hot swap.
+
+One process, N model replicas (`ServingEngine` over Predictor/compiled
+runners, or `DecodeEngine` for the continuous-batching decode path —
+anything with submit()/stats_window()/shutdown()). The router is the
+single front door:
+
+  * LEAST-LOADED dispatch: each replica's admission pressure is sampled
+    from its `stats_window()` — the queue high-water mark and shed/
+    reject counts since the last sample, not just instantaneous depth
+    (a bursty replica reads depth 0 between bursts; the window does
+    not) — plus a same-window count of requests this router already
+    sent it, so consecutive submits spread instead of dogpiling the
+    replica that looked idle a moment ago;
+  * PER-MODEL ADMISSION QUOTAS: a cap on outstanding (queued +
+    in-flight) work per model id; exceeding it raises the typed
+    `ModelOverloaded` BEFORE any replica queue is touched, and a
+    replica's own `ServerOverloaded` is caught and retried on the next
+    least-loaded replica — overload propagates to the caller typed, as
+    `ModelOverloaded(model_id)`, only when every replica refused;
+  * VERSIONED HOT SWAP (`swap`): load the incoming artifact via
+    `inference.load_compiled`, `warmup()` it off to the side (every
+    bucket pre-compiled), then cut traffic over atomically — requests
+    route to the new replicas from one submit to the next — while the
+    OLD replicas drain in the background (their queued and in-flight
+    work completes; no future is lost). Zero downtime: admission never
+    closes during a swap.
+
+Observability: router.routed / router.overloaded counters (labeled by
+model), router.swap events, and a replicas gauge; `obs_report` folds
+them into the serving section (docs/serving.md).
+"""
+import concurrent.futures
+import threading
+import time
+
+from .. import obs
+from .engine import (DeadlineExceeded, ServerClosed, ServerOverloaded,
+                     ServingEngine)
+
+__all__ = ['Router', 'ModelOverloaded', 'UnknownModel']
+
+
+class UnknownModel(KeyError):
+    """submit()/swap() named a model id the router does not serve."""
+
+
+class ModelOverloaded(ServerOverloaded):
+    """The model's admission quota is exhausted, or every replica
+    refused the request (typed overload propagation: callers catch
+    ServerOverloaded and get the model id via .model_id)."""
+
+    def __init__(self, model_id, message):
+        super(ModelOverloaded, self).__init__(message)
+        self.model_id = model_id
+
+
+_C_ROUTED = obs.counter('router.routed')
+_C_OVERLOADED = obs.counter('router.overloaded')
+_G_REPLICAS = obs.gauge('router.replicas')
+
+
+class _Replica(object):
+    __slots__ = ('engine', 'window', 'routed_since', 'sampled_at')
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.window = {}
+        self.routed_since = 0
+        self.sampled_at = None    # None = never sampled: refresh first
+
+    def score(self):
+        """Admission-pressure score (lower = less loaded): live queue
+        depth + in-flight work + the windowed high-water mark, with
+        shed/reject counts weighted heavily (a replica that had to
+        refuse work is the last place to send more), plus requests this
+        router routed to it since the sample."""
+        w = self.window
+        return (w.get('queue_depth', 0) + w.get('inflight', 0)
+                + w.get('queue_high_water', 0)
+                + 4 * (w.get('shed', 0) + w.get('rejected', 0))
+                + self.routed_since)
+
+    def outstanding(self):
+        return (self.window.get('queue_depth', 0)
+                + self.window.get('inflight', 0) + self.routed_since)
+
+
+class _ModelEntry(object):
+    __slots__ = ('replicas', 'quota', 'version', 'path')
+
+    def __init__(self, replicas, quota):
+        self.replicas = replicas
+        self.quota = quota
+        self.version = 1
+        self.path = None
+
+
+class Router(object):
+    """Least-loaded request router over named models (module docstring).
+
+    window_s: minimum seconds between stats_window() samples per
+    replica — the windowed counters reset on read, so the router is
+    their single consumer and rations the reads."""
+
+    def __init__(self, window_s=0.25):
+        self._lock = threading.Lock()
+        self._swap_lock = threading.Lock()   # one swap at a time
+        self._models = {}
+        self._window_s = float(window_s)
+        self._drainers = []
+
+    # -- registry ----------------------------------------------------------
+
+    def add_model(self, model_id, replicas, quota=None):
+        """Register `model_id` served by `replicas` (a list of engines).
+        `quota` caps outstanding (queued + in-flight) requests across
+        the model's replicas; None = no cap."""
+        if not replicas:
+            raise ValueError('a model needs at least one replica')
+        with self._lock:
+            if model_id in self._models:
+                raise ValueError('model %r is already registered; use '
+                                 'swap() or add_replica()' % (model_id,))
+            self._models[model_id] = _ModelEntry(
+                [_Replica(e) for e in replicas],
+                int(quota) if quota is not None else None)
+            self._update_gauge_locked()
+        return self
+
+    def add_replica(self, model_id, engine):
+        with self._lock:
+            self._entry(model_id).replicas.append(_Replica(engine))
+            self._update_gauge_locked()
+
+    def models(self):
+        with self._lock:
+            return {m: {'replicas': len(e.replicas), 'quota': e.quota,
+                        'version': e.version, 'path': e.path}
+                    for m, e in self._models.items()}
+
+    def _entry(self, model_id):
+        try:
+            return self._models[model_id]
+        except KeyError:
+            raise UnknownModel(
+                'no model %r (serving %r)'
+                % (model_id, sorted(self._models)))
+
+    def _update_gauge_locked(self):
+        _G_REPLICAS.set(sum(len(e.replicas)
+                            for e in self._models.values()))
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _refresh_locked(self, entry, now):
+        for r in entry.replicas:
+            if r.sampled_at is None or now - r.sampled_at >= self._window_s:
+                try:
+                    r.window = r.engine.stats_window()
+                except Exception:
+                    r.window = {}
+                r.routed_since = 0
+                r.sampled_at = now
+
+    def submit(self, model_id, feed, **kwargs):
+        """Route one request to the least-loaded replica of `model_id`;
+        extra keyword arguments (deadline_ms, timeout, max_new_tokens,
+        ...) pass through to the replica's submit(). Raises UnknownModel
+        for an unregistered id and ModelOverloaded when the model quota
+        is exhausted or every replica refused."""
+        last_err = None
+        # one admission budget for the WHOLE dispatch: trying N blocking
+        # replicas in sequence must not multiply the caller's timeout
+        t_end = None
+        if kwargs.get('timeout') is not None:
+            t_end = time.monotonic() + kwargs['timeout']
+        for attempt in (0, 1):
+            now = time.monotonic()
+            with self._lock:
+                entry = self._entry(model_id)
+                self._refresh_locked(entry, now)
+                if entry.quota is not None:
+                    outstanding = sum(r.outstanding()
+                                      for r in entry.replicas)
+                    if outstanding >= entry.quota:
+                        _C_OVERLOADED.inc()
+                        obs.event('router.overloaded',
+                                  model=str(model_id),
+                                  outstanding=outstanding,
+                                  quota=entry.quota)
+                        raise ModelOverloaded(
+                            model_id,
+                            'model %r admission quota exhausted (%d '
+                            'outstanding >= quota %d)'
+                            % (model_id, outstanding, entry.quota))
+                order = sorted(entry.replicas, key=lambda r: r.score())
+            all_closed = True
+            fut = picked = bumped = None
+            try:
+                for r in order:
+                    if t_end is not None:
+                        kwargs['timeout'] = max(0.0,
+                                                t_end - time.monotonic())
+                    # bump ONLY the replica being attempted (bumping the
+                    # whole order up front inflated outstanding() by N-1
+                    # phantoms for the duration of a blocking submit,
+                    # spuriously tripping the quota for other callers);
+                    # a successful dispatch keeps its bump
+                    with self._lock:
+                        r.routed_since += 1
+                    bumped = r
+                    try:
+                        fut = r.engine.submit(feed, **kwargs)
+                    except (ServerOverloaded, ServerClosed) as e:
+                        with self._lock:
+                            # max(): a concurrent _refresh_locked may
+                            # have reset the counter since the bump
+                            r.routed_since = max(0, r.routed_since - 1)
+                        bumped = None
+                        last_err = e
+                        all_closed = (all_closed
+                                      and isinstance(e, ServerClosed))
+                        continue
+                    picked = r
+                    break
+            finally:
+                # an UNEXPECTED submit error (bad feed ValueError, ...)
+                # must not leave a phantom routed_since eating the quota
+                if bumped is not None and picked is None:
+                    with self._lock:
+                        bumped.routed_since = max(
+                            0, bumped.routed_since - 1)
+            if picked is not None:
+                _C_ROUTED.inc()
+                return fut
+            if attempt == 0 and last_err is not None and all_closed:
+                # every replica in our snapshot raised ServerClosed: we
+                # raced a swap() cutover and held the drained OLD
+                # generation — re-resolve entry.replicas once and retry
+                # against the warmed-up incoming generation (zero
+                # downtime for callers)
+                continue
+            break
+        if last_err is not None and all_closed:
+            # still all closed after the re-resolve: the model is DOWN,
+            # not overloaded — don't hand retry-forever clients a
+            # transient-overload signal for a dead backend
+            obs.event('router.closed', model=str(model_id),
+                      replicas=len(order))
+            raise ServerClosed(
+                'every replica of model %r is shut down (last: %s)'
+                % (model_id, last_err))
+        _C_OVERLOADED.inc()
+        obs.event('router.overloaded', model=str(model_id),
+                  replicas=len(order))
+        raise ModelOverloaded(
+            model_id, 'every replica of model %r refused the request '
+            '(last: %s)' % (model_id, last_err))
+
+    def predict(self, model_id, feed, timeout=None, **kwargs):
+        """Synchronous convenience: one wall-clock budget covering both
+        admission and the result wait, with the engines' typed-timeout
+        contract (DeadlineExceeded, never a raw TimeoutError; a still-
+        queued request is cancelled so it stops holding quota)."""
+        t0 = time.monotonic()
+        fut = self.submit(model_id, feed, timeout=timeout, **kwargs)
+        remaining = None if timeout is None else \
+            max(0.0, timeout - (time.monotonic() - t0))
+        try:
+            return fut.result(remaining)
+        except concurrent.futures.TimeoutError:
+            if fut.done():
+                return fut.result()
+            if fut.cancel():
+                raise DeadlineExceeded(
+                    'no result within the %.3fs predict() timeout; the '
+                    'queued request was cancelled' % timeout)
+            raise DeadlineExceeded(
+                'no result within the %.3fs predict() timeout; the '
+                'request is already executing — it completes but the '
+                'result is discarded' % timeout)
+
+    # -- hot swap ----------------------------------------------------------
+
+    def swap(self, model_id, path, config=None, warmup_feed=None,
+             builder=None):
+        """Zero-downtime versioned artifact hot-swap: build one NEW
+        replica per current replica from the `load_compiled` artifact at
+        `path`, run `warmup()` on each incoming replica (every bucket
+        pre-compiled — the cutover never serves a cold compile), then
+        atomically cut traffic over and drain the old replicas in the
+        background (queued + in-flight work completes; no future is
+        lost). Admission stays open throughout. Returns the new version
+        number.
+
+        `builder(path)` overrides replica construction (e.g. to swap a
+        DecodeEngine); default: ServingEngine(load_compiled(path),
+        config or the old replica's config). Swaps serialize on one
+        router-wide lock (a second swap waits, it is not lost), and a
+        replica added concurrently via add_replica survives the
+        cutover."""
+        with self._swap_lock:
+            return self._swap_locked(model_id, path, config, warmup_feed,
+                                     builder)
+
+    def _swap_locked(self, model_id, path, config, warmup_feed, builder):
+        with self._lock:
+            entry = self._entry(model_id)
+            n, old_replicas = len(entry.replicas), list(entry.replicas)
+        if builder is None:
+            from .. import inference
+
+            def builder(p):
+                cfg = config
+                if cfg is None:
+                    old_eng = old_replicas[0].engine
+                    cfg = getattr(old_eng, 'config', None)
+                return ServingEngine(inference.load_compiled(p), cfg)
+
+        incoming = []
+        try:
+            for _ in range(n):
+                eng = builder(path)
+                with obs.span('router.swap.warmup', model=str(model_id)):
+                    eng.warmup(warmup_feed)
+                incoming.append(eng)
+        except Exception:
+            for eng in incoming:       # half-built generation: tear down
+                try:
+                    eng.shutdown(drain=False, timeout=5)
+                except Exception:
+                    pass
+            raise
+        with self._lock:
+            # replace ONLY the snapshotted generation; replicas added
+            # concurrently via add_replica keep serving (they are
+            # neither drained below nor silently dropped)
+            old_set = set(old_replicas)
+            kept = [r for r in entry.replicas if r not in old_set]
+            entry.replicas = [_Replica(e) for e in incoming] + kept
+            entry.version += 1
+            entry.path = path
+            version = entry.version
+            self._update_gauge_locked()
+        obs.event('router.swap', model=str(model_id), version=version,
+                  replicas=n, path=str(path))
+        for old in old_replicas:
+            t = threading.Thread(
+                target=lambda e=old.engine: e.shutdown(drain=True),
+                name='router-drain', daemon=True)
+            t.start()
+            self._drainers.append(t)
+        return version
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def stats(self):
+        """Per-model routing view: replica count, version, and each
+        replica's last-sampled window (no reset — the dispatch path owns
+        the sampling)."""
+        with self._lock:
+            return {m: {'version': e.version, 'quota': e.quota,
+                        'replicas': [dict(r.window,
+                                          routed_since=r.routed_since)
+                                     for r in e.replicas]}
+                    for m, e in self._models.items()}
+
+    def shutdown(self, drain=True, timeout=None):
+        """Shut every replica down (draining by default) and join the
+        background drainers from past swaps."""
+        with self._lock:
+            engines = [r.engine for e in self._models.values()
+                       for r in e.replicas]
+            drainers = list(self._drainers)
+        ok = True
+        for e in engines:
+            ok = bool(e.shutdown(drain=drain, timeout=timeout)) and ok
+        for t in drainers:
+            t.join(timeout)
+            ok = ok and not t.is_alive()
+        return ok
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.shutdown(drain=exc_type is None)
+        return False

@@ -1,0 +1,982 @@
+"""Continuous-batching decode drills: the slot-based decode engine's A/B
+fetch-equivalence against whole-batch lockstep beam decode (same tokens,
+same scores, under randomized join/leave order), fault isolation of a
+poisoned slot (FaultInjector NaN drill), admission control, the windowed
+stats signal, the StepHandle executor surface, and the multi-replica
+router (least-loaded dispatch, per-model quotas, typed overload
+propagation, zero-downtime hot swap).
+
+All tests run on the CPU platform; continuous batching is host-side slot
+scheduling around one jitted step module, so nothing here is
+TPU-specific. Marker: `decode` (pytest -m decode); the three-replica
+router drill is additionally `slow`.
+"""
+import concurrent.futures
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.fluid.layers as layers
+from paddle_tpu import inference, obs, serving
+from paddle_tpu.fluid import framework
+from paddle_tpu.fluid.executor import Scope
+from paddle_tpu.obs import report as obs_report
+from paddle_tpu.serving import (DecodeConfig, DecodeEngine,
+                                DecodeSlotPoisoned, LockstepDecoder,
+                                ModelOverloaded, Router, UnknownModel,
+                                program_prefill)
+from paddle_tpu.serving.engine import (DeadlineExceeded, ServerClosed,
+                                       ServerOverloaded)
+from paddle_tpu.utils.faults import FaultInjector
+
+from util import fresh_program
+
+pytestmark = pytest.mark.decode
+
+# one small decoder shared by the whole module: V tokens, E-dim target
+# embedding, D-dim encoder rows, H-dim LSTM, beam K
+V, E, D, H, K = 20, 8, 6, 8, 3
+SRC = 5          # src_cap
+MAXLEN = 8
+
+
+def _weights(rng):
+    return {
+        'w_dec': (rng.randn(E + D, 4 * H) * 0.3).astype(np.float32),
+        'u_dec': (rng.randn(H, 4 * H) * 0.3).astype(np.float32),
+        'b_dec': (rng.randn(1, 4 * H) * 0.1).astype(np.float32),
+        'w_q': (rng.randn(H, D) * 0.3).astype(np.float32),
+        'w_emb': (rng.randn(V, E) * 0.3).astype(np.float32),
+        'w_out': (rng.randn(H, V) * 0.3).astype(np.float32),
+        'b_out': (rng.randn(1, V) * 0.1).astype(np.float32),
+    }
+
+
+WEIGHTS = _weights(np.random.RandomState(7))
+
+# lockstep A/B references, one compile per distinct max_len for the whole
+# module (the op reads, never writes, so reuse across tests is safe)
+_LS = {}
+
+
+def lockstep(max_len):
+    if max_len not in _LS:
+        _LS[max_len] = LockstepDecoder(WEIGHTS, beam_size=K,
+                                       max_len=max_len, src_cap=SRC)
+    return _LS[max_len]
+
+
+def _encs(rng, n, lo=2):
+    return [(rng.randn(rng.randint(lo, SRC + 1), D) * 0.5)
+            .astype(np.float32) for _ in range(n)]
+
+
+def _lockstep_ref(encs, max_len):
+    """Batched lockstep reference rows for a list of [S, D] encoder
+    row-sets: (ids [n, K, max_len], scores [n, K])."""
+    lens = np.asarray([e.shape[0] for e in encs], np.int32)
+    enc = np.zeros((len(encs), SRC, D), np.float32)
+    for i, e in enumerate(encs):
+        enc[i, :e.shape[0]] = e
+    return lockstep(max_len).run(enc, lens)
+
+
+def _engine(slots=4, max_len=MAXLEN, **kw):
+    return DecodeEngine(WEIGHTS, DecodeConfig(
+        slots=slots, beam_size=K, max_len=max_len, src_cap=SRC, **kw))
+
+
+def _wait(cond, timeout=60.0):
+    """Poll until cond() — the admission drills must not race the decode
+    loop's queue pop (a request is 'queued' only once the one in front
+    of it holds the slot)."""
+    t0 = time.monotonic()
+    while not cond():
+        assert time.monotonic() - t0 < timeout, 'condition never held'
+        time.sleep(0.002)
+
+
+@pytest.fixture
+def obs_events(tmp_path):
+    """Run-log reader: drills verify behavior AND that an operator could
+    have seen it happen (docs/serving.md event catalog)."""
+    obs.enable(str(tmp_path / 'obs'))
+
+    def read(name=None):
+        path = obs.run_log_path()
+        if path is None:
+            return []
+        events, errors = obs_report.load_events(path)
+        assert errors == [], errors
+        return [e for e in events if name is None or e['name'] == name]
+
+    try:
+        yield read
+    finally:
+        obs._reset()
+
+
+# ---------------------------------------------------------------------------
+# A/B: continuous slot decode is fetch-equivalent to lockstep beam decode
+# ---------------------------------------------------------------------------
+
+def test_more_requests_than_slots_bit_exact():
+    """6 requests over 4 slots: releases refill slots mid-flight, yet
+    every request's tokens AND scores match the whole-batch lockstep op
+    bit for bit (row independence of the shared step body)."""
+    encs = _encs(np.random.RandomState(0), 6)
+    ids_ref, sc_ref = _lockstep_ref(encs, MAXLEN)
+    eng = _engine(slots=4)
+    try:
+        eng.warmup()
+        futs = [eng.submit({'enc': e}) for e in encs]
+        for i, f in enumerate(futs):
+            toks, acc = f.result(60)
+            assert np.array_equal(toks, ids_ref[i])
+            assert np.array_equal(acc, sc_ref[i])
+        st = eng.stats
+        assert st['completed'] == 6 and st['slots_occupied'] == 0
+    finally:
+        eng.shutdown()
+
+
+@pytest.mark.parametrize('seed', [0, 1])
+def test_ab_randomized_join_leave(seed):
+    """THE acceptance drill: mixed per-request token limits submitted in
+    randomized order with staggered timing over a 2-slot pool — maximum
+    join/leave churn — and every request still emits exactly the tokens
+    and scores the lockstep decode with max_len=its limit produces.
+    Slot assignment, join step, and batch composition must be
+    invisible."""
+    rng = np.random.RandomState(seed)
+    limits = (4, MAXLEN)
+    encs = _encs(rng, 10)
+    lim = [limits[rng.randint(len(limits))] for _ in encs]
+    refs = {}
+    for L in limits:
+        grp = [i for i in range(len(encs)) if lim[i] == L]
+        if grp:
+            ids, sc = _lockstep_ref([encs[i] for i in grp], L)
+            for j, i in enumerate(grp):
+                refs[i] = (ids[j], sc[j])
+    order = rng.permutation(len(encs))
+    eng = _engine(slots=2)
+    try:
+        eng.warmup()
+        futs = {}
+        for i in order:
+            futs[i] = eng.submit({'enc': encs[i]}, max_new_tokens=lim[i])
+            if rng.rand() < 0.5:
+                time.sleep(rng.rand() * 0.01)
+        for i, f in futs.items():
+            toks, acc = f.result(60)
+            ids_ref, sc_ref = refs[i]
+            assert toks.shape == (K, lim[i])
+            assert np.array_equal(toks, ids_ref), 'request %d tokens' % i
+            assert np.array_equal(acc, sc_ref), 'request %d scores' % i
+    finally:
+        eng.shutdown()
+
+
+@pytest.mark.parametrize('bundle', [3, 8])
+def test_bundled_decode_bit_exact(bundle):
+    """bundle>1 runs K decode steps inside one dispatched module (the
+    PR 4 K-step-bundling move); slots finishing mid-bundle freeze
+    in-graph, so tokens and scores stay bit-identical to bundle=1 and to
+    lockstep — including limits that do NOT divide the bundle."""
+    rng = np.random.RandomState(11)
+    encs = _encs(rng, 7)
+    lims = [3, MAXLEN, 5, MAXLEN, 1, 7, MAXLEN]
+    refs = {}
+    for L in sorted(set(lims)):
+        grp = [i for i in range(len(encs)) if lims[i] == L]
+        ids, sc = _lockstep_ref([encs[i] for i in grp], L)
+        for j, i in enumerate(grp):
+            refs[i] = (ids[j], sc[j])
+    eng = _engine(slots=2, bundle=bundle)
+    try:
+        eng.warmup()
+        futs = [eng.submit({'enc': e}, max_new_tokens=l)
+                for e, l in zip(encs, lims)]
+        for i, f in enumerate(futs):
+            toks, acc = f.result(60)
+            assert np.array_equal(toks, refs[i][0]), (bundle, i)
+            assert np.array_equal(acc, refs[i][1]), (bundle, i)
+        # a bundle dispatch advances up to `bundle` tokens per slot
+        assert eng.stats['steps'] < eng.stats['tokens']
+    finally:
+        eng.shutdown()
+
+
+def test_decode_config_validates_bundle():
+    with pytest.raises(ValueError, match='bundle'):
+        DecodeConfig(max_len=8, bundle=9)
+    with pytest.raises(ValueError, match='bundle'):
+        DecodeConfig(bundle=0)
+
+
+def test_program_prefill_ab():
+    """Admission through an encoder Program (bucketed prefill batches)
+    feeds the same slot state the direct-enc path would: bit-exact
+    against lockstep over the prefill's own encoder output."""
+    rng = np.random.RandomState(3)
+    with fresh_program() as (main, startup):
+        src = layers.data(name='src', shape=[1], dtype='int64',
+                          lod_level=1)
+        emb = layers.embedding(input=src, size=[V, E])
+        enc = layers.fc(input=emb, size=D, num_flatten_dims=2)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+        pre = program_prefill(exe, main, scope, 'src', enc, token_cap=SRC)
+        feeds = [{'src': rng.randint(0, V, (rng.randint(2, SRC + 1),))}
+                 for _ in range(5)]
+        enc_out, lens = pre(feeds)
+        assert enc_out.shape == (5, SRC, D)
+        ids_ref, sc_ref = lockstep(MAXLEN).run(enc_out, lens)
+        eng = DecodeEngine(WEIGHTS, DecodeConfig(
+            slots=2, beam_size=K, max_len=MAXLEN, src_cap=SRC),
+            prefill=pre)
+        try:
+            assert eng.warmup(example_feed=feeds[0]) == [1, 2]
+            futs = [eng.submit(f) for f in feeds]
+            for i, f in enumerate(futs):
+                toks, acc = f.result(60)
+                assert np.array_equal(toks, ids_ref[i])
+                assert np.array_equal(acc, sc_ref[i])
+        finally:
+            eng.shutdown()
+
+
+def test_zero_steady_state_compiles():
+    """After warmup() the decode engine's signature set is closed: a
+    mixed-length request stream adds ZERO compiled-module cache misses
+    (the acceptance criterion's cache_stats assertion)."""
+    eng = _engine(slots=4)
+    try:
+        eng.warmup()
+        misses0 = eng.cache_stats()['misses']
+        rng = np.random.RandomState(5)
+        futs = [eng.submit({'enc': e}, max_new_tokens=int(rng.randint(
+            1, MAXLEN + 1))) for e in _encs(rng, 8)]
+        for f in futs:
+            f.result(60)
+        assert eng.cache_stats()['misses'] == misses0
+    finally:
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# fault isolation
+# ---------------------------------------------------------------------------
+
+def test_poisoned_slot_fault_drill(obs_events):
+    """FaultInjector drill: one request's encoder rows are NaN-poisoned.
+    Only ITS future fails (typed DecodeSlotPoisoned), the slot is freed
+    and reusable, and every healthy in-flight request still matches the
+    lockstep reference bit for bit."""
+    fi = FaultInjector(seed=0)
+    encs = _encs(np.random.RandomState(1), 3)
+    ids_ref, sc_ref = _lockstep_ref(encs, MAXLEN)
+    bad = fi.poison_nan(np.asarray(encs[0]), rate=1.0)
+    assert np.isnan(bad).any()
+    eng = _engine(slots=4)
+    try:
+        eng.warmup()
+        good = [eng.submit({'enc': e}) for e in encs]
+        poisoned = eng.submit({'enc': bad})
+        with pytest.raises(DecodeSlotPoisoned, match='fails|aborted'):
+            poisoned.result(60)
+        for i, f in enumerate(good):
+            toks, acc = f.result(60)
+            assert np.array_equal(toks, ids_ref[i])
+            assert np.array_equal(acc, sc_ref[i])
+        st = eng.stats
+        assert st['poisoned'] == 1 and st['completed'] == 3
+        assert st['slots_occupied'] == 0      # the slot was freed ...
+        toks, _ = eng.submit({'enc': encs[0]}).result(60)
+        assert np.array_equal(toks, ids_ref[0])   # ... and reusable
+        ev = obs_events('decode.poisoned')
+        assert len(ev) == 1 and ev[0]['fields']['steps'] >= 1
+    finally:
+        eng.shutdown()
+
+
+def test_prefill_failure_fails_only_joiners(obs_events):
+    """A prefill fault (flaky encoder) fails the joining requests'
+    futures — in-flight slots and later admissions are untouched."""
+    arm = {'fail': 0}
+
+    def prefill(feeds):
+        if arm['fail']:
+            arm['fail'] -= 1
+            raise RuntimeError('injected prefill fault')
+        lens = np.asarray([f['enc'].shape[0] for f in feeds], np.int32)
+        enc = np.zeros((len(feeds), SRC, D), np.float32)
+        for i, f in enumerate(feeds):
+            enc[i, :lens[i]] = f['enc']
+        return enc, lens
+
+    encs = _encs(np.random.RandomState(2), 2)
+    ids_ref, _ = _lockstep_ref(encs, MAXLEN)
+    eng = DecodeEngine(WEIGHTS, DecodeConfig(
+        slots=2, beam_size=K, max_len=MAXLEN, src_cap=SRC),
+        prefill=prefill)
+    try:
+        eng.warmup(example_feed={'enc': encs[0]})
+        arm['fail'] = 1
+        doomed = eng.submit({'enc': encs[0]})
+        with pytest.raises(RuntimeError, match='injected prefill fault'):
+            doomed.result(60)
+        toks, _ = eng.submit({'enc': encs[1]}).result(60)
+        assert np.array_equal(toks, ids_ref[1])
+        assert len(obs_events('decode.prefill.error')) == 1
+    finally:
+        eng.shutdown()
+
+
+def test_malformed_prefill_fails_only_joiners():
+    """A prefill returning too FEW rows (or misshapen src_len) fails the
+    joining futures with a clear error — it must neither broadcast
+    silently into other joiners' masks nor reach the decode loop's
+    crash guard (which would kill the whole engine)."""
+    state = {'short': False}
+
+    def prefill(feeds):
+        # short mode: ALWAYS one row fewer than asked, whatever the
+        # batch split — every affected join batch is malformed
+        n = max(0, len(feeds) - 1) if state['short'] else len(feeds)
+        return (np.zeros((n, SRC, D), np.float32),
+                np.full(n, 2, np.int32))
+
+    eng = DecodeEngine(WEIGHTS, DecodeConfig(
+        slots=4, beam_size=K, max_len=4, src_cap=SRC), prefill=prefill)
+    try:
+        eng.warmup(example_feed={'x': 0})
+        state['short'] = True
+        doomed = [eng.submit({'x': i}) for i in range(2)]
+        failed = 0
+        for f in doomed:
+            try:
+                f.result(60)
+            except ValueError as e:
+                assert 'prefill returned' in str(e)
+                failed += 1
+        assert failed >= 1          # the short batch's joiners failed
+        state['short'] = False      # engine survived: next request runs
+        toks, _ = eng.submit({'x': 9}).result(60)
+        assert toks.shape == (K, 4)
+    finally:
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+def test_reject_policy_and_validation(obs_events):
+    eng = _engine(slots=1, max_len=64, queue_capacity=1,
+                  overflow='reject')
+    try:
+        with pytest.raises(ValueError, match='out of range'):
+            eng.submit({'enc': np.zeros((2, D), np.float32)},
+                       max_new_tokens=0)
+        with pytest.raises(ValueError, match='out of range'):
+            eng.submit({'enc': np.zeros((2, D), np.float32)},
+                       max_new_tokens=65)
+        with pytest.raises(ValueError, match="carry 'enc'"):
+            eng.submit({'x': np.zeros((2, D), np.float32)})
+        with pytest.raises(ValueError, match='must be'):
+            eng.submit({'enc': np.zeros((2, D + 1), np.float32)})
+        # no warmup: the first step's compile keeps the slot busy long
+        # enough for the queue to fill deterministically
+        e = np.zeros((2, D), np.float32)
+        eng.submit({'enc': e})                    # -> slot
+        _wait(lambda: eng.stats['joins'] == 1)
+        eng.submit({'enc': e})                    # -> queue (cap 1)
+        with pytest.raises(ServerOverloaded, match='reject'):
+            eng.submit({'enc': e})
+        assert len(obs_events('decode.reject')) == 1
+        assert eng.stats['rejected'] == 1
+    finally:
+        eng.shutdown()
+
+
+def test_block_policy_submit_timeout():
+    eng = _engine(slots=1, max_len=64, queue_capacity=1, overflow='block')
+    try:
+        e = np.zeros((2, D), np.float32)
+        eng.submit({'enc': e})
+        _wait(lambda: eng.stats['joins'] == 1)
+        eng.submit({'enc': e})
+        t0 = time.monotonic()
+        with pytest.raises(ServerOverloaded, match='stayed full'):
+            eng.submit({'enc': e}, timeout=0.05)
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        eng.shutdown()
+
+
+def test_deadline_expired_requests_shed(obs_events):
+    """A queued request whose deadline passes before a slot opens is
+    shed with the typed DeadlineExceeded; the running request and later
+    submits are unaffected."""
+    eng = _engine(slots=1, max_len=64)
+    try:
+        e = np.zeros((2, D), np.float32)
+        running = eng.submit({'enc': e})           # occupies the slot
+        _wait(lambda: eng.stats['joins'] == 1)
+        doomed = eng.submit({'enc': e}, deadline_ms=1)
+        with pytest.raises(DeadlineExceeded, match='shed'):
+            doomed.result(60)
+        running.result(60)
+        assert eng.stats['shed'] == 1
+        assert len(obs_events('decode.shed')) == 1
+    finally:
+        eng.shutdown()
+
+
+def test_predict_timeout_is_typed():
+    eng = _engine(slots=1, max_len=64)
+    try:
+        e = np.zeros((2, D), np.float32)
+        eng.submit({'enc': e})
+        _wait(lambda: eng.stats['joins'] == 1)
+        with pytest.raises(DeadlineExceeded):
+            eng.predict({'enc': e}, timeout=0.01)
+    finally:
+        eng.shutdown()
+
+
+def test_shutdown_drains_no_lost_futures():
+    eng = _engine(slots=2)
+    futs = [eng.submit({'enc': e})
+            for e in _encs(np.random.RandomState(4), 6)]
+    assert eng.shutdown(drain=True, timeout=120)
+    for f in futs:
+        toks, acc = f.result(0)
+        assert toks.shape == (K, MAXLEN) and np.isfinite(acc).all()
+    with pytest.raises(ServerClosed):
+        eng.submit({'enc': np.zeros((2, D), np.float32)})
+
+
+def test_shutdown_without_drain_fails_queued():
+    eng = _engine(slots=1, max_len=64)
+    e = np.zeros((2, D), np.float32)
+    inflight = eng.submit({'enc': e})
+    _wait(lambda: eng.stats['joins'] == 1)
+    queued = [eng.submit({'enc': e}) for _ in range(3)]
+    assert eng.shutdown(drain=False, timeout=120)
+    inflight.result(0)                    # in-flight always completes
+    for f in queued:
+        with pytest.raises(ServerClosed):
+            f.result(0)
+
+
+# ---------------------------------------------------------------------------
+# stats: cumulative + the windowed admission-pressure signal
+# ---------------------------------------------------------------------------
+
+def test_decode_stats_window_resets_on_read():
+    eng = _engine(slots=2)
+    try:
+        eng.warmup()
+        futs = [eng.submit({'enc': e})
+                for e in _encs(np.random.RandomState(6), 4)]
+        for f in futs:
+            f.result(60)
+        w1 = eng.stats_window()
+        assert w1['submitted'] == 4 and w1['completed'] == 4
+        assert w1['queue_high_water'] >= 1 and w1['tokens'] > 0
+        # 'capacity' = admission queue capacity (same units as
+        # ServingEngine's window); the slot pool reports separately
+        assert w1['capacity'] == eng.config.queue_capacity
+        assert w1['slots'] == 2
+        w2 = eng.stats_window()           # the read reset the window
+        assert w2['submitted'] == 0 and w2['queue_high_water'] == 0
+        assert eng.stats['submitted'] == 4    # cumulative view unchanged
+    finally:
+        eng.shutdown()
+
+
+class _FakeModel(object):
+    """Host-side ServingEngine stand-in (no compiled path)."""
+    feed_names = ['x']
+    fetch_names = ['out']
+
+    def run(self, feed):
+        return [np.asarray(feed['x']) * 2.0]
+
+
+def test_serving_engine_windowed_stats():
+    """The PR's ServingEngine.stats fix: the admission-queue high-water
+    mark and shed/reject counts are surfaced cumulatively in stats AND
+    as a since-last-call window — instantaneous depth alone reads zero
+    between bursts."""
+    eng = serving.ServingEngine(_FakeModel(), serving.ServingConfig(
+        max_batch_size=4, max_queue_delay_ms=200, queue_capacity=2,
+        overflow='reject'))
+    try:
+        x = np.zeros((1, 2), np.float32)
+        futs = [eng.submit({'x': x}) for _ in range(2)]
+        rejected = 0
+        try:
+            eng.submit({'x': x})
+        except ServerOverloaded:
+            rejected = 1
+        for f in futs:
+            f.result(30)
+        st = eng.stats
+        assert st['queue_high_water'] >= 1
+        assert 'inflight' in st
+        w1 = eng.stats_window()
+        assert w1['submitted'] == 2 and w1['rejected'] == rejected
+        assert w1['queue_high_water'] >= 1
+        w2 = eng.stats_window()
+        assert w2['submitted'] == 0 and w2['queue_high_water'] == 0
+        assert eng.stats['submitted'] == 2    # cumulative survives reads
+    finally:
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# StepHandle: the pinned per-step executor surface under the engine
+# ---------------------------------------------------------------------------
+
+def test_acquire_step_requires_initialized_state():
+    prog = framework.Program()
+    blk = prog.global_block()
+    x = blk.create_var(name='sh_x', shape=[2, 2], dtype='float32',
+                       persistable=True)
+    blk.append_op(type='scale', inputs={'X': [x]}, outputs={'Out': [x]},
+                  attrs={'scale': 2.0, 'bias': 0.0,
+                         'bias_after_scale': True})
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = Scope()
+    with pytest.raises(ValueError, match='no scope value'):
+        exe.acquire_step(prog, fetch_list=[], scope=scope)
+
+
+def test_step_handle_donates_and_syncs_scope():
+    import jax.numpy as jnp
+    prog = framework.Program()
+    blk = prog.global_block()
+    x = blk.create_var(name='sh2_x', shape=[2, 2], dtype='float32',
+                       persistable=True)
+    blk.append_op(type='scale', inputs={'X': [x]}, outputs={'Out': [x]},
+                  attrs={'scale': 2.0, 'bias': 0.0,
+                         'bias_after_scale': True})
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = Scope()
+    scope.vars['sh2_x'] = jnp.ones((2, 2), jnp.float32)
+    handle = exe.acquire_step(prog, fetch_list=[], scope=scope)
+    assert handle._compiled.plan.donates       # written -> donated
+    handle.step()
+    handle.step()
+    np.testing.assert_array_equal(np.asarray(scope.vars['sh2_x']),
+                                  np.full((2, 2), 4.0, np.float32))
+    np.testing.assert_array_equal(np.asarray(handle.state['sh2_x']),
+                                  np.full((2, 2), 4.0, np.float32))
+    handle.set_state('sh2_x', jnp.zeros((2, 2), jnp.float32))
+    handle.step()
+    np.testing.assert_array_equal(np.asarray(scope.vars['sh2_x']),
+                                  np.zeros((2, 2), np.float32))
+    with pytest.raises(KeyError, match='no persistable'):
+        handle.set_state('nope', jnp.zeros((1,)))
+    assert handle.steps == 3
+
+
+def test_step_handle_detects_foreign_scope_writes():
+    """A pinned handle must be the ONLY driver of its (program, scope):
+    another run() over the same pair re-collects and donates the scope
+    buffers the handle still holds. The handle detects the foreign
+    write and raises a clear error instead of dying opaquely (or
+    silently diverging on CPU, where donation is a no-op)."""
+    import jax.numpy as jnp
+    prog = framework.Program()
+    blk = prog.global_block()
+    x = blk.create_var(name='sh3_x', shape=[2, 2], dtype='float32',
+                       persistable=True)
+    blk.append_op(type='scale', inputs={'X': [x]}, outputs={'Out': [x]},
+                  attrs={'scale': 2.0, 'bias': 0.0,
+                         'bias_after_scale': True})
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = Scope()
+    scope.vars['sh3_x'] = jnp.ones((2, 2), jnp.float32)
+    handle = exe.acquire_step(prog, fetch_list=[], scope=scope)
+    handle.step()
+    exe.run(prog, fetch_list=[], scope=scope)      # foreign driver
+    with pytest.raises(RuntimeError, match='re-acquire_step'):
+        handle.step()
+    handle2 = exe.acquire_step(prog, fetch_list=[], scope=scope)
+    handle2.step()                                  # recovery path
+    np.testing.assert_array_equal(np.asarray(scope.vars['sh3_x']),
+                                  np.full((2, 2), 8.0, np.float32))
+
+
+# ---------------------------------------------------------------------------
+# the exported step-form artifact lints clean
+# ---------------------------------------------------------------------------
+
+def test_export_step_program_lints_clean(tmp_path):
+    """The step-form decode Program saved as an ordinary __model__
+    artifact passes the program verifier (tools/lint.sh runs the same
+    check over a fresh export)."""
+    import importlib.util
+    import os
+    eng = _engine(slots=2)
+    try:
+        out = eng.export_step_program(str(tmp_path / 'step'))
+    finally:
+        eng.shutdown()
+    assert (tmp_path / 'step').exists()
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        '_decode_program_lint', os.path.join(here, 'tools',
+                                             'program_lint.py'))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main([out]) == 0
+
+
+# ---------------------------------------------------------------------------
+# router: least-loaded dispatch, quotas, typed overload, hot swap
+# ---------------------------------------------------------------------------
+
+class _FakeReplica(object):
+    """Anything with submit()/stats_window()/shutdown() routes. The
+    window dict is test-controlled so dispatch decisions are
+    deterministic."""
+
+    def __init__(self, refuse=False, window=None):
+        self.refuse = refuse
+        self.window = dict(window or {})
+        self.submits = []
+        self.shutdowns = []
+
+    def submit(self, feed, **kwargs):
+        if self.refuse:
+            raise ServerOverloaded('replica full')
+        fut = concurrent.futures.Future()
+        fut.set_result(feed)
+        self.submits.append(feed)
+        return fut
+
+    def stats_window(self):
+        return dict(self.window)
+
+    def shutdown(self, drain=True, timeout=None):
+        self.shutdowns.append(drain)
+        return True
+
+
+def test_router_least_loaded_prefers_idle_replica():
+    busy = _FakeReplica(window={'queue_high_water': 6, 'shed': 2,
+                                'queue_depth': 3, 'inflight': 4})
+    idle = _FakeReplica(window={'queue_high_water': 0, 'shed': 0,
+                                'queue_depth': 0, 'inflight': 0})
+    r = Router(window_s=1e9)          # sample once, then hold the window
+    r.add_model('m', [busy, idle])
+    for i in range(4):
+        r.submit('m', {'i': i}).result(1)
+    assert len(idle.submits) == 4 and len(busy.submits) == 0
+    view = r.stats()['m']
+    assert view['replicas'][1]['routed_since'] == 4
+
+
+def test_router_spreads_consecutive_submits():
+    """routed_since makes back-to-back submits spread over equally idle
+    replicas instead of dogpiling the first one."""
+    a, b = _FakeReplica(), _FakeReplica()
+    r = Router(window_s=1e9)
+    r.add_model('m', [a, b])
+    for i in range(6):
+        r.submit('m', {'i': i})
+    assert len(a.submits) == 3 and len(b.submits) == 3
+
+
+def test_router_quota_typed_overload():
+    a = _FakeReplica()
+    r = Router(window_s=1e9)
+    r.add_model('m', [a], quota=2)
+    r.submit('m', {'i': 0})
+    r.submit('m', {'i': 1})
+    with pytest.raises(ModelOverloaded) as ei:
+        r.submit('m', {'i': 2})
+    assert ei.value.model_id == 'm'
+    assert isinstance(ei.value, ServerOverloaded)   # typed propagation
+    assert len(a.submits) == 2          # quota enforced BEFORE the queue
+
+
+def test_router_retries_next_replica_then_propagates():
+    full_a, full_b = _FakeReplica(refuse=True), _FakeReplica(refuse=True)
+    ok = _FakeReplica()
+    r = Router(window_s=1e9)
+    r.add_model('m', [full_a, ok])
+    assert r.submit('m', {'i': 0}).result(1) == {'i': 0}
+    assert len(ok.submits) == 1         # refused replica was skipped
+    r2 = Router(window_s=1e9)
+    r2.add_model('m', [full_a, full_b])
+    with pytest.raises(ModelOverloaded, match='every replica'):
+        r2.submit('m', {'i': 1})
+    # the provisional routed_since was rolled back on total refusal
+    assert all(rep['routed_since'] == 0
+               for rep in r2.stats()['m']['replicas'])
+
+
+def test_router_unexpected_submit_error_rolls_back_counters():
+    """A non-overload error from a replica's submit (malformed feed)
+    propagates to the caller WITHOUT leaving phantom routed_since bumps
+    that would eat the quota for later valid requests."""
+
+    class _Picky(_FakeReplica):
+        def submit(self, feed, **kwargs):
+            if feed.get('bad'):
+                raise ValueError('malformed feed')
+            return _FakeReplica.submit(self, feed, **kwargs)
+
+    r = Router(window_s=1e9)
+    ok = _FakeReplica()
+    r.add_model('m', [_Picky(), ok], quota=2)
+    # _Picky scores lower-or-equal, so it is tried first
+    with pytest.raises(ValueError, match='malformed feed'):
+        r.submit('m', {'bad': True})
+    assert all(rep['routed_since'] == 0
+               for rep in r.stats()['m']['replicas'])
+    r.submit('m', {'ok': 1})          # quota not eaten by the failure
+    r.submit('m', {'ok': 2})
+
+
+def test_router_predict_timeout_typed_and_cancels():
+    class _Stuck(_FakeReplica):
+        def submit(self, feed, **kwargs):
+            self.fut = concurrent.futures.Future()   # never resolves
+            return self.fut
+
+    stuck = _Stuck()
+    r = Router(window_s=1e9)
+    r.add_model('m', [stuck])
+    with pytest.raises(DeadlineExceeded):
+        r.predict('m', {'i': 0}, timeout=0.05)
+    assert stuck.fut.cancelled()      # stops holding quota
+
+
+def test_router_closed_model_is_not_overloaded():
+    """A model whose every replica is permanently shut down raises
+    ServerClosed (a dead backend), NOT ModelOverloaded (a transient
+    retry-me signal)."""
+
+    class _Closed(_FakeReplica):
+        def submit(self, feed, **kwargs):
+            raise ServerClosed('engine is shut down')
+
+    r = Router(window_s=1e9)
+    r.add_model('m', [_Closed(), _Closed()])
+    with pytest.raises(ServerClosed):
+        r.submit('m', {'i': 0})
+
+
+def test_router_unknown_model():
+    r = Router()
+    with pytest.raises(UnknownModel):
+        r.submit('ghost', {})
+    with pytest.raises(UnknownModel):
+        r.swap('ghost', '/nope')
+
+
+def test_router_swap_builder_cutover_and_drain(obs_events):
+    old_a, old_b = _FakeReplica(), _FakeReplica()
+    r = Router(window_s=1e9)
+    r.add_model('m', [old_a, old_b])
+    r.submit('m', {'gen': 1})
+
+    class _New(_FakeReplica):
+        def warmup(self, example_feed=None):
+            self.warmed = True
+            return [1]
+
+    new = []
+
+    def builder(path):
+        assert path == '/v2'
+        eng = _New()
+        new.append(eng)
+        return eng
+
+    assert r.swap('m', '/v2', builder=builder) == 2
+    assert len(new) == 2 and all(e.warmed for e in new)
+    r.submit('m', {'gen': 2})
+    assert not any(s == {'gen': 2} for s in old_a.submits + old_b.submits)
+    assert sum(len(e.submits) for e in new) == 1
+    assert r.shutdown(timeout=30)
+    # old generation drained (drain=True), never hard-killed
+    assert old_a.shutdowns == [True] and old_b.shutdowns == [True]
+    ev = obs_events('router.swap')
+    assert len(ev) == 1 and ev[0]['fields']['version'] == 2
+
+
+def test_router_submit_racing_swap_retries_new_generation():
+    """A submit that snapshotted the OLD generation right before a
+    swap() cutover sees only ServerClosed from the drained replicas; it
+    must re-resolve the replica list once and land on the warmed-up new
+    generation instead of raising ModelOverloaded (zero downtime)."""
+    from paddle_tpu.serving.router import _Replica
+
+    r = Router(window_s=1e9)
+    fresh = _FakeReplica()
+
+    class _DrainedMidFlight(_FakeReplica):
+        def submit(self, feed, **kwargs):
+            # the cutover lands between the router's snapshot and this
+            # call: the entry now serves the new generation, and this
+            # old replica is already draining
+            r._models['m'].replicas = [_Replica(fresh)]
+            raise ServerClosed('draining after swap')
+
+    r.add_model('m', [_DrainedMidFlight()])
+    assert r.submit('m', {'i': 0}).result(1) == {'i': 0}
+    assert fresh.submits == [{'i': 0}]
+    # a PERSISTENTLY closed model still fails typed (no retry loop)
+    r2 = Router(window_s=1e9)
+    r2.add_model('m', [_FakeReplica(refuse=True)])
+    with pytest.raises(ModelOverloaded):
+        r2.submit('m', {'i': 1})
+
+
+def test_no_drain_shutdown_callback_reenters_engine():
+    """Queued futures failed by a no-drain shutdown resolve OUTSIDE the
+    engine lock: a done-callback that re-enters the engine (reads
+    stats) must not deadlock the decode loop."""
+    eng = _engine(slots=1, max_len=64)
+    e = np.zeros((2, D), np.float32)
+    inflight = eng.submit({'enc': e})
+    _wait(lambda: eng.stats['joins'] == 1)
+    queued = eng.submit({'enc': e})
+    reentered = []
+    queued.add_done_callback(
+        lambda f: reentered.append(eng.stats['submitted']))
+    assert eng.shutdown(drain=False, timeout=120)
+    with pytest.raises(ServerClosed):
+        queued.result(0)
+    inflight.result(0)
+    assert reentered == [2]
+
+
+def test_router_swap_failure_keeps_old_generation():
+    old = _FakeReplica()
+    r = Router(window_s=1e9)
+    r.add_model('m', [old])
+
+    def bad_builder(path):
+        raise IOError('artifact missing')
+
+    with pytest.raises(IOError):
+        r.swap('m', '/broken', builder=bad_builder)
+    assert r.models()['m']['version'] == 1
+    r.submit('m', {'still': 'served'})
+    assert old.submits == [{'still': 'served'}]
+
+
+def test_router_swap_compiled_artifact(tmp_path):
+    """The default swap path end to end: export_compiled artifact ->
+    load_compiled -> ServingEngine -> warmup -> atomic cutover, with
+    traffic before and after (ROADMAP item 2's zero-downtime half)."""
+    with fresh_program() as (main, startup):
+        x = layers.data(name='x', shape=[6])
+        pred = layers.fc(input=x, size=2)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        xv = np.random.RandomState(3).rand(4, 6).astype('float32')
+        inference.export_compiled(str(tmp_path), {'x': xv}, [pred], exe,
+                                  main_program=main)
+        want, = exe.run(main.clone(for_test=True).prune([pred]),
+                        feed={'x': xv}, fetch_list=[pred])
+    cfg = serving.ServingConfig(max_batch_size=4, buckets=[4],
+                                max_queue_delay_ms=5)
+    eng = serving.ServingEngine(inference.load_compiled(str(tmp_path)),
+                                cfg)
+    eng.warmup()
+    r = Router(window_s=1e9)
+    r.add_model('m', [eng])
+    try:
+        out, = r.predict('m', {'x': xv}, timeout=30)
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+        assert r.swap('m', str(tmp_path), config=cfg) == 2
+        out, = r.predict('m', {'x': xv}, timeout=30)
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+        assert r.models()['m']['path'] == str(tmp_path)
+    finally:
+        assert r.shutdown(timeout=60)
+
+
+@pytest.mark.slow
+def test_three_replica_router_decode_drill():
+    """Three continuous-decode replicas behind the router under
+    concurrent mixed-length traffic: every result bit-exact, work spread
+    over every replica, zero steady-state compiles anywhere."""
+    rng = np.random.RandomState(9)
+    encs = _encs(rng, 24)
+    ids_ref, sc_ref = _lockstep_ref(encs, MAXLEN)
+    replicas = [_engine(slots=2) for _ in range(3)]
+    for e in replicas:
+        e.warmup()
+    misses0 = [e.cache_stats()['misses'] for e in replicas]
+    r = Router(window_s=0.02)
+    r.add_model('mt', replicas, quota=200)
+    try:
+        futs = {}
+        lock = threading.Lock()
+
+        def client(idxs):
+            for i in idxs:
+                f = r.submit('mt', {'enc': encs[i]})
+                with lock:
+                    futs[i] = f
+
+        threads = [threading.Thread(target=client,
+                                    args=(range(w, 24, 3),))
+                   for w in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i, f in futs.items():
+            toks, acc = f.result(120)
+            assert np.array_equal(toks, ids_ref[i])
+            assert np.array_equal(acc, sc_ref[i])
+        done = [e.stats['completed'] for e in replicas]
+        assert sum(done) == 24
+        assert all(d > 0 for d in done), done     # least-loaded spread
+        assert [e.cache_stats()['misses'] for e in replicas] == misses0
+    finally:
+        assert r.shutdown(timeout=120)
+
+
+# ---------------------------------------------------------------------------
+# obs_report renders the decode section
+# ---------------------------------------------------------------------------
+
+def test_obs_report_decode_section(tmp_path, obs_events):
+    eng = _engine(slots=2)
+    try:
+        eng.warmup()
+        futs = [eng.submit({'enc': e})
+                for e in _encs(np.random.RandomState(8), 3)]
+        bad = eng.submit({'enc': np.full((2, D), np.nan, np.float32)})
+        for f in futs:
+            f.result(60)
+        with pytest.raises(DecodeSlotPoisoned):
+            bad.result(60)
+    finally:
+        eng.shutdown()
+    text = obs_report.summarize(obs_events())
+    assert '-- decode --' in text
+    assert 'joins: 4' in text
+    assert 'released: 3' in text      # the poisoned slot is counted apart
+    assert 'poisoned: 1' in text
+    assert 'tokens per released request:' in text
+    assert 'shutdown: drained=True' in text

@@ -94,6 +94,15 @@ def test_counter_gauge_histogram_semantics():
     assert snap['count'] == 100 and snap['kind'] == 'histogram'
     assert sum(c for _, c in snap['buckets']) == 100
 
+    # windowed percentile: only the observations BETWEEN two snapshots
+    # count (serve_bench isolates one benchmark rep's TTFT this way)
+    before = h.snapshot()
+    for _ in range(10):
+        h.observe(1.0)
+    after = h.snapshot()
+    assert h.percentile_window(before, after, 50) > 0.5   # no old 10ms
+    assert h.percentile_window(after, after, 50) is None  # empty window
+
     # kind conflicts are loud, not silent corruption
     with pytest.raises(TypeError):
         obs.gauge('t.reg.counter', site='a')

@@ -47,9 +47,12 @@ def add(out, obj):
     for m in obj.get('metrics') or []:       # legacy nested summary
         add(out, m)
     if obj.get('metric') and obj.get('value') is not None:
+        try:
+            v = float(obj['value'])
+        except (TypeError, ValueError):
+            return          # banner/config records carry string values
         out.setdefault(obj['metric'],
-                       (float(obj['value']), obj.get('platform'),
-                        obj.get('mesh_shape')))
+                       (v, obj.get('platform'), obj.get('mesh_shape')))
 
 def metrics_of(path):
     """Per-metric values from either format: raw bench stdout (one JSON
@@ -107,7 +110,17 @@ for name in sorted(set(new) & set(prev)):
         continue
     ratio = nv / pv if pv else float('inf')
     flag = ''
-    if ratio < 0.9:
+    # latency-style metrics (the serve/decode *_ms percentiles, shed/
+    # dropped counts) are LOWER-is-better: a p99 that dropped is an
+    # improvement; a rise is the regression. Throughput metrics
+    # (steps/sec, tokens_per_sec, speedup) keep the higher-is-better
+    # rule.
+    lower_is_better = name.endswith('_ms') or name.endswith('.dropped')
+    if lower_is_better:
+        if ratio > 1.1:
+            flag = '  <-- WARNING: >10%% regression (rise) vs %s' \
+                % prev_path
+    elif ratio < 0.9:
         flag = '  <-- WARNING: >10%% regression vs %s' % prev_path
     print('[compare] %s: %.2f vs %.2f (x%.3f)%s'
           % (name, nv, pv, ratio, flag))

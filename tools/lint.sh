@@ -58,4 +58,34 @@ with unique_name.guard(), framework.program_guard(main, startup):
 print('exported mnist artifact to %s' % out)
 PY
 python tools/program_lint.py "$LINT_DIR" --concurrent
+
+echo "== lint: program_lint on exported step-form decode artifact =="
+python - "$LINT_DIR/decode_step" <<'PY'
+import sys
+
+import numpy as np
+
+from paddle_tpu import serving
+
+out = sys.argv[1]
+rng = np.random.RandomState(0)
+V, E, D, H = 20, 8, 6, 8
+weights = {
+    'w_dec': (rng.randn(E + D, 4 * H) * 0.3).astype(np.float32),
+    'u_dec': (rng.randn(H, 4 * H) * 0.3).astype(np.float32),
+    'b_dec': (rng.randn(1, 4 * H) * 0.1).astype(np.float32),
+    'w_q': (rng.randn(H, D) * 0.3).astype(np.float32),
+    'w_emb': (rng.randn(V, E) * 0.3).astype(np.float32),
+    'w_out': (rng.randn(H, V) * 0.3).astype(np.float32),
+    'b_out': (rng.randn(1, V) * 0.1).astype(np.float32),
+}
+eng = serving.DecodeEngine(weights, serving.DecodeConfig(
+    slots=2, beam_size=3, max_len=8, src_cap=5))
+try:
+    eng.export_step_program(out)
+finally:
+    eng.shutdown()
+print('exported step-form decode artifact to %s' % out)
+PY
+python tools/program_lint.py "$LINT_DIR/decode_step"
 echo "lint: OK"

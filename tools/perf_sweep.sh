@@ -170,4 +170,14 @@ if [ "${SERVE:-0}" = 1 ]; then
       --duration 3 --check-compiles
 fi
 
+# 10. continuous-batching decode vs whole-batch lockstep beam decode
+#     (opt-in: DECODE=1): the open-loop mixed-length stream at equal
+#     batch capacity; --check-speedup enforces the >=1.5x tokens/sec
+#     acceptance bar and --check-compiles the closed-signature-set
+#     contract (decode.* bench.metric records, docs/serving.md).
+if [ "${DECODE:-0}" = 1 ]; then
+  run python tools/serve_bench.py --workload decode --requests 96 \
+      --check-compiles --check-speedup 1.5
+fi
+
 echo "sweep complete; see $LOG" | tee -a "$LOG"
