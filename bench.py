@@ -515,6 +515,107 @@ def bench_gspmd(model, warmup=2, iters=None):
     return sps_dp, sps_1, {'dp': ndev}, batch, gap
 
 
+def bench_embedding(vocab=None, embed_dim=None, num_fields=8, batch=256,
+                    warmup=2, iters=None):
+    """Sharded-embedding phase (docs/embedding.md): a deepfm-style CTR
+    net whose FM tables hold `vocab` rows (default 1e6 — the huge-vocab
+    regime the subsystem exists for), trained two ways on the SAME mesh:
+
+      dense-replicated — tables replicated, is_sparse=False: the
+        backward materializes the full [vocab, dim] gradient and adam
+        walks every row every step;
+      sharded-sparse  — tables row-sharded over the 'model' axis,
+        is_sparse=True + is_distributed=True: the all_to_all lookup wire
+        plus touched-rows-only SparseRows updates per shard.
+
+    Reports steps/sec for both legs, the static rows-touched-per-step
+    bound (a COUNTER metric, not a latency — bench_sentinel treats
+    *_rows_touched as informational), and each leg's compiled-step TEMP
+    footprint from XLA's memory analysis: the dense leg's temporaries
+    carry the vocab-sized gradient chain, the sparse leg's only
+    [rows_touched, dim] blocks — the docs/perf.md touched-rows-only
+    claim extended to the sharded case and measured at 1e6 rows.
+    Returns {leg: {steps_per_sec, temp_bytes, loss}}, rows_touched,
+    mesh dict."""
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework, unique_name
+    from paddle_tpu.fluid.executor import Scope, scope_guard
+    from paddle_tpu.models.deepfm import deepfm
+
+    ndev = len(jax.devices())
+    if vocab is None:
+        vocab = int(os.environ.get('BENCH_EMBED_VOCAB', '1000000'))
+    if embed_dim is None:
+        embed_dim = int(os.environ.get('BENCH_EMBED_DIM', '4'))
+    if iters is None:
+        iters = int(os.environ.get('BENCH_EMBED_ITERS', '6'))
+    from paddle_tpu.embedding import pad_vocab
+    vocab = pad_vocab(vocab, ndev)
+
+    rng = np.random.RandomState(0)
+    feed = {'feat_ids': rng.randint(0, vocab, size=(batch, num_fields))
+            .astype('int64'),
+            'label': rng.randint(0, 2, size=(batch, 1)).astype('int64')}
+
+    def leg(sharded):
+        main, startup = _fresh()
+        with unique_name.guard():
+            with framework.program_guard(main, startup):
+                feat = fluid.layers.data(name='feat_ids',
+                                         shape=[num_fields],
+                                         dtype='int64')
+                label = fluid.layers.data(name='label', shape=[1],
+                                          dtype='int64')
+                cost, _, _ = deepfm(
+                    feat, label, num_fields=num_fields,
+                    vocab_size=vocab, embed_dim=embed_dim, hidden=[64],
+                    dist_axis='model' if sharded else None,
+                    is_sparse=sharded)
+                fluid.optimizer.Adam(learning_rate=1e-3).minimize(cost)
+                main.set_mesh({'model': ndev})
+                sc = Scope()
+                with scope_guard(sc):
+                    exe = fluid.Executor()
+                    exe.run(startup)
+                    for _ in range(warmup):
+                        exe.run(main, feed=feed, fetch_list=[cost])
+                    t0 = time.time()
+                    for _ in range(iters):
+                        loss, = exe.run(main, feed=feed,
+                                        fetch_list=[cost])
+                    dt = time.time() - t0
+                    val = _scalar(np.asarray(loss))
+                    assert np.isfinite(val), val
+                    # compiled-step temp footprint: XLA's memory
+                    # analysis of the EXACT cached step (one extra
+                    # compile per leg; persistent cache absorbs it when
+                    # wired)
+                    rows = exe.embed_rows_per_step(main, feed, [cost],
+                                                   scope=sc) or None
+                    temp = None
+                    try:
+                        mem = exe.compiled_memory_stats(
+                            main, feed, [cost], scope=sc)
+                        temp = int(mem.temp_size_in_bytes)
+                    except Exception as e:
+                        _log('embedding: memory analysis unavailable '
+                             '(%r)' % (e,))
+        return {'steps_per_sec': iters / dt, 'temp_bytes': temp,
+                'loss': val, 'rows_touched': rows}
+
+    _log('embedding: dense-replicated leg (vocab %d, %d devices)...'
+         % (vocab, ndev))
+    dense = leg(False)
+    _log('embedding: sharded-sparse leg...')
+    sparse = leg(True)
+    # rows_touched comes ONLY from the executor's actual sparse plan: a
+    # fabricated fallback here would mask the exact regression (plan
+    # disarmed -> dense [vocab, dim] grad) this metric exists to catch
+    return ({'dense': dense, 'sparse': sparse},
+            sparse['rows_touched'] or 0, {'model': ndev}, vocab, batch)
+
+
 def bench_flash_longcontext(seq_len=32768, heads=8, dim=64, warmup=1,
                             iters=2):
     """Causal flash attention fwd+bwd at 32k context on ONE chip — the
@@ -590,9 +691,16 @@ NAME_F = 'flash_causal_seq32768_tokens_per_sec_per_chip'
 NAME_B = 'fit_a_line_bundled_train_steps_per_sec'
 NAME_G_FAL = 'fit_a_line_gspmd_steps_per_sec'
 NAME_G_MLP = 'mnist_mlp_gspmd_steps_per_sec'
-PHASES = ('transformer', 'resnet', 'bundle', 'gspmd', 'longseq', 'longctx')
+NAME_E_DENSE = 'deepfm_embed_dense_replicated_steps_per_sec'
+NAME_E_SHARD = 'deepfm_embed_sharded_sparse_steps_per_sec'
+NAME_E_ROWS = 'deepfm_embed_rows_touched'
+NAME_E_DTEMP = 'deepfm_embed_dense_step_temp_bytes'
+NAME_E_STEMP = 'deepfm_embed_sharded_step_temp_bytes'
+PHASES = ('transformer', 'resnet', 'bundle', 'gspmd', 'embedding',
+          'longseq', 'longctx')
 PHASE_NAMES = {'transformer': NAME_T, 'resnet': NAME_R, 'bundle': NAME_B,
-               'gspmd': NAME_G_MLP, 'longseq': NAME_L, 'longctx': NAME_F}
+               'gspmd': NAME_G_MLP, 'embedding': NAME_E_SHARD,
+               'longseq': NAME_L, 'longctx': NAME_F}
 
 
 def _tier(platform):
@@ -638,7 +746,7 @@ def run_phase(phase, platform):
     process — the parent's timeout fires, and later phases still run."""
     _PLATFORM[0] = platform
     _FALLBACK[0] = os.environ.get('BENCH_FALLBACK') == '1'
-    if phase == 'gspmd' and platform != 'tpu':
+    if phase in ('gspmd', 'embedding') and platform != 'tpu':
         # the 8-device CPU mesh (the same platform the MULTICHIP dryruns
         # and tests use), with per-device eigen threading off so each
         # virtual device approximates a fixed-capacity chip. Must land
@@ -741,6 +849,64 @@ def run_phase(phase, platform):
                 _log('%s failed: %r' % (metric, e))
                 _emit({'metric': metric, 'skipped': True,
                        'error': str(e)[:300]})
+    elif phase == 'embedding':
+        # sharded-embedding contract metrics (docs/embedding.md): the
+        # huge-vocab CTR workload on the 8-virtual-device mesh. CPU
+        # numbers are VALID — the footprint story (temp bytes, rows
+        # touched) is platform-independent and the steps/sec pair shares
+        # one host either way; the sentinel refuses cross-platform and
+        # cross-mesh comparisons as usual.
+        try:
+            legs, rows, mesh, vocab, batch = bench_embedding()
+            mesh_shape = 'x'.join('%s=%d' % kv
+                                  for kv in sorted(mesh.items()))
+            common = {'platform': platform, 'mesh': mesh,
+                      'mesh_shape': mesh_shape, 'vocab': vocab,
+                      'batch': batch}
+            _emit(dict({'metric': NAME_E_DENSE,
+                        'value': round(legs['dense']['steps_per_sec'], 2),
+                        'unit': 'steps/sec'}, **common))
+            _emit(dict({'metric': NAME_E_SHARD,
+                        'value': round(legs['sparse']['steps_per_sec'], 2),
+                        'unit': 'steps/sec',
+                        'speedup_vs_dense_replicated': round(
+                            legs['sparse']['steps_per_sec']
+                            / legs['dense']['steps_per_sec'], 3)},
+                       **common))
+            # counter metric (not a latency): the static per-step bound
+            # on rows the sparse update touches vs the vocab the dense
+            # update walks. rows=0 means the sparse plan DISARMED (the
+            # leg trained dense): emit the failure loudly, never a
+            # fabricated bound.
+            if rows:
+                _emit(dict({'metric': NAME_E_ROWS, 'value': int(rows),
+                            'unit': 'rows/step',
+                            'vocab_rows_dense_walks': vocab}, **common))
+            else:
+                _emit({'metric': NAME_E_ROWS, 'skipped': True,
+                       'error': 'sparse plan inactive — the sharded leg '
+                                'trained with DENSE table gradients'})
+            for nm, lg in ((NAME_E_DTEMP, 'dense'),
+                           (NAME_E_STEMP, 'sparse')):
+                tb = legs[lg]['temp_bytes']
+                if tb is None:
+                    _emit({'metric': nm, 'skipped': True,
+                           'error': 'memory_analysis unavailable'})
+                else:
+                    _emit(dict({'metric': nm, 'value': int(tb),
+                                'unit': 'bytes'}, **common))
+            if (legs['dense']['temp_bytes']
+                    and legs['sparse']['temp_bytes']):
+                _log('embedding: temp footprint dense %.1f MB vs '
+                     'sharded-sparse %.1f MB (%.1fx)' % (
+                         legs['dense']['temp_bytes'] / 2 ** 20,
+                         legs['sparse']['temp_bytes'] / 2 ** 20,
+                         legs['dense']['temp_bytes']
+                         / max(1, legs['sparse']['temp_bytes'])))
+        except Exception as e:
+            _log('%s failed: %r' % (NAME_E_SHARD, e))
+            _emit({'metric': NAME_E_SHARD, 'skipped': True,
+                   'error': str(e)[:300]})
     elif phase == 'longseq':
         _transformer_metric(NAME_L, 8, 1024, t['iters'], t['use_amp'],
                             platform)

@@ -39,6 +39,31 @@ def force_platform(args):
         jax.config.update('jax_platforms', 'cpu')
 
 
+def claim_devices(n=8):
+    """Provision n virtual CPU devices for a mesh example. Must run
+    before any jax device query: the device count cannot change after
+    backend init. No-op when a backend is already up (the test harness
+    pre-provisions its own 8-device mesh)."""
+    import jax
+    try:
+        from jax._src import xla_bridge as _xb
+        if getattr(_xb, '_backends', None):
+            return
+    except Exception:
+        pass
+    jax.config.update('jax_platforms', 'cpu')
+    try:
+        jax.config.update('jax_num_cpu_devices', n)
+    except AttributeError:
+        # older jax: the XLA flag is the portable spelling, read at
+        # backend init (which has not happened yet — see guard above)
+        flags = os.environ.get('XLA_FLAGS', '')
+        if '--xla_force_host_platform_device_count' not in flags:
+            os.environ['XLA_FLAGS'] = (
+                flags + ' --xla_force_host_platform_device_count=%d'
+                % n).strip()
+
+
 def fresh_session():
     """Reset the process-global default programs, scope, and name counters
     so several examples can run in one interpreter (each script is its own

@@ -1,0 +1,48 @@
+"""paddle_tpu.embedding — sharded huge-vocab embedding tables (docs/embedding.md).
+
+The reference served millions-of-users recommendation by splitting
+`lookup_table` rows across parameter servers (DistributeTranspiler +
+gRPC prefetch). This package is that role rebuilt TPU-native on the
+first-class GSPMD surface:
+
+  * the TABLE is an ordinary parameter row-sharded over a mesh axis —
+    ``ParamAttr(sharding=('model', None))`` (or `table_attr` below) on a
+    Program with ``set_mesh({'model': N, ...})``;
+  * the LOOKUP — ``layers.embedding(..., is_sparse=True,
+    is_distributed=True)`` — lowers to the all_to_all wire in
+    `embedding.lookup` (bucket by owning shard, dedup, exchange, gather,
+    return), behind the plain `lookup_table` op: `Executor.run`,
+    `run_bundle`, and `Trainer` need no wrapper;
+  * the UPDATE stays sparse AND sharded: the backward produces a
+    `lowering.SparseRows` (touched rows only) and sgd/adagrad/adam apply
+    per-shard touched-row updates (ops_impl/optim_ops.py) — the dense
+    [vocab, dim] gradient never exists on any device.
+
+Functional surface (usable outside Programs too): `sharded_lookup`,
+`pad_vocab`, `dedup_plan`, `wire_stats`, and `table_attr` /
+`gather_table` helpers for building and exporting sharded models.
+"""
+from .lookup import sharded_lookup, dedup_plan, pad_vocab, wire_stats
+
+__all__ = ['sharded_lookup', 'dedup_plan', 'pad_vocab', 'wire_stats',
+           'table_attr', 'gather_table']
+
+
+def table_attr(name, axis='model', **kwargs):
+    """ParamAttr for a row-sharded embedding table: dim 0 (vocab) over
+    `axis`, the embedding dim whole on every shard."""
+    from ..fluid.param_attr import ParamAttr
+    return ParamAttr(name=name, sharding=(axis, None), **kwargs)
+
+
+def gather_table(scope, name):
+    """Materialize a (possibly mesh-sharded) table on the host as one
+    numpy array — the export seam: after sharded training, inference
+    artifacts (`export_compiled` / `save_inference_model`) trace against
+    single-device values, so the trained shards are gathered once here,
+    not inside the serving path."""
+    import numpy as np
+    holder = scope.find_var(name)
+    if holder is None:
+        raise KeyError('no variable %r in scope' % name)
+    return np.asarray(holder.get_tensor())

@@ -14,7 +14,7 @@ __all__ = [
     'DANGLING_INPUT', 'WRITE_TO_FEED', 'DEAD_OP', 'UNREACHABLE_FETCH',
     'USE_BEFORE_WRITE', 'SHAPE_MISMATCH', 'DTYPE_MISMATCH',
     'DONATION_UNSAFE', 'SCOPE_RACE', 'SHARDING_INVALID',
-    'SHARDING_UNTILEABLE', 'SHARDING_RESHARD',
+    'SHARDING_UNTILEABLE', 'SHARDING_RESHARD', 'EMBEDDING_UNTILEABLE',
 ]
 
 SEV_ERROR = 'error'       # the program cannot run correctly as lowered
@@ -34,6 +34,12 @@ SCOPE_RACE = 'ScopeRace'                # persistable writes + shared scope
 SHARDING_INVALID = 'ShardingInvalid'        # annotation vs mesh spec
 SHARDING_UNTILEABLE = 'ShardingUntileable'  # mesh cannot tile the dim
 SHARDING_RESHARD = 'ShardingReshard'        # resharding implied mid-pipeline
+# a row-sharded EMBEDDING TABLE whose vocab dim the mesh axis cannot tile:
+# the untileable class specialized for lookup_table weights, where the fix
+# is concrete (pad the vocab — embedding.pad_vocab) and the runtime cost
+# of the fallback is a silent replicate of the one tensor the annotation
+# existed to shard (docs/embedding.md)
+EMBEDDING_UNTILEABLE = 'EmbeddingShardUntileable'
 
 _SEV_ORDER = {SEV_ERROR: 0, SEV_WARNING: 1}
 

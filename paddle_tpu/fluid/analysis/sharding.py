@@ -34,10 +34,27 @@ program. `mesh_axes` overrides the program's own spec — that is how
 `tools/program_lint.py --mesh dpx8,tpx2` lints a saved artifact against a
 deployment mesh it was not annotated with.
 """
-from .findings import (Finding, SEV_ERROR, SEV_WARNING, SHARDING_INVALID,
-                       SHARDING_RESHARD, SHARDING_UNTILEABLE)
+from .findings import (EMBEDDING_UNTILEABLE, Finding, SEV_ERROR,
+                       SEV_WARNING, SHARDING_INVALID, SHARDING_RESHARD,
+                       SHARDING_UNTILEABLE)
 
 __all__ = ['run_pass']
+
+
+def _embedding_tables(program):
+    """Table name -> [lookup_table op] map: vars read through the 'W'
+    slot of a lookup_table anywhere in the program. An untileable
+    annotation on one of THESE is the EmbeddingShardUntileable class —
+    the huge-vocab tensor the sharded-embedding subsystem exists for
+    (docs/embedding.md), where the actionable fix is padding the vocab."""
+    tables = {}
+    for blk in program.blocks:
+        for op in blk.ops:
+            if op.type != 'lookup_table':
+                continue
+            for v in op.inputs.get('W', []):
+                tables.setdefault(v.name, []).append(op)
+    return tables
 
 
 def _annotated_vars(program):
@@ -78,6 +95,7 @@ def run_pass(program, mesh_axes=None):
         axes = dict(mesh_axes)
 
     annotated = list(_annotated_vars(program))
+    emb_tables = _embedding_tables(program)
     if axes is None:
         for v in annotated:
             findings.append(_var_finding(
@@ -128,6 +146,31 @@ def run_pass(program, mesh_axes=None):
             for ax in _axes_of_entry(entry):
                 tile *= axes[ax]
             if dim % tile:
+                if d == 0 and v.name in emb_tables:
+                    # untileable VOCAB dim of a lookup table: the
+                    # embedding-specific class, same provenance plumbing
+                    # (the annotating layer call via _annot_callsite),
+                    # plus the lookup op(s) that make it a table and the
+                    # concrete fix
+                    ops = emb_tables[v.name]
+                    dist = any(o.attrs.get('is_distributed')
+                               for o in ops)
+                    findings.append(_var_finding(
+                        EMBEDDING_UNTILEABLE, SEV_ERROR,
+                        'embedding table %r (read by %d lookup_table '
+                        'op%s%s) is row-sharded %r but its vocab dim %d '
+                        'is not divisible by the assigned mesh extent '
+                        '%d (%s) — the executor would replicate the one '
+                        'tensor the annotation exists to shard; pad the '
+                        'vocab to a multiple (paddle_tpu.embedding.'
+                        'pad_vocab) or resize the axis'
+                        % (v.name, len(ops), 's' if len(ops) > 1 else '',
+                           ', is_distributed=True' if dist else '',
+                           spec, dim, tile,
+                           'x'.join('%s=%d' % (ax, axes[ax])
+                                    for ax in _axes_of_entry(entry))),
+                        v))
+                    continue
                 findings.append(_var_finding(
                     SHARDING_UNTILEABLE, SEV_ERROR,
                     'sharding annotation %r on %r: dim %d of size %d is '

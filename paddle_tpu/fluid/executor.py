@@ -376,6 +376,33 @@ class _CompiledStep(object):
         ad_idxs = [i for i, op in enumerate(ops) if op.type == 'autodiff']
         assert len(ad_idxs) <= 1, "at most one append_backward per program"
         self.ad_idx = ad_idxs[0] if ad_idxs else None
+        for op in (o for blk in program.blocks for o in blk.ops):
+            # loud inertness check (docs/embedding.md): a TRAINING step
+            # whose lookup was built for the distributed wire (annotated
+            # table, is_distributed) compiling WITHOUT a mesh that
+            # declares its axis silently degrades to a replicated dense
+            # gather — the pserver-era failure mode this subsystem
+            # exists to replace. Once per compiled key, like every other
+            # _prepare-time diagnostic. Inference programs are exempt:
+            # the documented export seam (gather_table + set_mesh(None),
+            # docs/serving.md) runs the for_test clone dense-after-
+            # gather on purpose.
+            if (self.ad_idx is not None and op.type == 'lookup_table'
+                    and op.attrs.get('is_distributed')
+                    and op.attrs.get('dist_axis') is not None
+                    and (mesh is None or op.attrs['dist_axis']
+                         not in getattr(mesh, 'shape', {}))):
+                import warnings
+                warnings.warn(
+                    "embedding(is_distributed=True) on table %r is "
+                    "annotated for mesh axis %r but the step compiles "
+                    "against %s — the lookup runs as a replicated dense "
+                    "gather. Declare Program.set_mesh({%r: N, ...}) to "
+                    "shard it (docs/embedding.md)."
+                    % (op.inputs['W'][0].name, op.attrs['dist_axis'],
+                       'no mesh' if mesh is None
+                       else 'mesh axes %r' % sorted(mesh.shape),
+                       op.attrs['dist_axis']), UserWarning)
         self.sparse_plan = self._sparse_embedding_plan(program)
         # Donation/memory plan (fluid.passes.memplan): which persistables
         # the ops actually WRITE decides donation. A mutating step
@@ -553,12 +580,22 @@ class _CompiledStep(object):
           - W@GRAD is consumed by exactly one sgd/adagrad/adam op and
             produced only by autodiff (no clip/regularizer rewriting it),
             is not persistable and not fetched;
-          - the step is unsharded (self.mesh is None): under dp/tp the
-            dense grad IS the right thing — XLA all-reduces it — and
-            SelectedRows never distributed in the reference either.
+          - the step is unsharded (self.mesh is None), OR — the sharded-
+            embedding subsystem (docs/embedding.md) — the program is on
+            the first-class annotation path and W is row-sharded over a
+            mesh axis with every lookup stamped for the distributed wire
+            (is_sparse=True + is_distributed=True): the SparseRows grad
+            then stays touched-rows-only and the optimizer's row scatter
+            partitions per shard, so the dense [vocab, dim] gradient
+            never exists on any device. Legacy transpiler meshes keep
+            the dense fallback: there the dense grad IS the right thing
+            — XLA all-reduces it — and SelectedRows never distributed in
+            the reference either.
         Returns {w_name: {'lookups': [(op_idx, ids_name, padding_idx)],
                           'gname': str}}."""
-        if self.ad_idx is None or self.mesh is not None:
+        if self.ad_idx is None:
+            return {}
+        if self.mesh is not None and not _is_annotated(program):
             return {}
         ad = self.ops[self.ad_idx]
         gnames = dict(zip(ad.attrs['param_names'], ad.attrs['grad_names']))
@@ -574,13 +611,25 @@ class _CompiledStep(object):
                 writers.setdefault(n, []).append(i)
         plan = {}
         for w, gname in gnames.items():
+            if self.mesh is not None:
+                var = program.global_block().vars.get(w)
+                spec = getattr(var, 'sharding', None)
+                row = spec[0] if spec else None
+                if (row is None or isinstance(row, tuple)
+                        or row not in getattr(self.mesh, 'shape', {})):
+                    # mesh without a row-sharded annotation: the dense
+                    # grad all-reduces; only the sharded-sparse
+                    # combination takes the SparseRows path here
+                    continue
             lookups = []
             opt_idx = None
             ok = gname not in self.fetch_names and gname not in persistable
             for i in set(readers.get(w, [])):
                 op = self.ops[i]
                 if (op.type == 'lookup_table' and op.attrs.get('is_sparse')
-                        and op.inputs['W'][0].name == w):
+                        and op.inputs['W'][0].name == w
+                        and (self.mesh is None
+                             or op.attrs.get('dist_axis') is not None)):
                     lookups.append(
                         (i, op.inputs['Ids'][0].name,
                          op.attrs.get('padding_idx', -1)))
@@ -992,6 +1041,12 @@ _C_BUNDLED_STEPS = obs.counter('executor.bundle.steps')
 # is an executor.remat_detected event + this counter, so a sharding
 # regression shows up in obs_report)
 _C_REMAT = obs.counter('executor.remat_detected')
+# sharded-embedding subsystem (docs/embedding.md): upper bound on table
+# rows touched by sparse updates this process ran (the per-step bound is
+# static — the id count of the step's lookups; dedup/merge can only
+# shrink it). The per-key geometry lives in the embedding.lookup /
+# embedding.update_rows run-log events; this counter carries the volume.
+_C_EMBED_ROWS = obs.counter('embedding.rows_touched')
 
 # RLock: FetchHandle.__del__ may run from a GC pass triggered INSIDE an
 # _inflight_delta call on the same thread (allocation under the lock);
@@ -1776,6 +1831,22 @@ class Executor(object):
                         persist_shardings=persist_shardings,
                         mesh=dist_mesh, guard=guard,
                         jit_shardings=jit_shardings)
+            # sparse-embedding accounting (docs/embedding.md): the
+            # rows-touched-per-step bound is static given the feed
+            # signature, so resolve it once per compiled key — run()'s
+            # hot loop only bumps a counter
+            embed_rows = self._embed_rows_per_step(
+                compiled, feed_vals, scope)
+            compiled._embed_rows_step = sum(embed_rows.values())
+            # report ONLY the tables whose sparse path actually arms —
+            # a planned table with unresolvable ids falls back dense in
+            # _grad_setup and must not be claimed sparse here
+            active = sorted(w for w, r in embed_rows.items() if r)
+            if active:
+                obs.event(
+                    'embedding.update_rows', key=key_id, tables=active,
+                    rows_per_step=compiled._embed_rows_step,
+                    sharded=dist_mesh is not None)
             if use_program_cache:
                 self._cache[key] = compiled
             outcome = 'miss'
@@ -1813,6 +1884,39 @@ class Executor(object):
 
         persist = {n: scope._chain_get(n) for n in compiled.persist_in}
         return compiled, feed_vals, persist
+
+    @staticmethod
+    def _embed_rows_per_step(compiled, feed_vals, scope=None):
+        """Static per-step bound on table rows the sparse-embedding plan
+        touches: the total id count of the plan's lookups resolved from
+        the feed shapes — or the scope, matching _grad_setup's own
+        resolution order, so persist-resident id tensors count too (on-
+        device merge collapses duplicates, so the true unique count is
+        <= this; the dense path would touch the full vocab instead — the
+        number docs/perf.md's 49x claim is about). Mirrors _grad_setup's
+        ALL-OR-NOTHING activation per table: a table with ANY
+        unresolvable ids tensor falls back to the dense path there, so
+        it must contribute zero here — otherwise the counter/event/bench
+        would claim touched-rows updates while the [vocab, dim] dense
+        grad actually materializes. Returns {table: rows} with 0 for
+        fallen-back tables."""
+        per_table = {}
+        for w, plan in compiled.sparse_plan.items():
+            table_rows = 0
+            for _, ids_name, _ in plan['lookups']:
+                v = feed_vals.get(ids_name)
+                if v is None and scope is not None:
+                    v = scope._chain_get(ids_name)
+                if v is None:
+                    table_rows = 0
+                    break   # dense fallback for this whole table
+                arr = v.data if isinstance(v, SeqValue) else v
+                shp = tuple(getattr(arr, 'shape', ()))
+                if shp and shp[-1] == 1:
+                    shp = shp[:-1]
+                table_rows += int(np.prod(shp)) if shp else 1
+            per_table[w] = table_rows
+        return per_table
 
     # -- persistent-compile-cache probe -----------------------------------
 
@@ -1981,6 +2085,8 @@ class Executor(object):
             else:
                 fetches, new_persist, health = compiled(
                     persist, feed_vals, rng)
+            if compiled.sparse_plan:
+                _C_EMBED_ROWS.inc(getattr(compiled, '_embed_rows_step', 0))
             for n, v in new_persist.items():
                 scope._chain_set(n, v)
             if health is not None:
@@ -2228,6 +2334,9 @@ class Executor(object):
             else:
                 new_persist, (fetches, healths) = bundle_fn(
                     donated, readonly, stacked, seeds)
+            if compiled.sparse_plan:
+                _C_EMBED_ROWS.inc(
+                    K * getattr(compiled, '_embed_rows_step', 0))
             for n, v in new_persist.items():
                 scope._chain_set(n, v)
             if healths is not None:
@@ -2321,18 +2430,56 @@ class Executor(object):
         attributes the real run; profiler.py:81-130). optimized=True
         returns post-XLA-pass HLO (what actually executes, fusions and
         all); False returns the stable pre-optimization module."""
+        _, lowered = self._lower_current_step(program, feed, fetch_list,
+                                              scope)
+        if optimized:
+            return lowered.compile().as_text()
+        return lowered.as_text()
+
+    def _lower_current_step(self, program, feed, fetch_list, scope):
+        """Shared prep for the step diagnostics (lowered_hlo /
+        compiled_memory_stats): resolve defaults, build-or-fetch the
+        cached compiled step, and lower the EXACT jitted call run()
+        would make. Returns (compiled, jax Lowered)."""
         if program is None:
             program = default_main_program()
         if scope is None:
             scope = global_scope()
         compiled, feed_vals, persist = self._prepare(
             program, feed or {}, fetch_list or [], scope)
-        rng = jax.random.key(0)
         donated, readonly = compiled.plan.split(persist)
-        lowered = compiled._jitted.lower(donated, readonly, feed_vals, rng)
-        if optimized:
-            return lowered.compile().as_text()
-        return lowered.as_text()
+        return compiled, compiled._jitted.lower(
+            donated, readonly, feed_vals, jax.random.key(0))
+
+    def compiled_memory_stats(self, program=None, feed=None,
+                              fetch_list=None, scope=None):
+        """XLA's CompiledMemoryStats for the EXACT fused step run() would
+        execute for this (program, feed, fetch) combination — argument/
+        output/temp byte sizes of the compiled module. The temp figure is
+        the per-step scratch footprint the docs/perf.md and
+        docs/embedding.md sparse-vs-dense claims are measured with
+        (`bench.py --phase embedding`). Costs one lowering + compile
+        (absorbed by the persistent compile cache when wired); the
+        compiled-step cache itself is shared with run()."""
+        _, lowered = self._lower_current_step(program, feed, fetch_list,
+                                              scope)
+        return lowered.compile().memory_analysis()
+
+    def embed_rows_per_step(self, program=None, feed=None,
+                            fetch_list=None, scope=None):
+        """Static rows-touched-per-step bound of this step's ACTIVE
+        sparse-embedding plan (docs/embedding.md): the number the
+        embedding.rows_touched counter advances by per run. 0 means the
+        step updates its tables densely (no plan, or every planned
+        table fell back). Resolves through the same compiled-step cache
+        as run()."""
+        if program is None:
+            program = default_main_program()
+        if scope is None:
+            scope = global_scope()
+        compiled, _, _ = self._prepare(
+            program, feed or {}, fetch_list or [], scope)
+        return getattr(compiled, '_embed_rows_step', 0)
 
     @property
     def cache_stats(self):

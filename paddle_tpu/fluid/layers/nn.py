@@ -78,12 +78,59 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
 
 def embedding(input, size, is_sparse=False, is_distributed=False,
               padding_idx=None, param_attr=None, dtype='float32'):
-    """reference nn.py:embedding (lookup_table op). is_sparse is accepted but
-    on TPU the gradient is a dense scatter-add fused by XLA (no
-    SelectedRows)."""
+    """reference nn.py:embedding (lookup_table op).
+
+    is_sparse=True routes the table gradient through the touched-rows-only
+    SparseRows path (executor sparse plan; reference SelectedRows) when
+    the program shape allows it; otherwise the gradient is a dense
+    scatter-add fused by XLA.
+
+    is_distributed=True is the pserver row-split rebuilt TPU-native
+    (docs/embedding.md): annotate the table row-sharded over a mesh axis
+    — ``param_attr=ParamAttr(..., sharding=('model', None))`` — and
+    declare the mesh with ``Program.set_mesh``; the lookup then lowers to
+    the all_to_all exchange wire (ops_impl/embedding_ops.py) and, with
+    is_sparse=True as well (the supported sharded-sparse combination),
+    updates stay touched-rows-only per shard. Without the annotation or
+    the mesh the flag is INERT — warned about loudly below, since the
+    reference accepted it silently while this framework used to too."""
     helper = LayerHelper('embedding', **locals())
     w = helper.create_parameter(attr=helper.param_attr, shape=size,
                                 dtype=dtype, is_bias=False)
+    dist_axis = None
+    if is_distributed:
+        spec = getattr(w, 'sharding', None)
+        row = spec[0] if spec else None
+        if row is not None and isinstance(row, tuple):
+            # annotated, but over an axis PRODUCT: GSPMD will still
+            # shard the table, only the lookup wire stays dense — a
+            # different situation from no annotation at all
+            import warnings
+            warnings.warn(
+                "embedding(is_distributed=True) on table %r row-shards "
+                "over the axis product %r — the all_to_all lookup wire "
+                "supports a SINGLE row axis, so lookups stay dense "
+                "gathers (the table itself still shards). Use one axis, "
+                "e.g. sharding=('model', None) (docs/embedding.md)."
+                % (w.name, row), UserWarning, stacklevel=2)
+            row = None
+        elif row is None:
+            import warnings
+            warnings.warn(
+                "embedding(is_distributed=True) on table %r has no row-"
+                "sharding annotation — unless one is stamped later (the "
+                "DistributeTranspiler shim does, on transpile()), the "
+                "flag is INERT and the table will be replicated. Declare "
+                "ParamAttr(sharding=('<axis>', None)) on the table and "
+                "Program.set_mesh({'<axis>': N, ...}); is_sparse=True + "
+                "is_distributed=True is the supported sharded-sparse "
+                "combination (docs/embedding.md)." % w.name,
+                UserWarning, stacklevel=2)
+        else:
+            # set_mesh() may legitimately come after the layer calls; a
+            # program that still has no mesh (or no such axis) when it
+            # COMPILES is warned about there (executor._CompiledStep)
+            dist_axis = row
     # static out shape (reference lookup_table_op InferShape): an id
     # column [..., 1] embeds to [..., emb_dim] — downstream layers (fc)
     # read .shape for their own parameter shapes
@@ -97,12 +144,18 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
         lod_level=getattr(input, 'lod_level', 0) or 0)
     padding_idx = -1 if padding_idx is None else \
         padding_idx if padding_idx >= 0 else (size[0] + padding_idx)
+    attrs = {'is_sparse': is_sparse,
+             'is_distributed': is_distributed,
+             'padding_idx': padding_idx}
+    if dist_axis is not None:
+        # static routing for the lowering rule: the table's row axis,
+        # resolved here where the annotation is in hand (the rule sees
+        # values, not Variables)
+        attrs['dist_axis'] = dist_axis
     helper.append_op(type='lookup_table',
                      inputs={'Ids': [input], 'W': [w]},
                      outputs={'Out': [tmp]},
-                     attrs={'is_sparse': is_sparse,
-                            'is_distributed': is_distributed,
-                            'padding_idx': padding_idx})
+                     attrs=attrs)
     return tmp
 
 

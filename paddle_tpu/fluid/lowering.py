@@ -179,7 +179,13 @@ class SparseRows(object):
     is scatter-add(zeros(dense_shape), ids, rows). Optimizer rules
     (ops_impl/optim_ops.py) consume it with index-based row updates, so the
     vocab-sized dense @GRAD buffer never materializes in HBM. Static shapes
-    throughout (N = batch positions, not unique count) keep XLA happy."""
+    throughout (N = batch positions, not unique count) keep XLA happy.
+
+    Sharded case (docs/embedding.md): `dense_shape` is always the GLOBAL
+    table shape — under a mesh with a row-sharded table the [N, D] rows
+    stay batch-sized (merged replicated by _merge_sparse) while the
+    optimizer's row scatter partitions per shard, so neither layout ever
+    builds the dense buffer."""
 
     __slots__ = ('ids', 'rows', 'dense_shape')
 

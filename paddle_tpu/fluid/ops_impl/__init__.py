@@ -15,4 +15,5 @@ from . import detection_ops  # noqa: F401
 from . import crf_ctc_ops  # noqa: F401
 from . import sampled_ops  # noqa: F401
 from . import moe_ops  # noqa: F401
+from . import embedding_ops  # noqa: F401
 from . import extra_ops  # noqa: F401

@@ -1,0 +1,85 @@
+"""Distributed `lookup_table` lowering (docs/embedding.md).
+
+Parity: reference lookup_table_op.cc with `is_distributed=True` rewired by
+DistributeTranspiler into per-pserver row shards and gRPC prefetch ops.
+TPU-first: the table is row-sharded over a mesh axis by its GSPMD
+annotation (`ParamAttr(sharding=(axis, None))`) and the lookup lowers to
+the all_to_all wire in paddle_tpu.embedding.lookup — bucket ids by owning
+shard, dedup, ONE all_to_all out with the queries, local gather, one
+all_to_all back with the rows (the parallel/moe.py exchange pattern).
+
+The layer (layers/nn.py:embedding) stamps the table's row axis on the op
+as `dist_axis`; this rule takes the wire path only when the step is
+compiled against a mesh that declares that axis — everywhere else
+(build-time shape inference, single-device runs, program_lint) the caller
+(sequence_ops._lookup_table) keeps the dense gather, so the two paths are
+fetch-equivalent by construction (drilled in tests/test_embedding.py).
+"""
+import warnings
+
+import jax
+import jax.numpy as jnp
+
+from ... import obs
+from ..lowering import data_of, like
+
+
+def dist_lookup_applies(attrs, ctx):
+    """Does this lookup_table op take the sharded wire? Requires the
+    layer-stamped `dist_axis` AND a step mesh declaring that axis — the
+    dense gather is the correct lowering everywhere else (abstract_eval
+    runs with ctx.mesh=None and must agree on shapes)."""
+    axis = attrs.get('dist_axis')
+    return (bool(attrs.get('is_distributed')) and axis is not None
+            and ctx.mesh is not None
+            and axis in getattr(ctx.mesh, 'shape', {})
+            # already manual over mesh axes (a pipeline-region body):
+            # opening a nested shard_map would fail — the stage keeps
+            # the dense gather
+            and not ctx.manual_axes)
+
+
+def lookup_table_dist(ins, attrs, ctx):
+    """The sharded branch of the `lookup_table` rule. Mirrors the dense
+    rule's conventions (squeeze trailing id column, padding_idx zeroing,
+    SeqValue/beam re-wrapping) with the gather replaced by the
+    all_to_all exchange. Falls back to the dense gather — loudly — when
+    the annotated vocab cannot tile over the axis (the statically-checked
+    EmbeddingShardUntileable case reached at runtime)."""
+    from ...embedding.lookup import sharded_lookup, wire_stats
+    from .sequence_ops import _lookup_table_dense
+
+    axis = attrs['dist_axis']
+    ws = ctx.mesh.shape[axis]
+    w = data_of(ins['W'][0])
+    if w.shape[0] % ws:
+        warnings.warn(
+            'lookup_table(is_distributed=True): vocab %d does not tile '
+            'over mesh axis %r size %d — falling back to the dense '
+            'gather (pad the table via embedding.pad_vocab)'
+            % (w.shape[0], axis, ws), RuntimeWarning)
+        return _lookup_table_dense(ins, attrs, ctx)
+
+    ids_v = ins['Ids'][0]
+    ids = data_of(ids_v).astype(jnp.int32)
+    if ids.shape and ids.shape[-1] == 1:
+        ids = jnp.squeeze(ids, -1)
+    pad = attrs.get('padding_idx')
+    pad = pad if pad is not None and pad >= 0 else None
+    out = sharded_lookup(w, ids, ctx.mesh, axis, padding_idx=pad)
+    if isinstance(w, jax.core.Tracer):
+        # once per TRACE (= once per compiled cache key; the jitted
+        # steady state re-emits nothing): the wire geometry of this
+        # lookup. The Tracer guard keeps the eager debug/profiler path
+        # — which executes the rule EVERY step — from flooding the run
+        # log with one event per step.
+        obs.event('embedding.lookup', axis=axis,
+                  **wire_stats(int(ids.size), int(w.shape[0]),
+                               int(w.shape[1]), ws,
+                               itemsize=int(w.dtype.itemsize)))
+    from .lod_beam import is_beam_form
+    if is_beam_form(ids_v) and out.ndim == ids.ndim + 1:
+        # capacity-form beam rows [R] embed to [R, 1, E] (decode idiom —
+        # same shape contract as the dense rule)
+        out = out[:, None]
+    return {'Out': like(ids_v, out)}

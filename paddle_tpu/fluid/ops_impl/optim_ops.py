@@ -16,25 +16,44 @@ def _lr(ins):
     return data_of(ins['LearningRate'][0]).reshape(())
 
 
-def _merge_sparse(g):
+def _merge_sparse(g, ctx=None):
     """Merge duplicate ids of a SparseRows grad (reference MergeAdd,
     operators/math/selected_rows_functor.cc): nonlinear updates (adagrad's
     g^2, adam's moments) must see each touched row ONCE with its summed
     gradient. Static shapes: sort the N occurrences, segment-sum into at
     most N merged rows, and return (uids int32[N], merged [N, D],
     valid bool[N]) where invalid slots carry zero rows and id 0 — callers
-    mask their update deltas with `valid` so the padding rows are no-ops."""
+    mask their update deltas with `valid` so the padding rows are no-ops.
+
+    Sharded case (docs/embedding.md): when the step is compiled against a
+    mesh (ctx.mesh) the merge's [N, *] intermediates are PINNED replicated
+    — N is batch-sized, and without the pin GSPMD has to invent layouts
+    for the argsort/segment-sum chain from the (axis-sharded) cotangents
+    feeding it, which is exactly the replicate-then-repartition class the
+    remat detector flags. The row scatter the CALLER then does against the
+    row-sharded table partitions per shard (each shard applies the deltas
+    for rows it owns), and the step's out-sharding constraint keeps the
+    table's layout a fixed point — the dense [vocab, dim] gradient never
+    exists under either layout.
+
+    The sort/segment/unsort core is embedding.lookup.dedup_plan — ONE
+    definition of the static-shape dedup invariant serves both the
+    lookup wire's query side and this merge."""
+    from ...embedding.lookup import dedup_plan
     ids, rows = g.ids, g.rows
+    if ctx is not None and getattr(ctx, 'mesh', None) is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+        rep = NamedSharding(ctx.mesh, PartitionSpec())
+        ids = jax.lax.with_sharding_constraint(ids, rep)
+        rows = jax.lax.with_sharding_constraint(rows, rep)
     n = ids.shape[0]
-    order = jnp.argsort(ids)
-    sid = ids[order]
-    srows = rows[order]
-    is_first = jnp.concatenate(
-        [jnp.ones((1,), bool), sid[1:] != sid[:-1]])
-    seg = jnp.cumsum(is_first) - 1                  # [N] segment per row
-    merged = jax.ops.segment_sum(srows, seg, num_segments=n)
-    uids = jnp.zeros((n,), sid.dtype).at[seg].set(sid)
-    valid = jnp.arange(n) < seg[-1] + 1
+    uids, seg, order, n_unique = dedup_plan(ids.astype(jnp.int32))
+    merged = jax.ops.segment_sum(rows[order], seg, num_segments=n)
+    valid = jnp.arange(n) < n_unique
+    # invalid slots carry dedup_plan's sentinel id: clamp to 0 so the
+    # callers' moment GATHERS at uids stay in-bounds (their scattered
+    # deltas are already masked with `valid`)
+    uids = jnp.where(valid, uids, 0)
     return uids, merged, valid
 
 
@@ -78,7 +97,7 @@ def _adagrad(ins, attrs, ctx):
         # adagrad_op.h SelectedRows branch: MergeAdd then per-row update).
         # Deltas (not absolute values) are scattered so the zero-padded
         # invalid merge slots are exact no-ops under duplicate indices.
-        uids, gm, valid = _merge_sparse(g)
+        uids, gm, valid = _merge_sparse(g, ctx)
         vm = valid[:, None].astype(gm.dtype)
         m_rows = m[uids]
         m_new = m_rows + gm * gm
@@ -109,7 +128,7 @@ def _adam(ins, attrs, ctx):
         # first so the nonlinear moment math sees each row's summed grad
         # once. Scattered as deltas — padding slots from the merge are
         # exact no-ops.
-        uids, gm, valid = _merge_sparse(g)
+        uids, gm, valid = _merge_sparse(g, ctx)
         vm = valid[:, None].astype(gm.dtype)
         m1_rows, m2_rows = m1[uids], m2[uids]
         m1_new = b1 * m1_rows + (1 - b1) * gm

@@ -21,6 +21,17 @@ from ..lowering import register, data_of, like, SeqValue
 
 @register('lookup_table')
 def _lookup_table(ins, attrs, ctx):
+    from . import embedding_ops
+    if embedding_ops.dist_lookup_applies(attrs, ctx):
+        # row-sharded table on a mesh: the all_to_all lookup wire
+        # (docs/embedding.md) replaces the dense gather
+        return embedding_ops.lookup_table_dist(ins, attrs, ctx)
+    return _lookup_table_dense(ins, attrs, ctx)
+
+
+def _lookup_table_dense(ins, attrs, ctx):
+    """The dense gather (no dispatch) — also the distributed rule's
+    fallback when the vocab cannot tile over the mesh axis."""
     w = data_of(ins['W'][0])
     ids_v = ins['Ids'][0]
     ids = data_of(ids_v).astype(jnp.int32)

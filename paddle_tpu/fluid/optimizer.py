@@ -91,6 +91,17 @@ class Optimizer(object):
             persistable=True, dtype=dtype or param.dtype, shape=shape)
         # marks ZeRO-shardable state for the distribute path (executor.py)
         var._is_optimizer_accumulator = True
+        # same-shape accumulators inherit their master parameter's GSPMD
+        # annotation (docs/parallel.md): adam moments of a row-sharded
+        # embedding table are themselves vocab-sized — replicating them
+        # would forfeit the memory scaling the annotation asked for
+        # (docs/embedding.md; the legacy dist path does the same by
+        # name-matching tp specs). Scalar state (beta pows) passes a
+        # `shape` of its own and stays replicated.
+        if (getattr(param, 'sharding', None) is not None
+                and list(shape) == list(param.shape)):
+            var.sharding = param.sharding
+            var._annot_callsite = getattr(param, '_annot_callsite', None)
         self._accumulators[name][param.name] = var
         self.helper.set_variable_initializer(
             var, initializer=Constant(value=float(fill_value)))

@@ -57,9 +57,33 @@ class DistributeTranspiler(object):
                   trainers=1, sync_mode=True, startup_program=None,
                   slice_var_up=True, split_method=None):
         """Record the topology and annotate the program with the dp mesh
-        size. trainer_id/trainers map onto mesh coordinates."""
+        size. trainer_id/trainers map onto mesh coordinates.
+
+        DEPRECATED shim (docs/migration.md, docs/embedding.md): the
+        pserver topology this API described is now two first-class
+        Program concerns — `Program.set_mesh({...})` for the mesh and
+        `ParamAttr(sharding=...)` for per-tensor placement; the pserver
+        ROW SPLIT of huge embedding tables specifically is
+        `embedding(is_sparse=True, is_distributed=True)` with the table
+        annotated `sharding=('dp', None)` (the all_to_all lookup wire +
+        sharded sparse updates replace gRPC prefetch + pserver-side
+        optimizer blocks). This call still arms the legacy dp-mesh
+        executor path AND translates its embedding intent forward: any
+        table read by an `is_distributed=True` lookup gets the row-
+        sharding annotation stamped here, so dropping the transpile()
+        call and declaring set_mesh() is the whole migration."""
+        import warnings
+        warnings.warn(
+            'DistributeTranspiler is deprecated: declare the mesh with '
+            "Program.set_mesh({'dp': N, ...}) and shard huge embedding "
+            "tables with ParamAttr(sharding=('dp', None)) + "
+            'embedding(is_sparse=True, is_distributed=True) — the '
+            'sharded-embedding subsystem (docs/embedding.md) replaces '
+            'the pserver row split; see the migration table in '
+            'docs/migration.md.', DeprecationWarning, stacklevel=2)
         if program is None:
             program = default_main_program()
+        self._annotate_distributed_tables(program)
         if isinstance(pservers, str):
             pserver_endpoints = [e for e in pservers.split(",") if e]
         else:
@@ -93,6 +117,34 @@ class DistributeTranspiler(object):
         program._dist_config = base
         program._dist_mesh = None
         return self
+
+    @staticmethod
+    def _annotate_distributed_tables(program, axis='dp'):
+        """Translate the pserver embedding intent into the first-class
+        surface: every table read by an `is_distributed=True`
+        lookup_table gets `sharding=(axis, None)` stamped (and the op its
+        `dist_axis` routing attr), so the SAME program runs the all_to_all
+        lookup wire the moment it is driven through `set_mesh()` instead
+        of this shim. Already-annotated tables are left alone; the legacy
+        `_dist_config` executor path ignores the annotation except to
+        preserve it across reloads (_replace_strays)."""
+        from ..framework import normalize_sharding
+        ops = [op for blk in program.blocks for op in blk.ops]
+        for op in ops:
+            # every block: the decode idiom puts lookups inside While
+            # sub-blocks (analysis._embedding_tables walks the same way)
+            if op.type != 'lookup_table' \
+                    or not op.attrs.get('is_distributed'):
+                continue
+            w = op.inputs['W'][0]
+            if getattr(w, 'sharding', None) is None:
+                ndim = len(w.shape) if w.shape is not None else 2
+                w.sharding = normalize_sharding(
+                    (axis,) + (None,) * (ndim - 1))
+            row = w.sharding[0]
+            if op.attrs.get('dist_axis') is None \
+                    and row is not None and not isinstance(row, tuple):
+                op.attrs['dist_axis'] = row
 
     def get_trainer_program(self):
         """The trainer program IS the original program — GSPMD shards it

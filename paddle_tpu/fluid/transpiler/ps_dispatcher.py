@@ -1,12 +1,25 @@
-"""PS dispatchers. Parity: reference transpiler/ps_dispatcher.py (HashName/
-RoundRobin decide which pserver owns a var). Kept for API compatibility;
-with GSPMD the "dispatch" is the mesh sharding spec."""
+"""PS dispatchers — DEPRECATED shims. Parity: reference
+transpiler/ps_dispatcher.py (HashName/RoundRobin decide which pserver owns
+a var). With the sharded-embedding subsystem (docs/embedding.md) the
+"dispatch" decision is static and uniform: a row-sharded table's owner for
+id `i` is `i // (vocab / axis_size)` — the mesh sharding spec, consumed by
+the all_to_all lookup wire — so these classes only translate old launcher
+code: `dispatch()` still round-robins/hashes endpoint strings, and
+construction warns with the migration pointer (docs/migration.md)."""
+import warnings
 
 __all__ = ['PSDispatcher', 'HashName', 'RoundRobin']
 
 
 class PSDispatcher(object):
     def __init__(self, pserver_endpoints):
+        warnings.warn(
+            '%s is deprecated: pserver var dispatch is replaced by mesh '
+            "sharding specs — row-shard embedding tables with "
+            "ParamAttr(sharding=('dp', None)) + embedding(is_sparse=True, "
+            'is_distributed=True) on a Program.set_mesh() program '
+            '(docs/embedding.md, migration table in docs/migration.md).'
+            % type(self).__name__, DeprecationWarning, stacklevel=2)
         self._eps = list(pserver_endpoints)
         self._step = 0
 

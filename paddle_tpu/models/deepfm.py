@@ -20,17 +20,31 @@ VOCAB = 100000
 
 
 def deepfm(feat_ids, label, num_fields=NUM_FIELDS, vocab_size=VOCAB,
-           embed_dim=10, hidden=[400, 400, 400]):
-    """feat_ids: int64 [B, num_fields]; one id per field."""
+           embed_dim=10, hidden=[400, 400, 400], dist_axis=None,
+           is_sparse=False):
+    """feat_ids: int64 [B, num_fields]; one id per field.
+
+    dist_axis: row-shard both FM tables over this mesh axis (the sharded-
+    embedding subsystem, docs/embedding.md) — pair with
+    `Program.set_mesh({dist_axis: N, ...})` and is_sparse=True for
+    sharded-sparse training; vocab_size must be a multiple of the axis
+    size (embedding.pad_vocab)."""
+    def _table(name):
+        sharding = (dist_axis, None) if dist_axis else None
+        return fluid.ParamAttr(name=name, sharding=sharding)
+
+    dist = dist_axis is not None
     # ---- FM first order: w[ids] summed over fields
     first_w = layers.embedding(input=feat_ids, size=[vocab_size, 1],
-                               param_attr=fluid.ParamAttr(name='fm_first_w'))
+                               is_sparse=is_sparse, is_distributed=dist,
+                               param_attr=_table('fm_first_w'))
     # [B, F, 1] -> [B, 1]
     first = layers.reduce_sum(first_w, dim=1)
 
     # ---- FM second order: 0.5 * ((sum_f v_f)^2 - sum_f v_f^2)
     emb = layers.embedding(input=feat_ids, size=[vocab_size, embed_dim],
-                           param_attr=fluid.ParamAttr(name='fm_embed'))
+                           is_sparse=is_sparse, is_distributed=dist,
+                           param_attr=_table('fm_embed'))
     sum_v = layers.reduce_sum(emb, dim=1)                    # [B, D]
     sum_v_sq = layers.square(sum_v)
     sq_v = layers.square(emb)

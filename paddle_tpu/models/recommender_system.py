@@ -14,12 +14,35 @@ FEED_ORDER = ['user_id', 'gender_id', 'age_id', 'job_id', 'movie_id',
               'category_id', 'movie_title', 'score']
 
 
-def get_usr_combined_features(emb_dim=32, out_dim=200):
-    usr_dict_size = paddle.dataset.movielens.max_user_id() + 1
+def _big_table(name, dist_axis):
+    """ParamAttr for a huge-vocab table: row-sharded over `dist_axis`
+    when the model is built for the sharded-embedding subsystem
+    (docs/embedding.md), plain otherwise. The tiny side tables (gender/
+    age/job, a handful of rows) always stay replicated — sharding them
+    would cost a wire exchange to save nothing."""
+    import paddle_tpu.fluid as _fluid
+    return _fluid.ParamAttr(
+        name=name, sharding=(dist_axis, None) if dist_axis else None)
+
+
+def _pad(n, dist_axis, axis_size):
+    if not dist_axis:
+        return n
+    from paddle_tpu.embedding import pad_vocab
+    return pad_vocab(n, axis_size)
+
+
+def get_usr_combined_features(emb_dim=32, out_dim=200, dist_axis=None,
+                              axis_size=1, is_sparse=False):
+    usr_dict_size = _pad(paddle.dataset.movielens.max_user_id() + 1,
+                         dist_axis, axis_size)
     uid = layers.data(name='user_id', shape=[1], dtype='int64')
     usr_emb = layers.embedding(input=uid, dtype='float32',
                                size=[usr_dict_size, emb_dim],
-                               param_attr='user_table')
+                               is_sparse=is_sparse,
+                               is_distributed=dist_axis is not None,
+                               param_attr=_big_table('user_table',
+                                                     dist_axis))
     usr_fc = layers.fc(input=usr_emb, size=emb_dim)
 
     usr_gender_id = layers.data(name='gender_id', shape=[1], dtype='int64')
@@ -44,12 +67,17 @@ def get_usr_combined_features(emb_dim=32, out_dim=200):
     return layers.fc(input=concat_embed, size=out_dim, act='tanh')
 
 
-def get_mov_combined_features(emb_dim=32, out_dim=200):
-    mov_dict_size = paddle.dataset.movielens.max_movie_id() + 1
+def get_mov_combined_features(emb_dim=32, out_dim=200, dist_axis=None,
+                              axis_size=1, is_sparse=False):
+    mov_dict_size = _pad(paddle.dataset.movielens.max_movie_id() + 1,
+                         dist_axis, axis_size)
     mov_id = layers.data(name='movie_id', shape=[1], dtype='int64')
     mov_emb = layers.embedding(input=mov_id, dtype='float32',
                                size=[mov_dict_size, emb_dim],
-                               param_attr='movie_table')
+                               is_sparse=is_sparse,
+                               is_distributed=dist_axis is not None,
+                               param_attr=_big_table('movie_table',
+                                                     dist_axis))
     mov_fc = layers.fc(input=mov_emb, size=emb_dim)
 
     category_size = len(paddle.dataset.movielens.movie_categories())
@@ -60,11 +88,16 @@ def get_mov_combined_features(emb_dim=32, out_dim=200):
     mov_categories_hidden = layers.sequence_pool(
         input=mov_categories_emb, pool_type='sum')
 
-    title_size = len(paddle.dataset.movielens.get_movie_title_dict())
+    title_size = _pad(len(paddle.dataset.movielens.get_movie_title_dict()),
+                      dist_axis, axis_size)
     mov_title_id = layers.data(name='movie_title', shape=[1], dtype='int64',
                                lod_level=1)
     mov_title_emb = layers.embedding(input=mov_title_id,
-                                     size=[title_size, emb_dim])
+                                     size=[title_size, emb_dim],
+                                     is_sparse=is_sparse,
+                                     is_distributed=dist_axis is not None,
+                                     param_attr=_big_table('title_table',
+                                                           dist_axis))
     mov_title_conv = nets.sequence_conv_pool(
         input=mov_title_emb, num_filters=emb_dim, filter_size=3, act='tanh',
         pool_type='sum')
@@ -74,9 +107,15 @@ def get_mov_combined_features(emb_dim=32, out_dim=200):
     return layers.fc(input=concat_embed, size=out_dim, act='tanh')
 
 
-def model(emb_dim=32, tower_dim=200):
-    usr = get_usr_combined_features(emb_dim, tower_dim)
-    mov = get_mov_combined_features(emb_dim, tower_dim)
+def model(emb_dim=32, tower_dim=200, dist_axis=None, axis_size=1,
+          is_sparse=False):
+    """dist_axis/axis_size/is_sparse: build the big tables (user/movie/
+    title) row-sharded for the sharded-embedding subsystem — vocabs are
+    padded to the axis size (docs/embedding.md)."""
+    usr = get_usr_combined_features(emb_dim, tower_dim, dist_axis,
+                                    axis_size, is_sparse)
+    mov = get_mov_combined_features(emb_dim, tower_dim, dist_axis,
+                                    axis_size, is_sparse)
     inference = layers.cos_sim(X=usr, Y=mov)
     scale_infer = layers.scale(x=inference, scale=5.0)
 

@@ -279,6 +279,35 @@ def summarize(events):
                      'XLA could only satisfy by replicating the tensor'
                      % (n, ', '.join(keys)))
 
+    # -- embedding -------------------------------------------------------
+    # sharded-embedding subsystem (docs/embedding.md): one
+    # embedding.lookup event per compiled lookup wire (its geometry) and
+    # one embedding.update_rows event per sparse-plan compile (which
+    # tables update touched-rows-only, at what per-step bound)
+    lookups = _events(events, 'embedding.lookup')
+    updates = _events(events, 'embedding.update_rows')
+    if lookups or updates:
+        lines.append('')
+        lines.append('-- embedding --')
+        for e in lookups:
+            f = e.get('fields', {})
+            lines.append('lookup wire: %s ids over axis %s=%s '
+                         '(vocab %s, dim %s; %s query slots/shard, '
+                         '%s row B/device per exchange)'
+                         % (f.get('ids', '?'), f.get('axis', '?'),
+                            f.get('axis_size', '?'), f.get('vocab', '?'),
+                            f.get('dim', '?'),
+                            f.get('query_capacity', '?'),
+                            f.get('row_bytes_per_device', '?')))
+        for e in updates:
+            f = e.get('fields', {})
+            lines.append('sparse updates: tables %s, <= %s rows/step '
+                         'touched%s (key %s)'
+                         % (','.join(f.get('tables', []) or ['?']),
+                            f.get('rows_per_step', '?'),
+                            ' [sharded]' if f.get('sharded') else '',
+                            f.get('key', '?')))
+
     # -- anomaly guard ---------------------------------------------------
     skips = _events(events, 'anomaly.skip')
     lines.append('')

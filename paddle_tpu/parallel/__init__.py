@@ -6,6 +6,11 @@ the pserver/gRPC distributed runtime (operators/send_recv + Go pserver):
 parallelism is expressed as jax.sharding over a device Mesh and XLA GSPMD
 inserts the collectives on ICI/DCN. Multi-host scale-out is the same program
 over a bigger mesh (jax.distributed.initialize on each host).
+
+The moe `all_to_all` dispatch pattern here (parallel/moe.py) is also the
+wire under `paddle_tpu.embedding` — row-sharded huge-vocab lookup tables
+with bucket/dedup/exchange lookups and per-shard sparse updates, the
+pserver workload rebuilt TPU-native (docs/embedding.md).
 """
 import re
 

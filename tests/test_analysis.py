@@ -12,9 +12,9 @@ from paddle_tpu.fluid import analysis, framework, layers, lowering
 from paddle_tpu.fluid.analysis import donation
 from paddle_tpu.fluid.analysis.findings import (
     DANGLING_INPUT, DEAD_OP, DONATION_UNSAFE, DTYPE_MISMATCH,
-    SCOPE_RACE, SHAPE_MISMATCH, SHARDING_INVALID, SHARDING_RESHARD,
-    SHARDING_UNTILEABLE, UNREACHABLE_FETCH, USE_BEFORE_WRITE,
-    WRITE_TO_FEED)
+    EMBEDDING_UNTILEABLE, SCOPE_RACE, SHAPE_MISMATCH, SHARDING_INVALID,
+    SHARDING_RESHARD, SHARDING_UNTILEABLE, UNREACHABLE_FETCH,
+    USE_BEFORE_WRITE, WRITE_TO_FEED)
 
 from util import fresh_program
 
@@ -588,6 +588,56 @@ class TestShardingPass:
             assert len(fs) == 1
             assert 'not divisible' in fs[0].message
 
+    def test_untileable_embedding_table_gets_specific_finding(self):
+        """A row-sharded lookup_table weight whose VOCAB dim the axis
+        cannot tile reports EmbeddingShardUntileable (not the generic
+        untileable kind): the message names the lookup, the distributed
+        flag, and the pad_vocab fix, with the annotating callsite as
+        provenance (docs/embedding.md)."""
+        with fresh_program() as (main, _):
+            ids = layers.data(name='ids', shape=[1], dtype='int64')
+            layers.embedding(
+                ids, size=[50, 8], is_sparse=True, is_distributed=True,
+                param_attr=fluid.ParamAttr(name='emb_w',
+                                           sharding=('model', None)))
+            main.set_mesh({'model': 8})
+            fs = [f for f in analysis.analyze(main)
+                  if f.kind == EMBEDDING_UNTILEABLE]
+            assert len(fs) == 1 and fs[0].severity == 'error'
+            assert 'emb_w' in fs[0].var_names
+            assert 'pad_vocab' in fs[0].message
+            assert 'is_distributed=True' in fs[0].message
+            assert fs[0].callsite and 'test_analysis.py' in fs[0].callsite
+            # the generic kind stays for non-table vars only
+            assert not [f for f in analysis.analyze(main)
+                        if f.kind == SHARDING_UNTILEABLE]
+
+    def test_tileable_embedding_table_is_clean(self):
+        with fresh_program() as (main, _):
+            ids = layers.data(name='ids', shape=[1], dtype='int64')
+            out = layers.embedding(
+                ids, size=[48, 8], is_sparse=True, is_distributed=True,
+                param_attr=fluid.ParamAttr(name='emb_w',
+                                           sharding=('model', None)))
+            main.set_mesh({'model': 8})
+            assert [f for f in analysis.analyze(main,
+                                                fetches=[out.name])
+                    if f.kind in (EMBEDDING_UNTILEABLE,
+                                  SHARDING_UNTILEABLE)] == []
+
+    def test_embedding_untileable_via_mesh_override(self):
+        """program_lint --mesh semantics: a table that tiles its OWN mesh
+        can still fail a deployment mesh override (axis grown to 16)."""
+        with fresh_program() as (main, _):
+            ids = layers.data(name='ids', shape=[1], dtype='int64')
+            layers.embedding(
+                ids, size=[48, 8], is_sparse=True, is_distributed=True,
+                param_attr=fluid.ParamAttr(name='emb_w',
+                                           sharding=('model', None)))
+            main.set_mesh({'model': 8})
+            fs = analysis.analyze(main, mesh_axes=[('model', 32)])
+            assert [f.kind for f in fs] == [EMBEDDING_UNTILEABLE]
+
     def test_annotation_without_mesh_is_inert_warning(self):
         with fresh_program() as (main, _):
             self._annotated(mesh=None)
@@ -671,3 +721,25 @@ def test_program_lint_mesh_flag_one_json_document(tmp_path):
     assert rc == 0
     rc, _ = run([d, '--mesh', 'dp-8'])
     assert rc == 2
+
+    # embedding table artifact: the vocab-untileable deployment mesh
+    # reports the embedding-specific kind through the CLI too
+    # (docs/embedding.md)
+    with fresh_program() as (main, startup):
+        ids = layers.data(name='ids', shape=[1], dtype='int64')
+        out_v = layers.embedding(
+            ids, size=[48, 8], is_sparse=True, is_distributed=True,
+            param_attr=fluid.ParamAttr(name='emb_w',
+                                       sharding=('model', None)))
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        d2 = str(tmp_path / 'emb')
+        fluid.io.save_inference_model(d2, ['ids'], [out_v], exe,
+                                      main_program=main)
+    rc, out = run([d2, '--mesh', 'modelx8', '--json'])
+    assert rc == 0 and json.loads(out)['findings'] == []
+    rc, out = run([d2, '--mesh', 'modelx32', '--json'])
+    doc = json.loads(out)
+    assert rc == 1
+    assert [f['kind'] for f in doc['findings']] == [EMBEDDING_UNTILEABLE]
+    assert 'pad_vocab' in doc['findings'][0]['message']

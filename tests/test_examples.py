@@ -65,3 +65,10 @@ def test_serving_example(tmp_path):
     pred = _run_example('serving', ['--requests', '32',
                                     '--save_dir', str(tmp_path)])
     assert np.isfinite(pred)
+
+
+def test_sharded_recommender_example(tmp_path):
+    loss = _run_example('sharded_recommender',
+                        ['--steps', '4', '--bundle', '2',
+                         '--requests', '2', '--save_dir', str(tmp_path)])
+    assert np.isfinite(loss)

@@ -110,12 +110,21 @@ for name in sorted(set(new) & set(prev)):
         continue
     ratio = nv / pv if pv else float('inf')
     flag = ''
+    # counter metrics (the embedding *_rows_touched class) are neither
+    # latencies nor throughputs: they restate a static per-step bound
+    # (batch x fields), so a change is a CONFIG change, not a perf
+    # delta — print informationally, never flag a regression either way
+    if name.endswith('_rows_touched'):
+        print('[compare] %s: %.0f vs %.0f (counter metric; config-'
+              'driven, not flagged)' % (name, nv, pv))
+        continue
     # latency-style metrics (the serve/decode *_ms percentiles, shed/
-    # dropped counts) are LOWER-is-better: a p99 that dropped is an
-    # improvement; a rise is the regression. Throughput metrics
-    # (steps/sec, tokens_per_sec, speedup) keep the higher-is-better
-    # rule.
-    lower_is_better = name.endswith('_ms') or name.endswith('.dropped')
+    # dropped counts, the embedding *_temp_bytes footprints) are
+    # LOWER-is-better: a p99/footprint that dropped is an improvement;
+    # a rise is the regression. Throughput metrics (steps/sec,
+    # tokens_per_sec, speedup) keep the higher-is-better rule.
+    lower_is_better = (name.endswith('_ms') or name.endswith('.dropped')
+                       or name.endswith('_temp_bytes'))
     if lower_is_better:
         if ratio > 1.1:
             flag = '  <-- WARNING: >10%% regression (rise) vs %s' \

@@ -159,6 +159,16 @@ if [ "${GSPMD:-0}" = 1 ]; then
       --platform "${BENCH_PLATFORM:-tpu}"
 fi
 
+# 8c. sharded-embedding phase (opt-in: EMBED=1): the huge-vocab CTR
+#     workload — dense-replicated vs sharded-sparse deepfm tables at
+#     BENCH_EMBED_VOCAB (default 1e6) rows on the 'model' mesh; emits
+#     steps/sec per leg, the *_rows_touched counter metric, and each
+#     leg's compiled-step temp footprint (docs/embedding.md).
+if [ "${EMBED:-0}" = 1 ]; then
+  run python bench.py --phase embedding \
+      --platform "${BENCH_PLATFORM:-tpu}"
+fi
+
 # 9. serving engine vs sequential Predictor (opt-in: SERVE=1). Closed
 #    loop at the acceptance concurrency, then an open-loop arrival test;
 #    --check-compiles fails the command if steady state compiled, which
