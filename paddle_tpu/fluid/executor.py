@@ -2481,6 +2481,98 @@ class Executor(object):
             program, feed or {}, fetch_list or [], scope)
         return getattr(compiled, '_embed_rows_step', 0)
 
+    # -- elastic checkpoint seam (docs/robustness.md#elastic) --------------
+
+    def state_dict(self, program=None, scope=None):
+        """The scope's persistable train state, placement-true: {name:
+        jax.Array} for every scope-initialized persistable of `program`,
+        each carrying its LIVE sharding (mesh placement is (re)asserted
+        first, so an annotated program's arrays are NamedSharding-placed
+        per their annotations — a vocab-sharded table comes back as 8
+        device shards, never a gathered dense host array). This is what
+        utils.checkpoint.save_sharded consumes: each host then writes
+        only the shards it can address. LoD (SeqValue) persistables are
+        skipped with a warning — the dense npz path owns those."""
+        program = program if program is not None else default_main_program()
+        scope = scope if scope is not None else global_scope()
+        self._ensure_dist_placement(program, scope)
+        out = {}
+        for v in program.list_vars():
+            if not v.persistable:
+                continue
+            val = scope._chain_get(v.name)
+            if val is None:
+                continue
+            if isinstance(val, SeqValue):
+                import warnings
+                warnings.warn(
+                    'state_dict skips LoD persistable %r (SeqValue '
+                    'state has no sharded-checkpoint representation)'
+                    % v.name, RuntimeWarning)
+                continue
+            out[v.name] = (val if isinstance(val, jax.Array)
+                           else jnp.asarray(val))
+        return out
+
+    def load_state_dict(self, state, program=None, scope=None):
+        """Restore a state_dict into the scope, re-placed per the
+        program's CURRENT annotations — the reshard-on-restore seam: the
+        arrays may arrive from utils.checkpoint.load_sharded on a
+        different mesh shape than they were saved on (8 devices -> 4
+        after an elastic restart); each is device_put into the
+        annotation's NamedSharding over the program's own mesh, so the
+        step's sharding fixed point holds from the first post-restore
+        run. Entries that are not persistables of the program are
+        skipped with a warning; program persistables absent from `state`
+        keep their scope values. Returns the restored names."""
+        program = program if program is not None else default_main_program()
+        scope = scope if scope is not None else global_scope()
+        mesh = self._ensure_dist_placement(program, scope)
+        annot = mesh is not None and _is_annotated(program)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        pvars = {v.name: v for v in program.list_vars() if v.persistable}
+        restored, unknown = [], []
+        for name, val in state.items():
+            v = pvars.get(name)
+            if v is None:
+                unknown.append(name)
+                continue
+            if annot:
+                spec = (P(*v.sharding) if getattr(v, 'sharding', None)
+                        else P())
+                try:
+                    val = jax.device_put(val, NamedSharding(mesh, spec))
+                except ValueError as e:
+                    import warnings
+                    warnings.warn(
+                        'load_state_dict: annotation %r on %r does not '
+                        'fit the mesh (%s); replicating instead'
+                        % (getattr(v, 'sharding', None), name, e))
+                    val = jax.device_put(val, NamedSharding(mesh, P()))
+            elif mesh is not None:
+                # legacy-dist mesh: keep an already-mesh-placed array's
+                # layout (ZeRO/FSDP state restored by load_sharded);
+                # single-device values replicate and _replace_strays /
+                # the placement pass re-assert specifics on the next run
+                if not (isinstance(val, jax.Array)
+                        and len(val.sharding.device_set) > 1):
+                    from .. import parallel
+                    val = parallel.replicate(mesh, val)
+            else:
+                val = self._to_device(val)
+            scope._chain_set(name, val)
+            restored.append(name)
+        if unknown:
+            import warnings
+            warnings.warn(
+                'load_state_dict: %d checkpoint entr(ies) are not '
+                'persistables of this program and were skipped: %s'
+                % (len(unknown), sorted(unknown)[:8]), RuntimeWarning)
+        obs.event('executor.load_state_dict', restored=len(restored),
+                  skipped=len(unknown),
+                  mesh=sorted(dict(mesh.shape).items()) if mesh else None)
+        return restored
+
     @property
     def cache_stats(self):
         """THIS executor's compile-cache statistics

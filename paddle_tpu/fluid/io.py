@@ -172,6 +172,21 @@ def save_checkpoint(executor, checkpoint_dir, trainer_id=0, main_program=None,
     """Failure-recovery checkpoint: persistables + step counter + optional
     trainer args like {'epoch_id', 'step_id'} (reference io.py checkpoint
     utilities / trainer.py:641 save_checkpoint)."""
+    prog = main_program if main_program is not None \
+        else default_main_program()
+    from .executor import _is_annotated
+    if _is_annotated(prog):
+        # this path np.asarray()s every persistable DENSE on this host:
+        # for a mesh-annotated program that gathers a vocab-sharded table
+        # whole (the 92x footprint win undone; OOM on a real pod)
+        import warnings
+        warnings.warn(
+            'save_checkpoint gathers every persistable dense on this '
+            'host, but the program is mesh-annotated (set_mesh) — a '
+            'sharded table materializes whole here. Use '
+            'utils.checkpoint.save_sharded (the Trainer routes annotated '
+            'programs there automatically; docs/robustness.md#elastic).',
+            RuntimeWarning, stacklevel=2)
     serial_dir = os.path.join(checkpoint_dir, 'checkpoint_%d' % step)
     with obs.span('checkpoint.save', serial=step):
         params_path = save_persistables(executor, serial_dir, main_program)

@@ -69,7 +69,11 @@ class CheckpointConfig(object):
     """reference trainer.py:100."""
 
     def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
-                 epoch_interval=1, step_interval=10):
+                 epoch_interval=1, step_interval=10, commit_timeout=60.0):
+        """commit_timeout: sharded-checkpoint commit wait (seconds) —
+        how long process 0 waits for every peer's staged manifest before
+        declaring the save uncommitted (docs/robustness.md#elastic).
+        Irrelevant to the dense npz format."""
         assert epoch_interval >= 1
         assert step_interval >= 1
         self.checkpoint_dir = (checkpoint_dir if checkpoint_dir is not None
@@ -77,6 +81,7 @@ class CheckpointConfig(object):
         self.max_num_checkpoints = max_num_checkpoints
         self.epoch_interval = epoch_interval
         self.step_interval = step_interval
+        self.commit_timeout = float(commit_timeout)
         self.epoch_id = 0
         self.step_id = 0
         self.load_serial = None
@@ -116,7 +121,7 @@ class Trainer(object):
     def __init__(self, train_func, optimizer_func, param_path=None,
                  place=None, parallel=False, checkpoint_config=None,
                  transpiler_fn=None, bundle_steps=1, sync='auto',
-                 async_window=2):
+                 async_window=2, heartbeat=None):
         """transpiler_fn(train_program): optional hook applied after
         minimize — the high-level entry for the Program transpilers, e.g.
         lambda p: fluid.TensorParallelTranspiler(tp=2).transpile(p)
@@ -168,6 +173,13 @@ class Trainer(object):
         self._preempt_requested = False
         self._preempt_signum = None
         self.preempted = False
+        # elastic host-failure detection (docs/robustness.md#elastic):
+        # a parallel.Heartbeat whose check() runs at every step boundary;
+        # a stale peer flushes an emergency checkpoint and raises the
+        # typed parallel.HostLost so a supervisor restarts on the
+        # surviving topology. host_lost records what was detected.
+        self.heartbeat = heartbeat
+        self.host_lost = None
         self.parallel = parallel
         self.trainer_id = 0
         self.checkpoint_cfg = checkpoint_config
@@ -206,6 +218,15 @@ class Trainer(object):
                     if dc is not None:
                         self.test_program._dist_config = dict(dc)
                         self.test_program._dist_mesh = None
+                    # GSPMD annotation path: a hook that set_mesh() the
+                    # train program must leave test() on the same mesh —
+                    # the scope's persistables are mesh-placed
+                    ma = getattr(self.train_program, '_mesh_axes', None)
+                    if (ma is not None and getattr(
+                            self.test_program, '_mesh_axes', None) is None):
+                        self.test_program.set_mesh(
+                            list(ma),
+                            data_axis=self.train_program._mesh_data_axis)
                     self.train_program._retranspile_pipeline(
                         self.test_program)
 
@@ -225,10 +246,34 @@ class Trainer(object):
 
     # -- checkpoint/resume ------------------------------------------------
 
+    def _use_sharded_ckpt(self):
+        """Annotated (set_mesh) programs checkpoint SHARDED through
+        utils.checkpoint.save_sharded: state_dict walks the mesh-placed
+        persistables and each host writes only the shards it addresses —
+        the dense io.save_checkpoint path would gather a vocab-sharded
+        table whole on this host, undoing the sharding's footprint win
+        (docs/robustness.md#elastic)."""
+        from .executor import _is_annotated
+        return _is_annotated(self.train_program)
+
+    def _mesh_axes_list(self):
+        mesh = getattr(self.train_program, '_dist_mesh', None)
+        if not mesh:
+            return None
+        return [[str(n), int(s)] for n, s in
+                zip(mesh.axis_names, mesh.devices.shape)]
+
     def _maybe_resume_from_checkpoint(self):
         cfg = self.checkpoint_cfg
         if not os.path.isdir(cfg.checkpoint_dir):
             return
+        if self._use_sharded_ckpt():
+            from ..utils import checkpoint as shck
+            if shck.latest_step(cfg.checkpoint_dir) is not None \
+                    and self._resume_sharded(cfg):
+                return
+            # fall through: no (intact) sharded serial — old dense
+            # serials from a pre-elastic run still resume below
         # Newest first; a serial with a torn meta.json / missing or
         # CRC-mismatched params file (crash mid-save, bit rot) falls back
         # to the previous intact one — LOUDLY, because silently replaying
@@ -257,6 +302,118 @@ class Trainer(object):
             self._serial = int(meta.get('step', 0))
             return
 
+    @staticmethod
+    def _max_disk_serial(cfg):
+        """Largest serial number any sharded_<n>[.tmp|.old] dir under the
+        checkpoint dir claims — 0 when none."""
+        best = 0
+        if os.path.isdir(cfg.checkpoint_dir):
+            for d in os.listdir(cfg.checkpoint_dir):
+                m = re.fullmatch(r'sharded_(\d+)(\.tmp|\.old)?', d)
+                if m:
+                    best = max(best, int(m.group(1)))
+        return best
+
+    def _resume_sharded(self, cfg):
+        """Elastic resume (docs/robustness.md#elastic): restore the
+        newest COMMITTED, integrity-verified sharded serial, resharding
+        every persistable onto THIS run's mesh — the checkpoint may have
+        been written on a different topology (8 devices before a host
+        died, 4 now). Exact-step semantics are the dense path's: the
+        meta records (epoch, step-within-epoch) and the train loop
+        fast-forwards the reader past the already-done steps. Returns
+        False (loudly) when no intact sharded serial restores, so the
+        caller can try the legacy dense serials."""
+        import warnings
+        from ..utils import checkpoint as shck
+        try:
+            with self._prog_and_scope_guard():
+                with obs.span('trainer.checkpoint.load', sharded=True):
+                    mesh = self.exe._ensure_dist_placement(
+                        self.train_program, self.scope)
+                    arrays, meta = shck.load_latest_verified(
+                        cfg.checkpoint_dir, mesh=mesh)
+                    self.exe.load_state_dict(
+                        arrays, self.train_program, scope=self.scope)
+        except (RuntimeError, OSError, ValueError, KeyError) as e:
+            obs.counter('trainer.resume.fallbacks').inc()
+            obs.event('trainer.resume.fallback', serial='sharded',
+                      error='%s: %s' % (type(e).__name__, e))
+            warnings.warn(
+                'sharded checkpoint resume from %r failed (%s) — trying '
+                'the dense checkpoint serials'
+                % (cfg.checkpoint_dir, e), RuntimeWarning)
+            return False
+        extra = meta.get('extra') or {}
+        args = extra.get('trainer_args') or {}
+        cfg.load_serial = int(meta.get('step', 0))
+        cfg.epoch_id = int(args.get('epoch_id', 0))
+        cfg.step_id = int(args.get('step_id', 0))
+        # resume numbering PAST every serial number present on disk —
+        # committed, staged (.tmp) or demoted (.old). Reusing a crashed
+        # incarnation's serial would reuse its staging dir, whose stale
+        # step-matched peer manifests could satisfy the new save's
+        # commit wait early (mixed-incarnation checkpoint). Every
+        # restarted process derives the same number from the same
+        # (quiescent) listing, so the cohort stays in step.
+        self._serial = max(int(meta.get('step', 0)),
+                           self._max_disk_serial(cfg))
+        obs.event('elastic.resume', serial=self._serial,
+                  epoch=cfg.epoch_id, step=cfg.step_id,
+                  from_mesh=extra.get('mesh_axes'),
+                  to_mesh=self._mesh_axes_list())
+        return True
+
+    def _save_sharded(self, epoch_id, step_id, preempted=False,
+                      commit_timeout=None):
+        """The annotated-program save path: Executor.state_dict walks
+        the mesh-placed persistables (a vocab-sharded table stays 8
+        device shards — never gathered dense) and save_sharded streams
+        each host's own shards, staging + manifest-last + atomic rename
+        so a SIGKILL can never leave a latest-looking torn serial. The
+        extra meta records the reader position (epoch, step-within-
+        epoch) and the mesh shape, for exact-step topology-aware
+        resume."""
+        from ..utils import checkpoint as shck
+        cfg = self.checkpoint_cfg
+        args = {'epoch_id': epoch_id, 'step_id': step_id}
+        if preempted:
+            args['preempted'] = True
+        with self._prog_and_scope_guard():
+            state = self.exe.state_dict(self.train_program,
+                                        scope=self.scope)
+            path = shck.save_sharded(
+                os.path.join(cfg.checkpoint_dir,
+                             'sharded_%d' % self._serial),
+                state, step=self._serial,
+                extra_meta={'trainer_args': args,
+                            'trainer_id': self.trainer_id,
+                            'mesh_axes': self._mesh_axes_list()},
+                commit_timeout=(cfg.commit_timeout if commit_timeout
+                                is None else commit_timeout))
+        self._prune_sharded(cfg)
+        return path
+
+    def _prune_sharded(self, cfg):
+        """Keep max_num_checkpoints committed sharded serials (process 0
+        only on multi-process meshes — one pruner). Staging leftovers of
+        pruned serials go with them."""
+        import shutil
+        import jax
+        if jax.process_index() != 0:
+            return
+        from ..utils import checkpoint as shck
+        serials = []
+        for d in os.listdir(cfg.checkpoint_dir):
+            m = re.fullmatch(r'sharded_(\d+)', d)
+            if m:
+                serials.append(int(m.group(1)))
+        for s in sorted(serials)[:-cfg.max_num_checkpoints]:
+            base = os.path.join(cfg.checkpoint_dir, 'sharded_%d' % s)
+            shutil.rmtree(base, ignore_errors=True)
+            shutil.rmtree(shck._staging_dir(base), ignore_errors=True)
+            shutil.rmtree(base + shck._OLD_SUFFIX, ignore_errors=True)
+
     def _save_checkpoint(self, epoch_id, step_id, force=False):
         """force=True skips the interval modulo gate — the bundled loop
         applies its own range-crossing gate (a bundle boundary rarely
@@ -266,10 +423,31 @@ class Trainer(object):
         if force or (epoch_id % cfg.epoch_interval == 0
                      and step_id % cfg.step_interval == 0):
             self._serial += 1
-            with self._prog_and_scope_guard():
-                with obs.span('trainer.checkpoint.save',
-                              serial=self._serial, epoch=epoch_id,
-                              step=step_id):
+            with obs.span('trainer.checkpoint.save',
+                          serial=self._serial, epoch=epoch_id,
+                          step=step_id,
+                          sharded=self._use_sharded_ckpt()):
+                if self._use_sharded_ckpt():
+                    from ..utils.checkpoint import CommitTimeout
+                    try:
+                        self._save_sharded(epoch_id, step_id)
+                    except CommitTimeout as e:
+                        # a slow-but-alive peer (FS stall, GC pause)
+                        # missed the commit window: this is a MISSED
+                        # periodic checkpoint, not a dead run — the
+                        # previous committed serial still carries any
+                        # resume. Killing process 0 here would wedge
+                        # the healthy peers inside their next
+                        # collective. (A genuinely dead peer surfaces
+                        # through the heartbeat gate instead.)
+                        import warnings
+                        warnings.warn(
+                            'periodic sharded checkpoint did not '
+                            'commit (%s); training continues on the '
+                            'previous committed serial' % e,
+                            RuntimeWarning)
+                    return
+                with self._prog_and_scope_guard():
                     io.save_checkpoint(
                         self.exe, cfg.checkpoint_dir,
                         trainer_id=self.trainer_id,
@@ -279,20 +457,28 @@ class Trainer(object):
                                       'step_id': step_id},
                         max_num_checkpoints=cfg.max_num_checkpoints)
 
-    def _save_emergency_checkpoint(self, epoch_id, step_id):
+    def _save_emergency_checkpoint(self, epoch_id, step_id,
+                                   commit_timeout=None):
         """Preemption flush: unconditional (interval-ignoring) snapshot
         recording the exact (epoch, step) just completed, so a successor
         Trainer resumes at step_id + 1 — the reference's crash-recovery
         dirs never had a clean-shutdown writer; SIGTERM simply killed the
-        process and lost everything since the last periodic snapshot."""
+        process and lost everything since the last periodic snapshot.
+        Annotated programs flush SHARDED, like the periodic path;
+        commit_timeout shortens the commit wait when a peer is already
+        known dead (host loss)."""
         cfg = self.checkpoint_cfg
         if not cfg:
             return None
         self._serial += 1
-        with self._prog_and_scope_guard():
-            with obs.span('trainer.checkpoint.emergency_flush',
-                          serial=self._serial, epoch=epoch_id,
-                          step=step_id):
+        with obs.span('trainer.checkpoint.emergency_flush',
+                      serial=self._serial, epoch=epoch_id,
+                      step=step_id, sharded=self._use_sharded_ckpt()):
+            if self._use_sharded_ckpt():
+                return self._save_sharded(epoch_id, step_id,
+                                          preempted=True,
+                                          commit_timeout=commit_timeout)
+            with self._prog_and_scope_guard():
                 return io.save_checkpoint(
                     self.exe, cfg.checkpoint_dir,
                     trainer_id=self.trainer_id,
@@ -375,15 +561,73 @@ class Trainer(object):
             RuntimeWarning)
 
     def _clean_checkpoint(self):
-        # Remove only the checkpoint_<n> serial subdirs we created — the
+        # Remove only the serial subdirs we created (dense checkpoint_<n>,
+        # sharded sharded_<n> + their .tmp staging leftovers) — the
         # configured dir may be (and defaults to) the user's cwd.
         import shutil
         d = self.checkpoint_cfg.checkpoint_dir
         if not os.path.isdir(d):
             return
         for sub in os.listdir(d):
-            if re.fullmatch(r'checkpoint_\d+', sub):
+            if re.fullmatch(r'(checkpoint|sharded)_\d+(\.tmp|\.old)?', sub):
                 shutil.rmtree(os.path.join(d, sub), ignore_errors=True)
+
+    # -- host-failure detection -------------------------------------------
+
+    def _check_host_loss(self, last_done, window=None):
+        """Heartbeat gate, run at every step boundary BEFORE the next
+        dispatch (a dispatch against a dead peer hangs in the
+        collective). A stale peer: drain in-flight work, flush an
+        emergency checkpoint (sharded saves may legitimately fail to
+        COMMIT here — the dead peer can't stage its manifest; the last
+        periodic serial then carries the resume), record host_lost, and
+        raise the typed parallel.HostLost so the supervisor restarts on
+        the surviving topology (docs/robustness.md#elastic)."""
+        hb = self.heartbeat
+        if hb is None:
+            return
+        stale = hb.check(raise_error=False)
+        if not stale:
+            return
+        import warnings
+        from ..parallel.heartbeat import HostLost
+        if window:
+            self._drain_async_window(window)
+        obs.event('elastic.host_lost', stale=[int(s) for s in stale],
+                  epoch=last_done[0] if last_done else None,
+                  step=last_done[1] if last_done else None,
+                  mesh=self._mesh_axes_list())
+        saved = None
+        if self.checkpoint_cfg and last_done is not None:
+            try:
+                saved = self._save_emergency_checkpoint(
+                    *last_done,
+                    commit_timeout=max(1.0, hb.timeout))
+            except Exception as e:
+                warnings.warn(
+                    'emergency checkpoint after host loss did not '
+                    'commit (%s: %s) — resume will fall back to the '
+                    'last committed serial' % (type(e).__name__, e),
+                    RuntimeWarning)
+        # "saved" from a non-zero process means STAGED only — process 0
+        # performs the commit rename, and on this path process 0 may be
+        # the dead host. Report commitment from the filesystem truth.
+        committed = bool(saved) and os.path.isdir(saved)
+        self.host_lost = {'stale': list(stale), 'last_done': last_done,
+                          'emergency_checkpoint':
+                              saved if committed else None,
+                          'emergency_staged': saved}
+        warnings.warn(
+            'host(s) %s lost (heartbeat stale > %.1fs)%s — raising '
+            'HostLost; restart on the surviving topology and resume '
+            'from the last verified checkpoint'
+            % (stale, hb.timeout,
+               '; emergency checkpoint committed' if committed
+               else '; emergency flush did not commit'), RuntimeWarning)
+        raise HostLost(
+            'host(s) %s stopped heartbeating during training%s'
+            % (stale, ' (last completed step: epoch %d step %d)'
+               % last_done if last_done else ''), stale=stale)
 
     # -- public API -------------------------------------------------------
 
@@ -399,15 +643,23 @@ class Trainer(object):
         the same checkpoint dir)."""
         self.preempted = False
         self._preempt_requested = False
-        with self._preemption_handlers():
-            if self.parallel:
-                with self._prog_and_scope_guard():
-                    pe = self._get_or_create_parallel_executor()
-                self._train_loop(pe, num_epochs, event_handler, reader,
-                                 feed_order)
-            else:
-                self._train_loop(self.exe, num_epochs, event_handler, reader,
-                                 feed_order)
+        started_hb = False
+        if self.heartbeat is not None and not self.heartbeat.running:
+            self.heartbeat.start()
+            started_hb = True
+        try:
+            with self._preemption_handlers():
+                if self.parallel:
+                    with self._prog_and_scope_guard():
+                        pe = self._get_or_create_parallel_executor()
+                    self._train_loop(pe, num_epochs, event_handler, reader,
+                                     feed_order)
+                else:
+                    self._train_loop(self.exe, num_epochs, event_handler,
+                                     reader, feed_order)
+        finally:
+            if started_hb:
+                self.heartbeat.stop()
 
     def test(self, reader, feed_order=None):
         """reference trainer.py:409 — mean of train_func outputs over the
@@ -513,6 +765,9 @@ class Trainer(object):
                         self._drain_async_window(window)
                         self._finish_preemption(last_done)
                         return
+                    # host-failure gate: BEFORE dispatching another step
+                    # whose collectives would hang on a dead peer
+                    self._check_host_loss(last_done, window)
                     if (cfg and cfg.load_serial
                             and epoch_id == cfg.epoch_id
                             and step_id <= cfg.step_id):
@@ -639,6 +894,10 @@ class Trainer(object):
                     last_done = done or last_done
                     self._finish_preemption(last_done)
                     return
+                # host-failure gate; buffered batches are NOT flushed
+                # through the mesh first (its peers are gone) — the
+                # emergency path records the last COMPLETED bundle
+                self._check_host_loss(last_done)
                 if (cfg and cfg.load_serial
                         and epoch_id == cfg.epoch_id
                         and step_id <= cfg.step_id):

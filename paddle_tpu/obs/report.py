@@ -385,6 +385,60 @@ def summarize(events):
     else:
         lines.append('no checkpoint activity')
 
+    # -- elastic ----------------------------------------------------------
+    # elastic pod training (docs/robustness.md#elastic): sharded-
+    # checkpoint commits, reshard-on-restore, topology-change resumes,
+    # heartbeat staleness and host-loss verdicts — the decisions that
+    # keep a pod job restartable, one line each
+    # commits counted from the checkpoint.committed EVENT (fires only
+    # after the rename) — the checkpoint.commit span also covers
+    # staged-role peers and timed-out attempts, which are not commits
+    el_commits = _events(events, 'checkpoint.committed')
+    el_reshard = _spans(events, 'checkpoint.reshard')
+    el_resume = _events(events, 'elastic.resume')
+    el_lost = _events(events, 'elastic.host_lost')
+    el_stale = _events(events, 'parallel.heartbeat.stale')
+    el_skip = _events(events, 'checkpoint.uncommitted_skipped')
+    el_cto = _events(events, 'checkpoint.commit.timeout')
+    if el_commits or el_reshard or el_resume or el_lost or el_stale \
+            or el_skip or el_cto:
+        lines.append('')
+        lines.append('-- elastic --')
+        if el_commits:
+            steps = [e.get('fields', {}).get('step') for e in el_commits]
+            lines.append('checkpoint commits: %d (last step %s)'
+                         % (len(el_commits), steps[-1]))
+        for e in el_cto:
+            f = e.get('fields', {})
+            lines.append('commit TIMED OUT: step %s waiting for peer '
+                         'process(es) %s — left uncommitted'
+                         % (f.get('step', '?'), f.get('missing', '?')))
+        for e in el_skip:
+            lines.append('uncommitted (torn) staging dir(s) skipped on '
+                         'restore: %s' % e.get('fields', {}).get('dirs'))
+        for s in el_reshard:
+            f = s.get('fields', {})
+            lines.append('reshard-on-restore: %s array(s), mesh %s -> %s'
+                         % (f.get('arrays', '?'), f.get('from_mesh', '?'),
+                            f.get('to_mesh', '?')))
+        for e in el_resume:
+            f = e.get('fields', {})
+            lines.append('elastic resume: serial %s at epoch %s step %s, '
+                         'mesh %s -> %s'
+                         % (f.get('serial', '?'), f.get('epoch', '?'),
+                            f.get('step', '?'), f.get('from_mesh', '?'),
+                            f.get('to_mesh', '?')))
+        if el_stale:
+            peers = sorted({e.get('fields', {}).get('peer')
+                            for e in el_stale})
+            lines.append('stale heartbeats: %d detection(s), peer(s) %s'
+                         % (len(el_stale), peers))
+        for e in el_lost:
+            f = e.get('fields', {})
+            lines.append('HOST LOST: peer(s) %s at epoch %s step %s'
+                         % (f.get('stale', '?'), f.get('epoch', '?'),
+                            f.get('step', '?')))
+
     # -- serving ----------------------------------------------------------
     sv_batches = _spans(events, 'serving.batch')
     sv_warm = _spans(events, 'serving.warmup')

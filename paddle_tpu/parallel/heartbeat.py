@@ -1,0 +1,218 @@
+"""Host liveness for elastic multi-process training (docs/robustness.md).
+
+jax.distributed has no failure detector: when a host of a multi-process
+mesh dies (hardware fault, OOM-kill, preemption the supervisor never
+signaled), the survivors learn about it only by HANGING in the next
+cross-host collective. This layer detects the loss BEFORE the next
+dispatch: every process writes a monotonically increasing beat to a
+shared directory (the checkpoint filesystem — elastic training already
+requires one), and peers judge staleness by LOCAL monotonic time since a
+peer's counter last advanced. Judging progress rather than wall-clock
+mtimes makes the detector immune to cross-host clock skew, and
+file-based beats make it dependency-free (no side control-plane service).
+
+The Trainer consumes this (``Trainer(heartbeat=Heartbeat(...))``): a
+stale peer surfaces as the typed :class:`HostLost` after an emergency
+checkpoint flush, so a supervisor can restart the job on the surviving
+topology and resume from the last committed serial
+(``utils.checkpoint.load_latest_verified``).
+
+Every staleness verdict lands in the ``parallel.heartbeat.stale``
+counter and run-log event (docs/observability.md).
+"""
+import os
+import threading
+import time
+
+from .. import obs
+
+__all__ = ['Heartbeat', 'HostLost']
+
+
+class HostLost(RuntimeError):
+    """A peer process of the multi-process runtime stopped heartbeating.
+
+    Raised by :meth:`Heartbeat.check` (and surfaced through
+    ``Trainer.train``) once a peer's beat counter has not advanced for
+    longer than the configured timeout. ``.stale`` lists the lost
+    process ids, so a supervisor can log/restart on the surviving
+    topology."""
+
+    def __init__(self, message, stale=()):
+        super(HostLost, self).__init__(message)
+        self.stale = list(stale)
+
+
+def _beat_path(beat_dir, process_id):
+    return os.path.join(beat_dir, 'beat.p%d' % process_id)
+
+
+class Heartbeat(object):
+    """Per-host beat writer + stale-peer detector.
+
+    beat_dir: shared directory (every process of the job must see it —
+        the checkpoint dir is the natural choice).
+    process_id / num_processes: default from the initialized jax
+        runtime (jax.process_index / jax.process_count); explicit values
+        let tests drive several instances inside one process.
+    interval: seconds between background beats (start()).
+    timeout: seconds a peer's counter may stand still before it counts
+        as stale — must comfortably exceed the longest step + checkpoint
+        pause of the training loop, or a slow-but-alive host reads as
+        dead.
+
+    A peer is tracked from the moment start()/check() first runs; a peer
+    whose beat file never appears at all becomes stale after `timeout`
+    too (a host that never came up is as lost as one that died)."""
+
+    def __init__(self, beat_dir, process_id=None, num_processes=None,
+                 interval=0.25, timeout=2.0):
+        import jax
+        self.dir = beat_dir
+        os.makedirs(beat_dir, exist_ok=True)
+        self.process_id = (jax.process_index() if process_id is None
+                           else int(process_id))
+        self.num_processes = (jax.process_count() if num_processes is None
+                              else int(num_processes))
+        self.interval = float(interval)
+        self.timeout = float(timeout)
+        self._seq = 0
+        # per-writer nonce: a RESTARTED writer (new process — or a new
+        # Heartbeat instance in tests) starts again at seq 1, but its
+        # fresh nonce makes that first beat read as progress to peers
+        self._nonce = int.from_bytes(os.urandom(4), 'little')
+        self._thread = None
+        self._stop = threading.Event()
+        self._peers = {}     # pid -> {'seq': last seen, 'since': monotonic}
+        self._reported = set()   # peers already counted stale
+
+    @property
+    def running(self):
+        return self._thread is not None and self._thread.is_alive()
+
+    def _track_peers(self):
+        now = time.monotonic()
+        for i in range(self.num_processes):
+            if i != self.process_id:
+                self._peers.setdefault(i, {'seq': None, 'since': now})
+
+    def beat(self):
+        """Write one beat (atomic tmp+replace: readers never see a torn
+        payload). Manual loops call this directly; start() runs it on a
+        background thread."""
+        self._seq += 1
+        path = _beat_path(self.dir, self.process_id)
+        tmp = '%s.tmp%d' % (path, os.getpid())
+        with open(tmp, 'w') as f:
+            f.write('%d %d\n' % (self._seq, self._nonce))
+        os.replace(tmp, path)
+        return self._seq
+
+    def start(self):
+        """Start the background beat thread (daemon — a SIGKILLed host
+        stops beating by construction, which is the whole signal)."""
+        if self.running:
+            return self
+        self._stop.clear()
+        self._track_peers()
+        self.beat()
+
+        def loop():
+            while not self._stop.wait(self.interval):
+                try:
+                    self.beat()
+                except OSError:
+                    pass  # transient FS hiccup: the next beat retries
+
+        self._thread = threading.Thread(
+            target=loop, name='paddle-tpu-heartbeat', daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        """Stop the background beats (the beat files remain — peers will
+        judge this host stale, which is correct for a stopping host)."""
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=max(1.0, 4 * self.interval))
+            self._thread = None
+
+    def _read_beat(self, process_id):
+        """(seq, writer-nonce) of a peer's beat, or None. Progress is
+        judged on the PAIR: a restarted peer begins again at seq 1, but
+        its fresh nonce makes that first beat read as progress."""
+        try:
+            with open(_beat_path(self.dir, process_id)) as f:
+                parts = f.read().split()
+            return (int(parts[0]), int(parts[1]))
+        except (OSError, ValueError, IndexError):
+            return None
+
+    def _confirm_alive(self, pid, last):
+        """Bounded liveness confirmation: wait for ONE more beat from
+        the peer. Live peers beat every `interval`, so a window of a few
+        intervals decides; a dead peer's file never changes again."""
+        deadline = time.monotonic() + min(self.timeout,
+                                          3 * self.interval + 0.05)
+        while time.monotonic() < deadline:
+            time.sleep(min(0.02, self.interval / 4))
+            cur = self._read_beat(pid)
+            if cur is not None and cur != last:
+                return True
+        return False
+
+    def check(self, raise_error=True):
+        """Scan every peer's beat file; returns the sorted stale process
+        ids (empty = all alive). With raise_error (the default), any
+        staleness raises :class:`HostLost` instead. Cheap — one small
+        file read per peer — so the training loop runs it every step."""
+        self._track_peers()
+        now = time.monotonic()
+        stale = []
+        for pid in sorted(self._peers):
+            st = self._peers[pid]
+            gap = now - st.get('checked', now)
+            st['checked'] = now
+            seq = self._read_beat(pid)
+            if seq is not None and seq != st['seq']:
+                prev = st['seq']
+                st['seq'] = seq
+                # An advance observed after a BLIND window longer than
+                # the timeout proves nothing about the peer being alive
+                # NOW — a peer that died mid-window still shows the
+                # beats it banked first, and crediting them as fresh
+                # would send the caller into one more collective
+                # dispatch against a dead host (which hangs). Confirm
+                # current liveness with a short bounded re-poll: a live
+                # peer produces its next beat within ~interval; a dead
+                # one stays silent and goes stale on the spot. Checks
+                # at a normal cadence (gap <= timeout) skip the poll,
+                # so the steady state pays nothing and a live-but-slow
+                # peer can never accumulate drift toward a spurious
+                # verdict. A restarted writer (new nonce) is fresh by
+                # construction.
+                suspect = (prev is not None and seq[1] == prev[1]
+                           and gap > self.timeout)
+                if suspect and not self._confirm_alive(pid, seq):
+                    # liveness unproven: stale as of this check
+                    st['since'] = now - self.timeout - self.interval
+                else:
+                    st['since'] = now
+                    self._reported.discard(pid)   # peer (re)alive
+                    continue
+            age = now - st['since']
+            if age > self.timeout:
+                stale.append(pid)
+                if pid not in self._reported:
+                    self._reported.add(pid)
+                    obs.counter('parallel.heartbeat.stale').inc()
+                    obs.event('parallel.heartbeat.stale', peer=pid,
+                              age=round(age, 3), timeout=self.timeout,
+                              dir=os.path.basename(self.dir))
+        if stale and raise_error:
+            raise HostLost(
+                'process(es) %s stopped heartbeating (no beat for more '
+                'than %.1fs under %r) — the host is gone or wedged'
+                % (stale, self.timeout, self.dir), stale=stale)
+        return stale

@@ -12,16 +12,28 @@ materializes the full array and shardings round-trip exactly.
 
 Format:
   <dir>/manifest.json                  process 0's view: {step, arrays}
-  <dir>/manifest.p<i>.json             per-process shard listings (i > 0)
+  <dir>/manifest.json.sum              size+CRC32 of the manifest itself
+  <dir>/manifest.p<i>.json[.sum]       per-process shard listings (i > 0)
   <dir>/<escaped-name>.p<i>.shard<k>.npy   one file per distinct shard
 Every process writes its own files (no filename collisions); the loader
 merges all per-process manifests, so shards owned by other hosts are found
 without any cross-host coordination at save time.
+
+Atomic commit protocol (docs/robustness.md#elastic): everything above is
+staged into `<dir>.tmp` — shard files first, each process's manifest LAST
+— and process 0 COMMITS by renaming the staging dir to `<dir>` (after
+waiting for every peer's manifest on multi-process meshes). A SIGKILL at
+any point mid-save leaves only the `.tmp` dir, which `latest_step` /
+`load_latest_verified` never select, so a torn write can never look like
+the latest checkpoint — the loader falls back to the previous committed
+serial without depending on a CRC check happening to fail.
 """
 import json
 import os
-import re
+import shutil
 import threading
+import time
+import re
 import zlib
 
 import numpy as np
@@ -30,7 +42,7 @@ from .. import obs
 
 __all__ = ['save_sharded', 'save_sharded_async', 'load_sharded',
            'load_latest_verified', 'verify_sharded', 'latest_step',
-           'AsyncSave']
+           'restorable', 'AsyncSave', 'CommitTimeout']
 
 # transient-IO retry shape shared by shard reads/writes (utils.retry):
 # 2 extra attempts, short base delay — a genuinely corrupt file fails all
@@ -136,13 +148,180 @@ def _collect_shards(arrays, step, extra_meta, sink=None):
 def _write_manifest(ckpt_dir, manifest):
     """ATOMICALLY LAST — a crash mid-save leaves either no manifest (save
     never happened) or byte counts that expose any truncated shard to
-    _load_shard's corruption check."""
+    _load_shard's corruption check. A `.sum` sidecar (size + content
+    CRC32 of the manifest file itself) commits right after, so a
+    bit-rotted manifest fails verification with a typed error instead of
+    a raw JSON/KeyError; old checkpoints without the sidecar still
+    load."""
     proc = manifest['process']
     fname = _MANIFEST if proc == 0 else 'manifest.p%d.json' % proc
-    tmp = os.path.join(ckpt_dir, fname + '.tmp')
+    path = os.path.join(ckpt_dir, fname)
+    tmp = path + '.tmp'
     with open(tmp, 'w') as f:
         json.dump(manifest, f)
-    os.replace(tmp, os.path.join(ckpt_dir, fname))
+    # sidecar FIRST (computed over the staged bytes), manifest second:
+    # the manifest's appearance is what commit/peers key on, so by the
+    # time anyone can see it, its integrity record already exists — the
+    # reverse order would let process 0 rename the staging dir out from
+    # under a peer still writing its sidecar. An orphaned sidecar from
+    # a crash in between is harmless (loaders key on the manifest).
+    sum_tmp = path + '.sum.tmp'
+    with open(sum_tmp, 'w') as f:
+        json.dump({'file': fname, 'bytes': os.path.getsize(tmp),
+                   'crc32': _crc32_file(tmp)}, f)
+    os.replace(sum_tmp, path + '.sum')
+    os.replace(tmp, path)
+    return ckpt_dir
+
+
+def _read_manifest_file(path):
+    """Parse one manifest file, integrity-gated: when its `.sum` sidecar
+    exists (every checkpoint written since the commit protocol), the
+    manifest's size and content CRC32 are verified FIRST, so bit rot or
+    truncation surfaces as a typed RuntimeError the fallback machinery
+    understands — never a raw json/KeyError from half-parsed garbage.
+    Checkpoints predating the sidecar parse unverified (compat)."""
+    sum_path = path + '.sum'
+    if os.path.exists(sum_path):
+        try:
+            with open(sum_path) as f:
+                rec = json.load(f)
+            want_bytes, want_crc = rec.get('bytes'), rec.get('crc32')
+        except (OSError, ValueError) as e:
+            obs.counter('checkpoint.crc_verify', outcome='fail').inc()
+            raise RuntimeError(
+                'sharded checkpoint manifest sidecar %r is unreadable '
+                '(%r) — the manifest cannot be verified' % (sum_path, e))
+        if want_bytes is not None and os.path.getsize(path) != want_bytes:
+            obs.counter('checkpoint.crc_verify', outcome='fail').inc()
+            raise RuntimeError(
+                'sharded checkpoint manifest %r is corrupt: %d bytes on '
+                'disk, sidecar recorded %d (truncated write?)'
+                % (path, os.path.getsize(path), want_bytes))
+        got = _crc32_file(path)
+        if want_crc is not None and got != want_crc:
+            obs.counter('checkpoint.crc_verify', outcome='fail').inc()
+            raise RuntimeError(
+                'sharded checkpoint manifest %r is corrupt: content '
+                'CRC32 %08x does not match the sidecar record %08x '
+                '(bit rot or a partially-overwritten file)'
+                % (path, got, want_crc))
+        obs.counter('checkpoint.crc_verify', outcome='ok').inc()
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except ValueError as e:
+        raise RuntimeError(
+            'sharded checkpoint manifest %r is unreadable (%r) — torn '
+            'write or corruption the size/CRC sidecar did not cover'
+            % (path, e))
+
+
+# -- atomic commit protocol -------------------------------------------------
+
+_STAGING_SUFFIX = '.tmp'
+_OLD_SUFFIX = '.old'
+_COMMIT_TIMEOUT = 60.0
+_COMMIT_POLL = 0.05
+
+
+class CommitTimeout(RuntimeError):
+    """The commit wait for peer manifests expired — the save stays
+    loudly UNCOMMITTED (staging dir left in place; load_latest_verified
+    skips it). The previous committed serial carries the resume, so
+    callers with that fallback (the Trainer's periodic saves) may treat
+    this as a missed checkpoint rather than a fatal error."""
+
+
+def _staging_dir(ckpt_dir):
+    return ckpt_dir.rstrip('/' + os.sep) + _STAGING_SUFFIX
+
+
+def _prepare_staging(staging):
+    """Create the staging dir. Single-process, stale manifests left by a
+    previous crashed save to the same serial are cleared (no peer can be
+    writing); multi-process they are left alone — a peer may legitimately
+    already be staging this very save — and the commit wait instead
+    validates each peer manifest's step before counting it."""
+    import jax
+    os.makedirs(staging, exist_ok=True)
+    if jax.process_count() == 1:
+        for f in os.listdir(staging):
+            if re.fullmatch(r'manifest(\.p\d+)?\.json(\.sum)?', f):
+                try:
+                    os.remove(os.path.join(staging, f))
+                except OSError:
+                    pass
+    return staging
+
+
+def _peer_manifest_step(staging, proc):
+    """The 'step' a peer's staged manifest records, or None when absent /
+    unparseable / unverifiable (still being written, or stale garbage)."""
+    try:
+        man = _read_manifest_file(
+            os.path.join(staging, 'manifest.p%d.json' % proc))
+        return int(man.get('step', -1))
+    except (RuntimeError, OSError, ValueError, TypeError):
+        return None
+
+
+def _commit(staging, ckpt_dir, manifest, commit_timeout):
+    """Commit a fully-staged checkpoint: process 0 waits until every
+    peer's manifest (matching this save's step) is present in the staging
+    dir, then atomically renames it to the final name. Non-zero processes
+    only stage — the rename is process 0's, so on them this RETURNS
+    WITHOUT COMMITTING (the final dir exists only once process 0
+    renames; a caller that must know checks os.path.isdir on the final
+    name). A SIGKILL anywhere before the rename leaves `<dir>.tmp`,
+    which no loader ever selects; a commit TIMEOUT (a peer died
+    mid-save) raises CommitTimeout, leaving the checkpoint loudly
+    uncommitted."""
+    import jax
+    proc = int(manifest['process'])
+    nproc = jax.process_count()
+    step = int(manifest['step'])
+    with obs.span('checkpoint.commit', dir=os.path.basename(ckpt_dir),
+                  step=step, process=proc, processes=nproc) as sp:
+        if nproc > 1 and proc != 0:
+            sp.fields['role'] = 'staged'
+            return ckpt_dir
+        if nproc > 1:
+            deadline = time.monotonic() + float(commit_timeout)
+            while True:
+                missing = [i for i in range(1, nproc)
+                           if _peer_manifest_step(staging, i) != step]
+                if not missing:
+                    break
+                if time.monotonic() > deadline:
+                    obs.counter('checkpoint.commit.timeouts').inc()
+                    obs.event('checkpoint.commit.timeout', step=step,
+                              dir=os.path.basename(ckpt_dir),
+                              missing=missing)
+                    raise CommitTimeout(
+                        'sharded checkpoint commit of %r timed out after '
+                        '%.1fs waiting for peer manifest(s) from '
+                        'process(es) %s — the save stays UNCOMMITTED at '
+                        '%r and load_latest_verified will skip it'
+                        % (ckpt_dir, float(commit_timeout), missing,
+                           staging))
+                time.sleep(_COMMIT_POLL)
+        old = ckpt_dir.rstrip('/' + os.sep) + _OLD_SUFFIX
+        if os.path.isdir(old):
+            shutil.rmtree(old)   # garbage from a crashed earlier swap
+        if os.path.isdir(ckpt_dir):
+            # overwrite semantics of the pre-protocol writer, done as an
+            # atomic SWAP: the committed data is never deleted before
+            # its replacement is in place — a SIGKILL between the two
+            # renames demotes the old serial to `.old` (unselectable but
+            # intact on disk) instead of destroying it
+            os.rename(ckpt_dir, old)
+            os.rename(staging, ckpt_dir)
+            shutil.rmtree(old, ignore_errors=True)
+        else:
+            os.rename(staging, ckpt_dir)
+        obs.event('checkpoint.committed', step=step,
+                  dir=os.path.basename(ckpt_dir))
     return ckpt_dir
 
 
@@ -162,20 +341,28 @@ def _write_shard(fpath, data, sh):
     obs.counter('checkpoint.shard.bytes').inc(sh['bytes'])
 
 
-def _write_all(ckpt_dir, manifest, writes):
-    """Deferred writer (async path): shard files first, manifest last."""
-    os.makedirs(ckpt_dir, exist_ok=True)
+def _write_all(ckpt_dir, manifest, writes, commit_timeout=_COMMIT_TIMEOUT):
+    """Deferred writer (async path): stage shard files first, the
+    manifest last, then commit (rename) the staging dir."""
+    staging = _prepare_staging(_staging_dir(ckpt_dir))
     for fname, data, sh in writes:
-        _write_shard(os.path.join(ckpt_dir, fname), data, sh)
-    return _write_manifest(ckpt_dir, manifest)
+        _write_shard(os.path.join(staging, fname), data, sh)
+    _write_manifest(staging, manifest)
+    return _commit(staging, ckpt_dir, manifest, commit_timeout)
 
 
-def save_sharded(ckpt_dir, arrays, step=0, extra_meta=None):
+def save_sharded(ckpt_dir, arrays, step=0, extra_meta=None,
+                 commit_timeout=_COMMIT_TIMEOUT):
     """Save {name: jax.Array} without gathering: each process writes the
     replica-0 shards it can address (filenames carry the process index, so
     hosts never collide) and its own manifest listing exactly those shards;
     the loader merges all manifests. Shards stream to disk one at a time
-    (no whole-checkpoint host copy); the manifest commits last."""
+    (no whole-checkpoint host copy); everything stages into `<dir>.tmp`,
+    each process's manifest commits last within the staging dir, and
+    process 0 atomically renames it to `<dir>` once every peer's manifest
+    for this step is present (`commit_timeout` bounds that wait — a peer
+    that died mid-save raises here, leaving the save loudly uncommitted
+    instead of latest-looking and torn)."""
     key = os.path.abspath(ckpt_dir)
     with _INFLIGHT_LOCK:
         if key in _INFLIGHT_DIRS:
@@ -186,17 +373,18 @@ def save_sharded(ckpt_dir, arrays, step=0, extra_meta=None):
                 % ckpt_dir)
         _INFLIGHT_DIRS.add(key)
     try:
-        os.makedirs(ckpt_dir, exist_ok=True)
+        staging = _prepare_staging(_staging_dir(ckpt_dir))
 
         def sink(fname, shard_data, sh):
-            _write_shard(os.path.join(ckpt_dir, fname),
+            _write_shard(os.path.join(staging, fname),
                          np.asarray(shard_data), sh)
 
         with obs.span('checkpoint.save_sharded', step=step,
                       dir=os.path.basename(ckpt_dir), arrays=len(arrays)):
             manifest, _ = _collect_shards(arrays, step, extra_meta,
                                           sink=sink)
-            return _write_manifest(ckpt_dir, manifest)
+            _write_manifest(staging, manifest)
+            return _commit(staging, ckpt_dir, manifest, commit_timeout)
     finally:
         with _INFLIGHT_LOCK:
             _INFLIGHT_DIRS.discard(key)
@@ -273,7 +461,8 @@ class AsyncSave(object):
             raise
 
 
-def save_sharded_async(ckpt_dir, arrays, step=0, extra_meta=None):
+def save_sharded_async(ckpt_dir, arrays, step=0, extra_meta=None,
+                       commit_timeout=_COMMIT_TIMEOUT):
     """save_sharded with the file IO off the critical path: device->host
     shard COPIES happen synchronously (so the caller may immediately
     donate/overwrite the device buffers — the next train step overlaps
@@ -300,7 +489,8 @@ def save_sharded_async(ckpt_dir, arrays, step=0, extra_meta=None):
         manifest, writes = _collect_shards(arrays, step, extra_meta)
         pool = ThreadPoolExecutor(max_workers=1,
                                   thread_name_prefix='paddle-tpu-async-ckpt')
-        future = pool.submit(_write_all, ckpt_dir, manifest, writes)
+        future = pool.submit(_write_all, ckpt_dir, manifest, writes,
+                             commit_timeout)
     except BaseException:
         with _INFLIGHT_LOCK:
             _INFLIGHT_DIRS.discard(key)
@@ -395,13 +585,14 @@ def _load_shard(ckpt_dir, sh, verify_crc=True):
 
 def _merged_manifest(ckpt_dir):
     """Process 0's manifest with every other host's shard listings merged
-    into the arrays table."""
-    with open(os.path.join(ckpt_dir, _MANIFEST)) as f:
-        manifest = json.load(f)
+    into the arrays table. Every manifest file is size/CRC-verified
+    against its `.sum` sidecar first (when present — old checkpoints
+    predate it), so a bit-rotted manifest is a typed verification
+    failure, not a raw parse error."""
+    manifest = _read_manifest_file(os.path.join(ckpt_dir, _MANIFEST))
     for d in sorted(os.listdir(ckpt_dir)):
         if re.fullmatch(r'manifest\.p\d+\.json', d):
-            with open(os.path.join(ckpt_dir, d)) as f:
-                part = json.load(f)
+            part = _read_manifest_file(os.path.join(ckpt_dir, d))
             for name, entry in part.get('arrays', {}).items():
                 if name in manifest['arrays']:
                     manifest['arrays'][name]['shards'].extend(entry['shards'])
@@ -421,9 +612,9 @@ def verify_sharded(ckpt_dir):
             as sp:
         try:
             manifest = _merged_manifest(ckpt_dir)
-        except (OSError, ValueError, KeyError) as e:
+        except (RuntimeError, OSError, ValueError, KeyError) as e:
             sp.fields['problems'] = 1
-            return ['manifest unreadable in %r: %r' % (ckpt_dir, e)]
+            return ['manifest unreadable in %r: %s' % (ckpt_dir, e)]
         for name, entry in manifest.get('arrays', {}).items():
             for sh in entry.get('shards', []):
                 try:
@@ -448,15 +639,35 @@ def load_latest_verified(base_dir, prefix='sharded_', mesh=None):
     remains. Returns (arrays, meta) like load_sharded."""
     import warnings
     steps = []
+    uncommitted = []
     if os.path.isdir(base_dir):
         for d in os.listdir(base_dir):
-            if d.startswith(prefix):
-                try:
-                    steps.append(int(d[len(prefix):]))
-                except ValueError:
-                    continue
+            if not d.startswith(prefix):
+                continue
+            if re.fullmatch(r'\d+' + re.escape(_STAGING_SUFFIX),
+                            d[len(prefix):]):
+                uncommitted.append(d)
+                continue
+            try:
+                steps.append(int(d[len(prefix):]))
+            except ValueError:
+                continue
+    if uncommitted:
+        # a save that never committed (SIGKILL / peer death mid-write):
+        # by construction it is not a candidate — say so out loud rather
+        # than silently ignoring what an operator will see on disk
+        obs.event('checkpoint.uncommitted_skipped',
+                  dirs=sorted(uncommitted))
+        warnings.warn(
+            'skipping uncommitted (torn) sharded checkpoint staging '
+            'dir(s) %s under %r — a save was killed before its commit '
+            'rename; restoring from the newest COMMITTED serial'
+            % (sorted(uncommitted), base_dir), RuntimeWarning)
     if not steps:
-        raise RuntimeError('no %r serials under %r' % (prefix, base_dir))
+        raise RuntimeError('no committed %r serials under %r%s'
+                           % (prefix, base_dir,
+                              ' (only uncommitted staging dirs %s)'
+                              % sorted(uncommitted) if uncommitted else ''))
     tried = []
     for step in sorted(steps, reverse=True):
         ckpt_dir = os.path.join(base_dir, '%s%d' % (prefix, step))
@@ -495,10 +706,21 @@ def load_sharded(ckpt_dir, mesh=None, verify_crc=True):
     (arrays, meta) where meta has 'step' and 'extra'. verify_crc=False
     skips the per-shard content CRC (size/readability still checked) —
     for callers that just ran verify_sharded over the same dir.
+
+    Reshard-on-restore (docs/robustness.md#elastic): when `mesh` differs
+    from the mesh an array was SAVED on (fewer/more devices after an
+    elastic restart), each requested shard region is assembled from the
+    overlapping saved shard files — no host ever materializes the full
+    array. Spec axes absent from the target mesh replicate that dim (with
+    a warning); `restorable()` is the static pre-check.
     """
     with obs.span('checkpoint.load_sharded',
                   dir=os.path.basename(ckpt_dir)):
         return _load_sharded_impl(ckpt_dir, mesh, verify_crc)
+
+
+def _mesh_desc(axes, shape):
+    return ','.join('%s=%d' % (a, s) for a, s in zip(axes, shape))
 
 
 def _load_sharded_impl(ckpt_dir, mesh, verify_crc):
@@ -506,6 +728,56 @@ def _load_sharded_impl(ckpt_dir, mesh, verify_crc):
     from jax.sharding import Mesh, NamedSharding
 
     manifest = _merged_manifest(ckpt_dir)
+
+    # reshard-on-restore accounting: arrays whose saved mesh geometry
+    # differs from the target mesh get reassembled below; the span makes
+    # that visible (from/to shapes) instead of silent per-array work
+    resharded = []
+    if mesh is not None:
+        tgt = (tuple(str(a) for a in mesh.axis_names),
+               tuple(int(s) for s in mesh.devices.shape))
+        for name, entry in manifest.get('arrays', {}).items():
+            if 'spec' not in entry:
+                continue
+            src = (tuple(entry.get('mesh_axes', ())),
+                   tuple(entry.get('mesh_shape', ())))
+            if src != tgt:
+                resharded.append((name, src))
+    if resharded:
+        src = resharded[0][1]
+        with obs.span('checkpoint.reshard', arrays=len(resharded),
+                      dir=os.path.basename(ckpt_dir),
+                      from_mesh=_mesh_desc(*src),
+                      to_mesh=_mesh_desc(*tgt)):
+            return _load_arrays(ckpt_dir, manifest, mesh, verify_crc)
+    return _load_arrays(ckpt_dir, manifest, mesh, verify_crc)
+
+
+def _spec_for_mesh(spec, mesh, name):
+    """Drop spec axes the target mesh does not have (those dims restore
+    replicated) — the elastic case of restoring onto a mesh with a
+    different axis set; loud, because the layout changes."""
+    missing = set()
+    out = []
+    for e in tuple(spec):
+        axes = e if isinstance(e, tuple) else ((e,) if e else ())
+        keep = tuple(a for a in axes if a in mesh.shape)
+        missing.update(a for a in axes if a not in mesh.shape)
+        out.append(keep if len(keep) > 1 else (keep[0] if keep else None))
+    if missing:
+        import warnings
+        from jax.sharding import PartitionSpec as P
+        warnings.warn(
+            'sharded checkpoint array %r: saved sharding axes %s are not '
+            'on the restore mesh %r — those dims restore replicated'
+            % (name, sorted(missing), dict(mesh.shape)), RuntimeWarning)
+        return P(*out)
+    return spec
+
+
+def _load_arrays(ckpt_dir, manifest, mesh, verify_crc):
+    import jax
+    from jax.sharding import Mesh, NamedSharding
 
     mesh_cache = {}
 
@@ -558,7 +830,10 @@ def _load_sharded_impl(ckpt_dir, mesh, verify_crc):
 
         if 'spec' in entry:
             m = get_mesh(entry['mesh_axes'], entry['mesh_shape'])
-            sharding = NamedSharding(m, _spec_from_json(entry['spec']))
+            spec = _spec_from_json(entry['spec'])
+            if mesh is not None:
+                spec = _spec_for_mesh(spec, m, name)
+            sharding = NamedSharding(m, spec)
         else:
             sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
         if shape == ():
@@ -567,6 +842,69 @@ def _load_sharded_impl(ckpt_dir, mesh, verify_crc):
         else:
             out[name] = jax.make_array_from_callback(shape, sharding, cb)
     return out, {'step': manifest['step'], 'extra': manifest.get('extra', {})}
+
+
+def restorable(src, mesh_axes):
+    """Static reshard-on-restore check: can the checkpoint described by
+    `src` (a merged-manifest dict, or a committed sharded checkpoint dir)
+    restore cleanly onto a deployment mesh of `mesh_axes` ({'dp': 4} or
+    [(name, size), ...] ordered pairs)?
+
+    Returns a list of human-readable problems — empty means every array
+    restores cleanly. Checked per array, without reading any shard
+    payload: (a) the saved replica-0 shards cover the full array (their
+    volumes sum to the array's — save_sharded writes disjoint shards, so
+    a gap means a deleted/never-written file); (b) every saved sharding
+    axis exists on the target mesh (a dropped axis restores that dim
+    REPLICATED — legal but layout-changing, so it is reported); (c) each
+    sharded dim tiles over its target axis product (mirroring the
+    analysis ShardingUntileable posture). Wired into
+    `tools/program_lint.py --mesh ... --checkpoint DIR` so an elastic
+    restart can be validated before any device is touched."""
+    manifest = src if isinstance(src, dict) else _merged_manifest(src)
+    axes = dict(mesh_axes)
+    problems = []
+    for name, entry in sorted(manifest.get('arrays', {}).items()):
+        shape = entry.get('shape')
+        if shape is None:
+            problems.append('%s: manifest entry records no shape' % name)
+            continue
+        shape = tuple(int(s) for s in shape)
+        total = int(np.prod(shape)) if shape else 1
+        covered = 0
+        try:
+            for sh in entry.get('shards', []):
+                covered += int(np.prod(
+                    [int(t) - int(s)
+                     for s, t in zip(sh['start'], sh['stop'])]
+                    or [1]))
+        except (KeyError, TypeError, ValueError) as e:
+            problems.append('%s: malformed shard entry (%r)' % (name, e))
+            continue
+        if covered != total:
+            problems.append(
+                '%s: saved shards cover %d of %d elements — a shard '
+                'file is missing from the manifest (torn or pruned '
+                'save?)' % (name, covered, total))
+        spec = entry.get('spec')
+        if not spec:
+            continue  # replicated / single-device: restores anywhere
+        for dim, e in zip(shape, spec):
+            entry_axes = e if isinstance(e, list) else ([e] if e else [])
+            prod = 1
+            for a in entry_axes:
+                if a not in axes:
+                    problems.append(
+                        '%s: sharding axis %r is not on the target mesh '
+                        '%s — the dim would restore replicated'
+                        % (name, a, axes))
+                else:
+                    prod *= int(axes[a])
+            if prod > 1 and dim % prod:
+                problems.append(
+                    '%s: dim of size %d does not tile over the target '
+                    'axis product %s=%d' % (name, dim, entry_axes, prod))
+    return problems
 
 
 def latest_step(base_dir, prefix='sharded_'):

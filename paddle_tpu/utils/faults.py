@@ -139,8 +139,64 @@ class FaultInjector(object):
             raise ValueError('no %r files under %r' % (suffix, directory))
         return os.path.join(directory, names[self.rng.randint(len(names))])
 
+    # -- checkpoint faults -------------------------------------------------
+
+    def torn_checkpoint(self, ckpt_dir, what=None):
+        """Tear a sharded checkpoint dir the way a crash mid-save (or
+        bit rot after it) would, for the elastic drills:
+
+          'drop_manifest'     — delete manifest.json (+ its .sum): the
+                                serial can never verify;
+          'truncate_manifest' — cut the manifest short (a torn write the
+                                .sum sidecar exposes as a typed failure);
+          'corrupt_manifest'  — same-size bit rot in the manifest (only
+                                the sidecar CRC catches it);
+          'drop_shard'        — delete one seeded shard file;
+          'truncate_shard'    — truncate one seeded shard file.
+
+        Default: a seeded choice among all five. Returns (what, path)."""
+        modes = ('drop_manifest', 'truncate_manifest', 'corrupt_manifest',
+                 'drop_shard', 'truncate_shard')
+        if what is None:
+            what = modes[self.rng.randint(len(modes))]
+        if what not in modes:
+            raise ValueError('unknown torn_checkpoint mode %r (one of %s)'
+                             % (what, modes))
+        if what.endswith('_manifest'):
+            path = os.path.join(ckpt_dir, 'manifest.json')
+            if what == 'drop_manifest':
+                os.remove(path)
+                for side in (path + '.sum',):
+                    if os.path.exists(side):
+                        os.remove(side)
+            elif what == 'truncate_manifest':
+                self.truncate_file(path)
+            else:
+                self.corrupt_file(path)
+            return what, path
+        path = self.pick_file(ckpt_dir, suffix='.npy')
+        if what == 'drop_shard':
+            os.remove(path)
+        else:
+            self.truncate_file(path)
+        return what, path
+
     # -- process faults ----------------------------------------------------
 
     def preempt(self, sig=signal.SIGTERM):
         """Simulated preemption of THIS process (see send_preemption)."""
         send_preemption(sig)
+
+    def kill_process(self, proc, sig=signal.SIGKILL):
+        """SIGKILL a child process mid-step — the host-failure fault: no
+        handlers run, no flush happens, beats stop. `proc` is a
+        subprocess.Popen (or anything with .pid) or a raw pid. Returns
+        the pid killed."""
+        pid = int(getattr(proc, 'pid', proc))
+        if pid == os.getpid():
+            raise ValueError(
+                'kill_process targets a CHILD (SIGKILL to self would '
+                'take the test runner down); use preempt() for '
+                'self-delivered signals')
+        os.kill(pid, sig)
+        return pid

@@ -6,8 +6,14 @@
 # seeded and sleep-free); anything slow must carry the `slow` marker so
 # this stays a pre-merge check, not a nightly.
 #
+# The elastic tier (tests/test_elastic.py, marker `elastic`) rides along:
+# sharded-checkpoint commit/torn-write drills, topology-changing resume,
+# heartbeat host-loss detection (docs/robustness.md#elastic). Its
+# multi-process kill-one-worker drill is `slow` and so excluded here.
+#
 # Usage: tools/fault_drill.sh [extra pytest args]
 set -euo pipefail
 cd "$(dirname "$0")/.."
-exec env JAX_PLATFORMS=cpu python -m pytest -m 'faults and not slow' \
-    -q -p no:cacheprovider "$@" tests/test_faults.py
+exec env JAX_PLATFORMS=cpu python -m pytest \
+    -m '(faults or elastic) and not slow' \
+    -q -p no:cacheprovider "$@" tests/test_faults.py tests/test_elastic.py

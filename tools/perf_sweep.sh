@@ -169,6 +169,16 @@ if [ "${EMBED:-0}" = 1 ]; then
       --platform "${BENCH_PLATFORM:-tpu}"
 fi
 
+# 8d. elastic smoke (opt-in: ELASTIC=1): the fast elastic drill tier —
+#     sharded checkpoints through the Trainer, atomic commit + torn-write
+#     fallback, reshard-on-restore topology change, heartbeat staleness
+#     (docs/robustness.md#elastic). CPU-pinned: the drills exercise
+#     host-side commit/restore machinery, not chip throughput.
+if [ "${ELASTIC:-0}" = 1 ]; then
+  run env JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
+      -m 'elastic and not slow' tests/test_elastic.py
+fi
+
 # 9. serving engine vs sequential Predictor (opt-in: SERVE=1). Closed
 #    loop at the acceptance concurrency, then an open-loop arrival test;
 #    --check-compiles fails the command if steady state compiled, which

@@ -6,6 +6,9 @@
     python tools/program_lint.py MODEL_DIR --json     # machine-readable
     python tools/program_lint.py MODEL_DIR --fetch y_out --fetch probs
     python tools/program_lint.py MODEL_DIR --concurrent   # serving context
+    python tools/program_lint.py MODEL_DIR --mesh dpx4 --checkpoint CKPT
+                                   # elastic-restart pre-check: does the
+                                   # sharded checkpoint restore onto dpx4?
 
 Rebuilds the Program from the artifact (the save_inference_model JSON —
 the TPU equivalent of a ProgramDesc) and runs every fluid.analysis pass
@@ -74,6 +77,13 @@ def main(argv=None):
                          'comma-separated; NAME=SIZE also accepted) — '
                          'the deployment mesh a saved Program is about '
                          'to run on')
+    ap.add_argument('--checkpoint', default=None, metavar='CKPT_DIR',
+                    help='with --mesh: statically check that this '
+                         'COMMITTED sharded checkpoint dir restores '
+                         'onto the --mesh topology '
+                         '(utils.checkpoint.restorable — shard '
+                         'coverage, axis fit, dim tiling) before any '
+                         'device is touched; problems exit 1')
     ap.add_argument('--strict', action='store_true',
                     help='exit 1 on warnings too, not just errors')
     ap.add_argument('--optimize', nargs='?', const='default',
@@ -100,6 +110,21 @@ def main(argv=None):
         if mesh_axes is None:
             print('program_lint: cannot parse --mesh %r (expected e.g. '
                   '"dpx8" or "dpx2,modelx4")' % args.mesh, file=sys.stderr)
+            return 2
+
+    ckpt_problems = None
+    if args.checkpoint:
+        if mesh_axes is None:
+            print('program_lint: --checkpoint needs --mesh (the target '
+                  'topology to restore onto)', file=sys.stderr)
+            return 2
+        from paddle_tpu.utils import checkpoint as shck
+        try:
+            ckpt_problems = shck.restorable(args.checkpoint, mesh_axes)
+        except Exception as e:
+            print('program_lint: cannot read sharded checkpoint %r: '
+                  '%s: %s' % (args.checkpoint, type(e).__name__, e),
+                  file=sys.stderr)
             return 2
 
     from paddle_tpu.fluid import analysis
@@ -140,6 +165,10 @@ def main(argv=None):
                 report, plan = opt_payload
                 doc['optimize'] = report.to_dict()
                 doc['memory_plan'] = plan.to_dict()
+            if ckpt_problems is not None:
+                doc['checkpoint'] = {'dir': args.checkpoint,
+                                     'restorable': not ckpt_problems,
+                                     'problems': ckpt_problems}
             print(json.dumps(doc, indent=2))
     else:
         nops = sum(len(b.ops) for b in program.blocks)
@@ -148,6 +177,15 @@ def main(argv=None):
         if mesh_axes is not None:
             print('sharding pass: linted against mesh %s'
                   % 'x'.join('%s=%d' % a for a in mesh_axes))
+        if ckpt_problems is not None:
+            if not ckpt_problems:
+                print('checkpoint %s: restorable onto this mesh'
+                      % args.checkpoint)
+            else:
+                print('checkpoint %s: NOT cleanly restorable onto this '
+                      'mesh:' % args.checkpoint)
+                for p in ckpt_problems:
+                    print('  %s' % p)
         print('shape pass: %(inferred)d inferred, %(skipped)d skipped, '
               '%(failed)d failed, %(no_rule)d without rules' % stats)
         if not findings:
@@ -171,6 +209,8 @@ def main(argv=None):
 
     errors = sum(1 for f in findings if f.severity == analysis.SEV_ERROR)
     bad = len(findings) if args.strict else errors
+    if ckpt_problems:
+        bad += len(ckpt_problems)
     return 1 if bad else 0
 
 
