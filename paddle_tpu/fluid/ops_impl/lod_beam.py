@@ -373,6 +373,34 @@ def attention_beam_step(params, enc_t, mask_t, carry, beam, end_id):
         (sel_ids, parent, top_scores)
 
 
+def greedy_attend_cell(params, enc, mask, h, c, tok):
+    """One attend -> LSTM cell -> project step for [B] independent rows
+    with NO beam dimension — the draft model's proposal step in
+    speculative decoding (sampled_ops.attention_lstm_spec_decode_step)
+    and the reference the verify phase's split-projection restructuring
+    is measured against. Same cell math as attention_beam_step at
+    beam=1, minus the top-k/reorder bookkeeping.
+
+    params: the WEIGHT_KEYS tuple (w_dec [E+D,4H], u_dec [H,4H], b_dec,
+    w_q [H,D], w_emb [V,E], w_out [H,V], b_out); enc [B, S, D];
+    mask [B, S] 1/0; h/c [B, H]; tok [B] int32.
+    Returns (h2, c2, logits [B, V] float32)."""
+    w_dec, u_dec, b_dec, w_q, w_emb, w_out, b_out = params
+    neg = jnp.finfo(jnp.float32).min
+    x = jnp.take(w_emb, tok, axis=0)
+    q = h @ w_q
+    scores = jnp.einsum('bd,bsd->bs', q, enc)
+    scores = jnp.where(mask > 0, scores, neg)
+    alpha = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum('bs,bsd->bd', alpha, enc)
+    g = jnp.concatenate([x, ctx], -1) @ w_dec + h @ u_dec + b_dec
+    gi, gf, gc, go = jnp.split(g, 4, axis=-1)
+    c2 = jax.nn.sigmoid(gf) * c + jax.nn.sigmoid(gi) * jnp.tanh(gc)
+    h2 = jax.nn.sigmoid(go) * jnp.tanh(c2)
+    logits = (h2 @ w_out + b_out).astype(jnp.float32)
+    return h2, c2, logits
+
+
 def backtrace_beams(ids_seq, par_seq):
     """Host-side backtrace of one source's per-step beams — the exact
     numpy transcription of the whole-sequence op's in-graph `back` scan

@@ -286,20 +286,10 @@ def _attention_lstm_beam_decode_step(ins, attrs, ctx):
 
     def one_step(carry, _):
         h, c, prev, acc, fin, ids_h, par_h, step, active, bad_acc = carry
-        new_carry, (sel_ids, parent, _top) = attention_beam_step(
-            params, enc_t, mask_t, (h, c, prev, acc, fin), beam, end_id)
-
-        # where-select masking (the anomaly guard's rollback pattern):
-        # only ACTIVE slots advance; everything else keeps its old state
-        # bit for bit, so joins/leaves between dispatches — and slots
-        # that finished EARLIER IN THE BUNDLE — never disturb live ones
-        act_row = jnp.repeat(active, beam)           # [slots*beam]
-        sel = lambda new, old: jnp.where(
-            act_row.reshape((-1,) + (1,) * (new.ndim - 1)), new, old)
-        h2, c2, ids2, acc2, fin2 = (
-            sel(new_carry[0], h), sel(new_carry[1], c),
-            sel(new_carry[2], prev), sel(new_carry[3], acc),
-            sel(new_carry[4], fin))
+        (h2, c2, ids2, acc2, fin2), (sel_ids, parent) = \
+            _masked_beam_advance(params, enc_t, mask_t,
+                                 (h, c, prev, acc, fin), active, beam,
+                                 end_id)
 
         # per-slot history write at each slot's OWN step index
         at_t = ((jnp.arange(t_cap)[None, :] == step[:, None])
@@ -331,6 +321,342 @@ def _attention_lstm_beam_decode_step(ins, attrs, ctx):
             'ParHistOut': par_hist2, 'StepOut': step2,
             'ActiveOut': active2, 'Done': active_in & ~active2,
             'Bad': bad}
+
+
+def _masked_beam_advance(params, enc_t, mask_t, carry5, active, beam,
+                         end_id):
+    """One beam step over the slot pool with where-select masking (the
+    anomaly guard's rollback pattern): only ACTIVE slots advance;
+    everything else keeps its old state bit for bit, so joins/leaves
+    between dispatches — and slots that finished earlier in a bundle —
+    never disturb live ones. Shared by the dense and the paged step op
+    so the two are bit-exact by construction."""
+    from .lod_beam import attention_beam_step
+    h, c, prev, acc, fin = carry5
+    new_carry, (sel_ids, parent, _top) = attention_beam_step(
+        params, enc_t, mask_t, carry5, beam, end_id)
+    act_row = jnp.repeat(active, beam)               # [slots*beam]
+    sel = lambda new, old: jnp.where(
+        act_row.reshape((-1,) + (1,) * (new.ndim - 1)), new, old)
+    return (sel(new_carry[0], h), sel(new_carry[1], c),
+            sel(new_carry[2], prev), sel(new_carry[3], acc),
+            sel(new_carry[4], fin)), (sel_ids, parent)
+
+
+def _decode_weight_params(ins, prefix=''):
+    """The WEIGHT_KEYS tuple from op inputs (prefix='Draft' pulls the
+    draft model's tensors in the speculative step)."""
+    return (data_of(ins[prefix + 'WDec'][0]),
+            data_of(ins[prefix + 'UDec'][0]),
+            data_of(ins[prefix + 'BDec'][0])
+            if ins.get(prefix + 'BDec') else 0.0,
+            data_of(ins[prefix + 'WAttnQ'][0]),
+            data_of(ins[prefix + 'WEmb'][0]),
+            data_of(ins[prefix + 'WOut'][0]),
+            data_of(ins[prefix + 'BOut'][0])
+            if ins.get(prefix + 'BOut') else 0.0)
+
+
+def _gather_paged_enc(ins, src_cap):
+    """Assemble per-slot encoder rows + attention mask from the page
+    pools through the slot page tables — ONE in-graph gather per
+    dispatch (amortized over the whole bundle), the PagedAttention
+    lookup. Tail page-table entries point at the reserved ZERO page, so
+    masked-out rows always read finite zeros."""
+    pt_enc = data_of(ins['PtEnc'][0]).astype(jnp.int32)    # [C, NPE]
+    enc_pages = data_of(ins['EncPages'][0])                # [Pe, ps, D]
+    mask_pages = data_of(ins['MaskPages'][0])              # [Pe, ps]
+    C, NPE = pt_enc.shape
+    ps, D2 = enc_pages.shape[1], enc_pages.shape[2]
+    enc = jnp.take(enc_pages, pt_enc, axis=0)              # [C,NPE,ps,D]
+    enc = enc.reshape(C, NPE * ps, D2)[:, :src_cap]
+    mask = jnp.take(mask_pages, pt_enc, axis=0).reshape(
+        C, NPE * ps)[:, :src_cap]
+    return enc, mask
+
+
+def _paged_hist_write(pool, pt_hist, step, page_size, valid, rows,
+                      n_pages):
+    """Scatter one [slots, beam] history row into the page pool at each
+    slot's own (page, offset): physical page = pt_hist[slot,
+    step // page_size], offset = step % page_size. Invalid slots are
+    redirected to the out-of-range page index and dropped — the page
+    analogue of the dense op's where-select write."""
+    lp = step // page_size                                 # [C] logical
+    phys = jnp.take_along_axis(pt_hist, lp[:, None], axis=1)[:, 0]
+    phys = jnp.where(valid, phys, n_pages)                 # drop
+    off = step - lp * page_size
+    return pool.at[phys, off].set(rows.astype(pool.dtype), mode='drop')
+
+
+@register('attention_lstm_beam_paged_step')
+def _attention_lstm_beam_paged_step(ins, attrs, ctx):
+    """The paged-memory form of `attention_lstm_beam_decode_step`: the
+    per-slot dense history/encoder buffers are replaced by fixed-size
+    PAGES drawn from pool inputs, indexed through per-slot int32 page
+    tables (serving/pages.py has the allocator; docs/serving.md the
+    diagram). Shapes stay static: encoder rows are assembled by one
+    in-graph gather per dispatch, history tokens scatter to
+    (page_table[slot, step//page_size], step%page_size) with inactive
+    rows dropped. The beam math, masking, bundling and Done/Bad
+    semantics are the dense op's, shared code — the paged engine is
+    bit-exact against the dense engine by construction
+    (tests/test_decode.py's paged family drills it).
+
+    State inputs (written -> donated): H, C, PrevIds, Acc, Fin, Step,
+    Active as the dense op; HistIds/HistPar [pages, page_size, beam]
+    are the token/parent history POOLS.
+    Read-only: PtHist [slots, ceil(T/page_size)], PtEnc [slots,
+    ceil(src_cap/page_size)] page tables (written at join time by the
+    engine's scatter, constant during decode), EncPages [enc_pages,
+    page_size, D], MaskPages [enc_pages, page_size], Limit.
+    Attrs: beam_size, end_id, bundle, page_size, src_cap.
+    """
+    h = data_of(ins['H'][0])
+    c = data_of(ins['C'][0])
+    prev_ids = data_of(ins['PrevIds'][0]).astype(jnp.int32)
+    acc = data_of(ins['Acc'][0]).astype(jnp.float32)
+    fin = data_of(ins['Fin'][0]).astype(bool)
+    step = data_of(ins['Step'][0]).astype(jnp.int32)
+    limit = data_of(ins['Limit'][0]).astype(jnp.int32)
+    active_in = data_of(ins['Active'][0]).astype(bool)
+    pt_hist = data_of(ins['PtHist'][0]).astype(jnp.int32)
+    hist_ids = data_of(ins['HistIds'][0])
+    hist_par = data_of(ins['HistPar'][0])
+    params = _decode_weight_params(ins)
+
+    slots, beam = prev_ids.shape
+    n_pages, page_size = hist_ids.shape[0], int(attrs['page_size'])
+    end_id = int(attrs['end_id'])
+    bundle = int(attrs.get('bundle', 1))
+    src_cap = int(attrs['src_cap'])
+
+    enc, mask = _gather_paged_enc(ins, src_cap)
+    enc_t = jnp.repeat(enc, beam, axis=0)            # [slots*beam, S, D]
+    mask_t = jnp.repeat(mask, beam, axis=0)
+    flat = lambda a: a.reshape((slots * beam,) + a.shape[2:])
+    unflat = lambda a: a.reshape((slots, beam) + a.shape[1:])
+
+    def one_step(carry, _):
+        h, c, prev, acc, fin, ids_pool, par_pool, step, active, bad_acc \
+            = carry
+        (h2, c2, ids2, acc2, fin2), (sel_ids, parent) = \
+            _masked_beam_advance(params, enc_t, mask_t,
+                                 (h, c, prev, acc, fin), active, beam,
+                                 end_id)
+        ids_pool2 = _paged_hist_write(ids_pool, pt_hist, step, page_size,
+                                      active, sel_ids, n_pages)
+        par_pool2 = _paged_hist_write(par_pool, pt_hist, step, page_size,
+                                      active, parent, n_pages)
+        step2 = step + active.astype(jnp.int32)
+        acc_s = unflat(acc2)
+        fin_s = unflat(fin2)
+        bad_t = active & jnp.isnan(acc_s).any(axis=1)
+        done_t = active & (fin_s.all(axis=1) | (step2 >= limit) | bad_t)
+        return (h2, c2, ids2, acc2, fin2, ids_pool2, par_pool2, step2,
+                active & ~done_t, bad_acc | bad_t), None
+
+    carry0 = (flat(h), flat(c), flat(prev_ids), flat(acc), flat(fin),
+              hist_ids, hist_par, step, active_in,
+              jnp.zeros((slots,), bool))
+    if bundle == 1:
+        carry, _ = one_step(carry0, None)
+    else:
+        carry, _ = lax.scan(one_step, carry0, None, length=bundle)
+    (h2, c2, ids2, acc2, fin2, hist_ids2, hist_par2, step2, active2,
+     bad) = carry
+
+    return {'HOut': unflat(h2), 'COut': unflat(c2),
+            'PrevIdsOut': unflat(ids2), 'AccOut': unflat(acc2),
+            'FinOut': unflat(fin2), 'HistIdsOut': hist_ids2,
+            'HistParOut': hist_par2, 'StepOut': step2,
+            'ActiveOut': active2, 'Done': active_in & ~active2,
+            'Bad': bad}
+
+
+@register('attention_lstm_spec_decode_step')
+def _attention_lstm_spec_decode_step(ins, attrs, ctx):
+    """Speculative GREEDY decoding over the paged slot pool: a small
+    DRAFT proposes spec_k tokens, the TARGET verifies them all in ONE
+    dispatched module, accept/rollback entirely in-graph.
+
+    Why it wins even for a recurrent target: the draft's proposals make
+    every verify-step's INPUT token known up front, so the expensive
+    position-independent work batches across all spec_k+1 positions —
+    the embedding gather, the input half of the decoder matmul
+    (x @ w_dec[:E]), and above all the [H, V] output projection +
+    log-softmax/argmax run as ONE stacked matmul instead of one per
+    step. Only the slim recurrence (attention query + ctx @ w_dec[E:] +
+    h @ u_dec + cell) stays sequential. docs/serving.md carries the
+    acceptance-rate math; the engine reports accept-rate from the
+    Accepted output.
+
+    Emission contract (token-exact vs greedy target-only decode, which
+    is beam_size=1 through the paged step op): the emitted token at
+    every position is the TARGET's own greedy argmax g_t; the draft
+    only decides how many positions are valid. Position t is emitted
+    iff every earlier proposal matched (d_s == g_s for s < t) and the
+    slot is still within its limit and un-finished — so a slot emits
+    between 1 and spec_k+1 tokens per dispatch (the +1 is the classic
+    bonus token: verifying spec_k proposals yields spec_k+1 target
+    distributions). Target, draft hidden state, and the next input
+    token all roll back to the last VALID position in-graph
+    (where-select gathers over the stacked per-position states).
+
+    Draft forms (attr `draft`): 'weights' — a small attention-LSTM with
+    its own Draft* weight inputs (same vocab + enc_dim as the target,
+    any hidden/embedding size), state carried per slot in DraftH/DraftC;
+    'table' — a [V] int32 next-token table input (DraftTable), the
+    n-gram/prompt-lookup speculator: zero proposal cost, no state.
+
+    State inputs as the paged beam op (beam dim fixed at 1) plus
+    DraftH/DraftC [slots, draft_hidden] (weights draft only).
+    Attrs: end_id, spec_k, page_size, src_cap, draft.
+    Outputs additionally: Accepted [slots] int32 — draft proposals
+    accepted this dispatch (emitted tokens minus the always-target
+    correction/bonus token).
+    """
+    from .lod_beam import greedy_attend_cell
+
+    h = data_of(ins['H'][0])[:, 0]                   # [C, Ht]
+    c = data_of(ins['C'][0])[:, 0]
+    prev = data_of(ins['PrevIds'][0]).astype(jnp.int32)[:, 0]
+    acc = data_of(ins['Acc'][0]).astype(jnp.float32)[:, 0]
+    fin = data_of(ins['Fin'][0]).astype(bool)[:, 0]
+    step = data_of(ins['Step'][0]).astype(jnp.int32)
+    limit = data_of(ins['Limit'][0]).astype(jnp.int32)
+    active = data_of(ins['Active'][0]).astype(bool)
+    pt_hist = data_of(ins['PtHist'][0]).astype(jnp.int32)
+    hist_ids = data_of(ins['HistIds'][0])
+    hist_par = data_of(ins['HistPar'][0])
+    w_dec, u_dec, b_dec, w_q, w_emb, w_out, b_out = \
+        _decode_weight_params(ins)
+
+    C = prev.shape[0]
+    n_pages, page_size = hist_ids.shape[0], int(attrs['page_size'])
+    end_id = int(attrs['end_id'])
+    spec_k = int(attrs['spec_k'])
+    src_cap = int(attrs['src_cap'])
+    R = spec_k + 1                       # verify steps = proposals + 1
+    E = w_emb.shape[1]
+    neg = jnp.finfo(jnp.float32).min
+
+    enc, mask = _gather_paged_enc(ins, src_cap)      # [C, S, D]
+
+    # -- draft phase: propose spec_k tokens (and advance one past them,
+    # so the draft state can roll back to any accepted position) -------
+    if attrs.get('draft', 'weights') == 'table':
+        table = data_of(ins['DraftTable'][0]).astype(jnp.int32)
+        d_list, tok = [], prev
+        for _ in range(R):
+            tok = jnp.take(table, tok)
+            d_list.append(tok)
+        d_seq = jnp.stack(d_list)                    # [R, C]
+        hd_seq = cd_seq = None
+    else:
+        dparams = _decode_weight_params(ins, prefix='Draft')
+        h_d = data_of(ins['DraftH'][0])
+        c_d = data_of(ins['DraftC'][0])
+
+        def dstep(carry, _):
+            hd, cd, tok = carry
+            hd2, cd2, logits = greedy_attend_cell(dparams, enc, mask,
+                                                  hd, cd, tok)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (hd2, cd2, nxt), (nxt, hd2, cd2)
+
+        _, (d_seq, hd_seq, cd_seq) = lax.scan(
+            dstep, (h_d, c_d, prev), None, length=R)
+
+    # -- verify phase: ONE bundled target pass over all R positions ----
+    # batched (position-independent): embedding + input projection
+    tok_in = jnp.concatenate([prev[None], d_seq[:R - 1]])    # [R, C]
+    xw = jnp.take(w_emb, tok_in, axis=0) @ w_dec[:E] # [R, C, 4Ht]
+
+    def vstep(carry, xw_t):
+        h, c = carry
+        q = h @ w_q
+        scores = jnp.einsum('bd,bsd->bs', q, enc)
+        scores = jnp.where(mask > 0, scores, neg)
+        alpha = jax.nn.softmax(scores, axis=-1)
+        ctx_v = jnp.einsum('bs,bsd->bd', alpha, enc)
+        g = xw_t + ctx_v @ w_dec[E:] + h @ u_dec + b_dec
+        gi, gf, gc, go = jnp.split(g, 4, axis=-1)
+        c2 = jax.nn.sigmoid(gf) * c + jax.nn.sigmoid(gi) * jnp.tanh(gc)
+        h2 = jax.nn.sigmoid(go) * jnp.tanh(c2)
+        return (h2, c2), (h2, c2)
+
+    _, (h_seq, c_seq) = lax.scan(vstep, (h, c), xw)  # [R, C, Ht]
+    # batched: output projection + greedy choice over every position
+    logp = jax.nn.log_softmax(
+        (h_seq @ w_out + b_out).astype(jnp.float32), axis=-1)
+    g_seq = jnp.argmax(logp, axis=-1).astype(jnp.int32)      # [R, C]
+    lp_seq = jnp.take_along_axis(logp, g_seq[..., None],
+                                 axis=-1)[..., 0]            # [R, C]
+
+    # -- accept/rollback masking (all in-graph) ------------------------
+    # position t (0-based) is emitted iff every earlier draft proposal
+    # matched the target's own choice AND the slot is still live there
+    match = g_seq[:R - 1] == d_seq[:R - 1]           # [R-1, C]
+    valid = []
+    v = active & ~fin & (step < limit)
+    for t in range(R):
+        if t > 0:
+            v = (v & match[t - 1] & (g_seq[t - 1] != end_id)
+                 & (step + t < limit))
+        valid.append(v)
+    valid = jnp.stack(valid)                         # [R, C] bool
+    n_emit = valid.astype(jnp.int32).sum(axis=0)     # [C]
+    accepted = (valid[:R - 1] & match).astype(jnp.int32).sum(axis=0)
+
+    # history writes: each emitted token at its own (page, offset)
+    ids_pool, par_pool = hist_ids, hist_par
+    zero_par = jnp.zeros((C, 1), jnp.int32)          # beam 1: parent 0
+    for t in range(R):
+        ids_pool = _paged_hist_write(ids_pool, pt_hist, step + t,
+                                     page_size, valid[t],
+                                     g_seq[t][:, None], n_pages)
+        par_pool = _paged_hist_write(par_pool, pt_hist, step + t,
+                                     page_size, valid[t], zero_par,
+                                     n_pages)
+
+    # score accumulation in strict emission order (the greedy target-
+    # only path's left fold)
+    acc2 = acc
+    for t in range(R):
+        acc2 = acc2 + jnp.where(valid[t], lp_seq[t], 0.0)
+
+    # roll back to the state after the LAST emitted token's input was
+    # consumed: S_{n_emit} = h_seq[n_emit - 1]
+    idx = jnp.maximum(n_emit - 1, 0)
+    rows = jnp.arange(C)
+    emitted_any = active & (n_emit > 0)
+    pick = lambda seq, old: jnp.where(
+        emitted_any.reshape((-1,) + (1,) * (old.ndim - 1)),
+        seq[idx, rows], old)
+    h2 = pick(h_seq, h)
+    c2 = pick(c_seq, c)
+    prev2 = jnp.where(emitted_any, g_seq[idx, rows], prev)
+    acc2 = jnp.where(emitted_any, acc2, acc)
+    out = {}
+    if hd_seq is not None:
+        out['DraftHOut'] = pick(hd_seq, data_of(ins['DraftH'][0]))
+        out['DraftCOut'] = pick(cd_seq, data_of(ins['DraftC'][0]))
+
+    fin2 = fin | (valid & (g_seq == end_id)).any(axis=0)
+    step2 = step + n_emit
+    bad = active & jnp.isnan(acc2)
+    done = active & (fin2 | (step2 >= limit) | bad)
+    active2 = active & ~done
+
+    out.update({
+        'HOut': h2[:, None], 'COut': c2[:, None],
+        'PrevIdsOut': prev2[:, None], 'AccOut': acc2[:, None],
+        'FinOut': fin2[:, None], 'HistIdsOut': ids_pool,
+        'HistParOut': par_pool, 'StepOut': step2, 'ActiveOut': active2,
+        'Done': active & ~active2, 'Bad': bad,
+        'Accepted': jnp.where(active, accepted, 0)})
+    return out
 
 
 @register('beam_search_decode')

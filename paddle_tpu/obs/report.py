@@ -529,9 +529,46 @@ def summarize(events):
                          '(total %d)'
                          % (percentile_exact(toks, 50), max(toks),
                             sum(toks)))
+        # paged state memory: page-pool occupancy from the per-join
+        # pages_free samples, prefix-cache counters + speculative
+        # accept rate from the shutdown summary (docs/serving.md)
+        pg_free = [e['fields']['pages_free'] for e in dc_joins
+                   if 'pages_free' in e.get('fields', {})]
+        pg_total = next((e['fields']['pages_total'] for e in dc_down
+                         if 'pages_total' in e.get('fields', {})), None)
+        if pg_free:
+            line = ('page pool: min free %d (peak occupancy)'
+                    % min(pg_free))
+            if pg_total is not None:
+                line += ' of %d total' % pg_total
+            lines.append(line)
+        dc_evict = _events(events, 'decode.prefix.evict')
+        pf_hits = sum(1 for e in dc_joins
+                      if e.get('fields', {}).get('prefix_hit') is True)
+        pf_miss = sum(1 for e in dc_joins
+                      if e.get('fields', {}).get('prefix_hit') is False)
+        if pf_hits or pf_miss or dc_evict:
+            lines.append('prefix cache: %d hit(s), %d miss(es), %d '
+                         'evicted (hit rate %s)'
+                         % (pf_hits, pf_miss, len(dc_evict),
+                            '%.2f' % (pf_hits / (pf_hits + pf_miss))
+                            if pf_hits + pf_miss else 'n/a'))
+        for e in dc_down:
+            rate = e.get('fields', {}).get('spec_accept_rate')
+            if rate is not None:
+                lines.append('speculative decode: accept rate %.2f'
+                             % rate)
         if dc_shed or dc_rej:
-            lines.append('overload: %d rejected, %d shed past deadline'
-                         % (len(dc_rej), len(dc_shed)))
+            by_reason = {}
+            for e in dc_rej:
+                r = e.get('fields', {}).get('reason', 'queue')
+                by_reason[r] = by_reason.get(r, 0) + 1
+            detail = ''
+            if by_reason.get('pages'):
+                detail = ' (%d blocked on the page pool)' \
+                    % by_reason['pages']
+            lines.append('overload: %d rejected%s, %d shed past deadline'
+                         % (len(dc_rej), detail, len(dc_shed)))
         for e in dc_pferr:
             f = e.get('fields', {})
             lines.append('  prefill ERROR (%s request(s)): %s'

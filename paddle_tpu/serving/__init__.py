@@ -19,7 +19,9 @@ See docs/serving.md; run the serving test tier with `pytest -m serving`.
     eng.shutdown()                     # drains in-flight requests
 """
 from . import buckets  # noqa: F401
+from . import pages  # noqa: F401
 from .buckets import default_buckets, pad_rows, pick_bucket  # noqa: F401
+from .pages import PagePool, PrefixCache  # noqa: F401
 from .decode import (DecodeConfig, DecodeEngine,  # noqa: F401
                      DecodeSlotPoisoned, LockstepDecoder, mt_weights,
                      program_prefill)
@@ -33,4 +35,5 @@ __all__ = ['ServingEngine', 'ServingConfig', 'ServerOverloaded',
            'default_buckets', 'pick_bucket', 'pad_rows',
            'DecodeConfig', 'DecodeEngine', 'DecodeSlotPoisoned',
            'LockstepDecoder', 'mt_weights', 'program_prefill',
-           'Router', 'ModelOverloaded', 'UnknownModel']
+           'Router', 'ModelOverloaded', 'UnknownModel',
+           'pages', 'PagePool', 'PrefixCache']

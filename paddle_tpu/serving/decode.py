@@ -30,11 +30,28 @@ iteration-level scheduling instead:
     HBM updates per step, driven through `Executor.acquire_step`'s
     pinned StepHandle (no per-step prepare pass).
 
-Observability: decode.slots.occupied / decode.queue.depth gauges,
-decode.step.seconds + decode.ttft.seconds histograms, join/release/
-poison events and token counters — `tools/obs_report.py` renders a
-decode section from them (docs/serving.md has the catalog and the slot
-lifecycle diagram).
+Beyond the slot pool, three LLM-serving moves live here (each drilled
+bit-exact/token-exact against the plain engine — docs/serving.md):
+
+  * PAGED state memory (`DecodeConfig(page_size=, pages=)`): token
+    history and encoder rows live in fixed-size pages claimed at
+    admission for each request's OWN limit/source length
+    (serving/pages.py allocator; int32 page tables, in-graph
+    gather/scatter) — several times the concurrent streams per state
+    byte; pool exhaustion blocks/rejects typed (`reason=pages`);
+  * PREFIX caching: released encoder pages stay resident keyed by
+    request content; a shared system-prompt/encoder prefix joins
+    WITHOUT re-prefilling (refcounts, LRU eviction through the pool);
+  * SPECULATIVE decoding (`spec_k=K` + `DecodeEngine(draft=...)`): a
+    small draft proposes K tokens, the target verifies all K in ONE
+    dispatched module with in-graph accept/rollback — the verify
+    batches the vocab-sized projections across positions.
+
+Observability: decode.slots.occupied / decode.queue.depth /
+decode.pages.free gauges, decode.step.seconds + decode.ttft.seconds
+histograms, join/release/poison/prefix/spec events and token counters
+— `tools/obs_report.py` renders a decode section from them
+(docs/serving.md has the catalog and the slot lifecycle diagram).
 """
 import collections
 import concurrent.futures
@@ -45,6 +62,7 @@ import numpy as np
 
 from .. import obs
 from . import buckets as _buckets
+from . import pages as _pages
 from .engine import (DeadlineExceeded, ServerClosed, ServerOverloaded,
                      _POLL_S)
 
@@ -58,6 +76,14 @@ WEIGHT_KEYS = ('w_dec', 'u_dec', 'b_dec', 'w_q', 'w_emb', 'w_out', 'b_out')
 _WRITTEN_STATE = ('h', 'c', 'prev_ids', 'acc', 'fin', 'ids_hist',
                   'par_hist', 'step', 'active')
 _READONLY_STATE = ('enc', 'mask', 'limit')
+# paged mode: history/encoder rows live in page POOLS; the per-slot
+# page tables and the encoder pools are written only at join time
+# (through the join scatter), so the step never copies them
+_WRITTEN_STATE_PAGED = ('h', 'c', 'prev_ids', 'acc', 'fin', 'hist_ids',
+                        'hist_par', 'step', 'active')
+_READONLY_STATE_PAGED = ('pt_hist', 'pt_enc', 'enc_pages', 'mask_pages',
+                         'limit')
+_DRAFT_STATE = ('draft_h', 'draft_c')     # spec_k + weights draft only
 
 
 class DecodeSlotPoisoned(RuntimeError):
@@ -91,11 +117,35 @@ class DecodeConfig(object):
     queue_capacity / overflow / default_deadline_ms: admission control,
                   same semantics as ServingConfig (typed
                   ServerOverloaded / DeadlineExceeded).
+    page_size:    switches the engine to PAGED state memory
+                  (serving/pages.py, docs/serving.md "Paged decode
+                  memory"): token history and encoder rows live in
+                  fixed-size pages claimed at admission for the
+                  request's OWN limit/source length instead of dense
+                  worst-case per-slot buffers — the capacity knob that
+                  lets the same state bytes serve several times the
+                  concurrent streams. Bit-exact vs the dense engine.
+    pages:        history-pool size (required with page_size). A
+                  request claims ceil(limit/page_size) of them.
+    enc_pages:    encoder-pool size (default: one full src_cap window
+                  per slot + equal headroom for resident prefixes +
+                  the reserved zero page).
+    prefix_cache: keep released encoder pages RESIDENT keyed by request
+                  content (default True when paged): a request sharing
+                  a prefix joins WITHOUT re-prefilling; LRU-evicted
+                  under pool pressure.
+    spec_k:       speculative decoding (paged + beam_size=1 +
+                  bundle=1 only): a draft model proposes spec_k tokens
+                  per dispatch and the target verifies them in ONE
+                  bundled module (accept/rollback in-graph; the engine
+                  takes the draft via DecodeEngine(draft=...)).
     """
 
     def __init__(self, slots=8, beam_size=3, max_len=32, start_id=0,
                  end_id=1, src_cap=16, bundle=1, queue_capacity=256,
-                 overflow='block', default_deadline_ms=None):
+                 overflow='block', default_deadline_ms=None,
+                 page_size=None, pages=None, enc_pages=None,
+                 prefix_cache=None, spec_k=None):
         if overflow not in ('block', 'reject'):
             raise ValueError("overflow must be 'block' or 'reject', got %r"
                              % (overflow,))
@@ -117,6 +167,69 @@ class DecodeConfig(object):
         self.overflow = overflow
         self.default_deadline_ms = default_deadline_ms
         self.admit_buckets = _buckets.default_buckets(self.slots)
+        # -- paged state memory -------------------------------------------
+        self.paged = page_size is not None
+        self.page_size = int(page_size) if self.paged else 0
+        self.spec_k = int(spec_k) if spec_k is not None else 0
+        if not self.paged:
+            if pages is not None or enc_pages is not None:
+                raise ValueError('pages/enc_pages require page_size '
+                                 '(the paged engine)')
+            if prefix_cache:
+                raise ValueError('prefix_cache requires page_size (the '
+                                 'cache is resident PAGES)')
+            if self.spec_k:
+                raise ValueError('spec_k requires page_size (speculative '
+                                 'decoding runs on the paged engine)')
+            self.pages = self.enc_pages = 0
+            self.prefix_cache = False
+            self.hist_table_width = self.enc_table_width = 0
+            return
+        if self.page_size < 1:
+            raise ValueError('page_size must be >= 1')
+        # per-slot page-table widths (static shapes)
+        self.hist_table_width = _pages.pages_for(self.max_len,
+                                                 self.page_size)
+        self.enc_table_width = _pages.pages_for(self.src_cap,
+                                                self.page_size)
+        if pages is None:
+            raise ValueError('paged mode needs pages=N (the history '
+                             'pool size; a request claims '
+                             'ceil(limit/page_size) of them)')
+        self.pages = int(pages)
+        if self.pages < self.hist_table_width:
+            raise ValueError(
+                'pages=%d cannot back even one max_len=%d request '
+                '(needs %d pages of %d rows)'
+                % (self.pages, self.max_len, self.hist_table_width,
+                   self.page_size))
+        # +1: encoder page 0 is the reserved zero page masked-out rows
+        # read through. Default: one worst-case working set for the
+        # live slots PLUS equal headroom — without headroom a released
+        # prefix is evicted by the very next join and the cache only
+        # ever serves CONCURRENT sharers (found by the verify drive)
+        self.enc_pages = (1 + 2 * self.slots * self.enc_table_width
+                          if enc_pages is None else int(enc_pages))
+        if self.enc_pages < 1 + self.enc_table_width:
+            raise ValueError(
+                'enc_pages=%d cannot back one src_cap=%d request plus '
+                'the reserved zero page (needs %d)'
+                % (self.enc_pages, self.src_cap,
+                   1 + self.enc_table_width))
+        self.prefix_cache = True if prefix_cache is None \
+            else bool(prefix_cache)
+        if self.spec_k:
+            if self.spec_k < 1:
+                raise ValueError('spec_k must be >= 1')
+            if self.beam_size != 1:
+                raise ValueError(
+                    'speculative decoding is greedy: spec_k requires '
+                    'beam_size=1 (got %d)' % self.beam_size)
+            if self.bundle != 1:
+                raise ValueError(
+                    'spec_k and bundle>1 are mutually exclusive: the '
+                    'verify pass IS the bundled dispatch (spec_k '
+                    'tokens per module call)')
 
 
 def mt_weights(scope, name='mt'):
@@ -229,15 +342,21 @@ class LockstepDecoder(object):
 
 class _Request(object):
     __slots__ = ('feed', 'limit', 'future', 't_submit', 'deadline',
-                 't_join')
+                 't_join', 'pkey', 'hist_need', 'enc_need')
 
-    def __init__(self, feed, limit, future, t_submit, deadline):
+    def __init__(self, feed, limit, future, t_submit, deadline,
+                 pkey=None, hist_need=0, enc_need=0):
         self.feed = feed
         self.limit = limit
         self.future = future
         self.t_submit = t_submit
         self.deadline = deadline
         self.t_join = None
+        # paged admission: content key for the prefix cache + the
+        # worst-case page claim the admission gate budgets against
+        self.pkey = pkey
+        self.hist_need = hist_need
+        self.enc_need = enc_need
 
 
 # process-wide decode telemetry (docs/serving.md); per-engine views live
@@ -257,6 +376,13 @@ _C_POISONED = obs.counter('decode.poisoned')
 _C_SHED = obs.counter('decode.shed')
 _C_REJECTED = obs.counter('decode.rejected')
 _C_STEPS = obs.counter('decode.steps')
+# paged state memory + prefix cache + speculative decoding
+_G_PAGES_FREE = obs.gauge('decode.pages.free')
+_C_PREFIX_HITS = obs.counter('decode.prefix.hits')
+_C_PREFIX_MISSES = obs.counter('decode.prefix.misses')
+_C_PREFIX_EVICT = obs.counter('decode.prefix.evictions')
+_C_SPEC_PROPOSED = obs.counter('decode.spec.proposed')
+_C_SPEC_ACCEPTED = obs.counter('decode.spec.accepted')
 
 
 class DecodeEngine(object):
@@ -285,7 +411,8 @@ class DecodeEngine(object):
     randomized join/leave).
     """
 
-    def __init__(self, weights, config=None, place=None, prefill=None):
+    def __init__(self, weights, config=None, place=None, prefill=None,
+                 draft=None):
         from ..fluid import core
         from ..fluid.executor import Executor, Scope
 
@@ -299,6 +426,21 @@ class DecodeEngine(object):
         self._exe = Executor(place or core.CPUPlace())
         self._hidden = int(np.asarray(weights['u_dec']).shape[0])
         self._enc_dim = int(np.asarray(weights['w_q']).shape[1])
+        self._vocab = int(np.asarray(weights['w_out']).shape[1])
+        self._draft = self._check_draft(draft)
+        # host side of the paged state: the allocator + prefix cache
+        # (loop-thread owned; the integer counters are read lock-free by
+        # the stats surface) and per-slot page assignments
+        cfg = self.config
+        self._hist_pool = self._enc_pool = self._prefix = None
+        self._slot_pages = [None] * cfg.slots
+        self._pages_starved = False
+        if cfg.paged:
+            self._hist_pool = _pages.PagePool(cfg.pages)
+            self._enc_pool = _pages.PagePool(cfg.enc_pages, reserved=1)
+            if cfg.prefix_cache:
+                self._prefix = _pages.PrefixCache(
+                    self._enc_pool, on_evict=self._on_prefix_evict)
         self._build_step_program(weights)
         self._handle = None          # acquired lazily (first step/warmup)
         self._warm = False
@@ -325,6 +467,46 @@ class DecodeEngine(object):
 
     # -- program build -----------------------------------------------------
 
+    def _check_draft(self, draft):
+        """Validate the speculative draft: a small attention-LSTM
+        weights dict (same vocab + enc_dim as the target, any hidden /
+        embedding size) or a [vocab] int next-token TABLE (the n-gram /
+        prompt-lookup speculator). Returns ('weights', dict) /
+        ('table', np.int32 array) / None."""
+        cfg = self.config
+        if not cfg.spec_k:
+            if draft is not None:
+                raise ValueError('draft= needs DecodeConfig(spec_k=K)')
+            return None
+        if draft is None:
+            raise ValueError('DecodeConfig(spec_k=%d) needs a draft: '
+                             'DecodeEngine(draft=weights dict or [vocab]'
+                             ' next-token table)' % cfg.spec_k)
+        if isinstance(draft, dict):
+            missing = [k for k in WEIGHT_KEYS if k not in draft]
+            if missing:
+                raise ValueError('draft weights missing %r' % (missing,))
+            d_enc = int(np.asarray(draft['w_q']).shape[1])
+            d_vocab = int(np.asarray(draft['w_out']).shape[1])
+            if d_enc != self._enc_dim or d_vocab != self._vocab:
+                raise ValueError(
+                    'draft must share the target vocab (%d) and enc_dim '
+                    '(%d); got vocab=%d enc_dim=%d'
+                    % (self._vocab, self._enc_dim, d_vocab, d_enc))
+            return ('weights', draft)
+        table = np.asarray(draft)
+        if table.ndim != 1 or table.shape[0] != self._vocab \
+                or not np.issubdtype(table.dtype, np.integer):
+            raise ValueError(
+                'a table draft must be a [vocab=%d] int next-token '
+                'array, got %r %r' % (self._vocab, table.dtype,
+                                      table.shape))
+        return ('table', table.astype(np.int32))
+
+    def _on_prefix_evict(self, key, pages):
+        _C_PREFIX_EVICT.inc()
+        obs.event('decode.prefix.evict', key=key[:12], pages=len(pages))
+
     def _build_step_program(self, weights):
         """The step-form decode Program: one
         `attention_lstm_beam_decode_step` op over persistable slot state
@@ -338,57 +520,133 @@ class DecodeEngine(object):
         blk = prog.global_block()
         C, K, T, S = cfg.slots, cfg.beam_size, cfg.max_len, cfg.src_cap
         H, D = self._hidden, self._enc_dim
+        ps, NPH, NPE = (cfg.page_size, cfg.hist_table_width,
+                        cfg.enc_table_width)
 
-        def pvar(name, shape, dtype):
-            return blk.create_var(name='cbd_' + name, shape=shape,
-                                  dtype=dtype, persistable=True)
+        def pvar(name, shape, dtype, init=None):
+            v = blk.create_var(name='cbd_' + name, shape=shape,
+                               dtype=dtype, persistable=True)
+            if init is not None:
+                self._scope.vars['cbd_' + name] = jnp.asarray(init)
+            return v
 
         wvars = {}
         for k in WEIGHT_KEYS:
             a = np.asarray(weights[k], np.float32)
-            wvars[k] = pvar(k, list(a.shape), 'float32')
-            self._scope.vars['cbd_' + k] = jnp.asarray(a)
+            wvars[k] = pvar(k, list(a.shape), 'float32', a)
 
         spec = {'h': ([C, K, H], 'float32'), 'c': ([C, K, H], 'float32'),
                 'prev_ids': ([C, K], 'int32'), 'acc': ([C, K], 'float32'),
-                'fin': ([C, K], 'bool'), 'enc': ([C, S, D], 'float32'),
-                'mask': ([C, S], 'float32'),
-                'ids_hist': ([C, T, K], 'int32'),
-                'par_hist': ([C, T, K], 'int32'),
+                'fin': ([C, K], 'bool'),
                 'step': ([C], 'int32'), 'limit': ([C], 'int32'),
                 'active': ([C], 'bool')}
+        if cfg.paged:
+            # the dense [C, T, K] / [C, S, D] buffers become pools +
+            # per-slot page tables: a slot only claims the pages its OWN
+            # limit and source length need. pt_hist defaults to the
+            # out-of-range page (writes drop), pt_enc to the reserved
+            # zero page (reads stay finite under the mask).
+            spec.update({
+                'hist_ids': ([cfg.pages, ps, K], 'int32'),
+                'hist_par': ([cfg.pages, ps, K], 'int32'),
+                'enc_pages': ([cfg.enc_pages, ps, D], 'float32'),
+                'mask_pages': ([cfg.enc_pages, ps], 'float32'),
+                'pt_hist': ([C, NPH], 'int32'),
+                'pt_enc': ([C, NPE], 'int32')})
+        else:
+            spec.update({
+                'enc': ([C, S, D], 'float32'), 'mask': ([C, S], 'float32'),
+                'ids_hist': ([C, T, K], 'int32'),
+                'par_hist': ([C, T, K], 'int32')})
+        if self._draft and self._draft[0] == 'weights':
+            Hd = int(np.asarray(self._draft[1]['u_dec']).shape[0])
+            spec.update({'draft_h': ([C, Hd], 'float32'),
+                         'draft_c': ([C, Hd], 'float32')})
         svars = {}
         for name, (shape, dtype) in spec.items():
-            svars[name] = pvar(name, shape, dtype)
-            self._scope.vars['cbd_' + name] = jnp.zeros(
-                shape, np.dtype(dtype))
+            fill = cfg.pages if name == 'pt_hist' else 0
+            svars[name] = pvar(name, shape, dtype,
+                               jnp.full(shape, fill, np.dtype(dtype)))
+        dvars = {}
+        if self._draft and self._draft[0] == 'weights':
+            for k in WEIGHT_KEYS:
+                a = np.asarray(self._draft[1][k], np.float32)
+                dvars[k] = pvar('d_' + k, list(a.shape), 'float32', a)
+        elif self._draft:
+            dvars['table'] = pvar('d_table',
+                                  [self._vocab], 'int32',
+                                  self._draft[1])
         done = blk.create_var(name='cbd_done', shape=[C], dtype='bool')
         bad = blk.create_var(name='cbd_bad', shape=[C], dtype='bool')
 
-        blk.append_op(
-            type='attention_lstm_beam_decode_step',
-            inputs={'H': [svars['h']], 'C': [svars['c']],
-                    'PrevIds': [svars['prev_ids']], 'Acc': [svars['acc']],
-                    'Fin': [svars['fin']], 'Enc': [svars['enc']],
-                    'Mask': [svars['mask']],
-                    'IdsHist': [svars['ids_hist']],
-                    'ParHist': [svars['par_hist']],
-                    'Step': [svars['step']], 'Limit': [svars['limit']],
-                    'Active': [svars['active']],
-                    'WDec': [wvars['w_dec']], 'UDec': [wvars['u_dec']],
-                    'BDec': [wvars['b_dec']], 'WAttnQ': [wvars['w_q']],
-                    'WEmb': [wvars['w_emb']], 'WOut': [wvars['w_out']],
-                    'BOut': [wvars['b_out']]},
-            outputs={'HOut': [svars['h']], 'COut': [svars['c']],
-                     'PrevIdsOut': [svars['prev_ids']],
-                     'AccOut': [svars['acc']], 'FinOut': [svars['fin']],
-                     'IdsHistOut': [svars['ids_hist']],
-                     'ParHistOut': [svars['par_hist']],
-                     'StepOut': [svars['step']],
-                     'ActiveOut': [svars['active']],
-                     'Done': [done], 'Bad': [bad]},
-            attrs={'beam_size': cfg.beam_size, 'end_id': cfg.end_id,
-                   'bundle': cfg.bundle})
+        weight_ins = {
+            'WDec': [wvars['w_dec']], 'UDec': [wvars['u_dec']],
+            'BDec': [wvars['b_dec']], 'WAttnQ': [wvars['w_q']],
+            'WEmb': [wvars['w_emb']], 'WOut': [wvars['w_out']],
+            'BOut': [wvars['b_out']]}
+        state_ins = {
+            'H': [svars['h']], 'C': [svars['c']],
+            'PrevIds': [svars['prev_ids']], 'Acc': [svars['acc']],
+            'Fin': [svars['fin']], 'Step': [svars['step']],
+            'Limit': [svars['limit']], 'Active': [svars['active']]}
+        state_outs = {
+            'HOut': [svars['h']], 'COut': [svars['c']],
+            'PrevIdsOut': [svars['prev_ids']], 'AccOut': [svars['acc']],
+            'FinOut': [svars['fin']], 'StepOut': [svars['step']],
+            'ActiveOut': [svars['active']],
+            'Done': [done], 'Bad': [bad]}
+        if cfg.paged:
+            state_ins.update({
+                'PtHist': [svars['pt_hist']], 'PtEnc': [svars['pt_enc']],
+                'HistIds': [svars['hist_ids']],
+                'HistPar': [svars['hist_par']],
+                'EncPages': [svars['enc_pages']],
+                'MaskPages': [svars['mask_pages']]})
+            state_outs.update({'HistIdsOut': [svars['hist_ids']],
+                               'HistParOut': [svars['hist_par']]})
+        else:
+            state_ins.update({
+                'Enc': [svars['enc']], 'Mask': [svars['mask']],
+                'IdsHist': [svars['ids_hist']],
+                'ParHist': [svars['par_hist']]})
+            state_outs.update({'IdsHistOut': [svars['ids_hist']],
+                               'ParHistOut': [svars['par_hist']]})
+        if cfg.spec_k:
+            accepted = blk.create_var(name='cbd_accepted', shape=[C],
+                                      dtype='int32')
+            ins = dict(state_ins)
+            ins.update(weight_ins)
+            if self._draft[0] == 'weights':
+                ins.update({'Draft' + k: [v] for k, v in {
+                    'WDec': dvars['w_dec'], 'UDec': dvars['u_dec'],
+                    'BDec': dvars['b_dec'], 'WAttnQ': dvars['w_q'],
+                    'WEmb': dvars['w_emb'], 'WOut': dvars['w_out'],
+                    'BOut': dvars['b_out']}.items()})
+                ins.update({'DraftH': [svars['draft_h']],
+                            'DraftC': [svars['draft_c']]})
+                state_outs.update({'DraftHOut': [svars['draft_h']],
+                                   'DraftCOut': [svars['draft_c']]})
+            else:
+                ins['DraftTable'] = [dvars['table']]
+            outs = dict(state_outs)
+            outs['Accepted'] = [accepted]
+            blk.append_op(
+                type='attention_lstm_spec_decode_step', inputs=ins,
+                outputs=outs,
+                attrs={'end_id': cfg.end_id, 'spec_k': cfg.spec_k,
+                       'page_size': ps, 'src_cap': S,
+                       'draft': self._draft[0]})
+        else:
+            ins = dict(state_ins)
+            ins.update(weight_ins)
+            blk.append_op(
+                type='attention_lstm_beam_paged_step' if cfg.paged
+                else 'attention_lstm_beam_decode_step',
+                inputs=ins, outputs=dict(state_outs),
+                attrs=dict({'beam_size': cfg.beam_size,
+                            'end_id': cfg.end_id, 'bundle': cfg.bundle},
+                           **({'page_size': ps, 'src_cap': S}
+                              if cfg.paged else {})))
         self._step_program = prog
         # fetching the state with every step makes a slot release a pure
         # numpy slice (one host sync per dispatch that released
@@ -399,11 +657,18 @@ class DecodeEngine(object):
         # the per-dispatch Done edge: an extra dispatch (e.g. warmup's
         # no-op step racing live traffic) can swallow an edge, but a
         # level can't be lost.
-        self._fetch_vars = [svars['active'], svars['ids_hist'],
-                            svars['par_hist'], svars['acc'],
-                            svars['step']]
-        self._state_names = ['cbd_' + n
-                            for n in _WRITTEN_STATE + _READONLY_STATE]
+        ids_n, par_n = ('hist_ids', 'hist_par') if cfg.paged \
+            else ('ids_hist', 'par_hist')
+        self._fetch_vars = [svars['active'], svars[ids_n], svars[par_n],
+                            svars['acc'], svars['step']]
+        if cfg.spec_k:
+            self._fetch_vars.append(accepted)
+        names = _WRITTEN_STATE_PAGED + _READONLY_STATE_PAGED \
+            if cfg.paged else _WRITTEN_STATE + _READONLY_STATE
+        if 'draft_h' in spec:
+            names = names + _DRAFT_STATE
+        self._state_names = ['cbd_' + n for n in names]
+        self._state_spec = spec
         self._join_fn = self._build_join_fn()
 
     def _build_join_fn(self):
@@ -412,7 +677,13 @@ class DecodeEngine(object):
         update is in place. Rows padded past the real join count carry
         valid=False and scatter to index `slots`, which mode='drop'
         discards — so the signature set is exactly cfg.admit_buckets
-        (pre-compiled by warmup, like the prefill buckets)."""
+        (pre-compiled by warmup, like the prefill buckets).
+
+        Paged form: instead of dense enc/mask rows the join writes the
+        slot's PAGE-TABLE rows and scatters the encoder content into
+        its freshly-allocated pages. Prefix-cache hits pass the
+        out-of-range write page, so resident pages are never rewritten
+        (their content is the hit)."""
         import jax
         import jax.numpy as jnp
         cfg = self.config
@@ -420,11 +691,11 @@ class DecodeEngine(object):
         neg = float(np.finfo(np.float32).min)
         acc0 = np.full((K,), neg, np.float32)
         acc0[0] = 0.0
+        draft_hd = None
+        if self._draft and self._draft[0] == 'weights':
+            draft_hd = int(np.asarray(self._draft[1]['u_dec']).shape[0])
 
-        def join(st, slot_idx, valid, enc, mask, limit):
-            idx = jnp.where(valid, slot_idx, cfg.slots)   # drop padding
-            m = slot_idx.shape[0]
-
+        def base_puts(st, idx, m, valid, limit):
             def put(name, rows):
                 full = 'cbd_' + name
                 st[full] = st[full].at[idx].set(
@@ -435,16 +706,48 @@ class DecodeEngine(object):
             put('prev_ids', jnp.full((m, K), cfg.start_id, jnp.int32))
             put('acc', jnp.broadcast_to(jnp.asarray(acc0), (m, K)))
             put('fin', jnp.zeros((m, K), bool))
-            put('enc', enc)
-            put('mask', mask)
             put('step', jnp.zeros((m,), jnp.int32))
             put('limit', limit)
             put('active', valid)
+            if draft_hd is not None:
+                put('draft_h', jnp.zeros((m, draft_hd), jnp.float32))
+                put('draft_c', jnp.zeros((m, draft_hd), jnp.float32))
+            return put
+
+        if not cfg.paged:
+            def join(st, slot_idx, valid, enc, mask, limit):
+                idx = jnp.where(valid, slot_idx, cfg.slots)
+                m = slot_idx.shape[0]
+                put = base_puts(st, idx, m, valid, limit)
+                put('enc', enc)
+                put('mask', mask)
+                return st
+
+            return jax.jit(join, donate_argnums=(0,))
+
+        ps, NPE = cfg.page_size, cfg.enc_table_width
+
+        def join_paged(st, slot_idx, valid, enc, mask, limit,
+                       pt_hist_rows, pt_enc_rows, enc_write_pages):
+            idx = jnp.where(valid, slot_idx, cfg.slots)
+            m = slot_idx.shape[0]
+            put = base_puts(st, idx, m, valid, limit)
+            put('pt_hist', pt_hist_rows)
+            put('pt_enc', pt_enc_rows)
+            # page-content scatter: [m, NPE] write pages (out-of-range
+            # = drop: bucket padding, prefix hits, zero-page tails)
+            pages_flat = enc_write_pages.reshape(-1)
+            st['cbd_enc_pages'] = st['cbd_enc_pages'].at[pages_flat].set(
+                enc.reshape(m * NPE, ps, enc.shape[-1]), mode='drop')
+            st['cbd_mask_pages'] = st['cbd_mask_pages'].at[
+                pages_flat].set(mask.reshape(m * NPE, ps), mode='drop')
             return st
 
-        return jax.jit(join, donate_argnums=(0,))
+        return jax.jit(join_paged, donate_argnums=(0,))
 
-    def _scatter_join(self, slot_idx, valid, enc, mask, limit):
+    def _scatter_join(self, slot_idx, valid, enc, mask, limit,
+                      pt_hist_rows=None, pt_enc_rows=None,
+                      enc_write_pages=None):
         """Run the jitted join over the handle's live state; inputs are
         bucket-padded host arrays. Serialized with handle creation and
         the step dispatch via _handle_lock (warmup's bucket probes run
@@ -453,11 +756,16 @@ class DecodeEngine(object):
         with self._handle_lock:
             st_all = handle.state
             st = {n: st_all[n] for n in self._state_names}
-            new = self._join_fn(st, np.asarray(slot_idx, np.int32),
-                                np.asarray(valid, bool),
-                                np.asarray(enc, np.float32),
-                                np.asarray(mask, np.float32),
-                                np.asarray(limit, np.int32))
+            args = [st, np.asarray(slot_idx, np.int32),
+                    np.asarray(valid, bool),
+                    np.asarray(enc, np.float32),
+                    np.asarray(mask, np.float32),
+                    np.asarray(limit, np.int32)]
+            if self.config.paged:
+                args += [np.asarray(pt_hist_rows, np.int32),
+                         np.asarray(pt_enc_rows, np.int32),
+                         np.asarray(enc_write_pages, np.int32)]
+            new = self._join_fn(*args)
             for name, val in new.items():
                 handle.set_state(name, val)
 
@@ -527,8 +835,22 @@ class DecodeEngine(object):
         now = time.monotonic()
         deadline = now + deadline_ms / 1000.0 if deadline_ms is not None \
             else None
+        pkey, hist_need, enc_need = None, 0, 0
+        if cfg.paged:
+            hist_need = _pages.pages_for(limit, cfg.page_size)
+            if self._prefill is None:
+                enc_need = _pages.pages_for(feed['enc'].shape[0],
+                                            cfg.page_size)
+            else:
+                # actual source length is only known after prefill; the
+                # admission gate budgets the worst case and the surplus
+                # is released right after prefill returns
+                enc_need = cfg.enc_table_width
+            if cfg.prefix_cache:
+                pkey = _pages.content_key(feed)
         fut = concurrent.futures.Future()
-        req = _Request(feed, limit, fut, now, deadline)
+        req = _Request(feed, limit, fut, now, deadline, pkey=pkey,
+                       hist_need=hist_need, enc_need=enc_need)
         t_give_up = now + timeout if timeout is not None else None
         with self._lock:
             while True:
@@ -536,17 +858,24 @@ class DecodeEngine(object):
                     raise ServerClosed('decode engine is shut down')
                 if len(self._queue) < cfg.queue_capacity:
                     break
+                # the queue can be full because joins are blocked on an
+                # exhausted page pool — a typed admission signal, not a
+                # crash; the reject event says which wall was hit
+                reason = 'pages' if self._pages_starved else 'queue'
                 if cfg.overflow == 'reject':
                     self._n['rejected'] += 1
                     self._win['rejected'] += 1
                     _C_REJECTED.inc()
                     obs.event('decode.reject',
                               queue_depth=len(self._queue),
-                              capacity=cfg.queue_capacity)
+                              capacity=cfg.queue_capacity,
+                              reason=reason)
                     raise ServerOverloaded(
                         'decode queue is full (%d request(s), capacity '
-                        '%d) and the overflow policy is reject'
-                        % (len(self._queue), cfg.queue_capacity))
+                        '%d; blocked on %s) and the overflow policy is '
+                        'reject' % (len(self._queue), cfg.queue_capacity,
+                                    'free pages' if reason == 'pages'
+                                    else 'free slots'))
                 remaining = _POLL_S if t_give_up is None else \
                     min(_POLL_S, t_give_up - time.monotonic())
                 if t_give_up is not None and remaining <= 0:
@@ -556,7 +885,7 @@ class DecodeEngine(object):
                     obs.event('decode.reject',
                               queue_depth=len(self._queue),
                               capacity=cfg.queue_capacity,
-                              waited_s=timeout)
+                              waited_s=timeout, reason=reason)
                     raise ServerOverloaded(
                         'decode queue stayed full for %.3fs (capacity %d)'
                         % (timeout, cfg.queue_capacity))
@@ -611,11 +940,27 @@ class DecodeEngine(object):
             handle.step()             # all slots inactive: a no-op step
         for b in cfg.admit_buckets:   # join-scatter kernel per bucket
             with obs.span('decode.warmup', bucket=b, kind='join'):
-                self._scatter_join(
-                    np.zeros(b, np.int32), np.zeros(b, bool),
-                    np.zeros((b, cfg.src_cap, self._enc_dim), np.float32),
-                    np.zeros((b, cfg.src_cap), np.float32),
-                    np.zeros(b, np.int32))
+                if cfg.paged:
+                    # all-invalid probe: page writes drop, the allocator
+                    # is never touched
+                    S_pad = cfg.enc_table_width * cfg.page_size
+                    self._scatter_join(
+                        np.zeros(b, np.int32), np.zeros(b, bool),
+                        np.zeros((b, S_pad, self._enc_dim), np.float32),
+                        np.zeros((b, S_pad), np.float32),
+                        np.zeros(b, np.int32),
+                        np.full((b, cfg.hist_table_width), cfg.pages,
+                                np.int32),
+                        np.zeros((b, cfg.enc_table_width), np.int32),
+                        np.full((b, cfg.enc_table_width), cfg.enc_pages,
+                                np.int32))
+                else:
+                    self._scatter_join(
+                        np.zeros(b, np.int32), np.zeros(b, bool),
+                        np.zeros((b, cfg.src_cap, self._enc_dim),
+                                 np.float32),
+                        np.zeros((b, cfg.src_cap), np.float32),
+                        np.zeros(b, np.int32))
         if self._prefill is not None:
             if example_feed is None:
                 raise ValueError(
@@ -632,17 +977,49 @@ class DecodeEngine(object):
     def _pop_live_locked(self, now, shed, cap):
         """Pop up to `cap` still-wanted requests; expired ones collect
         into `shed` (failed by the caller OUTSIDE the lock, like the
-        serving engine's batcher)."""
+        serving engine's batcher). In paged mode a head whose page
+        claim cannot be covered RIGHT NOW (free + evictable) BLOCKS in
+        the queue — FIFO head-of-line, so admission order is preserved;
+        its deadline still sheds it, and the engine marks itself
+        page-starved for the reject events' reason field."""
         out = []
+        budget = None
+        pinned = set()
+        if self.config.paged:
+            budget = {'hist': self._hist_pool.available(),
+                      'enc': self._enc_pool.available(self._prefix)}
+        starved = False
         while self._queue and len(out) < cap:
-            req = self._queue.popleft()
-            self._not_full.notify()
+            req = self._queue[0]
             if req.deadline is not None and now > req.deadline:
+                self._queue.popleft()
+                self._not_full.notify()
                 shed.append(req)
                 continue
+            if budget is not None:
+                enc_need = req.enc_need
+                if req.pkey is not None and self._prefix.peek(req.pkey):
+                    # resident prefix: no NEW pages, but the hit PINS
+                    # the entry (refs>0), taking its pages out of the
+                    # evictable budget batch-mates were counting on —
+                    # charge that once per key or the admit-time alloc
+                    # comes up short and fails the whole batch
+                    enc_need = 0 if req.pkey in pinned \
+                        else self._prefix.pinnable_pages(req.pkey)
+                if req.hist_need > budget['hist'] \
+                        or enc_need > budget['enc']:
+                    starved = True        # head-of-line blocks on pages
+                    break
+                budget['hist'] -= req.hist_need
+                budget['enc'] -= enc_need
+                if req.pkey is not None:
+                    pinned.add(req.pkey)
+            self._queue.popleft()
+            self._not_full.notify()
             if not req.future.set_running_or_notify_cancel():
                 continue              # cancelled while queued
             out.append(req)
+        self._pages_starved = starved
         _G_QDEPTH.set(len(self._queue))
         return out
 
@@ -665,6 +1042,8 @@ class DecodeEngine(object):
         """Prefill + scatter the joining requests' slot state in ONE
         bucket-padded jitted join (loop thread only). A prefill/feed
         failure fails ONLY the joining futures."""
+        if self.config.paged:
+            return self._admit_paged(joins)
         cfg = self.config
         b = _buckets.pick_bucket(len(joins), cfg.admit_buckets)
         try:
@@ -734,16 +1113,220 @@ class DecodeEngine(object):
             _C_JOINS.inc()
             obs.event('decode.join', slot=slot, limit=req.limit,
                       src_len=int(src_len[i]))
-        _G_SLOTS.set(sum(o is not None for o in self._occupant))
+        occ_now = sum(o is not None for o in self._occupant)
+        _G_SLOTS.set(occ_now)
+        with self._lock:
+            self._n['slots_high_water'] = max(
+                self._n['slots_high_water'], occ_now)
+
+    def _admit_paged(self, joins):
+        """Paged admission (loop thread only): prefix-cache lookups
+        FIRST (so a resident entry a batch-mate relies on cannot be
+        evicted by this batch's own allocations), then prefill for the
+        MISSES only — a prefix hit joins WITHOUT re-prefilling — then
+        page claims, then one bucket-padded join scatter writing page
+        tables + fresh page content. The admission gate
+        (_pop_live_locked) already budgeted the worst case, so the
+        claims cannot fail; a prefill/feed failure rolls every claim
+        back and fails ONLY the joining futures."""
+        cfg = self.config
+        ps, NPE, NPH = (cfg.page_size, cfg.enc_table_width,
+                        cfg.hist_table_width)
+        S_pad = NPE * ps
+        n = len(joins)
+        # enc plan per join: ('hit', pages, src_len) | ('miss', j) with
+        # j its row in the prefill batch | ('dup', i_first)
+        plan = [None] * n
+        first_by_key = {}
+        miss_idx = []
+        for i, r in enumerate(joins):
+            if r.pkey is not None and self._prefix.peek(r.pkey):
+                got = self._prefix.lookup(r.pkey)
+                plan[i] = ('hit',) + tuple(got)
+                continue
+            if r.pkey is not None and r.pkey in first_by_key:
+                plan[i] = ('dup', first_by_key[r.pkey])
+                continue
+            if r.pkey is not None:
+                first_by_key[r.pkey] = i
+                self._prefix.misses += 1   # cache-level miss
+            plan[i] = ('miss', len(miss_idx))
+            miss_idx.append(i)
+        claimed_enc, claimed_hist = [], []    # rollback ledger
+        try:
+            # -- prefill / direct content for the misses only ----------
+            if miss_idx and self._prefill is not None:
+                b_pf = _buckets.pick_bucket(len(miss_idx),
+                                            cfg.admit_buckets)
+                feeds = [joins[i].feed for i in miss_idx]
+                feeds += [joins[miss_idx[-1]].feed] \
+                    * (b_pf - len(miss_idx))
+                enc_m, len_m = self._prefill(feeds)
+                enc_m = np.asarray(enc_m, np.float32)[:len(miss_idx)]
+                len_m = np.asarray(len_m, np.int32)[:len(miss_idx)]
+                if enc_m.ndim != 3 or enc_m.shape[0] != len(miss_idx):
+                    raise ValueError(
+                        'prefill returned enc of shape %r for %d '
+                        'request(s) (want [n, S, %d])'
+                        % (getattr(enc_m, 'shape', None), len(miss_idx),
+                           self._enc_dim))
+                if len_m.shape != (len(miss_idx),):
+                    raise ValueError(
+                        'prefill returned src_len of shape %r for %d '
+                        'request(s)' % (len_m.shape, len(miss_idx)))
+                if enc_m.shape[1] > cfg.src_cap:
+                    raise ValueError(
+                        'prefill returned %d encoder rows > src_cap=%d'
+                        % (enc_m.shape[1], cfg.src_cap))
+            elif miss_idx:
+                len_m = np.asarray([joins[i].feed['enc'].shape[0]
+                                    for i in miss_idx], np.int32)
+                enc_m = np.zeros((len(miss_idx), int(len_m.max()),
+                                  self._enc_dim), np.float32)
+                for j, i in enumerate(miss_idx):
+                    enc_m[j, :len_m[j]] = joins[i].feed['enc']
+            # -- page claims (the pop gate guaranteed coverage; cache
+            # insertion waits until the content is actually written) ---
+            miss_pages = []
+            for j, i in enumerate(miss_idx):
+                need = _pages.pages_for(int(len_m[j]), ps)
+                pages = self._enc_pool.alloc(need, self._prefix)
+                if pages is None:       # gate bug — fail loudly
+                    raise RuntimeError(
+                        'encoder page pool exhausted mid-admit (%d '
+                        'needed, %d free)' % (need,
+                                              self._enc_pool.free_count))
+                miss_pages.append(pages)
+                claimed_enc.append(pages)
+            hist_pages = []
+            for r in joins:
+                pages = self._hist_pool.alloc(r.hist_need)
+                if pages is None:
+                    raise RuntimeError(
+                        'history page pool exhausted mid-admit (%d '
+                        'needed, %d free)' % (r.hist_need,
+                                              self._hist_pool.free_count))
+                hist_pages.append(pages)
+                claimed_hist.append(pages)
+            # -- bucket-padded join arrays -----------------------------
+            b = _buckets.pick_bucket(n, cfg.admit_buckets)
+            pad = b - n
+            valid = np.asarray([True] * n + [False] * pad)
+            enc_b = np.zeros((b, S_pad, self._enc_dim), np.float32)
+            mask_b = np.zeros((b, S_pad), np.float32)
+            limit_b = np.zeros(b, np.int32)
+            limit_b[:n] = [r.limit for r in joins]
+            pt_hist_b = np.full((b, NPH), cfg.pages, np.int32)
+            pt_enc_b = np.zeros((b, NPE), np.int32)   # tail: zero page
+            wr_enc_b = np.full((b, NPE), cfg.enc_pages, np.int32)
+            src_len = np.zeros(n, np.int32)
+            enc_pages_of = [None] * n
+            for i, r in enumerate(joins):
+                kind = plan[i][0]
+                if kind == 'hit':
+                    pages, s_len = plan[i][1], plan[i][2]
+                elif kind == 'dup':
+                    j = plan[i][1]
+                    jj = miss_idx.index(j)
+                    pages, s_len = miss_pages[jj], int(len_m[jj])
+                else:
+                    j = plan[i][1]
+                    pages, s_len = miss_pages[j], int(len_m[j])
+                    enc_b[i, :enc_m.shape[1]] = enc_m[j]
+                    mask_b[i, :cfg.src_cap] = (
+                        np.arange(cfg.src_cap) < s_len)
+                    wr_enc_b[i, :len(pages)] = pages
+                src_len[i] = s_len
+                enc_pages_of[i] = pages
+                pt_enc_b[i, :len(pages)] = pages
+                pt_hist_b[i, :len(hist_pages[i])] = hist_pages[i]
+        except Exception as e:  # noqa: BLE001 — the joiners' futures own it
+            for pages in claimed_enc:
+                self._enc_pool.release(pages)
+            for pages in claimed_hist:
+                self._hist_pool.release(pages)
+            for i, r in enumerate(joins):
+                if plan[i] is not None and plan[i][0] == 'hit':
+                    self._prefix.unref(r.pkey)
+                if not r.future.done():
+                    r.future.set_exception(e)
+            obs.event('decode.prefill.error',
+                      requests=len(joins),
+                      error='%s: %s' % (type(e).__name__, e))
+            return
+
+        free = [i for i, occ in enumerate(self._occupant) if occ is None]
+        slot_idx = np.asarray(free[:n] + [0] * (b - n), np.int32)
+        self._scatter_join(slot_idx, valid, enc_b, mask_b, limit_b,
+                           pt_hist_b, pt_enc_b, wr_enc_b)
+        # the pages now hold real content: make the miss entries
+        # resident (refs = every user in this batch — the first writer
+        # plus its dups); a failure above instead released the claims,
+        # so a half-written prefix can never be hit later
+        for j, i in enumerate(miss_idx):
+            key = joins[i].pkey
+            if key is not None:
+                users = 1 + sum(1 for p in plan
+                                if p[0] == 'dup' and p[1] == i)
+                self._prefix.insert(key, miss_pages[j], int(len_m[j]),
+                                    refs=users)
+        now = time.monotonic()
+        pages_free = (self._hist_pool.free_count
+                      + self._enc_pool.free_count)
+        _G_PAGES_FREE.set(pages_free)
+        for i, req in enumerate(joins):
+            slot = free[i]
+            self._occupant[slot] = req
+            self._slot_steps[slot] = 0
+            hit = plan[i][0] != 'miss'
+            self._slot_pages[slot] = {
+                'hist': hist_pages[i], 'enc': enc_pages_of[i],
+                'pkey': req.pkey}
+            req.t_join = now
+            with self._lock:
+                self._n['joins'] += 1
+                self._win['joins'] += 1
+                if hit:
+                    self._n['prefix_hits'] += 1
+                    self._win['prefix_hits'] += 1
+                else:
+                    self._n['prefix_misses'] += 1
+                    self._win['prefix_misses'] += 1
+            _C_JOINS.inc()
+            (_C_PREFIX_HITS if hit else _C_PREFIX_MISSES).inc()
+            obs.event('decode.join', slot=slot, limit=req.limit,
+                      src_len=int(src_len[i]), prefix_hit=hit,
+                      pages_hist=len(hist_pages[i]),
+                      pages_enc=len(enc_pages_of[i]),
+                      pages_free=pages_free)
+        occ_now = sum(o is not None for o in self._occupant)
+        _G_SLOTS.set(occ_now)
+        with self._lock:
+            self._n['slots_high_water'] = max(
+                self._n['slots_high_water'], occ_now)
 
     def _release(self, slot, poisoned, ids_np, par_np, acc_np):
         """Resolve the slot's future from the step's fetched token
-        history (host arrays — no device traffic here) and free it
-        (loop thread only)."""
+        history (host arrays — no device traffic here; in paged mode
+        ids_np/par_np are the page POOLS and the slot's history is
+        gathered through its page table) and free it — pages return to
+        the pool, the prefix entry stays resident with its ref count
+        dropped (loop thread only)."""
         from ..fluid.ops_impl.lod_beam import backtrace_beams
         req = self._occupant[slot]
         self._occupant[slot] = None
         taken = self._slot_steps[slot]
+        sp = self._slot_pages[slot]
+        self._slot_pages[slot] = None
+        if sp is not None:
+            self._hist_pool.release(sp['hist'])
+            if sp['pkey'] is not None:
+                self._prefix.unref(sp['pkey'])
+            else:
+                self._enc_pool.release(sp['enc'])
+            pages_free = (self._hist_pool.free_count
+                          + self._enc_pool.free_count)
+            _G_PAGES_FREE.set(pages_free)
         with self._lock:
             self._n['releases'] += 1
             self._win['releases'] += 1
@@ -763,8 +1346,17 @@ class DecodeEngine(object):
                 'sequences are unaffected)' % (slot, taken)))
             return
         acc = acc_np[slot]
-        toks = backtrace_beams(ids_np[slot, :taken],
-                               par_np[slot, :taken])    # [K, taken]
+        if self.config.paged:
+            # gather the slot's history through its page table: the
+            # fetched pools are host arrays, so this is a pure numpy
+            # slice like the dense path
+            K = self.config.beam_size
+            ids_seq = ids_np[sp['hist']].reshape(-1, K)[:taken]
+            par_seq = par_np[sp['hist']].reshape(-1, K)[:taken]
+        else:
+            ids_seq = ids_np[slot, :taken]
+            par_seq = par_np[slot, :taken]
+        toks = backtrace_beams(ids_seq, par_seq)        # [K, taken]
         if taken < req.limit:
             # the fused lockstep scan keeps emitting end_id with
             # identity parents once every beam finished — pad instead
@@ -852,14 +1444,19 @@ class DecodeEngine(object):
                         self._not_empty.wait(_POLL_S)
                 continue
             handle = self._acquire()
+            spec_k = self.config.spec_k
+            occupied = [slot for slot, occ in enumerate(self._occupant)
+                        if occ is not None]
             t0 = time.perf_counter()
             with self._handle_lock:   # vs warmup's join/step probes
-                active_v, ids_v, par_v, acc_v, step_v = handle.step()
+                fetched = handle.step()
+                (active_v, ids_v, par_v, acc_v, step_v) = fetched[:5]
                 # fetch conversion stays INSIDE the lock: the fetched
                 # arrays alias donated state, and a concurrent warmup
                 # dispatch would delete the buffers under us
                 active_np = np.asarray(active_v)
                 steps_np = np.asarray(step_v)
+                accepted_np = np.asarray(fetched[5]) if spec_k else None
                 finished = [slot for slot, occ
                             in enumerate(self._occupant)
                             if occ is not None and not active_np[slot]]
@@ -874,6 +1471,18 @@ class DecodeEngine(object):
             with self._lock:
                 self._n['steps'] += 1
                 self._win['steps'] += 1
+                if spec_k:
+                    # accept-rate bookkeeping: every active slot saw
+                    # spec_k proposals this dispatch; Accepted counts
+                    # the ones the target verified
+                    acc_n = int(sum(accepted_np[s] for s in occupied))
+                    self._n['spec_proposed'] += spec_k * len(occupied)
+                    self._n['spec_accepted'] += acc_n
+                    self._win['spec_proposed'] += spec_k * len(occupied)
+                    self._win['spec_accepted'] += acc_n
+            if spec_k:
+                _C_SPEC_PROPOSED.inc(spec_k * len(occupied))
+                _C_SPEC_ACCEPTED.inc(acc_n)
             now = time.monotonic()
             for slot, occ in enumerate(self._occupant):
                 if occ is None:
@@ -906,9 +1515,20 @@ class DecodeEngine(object):
             self._not_full.notify_all()
         self._thread.join(timeout)
         done = not self._thread.is_alive()
+        extra = {}
+        if self.config.paged:
+            extra.update(pages_total=(self._hist_pool.usable
+                                      + self._enc_pool.usable),
+                         prefix_hits=self._n['prefix_hits'],
+                         prefix_misses=self._n['prefix_misses'],
+                         prefix_evictions=(self._prefix.evictions
+                                           if self._prefix else 0))
+        if self.config.spec_k and self._n['spec_proposed']:
+            extra['spec_accept_rate'] = round(
+                self._n['spec_accepted'] / self._n['spec_proposed'], 4)
         obs.event('decode.shutdown', drained=drain, clean=done,
                   completed=self._n['completed'],
-                  tokens=self._n['tokens'])
+                  tokens=self._n['tokens'], **extra)
         return done
 
     def __enter__(self):
@@ -926,12 +1546,28 @@ class DecodeEngine(object):
             depth = len(self._queue)
         out = {k: self._n.get(k, 0) for k in
                ('submitted', 'completed', 'rejected', 'shed', 'poisoned',
-                'joins', 'releases', 'steps', 'tokens')}
+                'joins', 'releases', 'steps', 'tokens',
+                'slots_high_water')}
         out['queue_depth'] = depth
         out['queue_high_water'] = self._q_high_water
         out['slots'] = self.config.slots
         out['slots_occupied'] = sum(o is not None for o in self._occupant)
         out['warm'] = self._warm
+        if self.config.paged:
+            out['pages_total'] = (self._hist_pool.usable
+                                  + self._enc_pool.usable)
+            out['pages_free'] = (self._hist_pool.free_count
+                                 + self._enc_pool.free_count)
+            out['prefix_hits'] = self._n['prefix_hits']
+            out['prefix_misses'] = self._n['prefix_misses']
+            out['prefix_evictions'] = (self._prefix.evictions
+                                       if self._prefix else 0)
+        if self.config.spec_k:
+            out['spec_proposed'] = self._n['spec_proposed']
+            out['spec_accepted'] = self._n['spec_accepted']
+            out['spec_accept_rate'] = (
+                self._n['spec_accepted'] / self._n['spec_proposed']
+                if self._n['spec_proposed'] else None)
         return out
 
     def stats_window(self):
@@ -955,6 +1591,24 @@ class DecodeEngine(object):
         # pool is reported separately
         win['capacity'] = self.config.queue_capacity
         win['slots'] = self.config.slots
+        # page-pool occupancy + prefix hit rate feed the router's
+        # windowed pressure sample (0/0 on a dense engine: no page
+        # pressure term)
+        if self.config.paged:
+            win['pages_free'] = (self._hist_pool.free_count
+                                 + self._enc_pool.free_count)
+            win['pages_total'] = (self._hist_pool.usable
+                                  + self._enc_pool.usable)
+            seen = win.get('prefix_hits', 0) + win.get('prefix_misses', 0)
+            win['prefix_hit_rate'] = (win.get('prefix_hits', 0) / seen
+                                      if seen else None)
+        else:
+            win['pages_free'] = win['pages_total'] = 0
+            win['prefix_hit_rate'] = None
+        if self.config.spec_k:
+            win['spec_accept_rate'] = (
+                win.get('spec_accepted', 0) / win['spec_proposed']
+                if win.get('spec_proposed') else None)
         return win
 
     def cache_stats(self):
@@ -962,3 +1616,17 @@ class DecodeEngine(object):
         zero-steady-state-compiles assertion reads misses before/after
         traffic)."""
         return self._exe.cache_stats
+
+    def state_bytes(self):
+        """Total bytes of the per-request decode STATE buffers (slot
+        state + history/encoder storage — dense buffers or page pools +
+        page tables; model weights excluded). The capacity bench's
+        equal-HBM comparison is drawn at this number
+        (tools/serve_bench.py --workload decode-paged)."""
+        total = 0
+        for shape, dtype in self._state_spec.values():
+            n = 1
+            for d in shape:
+                n *= int(d)
+            total += n * np.dtype(dtype).itemsize
+        return total

@@ -1,0 +1,237 @@
+"""Paged decode-state memory: the block-pool allocator + prefix cache.
+
+PR 8's DecodeEngine reserves dense per-slot state for the WORST case —
+`max_len` token-history rows and `src_cap` encoder rows per slot, every
+slot, up front. That is the memory wall between serving hundreds and
+serving millions of concurrent decode streams: a slot decoding an
+8-token reply holds a 256-token history buffer hostage. The paged
+engine (``DecodeConfig(page_size=..., pages=...)``) replaces the dense
+buffers with fixed-size PAGES drawn from two device-resident pools
+(vLLM's PagedAttention block table, rebuilt TPU-native):
+
+  * token-history pages ``[pages, page_size, beam]`` (ids + parents),
+    indexed per slot through an int32 page table
+    ``pt_hist [slots, ceil(max_len/page_size)]``;
+  * encoder-row pages ``[enc_pages, page_size, enc_dim]`` (+ the
+    attention mask rows), through ``pt_enc``.
+
+Shapes are static throughout: the pools and page tables never change
+shape, page lookup is an in-graph gather, history writes are in-graph
+scatters at ``(page_table[slot, step // page_size], step % page_size)``
+with invalid rows redirected to the out-of-range page index (XLA
+``mode='drop'``), the same where-select discipline as slot masking. The
+HOST side — this module — only decides WHICH physical page backs which
+logical page, between dispatches:
+
+  * :class:`PagePool` is the free-list allocator. Admission claims
+    ``ceil(limit/page_size)`` history pages and ``ceil(src_len/
+    page_size)`` encoder pages; release returns them. A join that
+    cannot get pages BLOCKS in the admission queue (typed
+    ``decode.reject`` with ``reason=pages`` when the queue then
+    overflows) — never a crash, never a stranded future.
+  * :class:`PrefixCache` keeps encoder pages RESIDENT after release,
+    keyed by a content hash of the request's encoder prefix
+    (:func:`content_key`). A request whose prefix is resident joins
+    WITHOUT re-prefilling: its page table points at the shared pages
+    (refcounted while any slot uses them). Under pool pressure,
+    unreferenced resident entries are evicted least-recently-used —
+    eviction is just pages returning to the free list.
+
+Encoder page 0 is reserved as the permanent ZERO page: slots whose
+source is shorter than ``src_cap`` point their tail page-table entries
+at it, so the in-graph gather always reads finite zeros under the
+masked-out attention rows (a garbage row would turn ``0 * NaN`` into a
+poisoned softmax).
+
+See docs/serving.md ("Paged decode memory") for the page-table diagram
+and the eviction/refcount semantics; tests/test_decode.py's ``paged``
+drill family pins the invariants (no page referenced by two live slots,
+freed pages recycled, paged-vs-dense bit-exactness).
+"""
+import collections
+import hashlib
+
+import numpy as np
+
+__all__ = ['PagePool', 'PrefixCache', 'content_key', 'pages_for']
+
+
+def pages_for(rows, page_size):
+    """Physical pages needed to back `rows` logical rows."""
+    return -(-int(rows) // int(page_size))
+
+
+def content_key(feed):
+    """Stable content hash of a request feed (the prefix-cache key):
+    sorted keys, each value's dtype/shape/bytes hashed. Two requests
+    with bit-identical encoder inputs share resident pages."""
+    h = hashlib.sha1()
+    for k in sorted(feed):
+        v = feed[k]
+        h.update(str(k).encode())
+        a = np.asarray(v)
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()
+
+
+class PagePool(object):
+    """Free-list allocator over a fixed pool of device-resident pages.
+
+    `reserved` pages (the encoder pool's zero page) are never handed
+    out. All methods are called from the decode-loop thread only; the
+    integer counters (`free_count`, `total`) are read lock-free by the
+    stats surface.
+    """
+
+    def __init__(self, total, reserved=0):
+        self.total = int(total)
+        self.reserved = int(reserved)
+        if self.total <= self.reserved:
+            raise ValueError('page pool needs > %d page(s), got %d'
+                             % (self.reserved, self.total))
+        self._free = collections.deque(range(self.reserved, self.total))
+        self.free_count = len(self._free)
+        self.allocated = 0      # cumulative
+        self.freed = 0          # cumulative
+
+    @property
+    def usable(self):
+        """Pages the pool can ever hand out (total minus reserved)."""
+        return self.total - self.reserved
+
+    def alloc(self, n, cache=None):
+        """Claim `n` pages; evicts LRU unreferenced prefix-cache entries
+        through `cache` when the free list is short. Returns the page
+        list, or None when the pool (plus everything evictable) cannot
+        cover the request — the caller blocks, it never crashes."""
+        n = int(n)
+        while cache is not None and len(self._free) < n:
+            if not cache.evict_one():
+                break
+        if len(self._free) < n:
+            return None
+        out = [self._free.popleft() for _ in range(n)]
+        self.free_count = len(self._free)
+        self.allocated += n
+        return out
+
+    def release(self, pages):
+        """Return pages to the free list (slot release / cache evict)."""
+        for p in pages:
+            self._free.append(p)
+        self.free_count = len(self._free)
+        self.freed += len(pages)
+
+    def available(self, cache=None):
+        """Pages obtainable RIGHT NOW: free plus evictable residents."""
+        n = len(self._free)
+        if cache is not None:
+            n += cache.evictable_pages()
+        return n
+
+
+class _Resident(object):
+    __slots__ = ('pages', 'src_len', 'refs')
+
+    def __init__(self, pages, src_len, refs):
+        self.pages = pages
+        self.src_len = src_len
+        self.refs = refs
+
+
+class PrefixCache(object):
+    """Content-hash -> resident encoder pages, refcounted, LRU-evicted
+    through the owning :class:`PagePool`.
+
+    A hit bumps the entry's ref count and its LRU position (the
+    OrderedDict IS the recency order: move_to_end on hit, eviction
+    scans from the front) and returns the resident pages + src_len —
+    the joining request points its page table at them and SKIPS
+    prefill entirely. `unref` on slot release leaves the entry
+    resident (refs may drop to 0); only pool pressure evicts it,
+    least-recently-used first. `on_evict(key, pages)` lets the engine
+    emit the eviction event.
+    """
+
+    def __init__(self, pool, on_evict=None):
+        self._pool = pool
+        self._entries = collections.OrderedDict()   # key -> _Resident
+        self._on_evict = on_evict
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self):
+        return len(self._entries)
+
+    def peek(self, key):
+        """True when `key` is resident — the admission gate's page-need
+        probe; no counter or ref-count side effects."""
+        return key in self._entries
+
+    def pinnable_pages(self, key):
+        """Pages a hit on `key` would take OUT of the evictable budget:
+        the entry's page count while it is unreferenced (a referenced
+        entry was never evictable, so pinning it costs nothing). The
+        admission gate charges this before admitting a hit, else a
+        batch-mate's claim would count the same pages as evictable."""
+        e = self._entries.get(key)
+        return len(e.pages) if e is not None and e.refs == 0 else 0
+
+    def lookup(self, key):
+        """(pages, src_len) on a hit (ref count bumped), else None."""
+        e = self._entries.get(key)
+        if e is None:
+            self.misses += 1
+            return None
+        e.refs += 1
+        self._entries.move_to_end(key)
+        self.hits += 1
+        return list(e.pages), e.src_len
+
+    def insert(self, key, pages, src_len, refs=1):
+        """Make freshly-written pages resident under `key`. The pages
+        stay OUT of the pool's free list until evicted."""
+        if key in self._entries:        # racing duplicate miss: keep
+            e = self._entries[key]      # the first copy, free ours
+            e.refs += refs
+            self._pool.release(pages)
+            return
+        self._entries[key] = _Resident(list(pages), int(src_len),
+                                       int(refs))
+
+    def unref(self, key):
+        """One slot stopped using the entry; it STAYS resident (that is
+        the whole point — the next request with this prefix hits)."""
+        e = self._entries.get(key)
+        if e is not None and e.refs > 0:
+            e.refs -= 1
+
+    def evictable_pages(self):
+        return sum(len(e.pages) for e in self._entries.values()
+                   if e.refs == 0)
+
+    def evict_one(self):
+        """Evict the least-recently-used unreferenced entry, returning
+        its pages to the pool. False when nothing is evictable."""
+        victim = None
+        for key, e in self._entries.items():   # insertion order = LRU
+            if e.refs == 0:
+                victim = key
+                break
+        if victim is None:
+            return False
+        e = self._entries.pop(victim)
+        self._pool.release(e.pages)
+        self.evictions += 1
+        if self._on_evict is not None:
+            self._on_evict(victim, e.pages)
+        return True
+
+    def stats(self):
+        return {'entries': len(self._entries), 'hits': self.hits,
+                'misses': self.misses, 'evictions': self.evictions,
+                'resident_pages': sum(len(e.pages)
+                                      for e in self._entries.values())}

@@ -74,12 +74,21 @@ class _Replica(object):
         depth + in-flight work + the windowed high-water mark, with
         shed/reject counts weighted heavily (a replica that had to
         refuse work is the last place to send more), plus requests this
-        router routed to it since the sample."""
+        router routed to it since the sample. A paged decode replica
+        additionally reports page-pool occupancy (pages_free /
+        pages_total in the window): a nearly-exhausted pool blocks the
+        next join even when slots look free, so it scores as slot-worth
+        of pressure as it fills."""
         w = self.window
+        pages_total = w.get('pages_total', 0)
+        page_pressure = 0.0
+        if pages_total:
+            occupancy = 1.0 - w.get('pages_free', 0) / pages_total
+            page_pressure = occupancy * w.get('slots', 1)
         return (w.get('queue_depth', 0) + w.get('inflight', 0)
                 + w.get('queue_high_water', 0)
                 + 4 * (w.get('shed', 0) + w.get('rejected', 0))
-                + self.routed_since)
+                + self.routed_since + page_pressure)
 
     def outstanding(self):
         return (self.window.get('queue_depth', 0)

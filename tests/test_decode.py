@@ -271,6 +271,411 @@ def test_zero_steady_state_compiles():
 
 
 # ---------------------------------------------------------------------------
+# paged state memory: block-pool pages + prefix cache + speculative decode
+# ---------------------------------------------------------------------------
+
+def _pengine(slots=4, max_len=MAXLEN, page_size=3, pages=None, **kw):
+    """Paged engine over the module decoder; pages default to the dense
+    equivalent (slots * ceil(max_len/page_size))."""
+    if pages is None:
+        pages = slots * -(-max_len // page_size)
+    return DecodeEngine(WEIGHTS, DecodeConfig(
+        slots=slots, beam_size=K, max_len=max_len, src_cap=SRC,
+        page_size=page_size, pages=pages, **kw))
+
+
+@pytest.mark.paged
+@pytest.mark.parametrize('seed', [0, 1])
+def test_paged_ab_randomized_join_leave(seed):
+    """THE paged acceptance drill: the paged engine is bit-exact —
+    tokens AND scores — against the lockstep reference (and therefore
+    against the dense engine, which the earlier drills pin to the same
+    reference) under randomized submit order, staggered timing and
+    mixed per-request limits over a 2-slot pool. Page assignment must
+    be invisible to outputs."""
+    rng = np.random.RandomState(seed)
+    limits = (4, MAXLEN)
+    encs = _encs(rng, 10)
+    lim = [limits[rng.randint(len(limits))] for _ in encs]
+    refs = {}
+    for L in limits:
+        grp = [i for i in range(len(encs)) if lim[i] == L]
+        if grp:
+            ids, sc = _lockstep_ref([encs[i] for i in grp], L)
+            for j, i in enumerate(grp):
+                refs[i] = (ids[j], sc[j])
+    order = rng.permutation(len(encs))
+    eng = _pengine(slots=2)
+    try:
+        eng.warmup()
+        misses0 = eng.cache_stats()['misses']
+        futs = {}
+        for i in order:
+            futs[i] = eng.submit({'enc': encs[i]}, max_new_tokens=lim[i])
+            if rng.rand() < 0.5:
+                time.sleep(rng.rand() * 0.01)
+        for i, f in futs.items():
+            toks, acc = f.result(60)
+            assert np.array_equal(toks, refs[i][0]), 'request %d' % i
+            assert np.array_equal(acc, refs[i][1]), 'request %d' % i
+        assert eng.cache_stats()['misses'] == misses0   # steady = 0
+    finally:
+        eng.shutdown()
+
+
+@pytest.mark.paged
+@pytest.mark.parametrize('bundle', [3, 8])
+def test_paged_bundled_bit_exact(bundle):
+    """bundle=K through the PAGED step op: in-graph page scatters at
+    each slot's own step index stay bit-identical to bundle=1 and to
+    lockstep, including limits that do not divide the bundle."""
+    rng = np.random.RandomState(11)
+    encs = _encs(rng, 7)
+    lims = [3, MAXLEN, 5, MAXLEN, 1, 7, MAXLEN]
+    refs = {}
+    for L in sorted(set(lims)):
+        grp = [i for i in range(len(encs)) if lims[i] == L]
+        ids, sc = _lockstep_ref([encs[i] for i in grp], L)
+        for j, i in enumerate(grp):
+            refs[i] = (ids[j], sc[j])
+    eng = _pengine(slots=2, bundle=bundle)
+    try:
+        eng.warmup()
+        futs = [eng.submit({'enc': e}, max_new_tokens=l)
+                for e, l in zip(encs, lims)]
+        for i, f in enumerate(futs):
+            toks, acc = f.result(60)
+            assert np.array_equal(toks, refs[i][0]), (bundle, i)
+            assert np.array_equal(acc, refs[i][1]), (bundle, i)
+    finally:
+        eng.shutdown()
+
+
+@pytest.mark.paged
+def test_page_table_invariants_and_recycling():
+    """Structural invariants under churn: no history page is ever
+    referenced by two live slots at once (sampled concurrently from
+    the host page tables), and freed pages are actually recycled —
+    total allocations exceed the pool while the pool never grows."""
+    eng = _pengine(slots=3, page_size=2)
+    try:
+        eng.warmup()
+        rng = np.random.RandomState(3)
+        futs = [eng.submit({'enc': e},
+                           max_new_tokens=int(rng.randint(1, MAXLEN + 1)))
+                for e in _encs(rng, 12)]
+        deadline = time.monotonic() + 120
+        pending = list(futs)
+        while pending and time.monotonic() < deadline:
+            live = [sp for sp in list(eng._slot_pages) if sp is not None]
+            hist = [p for sp in live for p in sp['hist']]
+            assert len(hist) == len(set(hist)), \
+                'page referenced by two live slots: %r' % (hist,)
+            enc_owned = [p for sp in live if sp['pkey'] is None
+                         for p in sp['enc']]
+            assert len(enc_owned) == len(set(enc_owned))
+            pending = [f for f in pending if not f.done()]
+            time.sleep(0.001)
+        for f in futs:
+            f.result(60)
+        # quiesce: the loop thread releases pages after resolving
+        _wait(lambda: eng._hist_pool.free_count == eng._hist_pool.usable)
+        assert eng._hist_pool.allocated > eng._hist_pool.usable  # reuse
+        assert eng._hist_pool.freed == eng._hist_pool.allocated
+    finally:
+        eng.shutdown()
+
+
+@pytest.mark.paged
+def test_prefix_cache_join_without_prefill(obs_events):
+    """Requests sharing an encoder prefix join WITHOUT re-prefilling:
+    the prefill runs once per DISTINCT prefix (dispatch-counted), hits
+    point their page tables at the resident pages, results stay
+    identical, and the steady state still performs zero compiles."""
+    calls = []
+
+    def prefill(feeds):
+        calls.append(len(feeds))
+        lens = np.asarray([f['src'].shape[0] for f in feeds], np.int32)
+        enc = np.zeros((len(feeds), SRC, D), np.float32)
+        for i, f in enumerate(feeds):
+            enc[i, :lens[i]] = np.outer(
+                np.arange(1, lens[i] + 1), np.ones(D)) * 0.1 * f['src'][0]
+        return enc, lens
+
+    eng = DecodeEngine(WEIGHTS, DecodeConfig(
+        slots=2, beam_size=K, max_len=MAXLEN, src_cap=SRC,
+        page_size=3, pages=12), prefill=prefill)
+    try:
+        eng.warmup(example_feed={'src': np.ones(3)})
+        calls.clear()
+        misses0 = eng.cache_stats()['misses']
+        a = {'src': np.ones(3)}
+        b = {'src': np.full(4, 2.0)}
+        ra = [eng.submit(dict(a)) for _ in range(3)]   # 1 miss + 2 hits
+        ra = [f.result(60) for f in ra]
+        rb = eng.submit(dict(b)).result(60)            # distinct: miss
+        ra2 = eng.submit(dict(a)).result(60)           # resident: hit
+        for t, s in ra[1:]:
+            assert np.array_equal(t, ra[0][0])
+            assert np.array_equal(s, ra[0][1])
+        assert np.array_equal(ra2[0], ra[0][0])
+        assert not np.array_equal(rb[0], ra[0][0])
+        st = eng.stats
+        assert st['prefix_hits'] == 3 and st['prefix_misses'] == 2
+        # prefill dispatched once per DISTINCT prefix, never for hits
+        assert len(calls) == 2
+        assert eng.cache_stats()['misses'] == misses0
+        joins = obs_events('decode.join')
+        assert sum(e['fields'].get('prefix_hit') is True
+                   for e in joins) == 3
+        w = eng.stats_window()
+        assert w['prefix_hit_rate'] == 0.6
+        assert w['pages_total'] > 0 and w['pages_free'] >= 0
+    finally:
+        eng.shutdown()
+
+
+@pytest.mark.paged
+def test_prefix_cache_lru_eviction_under_pressure(obs_events):
+    """More distinct prefixes than the encoder pool holds: resident
+    entries are evicted least-recently-used THROUGH the pool (eviction
+    = pages returning to the free list), every request completes, and
+    the eviction is observable."""
+    # enc pool: zero page + 4 usable pages of 3 rows; each 3-row
+    # request takes 1 page, so at most 4 residents — 8 distinct
+    # prefixes force evictions
+    eng = _pengine(slots=2, page_size=3, enc_pages=5)
+    try:
+        eng.warmup()
+        rng = np.random.RandomState(9)
+        encs = _encs(rng, 8, lo=3)
+        for e in encs:
+            eng.submit({'enc': e}).result(60)
+        assert eng.stats['prefix_evictions'] >= 4
+        assert len(obs_events('decode.prefix.evict')) \
+            == eng.stats['prefix_evictions']
+        # the LRU survivor set still serves hits
+        toks, _ = eng.submit({'enc': encs[-1]}).result(60)
+        assert eng.stats['prefix_hits'] >= 1
+    finally:
+        eng.shutdown()
+
+
+@pytest.mark.paged
+def test_page_pool_exhaustion_blocks_never_strands(obs_events):
+    """Satellite drill: a FULL page pool is a typed admission signal,
+    not a crash. With every history page held by live slots, later
+    requests block in the queue; a FaultInjector-poisoned occupant is
+    released (its pages return to the pool) and the blocked requests
+    join and complete — no future is ever stranded. Under the reject
+    policy the overflow rejection is stamped reason=pages."""
+    fi = FaultInjector(seed=0)
+    encs = _encs(np.random.RandomState(1), 3)
+    ids_ref, sc_ref = _lockstep_ref(encs, MAXLEN)
+    bad = fi.poison_nan(np.asarray(encs[0]), rate=1.0)
+    # 2 history pages of MAXLEN rows: exactly 2 concurrent requests
+    # despite 4 slots — the pool, not the slot count, is the wall
+    eng = _pengine(slots=4, page_size=MAXLEN, pages=2)
+    try:
+        eng.warmup()
+        poisoned = eng.submit({'enc': bad})
+        blocked = [eng.submit({'enc': e}) for e in encs]
+        with pytest.raises(DecodeSlotPoisoned):
+            poisoned.result(60)
+        for i, f in enumerate(blocked):
+            toks, acc = f.result(60)      # pages freed -> joins proceed
+            assert np.array_equal(toks, ids_ref[i])
+            assert np.array_equal(acc, sc_ref[i])
+        assert eng.stats['slots_high_water'] <= 2
+        _wait(lambda: eng._hist_pool.free_count == 2)
+    finally:
+        eng.shutdown()
+    # reject policy: a queue full BECAUSE of page starvation says so.
+    # No warmup: the first dispatch's compile plus a 64-step limit keep
+    # the only page held long enough to starve deterministically (the
+    # dense reject drill's timing trick)
+    eng2 = _pengine(slots=4, max_len=64, page_size=64, pages=1,
+                    queue_capacity=1, overflow='reject')
+    try:
+        e = np.zeros((2, D), np.float32)
+        eng2.submit({'enc': e})           # takes the only page
+        _wait(lambda: eng2.stats['joins'] == 1)
+        eng2.submit({'enc': e})           # queued, starved on pages
+        _wait(lambda: eng2._pages_starved)
+        with pytest.raises(ServerOverloaded, match='pages'):
+            eng2.submit({'enc': e})
+        ev = obs_events('decode.reject')
+        assert ev and ev[-1]['fields']['reason'] == 'pages'
+    finally:
+        eng2.shutdown()
+
+
+@pytest.mark.paged
+def test_prefix_hit_pins_pages_against_batchmate_claims():
+    """Review regression: a prefix HIT pins the resident entry (refs>0),
+    taking its pages out of the evictable budget — a batch-mate miss
+    counting the same pages as evictable must BLOCK at the gate, not
+    blow up the whole admission with a mid-admit pool-exhausted error.
+    With one usable encoder page: request A completes (resident), then
+    A-hit + B-miss submitted together — both must complete."""
+    # page_size=SRC: one enc page per request; enc_pages=2 -> 1 usable
+    eng = _pengine(slots=2, page_size=SRC, pages=4, enc_pages=2)
+    try:
+        eng.warmup()
+        encs = _encs(np.random.RandomState(17), 2, lo=3)
+        ids_ref, sc_ref = _lockstep_ref(encs, MAXLEN)
+        eng.submit({'enc': encs[0]}).result(60)       # A resident now
+        fa = eng.submit({'enc': encs[0]})             # hit: pins A
+        fb = eng.submit({'enc': encs[1]})             # miss: needs A's page
+        ta, sa = fa.result(60)
+        tb, sb = fb.result(60)
+        assert np.array_equal(ta, ids_ref[0]) and np.array_equal(
+            tb, ids_ref[1])
+        assert np.array_equal(sa, sc_ref[0]) and np.array_equal(
+            sb, sc_ref[1])
+        st = eng.stats
+        assert st['completed'] == 3 and st['prefix_hits'] >= 1
+        assert st['prefix_evictions'] >= 1            # B evicted A later
+    finally:
+        eng.shutdown()
+
+
+def _greedy_refs(encs, lims):
+    """Greedy (beam_size=1) references through the DENSE engine — the
+    target-only decode the speculative path must match token-exactly."""
+    eng = DecodeEngine(WEIGHTS, DecodeConfig(
+        slots=4, beam_size=1, max_len=MAXLEN, src_cap=SRC))
+    try:
+        eng.warmup()
+        futs = [eng.submit({'enc': e}, max_new_tokens=l)
+                for e, l in zip(encs, lims)]
+        return [f.result(60) for f in futs]
+    finally:
+        eng.shutdown()
+
+
+@pytest.mark.paged
+@pytest.mark.parametrize('spec_k', [3, 8])
+def test_speculative_decode_token_exact(spec_k):
+    """Speculative accept/rollback at K=3 and K=8 (including limits
+    that do not divide K and exceed-by-one bonus emissions): with the
+    TARGET ITSELF as draft (high accept) every emitted token matches
+    greedy target-only decode exactly, scores agree to float tolerance,
+    and the accept bookkeeping is populated."""
+    rng = np.random.RandomState(21)
+    encs = _encs(rng, 6)
+    lims = [3, MAXLEN, 5, 1, 7, MAXLEN]
+    refs = _greedy_refs(encs, lims)
+    eng = DecodeEngine(WEIGHTS, DecodeConfig(
+        slots=2, beam_size=1, max_len=MAXLEN, src_cap=SRC,
+        page_size=3, pages=12, spec_k=spec_k), draft=WEIGHTS)
+    try:
+        eng.warmup()
+        misses0 = eng.cache_stats()['misses']
+        futs = [eng.submit({'enc': e}, max_new_tokens=l)
+                for e, l in zip(encs, lims)]
+        for i, f in enumerate(futs):
+            toks, acc = f.result(60)
+            assert np.array_equal(toks, refs[i][0]), (spec_k, i)
+            np.testing.assert_allclose(acc, refs[i][1], rtol=1e-4,
+                                       atol=1e-5)
+        st = eng.stats
+        assert st['spec_proposed'] > 0
+        assert 0.0 < st['spec_accept_rate'] <= 1.0
+        # the self-draft always agrees: every dispatch emits more than
+        # one token, so dispatches stay well under total tokens
+        assert st['steps'] < st['tokens']
+        assert eng.cache_stats()['misses'] == misses0
+    finally:
+        eng.shutdown()
+
+
+@pytest.mark.paged
+def test_speculative_rollback_with_wrong_draft():
+    """A DRAFT THAT IS USUALLY WRONG (adversarial next-token table)
+    forces the mismatch/rollback path on nearly every dispatch — the
+    output must still be token-exact vs greedy target-only decode, with
+    a correspondingly low measured accept rate."""
+    rng = np.random.RandomState(22)
+    encs = _encs(rng, 5)
+    lims = [MAXLEN, 4, MAXLEN, 2, 6]
+    refs = _greedy_refs(encs, lims)
+    table = rng.randint(0, V, V).astype(np.int32)
+    eng = DecodeEngine(WEIGHTS, DecodeConfig(
+        slots=2, beam_size=1, max_len=MAXLEN, src_cap=SRC,
+        page_size=3, pages=12, spec_k=4), draft=table)
+    try:
+        eng.warmup()
+        futs = [eng.submit({'enc': e}, max_new_tokens=l)
+                for e, l in zip(encs, lims)]
+        for i, f in enumerate(futs):
+            toks, acc = f.result(60)
+            assert np.array_equal(toks, refs[i][0]), i
+            np.testing.assert_allclose(acc, refs[i][1], rtol=1e-4,
+                                       atol=1e-5)
+        assert eng.stats['spec_accept_rate'] < 0.5
+    finally:
+        eng.shutdown()
+
+
+@pytest.mark.paged
+def test_paged_poisoned_slot_frees_pages(obs_events):
+    """Fault isolation composes with paging: a poisoned slot's typed
+    failure also returns its pages to the pool."""
+    fi = FaultInjector(seed=1)
+    bad = fi.poison_nan(np.zeros((3, D), np.float32), rate=1.0)
+    eng = _pengine(slots=2)
+    try:
+        eng.warmup()
+        free0 = eng._hist_pool.free_count
+        with pytest.raises(DecodeSlotPoisoned):
+            eng.submit({'enc': bad}).result(60)
+        _wait(lambda: eng._hist_pool.free_count == free0)
+        assert eng.stats['poisoned'] == 1
+    finally:
+        eng.shutdown()
+
+
+@pytest.mark.paged
+def test_decode_config_validates_paged():
+    with pytest.raises(ValueError, match='page_size'):
+        DecodeConfig(pages=8)                      # pages without paging
+    with pytest.raises(ValueError, match='page_size'):
+        DecodeConfig(spec_k=4)
+    with pytest.raises(ValueError, match='pages=N'):
+        DecodeConfig(page_size=4)                  # paging without pages
+    with pytest.raises(ValueError, match='cannot back'):
+        DecodeConfig(max_len=32, page_size=4, pages=7)
+    with pytest.raises(ValueError, match='beam_size=1'):
+        DecodeConfig(beam_size=2, page_size=4, pages=8, spec_k=2)
+    with pytest.raises(ValueError, match='mutually exclusive'):
+        DecodeConfig(beam_size=1, page_size=4, pages=8, spec_k=2,
+                     bundle=4)
+    with pytest.raises(ValueError, match='needs a draft'):
+        DecodeEngine(WEIGHTS, DecodeConfig(
+            beam_size=1, page_size=4, pages=8, spec_k=2))
+    with pytest.raises(ValueError, match='vocab'):
+        DecodeEngine(WEIGHTS, DecodeConfig(
+            beam_size=1, page_size=4, pages=8, spec_k=2),
+            draft=np.zeros(3, np.int32))
+
+
+@pytest.mark.paged
+def test_dense_stats_window_has_page_fields():
+    """The windowed pressure sample carries the page fields on EVERY
+    engine kind (the router normalizes across replicas): zeros on a
+    dense engine, live numbers on a paged one."""
+    eng = _engine(slots=2)
+    try:
+        w = eng.stats_window()
+        assert w['pages_free'] == 0 and w['pages_total'] == 0
+        assert w['prefix_hit_rate'] is None
+    finally:
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
 # fault isolation
 # ---------------------------------------------------------------------------
 
@@ -699,6 +1104,23 @@ def test_router_spreads_consecutive_submits():
     assert len(a.submits) == 3 and len(b.submits) == 3
 
 
+@pytest.mark.paged
+def test_router_scores_page_pool_occupancy():
+    """A paged decode replica's windowed pressure sample carries
+    pages_free/pages_total; a nearly-exhausted pool scores as
+    slot-pressure (the next join would block on pages even though
+    slots look free), so the router prefers the replica with pages."""
+    starved = _FakeReplica(window={'pages_total': 10, 'pages_free': 0,
+                                   'slots': 4})
+    roomy = _FakeReplica(window={'pages_total': 10, 'pages_free': 10,
+                                 'slots': 4})
+    r = Router(window_s=1e9)
+    r.add_model('m', [starved, roomy])
+    for i in range(3):
+        r.submit('m', {'i': i}).result(1)
+    assert len(roomy.submits) == 3 and len(starved.submits) == 0
+
+
 def test_router_quota_typed_overload():
     a = _FakeReplica()
     r = Router(window_s=1e9)
@@ -959,6 +1381,29 @@ def test_three_replica_router_decode_drill():
 # ---------------------------------------------------------------------------
 # obs_report renders the decode section
 # ---------------------------------------------------------------------------
+
+@pytest.mark.paged
+def test_obs_report_renders_page_pool_and_prefix(tmp_path, obs_events):
+    """The -- decode -- section renders page-pool occupancy (from the
+    join events' pages_free samples), the prefix hit/miss/evict
+    counters, and the speculative accept rate (from the shutdown
+    summary)."""
+    eng = DecodeEngine(WEIGHTS, DecodeConfig(
+        slots=2, beam_size=1, max_len=MAXLEN, src_cap=SRC,
+        page_size=3, pages=8, enc_pages=8, spec_k=3), draft=WEIGHTS)
+    try:
+        eng.warmup()
+        encs = _encs(np.random.RandomState(12), 4, lo=3)
+        for e in encs + [encs[0]]:        # repeat: one prefix hit
+            eng.submit({'enc': e}).result(60)
+        assert eng.stats['prefix_hits'] >= 1
+    finally:
+        eng.shutdown()
+    text = obs_report.summarize(obs_events())
+    assert 'page pool: min free' in text and 'of 15 total' in text
+    assert 'prefix cache:' in text and 'hit(s)' in text
+    assert 'speculative decode: accept rate' in text
+
 
 def test_obs_report_decode_section(tmp_path, obs_events):
     eng = _engine(slots=2)

@@ -118,6 +118,18 @@ for name in sorted(set(new) & set(prev)):
         print('[compare] %s: %.0f vs %.0f (counter metric; config-'
               'driven, not flagged)' % (name, nv, pv))
         continue
+    # rate metrics (the serve_bench prefix *_hit_rate and speculative
+    # *_accept_rate) are HIGHER-is-better fractions in [0, 1]: compare
+    # them on ABSOLUTE delta, not ratio — a hit rate moving 0.02 ->
+    # 0.01 is a 2x ratio but a negligible absolute change, while
+    # 0.9 -> 0.5 is the real regression the ratio rule under-weights
+    if name.endswith('_hit_rate') or name.endswith('_accept_rate'):
+        flag = ''
+        if nv < pv - 0.1:
+            flag = '  <-- WARNING: rate dropped >0.1 vs %s' % prev_path
+        print('[compare] %s: %.3f vs %.3f (rate; higher is better)%s'
+              % (name, nv, pv, flag))
+        continue
     # latency-style metrics (the serve/decode *_ms percentiles, shed/
     # dropped counts, the embedding *_temp_bytes footprints) are
     # LOWER-is-better: a p99/footprint that dropped is an improvement;

@@ -200,4 +200,23 @@ if [ "${DECODE:-0}" = 1 ]; then
       --check-compiles --check-speedup 1.5
 fi
 
+# 10a. paged decode memory (opt-in: PAGED=1): dense-slot vs paged
+#      engine at EQUAL state-buffer bytes on a short-request stream —
+#      --check-speedup here enforces the >=2x peak-concurrent-streams
+#      capacity ratio; prefix-cache hit rate + zero steady compiles
+#      ride along (decode.paged.* bench.metric records).
+if [ "${PAGED:-0}" = 1 ]; then
+  run python tools/serve_bench.py --workload decode-paged \
+      --check-compiles --check-speedup 2.0
+fi
+
+# 10b. speculative decoding (opt-in: SPEC=1): greedy target-only vs
+#      draft-then-verify on the predictable-continuation decoder;
+#      reports measured accept-rate and enforces a tokens/sec win
+#      (modest bar — the CI box is noisy; decode.spec.* records).
+if [ "${SPEC:-0}" = 1 ]; then
+  run python tools/serve_bench.py --workload decode-spec \
+      --check-compiles --check-speedup 1.02
+fi
+
 echo "sweep complete; see $LOG" | tee -a "$LOG"

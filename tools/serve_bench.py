@@ -36,6 +36,14 @@ steady-state compiles; `--check-speedup 1.5 --check-compiles` enforces
 it). Every record is stamped with the resolved platform + fallback flag,
 the PR 6 bench.py convention.
 
+`--workload decode-paged` is the PAGED-CAPACITY A/B (dense-slot vs
+paged-memory engine at EQUAL state-buffer bytes: peak concurrent
+streams + prefix-cache hit rate; `--check-speedup 2.0` enforces the
+capacity ratio) and `--workload decode-spec` the SPECULATIVE A/B
+(greedy target-only vs draft-then-verify: tokens/sec + measured accept
+rate; `--check-speedup` enforces the win) — docs/serving.md "Paged +
+speculative benchmarking" has the design and the CPU-box numbers.
+
 CPU-safe: run under JAX_PLATFORMS=cpu for a functional check; numbers
 only mean something on the real accelerator (tools/perf_sweep.sh wires
 this in behind SERVE=1, the decode workload behind DECODE=1).
@@ -373,6 +381,243 @@ def run_decode_engine(weights, reqs, args):
     return lat, tokens, tokens / wall, steady_compiles, stats, ttft
 
 
+def _bigram_weights(rng, vocab, emb, enc_dim, hidden, ctx_scale=0.15):
+    """A decoder with PREDICTABLE continuations — the workload premise
+    of speculative decoding (real text is draft-predictable; iid-random
+    weights are not). Construction: a forget-gate-biased cell makes the
+    hidden state mostly a function of the previous token, and w_out is
+    laid out so the greedy argmax follows a fixed successor permutation
+    with the attention context as a tunable noise floor (ctx_scale) —
+    so a cheap draft genuinely can propose what the target will emit,
+    at a measured (not scripted) accept rate."""
+
+    def sigmoid(x):
+        return 1.0 / (1.0 + np.exp(-x))
+
+    V, E, D, H = vocab, emb, enc_dim, hidden
+    b = np.zeros((1, 4 * H), np.float32)
+    b[0, H:2 * H] = -4.0        # forget gate ~0: cell resets per step
+    b[0, :H] = 2.0
+    b[0, 3 * H:] = 2.0
+    wd = rng.randn(E + D, 4 * H).astype(np.float32)
+    wd[E:] *= ctx_scale
+    w_emb = rng.randn(V, E).astype(np.float32)
+    g = w_emb @ wd[:E] + b
+    gi, gf, gc, go = np.split(g, 4, axis=1)
+    hv = sigmoid(go) * np.tanh(sigmoid(gi) * np.tanh(gc))   # h per token
+    succ = rng.permutation(V)
+    w_out = np.zeros((H, V), np.float32)
+    w_out[:, succ] = (2.5 * hv / ((hv * hv).sum(1) + 1e-6)[:, None]).T
+    return {'w_dec': wd,
+            'u_dec': (rng.randn(H, 4 * H) * 0.02).astype(np.float32),
+            'b_dec': b,
+            'w_q': (rng.randn(H, D) * 0.2).astype(np.float32),
+            'w_emb': w_emb, 'w_out': w_out,
+            'b_out': np.zeros((1, V), np.float32)}, succ
+
+
+def _decode_engine_cfg(args, **overrides):
+    from paddle_tpu import serving
+    base = dict(slots=args.slots, beam_size=args.beam,
+                max_len=args.decode_max_len, src_cap=args.src_cap,
+                bundle=args.decode_bundle,
+                queue_capacity=max(args.queue_capacity, 4096))
+    base.update(overrides)
+    return serving.DecodeConfig(**base)
+
+
+def _drive_decode(eng, reqs, timeout=600):
+    """Burst-submit the stream and wait; returns tokens/sec."""
+    t0 = time.perf_counter()
+    futs = [eng.submit({'enc': e}, max_new_tokens=l) for e, l in reqs]
+    for f in futs:
+        f.result(timeout)
+    wall = time.perf_counter() - t0
+    return sum(l for _, l in reqs) / wall
+
+
+def run_decode_paged(args):
+    """The PAGED-CAPACITY A/B: dense slots vs paged slots at EQUAL
+    state-buffer bytes, on a short-request stream (the elasticity
+    regime: every dense slot reserves max_len history + src_cap encoder
+    rows up front; pages reserve only each request's own need). The
+    acceptance bar is >= 2x peak concurrent streams; --check-speedup
+    enforces the ratio. A third of the stream shares canonical
+    prefixes, so the prefix-cache hit rate is exercised and reported."""
+    from paddle_tpu import serving
+    rng = np.random.RandomState(0)
+    weights = _decode_weights(rng, args.vocab, args.emb_dim,
+                              args.enc_dim, args.hidden)
+    lim_hi = max(2, args.decode_max_len // 4)
+    lim_lo = max(1, min(args.min_tokens, lim_hi))
+    src_hi = max(2, args.src_cap // 4)
+    srng = np.random.RandomState(1)
+    canon = [(srng.randn(src_hi, args.enc_dim) * 0.5).astype(np.float32)
+             for _ in range(4)]
+    reqs = []
+    for i in range(args.requests):
+        if i % 3 == 0:          # shared system-prompt prefixes
+            e = canon[srng.randint(len(canon))]
+        else:
+            e = (srng.randn(srng.randint(2, src_hi + 1), args.enc_dim)
+                 * 0.5).astype(np.float32)
+        reqs.append((e, int(srng.randint(lim_lo, lim_hi + 1))))
+
+    dense_cfg = _decode_engine_cfg(args)
+    probe = serving.DecodeEngine(weights, dense_cfg)
+    dense_bytes = probe.state_bytes()
+    probe.shutdown()
+    ps = args.page_size
+    paged_cfg = None
+    mults = (args.paged_slots / args.slots,) if args.paged_slots \
+        else (6, 5, 4, 3.5, 3, 2.75, 2.5, 2.25, 2)
+    for mult in mults:
+        slots_p = int(args.slots * mult)
+        cand = _decode_engine_cfg(
+            args, slots=slots_p, page_size=ps,
+            pages=slots_p * serving.pages.pages_for(lim_hi, ps),
+            enc_pages=1 + slots_p * serving.pages.pages_for(src_hi, ps))
+        probe = serving.DecodeEngine(weights, cand)
+        paged_bytes = probe.state_bytes()
+        probe.shutdown()
+        if paged_bytes <= dense_bytes:
+            paged_cfg = cand
+            break
+    if paged_cfg is None:
+        _emit({'metric': 'decode.paged.skipped',
+               'value': 'no paged config fits %d dense state bytes'
+                        % dense_bytes})
+        return 1
+    _emit({'metric': 'decode.paged.workload',
+           'value': '%d reqs, dense slots=%d, paged slots=%d '
+                    '(page_size=%d, pages=%d+%d)'
+                    % (len(reqs), args.slots, paged_cfg.slots, ps,
+                       paged_cfg.pages, paged_cfg.enc_pages),
+           'reps': args.reps})
+
+    best = {}
+    steady_worst = 0
+    stats = {}
+    for _ in range(max(1, args.reps)):
+        for leg, cfg in (('dense', dense_cfg), ('paged', paged_cfg)):
+            eng = serving.DecodeEngine(weights, cfg)
+            eng.warmup()
+            c0 = _steady_compile_counter()
+            tps = _drive_decode(eng, reqs)
+            steady_worst = max(steady_worst,
+                               _steady_compile_counter() - c0)
+            st = eng.stats
+            eng.shutdown()
+            if leg not in best or tps > best[leg]:
+                best[leg] = tps
+                stats[leg] = st
+    for leg, cfg in (('dense', dense_cfg), ('paged', paged_cfg)):
+        bytes_ = dense_bytes if leg == 'dense' else paged_bytes
+        _emit({'metric': 'decode.%s.peak_streams' % leg,
+               'value': stats[leg]['slots_high_water']})
+        _emit({'metric': 'decode.%s.tokens_per_sec' % leg,
+               'value': round(best[leg], 2), 'unit': 'tok/s'})
+        _emit({'metric': 'decode.%s.state_bytes' % leg, 'value': bytes_})
+    st = stats['paged']
+    seen = st['prefix_hits'] + st['prefix_misses']
+    if seen:
+        _emit({'metric': 'decode.paged.prefix_hit_rate',
+               'value': round(st['prefix_hits'] / seen, 4)})
+    ratio = (stats['paged']['slots_high_water']
+             / max(1, stats['dense']['slots_high_water']))
+    _emit({'metric': 'decode.paged.capacity_ratio',
+           'value': round(ratio, 3), 'unit': 'x'})
+    _emit({'metric': 'decode.steady_compiles', 'value': int(steady_worst)})
+    rc = 0
+    if args.check_compiles and steady_worst:
+        print('serve_bench: %d compile(s) happened AFTER paged-decode '
+              'warmup' % steady_worst, file=sys.stderr)
+        rc = 1
+    if args.check_speedup and ratio < args.check_speedup:
+        print('serve_bench: paged capacity ratio %.2fx below the %.2fx '
+              'bar at equal state bytes (%d vs %d)'
+              % (ratio, args.check_speedup, paged_bytes, dense_bytes),
+              file=sys.stderr)
+        rc = 1
+    return rc
+
+
+def run_decode_spec(args):
+    """The SPECULATIVE A/B: greedy target-only decode (beam_size=1,
+    bundled) vs draft-then-verify at spec_k proposals per dispatch,
+    over a predictable-continuation decoder (_bigram_weights — the
+    draft-predictability premise, with the accept rate MEASURED from
+    the engine's in-graph accept bookkeeping, never assumed). The
+    draft is the decoder's own successor table — the 'distilled
+    offline on the target's distribution' speculator; the attention
+    context still perturbs the target's argmax, so acceptance is a
+    property of the run, not of the construction. Reports accept-rate
+    and tokens/sec for both legs; --check-speedup enforces the win."""
+    from paddle_tpu import serving
+    rng = np.random.RandomState(0)
+    weights, succ = _bigram_weights(rng, args.vocab, args.emb_dim,
+                                    args.enc_dim, args.hidden)
+    table = succ.astype(np.int32)
+    lim_lo = max(1, min(args.min_tokens, args.decode_max_len))
+    srng = np.random.RandomState(1)
+
+    def stream(r, n):
+        return [((r.randn(r.randint(2, args.src_cap + 1), args.enc_dim)
+                  * 0.8).astype(np.float32),
+                 int(r.randint(lim_lo, args.decode_max_len + 1)))
+                for _ in range(n)]
+
+    pcfg = dict(beam_size=1, page_size=args.page_size,
+                pages=(args.slots + 4) * serving.pages.pages_for(
+                    args.decode_max_len, args.page_size))
+    _emit({'metric': 'decode.spec.workload',
+           'value': '%d reqs, slots=%d, K=%d, vocab=%d, draft=bigram '
+                    'successor table'
+                    % (args.requests, args.slots, args.spec_k,
+                       args.vocab),
+           'reps': args.reps})
+
+    reqs = stream(srng, args.requests)
+    target = serving.DecodeEngine(weights, _decode_engine_cfg(
+        args, **pcfg))
+    spec = serving.DecodeEngine(weights, _decode_engine_cfg(
+        args, bundle=1, spec_k=args.spec_k, **pcfg), draft=table)
+    target.warmup()
+    spec.warmup()
+    c0 = _steady_compile_counter()
+    best_t = best_s = 0.0
+    for _ in range(max(1, args.reps)):      # interleaved legs
+        best_t = max(best_t, _drive_decode(target, reqs))
+        best_s = max(best_s, _drive_decode(spec, reqs))
+    steady = _steady_compile_counter() - c0
+    accept = spec.stats['spec_accept_rate'] or 0.0
+    target.shutdown()
+    spec.shutdown()
+    _emit({'metric': 'decode.spec.target_tokens_per_sec',
+           'value': round(best_t, 2), 'unit': 'tok/s'})
+    _emit({'metric': 'decode.spec.tokens_per_sec',
+           'value': round(best_s, 2), 'unit': 'tok/s'})
+    _emit({'metric': 'decode.spec.accept_rate',
+           'value': round(accept, 4)})
+    _emit({'metric': 'decode.spec.speedup',
+           'value': round(best_s / best_t, 3) if best_t else None,
+           'unit': 'x'})
+    _emit({'metric': 'decode.steady_compiles', 'value': int(steady)})
+    rc = 0
+    if args.check_compiles and steady:
+        print('serve_bench: %d compile(s) happened AFTER spec-decode '
+              'warmup' % steady, file=sys.stderr)
+        rc = 1
+    if args.check_speedup and best_t \
+            and best_s / best_t < args.check_speedup:
+        print('serve_bench: speculative speedup %.2fx below the %.2fx '
+              'bar (accept rate %.2f)' % (best_s / best_t,
+                                          args.check_speedup, accept),
+              file=sys.stderr)
+        rc = 1
+    return rc
+
+
 def run_decode(args):
     """The DECODE workload: continuous batching must beat whole-batch
     lockstep on a mixed-length stream at equal batch capacity (the
@@ -469,12 +714,34 @@ def main(argv=None):
     ap.add_argument('--no-baseline', action='store_true')
     ap.add_argument('--check-compiles', action='store_true',
                     help='exit 1 if the steady-state phase compiled')
-    ap.add_argument('--workload', choices=('infer', 'decode'),
+    ap.add_argument('--workload',
+                    choices=('infer', 'decode', 'decode-paged',
+                             'decode-spec'),
                     default='infer',
                     help='infer: single-shot requests through the '
                          'ServingEngine; decode: autoregressive beam '
                          'decode through the continuous-batching '
-                         'DecodeEngine vs whole-batch lockstep')
+                         'DecodeEngine vs whole-batch lockstep; '
+                         'decode-paged: dense-slot vs paged-memory '
+                         'engine at EQUAL state bytes (peak concurrent '
+                         'streams + prefix hit rate; --check-speedup '
+                         'enforces the capacity ratio); decode-spec: '
+                         'greedy target-only vs speculative '
+                         'draft-then-verify decode (tokens/sec + '
+                         'accept rate; --check-speedup enforces the '
+                         'win). The two new workloads re-default the '
+                         'model dials to their regime (long max_len / '
+                         'short requests for paged capacity; a '
+                         'vocab-heavy predictable-continuation decoder '
+                         'for speculation) unless set explicitly.')
+    ap.add_argument('--page-size', type=int, default=8,
+                    help='paged workloads: rows per page')
+    ap.add_argument('--paged-slots', type=int, default=0,
+                    help='decode-paged: paged-leg slot count (default '
+                         '0 = largest multiple of --slots whose state '
+                         'fits the dense leg bytes)')
+    ap.add_argument('--spec-k', type=int, default=16,
+                    help='decode-spec: draft proposals per dispatch')
     ap.add_argument('--slots', type=int, default=8,
                     help='decode slot-pool capacity (= lockstep batch '
                          'capacity)')
@@ -505,9 +772,28 @@ def main(argv=None):
                          'batching is below X times lockstep tokens/sec')
     args = ap.parse_args(argv)
 
+    # per-workload regime defaults: applied only where the user kept
+    # the global default, so explicit flags always win
+    wl_defaults = {
+        'decode-paged': {'decode_max_len': 128, 'src_cap': 32,
+                         'hidden': 64, 'beam': 4, 'min_tokens': 4,
+                         'requests': 96},
+        'decode-spec': {'vocab': 4096, 'emb_dim': 64, 'enc_dim': 8,
+                        'hidden': 48, 'decode_max_len': 64,
+                        'src_cap': 8, 'min_tokens': 48, 'beam': 1,
+                        'requests': 48, 'reps': 3},
+    }
+    for k, v in wl_defaults.get(args.workload, {}).items():
+        if getattr(args, k) == ap.get_default(k):
+            setattr(args, k, v)
+
     _resolve_platform()
     if args.workload == 'decode':
         return run_decode(args)
+    if args.workload == 'decode-paged':
+        return run_decode_paged(args)
+    if args.workload == 'decode-spec':
+        return run_decode_spec(args)
 
     save_dir = tempfile.mkdtemp(prefix='serve_bench_')
     feed_name, example = build_model(args.model, save_dir)
