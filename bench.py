@@ -395,6 +395,137 @@ def bench_bundle(steps=None, bundle_steps=None, batch_size=64, warmup=1):
     return (steps / dt_unbundled, steps / dt_bundled, K, max_diff)
 
 
+def bench_overlap(steps=None, batch=None, interval=10):
+    """Pipeline-overlap phase (docs/perf.md#overlap), two A/Bs on the
+    small host-bound model where host work is visible:
+
+      1. double-buffered feeds: Trainer(double_buffer=False) vs True over
+         IDENTICAL python-list row data (the DataFeeder assembly is the
+         real host cost) — steps/sec, per-step input wait, and the
+         executor.host_stall.seconds histogram delta per leg;
+      2. checkpoint cadence: a run()-loop saving a sharded checkpoint
+         every `interval` steps — off vs synchronous save_sharded vs
+         save_sharded_async — steps/sec per leg plus the per-interval
+         step-boundary stall (sync pays the full file IO + commit
+         inline; async pays only the buffer snapshot).
+
+    Host-side wins, so CPU numbers are valid (the contract numbers ARE
+    CPU ones, like the bundle phase). Returns a dict of leg results."""
+    import shutil
+    import tempfile
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import obs as _obs
+    from paddle_tpu.fluid import framework, unique_name
+    from paddle_tpu.utils import checkpoint as shck
+
+    if steps is None:
+        steps = int(os.environ.get('BENCH_OVERLAP_STEPS', '160'))
+    if batch is None:
+        batch = int(os.environ.get('BENCH_OVERLAP_BATCH', '256'))
+
+    W = (np.arange(13, dtype='float32').reshape(13, 1) - 6.0) / 13.0
+
+    def reader():
+        rng = np.random.RandomState(0)
+        for _ in range(steps):
+            xs = rng.rand(batch, 13).astype('float32')
+            ys = xs @ W
+            # python-list rows: DataFeeder pays genuine per-row host
+            # assembly, the cost double buffering is supposed to hide
+            yield [(xs[i].tolist(), [float(ys[i, 0])])
+                   for i in range(batch)]
+
+    def train_func():
+        x = fluid.layers.data(name='x', shape=[13], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        pred = fluid.layers.fc(input=x, size=1)
+        return fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+
+    def opt_func():
+        return fluid.optimizer.SGD(learning_rate=0.01)
+
+    stall_h = _obs.histogram('executor.host_stall.seconds')
+
+    def feed_leg(double_buffer):
+        tr = fluid.Trainer(train_func, opt_func, place=fluid.CPUPlace(),
+                           sync='async', double_buffer=double_buffer)
+        handler = lambda ev: None  # noqa: E731
+        tr.train(1, handler, reader=reader, feed_order=['x', 'y'])  # warm
+        tr.input_stage_s, tr.batches_fed = 0.0, 0
+        s0 = stall_h.sum
+        t0 = time.time()
+        tr.train(1, handler, reader=reader, feed_order=['x', 'y'])
+        dt = time.time() - t0
+        return {'steps_per_sec': steps / dt,
+                'input_wait_ms_per_step':
+                    1e3 * tr.input_stage_s / max(1, tr.batches_fed),
+                'host_stall_s': stall_h.sum - s0}
+
+    def ckpt_leg(mode, h1=256, h2=4096, ck_batch=64):
+        # state is sized so one serial is a few MB — enough that the
+        # SYNC leg's inline file IO + commit is a visible per-interval
+        # stall while the async leg's snapshot (host memcpy) is not
+        main, startup = _fresh()
+        with unique_name.guard():
+            with framework.program_guard(main, startup):
+                x = fluid.layers.data(name='x', shape=[13],
+                                      dtype='float32')
+                y = fluid.layers.data(name='y', shape=[1],
+                                      dtype='float32')
+                h = fluid.layers.fc(input=x, size=h1, act='relu')
+                h = fluid.layers.fc(input=h, size=h2, act='relu')
+                pred = fluid.layers.fc(input=h, size=1)
+                cost = fluid.layers.mean(
+                    fluid.layers.square_error_cost(input=pred, label=y))
+                fluid.optimizer.SGD(learning_rate=0.01).minimize(cost)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        rng = np.random.RandomState(0)
+        feed = {'x': rng.rand(ck_batch, 13).astype('float32'),
+                'y': rng.rand(ck_batch, 1).astype('float32')}
+        tmp = tempfile.mkdtemp(prefix='bench_overlap_ckpt_')
+        stalls, handle, serial = [], None, 0
+        try:
+            with fluid.scope_guard(scope):
+                exe.run(startup)
+                for _ in range(2):   # compile + warm
+                    exe.run(main, feed=feed, fetch_list=[cost])
+                t0 = time.time()
+                for i in range(steps):
+                    exe.run(main, feed=feed, fetch_list=[cost])
+                    if mode != 'off' and (i + 1) % interval == 0:
+                        serial += 1
+                        s0 = time.time()
+                        state = exe.state_dict(main, scope=scope)
+                        dest = os.path.join(tmp, 'sharded_%d' % serial)
+                        if mode == 'sync':
+                            shck.save_sharded(dest, state, step=serial)
+                        else:
+                            if handle is not None:
+                                handle.wait()
+                            handle = shck.save_sharded_async(
+                                dest, state, step=serial)
+                        stalls.append(time.time() - s0)
+                if handle is not None:
+                    handle.wait()
+                dt = time.time() - t0
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+        out = {'steps_per_sec': steps / dt}
+        if stalls:
+            out['interval_stall_ms_p50'] = 1e3 * sorted(stalls)[
+                len(stalls) // 2]
+            out['interval_stall_ms_max'] = 1e3 * max(stalls)
+        return out
+
+    return {'feed_off': feed_leg(False), 'feed_on': feed_leg(True),
+            'ckpt_off': ckpt_leg('off'), 'ckpt_sync': ckpt_leg('sync'),
+            'ckpt_async': ckpt_leg('async'),
+            'steps': steps, 'batch': batch, 'interval': interval}
+
+
 def bench_gspmd(model, warmup=2, iters=None):
     """Pod-scale GSPMD phase (docs/parallel.md): the SAME Fluid Program
     run two ways — single device vs dp=N over every visible device via
@@ -696,6 +827,8 @@ NAME_E_SHARD = 'deepfm_embed_sharded_sparse_steps_per_sec'
 NAME_E_ROWS = 'deepfm_embed_rows_touched'
 NAME_E_DTEMP = 'deepfm_embed_dense_step_temp_bytes'
 NAME_E_STEMP = 'deepfm_embed_sharded_step_temp_bytes'
+NAME_O_FEED = 'fit_a_line_double_buffer_train_steps_per_sec'
+NAME_O_CK = 'fit_a_line_ckpt_async_train_steps_per_sec'
 PHASES = ('transformer', 'resnet', 'bundle', 'gspmd', 'embedding',
           'longseq', 'longctx')
 PHASE_NAMES = {'transformer': NAME_T, 'resnet': NAME_R, 'bundle': NAME_B,
@@ -907,6 +1040,73 @@ def run_phase(phase, platform):
             _log('%s failed: %r' % (NAME_E_SHARD, e))
             _emit({'metric': NAME_E_SHARD, 'skipped': True,
                    'error': str(e)[:300]})
+    elif phase == 'overlap':
+        # pipeline-overlap contract metrics (docs/perf.md#overlap):
+        # double-buffered feeds + async sharded checkpoints. Both are
+        # host-side wins, so CPU numbers are VALID and the phase never
+        # skips off-chip (the bundle-phase precedent).
+        try:
+            res = bench_overlap()
+            on, off = res['feed_on'], res['feed_off']
+            _emit({'metric': NAME_O_FEED,
+                   'value': round(on['steps_per_sec'], 2),
+                   'unit': 'steps/sec',
+                   'off_steps_per_sec': round(off['steps_per_sec'], 2),
+                   'speedup_vs_inline_feed': round(
+                       on['steps_per_sec'] / off['steps_per_sec'], 3),
+                   'input_wait_ms_per_step': round(
+                       on['input_wait_ms_per_step'], 3),
+                   'off_input_wait_ms_per_step': round(
+                       off['input_wait_ms_per_step'], 3),
+                   'host_stall_s': round(on['host_stall_s'], 4),
+                   'off_host_stall_s': round(off['host_stall_s'], 4),
+                   'platform': platform, 'batch': res['batch']})
+            # stall/wait numbers ALSO as their own lower-is-better
+            # records (the *_stall_s / *_ms suffixes are what
+            # bench_sentinel keys its direction rules on — fields inside
+            # the steps/sec record are invisible to it)
+            _emit({'metric': 'fit_a_line_double_buffer_host_stall_s',
+                   'value': round(on['host_stall_s'], 4),
+                   'unit': 'seconds',
+                   'off_host_stall_s': round(off['host_stall_s'], 4),
+                   'platform': platform})
+            _emit({'metric': 'fit_a_line_double_buffer_input_wait_ms',
+                   'value': round(on['input_wait_ms_per_step'], 3),
+                   'unit': 'ms/step',
+                   'off_input_wait_ms': round(
+                       off['input_wait_ms_per_step'], 3),
+                   'platform': platform})
+            ck_off, ck_s, ck_a = (res['ckpt_off'], res['ckpt_sync'],
+                                  res['ckpt_async'])
+            _emit({'metric': NAME_O_CK,
+                   'value': round(ck_a['steps_per_sec'], 2),
+                   'unit': 'steps/sec',
+                   'ckpt_off_steps_per_sec': round(
+                       ck_off['steps_per_sec'], 2),
+                   'ckpt_sync_steps_per_sec': round(
+                       ck_s['steps_per_sec'], 2),
+                   'vs_ckpt_off': round(
+                       ck_a['steps_per_sec'] / ck_off['steps_per_sec'],
+                       3),
+                   'ckpt_interval_steps': res['interval'],
+                   'platform': platform, 'batch': res['batch']})
+            _emit({'metric': 'fit_a_line_ckpt_sync_interval_stall_ms',
+                   'value': round(
+                       ck_s.get('interval_stall_ms_p50', 0.0), 3),
+                   'unit': 'ms', 'max_ms': round(
+                       ck_s.get('interval_stall_ms_max', 0.0), 3),
+                   'platform': platform})
+            _emit({'metric': 'fit_a_line_ckpt_async_interval_stall_ms',
+                   'value': round(
+                       ck_a.get('interval_stall_ms_p50', 0.0), 3),
+                   'unit': 'ms', 'max_ms': round(
+                       ck_a.get('interval_stall_ms_max', 0.0), 3),
+                   'platform': platform})
+        except Exception as e:
+            _log('overlap phase failed: %r' % e)
+            for nm in (NAME_O_FEED, NAME_O_CK):
+                _emit({'metric': nm, 'skipped': True,
+                       'error': str(e)[:300]})
     elif phase == 'longseq':
         _transformer_metric(NAME_L, 8, 1024, t['iters'], t['use_amp'],
                             platform)

@@ -69,11 +69,24 @@ class CheckpointConfig(object):
     """reference trainer.py:100."""
 
     def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
-                 epoch_interval=1, step_interval=10, commit_timeout=60.0):
+                 epoch_interval=1, step_interval=10, commit_timeout=60.0,
+                 async_save=False):
         """commit_timeout: sharded-checkpoint commit wait (seconds) —
         how long process 0 waits for every peer's staged manifest before
         declaring the save uncommitted (docs/robustness.md#elastic).
-        Irrelevant to the dense npz format."""
+        Irrelevant to the dense npz format.
+
+        async_save: move the sharded-checkpoint file IO + commit protocol
+        off the step path onto a background writer thread
+        (utils.checkpoint.save_sharded_async). The step-boundary cost
+        shrinks to the buffer snapshot (device->host shard copies, taken
+        synchronously so the next step may donate the device buffers);
+        the atomic staging + manifest-last + commit-rename protocol is
+        unchanged, so a SIGKILL mid-async-save still never leaves a
+        latest-looking torn serial. Emergency / preemption / host-loss
+        flushes first drain the in-flight writer, then save
+        SYNCHRONOUSLY — they commit (or stage loudly) before exit.
+        Sharded-format only; the dense npz path ignores it."""
         assert epoch_interval >= 1
         assert step_interval >= 1
         self.checkpoint_dir = (checkpoint_dir if checkpoint_dir is not None
@@ -82,6 +95,7 @@ class CheckpointConfig(object):
         self.epoch_interval = epoch_interval
         self.step_interval = step_interval
         self.commit_timeout = float(commit_timeout)
+        self.async_save = bool(async_save)
         self.epoch_id = 0
         self.step_id = 0
         self.load_serial = None
@@ -121,7 +135,7 @@ class Trainer(object):
     def __init__(self, train_func, optimizer_func, param_path=None,
                  place=None, parallel=False, checkpoint_config=None,
                  transpiler_fn=None, bundle_steps=1, sync='auto',
-                 async_window=2, heartbeat=None):
+                 async_window=2, heartbeat=None, double_buffer=False):
         """transpiler_fn(train_program): optional hook applied after
         minimize — the high-level entry for the Program transpilers, e.g.
         lambda p: fluid.TensorParallelTranspiler(tp=2).transpile(p)
@@ -141,7 +155,16 @@ class Trainer(object):
           FetchHandles and keeps up to `async_window` steps in flight:
           the loss is only synced when the event handler reads it (or
           when the window evicts its oldest step), overlapping host
-          bookkeeping with device execution."""
+          bookkeeping with device execution.
+          double_buffer=True moves the INPUT side off the critical path
+          (docs/perf.md#overlap): a background prefetch thread
+          (reader.pipeline.prefetch) runs the DataFeeder assembly — and,
+          for plain single-device programs, the host->device transfer —
+          of batch N+1 while step N executes, so the loop's per-step
+          input wait (`trainer.input_stage` spans, the obs_report
+          overlap ratio) reads ~0 in steady state. Values are
+          bit-identical to the synchronous path: staging changes WHERE
+          the feed work happens, never what is fed."""
         if bundle_steps < 1:
             raise ValueError('bundle_steps must be >= 1, got %r'
                              % (bundle_steps,))
@@ -164,6 +187,16 @@ class Trainer(object):
         self.bundle_steps = int(bundle_steps)
         self.sync = sync
         self.async_window = max(1, int(async_window))
+        self.double_buffer = bool(double_buffer)
+        # input-overlap accounting (docs/perf.md#overlap): total seconds
+        # the train loop actually WAITED for its next fed batch, and the
+        # batches counted — bench.py's overlap phase reads these
+        self.input_stage_s = 0.0
+        self.batches_fed = 0
+        # in-flight async sharded checkpoint (CheckpointConfig
+        # async_save=True): at most ONE writer outstanding; every new
+        # save, emergency flush, or cleanup drains it first
+        self._async_ckpt = None
         self.__stop = False
         # preemption (SIGTERM/SIGINT while train() runs): the handler only
         # sets _preempt_requested; the loop finishes the in-flight step,
@@ -365,7 +398,7 @@ class Trainer(object):
         return True
 
     def _save_sharded(self, epoch_id, step_id, preempted=False,
-                      commit_timeout=None):
+                      commit_timeout=None, sync=None):
         """The annotated-program save path: Executor.state_dict walks
         the mesh-placed persistables (a vocab-sharded table stays 8
         device shards — never gathered dense) and save_sharded streams
@@ -373,26 +406,79 @@ class Trainer(object):
         so a SIGKILL can never leave a latest-looking torn serial. The
         extra meta records the reader position (epoch, step-within-
         epoch) and the mesh shape, for exact-step topology-aware
-        resume."""
+        resume.
+
+        sync=None follows CheckpointConfig.async_save; emergency paths
+        pass sync=True. The async path (docs/perf.md#overlap) pays only
+        the buffer snapshot at the step boundary — file IO and the
+        commit protocol run on save_sharded_async's writer thread; the
+        previous save's handle is drained first, so writers to one dir
+        never overlap."""
         from ..utils import checkpoint as shck
         cfg = self.checkpoint_cfg
+        if sync is None:
+            sync = not getattr(cfg, 'async_save', False)
         args = {'epoch_id': epoch_id, 'step_id': step_id}
         if preempted:
             args['preempted'] = True
+        ct = cfg.commit_timeout if commit_timeout is None else commit_timeout
+        dest = os.path.join(cfg.checkpoint_dir, 'sharded_%d' % self._serial)
+        meta = {'trainer_args': args, 'trainer_id': self.trainer_id,
+                'mesh_axes': self._mesh_axes_list()}
+        if not sync:
+            # drain the previous writer BEFORE state_dict: ~0 wait in
+            # steady state (the write finished steps ago), and it keeps
+            # exactly one writer per checkpoint dir
+            self._wait_async_ckpt()
         with self._prog_and_scope_guard():
             state = self.exe.state_dict(self.train_program,
                                         scope=self.scope)
-            path = shck.save_sharded(
-                os.path.join(cfg.checkpoint_dir,
-                             'sharded_%d' % self._serial),
-                state, step=self._serial,
-                extra_meta={'trainer_args': args,
-                            'trainer_id': self.trainer_id,
-                            'mesh_axes': self._mesh_axes_list()},
-                commit_timeout=(cfg.commit_timeout if commit_timeout
-                                is None else commit_timeout))
+            if sync:
+                path = shck.save_sharded(dest, state, step=self._serial,
+                                         extra_meta=meta,
+                                         commit_timeout=ct)
+            else:
+                self._async_ckpt = shck.save_sharded_async(
+                    dest, state, step=self._serial, extra_meta=meta,
+                    commit_timeout=ct)
+                return dest
         self._prune_sharded(cfg)
         return path
+
+    def _wait_async_ckpt(self, final=False):
+        """Drain the in-flight async sharded save (no-op when none).
+        Steady state this wait is ~0 — the writer finished during the
+        intervening steps; the span records whatever it actually was.
+        A CommitTimeout or IO failure here is the PERIODIC-save posture
+        (a missed checkpoint, not a dead run): warn loudly, keep
+        training on the previous committed serial."""
+        h = self._async_ckpt
+        if h is None:
+            return
+        self._async_ckpt = None
+        import warnings
+        from ..utils.checkpoint import CommitTimeout
+        with obs.span('trainer.checkpoint.async_wait',
+                      ready=h.done(), final=final):
+            try:
+                h.wait()
+            except CommitTimeout as e:
+                warnings.warn(
+                    'async sharded checkpoint did not commit (%s); '
+                    'training continues on the previous committed '
+                    'serial' % e, RuntimeWarning)
+                return
+            except Exception as e:
+                obs.counter('trainer.async_ckpt.failures').inc()
+                obs.event('trainer.async_ckpt.failure',
+                          error='%s: %s' % (type(e).__name__, e))
+                warnings.warn(
+                    'async sharded checkpoint FAILED in the background '
+                    '(%s: %s) — the serial is missing or partial; '
+                    'training continues on the previous committed '
+                    'serial' % (type(e).__name__, e), RuntimeWarning)
+                return
+        self._prune_sharded(self.checkpoint_cfg)
 
     def _prune_sharded(self, cfg):
         """Keep max_num_checkpoints committed sharded serials (process 0
@@ -475,9 +561,14 @@ class Trainer(object):
                       serial=self._serial, epoch=epoch_id,
                       step=step_id, sharded=self._use_sharded_ckpt()):
             if self._use_sharded_ckpt():
+                # drain any in-flight async writer, then flush
+                # SYNCHRONOUSLY: the process is about to exit, and the
+                # flush must commit (or stage loudly) before it does
+                self._wait_async_ckpt(final=True)
                 return self._save_sharded(epoch_id, step_id,
                                           preempted=True,
-                                          commit_timeout=commit_timeout)
+                                          commit_timeout=commit_timeout,
+                                          sync=True)
             with self._prog_and_scope_guard():
                 return io.save_checkpoint(
                     self.exe, cfg.checkpoint_dir,
@@ -564,7 +655,10 @@ class Trainer(object):
         # Remove only the serial subdirs we created (dense checkpoint_<n>,
         # sharded sharded_<n> + their .tmp staging leftovers) — the
         # configured dir may be (and defaults to) the user's cwd.
+        # An in-flight async writer must finish first: deleting dirs out
+        # from under it would race the commit rename.
         import shutil
+        self._wait_async_ckpt(final=True)
         d = self.checkpoint_cfg.checkpoint_dir
         if not os.path.isdir(d):
             return
@@ -658,6 +752,10 @@ class Trainer(object):
                     self._train_loop(self.exe, num_epochs, event_handler,
                                      reader, feed_order)
         finally:
+            # train() returning means every checkpoint it started is
+            # committed (or loudly failed) — an async writer must never
+            # outlive the loop that owns its scope arrays
+            self._wait_async_ckpt(final=True)
             if started_hb:
                 self.heartbeat.stop()
 
@@ -729,6 +827,85 @@ class Trainer(object):
                 if isinstance(h, FetchHandle):
                     h.block()
 
+    def _iter_staged(self, reader, feeder, skip_until=-1):
+        """Yield (step_id, fed_batch) for one epoch's reader pass.
+
+        double_buffer=False: the DataFeeder assembly runs inline (the
+        historical behavior), timed as a `trainer.input_stage` span so
+        the on/off A/B is measurable from one run log.
+
+        double_buffer=True (docs/perf.md#overlap): assembly — and the
+        host->device transfer for plain single-device programs — runs on
+        a reader.pipeline.prefetch worker thread, staging batch N+1
+        while step N executes. The span then measures only the time the
+        loop actually BLOCKED on the queue: ~0 in the overlapped steady
+        state (the obs_report step-artifact section computes the overlap
+        ratio from input_stage vs trainer.step time). Bundled loops keep
+        host ndarrays so run_bundle's single-stack device transfer stays
+        on its fast path; mesh programs keep placement in _prepare.
+
+        skip_until: last step id already completed before a crash
+        (resume fast-forward) — those reader items are consumed and
+        yielded as (step_id, None) WITHOUT feed assembly or
+        input_stage accounting, so catching up past N done steps stays
+        as cheap as it was before staging existed."""
+        import time as _time
+
+        def record(step_id, dt, staged):
+            obs.span_record('trainer.input_stage', dt, step=step_id,
+                            staged=staged)
+            self.input_stage_s += dt
+            self.batches_fed += 1
+
+        if not self.double_buffer:
+            def plain():
+                for step_id, data in enumerate(reader()):
+                    if step_id <= skip_until:
+                        yield step_id, None
+                        continue
+                    t0 = _time.perf_counter()
+                    fed = feeder.feed(data)
+                    record(step_id, _time.perf_counter() - t0, False)
+                    yield step_id, fed
+            return plain()
+
+        from ..reader import pipeline as rpipe
+        exe, prog = self.exe, self.train_program
+        place_in_worker = (not self.parallel and self.bundle_steps == 1
+                           and getattr(prog, '_dist_config', None) is None
+                           and getattr(prog, '_mesh_axes', None) is None)
+
+        def tagged():
+            return enumerate(reader())
+
+        def stage(pair):
+            step_id, data = pair
+            if step_id <= skip_until:
+                return step_id, None
+            fed = feeder.feed(data)
+            if place_in_worker:
+                fed = exe._place_feed(prog, fed, None)
+            return step_id, fed
+
+        staged = rpipe.prefetch(tagged, depth=2, transform=stage)
+
+        def overlapped():
+            it = staged()
+            try:
+                while True:
+                    t0 = _time.perf_counter()
+                    try:
+                        step_id, fed = next(it)
+                    except StopIteration:
+                        return
+                    if fed is not None:
+                        record(step_id, _time.perf_counter() - t0, True)
+                    yield step_id, fed
+            finally:
+                it.close()   # unblock the prefetch worker on early exit
+
+        return overlapped()
+
     def _train_loop(self, exe, num_epochs, event_handler, reader, feed_order):
         with self._prog_and_scope_guard():
             feed_vars = build_feed_var_list(self.train_program, feed_order)
@@ -751,7 +928,10 @@ class Trainer(object):
             last_done = None
             for epoch_id in range(start_epoch, num_epochs):
                 event_handler(BeginEpochEvent(epoch_id))
-                for step_id, data in enumerate(reader()):
+                skip = (cfg.step_id if cfg and cfg.load_serial
+                        and epoch_id == cfg.epoch_id else -1)
+                for step_id, fed in self._iter_staged(reader, feeder,
+                                                      skip_until=skip):
                     if self.__stop:
                         self._drain_async_window(window)
                         if cfg:
@@ -768,9 +948,7 @@ class Trainer(object):
                     # host-failure gate: BEFORE dispatching another step
                     # whose collectives would hang on a dead peer
                     self._check_host_loss(last_done, window)
-                    if (cfg and cfg.load_serial
-                            and epoch_id == cfg.epoch_id
-                            and step_id <= cfg.step_id):
+                    if fed is None:
                         continue  # already done before the crash
                     begin = BeginStepEvent(epoch_id, step_id)
                     event_handler(begin)
@@ -784,15 +962,15 @@ class Trainer(object):
                                   step_num=self._steps_run,
                                   epoch=epoch_id, step=step_id):
                         if is_pe:
-                            metrics = exe.run(want, feed=feeder.feed(data))
+                            metrics = exe.run(want, feed=fed)
                         elif use_async:
                             metrics = exe.run(program=self.train_program,
-                                              feed=feeder.feed(data),
+                                              feed=fed,
                                               fetch_list=want,
                                               sync='async')
                         else:
                             metrics = exe.run(program=self.train_program,
-                                              feed=feeder.feed(data),
+                                              feed=fed,
                                               fetch_list=want)
                     last_done = (epoch_id, step_id)
                     if use_async:
@@ -882,7 +1060,10 @@ class Trainer(object):
             event_handler(BeginEpochEvent(epoch_id))
             buf = []   # (step_id, feed_dict, want) awaiting one dispatch
             buf_sig = None
-            for step_id, data in enumerate(reader()):
+            skip = (cfg.step_id if cfg and cfg.load_serial
+                    and epoch_id == cfg.epoch_id else -1)
+            for step_id, fed in self._iter_staged(reader, feeder,
+                                                  skip_until=skip):
                 if self.__stop:
                     done = run_bundle_buf(buf, epoch_id)
                     last_done = done or last_done
@@ -898,13 +1079,10 @@ class Trainer(object):
                 # through the mesh first (its peers are gone) — the
                 # emergency path records the last COMPLETED bundle
                 self._check_host_loss(last_done)
-                if (cfg and cfg.load_serial
-                        and epoch_id == cfg.epoch_id
-                        and step_id <= cfg.step_id):
+                if fed is None:
                     continue  # already done before the crash
                 begin = BeginStepEvent(epoch_id, step_id)
                 event_handler(begin)
-                fed = feeder.feed(data)
                 sig = self._bundle_feed_sig(fed)
                 if buf and sig != buf_sig:
                     # batch shape changed mid-stream (classically: the

@@ -234,6 +234,96 @@ def summarize(events):
                      'device' % (len(stalls), _fmt_s(sum(sdur)),
                                  _fmt_s(percentile_exact(sdur, 95))))
 
+    # -- step artifact ---------------------------------------------------
+    # the compiled-step artifact + pipeline-overlap story (docs/perf.md):
+    # one executor.artifact event per artifact build, one first-call
+    # record per compiled signature (executor.compile span = online
+    # compile; executor.compile.persistent_hit / .aot_hit events =
+    # deserialized), trainer.input_stage spans for the input-overlap
+    # ratio, and checkpoint.snapshot / checkpoint.commit /
+    # trainer.checkpoint.async_wait spans for the async-checkpoint
+    # latencies.
+    artifacts = _events(events, 'executor.artifact')
+    aot_hits = _events(events, 'executor.compile.aot_hit')
+    aot_stale = _events(events, 'executor.aot.stale')
+    aot_loaded = _events(events, 'executor.aot.loaded')
+    aot_exported = _events(events, 'executor.aot.exported')
+    input_stage = _spans(events, 'trainer.input_stage')
+    snaps = _spans(events, 'checkpoint.snapshot')
+    awaits = _spans(events, 'trainer.checkpoint.async_wait')
+    if artifacts or aot_hits or aot_loaded or aot_exported or input_stage \
+            or snaps or awaits:
+        lines.append('')
+        lines.append('-- step artifact --')
+        if artifacts:
+            # per-artifact signature count: every first-call record
+            # (compile span OR persistent/aot-hit event) under the
+            # artifact's cache key is one compiled entry point (the
+            # unbundled step, each bundle length)
+            sig_per_key = {}
+            for rec in (compiles + phits + aot_hits):
+                k = rec.get('fields', {}).get('key', '?')
+                sig_per_key[k] = sig_per_key.get(k, 0) + 1
+            per_art = [sig_per_key.get(
+                e.get('fields', {}).get('key', '?'), 0)
+                for e in artifacts]
+            lines.append('%d artifact(s) built; signatures per artifact: '
+                         '%s (total %d)'
+                         % (len(artifacts),
+                            '/'.join(str(n) for n in per_art) or '0',
+                            sum(sig_per_key.values())))
+        split = ('first calls: %d compiled online, %d persistent-hit, '
+                 '%d AOT-hit' % (len(compiles), len(phits),
+                                 len(aot_hits)))
+        if aot_stale:
+            split += ', %d STALE (AOT-claimed but compiled)' \
+                % len(aot_stale)
+        lines.append(split)
+        for e in aot_loaded:
+            f = e.get('fields', {})
+            lines.append('AOT blob loaded: %s signature(s), %s cache '
+                         'entr(ies) imported'
+                         % (f.get('signatures', '?'),
+                            f.get('cache_entries_imported', '?')))
+        for e in aot_exported:
+            f = e.get('fields', {})
+            lines.append('AOT blob exported: %s signature(s), %s cache '
+                         'entr(ies)' % (f.get('signatures', '?'),
+                                        f.get('cache_entries', '?')))
+        if input_stage:
+            wait_s = sum(s['dur_s'] for s in input_stage)
+            staged = sum(1 for s in input_stage
+                         if s.get('fields', {}).get('staged'))
+            step_s = sum(s['dur_s'] for s in
+                         _spans(events, 'trainer.step'))
+            line = ('input stage: %s over %d batch(es) (%d staged '
+                    'off-thread)' % (_fmt_s(wait_s), len(input_stage),
+                                     staged))
+            if step_s > 0:
+                line += (' — overlap ratio %.1f%% '
+                         '(1 - input wait / step time)'
+                         % (100.0 * (1.0 - min(1.0, wait_s / step_s))))
+            lines.append(line)
+        if snaps:
+            sd = [s['dur_s'] for s in snaps]
+            lines.append('async checkpoint snapshots: %d (p50 %s  max %s)'
+                         % (len(snaps),
+                            _fmt_s(percentile_exact(sd, 50)),
+                            _fmt_s(max(sd))))
+        commits = _spans(events, 'checkpoint.commit')
+        if snaps and commits:
+            cd = [s['dur_s'] for s in commits]
+            lines.append('commit latency: p50 %s  max %s (%d commit '
+                         'span(s))' % (_fmt_s(percentile_exact(cd, 50)),
+                                       _fmt_s(max(cd)), len(commits)))
+        if awaits:
+            ad = [s['dur_s'] for s in awaits]
+            stalls_n = sum(1 for s in awaits
+                           if not s.get('fields', {}).get('ready'))
+            lines.append('async-save waits at step boundary: %d (total '
+                         '%s, %d not yet done when waited)'
+                         % (len(awaits), _fmt_s(sum(ad)), stalls_n))
+
     # -- optimizer passes ------------------------------------------------
     # passes.optimize spans carry ops_before/ops_after + per-pass sums
     # (docs/passes.md): the attribution trail for op-count wins

@@ -486,7 +486,17 @@ def save_sharded_async(ckpt_dir, arrays, step=0, extra_meta=None,
         _INFLIGHT_DIRS.add(key)
 
     try:
-        manifest, writes = _collect_shards(arrays, step, extra_meta)
+        # the buffer snapshot IS the caller's whole step-boundary cost on
+        # the async path (docs/perf.md#overlap): device->host copies of
+        # every addressable shard, taken synchronously so the next step
+        # may donate the device buffers. The span is what obs_report's
+        # step-artifact section reports as snapshot latency.
+        with obs.span('checkpoint.snapshot', step=step,
+                      dir=os.path.basename(ckpt_dir),
+                      arrays=len(arrays)) as snap_sp:
+            manifest, writes = _collect_shards(arrays, step, extra_meta)
+            snap_sp.fields['bytes'] = int(
+                sum(w[1].nbytes for w in writes))
         pool = ThreadPoolExecutor(max_workers=1,
                                   thread_name_prefix='paddle-tpu-async-ckpt')
         future = pool.submit(_write_all, ckpt_dir, manifest, writes,

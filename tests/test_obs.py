@@ -277,6 +277,9 @@ def test_cache_stats_and_table_header():
         exe = fluid.Executor(fluid.CPUPlace())
         assert exe.cache_stats == {'hits': 0, 'misses': 0, 'entries': 0,
                                    'evictions': 0, 'persistent_hits': 0,
+                                   'online_compiles': 0,
+                                   'aot_hits': 0, 'aot_stale': 0,
+                                   'aot_signatures': None,
                                    'compile_cache_dir': None,
                                    'last_compile_seconds': None,
                                    'remat_detected': 0}

@@ -135,8 +135,13 @@ for name in sorted(set(new) & set(prev)):
     # LOWER-is-better: a p99/footprint that dropped is an improvement;
     # a rise is the regression. Throughput metrics (steps/sec,
     # tokens_per_sec, speedup) keep the higher-is-better rule.
+    # the overlap/AOT family (PR 12) adds host-stall seconds totals and
+    # online-compile counts — both lower-is-better like the latencies
+    # (the input-wait metric already ends in _ms and rides that rule)
     lower_is_better = (name.endswith('_ms') or name.endswith('.dropped')
-                       or name.endswith('_temp_bytes'))
+                       or name.endswith('_temp_bytes')
+                       or name.endswith('_stall_s')
+                       or name.endswith('_compiles'))
     if lower_is_better:
         if ratio > 1.1:
             flag = '  <-- WARNING: >10%% regression (rise) vs %s' \

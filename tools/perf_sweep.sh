@@ -121,6 +121,17 @@ if [ "${BUNDLE:-0}" != 0 ]; then
   done
 fi
 
+# 6b. pipeline-overlap A/B (opt-in: OVERLAP=1): double-buffered feeds
+#     on/off (steps/sec + per-step input wait + host-stall totals) and
+#     checkpoint-cadence off/sync/async (per-interval step-boundary
+#     stall: sync pays file IO + commit inline, async only the buffer
+#     snapshot) through the overlap bench phase. Host-side wins, so it
+#     runs regardless of platform — records are stamped platform-honest
+#     like every bench.metric (docs/perf.md#overlap).
+if [ "${OVERLAP:-0}" != 0 ]; then
+  run python bench.py --phase overlap --platform "${BENCH_PLATFORM:-tpu}"
+fi
+
 # 7. persistent compile-cache sweep (opt-in: CACHE_SWEEP=1): a cold run
 #    into a FRESH cache dir, then a SECOND PROCESS over the same dir.
 #    The second run's log must show zero executor.compile spans for the
@@ -188,6 +199,15 @@ if [ "${SERVE:-0}" = 1 ]; then
       --requests 512 --check-compiles
   run python tools/serve_bench.py --model mnist --mode open --qps 200 \
       --duration 3 --check-compiles
+fi
+
+# 9b. AOT cold-replica warmup (opt-in: AOT=1): process A warms the
+#     serving signature set and exports it as a step-artifact AOT blob;
+#     a COLD process B imports the blob before its own warmup — time to
+#     first response with ZERO online compiles (serve.aot.* records;
+#     --check-compiles fails the leg if the cold replica compiled).
+if [ "${AOT:-0}" = 1 ]; then
+  run python tools/serve_bench.py --workload aot-cold --check-compiles
 fi
 
 # 10. continuous-batching decode vs whole-batch lockstep beam decode

@@ -84,6 +84,15 @@ def main(argv=None):
                          '(utils.checkpoint.restorable — shard '
                          'coverage, axis fit, dim tiling) before any '
                          'device is touched; problems exit 1')
+    ap.add_argument('--aot', default=None, metavar='AOT_DIR',
+                    help='statically lint an exported step-artifact AOT '
+                         'blob (Executor.export_warm_signatures) against '
+                         'this program artifact: does any exported '
+                         'signature match the program, do the recorded '
+                         'feed shapes/dtypes still exist, does the '
+                         'donation plan agree — a stale blob is a typed '
+                         'finding here instead of a silent online '
+                         'recompile at serving warmup (exit 1)')
     ap.add_argument('--strict', action='store_true',
                     help='exit 1 on warnings too, not just errors')
     ap.add_argument('--optimize', nargs='?', const='default',
@@ -130,6 +139,16 @@ def main(argv=None):
     from paddle_tpu.fluid import analysis
     feeds = meta.get('feed_names') or None
     fetches = args.fetch or meta.get('fetch_names') or None
+
+    aot_problems = None
+    if args.aot:
+        from paddle_tpu.fluid import step_artifact
+        try:
+            aot_problems = step_artifact.aot_check(args.aot, program)
+        except Exception as e:
+            print('program_lint: cannot read AOT blob %r: %s: %s'
+                  % (args.aot, type(e).__name__, e), file=sys.stderr)
+            return 2
     stats = {}
     findings = analysis.analyze(program, feeds=feeds, fetches=fetches,
                                 concurrent=args.concurrent, stats=stats,
@@ -155,7 +174,7 @@ def main(argv=None):
         # ONE parseable document: a bare findings array (the historical
         # shape) unless --optimize/--mesh add their context, in which
         # case everything rides one object
-        if opt_payload is None and mesh_axes is None:
+        if opt_payload is None and mesh_axes is None and aot_problems is None:
             print(json.dumps([f.to_dict() for f in findings], indent=2))
         else:
             doc = {'findings': [f.to_dict() for f in findings]}
@@ -169,6 +188,10 @@ def main(argv=None):
                 doc['checkpoint'] = {'dir': args.checkpoint,
                                      'restorable': not ckpt_problems,
                                      'problems': ckpt_problems}
+            if aot_problems is not None:
+                doc['aot'] = {'dir': args.aot,
+                              'warm': not aot_problems,
+                              'problems': aot_problems}
             print(json.dumps(doc, indent=2))
     else:
         nops = sum(len(b.ops) for b in program.blocks)
@@ -185,6 +208,16 @@ def main(argv=None):
                 print('checkpoint %s: NOT cleanly restorable onto this '
                       'mesh:' % args.checkpoint)
                 for p in ckpt_problems:
+                    print('  %s' % p)
+        if aot_problems is not None:
+            if not aot_problems:
+                print('aot %s: signature set matches this program '
+                      '(a replica loading it warms without online '
+                      'compiles)' % args.aot)
+            else:
+                print('aot %s: STALE — first calls would silently '
+                      'recompile online:' % args.aot)
+                for p in aot_problems:
                     print('  %s' % p)
         print('shape pass: %(inferred)d inferred, %(skipped)d skipped, '
               '%(failed)d failed, %(no_rule)d without rules' % stats)
@@ -211,6 +244,8 @@ def main(argv=None):
     bad = len(findings) if args.strict else errors
     if ckpt_problems:
         bad += len(ckpt_problems)
+    if aot_problems:
+        bad += len(aot_problems)
     return 1 if bad else 0
 
 

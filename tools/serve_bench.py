@@ -692,6 +692,113 @@ def run_decode(args):
     return rc
 
 
+# ---------------------------------------------------------------------------
+# aot-cold workload: cold-replica time-to-first-response with and without
+# an imported AOT warm-signature blob (docs/perf.md#aot)
+# ---------------------------------------------------------------------------
+
+_AOT_CHILD = r"""
+import json, os, sys, time
+sys.path.insert(0, os.environ['PADDLE_TPU_REPO'])
+import numpy as np
+
+mode, model_dir, aot_dir, bucket = (sys.argv[1], sys.argv[2], sys.argv[3],
+                                    int(sys.argv[4]))
+from paddle_tpu import inference, serving
+
+# the replica clock starts at model load: python/jax import time is
+# common to both legs, the warmup compiles are what AOT removes
+t0 = time.perf_counter()
+pred = inference.Predictor(model_dir)
+exe = pred._exe
+if mode == 'import':
+    exe.load_warm_signatures(aot_dir)
+eng = serving.ServingEngine(
+    pred, serving.ServingConfig(max_batch_size=bucket, buckets=[bucket]))
+eng.warmup()
+spec = pred.input_spec
+feed = {n: np.zeros((1,) + tuple(int(d) for d in s[0][1:]),
+                    dtype=np.dtype(s[1])) for n, s in spec.items()}
+eng.predict(feed)
+t_first = time.perf_counter() - t0
+if mode == 'export':
+    exe.export_warm_signatures(aot_dir)
+eng.shutdown()
+stats = {k: v for k, v in exe.cache_stats.items()
+         if k != 'compile_cache_dir'}
+stats['first_response_s'] = t_first
+print('AOT_STATS=' + json.dumps(stats))
+"""
+
+
+def run_aot_cold(args):
+    """Cold-replica AOT drill: process A cold-compiles the serving
+    warmup signature set (with the persistent cache wired) and exports
+    the step-artifact AOT blob; process B — a genuinely cold replica
+    with NO pre-wired compile cache — imports the blob before warmup.
+    Metrics: time-to-first-response per leg, the cold replica's
+    online-compile count (the zero-compile contract) and its AOT-hit
+    count."""
+    import shutil
+    import subprocess
+
+    save_dir = tempfile.mkdtemp(prefix='serve_bench_aot_')
+    feed_name, example = build_model(args.model, save_dir)
+    aot_dir = os.path.join(save_dir, 'aot')
+    cache_dir = os.path.join(save_dir, 'cc')
+    bucket = int(args.max_batch)
+    _emit({'metric': 'serve.aot.workload', 'value': args.model,
+           'bucket': bucket})
+
+    def child(mode, wire_cache):
+        env = dict(os.environ, PADDLE_TPU_REPO=_REPO)
+        env.pop('PADDLE_TPU_OBS_RUN_FILE', None)
+        if wire_cache:
+            env['PADDLE_TPU_COMPILE_CACHE'] = cache_dir
+        else:
+            # the cold replica brings NO cache of its own:
+            # load_warm_signatures wires a fresh one seeded from the blob
+            env.pop('PADDLE_TPU_COMPILE_CACHE', None)
+        r = subprocess.run(
+            [sys.executable, '-c', _AOT_CHILD, mode, save_dir, aot_dir,
+             str(bucket)],
+            capture_output=True, text=True, timeout=900, env=env)
+        if r.returncode != 0:
+            raise RuntimeError('aot-cold %s leg failed:\n%s'
+                               % (mode, r.stderr[-2000:]))
+        line = [ln for ln in r.stdout.splitlines()
+                if ln.startswith('AOT_STATS=')]
+        return json.loads(line[0][len('AOT_STATS='):])
+
+    try:
+        base = child('export', wire_cache=True)
+        cold = child('import', wire_cache=False)
+    finally:
+        shutil.rmtree(save_dir, ignore_errors=True)
+
+    _emit({'metric': 'serve.aot.baseline_first_response_ms',
+           'value': round(1e3 * base['first_response_s'], 1),
+           'unit': 'ms', 'online_compiles': base['online_compiles']})
+    _emit({'metric': 'serve.aot.cold_first_response_ms',
+           'value': round(1e3 * cold['first_response_s'], 1),
+           'unit': 'ms',
+           'speedup_vs_cold_compile': round(
+               base['first_response_s']
+               / max(cold['first_response_s'], 1e-9), 3)})
+    _emit({'metric': 'serve.aot.hits', 'value': cold['aot_hits']})
+    _emit({'metric': 'serve.aot.online_compiles',
+           'value': cold['online_compiles']})
+    if cold.get('aot_stale'):
+        _emit({'metric': 'serve.aot.stale_signatures',
+               'value': cold['aot_stale']})
+    if args.check_compiles and cold['online_compiles']:
+        print('serve_bench: the AOT-warmed cold replica still compiled '
+              '%d signature(s) online — the blob is stale or incomplete'
+              % cold['online_compiles'], file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog='serve_bench',
                                  description=__doc__.splitlines()[0])
@@ -716,7 +823,7 @@ def main(argv=None):
                     help='exit 1 if the steady-state phase compiled')
     ap.add_argument('--workload',
                     choices=('infer', 'decode', 'decode-paged',
-                             'decode-spec'),
+                             'decode-spec', 'aot-cold'),
                     default='infer',
                     help='infer: single-shot requests through the '
                          'ServingEngine; decode: autoregressive beam '
@@ -788,6 +895,8 @@ def main(argv=None):
             setattr(args, k, v)
 
     _resolve_platform()
+    if args.workload == 'aot-cold':
+        return run_aot_cold(args)
     if args.workload == 'decode':
         return run_decode(args)
     if args.workload == 'decode-paged':
