@@ -747,6 +747,154 @@ def bench_embedding(vocab=None, embed_dim=None, num_fields=8, batch=256,
             sparse['rows_touched'] or 0, {'model': ndev}, vocab, batch)
 
 
+def bench_streaming(capacity=None, embed_dim=None, fields=4, batch=64,
+                    steps=None, publish_every=5):
+    """Streaming-ids online-training phase (docs/embedding.md
+    "streaming ids"): an unbounded click stream with DRIFTING raw ids
+    trains a row-sharded table online (VocabTable admission/eviction in
+    front of the sharded-sparse wire), while a DeltaPublisher pushes
+    touched-row deltas into a LIVE Predictor-backed serving replica
+    through Router.push_deltas. Measures the loop end to end:
+
+      steps/sec of the online loop (translation + training + cadence),
+      rows admitted/evicted over the run (the drift the table absorbed),
+      delta-push latency, and the measured freshness lag (now - oldest
+      unpushed touch at each push — the staleness a scoring request
+      could have observed).
+
+    The serving replica is built ONCE from the startup-initialized
+    params; every later refresh arrives as row deltas — the whole point
+    of the phase. A final scoring probe asserts a freshly-admitted id's
+    pushed rows actually changed the replica's answer, and steady-state
+    train compiles are asserted zero via cache_stats."""
+    import tempfile
+
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework, unique_name
+    from paddle_tpu.fluid.executor import Scope, scope_guard
+    from paddle_tpu.fluid.trainer import Trainer, CheckpointConfig
+    from paddle_tpu.embedding import pad_vocab
+    from paddle_tpu.streaming import DeltaPublisher, VocabTable
+    from paddle_tpu.inference import Predictor
+    from paddle_tpu.serving import ServingConfig, ServingEngine
+    from paddle_tpu.serving.router import Router
+
+    ndev = len(jax.devices())
+    if capacity is None:
+        capacity = int(os.environ.get('BENCH_STREAM_CAPACITY', '512'))
+    if embed_dim is None:
+        embed_dim = int(os.environ.get('BENCH_STREAM_DIM', '8'))
+    if steps is None:
+        steps = int(os.environ.get('BENCH_STREAM_STEPS', '60'))
+    capacity = pad_vocab(capacity, ndev)
+
+    def net(sharded):
+        ids = fluid.layers.data(name='ids', shape=[fields, 1],
+                                dtype='int64')
+        label = fluid.layers.data(name='label', shape=[1],
+                                  dtype='float32')
+        pa = fluid.ParamAttr(
+            name='emb_w', sharding=('model', None) if sharded else None)
+        emb = fluid.layers.embedding(
+            ids, size=[capacity, embed_dim], is_sparse=True,
+            is_distributed=sharded, param_attr=pa)
+        pred = fluid.layers.fc(input=emb, size=1, num_flatten_dims=2,
+                               param_attr=fluid.ParamAttr(name='fc_w'))
+        score = fluid.layers.reduce_sum(pred, dim=1)
+        loss = fluid.layers.mean(fluid.layers.square(score - label))
+        return ids, label, score, loss
+
+    # the serving side: a PLAIN (unsharded) scorer with the SAME var
+    # names, exported once from startup state — freshness then arrives
+    # exclusively as row deltas
+    serve_dir = tempfile.mkdtemp(prefix='bench_stream_serve_')
+    smain, sstart = _fresh()
+    with unique_name.guard():
+        with framework.program_guard(smain, sstart):
+            _ids, _lbl, score, _loss = net(sharded=False)
+            ssc = Scope()
+            with scope_guard(ssc):
+                sexe = fluid.Executor()
+                sexe.run(sstart)
+                fluid.io.save_inference_model(
+                    serve_dir, ['ids'], [score], sexe, main_program=smain)
+    engine = ServingEngine(Predictor(serve_dir),
+                           ServingConfig(max_batch_size=8, buckets=[8]))
+    router = Router().add_model('recsys', [engine])
+
+    vt = VocabTable(capacity, table='emb_w', admit_count=2)
+    pub = DeltaPublisher(router, 'recsys', interval_steps=publish_every)
+
+    rng = np.random.RandomState(0)
+    universe = 1 << 30
+
+    def reader():
+        t = 0
+        while True:
+            # drifting window: each step samples ids around a moving
+            # base, so admission + eviction run continuously
+            base = (t * 17) % universe
+            ids = (base + rng.zipf(1.5, size=(batch, fields, 1))) \
+                % universe
+            label = rng.randn(batch, 1).astype('float32')
+            yield [(ids.astype('int64')[i], label[i])
+                   for i in range(batch)]
+            t += 1
+
+    def train_func():
+        _ids, _lbl, _score, loss = net(sharded=True)
+        return [loss]
+
+    trainer = Trainer(train_func,
+                      lambda: fluid.optimizer.Adam(learning_rate=1e-2),
+                      checkpoint_config=CheckpointConfig(
+                          checkpoint_dir=tempfile.mkdtemp(
+                              prefix='bench_stream_ck_'),
+                          step_interval=max(20, steps)))
+    trainer.train_program.set_mesh({'model': ndev})
+
+    # warm the signature (2 steps), then time the steady state
+    trainer.train_stream(reader, vocabs={'ids': vt}, publisher=pub,
+                         max_steps=2)
+    cs0 = trainer.exe.cache_stats
+    misses0 = cs0['misses']
+    t0 = time.time()
+    trainer.train_stream(reader, vocabs={'ids': vt}, publisher=pub,
+                         max_steps=steps)
+    dt = time.time() - t0
+    pub.publish(lambda name: trainer.scope._chain_get(name))
+    steady_compiles = trainer.exe.cache_stats['misses'] - misses0
+
+    # freshness probe: a resident (admitted) id's pushed rows must have
+    # changed the live replica's answer vs the cold-row baseline
+    resident = vt.resident_ids()
+    probe_raw = np.asarray((resident * fields)[:fields])
+    probe_rows = vt.lookup(probe_raw).reshape(1, fields, 1)
+    cold = np.full((1, fields, 1), vt.cold_row, np.int64)
+    hot_score = router.predict('recsys', {'ids': probe_rows})[0]
+    cold_score = router.predict('recsys', {'ids': cold})[0]
+    fresh_reflected = not np.allclose(np.asarray(hot_score),
+                                      np.asarray(cold_score))
+    router.shutdown()
+    stats = vt.stats()
+    return {
+        'steps_per_sec': steps / dt,
+        'rows_admitted': stats['rows_admitted'],
+        'rows_evicted': stats['rows_evicted'],
+        'cold_hits': stats['cold_hits'],
+        'resident': stats['resident'],
+        'pushes': pub.pushes,
+        'rows_pushed': pub.rows_pushed,
+        'push_ms': pub.last_push_ms,
+        'freshness_lag_s': pub.last_lag_s,
+        'fresh_reflected': bool(fresh_reflected),
+        'steady_compiles': int(steady_compiles),
+        'capacity': capacity, 'batch': batch, 'steps': steps,
+        'mesh': {'model': ndev},
+    }
+
+
 def bench_flash_longcontext(seq_len=32768, heads=8, dim=64, warmup=1,
                             iters=2):
     """Causal flash attention fwd+bwd at 32k context on ONE chip — the
@@ -829,6 +977,9 @@ NAME_E_DTEMP = 'deepfm_embed_dense_step_temp_bytes'
 NAME_E_STEMP = 'deepfm_embed_sharded_step_temp_bytes'
 NAME_O_FEED = 'fit_a_line_double_buffer_train_steps_per_sec'
 NAME_O_CK = 'fit_a_line_ckpt_async_train_steps_per_sec'
+NAME_S_SPS = 'streaming_online_train_steps_per_sec'
+NAME_S_LAG = 'streaming_freshness_lag_s'
+NAME_S_PUSH = 'streaming_delta_push_ms'
 PHASES = ('transformer', 'resnet', 'bundle', 'gspmd', 'embedding',
           'longseq', 'longctx')
 PHASE_NAMES = {'transformer': NAME_T, 'resnet': NAME_R, 'bundle': NAME_B,
@@ -879,7 +1030,7 @@ def run_phase(phase, platform):
     process — the parent's timeout fires, and later phases still run."""
     _PLATFORM[0] = platform
     _FALLBACK[0] = os.environ.get('BENCH_FALLBACK') == '1'
-    if phase in ('gspmd', 'embedding') and platform != 'tpu':
+    if phase in ('gspmd', 'embedding', 'streaming') and platform != 'tpu':
         # the 8-device CPU mesh (the same platform the MULTICHIP dryruns
         # and tests use), with per-device eigen threading off so each
         # virtual device approximates a fixed-capacity chip. Must land
@@ -1039,6 +1190,55 @@ def run_phase(phase, platform):
         except Exception as e:
             _log('%s failed: %r' % (NAME_E_SHARD, e))
             _emit({'metric': NAME_E_SHARD, 'skipped': True,
+                   'error': str(e)[:300]})
+    elif phase == 'streaming':
+        # streaming-ids online training (docs/embedding.md "streaming
+        # ids"): drift stream -> online sharded training -> row-delta
+        # push into a live replica. Host-side machinery throughout, so
+        # CPU numbers are VALID; every record carries platform + mesh
+        # per the PR 6 convention, and the lag/push metrics ride
+        # bench_sentinel's lower-is-better *_lag_s / *_ms rules.
+        try:
+            res = bench_streaming()
+            mesh = res['mesh']
+            common = {'platform': platform, 'mesh': mesh,
+                      'mesh_shape': 'x'.join(
+                          '%s=%d' % kv for kv in sorted(mesh.items())),
+                      'capacity': res['capacity'], 'batch': res['batch']}
+            _emit(dict({'metric': NAME_S_SPS,
+                        'value': round(res['steps_per_sec'], 2),
+                        'unit': 'steps/sec',
+                        'rows_admitted': res['rows_admitted'],
+                        'rows_evicted': res['rows_evicted'],
+                        'cold_hits': res['cold_hits'],
+                        'resident_rows': res['resident'],
+                        'steady_compiles': res['steady_compiles'],
+                        'fresh_id_reflected_in_serving':
+                            res['fresh_reflected'],
+                        'steps': res['steps']}, **common))
+            if res['freshness_lag_s'] is not None:
+                _emit(dict({'metric': NAME_S_LAG,
+                            'value': round(res['freshness_lag_s'], 4),
+                            'unit': 'seconds',
+                            'pushes': res['pushes'],
+                            'rows_pushed': res['rows_pushed']},
+                           **common))
+            if res['push_ms'] is not None:
+                _emit(dict({'metric': NAME_S_PUSH,
+                            'value': round(res['push_ms'], 3),
+                            'unit': 'ms',
+                            'rows_pushed': res['rows_pushed']},
+                           **common))
+            if res['steady_compiles']:
+                _log('*** streaming: %d steady-state compile(s) — the '
+                     'static-signature contract broke ***'
+                     % res['steady_compiles'])
+            if not res['fresh_reflected']:
+                _log('*** streaming: freshly-admitted id did NOT change '
+                     'the serving answer — delta push broken ***')
+        except Exception as e:
+            _log('streaming phase failed: %r' % e)
+            _emit({'metric': NAME_S_SPS, 'skipped': True,
                    'error': str(e)[:300]})
     elif phase == 'overlap':
         # pipeline-overlap contract metrics (docs/perf.md#overlap):

@@ -1582,6 +1582,23 @@ class Executor(object):
         return StepHandle(self, compiled, scope, program, persist,
                           look.get('key'))
 
+    def step_artifact(self, program=None, feed=None, fetch_list=None,
+                      scope=None):
+        """The cached StepArtifact for (program, feed-sig, fetch) —
+        resolved through the same _prepare pass run() uses (a cache HIT
+        after the first step, so calling this in a hot loop costs a
+        dict lookup). Public seam for consumers of artifact metadata
+        that must not rebuild it: the streaming delta publisher reads
+        `touched_rows`/`sparse_plan` here (docs/embedding.md
+        "streaming ids")."""
+        if program is None:
+            program = default_main_program()
+        if scope is None:
+            scope = global_scope()
+        compiled, _, _ = self._prepare(program, feed or {},
+                                       fetch_list or [], scope)
+        return compiled
+
     def _convert_fetch(self, v, fetch_f32, return_numpy, lazy):
         """One fetched value -> what run()/run_bundle() hand back: numpy /
         device array / LoDTensor, or a lazy FetchHandle over the same

@@ -808,6 +808,39 @@ class StepArtifact(object):
             pinned.append(n)
         return pinned
 
+    def touched_rows(self, feed):
+        """HOST-side touched-row derivation for one fed batch: which
+        rows of each sparse-plan table will the step's sparse update
+        actually write? The answer is already in the feed — every
+        eligible table's lookup ids are feed/persist vars
+        (_sparse_embedding_plan resolves them before the forward runs),
+        so the streaming delta publisher (paddle_tpu.streaming) reads
+        the touched set without fetching anything from the device or
+        changing the compiled step.
+
+        Returns {table name: sorted unique int64 row ids} for tables on
+        the sparse path whose ids are present in `feed` (padding_idx
+        rows excluded — the lookup rule zeroes their gradient). Tables
+        training DENSE (no sparse plan) are absent: their update writes
+        every row, and a row-delta push would under-report; the
+        publisher warns on that case."""
+        out = {}
+        for w, plan in self.sparse_plan.items():
+            parts = []
+            ok = True
+            for _op_idx, ids_name, pad in plan['lookups']:
+                v = feed.get(ids_name)
+                if v is None:
+                    ok = False
+                    break
+                ids = np.asarray(lowering.data_of(v)).reshape(-1)
+                if pad is not None and pad >= 0:
+                    ids = ids[ids != pad]
+                parts.append(ids.astype(np.int64))
+            if ok and parts:
+                out[w] = np.unique(np.concatenate(parts))
+        return out
+
     @property
     def state_names(self):
         """The persistable names this step reads/writes — the artifact's

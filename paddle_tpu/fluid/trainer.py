@@ -70,7 +70,7 @@ class CheckpointConfig(object):
 
     def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
                  epoch_interval=1, step_interval=10, commit_timeout=60.0,
-                 async_save=False):
+                 async_save=False, wallclock_interval_s=None):
         """commit_timeout: sharded-checkpoint commit wait (seconds) —
         how long process 0 waits for every peer's staged manifest before
         declaring the save uncommitted (docs/robustness.md#elastic).
@@ -86,7 +86,14 @@ class CheckpointConfig(object):
         latest-looking torn serial. Emergency / preemption / host-loss
         flushes first drain the in-flight writer, then save
         SYNCHRONOUSLY — they commit (or stage loudly) before exit.
-        Sharded-format only; the dense npz path ignores it."""
+        Sharded-format only; the dense npz path ignores it.
+
+        wallclock_interval_s: unbounded-stream cadence
+        (Trainer.train_stream): ALSO checkpoint whenever this many
+        seconds have passed since the last save, regardless of the step
+        interval — an online trainer consuming a slow stream must bound
+        recovery by wall clock, not step count. Epoch-based train()
+        ignores it."""
         assert epoch_interval >= 1
         assert step_interval >= 1
         self.checkpoint_dir = (checkpoint_dir if checkpoint_dir is not None
@@ -96,6 +103,9 @@ class CheckpointConfig(object):
         self.step_interval = step_interval
         self.commit_timeout = float(commit_timeout)
         self.async_save = bool(async_save)
+        self.wallclock_interval_s = (float(wallclock_interval_s)
+                                     if wallclock_interval_s is not None
+                                     else None)
         self.epoch_id = 0
         self.step_id = 0
         self.load_serial = None
@@ -213,6 +223,12 @@ class Trainer(object):
         # surviving topology. host_lost records what was detected.
         self.heartbeat = heartbeat
         self.host_lost = None
+        # streaming-ids state (train_stream, docs/embedding.md): the
+        # active {feed name: VocabTable} map serialized into every
+        # checkpoint's meta, and the vocab meta recovered from a resumed
+        # checkpoint (applied when train_stream() is handed its tables)
+        self._stream_vocabs = None
+        self._stream_resume_vocab = None
         self.parallel = parallel
         self.trainer_id = 0
         self.checkpoint_cfg = checkpoint_config
@@ -333,6 +349,7 @@ class Trainer(object):
             cfg.epoch_id = int(args.get('epoch_id', 0))
             cfg.step_id = int(args.get('step_id', 0))
             self._serial = int(meta.get('step', 0))
+            self._stream_resume_vocab = args.get('streaming_vocab')
             return
 
     @staticmethod
@@ -379,6 +396,7 @@ class Trainer(object):
             return False
         extra = meta.get('extra') or {}
         args = extra.get('trainer_args') or {}
+        self._stream_resume_vocab = extra.get('streaming_vocab')
         cfg.load_serial = int(meta.get('step', 0))
         cfg.epoch_id = int(args.get('epoch_id', 0))
         cfg.step_id = int(args.get('step_id', 0))
@@ -425,6 +443,9 @@ class Trainer(object):
         dest = os.path.join(cfg.checkpoint_dir, 'sharded_%d' % self._serial)
         meta = {'trainer_args': args, 'trainer_id': self.trainer_id,
                 'mesh_axes': self._mesh_axes_list()}
+        vocab_meta = self._vocab_meta()
+        if vocab_meta is not None:
+            meta['streaming_vocab'] = vocab_meta
         if not sync:
             # drain the previous writer BEFORE state_dict: ~0 wait in
             # steady state (the write finished steps ago), and it keeps
@@ -500,6 +521,26 @@ class Trainer(object):
             shutil.rmtree(shck._staging_dir(base), ignore_errors=True)
             shutil.rmtree(base + shck._OLD_SUFFIX, ignore_errors=True)
 
+    def _vocab_meta(self):
+        """JSON-able {feed name: VocabTable.state_dict()} of the active
+        streaming vocabs (None outside train_stream) — folded into
+        every checkpoint's meta so exact-step resume holds under vocab
+        drift: the restored map reproduces the id->row assignment the
+        restored table rows were trained under
+        (docs/embedding.md "streaming ids")."""
+        if not self._stream_vocabs:
+            return None
+        return {str(k): vt.state_dict()
+                for k, vt in self._stream_vocabs.items()}
+
+    def _dense_trainer_args(self, epoch_id, step_id, **extra):
+        args = {'epoch_id': epoch_id, 'step_id': step_id}
+        args.update(extra)
+        vm = self._vocab_meta()
+        if vm is not None:
+            args['streaming_vocab'] = vm
+        return args
+
     def _save_checkpoint(self, epoch_id, step_id, force=False):
         """force=True skips the interval modulo gate — the bundled loop
         applies its own range-crossing gate (a bundle boundary rarely
@@ -539,8 +580,8 @@ class Trainer(object):
                         trainer_id=self.trainer_id,
                         main_program=self.train_program,
                         step=self._serial,
-                        trainer_args={'epoch_id': epoch_id,
-                                      'step_id': step_id},
+                        trainer_args=self._dense_trainer_args(
+                            epoch_id, step_id),
                         max_num_checkpoints=cfg.max_num_checkpoints)
 
     def _save_emergency_checkpoint(self, epoch_id, step_id,
@@ -575,8 +616,8 @@ class Trainer(object):
                     trainer_id=self.trainer_id,
                     main_program=self.train_program,
                     step=self._serial,
-                    trainer_args={'epoch_id': epoch_id, 'step_id': step_id,
-                                  'preempted': True},
+                    trainer_args=self._dense_trainer_args(
+                        epoch_id, step_id, preempted=True),
                     max_num_checkpoints=cfg.max_num_checkpoints)
 
     # -- preemption -------------------------------------------------------
@@ -759,6 +800,246 @@ class Trainer(object):
             if started_hb:
                 self.heartbeat.stop()
 
+    def train_stream(self, reader, event_handler=None, feed_order=None,
+                     vocabs=None, publisher=None, max_steps=None):
+        """Online training over an UNBOUNDED stream — the loop the
+        reference's pserver async-training era served, TPU-native
+        (docs/embedding.md "streaming ids"). `reader` is an ordinary
+        batch-reader factory with NO epoch length: the loop runs until
+        the stream ends, `stop()` is called, `max_steps` batches have
+        run this call, preemption lands (emergency checkpoint + clean
+        return, exactly like train()), or the heartbeat detects a host
+        loss (typed HostLost).
+
+        vocabs: {id feed name: streaming.VocabTable} — each named feed
+        is translated raw-id -> row on the input stage (prefetch worker
+        when double_buffer=True), rows referenced by the in-flight
+        batch are pinned until its step completes, and evicted rows are
+        zeroed (table + optimizer moments, streaming.RowResetter) at
+        the step boundary BEFORE their new owner trains. Translation is
+        pure host-side indexing: the compiled step signature never
+        changes as the vocab drifts, and with an identity map the
+        trained state is bit-exact vs the un-streamed loop (drilled).
+        The vocab serializes into every checkpoint's meta and a resumed
+        Trainer restores it here, so exact-step resume holds under
+        drift.
+
+        publisher: a streaming.DeltaPublisher — after each step the
+        touched-row set (StepArtifact.touched_rows: host-side, off the
+        step path) is collected, and the publisher's cadence pushes
+        those rows' live values into the serving replicas
+        (Router.push_deltas). Publisher failures other than the typed
+        HostLost are warned and retried next cadence — freshness
+        degrades, training never dies for a serving-side hiccup.
+
+        Checkpoints follow CheckpointConfig's step_interval AND
+        wallclock_interval_s (whichever fires first); epoch_id is
+        recorded as 0 and serials are NOT cleaned on return — a stream
+        has no "finished" state, the next Trainer resumes. There is no
+        reader fast-forward on resume: a live stream is not replayable;
+        the restored (vocab, table, moments) state carries the
+        continuity. Returns the number of steps run this call."""
+        import time as _time
+        if self.parallel:
+            raise ValueError('train_stream drives the single-program '
+                             'Executor loop; parallel=True does not '
+                             'compose with it (use GSPMD annotations)')
+        if self.bundle_steps > 1 or self.sync == 'async':
+            raise ValueError(
+                'train_stream paces checkpoints, vocab leases, and '
+                'delta publishing per STEP; bundle_steps>1 / '
+                "sync='async' pipeline across steps — pick one "
+                '(double_buffer=True overlaps the input side instead)')
+        if event_handler is None:
+            event_handler = lambda ev: None  # noqa: E731
+        vocabs = dict(vocabs or {})
+        self._stream_vocabs = vocabs
+        cfg = self.checkpoint_cfg
+        resumed = bool(cfg and cfg.load_serial)
+        if vocabs and resumed and self._stream_resume_vocab:
+            for fname, state in self._stream_resume_vocab.items():
+                if fname in vocabs:
+                    vocabs[fname].load_state_dict(state)
+            # one-shot: a SECOND train_stream() call on this Trainer
+            # continues the LIVE (drifted) vocab — re-applying the
+            # checkpoint-time map would silently mis-map ids to rows
+            self._stream_resume_vocab = None
+        from ..streaming.vocab import RowResetter, table_state_names
+        resetter = RowResetter()
+        reset_names = {}
+        for fname, vt in vocabs.items():
+            if vt.table:
+                reset_names[fname] = table_state_names(
+                    self.train_program, vt.table)
+
+        leases = {}   # step_id -> [Lease] (writer: input stage;
+        #               reader: the loop after that step completes)
+
+        def translate(step_id, fed):
+            ls = []
+            for fname, vt in vocabs.items():
+                v = fed.get(fname)
+                if v is None:
+                    continue
+                if not hasattr(v, 'dtype'):
+                    raise TypeError(
+                        'train_stream vocab feed %r is not a dense '
+                        'array (got %r) — streaming ids are dense id '
+                        'batches' % (fname, type(v).__name__))
+                mapped, lease = vt.translate(v)
+                fed[fname] = mapped.astype(v.dtype, copy=False)
+                ls.append(lease)
+            if ls:
+                leases[step_id] = ls
+            return fed
+
+        def apply_resets():
+            # zero evicted rows (table + moments) BEFORE the step that
+            # trains their new owners dispatches — stale moments would
+            # bleed the previous occupant's history into the new id
+            for fname, vt in vocabs.items():
+                names = reset_names.get(fname)
+                if not names:
+                    continue
+                rows = vt.drain_resets()
+                if not rows:
+                    continue
+                arrays = [self.scope._chain_get(n) for n in names]
+                new = resetter.reset(arrays, rows)
+                for n, a in zip(names, new):
+                    self.scope._chain_set(n, a)
+
+        steps_run = 0
+        started_hb = False
+        if self.heartbeat is not None and not self.heartbeat.running:
+            self.heartbeat.start()
+            started_hb = True
+        self.preempted = False
+        self._preempt_requested = False
+        last_done = None
+        last_ckpt_t = _time.monotonic()
+        start_step = cfg.step_id + 1 if resumed else 0
+        warned_dense = set()
+        try:
+            with self._preemption_handlers():
+                with self._prog_and_scope_guard():
+                    feed_vars = build_feed_var_list(self.train_program,
+                                                    feed_order)
+                    feeder = DataFeeder(feed_list=feed_vars,
+                                        place=self.place)
+                    fetch = [v.name for v in self.train_func_outputs]
+                    it = self._iter_staged(reader, feeder, post=translate)
+                    self._stream_it = it
+                    for rel_id, fed in it:
+                        step_id = start_step + rel_id
+                        if self.__stop or (max_steps is not None
+                                           and steps_run >= max_steps):
+                            return steps_run
+                        if self._preempt_requested:
+                            self._finish_preemption(last_done)
+                            return steps_run
+                        self._check_host_loss(last_done)
+                        apply_resets()
+                        begin = BeginStepEvent(0, step_id)
+                        event_handler(begin)
+                        want = fetch if begin.fetch_metrics else []
+                        self._steps_run = getattr(self, '_steps_run',
+                                                  0) + 1
+                        with obs.span('trainer.step',
+                                      step_num=self._steps_run,
+                                      epoch=0, step=step_id, stream=True):
+                            metrics = self.exe.run(
+                                program=self.train_program, feed=fed,
+                                fetch_list=want)
+                        last_done = (0, step_id)
+                        steps_run += 1
+                        for lease in leases.pop(rel_id, []):
+                            lease.release()
+                        if publisher is not None:
+                            self._stream_publish(publisher, fed, want,
+                                                 warned_dense, vocabs)
+                        if cfg:
+                            due = (step_id > 0 and step_id
+                                   % cfg.step_interval == 0)
+                            wall = cfg.wallclock_interval_s
+                            if not due and wall is not None:
+                                due = (_time.monotonic() - last_ckpt_t
+                                       >= wall)
+                            if due:
+                                self._save_checkpoint(0, step_id,
+                                                      force=True)
+                                last_ckpt_t = _time.monotonic()
+                        event_handler(EndStepEvent(0, step_id, metrics))
+                        if self._preempt_requested:
+                            self._finish_preemption(last_done)
+                            return steps_run
+                    return steps_run
+        finally:
+            it = getattr(self, '_stream_it', None)
+            self._stream_it = None
+            if it is not None:
+                it.close()   # unblock the prefetch worker on early exit
+            for ls in leases.values():
+                for lease in ls:
+                    lease.release()
+            leases.clear()
+            self._wait_async_ckpt(final=True)
+            if started_hb:
+                self.heartbeat.stop()
+            self._stream_vocabs = None
+            self._stream_art = None
+
+    def _stream_publish(self, publisher, fed, fetch, warned_dense, vocabs):
+        """Collect this step's touched rows (host-side seam) and run the
+        publisher's cadence. Serving-side failures warn and retry next
+        cadence; the typed HostLost propagates — that is a pod event,
+        not a publishing hiccup."""
+        import warnings
+        from ..parallel.heartbeat import HostLost
+        # resolve the artifact ONCE per fetch set, not per step:
+        # Executor.step_artifact runs the _prepare front half, which
+        # re-places the whole feed batch on device — per-step that
+        # would double the hot loop's host->device traffic just to
+        # read metadata (the sparse plan does not depend on the batch)
+        art = getattr(self, '_stream_art', None)
+        if art is None or self._stream_art_key != tuple(fetch):
+            try:
+                art = self.exe.step_artifact(self.train_program, fed,
+                                             fetch, scope=self.scope)
+            except Exception as e:
+                warnings.warn('train_stream: could not resolve the step '
+                              'artifact for touched-row collection '
+                              '(%s: %s)' % (type(e).__name__, e),
+                              RuntimeWarning)
+                return
+            self._stream_art = art
+            self._stream_art_key = tuple(fetch)
+        for fname, vt in vocabs.items():
+            t = vt.table
+            if t and t not in art.sparse_plan and t not in warned_dense:
+                warned_dense.add(t)
+                warnings.warn(
+                    'train_stream: table %r (vocab feed %r) is NOT on '
+                    'the sparse update path — its update writes every '
+                    'row each step, so touched-row deltas under-report '
+                    'and row eviction is unsafe. Build the lookup with '
+                    'is_sparse=True (docs/embedding.md)' % (t, fname),
+                    RuntimeWarning)
+        touched = art.touched_rows(fed)
+        if touched:
+            publisher.collect(touched)
+        try:
+            publisher.maybe_publish(
+                lambda name: self.scope._chain_get(name))
+        except HostLost:
+            raise
+        except Exception as e:
+            obs.counter('streaming.push_failures').inc()
+            warnings.warn(
+                'train_stream: delta push failed (%s: %s) — deltas are '
+                'retained and retried at the next cadence'
+                % (type(e).__name__, e), RuntimeWarning)
+
     def test(self, reader, feed_order=None):
         """reference trainer.py:409 — mean of train_func outputs over the
         test reader, on the for_test clone."""
@@ -827,7 +1108,7 @@ class Trainer(object):
                 if isinstance(h, FetchHandle):
                     h.block()
 
-    def _iter_staged(self, reader, feeder, skip_until=-1):
+    def _iter_staged(self, reader, feeder, skip_until=-1, post=None):
         """Yield (step_id, fed_batch) for one epoch's reader pass.
 
         double_buffer=False: the DataFeeder assembly runs inline (the
@@ -848,7 +1129,14 @@ class Trainer(object):
         (resume fast-forward) — those reader items are consumed and
         yielded as (step_id, None) WITHOUT feed assembly or
         input_stage accounting, so catching up past N done steps stays
-        as cheap as it was before staging existed."""
+        as cheap as it was before staging existed.
+
+        post(step_id, fed) -> fed: per-batch feed rewrite hook, run on
+        the SAME thread as the assembly (the prefetch worker when
+        double-buffered, before device staging) — the streaming-ids
+        loop translates raw ids through its VocabTable here, so
+        admission/eviction overlap the previous step exactly like the
+        rest of the input stage (docs/embedding.md "streaming ids")."""
         import time as _time
 
         def record(step_id, dt, staged):
@@ -865,6 +1153,8 @@ class Trainer(object):
                         continue
                     t0 = _time.perf_counter()
                     fed = feeder.feed(data)
+                    if post is not None:
+                        fed = post(step_id, fed)
                     record(step_id, _time.perf_counter() - t0, False)
                     yield step_id, fed
             return plain()
@@ -883,6 +1173,8 @@ class Trainer(object):
             if step_id <= skip_until:
                 return step_id, None
             fed = feeder.feed(data)
+            if post is not None:
+                fed = post(step_id, fed)
             if place_in_worker:
                 fed = exe._place_feed(prog, fed, None)
             return step_id, fed

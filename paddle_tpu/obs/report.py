@@ -398,6 +398,50 @@ def summarize(events):
                             ' [sharded]' if f.get('sharded') else '',
                             f.get('key', '?')))
 
+    # -- streaming --------------------------------------------------------
+    # streaming-ids online training (docs/embedding.md "streaming ids"):
+    # vocab drift (admit/evict events from the VocabTable), and the
+    # train->serve delta pushes with their freshness lag
+    admits = _events(events, 'streaming.admit')
+    evicts = _events(events, 'streaming.evict')
+    pushes = _events(events, 'streaming.delta_push')
+    rpushes = _events(events, 'router.delta_push')
+    if admits or evicts or pushes or rpushes:
+        lines.append('')
+        lines.append('-- streaming --')
+        n_adm = sum(int(e.get('fields', {}).get('rows', 0) or 0)
+                    for e in admits)
+        n_ev = sum(int(e.get('fields', {}).get('rows', 0) or 0)
+                   for e in evicts)
+        # the LAST drift event in file order (admits and evicts each
+        # carry the post-event resident count; concatenating the lists
+        # would wrongly prefer the last evict over a later admit)
+        drift = [e for e in events
+                 if e.get('name') in ('streaming.admit',
+                                      'streaming.evict')]
+        resident = drift[-1].get('fields', {}).get('resident', '?') \
+            if drift else '?'
+        lines.append('vocab drift: %d row(s) admitted, %d evicted '
+                     '(resident now: %s)' % (n_adm, n_ev, resident))
+        ok = [e for e in pushes if e.get('fields', {}).get('ok')]
+        failed = len(pushes) - len(ok)
+        if pushes:
+            n_rows = sum(int(e.get('fields', {}).get('rows', 0) or 0)
+                         for e in ok)
+            last = ok[-1].get('fields', {}) if ok else {}
+            lines.append('delta pushes: %d ok / %d failed, %d row(s) '
+                         'pushed (last: %s ms push, %s s freshness lag)'
+                         % (len(ok), failed, n_rows,
+                            last.get('push_ms', '?'),
+                            last.get('freshness_lag_s', '?')))
+        for e in rpushes[-3:]:
+            f = e.get('fields', {})
+            lines.append('  router push: model %s v%s -> %s replica(s)'
+                         ' (%s closed), tables %s'
+                         % (f.get('model', '?'), f.get('version', '?'),
+                            f.get('replicas', '?'), f.get('closed', 0),
+                            ','.join(f.get('tables', []) or ['?'])))
+
     # -- anomaly guard ---------------------------------------------------
     skips = _events(events, 'anomaly.skip')
     lines.append('')

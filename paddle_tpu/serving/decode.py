@@ -800,6 +800,55 @@ class DecodeEngine(object):
                                         main_program=self._step_program)
         return dirname
 
+    def push_rows(self, deltas):
+        """Scatter trained row deltas into this replica's LIVE decoder
+        weights between dispatches — the streaming train->serve
+        freshness path applied to the continuous-batching engine
+        (docs/serving.md#delta-push). `deltas` maps a step-program
+        persistable name to `(row_ids, rows)`.
+
+        Built on the StepHandle donation-safe mutation seam: the update
+        runs under `_handle_lock` (the same lock every dispatch, join
+        scatter, and warmup probe serializes on), so a push never
+        interleaves an in-flight step, and it lands through
+        `StepHandle.set_state` — the handle's view and the scope stay
+        one object, so the scope-identity invalidation check keeps
+        holding. Only READ-ONLY persistables (the memory plan's
+        non-donated set: the decoder weights) take deltas; the donated
+        decode-pool state (slot carries, histories, page content) is
+        typed DeltaUnsupported — scattering rows into per-slot state
+        would corrupt live decodes. A poisoned slot is irrelevant here
+        by construction: pushes touch weights, never slot state.
+        Returns rows applied."""
+        import jax.numpy as jnp
+        from .engine import DeltaUnsupported, _validate_delta
+        if self._shutdown:
+            raise ServerClosed('decode engine is shut down')
+        applied = 0
+        with self._handle_lock:
+            handle = self._acquire()
+            for name in sorted(deltas):
+                ids, rows = deltas[name]
+                if name in handle._donated:
+                    raise DeltaUnsupported(
+                        'push_rows: %r is donated per-step decode state '
+                        '(slot pool), not a weight — row deltas apply '
+                        'only to the read-only set %r'
+                        % (name, sorted(handle._readonly)))
+                w = handle._readonly.get(name)
+                if w is None:
+                    raise KeyError(
+                        'push_rows: no read-only persistable %r in the '
+                        'decode step (have %r)'
+                        % (name, sorted(handle._readonly)))
+                ids, rows = _validate_delta(name, w, ids, rows)
+                handle.set_state(name,
+                                 jnp.asarray(w).at[ids].set(rows))
+                applied += int(ids.shape[0])
+            self._n['delta_pushes'] += 1
+            self._n['delta_rows'] += applied
+        return applied
+
     # -- admission ---------------------------------------------------------
 
     def submit(self, feed, max_new_tokens=None, deadline_ms=None,
@@ -1547,7 +1596,7 @@ class DecodeEngine(object):
         out = {k: self._n.get(k, 0) for k in
                ('submitted', 'completed', 'rejected', 'shed', 'poisoned',
                 'joins', 'releases', 'steps', 'tokens',
-                'slots_high_water')}
+                'slots_high_water', 'delta_pushes', 'delta_rows')}
         out['queue_depth'] = depth
         out['queue_high_water'] = self._q_high_water
         out['slots'] = self.config.slots

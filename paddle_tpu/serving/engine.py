@@ -45,7 +45,7 @@ from .. import obs
 from . import buckets as _buckets
 
 __all__ = ['ServingConfig', 'ServingEngine', 'ServerOverloaded',
-           'ServerClosed', 'DeadlineExceeded']
+           'ServerClosed', 'DeadlineExceeded', 'DeltaUnsupported']
 
 # How long any internal condition-wait may sleep before re-checking the
 # shutdown flag. request_shutdown() must be callable from a signal
@@ -68,6 +68,14 @@ class ServerClosed(RuntimeError):
 class DeadlineExceeded(RuntimeError):
     """The request's deadline expired while it waited in the queue; it
     was shed before execution (its future receives this exception)."""
+
+
+class DeltaUnsupported(TypeError):
+    """push_rows targeted a model that cannot take row deltas: a
+    `load_compiled` runner (parameters are baked into the StableHLO
+    artifact as constants — publish a new artifact and Router.swap()
+    instead), or a decode-pool persistable that is donated per-step
+    state rather than a weight."""
 
 
 class ServingConfig(object):
@@ -115,6 +123,41 @@ class ServingConfig(object):
         self.max_retries = int(max_retries)
         self.retry_base_delay_ms = float(retry_base_delay_ms)
         self.retry_seed = retry_seed
+
+
+def _validate_delta(name, w, ids, rows):
+    """Shared delta validation for the push surfaces (ServingEngine and
+    DecodeEngine): in-range int row ids, matching trailing dims, a
+    safely-castable dtype. Returns (ids int32 [n], rows w.dtype [n,...])
+    or raises ValueError naming the table."""
+    ids = np.asarray(ids)
+    rows = np.asarray(rows)
+    if ids.ndim != 1:
+        raise ValueError('push_rows: %r row ids must be 1-D, got shape %r'
+                         % (name, tuple(ids.shape)))
+    if not np.issubdtype(ids.dtype, np.integer):
+        raise ValueError('push_rows: %r row ids must be integers, got %s'
+                         % (name, ids.dtype))
+    cap = int(w.shape[0])
+    if ids.size and (int(ids.min()) < 0 or int(ids.max()) >= cap):
+        raise ValueError(
+            'push_rows: %r row ids out of range [0, %d) (got min %d '
+            'max %d)' % (name, cap, int(ids.min()), int(ids.max())))
+    want = (ids.shape[0],) + tuple(int(d) for d in w.shape[1:])
+    if tuple(rows.shape) != want:
+        raise ValueError(
+            'push_rows: %r rows have shape %r, expected %r (one row per '
+            'id, trailing dims of the table)'
+            % (name, tuple(rows.shape), want))
+    wdt = np.dtype(str(w.dtype))
+    if rows.dtype != wdt:
+        if np.can_cast(rows.dtype, wdt, 'same_kind'):
+            rows = rows.astype(wdt)
+        else:
+            raise ValueError(
+                'push_rows: %r rows dtype %s cannot cast to the table '
+                'dtype %s' % (name, rows.dtype, wdt))
+    return ids.astype(np.int32), rows
 
 
 class _Request(object):
@@ -199,6 +242,12 @@ class ServingEngine(object):
         self._n_padded_rows = 0
         self._n_inflight = 0           # rows in the currently-executing batch
         self._q_high_water = 0         # cumulative queue high-water mark
+        # row-delta pushes (push_rows): serialized so two publishers'
+        # read-modify-write scatters never lose rows to each other
+        self._push_lock = threading.Lock()
+        self._push_write_set = None    # memoized program write set
+        self._n_delta_pushes = 0
+        self._n_delta_rows = 0
         # the windowed counterparts stats_window() reads-and-resets — the
         # admission-pressure signal the router balances on
         self._win = {'submitted': 0, 'completed': 0, 'shed': 0,
@@ -407,6 +456,65 @@ class ServingEngine(object):
         self._warm = True
         return list(self.buckets)
 
+    # -- row-delta push (docs/serving.md#delta-push) -----------------------
+
+    def push_rows(self, deltas):
+        """Scatter trained row deltas into this replica's LIVE weights —
+        the streaming train->serve freshness path (docs/embedding.md
+        "streaming ids"): `deltas` maps a persistable name to
+        `(row_ids, rows)` where `rows[i]` is the new value of
+        `table[row_ids[i]]`. The replacement is per-TABLE atomic: the
+        new array is built fully off to the side, then swapped into the
+        model scope by reference — a batch executing concurrently reads
+        the old table or the new one, never a torn row. Only
+        Predictor-backed models take deltas (a `load_compiled` runner
+        bakes parameters into the artifact as constants: typed
+        DeltaUnsupported — publish an artifact and Router.swap()
+        instead), and only into variables the program does not WRITE
+        (a written persistable is donated state; scattering into it
+        would race the batcher's in-place update). Returns rows
+        applied."""
+        scope = getattr(self._model, '_scope', None)
+        prog = getattr(self._model, '_program', None)
+        if scope is None or prog is None:
+            raise DeltaUnsupported(
+                'this replica serves a compiled artifact (or a bare '
+                'callable) with no live parameter scope — row deltas '
+                'need a Predictor-backed engine; swap() a new artifact '
+                'instead')
+        if self._shutdown:
+            raise ServerClosed('serving engine is shut down')
+        # the program never changes for the life of the engine: walk
+        # its write set once, not once per publisher cadence
+        write_set = self._push_write_set
+        if write_set is None:
+            from ..fluid.passes import memory_plan
+            write_set = self._push_write_set = memory_plan(prog).write_set
+        import jax.numpy as jnp
+        applied = 0
+        with self._push_lock:
+            for name in sorted(deltas):
+                ids, rows = deltas[name]
+                w = scope._chain_get(name)
+                if w is None:
+                    raise KeyError(
+                        'push_rows: no persistable %r in the model scope'
+                        % (name,))
+                if name in write_set:
+                    raise DeltaUnsupported(
+                        'push_rows: %r is WRITTEN by the serving program '
+                        '(donated state) — pushing rows into it would '
+                        'race the in-place update' % (name,))
+                ids, rows = _validate_delta(name, w, ids, rows)
+                new = jnp.asarray(w).at[ids].set(rows)
+                # reference swap = the atomic commit: concurrent batches
+                # hold either the old array or the new one
+                scope._chain_set(name, new)
+                applied += int(ids.shape[0])
+        self._n_delta_rows += applied
+        self._n_delta_pushes += 1
+        return applied
+
     # -- shutdown ----------------------------------------------------------
 
     def request_shutdown(self):
@@ -459,6 +567,8 @@ class ServingEngine(object):
                 'queue_depth': depth,
                 'queue_high_water': self._q_high_water,
                 'inflight': self._n_inflight,
+                'delta_pushes': self._n_delta_pushes,
+                'delta_rows': self._n_delta_rows,
                 'warm': self._warm}
 
     def stats_window(self):

@@ -53,6 +53,8 @@ import hashlib
 
 import numpy as np
 
+from ..utils.lru import RefCountedLRU
+
 __all__ = ['PagePool', 'PrefixCache', 'content_key', 'pages_for']
 
 
@@ -133,43 +135,43 @@ class PagePool(object):
 
 
 class _Resident(object):
-    __slots__ = ('pages', 'src_len', 'refs')
+    __slots__ = ('pages', 'src_len')
 
-    def __init__(self, pages, src_len, refs):
+    def __init__(self, pages, src_len):
         self.pages = pages
         self.src_len = src_len
-        self.refs = refs
 
 
 class PrefixCache(object):
     """Content-hash -> resident encoder pages, refcounted, LRU-evicted
     through the owning :class:`PagePool`.
 
-    A hit bumps the entry's ref count and its LRU position (the
-    OrderedDict IS the recency order: move_to_end on hit, eviction
-    scans from the front) and returns the resident pages + src_len —
-    the joining request points its page table at them and SKIPS
-    prefill entirely. `unref` on slot release leaves the entry
-    resident (refs may drop to 0); only pool pressure evicts it,
-    least-recently-used first. `on_evict(key, pages)` lets the engine
-    emit the eviction event.
+    A hit bumps the entry's ref count and its LRU position and returns
+    the resident pages + src_len — the joining request points its page
+    table at them and SKIPS prefill entirely. `unref` on slot release
+    leaves the entry resident (refs may drop to 0); only pool pressure
+    evicts it, least-recently-used first. `on_evict(key, pages)` lets
+    the engine emit the eviction event. The refcount+recency bookkeeping
+    is `utils.lru.RefCountedLRU` — the same structure the streaming
+    vocab table pins in-flight embedding rows with (docs/embedding.md
+    "streaming ids").
     """
 
     def __init__(self, pool, on_evict=None):
         self._pool = pool
-        self._entries = collections.OrderedDict()   # key -> _Resident
+        self._lru = RefCountedLRU()                 # key -> _Resident
         self._on_evict = on_evict
         self.hits = 0
         self.misses = 0
         self.evictions = 0
 
     def __len__(self):
-        return len(self._entries)
+        return len(self._lru)
 
     def peek(self, key):
         """True when `key` is resident — the admission gate's page-need
         probe; no counter or ref-count side effects."""
-        return key in self._entries
+        return key in self._lru
 
     def pinnable_pages(self, key):
         """Pages a hit on `key` would take OUT of the evictable budget:
@@ -177,61 +179,55 @@ class PrefixCache(object):
         entry was never evictable, so pinning it costs nothing). The
         admission gate charges this before admitting a hit, else a
         batch-mate's claim would count the same pages as evictable."""
-        e = self._entries.get(key)
-        return len(e.pages) if e is not None and e.refs == 0 else 0
+        e = self._lru.get(key)
+        return len(e.pages) if e is not None and self._lru.refs(key) == 0 \
+            else 0
 
     def lookup(self, key):
         """(pages, src_len) on a hit (ref count bumped), else None."""
-        e = self._entries.get(key)
+        e = self._lru.get(key)
         if e is None:
             self.misses += 1
             return None
-        e.refs += 1
-        self._entries.move_to_end(key)
+        self._lru.ref(key)
+        self._lru.touch(key)
         self.hits += 1
         return list(e.pages), e.src_len
 
     def insert(self, key, pages, src_len, refs=1):
         """Make freshly-written pages resident under `key`. The pages
         stay OUT of the pool's free list until evicted."""
-        if key in self._entries:        # racing duplicate miss: keep
-            e = self._entries[key]      # the first copy, free ours
-            e.refs += refs
+        if key in self._lru:            # racing duplicate miss: keep
+            for _ in range(int(refs)):  # the first copy, free ours
+                self._lru.ref(key)
             self._pool.release(pages)
             return
-        self._entries[key] = _Resident(list(pages), int(src_len),
-                                       int(refs))
+        self._lru.insert(key, _Resident(list(pages), int(src_len)),
+                         refs=int(refs))
 
     def unref(self, key):
         """One slot stopped using the entry; it STAYS resident (that is
         the whole point — the next request with this prefix hits)."""
-        e = self._entries.get(key)
-        if e is not None and e.refs > 0:
-            e.refs -= 1
+        self._lru.unref(key)
 
     def evictable_pages(self):
-        return sum(len(e.pages) for e in self._entries.values()
-                   if e.refs == 0)
+        return self._lru.evictable(weigh=lambda e: len(e.pages))
 
     def evict_one(self):
         """Evict the least-recently-used unreferenced entry, returning
         its pages to the pool. False when nothing is evictable."""
-        victim = None
-        for key, e in self._entries.items():   # insertion order = LRU
-            if e.refs == 0:
-                victim = key
-                break
+        victim = self._lru.evict_one()
         if victim is None:
             return False
-        e = self._entries.pop(victim)
+        key, e = victim
         self._pool.release(e.pages)
         self.evictions += 1
         if self._on_evict is not None:
-            self._on_evict(victim, e.pages)
+            self._on_evict(key, e.pages)
         return True
 
     def stats(self):
-        return {'entries': len(self._entries), 'hits': self.hits,
+        return {'entries': len(self._lru), 'hits': self.hits,
                 'misses': self.misses, 'evictions': self.evictions,
                 'resident_pages': sum(len(e.pages)
-                                      for e in self._entries.values())}
+                                      for _, e in self._lru.items())}

@@ -362,6 +362,58 @@ class Router(object):
             self._drainers.append(t)
         return version
 
+    # -- row-delta push ----------------------------------------------------
+
+    def push_deltas(self, model_id, deltas):
+        """Push trained row deltas into EVERY live replica of
+        `model_id` — the streaming train->serve freshness path
+        (docs/serving.md#delta-push): `deltas` maps a persistable name
+        to `(row_ids, rows)`, applied through each engine's
+        `push_rows` (per-table atomic reference swap on ServingEngine,
+        StepHandle.set_state under the handle lock on DecodeEngine).
+
+        Generation discipline: the push holds the router's SWAP lock,
+        so it can never interleave a `swap()` cutover — a delta lands
+        entirely on one generation, and a swap waits for an in-flight
+        push (and vice versa). A push that raced just AHEAD of a swap
+        is superseded by the incoming artifact; the publisher's next
+        cadence re-freshens the new generation (its pending set only
+        clears on success, docs/embedding.md "streaming ids"). A
+        replica that is independently shut down raises ServerClosed: if
+        every replica is closed the typed error propagates (the model
+        is down, there is nothing to freshen); a partial failure
+        freshens the survivors and reports the failures in the
+        router.delta_push event. Typed errors (DeltaUnsupported,
+        ValueError on malformed deltas) propagate immediately — they
+        mean the push itself is wrong, not the replica.
+
+        Returns the number of replicas updated."""
+        from .engine import DeltaUnsupported
+        with self._swap_lock:
+            with self._lock:
+                entry = self._entry(model_id)
+                replicas = list(entry.replicas)
+                version = entry.version
+            pushed, rows, closed = 0, 0, []
+            for r in replicas:
+                try:
+                    rows = r.engine.push_rows(deltas)
+                    pushed += 1
+                except ServerClosed as e:
+                    closed.append(e)
+                except (DeltaUnsupported, ValueError, KeyError):
+                    raise
+            obs.event('router.delta_push', model=str(model_id),
+                      version=version, replicas=pushed,
+                      closed=len(closed), rows=rows,
+                      tables=sorted(str(k) for k in deltas))
+            if closed and pushed == 0:
+                raise ServerClosed(
+                    'every replica of model %r is shut down — no live '
+                    'generation to push deltas into (last: %s)'
+                    % (model_id, closed[-1]))
+            return pushed
+
     # -- lifecycle ---------------------------------------------------------
 
     def stats(self):

@@ -1,0 +1,26 @@
+"""paddle_tpu.streaming — online training over unbounded id streams.
+
+The production loop the reference's pserver era actually served,
+rebuilt TPU-native (docs/embedding.md "streaming ids"): a recommender
+trains on a click stream whose id space drifts, while its parameters
+continuously publish to live serving. Three legs:
+
+  * :class:`VocabTable` — host-side raw-id -> row indirection with
+    frequency admission (cold-row training below the threshold) and
+    LRU eviction of unpinned rows, so the COMPILED step's table shape
+    never changes as the vocab drifts;
+  * `Trainer.train_stream` (fluid/trainer.py) — the unbounded-stream
+    hot loop: prefetch, translation, evicted-row zeroing, step/
+    wall-clock checkpoint cadence with the vocab serialized into the
+    checkpoint meta;
+  * :class:`DeltaPublisher` — touched-row snapshots pushed into
+    running `ServingEngine`/`DecodeEngine` replicas via
+    `Router.push_deltas` — per-row scatter instead of full-artifact
+    swap().
+"""
+from .publish import DeltaPublisher
+from .vocab import (Lease, RowPinned, RowResetter, VocabFull, VocabTable,
+                    table_state_names)
+
+__all__ = ['VocabTable', 'DeltaPublisher', 'RowResetter', 'Lease',
+           'RowPinned', 'VocabFull', 'table_state_names']

@@ -137,10 +137,14 @@ for name in sorted(set(new) & set(prev)):
     # tokens_per_sec, speedup) keep the higher-is-better rule.
     # the overlap/AOT family (PR 12) adds host-stall seconds totals and
     # online-compile counts — both lower-is-better like the latencies
-    # (the input-wait metric already ends in _ms and rides that rule)
+    # (the input-wait metric already ends in _ms and rides that rule);
+    # the streaming family (docs/embedding.md#streaming) adds freshness
+    # lag (*_lag_s) — lower is fresher — while its push latency
+    # (*_push_ms) already rides the _ms rule
     lower_is_better = (name.endswith('_ms') or name.endswith('.dropped')
                        or name.endswith('_temp_bytes')
                        or name.endswith('_stall_s')
+                       or name.endswith('_lag_s')
                        or name.endswith('_compiles'))
     if lower_is_better:
         if ratio > 1.1:

@@ -11,9 +11,16 @@
 # heartbeat host-loss detection (docs/robustness.md#elastic). Its
 # multi-process kill-one-worker drill is `slow` and so excluded here.
 #
+# The streaming faults (tests/test_streaming.py, drills marked `faults`
+# alongside `streaming`) ride along too: a delta push racing a swap()
+# cutover, host loss mid-push, and eviction of a row with an in-flight
+# gradient — each must fail typed and never strand a future or commit a
+# torn row (docs/embedding.md#streaming).
+#
 # Usage: tools/fault_drill.sh [extra pytest args]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 exec env JAX_PLATFORMS=cpu python -m pytest \
     -m '(faults or elastic) and not slow' \
-    -q -p no:cacheprovider "$@" tests/test_faults.py tests/test_elastic.py
+    -q -p no:cacheprovider "$@" tests/test_faults.py tests/test_elastic.py \
+    tests/test_streaming.py

@@ -180,6 +180,18 @@ if [ "${EMBED:-0}" = 1 ]; then
       --platform "${BENCH_PLATFORM:-tpu}"
 fi
 
+# 8c2. streaming-ids phase (opt-in: STREAM=1): the online-training
+#      loop — drifting id stream -> VocabTable admission/eviction ->
+#      sharded-sparse online training -> DeltaPublisher row pushes into
+#      a live replica; emits steps/sec, freshness lag (*_lag_s,
+#      lower-is-better), push latency (*_push_ms), and rows
+#      admitted/evicted (docs/embedding.md#streaming). Host-side
+#      machinery, so it runs regardless of platform.
+if [ "${STREAM:-0}" = 1 ]; then
+  run python bench.py --phase streaming \
+      --platform "${BENCH_PLATFORM:-tpu}"
+fi
+
 # 8d. elastic smoke (opt-in: ELASTIC=1): the fast elastic drill tier —
 #     sharded checkpoints through the Trainer, atomic commit + torn-write
 #     fallback, reshard-on-restore topology change, heartbeat staleness
