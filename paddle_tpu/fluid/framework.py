@@ -469,7 +469,11 @@ class Program(object):
         ``((name, size), ...)`` sequence. ``set_mesh(None)`` clears the
         spec. data_axis: the mesh axis feed batches shard their leading
         dim over; defaults to ``'dp'`` (then ``'data'``) when present,
-        else feeds replicate.
+        else feeds replicate. ``data_axis=False`` forces feeds to
+        REPLICATE even when a 'dp'/'data' axis exists — the sharded
+        SERVING posture (docs/serving.md#pod): request batches are
+        bucket-sized, not divisible-by-mesh-sized, while the params
+        (e.g. a row-sharded table) stay sharded over the axis.
 
         The Executor lowers an annotated Program through ONE jitted step
         with explicit in/out shardings and a donation vector over the
@@ -499,7 +503,13 @@ class Program(object):
             if int(size) < 1:
                 raise ValueError('mesh axis %r has size %r' % (name, size))
         items = tuple((n, int(s)) for n, s in items)
-        if data_axis is None:
+        if data_axis is False:
+            # forced replicate (serving posture): kept as False — NOT
+            # collapsed to None — so the choice survives clone() and
+            # the _to_dict/_from_dict round-trip (None would re-derive
+            # 'dp' on reload and silently re-shard request batches)
+            pass
+        elif data_axis is None:
             for cand in ('dp', 'data'):
                 if cand in seen:
                     data_axis = cand

@@ -715,6 +715,49 @@ def summarize(events):
                                         f.get('clean', '?'),
                                         f.get('completed', '?'),
                                         f.get('tokens', '?')))
+    # -- pod serving: registry, host loss, heal, autoscale -----------------
+    pd_reg = _events(events, 'serving.replica.register')
+    pd_drain = _events(events, 'serving.replica.drain')
+    pd_lost = _events(events, 'serving.replica.lost')
+    pd_resh = _events(events, 'serving.replica.reshard')
+    pd_heal = _events(events, 'serving.pod.heal_requested')
+    pd_hfail = (_events(events, 'serving.pod.heal_failed')
+                + _events(events, 'serving.pod.heal_unroutable'))
+    pd_scale = _events(events, 'serving.autoscale')
+    pd_hlost = _events(events, 'router.host_lost')
+    if pd_reg or pd_lost or pd_resh or pd_drain or pd_scale:
+        lines.append('')
+        lines.append('-- pod serving --')
+        hosts = sorted({e.get('fields', {}).get('host')
+                        for e in pd_reg
+                        if e.get('fields', {}).get('host') is not None})
+        lines.append('replicas: %d registered across %d host(s), '
+                     '%d drained, %d lost'
+                     % (len(pd_reg), len(hosts), len(pd_drain),
+                        len(pd_lost)))
+        for e in pd_hlost:
+            f = e.get('fields', {})
+            lines.append('host LOST: h%s — %s replica(s) detached, %s '
+                         'future(s) re-routed, %s heal(s) requested'
+                         % (f.get('host', '?'), f.get('replicas', '?'),
+                            f.get('rerouted', '?'), f.get('heals', '?')))
+        for e in pd_resh:
+            f = e.get('fields', {})
+            line = ('reshard: model=%s -> h%s (%s)'
+                    % (f.get('model', '?'), f.get('host', '?'),
+                       f.get('key', '?')))
+            if f.get('heal_s') is not None:
+                line += ' healed in %s' % _fmt_s(f['heal_s'])
+            lines.append(line)
+        if pd_heal or pd_hfail:
+            lines.append('heals: %d requested, %d failed/unroutable'
+                         % (len(pd_heal), len(pd_hfail)))
+        if pd_scale:
+            ups = sum(1 for e in pd_scale
+                      if e.get('fields', {}).get('direction') == 'up')
+            lines.append('autoscale: %d up, %d down'
+                         % (ups, len(pd_scale) - ups))
+
     if rt_swap or rt_over:
         lines.append('')
         lines.append('-- router --')

@@ -96,6 +96,31 @@ class Heartbeat(object):
             if i != self.process_id:
                 self._peers.setdefault(i, {'seq': None, 'since': now})
 
+    # -- dynamic membership (pod serving, serving/pod.py) -------------------
+    #
+    # Training jobs declare a fixed num_processes up front; a serving pod
+    # does not — replicas register and retire while the pod runs. These
+    # two calls let a watcher (the PodRouter's replica registry) track an
+    # explicit peer set on top of the same beat files and the same
+    # staleness judgement: pass num_processes=0 at construction (beat-only
+    # writer / pure watcher) and watch()/unwatch() hosts as they register.
+
+    def watch(self, process_id):
+        """Track an explicit peer from now on (it gets the full
+        `timeout` grace before it can read as stale)."""
+        pid = int(process_id)
+        if pid != self.process_id:
+            self._peers.setdefault(
+                pid, {'seq': None, 'since': time.monotonic()})
+        return self
+
+    def unwatch(self, process_id):
+        """Stop tracking a peer (a retired host must not read as lost)."""
+        pid = int(process_id)
+        self._peers.pop(pid, None)
+        self._reported.discard(pid)
+        return self
+
     def beat(self):
         """Write one beat (atomic tmp+replace: readers never see a torn
         payload). Manual loops call this directly; start() runs it on a

@@ -29,6 +29,9 @@ from .engine import (DeadlineExceeded, ServerClosed,  # noqa: F401
                      ServerOverloaded, ServingConfig, ServingEngine)
 from .router import (ModelOverloaded, Router,  # noqa: F401
                      UnknownModel)
+from .pod import (AutoscalePolicy, Autoscaler, PodRouter,  # noqa: F401
+                  PodWorker, RemoteReplica, ShardedPredictor,
+                  save_serving_program, sharded_replica)
 
 __all__ = ['ServingEngine', 'ServingConfig', 'ServerOverloaded',
            'ServerClosed', 'DeadlineExceeded', 'buckets',
@@ -36,4 +39,7 @@ __all__ = ['ServingEngine', 'ServingConfig', 'ServerOverloaded',
            'DecodeConfig', 'DecodeEngine', 'DecodeSlotPoisoned',
            'LockstepDecoder', 'mt_weights', 'program_prefill',
            'Router', 'ModelOverloaded', 'UnknownModel',
-           'pages', 'PagePool', 'PrefixCache']
+           'pages', 'PagePool', 'PrefixCache',
+           'PodRouter', 'PodWorker', 'RemoteReplica', 'ShardedPredictor',
+           'sharded_replica', 'save_serving_program',
+           'AutoscalePolicy', 'Autoscaler']

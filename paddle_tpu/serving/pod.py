@@ -1,0 +1,1461 @@
+"""Pod-scale serving: sharded replicas, cross-host routing, self-healing.
+
+The serving counterpart of the elastic training runtime
+(docs/robustness.md#elastic): one `set_mesh`-annotated Program served
+as a single Router replica across the devices of a host, replicas
+registered across MANY hosts behind one front door, and capacity that
+heals itself when a host dies. Three layers (docs/serving.md#pod):
+
+  * SHARDED REPLICAS — :class:`ShardedPredictor` loads an inference
+    Program (program only, no dense params) onto a device mesh and
+    restores its weights straight from a SHARDED checkpoint
+    (`utils.checkpoint.load_latest_verified(mesh=...)` →
+    `Executor.load_state_dict`): a row-sharded embedding table or a
+    tensor-parallel decoder comes up WITHOUT ever materializing dense
+    on any host, and the GSPMD executor serves it through the same
+    all_to_all lookup wire training proved (docs/embedding.md). Feeds
+    replicate (`set_mesh(..., data_axis=False)`), so every serving
+    bucket works regardless of the mesh shape.
+  * POD-AWARE ROUTING — :class:`PodWorker` registers a host's replicas
+    into a shared-filesystem registry (the heartbeat/checkpoint
+    posture: dependency-free, atomic-replace files) and serves their
+    request spools; :class:`PodRouter` watches the registry, wraps each
+    remote replica in an engine-protocol :class:`RemoteReplica` proxy,
+    and runs the EXISTING Router semantics — least-loaded dispatch,
+    quotas, swap, push_deltas — across process boundaries through the
+    one replica abstraction (`Router.add_replica(..., host=, key=)`).
+  * SELF-HEALING — each host heartbeats (`parallel.Heartbeat`); a stale
+    host surfaces as the typed `HostLost`, its replicas are detached,
+    every future still pending against them is RE-ROUTED to survivors
+    (zero dropped futures — the router holds each request's feed until
+    its response lands), and a heal command asks a surviving host to
+    re-shard the replica onto its own topology via the same
+    `load_latest_verified(mesh=...)` restore path. Queue-depth-driven
+    :class:`Autoscaler` rides the same add/drain machinery for
+    scale-up/down with zero-downtime cutover.
+
+Events: serving.replica.{register,drain,lost,reshard}, the
+router.pod_size gauge, and an obs_report `-- pod serving --` section
+(docs/observability.md). Drilled by tests/test_pod_serving.py
+(`pod` marker) and measured by `serve_bench --workload pod-sharded`.
+"""
+import collections
+import json
+import os
+import threading
+import time
+import uuid
+
+import numpy as np
+
+from .. import obs
+from .engine import (DeadlineExceeded, DeltaUnsupported, ServerClosed,
+                     ServerOverloaded, ServingConfig, ServingEngine)
+from .router import Router
+
+__all__ = ['ShardedPredictor', 'save_serving_program', 'sharded_replica',
+           'PodWorker', 'PodRouter', 'RemoteReplica', 'AutoscalePolicy',
+           'Autoscaler']
+
+_C_REROUTED = obs.counter('serving.pod.rerouted_futures')
+_C_HEALS = obs.counter('serving.pod.heals')
+
+# wire poll cadence: the spool transport is filesystem mailboxes, read
+# at this period (same order as the engine's _POLL_S)
+_POLL_S = 0.02
+
+# One host, many sharded replicas: two compiled modules ISSUING
+# COLLECTIVES (the all_to_all lookup wire) must never interleave on the
+# same devices — XLA's rendezvous would pair participants across the
+# two modules and deadlock. Replicas co-hosted on one process share the
+# physical chips anyway, so serializing their dispatches costs nothing
+# but removes the hazard (docs/serving.md#pod).
+_MESH_DISPATCH_LOCK = threading.Lock()
+
+
+# ---------------------------------------------------------------------------
+# sharded replicas: program-only load + sharded-checkpoint restore
+# ---------------------------------------------------------------------------
+
+def save_serving_program(dirname, feeded_var_names, target_vars,
+                         main_program=None, model_filename=None):
+    """Save ONLY the pruned inference Program (no parameters) — the
+    pod-serving artifact: a 100GB-table model's weights live in the
+    SHARDED checkpoint (`utils.checkpoint.save_sharded`), never in a
+    dense params file, so neither the save nor the load ever gathers a
+    table whole (`fluid.io.save_inference_model` would —
+    docs/serving.md#pod). The program keeps its mesh spec and sharding
+    annotations through serialization; :class:`ShardedPredictor` is the
+    loader. Returns the program file path."""
+    from ..fluid import framework, io
+    if isinstance(feeded_var_names, str):
+        feeded_var_names = [feeded_var_names]
+    if not isinstance(target_vars, (list, tuple)):
+        target_vars = [target_vars]
+    if main_program is None:
+        main_program = framework.default_main_program()
+    infer = main_program.clone(for_test=True).prune(list(target_vars))
+    os.makedirs(dirname, exist_ok=True)
+    meta = {
+        'program': infer._to_dict(),
+        'feed_names': list(feeded_var_names),
+        'fetch_names': [v.name if isinstance(v, framework.Variable)
+                        else str(v) for v in target_vars],
+    }
+    path = os.path.join(dirname, model_filename or io._PROGRAM_FILE)
+    _atomic_json(path, meta)
+    return path
+
+
+class ShardedPredictor(object):
+    """Predictor over a `set_mesh`-annotated Program with weights
+    restored from a SHARDED checkpoint — the sharded-replica loader
+    (docs/serving.md#pod).
+
+    Loads the saved inference Program WITHOUT its dense params file,
+    asserts/overrides the mesh (`mesh_axes`), and restores every
+    persistable via `utils.checkpoint.load_latest_verified(ckpt_dir,
+    mesh=...)` → `Executor.load_state_dict`: each array is assembled
+    shard-by-shard onto this host's devices per its annotation — a
+    vocab-sharded table arrives as per-device row shards and is NEVER
+    materialized dense anywhere (reshard-on-restore covers a checkpoint
+    written on a different topology). Inference then runs through the
+    plain GSPMD executor — a row-sharded `lookup_table` takes the same
+    all_to_all wire as training (docs/embedding.md), now on the serving
+    path. Feeds REPLICATE by default (`data_axis=False`), so any
+    serving bucket size works on any mesh; pass `data_axis='dp'` to
+    shard request batches instead (buckets must then divide the axis).
+
+    Drop-in for `inference.Predictor` wherever the serving engine
+    expects one (run/feed_names/fetch_names/input_spec, private
+    program/scope/executor seams — `push_rows` row-delta freshness
+    works against the sharded table too)."""
+
+    def __init__(self, model_dir, mesh_axes=None, ckpt_dir=None,
+                 place=None, model_filename=None, data_axis=False):
+        from .. import parallel
+        from ..fluid import analysis, core, io
+        from ..fluid.executor import Executor, Scope
+        from ..fluid.framework import Program
+
+        with open(os.path.join(model_dir,
+                               model_filename or io._PROGRAM_FILE)) as f:
+            meta = json.load(f)
+        prog = Program._from_dict(meta['program'])
+        axes = mesh_axes if mesh_axes is not None else prog.mesh_axes
+        if not axes:
+            raise ValueError(
+                'ShardedPredictor needs a mesh: the saved program at %r '
+                'carries no set_mesh spec and no mesh_axes= was given '
+                '(an un-annotated model belongs in inference.Predictor)'
+                % (model_dir,))
+        prog.set_mesh(dict(axes), data_axis=data_axis)
+        self._scope = Scope()
+        self._place = place or (core.TPUPlace(0)
+                                if core.is_compiled_with_tpu()
+                                else core.CPUPlace())
+        self._exe = Executor(self._place)
+        self._program = prog
+        self.feed_names = list(meta['feed_names'])
+        self._fetch_vars = [prog.global_block()._var_recursive(n)
+                            for n in meta['fetch_names']]
+        analysis.maybe_verify(
+            prog, where='predictor', feeds=list(self.feed_names),
+            fetches=[v.name for v in self._fetch_vars], concurrent=True)
+        self.mesh = parallel.make_mesh(dict(prog.mesh_axes))
+        self.state_step = None
+        if ckpt_dir is not None:
+            self._restore_sharded(ckpt_dir)
+        else:
+            # dense fallback: a small model saved the classic way still
+            # serves sharded (load_persistables reads the params file,
+            # load-time placement shards per the annotations)
+            io.load_persistables(self._exe, model_dir, prog,
+                                 scope=self._scope)
+
+    @staticmethod
+    def _referenced_names(program):
+        """Every var name an op of `program` reads/writes, including
+        names referenced through string attrs (control-flow rules
+        resolve env by attr name — the decode idiom)."""
+        out = set()
+
+        def from_attr(a):
+            if isinstance(a, str):
+                out.add(a)
+            elif isinstance(a, (list, tuple)):
+                for x in a:
+                    from_attr(x)
+            elif isinstance(a, dict):
+                for x in a.values():
+                    from_attr(x)
+
+        for blk in program.blocks:
+            for op in blk.ops:
+                for vs in list(op.inputs.values()) \
+                        + list(op.outputs.values()):
+                    for v in (vs if isinstance(vs, (list, tuple))
+                              else [vs]):
+                        out.add(getattr(v, 'name', v) if not
+                                isinstance(v, str) else v)
+                for a in op.attrs.values():
+                    from_attr(a)
+        return out
+
+    def _restore_sharded(self, ckpt_dir):
+        from ..utils import checkpoint as ck
+        # prune() keeps dead optimizer vars LISTED; only persistables an
+        # op actually references must come out of the checkpoint
+        used = self._referenced_names(self._program)
+        pvars = {v.name for v in self._program.list_vars()
+                 if v.persistable and v.name in used}
+        with obs.span('serving.sharded_restore',
+                      dir=os.path.basename(str(ckpt_dir))) as sp:
+            arrays, meta = ck.load_latest_verified(ckpt_dir,
+                                                   mesh=self.mesh)
+            # train-only state (optimizer moments) is legitimately
+            # absent from an inference program: filter BEFORE
+            # load_state_dict so the restore is quiet, then check the
+            # program side is fully covered
+            state = {n: a for n, a in arrays.items() if n in pvars}
+            self._exe.load_state_dict(state, self._program,
+                                      scope=self._scope)
+            missing = sorted(pvars - set(state))
+            if missing:
+                raise RuntimeError(
+                    'sharded checkpoint %r restores %d of %d program '
+                    'persistables; missing: %s — the serving program '
+                    'and the training checkpoint disagree'
+                    % (ckpt_dir, len(state), len(pvars), missing[:8]))
+            self.state_step = meta.get('step')
+            sp.fields['restored'] = len(state)
+            sp.fields['step'] = self.state_step
+
+    @property
+    def fetch_names(self):
+        return [v.name for v in self._fetch_vars]
+
+    @property
+    def input_spec(self):
+        blk = self._program.global_block()
+        spec = {}
+        for n in self.feed_names:
+            v = blk.vars.get(n)
+            if v is not None:
+                spec[n] = (tuple(int(d) for d in v.shape), str(v.dtype))
+        return spec
+
+    def shard_shapes(self):
+        """{name: per-device shard shape} for every multi-device
+        persistable — the never-dense assertion surface (a VOCAB-row
+        table on an 8-way mesh must report VOCAB/8 rows per device)."""
+        out = {}
+        for n, v in self._scope.vars.items():
+            shards = getattr(v, 'addressable_shards', None)
+            if shards and len(getattr(v.sharding, 'device_set', ())) > 1:
+                out[n] = tuple(shards[0].data.shape)
+        return out
+
+    def run(self, feed):
+        # the process-wide mesh-dispatch lock: a co-hosted replica's
+        # collectives must not interleave with ours (see _MESH_DISPATCH_LOCK)
+        with _MESH_DISPATCH_LOCK:
+            return self._exe.run(self._program, feed=feed,
+                                 fetch_list=self._fetch_vars,
+                                 scope=self._scope)
+
+
+def sharded_replica(model_dir, mesh_axes=None, ckpt_dir=None, config=None,
+                    warm=True, example_feed=None, **predictor_kwargs):
+    """One call from artifacts to a warmed sharded replica: build a
+    :class:`ShardedPredictor` and wrap it in a `ServingEngine` (every
+    bucket pre-compiled when `warm`). This is the builder shape the
+    pod's heal path wants: `lambda reason: sharded_replica(...)`."""
+    pred = ShardedPredictor(model_dir, mesh_axes=mesh_axes,
+                            ckpt_dir=ckpt_dir, **predictor_kwargs)
+    eng = ServingEngine(pred, config or ServingConfig())
+    if warm:
+        eng.warmup(example_feed)
+    return eng
+
+
+# ---------------------------------------------------------------------------
+# wire: filesystem mailboxes (the heartbeat/checkpoint posture)
+# ---------------------------------------------------------------------------
+
+def _registry_dir(pod_dir):
+    return os.path.join(pod_dir, 'registry')
+
+
+def _beats_dir(pod_dir):
+    return os.path.join(pod_dir, 'beats')
+
+
+def _spool_dir(pod_dir, key):
+    return os.path.join(pod_dir, 'spool', str(key))
+
+
+def _ctl_dir(pod_dir, host):
+    return os.path.join(pod_dir, 'ctl', 'h%d' % int(host))
+
+
+def _atomic_json(path, obj):
+    tmp = '%s.tmp%d' % (path, os.getpid())
+    with open(tmp, 'w') as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
+
+
+def _read_json(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _atomic_npz(path, **arrays):
+    tmp = '%s.tmp%d.npz' % (path, os.getpid())
+    with open(tmp, 'wb') as f:
+        np.savez(f, **arrays)
+    os.replace(tmp, path)
+
+
+# typed errors cross the wire by name — the caller gets the SAME typed
+# signal it would from an in-process engine (docs/serving.md#pod)
+_TYPED_ERRORS = {
+    'ServerOverloaded': ServerOverloaded,
+    'ServerClosed': ServerClosed,
+    'DeadlineExceeded': DeadlineExceeded,
+    'DeltaUnsupported': DeltaUnsupported,
+    'ValueError': ValueError,
+    'KeyError': KeyError,
+}
+
+
+def _encode_error(exc):
+    return json.dumps({'type': type(exc).__name__, 'message': str(exc)})
+
+
+def _decode_error(payload):
+    try:
+        d = json.loads(payload)
+    except ValueError:
+        return RuntimeError(str(payload))
+    cls = _TYPED_ERRORS.get(d.get('type'), RuntimeError)
+    return cls(d.get('message', 'remote replica error'))
+
+
+def _complete(fut, result=None, exc=None):
+    """Resolve a future that may have been cancelled (predict() timeout)
+    or already completed by a racing re-route — never raise into the
+    poller/worker thread."""
+    try:
+        if not fut.set_running_or_notify_cancel():
+            return False
+        if exc is not None:
+            fut.set_exception(exc)
+        else:
+            fut.set_result(result)
+        return True
+    except Exception:        # InvalidStateError: already resolved
+        return False
+
+
+def _chain(src, dst):
+    """Copy src's outcome into dst when src resolves (the re-route
+    splice: the caller keeps ITS future; a survivor's future feeds it)."""
+    def cb(f):
+        if f.cancelled():
+            dst.cancel()
+            return
+        e = f.exception()
+        if e is not None:
+            _complete(dst, exc=e)
+        else:
+            _complete(dst, result=f.result())
+    src.add_done_callback(cb)
+
+
+# ---------------------------------------------------------------------------
+# PodWorker: a host's replicas, served from the shared registry
+# ---------------------------------------------------------------------------
+
+class PodWorker(object):
+    """One serving HOST of the pod: registers replicas into the shared
+    registry, answers their request spools, heartbeats, and heals —
+    builds replacement replicas on a `heal` control command through the
+    builders it was constructed with (docs/serving.md#pod).
+
+    pod_dir: the shared directory (every host + the router must see it;
+        the checkpoint filesystem is the natural choice).
+    host: this host's integer id (beat files are per-host).
+    builders: {model_id: callable(reason) -> warmed engine} — the heal
+        path; a host with no builder for a model simply never receives
+        its heal commands. `sharded_replica` closures are the intended
+        shape: the replacement re-shards the checkpoint onto THIS
+        host's topology (`load_latest_verified(mesh=...)`).
+    """
+
+    def __init__(self, pod_dir, host, builders=None, beat_interval=0.25,
+                 stats_interval_s=0.2, poll_s=_POLL_S):
+        from ..parallel import Heartbeat
+        self.pod_dir = str(pod_dir)
+        self.host = int(host)
+        self._builders = dict(builders or {})
+        self._poll_s = float(poll_s)
+        self._stats_every = float(stats_interval_s)
+        for d in (_registry_dir(self.pod_dir), _beats_dir(self.pod_dir),
+                  _ctl_dir(self.pod_dir, self.host)):
+            os.makedirs(d, exist_ok=True)
+        self._lock = threading.Lock()
+        self._replicas = {}          # key -> dict(engine, thread, stop)
+        self._serial = 0
+        self._stop = threading.Event()
+        self._frozen = False         # simulate_death(): loops stall
+        self.heartbeat = Heartbeat(_beats_dir(self.pod_dir),
+                                   process_id=self.host, num_processes=0,
+                                   interval=beat_interval)
+        self.heartbeat.start()
+        _atomic_json(os.path.join(_registry_dir(self.pod_dir),
+                                  'host.%d.json' % self.host),
+                     {'host': self.host, 'pid': os.getpid(),
+                      'builders': sorted(str(m) for m in self._builders)})
+        self._ctl_thread = threading.Thread(
+            target=self._ctl_loop, name='pod-worker-ctl-h%d' % self.host,
+            daemon=True)
+        self._ctl_thread.start()
+
+    # -- replica lifecycle -------------------------------------------------
+
+    def serve(self, model_id, engine, name=None, heal_token=None,
+              mesh=None):
+        """Register `engine` as a replica of `model_id` and start
+        answering its spool. Returns the registry key. The engine should
+        already be WARM (every bucket pre-compiled) — registration makes
+        it routable immediately."""
+        with self._lock:
+            self._serial += 1
+            key = '%d.%s' % (self.host,
+                             name if name is not None else
+                             '%s-%d' % (model_id, self._serial))
+            if key in self._replicas:
+                raise ValueError('replica key %r already served' % key)
+        spool = _spool_dir(self.pod_dir, key)
+        os.makedirs(spool, exist_ok=True)
+        if mesh is None:
+            prog = getattr(getattr(engine, '_model', None), '_program',
+                           None)
+            axes = getattr(prog, 'mesh_axes', None)
+            mesh = sorted(axes.items()) if axes else None
+        stop = threading.Event()
+        rec = {'engine': engine, 'stop': stop, 'spool': spool,
+               'model_id': str(model_id)}
+        t = threading.Thread(target=self._replica_loop, args=(key, rec),
+                             name='pod-worker-%s' % key, daemon=True)
+        rec['thread'] = t
+        with self._lock:
+            self._replicas[key] = rec
+        self._publish_stats(key, rec)       # stats exist before routing
+        reg = {'model_id': str(model_id), 'host': self.host, 'key': key,
+               'pid': os.getpid(), 'mesh': mesh,
+               'feed_names': list(getattr(engine, 'feed_names', []) or []),
+               'buckets': [int(b) for b in
+                           getattr(engine, 'buckets', ()) or ()]}
+        if heal_token is not None:
+            reg['heal_token'] = str(heal_token)
+        t.start()
+        _atomic_json(os.path.join(_registry_dir(self.pod_dir),
+                                  'replica.%s.json' % key), reg)
+        obs.event('serving.replica.register', model=str(model_id),
+                  host=self.host, key=key,
+                  healed=heal_token is not None)
+        return key
+
+    def retire(self, key, drain=True, timeout=None):
+        """Deregister one replica (registry entry removed first, so the
+        router stops routing to it) and drain its engine."""
+        with self._lock:
+            rec = self._replicas.pop(key, None)
+        if rec is None:
+            return False
+        try:
+            os.remove(os.path.join(_registry_dir(self.pod_dir),
+                                   'replica.%s.json' % key))
+        except OSError:
+            pass
+        rec['stop'].set()
+        rec['thread'].join(timeout or 10.0)
+        ok = rec['engine'].shutdown(drain=drain, timeout=timeout)
+        obs.event('serving.replica.drain', model=rec['model_id'],
+                  host=self.host, key=key, drain=bool(drain),
+                  reason='retired')
+        return ok
+
+    def served(self):
+        with self._lock:
+            return sorted(self._replicas)
+
+    def shutdown(self, drain=True, timeout=None):
+        """Retire every replica, stop the heartbeat (peers will judge
+        this host stale, correct for a stopping host), remove the host
+        registration."""
+        self._stop.set()
+        ok = True
+        for key in self.served():
+            ok = self.retire(key, drain=drain, timeout=timeout) and ok
+        self.heartbeat.stop()
+        try:
+            os.remove(os.path.join(_registry_dir(self.pod_dir),
+                                   'host.%d.json' % self.host))
+        except OSError:
+            pass
+        return ok
+
+    def simulate_death(self):
+        """Test harness: stop beating and freeze every loop WITHOUT
+        cleanup — indistinguishable from a SIGKILLed host to the
+        router (beats stale, registration files orphaned, spooled
+        requests never answered)."""
+        self._frozen = True
+        self.heartbeat.stop()
+
+    # -- spool service -----------------------------------------------------
+
+    def _replica_loop(self, key, rec):
+        engine, spool, stop = rec['engine'], rec['spool'], rec['stop']
+        # requests taken but not yet answered: a request file stays on
+        # disk until its response is written (crash-visible), so the
+        # scan must skip what it already submitted
+        rec['inflight'] = set()
+        last_stats = 0.0
+        while not stop.is_set() and not self._stop.is_set():
+            if self._frozen:
+                time.sleep(self._poll_s)
+                continue
+            try:
+                names = sorted(os.listdir(spool))
+            except OSError:
+                names = []
+            worked = False
+            for fname in names:
+                if stop.is_set() or self._frozen:
+                    break
+                path = os.path.join(spool, fname)
+                if fname.startswith('rq.') and fname.endswith('.npz'):
+                    if fname[3:-4] in rec['inflight']:
+                        continue
+                    worked = True
+                    self._serve_request(engine, spool, path, fname,
+                                        rec['inflight'])
+                elif fname.startswith('push.') and fname.endswith('.npz'):
+                    worked = True
+                    self._serve_push(engine, spool, path, fname)
+                elif fname == 'retire.json':
+                    os.remove(path)
+                    # deregister THEN drain, like retire()
+                    threading.Thread(target=self.retire, args=(key,),
+                                     daemon=True).start()
+                    return
+            now = time.monotonic()
+            if now - last_stats >= self._stats_every:
+                self._publish_stats(key, rec)
+                last_stats = now
+            if not worked:
+                time.sleep(self._poll_s)
+
+    def _serve_request(self, engine, spool, path, fname, inflight):
+        uid = fname[3:-4]
+        rs = os.path.join(spool, 'rs.%s.npz' % uid)
+        inflight.add(uid)
+
+        def respond(outs=None, exc=None):
+            try:
+                if exc is not None:
+                    _atomic_npz(rs, __error__=np.frombuffer(
+                        _encode_error(exc).encode(), np.uint8))
+                else:
+                    _atomic_npz(rs, **{'o:%d' % i: np.asarray(o)
+                                       for i, o in enumerate(outs)})
+            except Exception:
+                pass
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            inflight.discard(uid)
+
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                kwargs = json.loads(bytes(z['__meta__']).decode())
+                feed = {k[2:]: z[k] for k in z.files if k.startswith('f:')}
+        except Exception:
+            # torn/unreadable request: leave it one cycle (the writer
+            # replaces atomically, so this is a transient FS hiccup)
+            inflight.discard(uid)
+            return
+        try:
+            fut = engine.submit(feed, **kwargs)
+        except Exception as e:  # noqa: BLE001 — typed back to the caller
+            respond(exc=e)
+            return
+        fut.add_done_callback(lambda f: respond(
+            outs=None if f.exception() else f.result(),
+            exc=f.exception()))
+
+    def _serve_push(self, engine, spool, path, fname):
+        uid = fname[5:-4]
+        ack = os.path.join(spool, 'pushok.%s.json' % uid)
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                deltas = {}
+                for k in z.files:
+                    if k.startswith('i:'):
+                        name = k[2:]
+                        deltas[name] = (z[k], z['r:%s' % name])
+            rows = engine.push_rows(deltas)
+            _atomic_json(ack, {'ok': True, 'rows': int(rows)})
+        except Exception as e:  # noqa: BLE001 — typed back to the caller
+            _atomic_json(ack, {'ok': False,
+                               'error': _encode_error(e)})
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+    def _publish_stats(self, key, rec):
+        engine = rec['engine']
+        cum = rec.setdefault('cum', collections.Counter())
+        try:
+            win = engine.stats_window()
+        except Exception:
+            return
+        live = {}
+        for k in ('queue_depth', 'inflight', 'capacity', 'slots',
+                  'pages_free', 'pages_total'):
+            if k in win:
+                live[k] = win.pop(k)
+        hw = win.pop('queue_high_water', 0)
+        for k, v in win.items():
+            if isinstance(v, (int, float)):
+                cum[k] += v
+        exe = getattr(getattr(engine, '_model', None), '_exe', None)
+        cache = {}
+        if exe is not None:
+            cs = exe.cache_stats
+            cache = {'online_compiles': cs.get('online_compiles'),
+                     'misses': cs.get('misses')}
+        rec['stats_seq'] = rec.get('stats_seq', 0) + 1
+        _atomic_json(os.path.join(rec['spool'], 'stats.json'),
+                     {'seq': rec['stats_seq'], 'cum': dict(cum),
+                      'live': live, 'queue_high_water': hw,
+                      'cache': cache})
+
+    # -- control: heal commands --------------------------------------------
+
+    def _ctl_loop(self):
+        ctl = _ctl_dir(self.pod_dir, self.host)
+        while not self._stop.is_set():
+            if self._frozen:
+                time.sleep(self._poll_s)
+                continue
+            try:
+                names = sorted(os.listdir(ctl))
+            except OSError:
+                names = []
+            for fname in names:
+                if not (fname.startswith('cmd.')
+                        and fname.endswith('.json')):
+                    continue
+                path = os.path.join(ctl, fname)
+                cmd = _read_json(path)
+                if cmd is None:
+                    continue
+                try:
+                    os.remove(path)
+                except OSError:
+                    continue   # another thread/incarnation took it
+                if cmd.get('cmd') == 'heal':
+                    self._heal(cmd)
+            time.sleep(self._poll_s)
+
+    def _heal(self, cmd):
+        model_id = cmd.get('model')
+        token = cmd.get('token')
+        builder = self._builders.get(model_id)
+        if builder is None:
+            self._heal_failed(token, 'host %d has no builder for %r'
+                              % (self.host, model_id))
+            return
+        try:
+            with obs.span('serving.replica.build', model=str(model_id),
+                          host=self.host, reason=cmd.get('reason')):
+                engine = builder(cmd.get('reason', 'heal'))
+            key = self.serve(model_id, engine, heal_token=token)
+        except Exception as e:  # noqa: BLE001 — report, don't die
+            self._heal_failed(token, '%s: %s' % (type(e).__name__, e))
+            return
+        obs.event('serving.replica.reshard', model=str(model_id),
+                  host=self.host, key=key, token=str(token),
+                  reason=cmd.get('reason'),
+                  lost_host=cmd.get('lost_host'))
+
+    def _heal_failed(self, token, why):
+        obs.event('serving.pod.heal_failed', host=self.host,
+                  token=str(token), error=str(why)[:200])
+        if token:
+            _atomic_json(os.path.join(_registry_dir(self.pod_dir),
+                                      'healfail.%s.json' % token),
+                         {'token': token, 'host': self.host,
+                          'error': str(why)[:500]})
+
+
+# ---------------------------------------------------------------------------
+# RemoteReplica: the engine-protocol proxy the router balances on
+# ---------------------------------------------------------------------------
+
+class RemoteReplica(object):
+    """Engine-protocol proxy for one registered replica on another
+    host: submit/predict/stats_window/push_rows/shutdown look exactly
+    like a local engine's, so `Router` (and everything riding it —
+    quotas, push_deltas, drain) works unchanged across process
+    boundaries. Requests travel as atomic files through the replica's
+    spool; the proxy keeps every in-flight request's feed until its
+    response lands, which is what makes host-loss re-routing LOSSLESS
+    (`take_pending`)."""
+
+    def __init__(self, pod_dir, reg, poll_s=_POLL_S):
+        self.pod_dir = str(pod_dir)
+        self.reg = dict(reg)
+        self.key = reg['key']
+        self.host = int(reg['host'])
+        self.model_id = reg.get('model_id')
+        self.feed_names = list(reg.get('feed_names') or [])
+        self.buckets = tuple(reg.get('buckets') or ())
+        self._spool = _spool_dir(self.pod_dir, self.key)
+        self._poll_s = float(poll_s)
+        self._lock = threading.Lock()
+        self._pending = {}           # uid -> (future, feed, kwargs)
+        self._seq = 0
+        self._closed = False
+        self._detached = False
+        self._last_cum = collections.Counter()
+        self._last_stats = {}
+        self._thread = threading.Thread(
+            target=self._poll_loop, name='pod-proxy-%s' % self.key,
+            daemon=True)
+        self._thread.start()
+
+    # -- engine protocol ---------------------------------------------------
+
+    def submit(self, feed, **kwargs):
+        import concurrent.futures
+        if self._closed:
+            raise ServerClosed('remote replica %s is closed' % self.key)
+        arrays = {str(n): np.asarray(a) for n, a in feed.items()}
+        with self._lock:
+            self._seq += 1
+            uid = '%06d-%s' % (self._seq, uuid.uuid4().hex[:8])
+            fut = concurrent.futures.Future()
+            self._pending[uid] = (fut, arrays, dict(kwargs))
+        payload = {'f:%s' % n: a for n, a in arrays.items()}
+        payload['__meta__'] = np.frombuffer(
+            json.dumps(kwargs).encode(), np.uint8)
+        try:
+            _atomic_npz(os.path.join(self._spool, 'rq.%s.npz' % uid),
+                        **payload)
+        except OSError as e:
+            with self._lock:
+                self._pending.pop(uid, None)
+            raise ServerClosed('replica %s spool unreachable: %s'
+                               % (self.key, e))
+        return fut
+
+    def predict(self, feed, timeout=None, **kwargs):
+        fut = self.submit(feed, timeout=timeout, **kwargs)
+        return fut.result(timeout)
+
+    def warmup(self, example_feed=None):
+        # the worker warmed the engine before registering it; the
+        # router-side contract (every bucket pre-compiled) already holds
+        return list(self.buckets)
+
+    def stats_window(self):
+        """Window semantics preserved remotely: the worker publishes
+        CUMULATIVE counters; the proxy diffs against its last read —
+        read-and-reset, single consumer, exactly like the local
+        engines. Live depth is the max of the published depth and this
+        proxy's own in-flight count (the truest signal between
+        publishes)."""
+        st = _read_json(os.path.join(self._spool, 'stats.json')) or {}
+        cum = collections.Counter(
+            {k: v for k, v in (st.get('cum') or {}).items()
+             if isinstance(v, (int, float))})
+        win = dict(cum - self._last_cum)
+        self._last_cum = cum
+        self._last_stats = st
+        live = st.get('live') or {}
+        with self._lock:
+            outstanding = len(self._pending)
+        win['queue_depth'] = max(int(live.get('queue_depth', 0)),
+                                 outstanding)
+        win['inflight'] = int(live.get('inflight', 0))
+        win['queue_high_water'] = max(int(st.get('queue_high_water', 0)),
+                                      outstanding)
+        win['capacity'] = live.get('capacity', 0)
+        for k in ('slots', 'pages_free', 'pages_total'):
+            if k in live:
+                win[k] = live[k]
+        return win
+
+    def cache_stats(self):
+        """The remote replica's published compile counters (the
+        steady-state-compiles assertion surface) — read fresh from the
+        worker's latest stats publish."""
+        st = _read_json(os.path.join(self._spool, 'stats.json')) \
+            or self._last_stats or {}
+        return dict(st.get('cache') or {})
+
+    def push_rows(self, deltas, timeout=30.0):
+        if self._closed:
+            raise ServerClosed('remote replica %s is closed' % self.key)
+        uid = uuid.uuid4().hex[:12]
+        payload = {}
+        for name in sorted(deltas):
+            ids, rows = deltas[name]
+            payload['i:%s' % name] = np.asarray(ids)
+            payload['r:%s' % name] = np.asarray(rows)
+        _atomic_npz(os.path.join(self._spool, 'push.%s.npz' % uid),
+                    **payload)
+        ack_path = os.path.join(self._spool, 'pushok.%s.json' % uid)
+        deadline = time.monotonic() + float(timeout)
+        while time.monotonic() < deadline:
+            ack = _read_json(ack_path)
+            if ack is not None:
+                try:
+                    os.remove(ack_path)
+                except OSError:
+                    pass
+                if ack.get('ok'):
+                    return int(ack.get('rows', 0))
+                raise _decode_error(ack.get('error', '{}'))
+            if self._closed:
+                break
+            time.sleep(self._poll_s)
+        raise ServerClosed(
+            'remote replica %s did not acknowledge a %d-table delta '
+            'push within %.1fs (host gone?)'
+            % (self.key, len(deltas), timeout))
+
+    def shutdown(self, drain=True, timeout=None):
+        """Retire the remote replica: the worker deregisters it first
+        (no new routing) then drains its engine; this proxy waits for
+        its own in-flight responses."""
+        if self._detached:
+            self._closed = True
+            return True
+        self._closed = True     # no NEW submits through this proxy
+        try:
+            _atomic_json(os.path.join(self._spool, 'retire.json'),
+                         {'drain': bool(drain)})
+        except OSError:
+            pass
+        deadline = None if timeout is None \
+            else time.monotonic() + float(timeout)
+        while drain:
+            with self._lock:
+                n = len(self._pending)
+            if n == 0:
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(self._poll_s)
+        return True
+
+    # -- host-loss seam ----------------------------------------------------
+
+    def take_pending(self):
+        """Atomically detach every unanswered request — (future, feed,
+        kwargs) triples the router re-routes to survivors. The proxy
+        stops accepting new submits; a LATE response (the host was slow,
+        not dead) still resolves any future the re-route has not beaten
+        (first outcome wins, the other is dropped)."""
+        self._closed = True
+        self._detached = True
+        self._detach_t = time.monotonic()
+        with self._lock:
+            pending = list(self._pending.values())
+            # keep the map: a late rs file may still win the race
+        return pending
+
+    def outstanding(self):
+        with self._lock:
+            return len(self._pending)
+
+    def _poll_loop(self):
+        while True:
+            try:
+                names = os.listdir(self._spool)
+            except OSError:
+                names = []
+            got = False
+            for fname in names:
+                if not (fname.startswith('rs.')
+                        and fname.endswith('.npz')):
+                    continue
+                uid = fname[3:-4]
+                with self._lock:
+                    entry = self._pending.pop(uid, None)
+                path = os.path.join(self._spool, fname)
+                if entry is None:
+                    try:
+                        os.remove(path)   # cancelled/duplicate response
+                    except OSError:
+                        pass
+                    continue
+                got = True
+                fut = entry[0]
+                try:
+                    with np.load(path, allow_pickle=False) as z:
+                        if '__error__' in z.files:
+                            _complete(fut, exc=_decode_error(
+                                bytes(z['__error__']).decode()))
+                        else:
+                            outs = [z['o:%d' % i]
+                                    for i in range(len(z.files))]
+                            _complete(fut, result=outs)
+                except Exception:
+                    # torn read: put it back for the next cycle
+                    with self._lock:
+                        self._pending.setdefault(uid, entry)
+                    continue
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+            if self._closed and not got:
+                if not self._pending:
+                    return
+                # detached (host lost): late responses get a bounded
+                # grace window, then the re-routed futures own the
+                # outcome and this poller retires
+                t0 = getattr(self, '_detach_t', None)
+                if t0 is not None and time.monotonic() - t0 > 5.0:
+                    return
+            if not got:
+                time.sleep(self._poll_s)
+
+
+# ---------------------------------------------------------------------------
+# autoscaling: queue-depth-driven capacity, riding the swap machinery
+# ---------------------------------------------------------------------------
+
+class AutoscalePolicy(object):
+    """When to grow/shrink a model's replica set (docs/serving.md#pod).
+
+    scale_up_at / scale_down_at: thresholds on the PER-REPLICA windowed
+        admission pressure (queue high-water + depth + in-flight, the
+        same signal least-loaded dispatch balances on). Above the first
+        for a full window -> one replica is added; below the second ->
+        one is drained.
+    cooldown_s: minimum seconds between scaling actions (a heal takes
+        time to land; don't storm).
+    """
+
+    def __init__(self, min_replicas=1, max_replicas=4, scale_up_at=4.0,
+                 scale_down_at=0.5, cooldown_s=5.0):
+        if min_replicas < 1 or max_replicas < min_replicas:
+            raise ValueError('need 1 <= min_replicas <= max_replicas')
+        if scale_down_at >= scale_up_at:
+            raise ValueError('scale_down_at must be < scale_up_at')
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.scale_up_at = float(scale_up_at)
+        self.scale_down_at = float(scale_down_at)
+        self.cooldown_s = float(cooldown_s)
+
+
+class Autoscaler(object):
+    """Queue-depth-driven replica scale-up/down for one model, riding
+    the router's zero-downtime machinery: scale-UP builds + warms the
+    incoming replica OFF TO THE SIDE (the swap() discipline — traffic
+    never sees a cold compile) then `add_replica`s it atomically;
+    scale-DOWN `remove_replica`s the least-loaded one and drains it in
+    the background (no future lost). `builder(reason) -> warmed engine`
+    adds in-process; a PodRouter wires `scale_up=` to a heal command so
+    the new replica lands on the least-loaded HOST instead."""
+
+    def __init__(self, router, model_id, policy, builder=None,
+                 scale_up=None):
+        if builder is None and scale_up is None:
+            raise ValueError('Autoscaler needs builder= or scale_up=')
+        self.router = router
+        self.model_id = model_id
+        self.policy = policy
+        self._builder = builder
+        self._scale_up = scale_up
+        self._last_action_t = None
+        self._building = False     # an async scale-up build in flight
+        self.actions = []          # ('up'|'down', pressure) history
+
+    def pressure(self):
+        """Mean per-replica windowed admission pressure."""
+        samples = self.router.sample_windows(self.model_id)
+        if not samples:
+            return None
+        per = []
+        for s in samples:
+            w = s['window']
+            per.append(w.get('queue_depth', 0) + w.get('inflight', 0)
+                       + w.get('queue_high_water', 0)
+                       + s.get('routed_since', 0))
+        return float(sum(per)) / len(per)
+
+    def tick(self):
+        """One policy evaluation; returns 'up', 'down', or None. The
+        pod/poll loop calls this each cycle; tests call it directly."""
+        pol = self.policy
+        now = time.monotonic()
+        if self._last_action_t is not None \
+                and now - self._last_action_t < pol.cooldown_s:
+            return None
+        p = self.pressure()
+        if p is None:
+            return None
+        n = len(self.router.replicas(self.model_id))
+        if p >= pol.scale_up_at and n < pol.max_replicas:
+            if self._building:
+                return None        # last scale-up is still building
+            self._last_action_t = now
+            obs.event('serving.autoscale', model=str(self.model_id),
+                      direction='up', replicas=n, pressure=round(p, 3))
+            if self._scale_up is not None:
+                self._scale_up('scale_up')
+            else:
+                # build + warm OFF the caller's thread (tick runs
+                # inside PodRouter.poll — a minutes-long sharded
+                # restore must not stall host-loss detection), then
+                # add atomically: the swap() discipline
+                self._building = True
+
+                def build():
+                    try:
+                        engine = self._builder('scale_up')
+                        self.router.add_replica(self.model_id, engine)
+                    except Exception as e:  # noqa: BLE001 — report
+                        obs.event('serving.autoscale.error',
+                                  model=str(self.model_id),
+                                  error='%s: %s' % (type(e).__name__, e))
+                    finally:
+                        self._building = False
+
+                threading.Thread(target=build, name='autoscale-build',
+                                 daemon=True).start()
+            self.actions.append(('up', p))
+            return 'up'
+        if p <= pol.scale_down_at and n > pol.min_replicas:
+            self._last_action_t = now
+            victim = min(self.router.sample_windows(self.model_id),
+                         key=lambda s: (
+                             s['window'].get('queue_depth', 0)
+                             + s['window'].get('inflight', 0)
+                             + s.get('routed_since', 0)))
+            obs.event('serving.autoscale', model=str(self.model_id),
+                      direction='down', replicas=n,
+                      pressure=round(p, 3), rid=victim['rid'])
+            self.router.remove_replica(self.model_id, victim['rid'],
+                                       drain=True, reason='scale_down')
+            self.actions.append(('down', p))
+            return 'down'
+        return None
+
+
+# ---------------------------------------------------------------------------
+# PodRouter: registry-driven routing + host-loss self-healing
+# ---------------------------------------------------------------------------
+
+class PodRouter(Router):
+    """A Router whose replicas live on OTHER hosts, discovered through
+    the shared-filesystem registry PodWorkers publish into
+    (docs/serving.md#pod). Everything the single-process Router does —
+    least-loaded dispatch, quotas, typed overload, swap, push_deltas —
+    runs unchanged over RemoteReplica proxies; on top of it:
+
+      * registry sync: new replica registrations become routable
+        replicas (serving.replica.register), voluntary retirements are
+        removed cleanly;
+      * host-loss: a host whose heartbeat goes stale raises the typed
+        `HostLost` inside the poll loop; its replicas are detached, the
+        futures pending against them RE-ROUTED to survivors (zero
+        dropped futures), and — with heal=True — a heal command asks
+        the least-loaded surviving host with a builder to re-shard the
+        replica onto its topology (serving.replica.{lost,reshard});
+      * autoscaling: `enable_autoscale` ticks an Autoscaler per poll,
+        scaling through heal commands (up) / draining removals (down).
+
+    Call `poll()` for one deterministic pass (tests), or rely on the
+    background thread (`poll_s` cadence)."""
+
+    def __init__(self, pod_dir, window_s=0.25, poll_s=0.1,
+                 heartbeat_timeout=2.0, heal=True, reroute_timeout=30.0,
+                 start=True):
+        from ..parallel import Heartbeat
+        Router.__init__(self, window_s=window_s)
+        self.pod_dir = str(pod_dir)
+        for d in (_registry_dir(self.pod_dir), _beats_dir(self.pod_dir)):
+            os.makedirs(d, exist_ok=True)
+        self.heal = bool(heal)
+        self._poll_s = float(poll_s)
+        self._reroute_timeout = float(reroute_timeout)
+        # pure watcher: beats nothing, watches hosts as they register
+        self.heartbeat = Heartbeat(_beats_dir(self.pod_dir),
+                                   process_id=-1, num_processes=0,
+                                   timeout=heartbeat_timeout)
+        self._pod_lock = threading.RLock()
+        self._known = {}        # key -> dict(rid, proxy, model_id, host)
+        self._hosts = {}        # host -> registration dict
+        self._heals = {}        # token -> dict(model, lost_host, host, t)
+        self._parked = []       # [(model_id, fut, feed, kwargs, t_expire)]
+        self._autoscalers = {}
+        self.lost_hosts = []    # [{'host', 'stale', 'error', ...}]
+        self._stop = threading.Event()
+        self._thread = None
+        if start:
+            self._thread = threading.Thread(
+                target=self._pod_loop, name='pod-router', daemon=True)
+            self._thread.start()
+
+    # -- registry sync -----------------------------------------------------
+
+    def poll(self):
+        """One synchronous registry/heartbeat/parked/autoscale pass."""
+        with self._pod_lock:
+            self._sync_hosts()
+            self._sync_registry()
+            self._check_hosts()
+            self._retry_parked()
+            self._check_heal_failures()
+            for a in list(self._autoscalers.values()):
+                try:
+                    a.tick()
+                except Exception as e:  # noqa: BLE001 — keep polling
+                    obs.event('serving.autoscale.error',
+                              error='%s: %s' % (type(e).__name__, e))
+
+    def _pod_loop(self):
+        while not self._stop.wait(self._poll_s):
+            try:
+                self.poll()
+            except Exception as e:  # noqa: BLE001 — the loop must live
+                obs.event('router.pod.error',
+                          error='%s: %s' % (type(e).__name__, e))
+
+    def _sync_hosts(self):
+        reg = _registry_dir(self.pod_dir)
+        try:
+            names = os.listdir(reg)
+        except OSError:
+            names = []
+        hosts = {}
+        for fname in names:
+            if fname.startswith('host.') and fname.endswith('.json'):
+                d = _read_json(os.path.join(reg, fname))
+                if d is not None and 'host' in d:
+                    hosts[int(d['host'])] = d
+        # watch EVERY advertised host — a builder-only host (no
+        # replicas yet) must still be disqualified as a heal candidate
+        # the moment its beats go stale; a host whose file vanished
+        # (clean shutdown, or the host-loss janitor) stops being
+        # watched so it cannot read as a fresh loss forever
+        for h in hosts:
+            if h not in self._hosts:
+                self.heartbeat.watch(h)
+        for h in self._hosts:
+            if h not in hosts \
+                    and not any(i['host'] == h
+                                for i in self._known.values()):
+                self.heartbeat.unwatch(h)
+        self._hosts = hosts
+
+    def _sync_registry(self):
+        reg = _registry_dir(self.pod_dir)
+        try:
+            names = os.listdir(reg)
+        except OSError:
+            names = []
+        seen = set()
+        for fname in names:
+            if not (fname.startswith('replica.')
+                    and fname.endswith('.json')):
+                continue
+            d = _read_json(os.path.join(reg, fname))
+            if d is None or 'key' not in d:
+                continue
+            key = d['key']
+            seen.add(key)
+            if key in self._known:
+                continue
+            proxy = RemoteReplica(self.pod_dir, d, poll_s=self._poll_s)
+            model_id = d.get('model_id')
+            if model_id not in self._models:
+                self.add_model(model_id, [proxy])
+                with self._lock:
+                    r = self._models[model_id].replicas[-1]
+                    r.host, r.key = proxy.host, key
+                    rid = r.rid
+                    self._update_gauge_locked()
+                obs.event('serving.replica.register',
+                          model=str(model_id), rid=rid,
+                          host=proxy.host, key=key)
+            else:
+                rid = self.add_replica(model_id, proxy,
+                                       host=proxy.host, key=key)
+            self.heartbeat.watch(proxy.host)
+            self._known[key] = {'rid': rid, 'proxy': proxy,
+                                'model_id': model_id, 'host': proxy.host}
+            token = d.get('heal_token')
+            if token and token in self._heals:
+                h = self._heals.pop(token)
+                obs.event('serving.replica.reshard',
+                          model=str(model_id), host=proxy.host, key=key,
+                          token=str(token), lost_host=h.get('lost_host'),
+                          mesh=d.get('mesh'),
+                          heal_s=round(time.monotonic() - h['t'], 3))
+        # voluntary retirement: the registration file vanished but the
+        # host still beats — remove the replica; its worker drains it
+        gone = sorted(set(self._known) - seen)
+        stale = set(self.heartbeat.check(raise_error=False)) if gone \
+            else ()
+        for key in gone:
+            info = self._known[key]
+            host = info['host']
+            if host in stale:
+                continue    # host is stale: _check_hosts owns this key
+            self._known.pop(key)
+            self.remove_replica(info['model_id'], info['rid'],
+                                drain=False, reason='retired')
+            info['proxy'].shutdown(drain=True, timeout=0)
+            if not any(i['host'] == host for i in self._known.values()):
+                self.heartbeat.unwatch(host)
+
+    # -- host loss: detach, re-route, heal ---------------------------------
+
+    def _check_hosts(self):
+        from ..parallel import HostLost
+        try:
+            self.heartbeat.check(raise_error=True)
+            return
+        except HostLost as e:
+            stale = [h for h in e.stale
+                     if any(i['host'] == h for i in self._known.values())]
+            if not stale:
+                return
+            for host in stale:
+                self._host_lost(host, e)
+
+    def _host_lost(self, host, exc):
+        record = {'host': host, 'stale': list(exc.stale),
+                  'error': '%s: %s' % (type(exc).__name__, exc),
+                  'replicas': 0, 'rerouted': 0, 'healed_models': []}
+        lost_models = []
+        for key, info in sorted(self._known.items()):
+            if info['host'] != host:
+                continue
+            self._known.pop(key)
+            # janitor the orphaned registration (a SIGKILLed host can't
+            # clean up its own files) — otherwise the next registry
+            # sync would re-adopt the dead replica; a RESTARTED host
+            # writes a fresh file and is re-adopted normally
+            try:
+                os.remove(os.path.join(_registry_dir(self.pod_dir),
+                                       'replica.%s.json' % key))
+            except OSError:
+                pass
+            record['replicas'] += 1
+            proxy, model_id = info['proxy'], info['model_id']
+            pending = proxy.take_pending()
+            self.remove_replica(model_id, info['rid'], drain=False,
+                                reason='host_lost')
+            obs.event('serving.replica.lost', model=str(model_id),
+                      rid=info['rid'], host=host, key=key,
+                      pending=len(pending))
+            lost_models.append(model_id)
+            t_exp = time.monotonic() + self._reroute_timeout
+            for fut, feed, kwargs in pending:
+                if fut.done():
+                    continue
+                self._reroute(model_id, fut, feed, kwargs, t_exp,
+                              record)
+        self.heartbeat.unwatch(host)
+        # janitor the dead host's advert too: it must stop being a heal/
+        # autoscale candidate NOW (a restarted host re-registers fresh)
+        try:
+            os.remove(os.path.join(_registry_dir(self.pod_dir),
+                                   'host.%d.json' % host))
+        except OSError:
+            pass
+        self._hosts.pop(host, None)
+        if self.heal:
+            for model_id in sorted(set(lost_models)):
+                token = self.request_heal(model_id, reason='host_lost',
+                                          lost_host=host)
+                if token is not None:
+                    record['healed_models'].append(model_id)
+        self.lost_hosts.append(record)
+        obs.event('router.host_lost', host=host,
+                  replicas=record['replicas'],
+                  rerouted=record['rerouted'],
+                  heals=len(record['healed_models']))
+
+    def _reroute(self, model_id, fut, feed, kwargs, t_expire,
+                 record=None):
+        """Send a detached request to a survivor, splicing the result
+        into the caller's ORIGINAL future. Unroutable now (no survivor
+        yet) -> parked and retried each poll until t_expire."""
+        try:
+            new_fut = self.submit(model_id, feed, **kwargs)
+        except Exception:  # noqa: BLE001 — park: a heal may be coming
+            self._parked.append((model_id, fut, feed, kwargs, t_expire))
+            return False
+        _chain(new_fut, fut)
+        _C_REROUTED.inc()
+        if record is not None:
+            record['rerouted'] += 1
+        obs.event('serving.pod.reroute', model=str(model_id))
+        return True
+
+    def _retry_parked(self):
+        from ..parallel import HostLost
+        parked, self._parked = self._parked, []
+        now = time.monotonic()
+        for model_id, fut, feed, kwargs, t_exp in parked:
+            if fut.done():
+                continue
+            if now > t_exp:
+                _complete(fut, exc=HostLost(
+                    'request could not be re-routed within %.1fs of its '
+                    'serving host dying (no survivor took it)'
+                    % self._reroute_timeout))
+                continue
+            self._reroute(model_id, fut, feed, kwargs, t_exp)
+
+    # -- healing -----------------------------------------------------------
+
+    def request_heal(self, model_id, reason='heal', lost_host=None,
+                     exclude_hosts=()):
+        """Ask the least-loaded live host with a builder for `model_id`
+        to build+register a replacement replica (it re-shards the
+        checkpoint onto its own topology). Returns the heal token, or
+        None when no candidate host exists (retried implicitly when a
+        capable host appears? no — callers re-request)."""
+        stale = set(self.heartbeat.check(raise_error=False))
+        if lost_host is not None:
+            stale.add(lost_host)
+        stale.update(exclude_hosts)
+        cands = [h for h, d in sorted(self._hosts.items())
+                 if h not in stale
+                 and str(model_id) in (d.get('builders') or [])]
+        if not cands:
+            obs.event('serving.pod.heal_unroutable',
+                      model=str(model_id), reason=reason)
+            return None
+        # least-loaded host = fewest replicas currently registered on it
+        load = collections.Counter(i['host']
+                                   for i in self._known.values())
+        host = min(cands, key=lambda h: (load.get(h, 0), h))
+        token = uuid.uuid4().hex[:12]
+        self._heals[token] = {'model': model_id, 'lost_host': lost_host,
+                              'host': host, 't': time.monotonic(),
+                              'reason': reason,
+                              'exclude': sorted(set(exclude_hosts))}
+        os.makedirs(_ctl_dir(self.pod_dir, host), exist_ok=True)
+        _atomic_json(os.path.join(_ctl_dir(self.pod_dir, host),
+                                  'cmd.%s.json' % token),
+                     {'cmd': 'heal', 'model': str(model_id),
+                      'token': token, 'reason': reason,
+                      'lost_host': lost_host})
+        _C_HEALS.inc()
+        obs.event('serving.pod.heal_requested', model=str(model_id),
+                  host=host, token=token, reason=reason)
+        return token
+
+    def _check_heal_failures(self):
+        reg = _registry_dir(self.pod_dir)
+        try:
+            names = os.listdir(reg)
+        except OSError:
+            return
+        for fname in names:
+            if not (fname.startswith('healfail.')
+                    and fname.endswith('.json')):
+                continue
+            d = _read_json(os.path.join(reg, fname))
+            try:
+                os.remove(os.path.join(reg, fname))
+            except OSError:
+                continue
+            token = (d or {}).get('token')
+            h = self._heals.pop(token, None)
+            if h is None:
+                continue
+            obs.event('serving.pod.heal_redispatch',
+                      model=str(h['model']), failed_host=d.get('host'),
+                      token=str(token),
+                      error=str(d.get('error'))[:200])
+            # bounded re-dispatch: the exclude set ACCUMULATES through
+            # the token chain, so with every capable host failed the
+            # chain terminates in heal_unroutable instead of
+            # ping-ponging between two broken builders forever
+            exclude = set(h.get('exclude') or ())
+            if d.get('host') is not None:
+                exclude.add(d['host'])
+            self.request_heal(h['model'], reason=h.get('reason', 'heal'),
+                              lost_host=h.get('lost_host'),
+                              exclude_hosts=sorted(exclude))
+
+    def pending_heals(self):
+        with self._pod_lock:
+            return {t: dict(h) for t, h in self._heals.items()}
+
+    # -- autoscaling -------------------------------------------------------
+
+    def enable_autoscale(self, model_id, policy, builder=None):
+        """Tick an Autoscaler for `model_id` every poll. Default
+        scale-up goes through a heal command (the replica lands on the
+        least-loaded capable HOST); pass `builder` to add in-process
+        replicas instead. Scale-down drains the least-loaded replica
+        through the removal seam either way."""
+        scale_up = None
+        if builder is None:
+            scale_up = lambda reason: self.request_heal(  # noqa: E731
+                model_id, reason=reason)
+        a = Autoscaler(self, model_id, policy, builder=builder,
+                       scale_up=scale_up)
+        with self._pod_lock:
+            self._autoscalers[model_id] = a
+        return a
+
+    # -- drill/bench conveniences ------------------------------------------
+
+    def wait_for_replicas(self, model_id, n, timeout=30.0):
+        """Block until `model_id` has >= n routable replicas (drills:
+        'pod is up'). Returns the replica view or raises TimeoutError."""
+        deadline = time.monotonic() + float(timeout)
+        while time.monotonic() < deadline:
+            self.poll()
+            try:
+                view = self.replicas(model_id)
+            except KeyError:
+                view = []
+            if len(view) >= n:
+                return view
+            time.sleep(self._poll_s)
+        raise TimeoutError(
+            'model %r has %d of %d wanted replicas after %.1fs'
+            % (model_id, len(view), n, timeout))
+
+    def shutdown(self, drain=True, timeout=None):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout or 10.0)
+        return Router.shutdown(self, drain=drain, timeout=timeout)

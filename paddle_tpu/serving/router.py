@@ -58,16 +58,40 @@ class ModelOverloaded(ServerOverloaded):
 _C_ROUTED = obs.counter('router.routed')
 _C_OVERLOADED = obs.counter('router.overloaded')
 _G_REPLICAS = obs.gauge('router.replicas')
+_G_POD_SIZE = obs.gauge('router.pod_size')
+
+# process-wide replica-id sequence: ids stay unique across routers so a
+# registry (serving/pod.py) can address any replica it ever handed out
+_RID_LOCK = threading.Lock()
+_RID = [0]
+
+
+def _next_rid():
+    with _RID_LOCK:
+        _RID[0] += 1
+        return _RID[0]
 
 
 class _Replica(object):
-    __slots__ = ('engine', 'window', 'routed_since', 'sampled_at')
+    """One replica slot of a model entry. The registration seam
+    (docs/serving.md#pod): every replica — in-process engine or a
+    cross-host proxy — carries a router-unique `rid` plus optional
+    `host`/`key` registry coordinates, so the single-process Router and
+    the pod registry share ONE replica abstraction (`add_replica`
+    returns the rid; `remove_replica` addresses it; `swap()` and
+    `push_deltas` run the same engine protocol against either kind)."""
 
-    def __init__(self, engine):
+    __slots__ = ('engine', 'window', 'routed_since', 'sampled_at',
+                 'rid', 'host', 'key')
+
+    def __init__(self, engine, host=None, key=None):
         self.engine = engine
         self.window = {}
         self.routed_since = 0
         self.sampled_at = None    # None = never sampled: refresh first
+        self.rid = _next_rid()
+        self.host = host          # pod host id (None = this process)
+        self.key = key            # registry key (None = unregistered)
 
     def score(self):
         """Admission-pressure score (lower = less loaded): live queue
@@ -137,10 +161,52 @@ class Router(object):
             self._update_gauge_locked()
         return self
 
-    def add_replica(self, model_id, engine):
+    def add_replica(self, model_id, engine, host=None, key=None):
+        """Register one more replica for `model_id`; returns its rid —
+        the registration handle `remove_replica` addresses. `host`/`key`
+        are registry coordinates for cross-host replicas
+        (serving/pod.py); in-process replicas leave them None."""
+        r = _Replica(engine, host=host, key=key)
         with self._lock:
-            self._entry(model_id).replicas.append(_Replica(engine))
+            self._entry(model_id).replicas.append(r)
             self._update_gauge_locked()
+        if key is not None:
+            obs.event('serving.replica.register', model=str(model_id),
+                      rid=r.rid, host=host, key=str(key))
+        return r.rid
+
+    def remove_replica(self, model_id, rid, drain=True, timeout=None,
+                       reason='removed'):
+        """Deregister the replica `rid` of `model_id`. With drain=True
+        (default) its engine drains in a background thread exactly like
+        a swapped-out generation (queued + in-flight work completes, no
+        future is lost); drain=False detaches without touching the
+        engine — the pod registry's host-loss path, where the engine is
+        gone and its pending work is re-routed by the caller. Returns
+        the detached engine, or None when the rid is not registered."""
+        with self._lock:
+            entry = self._entry(model_id)
+            match = [r for r in entry.replicas if r.rid == rid]
+            if not match:
+                return None
+            entry.replicas = [r for r in entry.replicas if r.rid != rid]
+            self._update_gauge_locked()
+        r = match[0]
+        obs.event('serving.replica.drain', model=str(model_id),
+                  rid=r.rid, host=r.host, drain=bool(drain),
+                  reason=str(reason))
+        if drain:
+            self._drain_async(r.engine)
+        return r.engine
+
+    def replicas(self, model_id):
+        """Registry view: one dict per replica of `model_id` — rid,
+        host, key, and the last-sampled window (no reset)."""
+        with self._lock:
+            return [{'rid': r.rid, 'host': r.host, 'key': r.key,
+                     'window': dict(r.window),
+                     'routed_since': r.routed_since}
+                    for r in self._entry(model_id).replicas]
 
     def models(self):
         with self._lock:
@@ -159,6 +225,22 @@ class Router(object):
     def _update_gauge_locked(self):
         _G_REPLICAS.set(sum(len(e.replicas)
                             for e in self._models.values()))
+        # pod size = distinct hosts serving at least one replica (a
+        # replica with host=None lives in this process)
+        hosts = {('local' if r.host is None else r.host)
+                 for e in self._models.values() for r in e.replicas}
+        _G_POD_SIZE.set(len(hosts))
+
+    def _drain_async(self, engine):
+        """Drain an outgoing engine in the background — the swap()
+        cutover machinery, shared by remove_replica and autoscaling:
+        queued + in-flight work completes, no future is lost."""
+        t = threading.Thread(
+            target=lambda e=engine: e.shutdown(drain=True),
+            name='router-drain', daemon=True)
+        t.start()
+        self._drainers.append(t)
+        return t
 
     # -- dispatch ----------------------------------------------------------
 
@@ -171,6 +253,21 @@ class Router(object):
                     r.window = {}
                 r.routed_since = 0
                 r.sampled_at = now
+
+    def sample_windows(self, model_id):
+        """Refresh (rationed by window_s) and return each replica's
+        admission-pressure sample: [{'rid', 'host', 'window',
+        'routed_since'}]. The autoscaler's signal (serving/pod.py) —
+        same windows the dispatch path balances on, same single-consumer
+        rationing."""
+        now = time.monotonic()
+        with self._lock:
+            entry = self._entry(model_id)
+            self._refresh_locked(entry, now)
+            return [{'rid': r.rid, 'host': r.host,
+                     'window': dict(r.window),
+                     'routed_since': r.routed_since}
+                    for r in entry.replicas]
 
     def submit(self, model_id, feed, **kwargs):
         """Route one request to the least-loaded replica of `model_id`;
@@ -355,11 +452,7 @@ class Router(object):
         obs.event('router.swap', model=str(model_id), version=version,
                   replicas=n, path=str(path))
         for old in old_replicas:
-            t = threading.Thread(
-                target=lambda e=old.engine: e.shutdown(drain=True),
-                name='router-drain', daemon=True)
-            t.start()
-            self._drainers.append(t)
+            self._drain_async(old.engine)
         return version
 
     # -- row-delta push ----------------------------------------------------

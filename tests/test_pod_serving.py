@@ -1,0 +1,910 @@
+"""Pod-scale serving drills (docs/serving.md#pod).
+
+The serving tier crossed the line training crossed in PRs 7/9/10: a
+`set_mesh`-annotated Program (row-sharded embedding table) serves as a
+single Router replica through the GSPMD executor — restored from a
+SHARDED checkpoint, never materialized dense — replicas register across
+hosts through a shared-filesystem registry, and a dead serving host is
+detected by heartbeat, its futures RE-ROUTED to survivors (zero dropped
+futures) and its replica RE-SHARDED onto the surviving topology.
+
+Every in-process drill simulates host death via `simulate_death()`
+(beats stop + loops freeze: indistinguishable from SIGKILL to the
+router); the 2-process drill (additionally `slow`, the test_elastic.py
+harness) uses a real SIGKILL. Telemetry assertions verify an operator
+could have SEEN each decision (docs/observability.md).
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import obs, serving
+from paddle_tpu.fluid import framework, unique_name
+from paddle_tpu.fluid.executor import Scope, _switch_scope
+from paddle_tpu.obs import report as obs_report
+from paddle_tpu.parallel import HostLost
+from paddle_tpu.serving import (AutoscalePolicy, Autoscaler, PodRouter,
+                                PodWorker, Router, ServerClosed,
+                                ServingConfig, ServingEngine,
+                                ShardedPredictor)
+from paddle_tpu.utils import checkpoint as ck
+
+pytestmark = pytest.mark.pod
+
+VOCAB, DIM = 64, 4
+
+
+@pytest.fixture
+def obs_events(tmp_path):
+    obs.enable(str(tmp_path / 'obs'))
+
+    def read(name=None):
+        path = obs.run_log_path()
+        if path is None:
+            return []
+        events, errors = obs_report.load_events(path)
+        assert errors == [], errors
+        return [e for e in events if name is None or e['name'] == name]
+
+    try:
+        yield read
+    finally:
+        obs._reset()
+
+
+# ---------------------------------------------------------------------------
+# shared artifacts: a trained sharded-embedding scorer + sharded ckpt
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope='module')
+def artifacts(tmp_path_factory):
+    """Train the acceptance-drill model (vocab-sharded table + fc head)
+    on the dp=8 mesh, save a SHARDED checkpoint + the program-only
+    serving artifact, and record dense reference scores for a probe."""
+    base = tmp_path_factory.mktemp('pod_artifacts')
+    model_dir = str(base / 'model')
+    ckpt_dir = str(base / 'ckpt')
+    main, startup, scope = (framework.Program(), framework.Program(),
+                            Scope())
+    prev = _switch_scope(scope)
+    try:
+        with unique_name.guard():
+            with framework.program_guard(main, startup):
+                ids = fluid.layers.data(name='ids', shape=[2, 1],
+                                        dtype='int64')
+                emb = fluid.layers.embedding(
+                    ids, size=[VOCAB, DIM], is_sparse=True,
+                    is_distributed=True,
+                    param_attr=fluid.ParamAttr(name='emb_w',
+                                               sharding=('dp', None)))
+                pred = fluid.layers.fc(
+                    input=emb, size=1, num_flatten_dims=2,
+                    bias_attr=False,
+                    param_attr=fluid.ParamAttr(name='fc_w'))
+                loss = fluid.layers.mean(fluid.layers.square(pred - 1.0))
+                fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+                main.set_mesh({'dp': 8})
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                rng = np.random.RandomState(0)
+                for _ in range(3):
+                    b = rng.randint(0, VOCAB, (8, 2, 1)).astype('int64')
+                    exe.run(main, feed={'ids': b}, fetch_list=[loss])
+                state = exe.state_dict(main, scope=scope)
+                ck.save_sharded(os.path.join(ckpt_dir, 'sharded_7'),
+                                {'emb_w': state['emb_w'],
+                                 'fc_w': state['fc_w']}, step=7)
+                serving.save_serving_program(model_dir, ['ids'], [pred],
+                                             main_program=main)
+                probe = rng.randint(0, VOCAB, (8, 2, 1)).astype('int64')
+                infer = main.clone(for_test=True).prune([pred])
+                ref = exe.run(infer, feed={'ids': probe},
+                              fetch_list=[pred.name], scope=scope)
+    finally:
+        _switch_scope(prev)
+    return {'model_dir': model_dir, 'ckpt_dir': ckpt_dir,
+            'probe': probe, 'ref': np.asarray(ref[0])}
+
+
+def _cfg(**kw):
+    base = dict(max_batch_size=8, buckets=[8], max_queue_delay_ms=1.0)
+    base.update(kw)
+    return ServingConfig(**base)
+
+
+def _builder(art, mesh_n, buckets=(8,)):
+    def b(reason):
+        return serving.sharded_replica(
+            art['model_dir'], mesh_axes={'dp': mesh_n},
+            ckpt_dir=art['ckpt_dir'], config=_cfg(buckets=list(buckets)))
+    return b
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: the replica registration-handle seam on the Router
+# ---------------------------------------------------------------------------
+
+class _StubEngine(object):
+    """Engine-protocol stub: controllable window, recorded calls."""
+
+    feed_names = ['x']
+
+    def __init__(self, window=None, result=1.0):
+        self.window = dict(window or {})
+        self.result = result
+        self.shutdowns = []
+        self.pushed = []
+
+    def submit(self, feed, **kw):
+        import concurrent.futures
+        f = concurrent.futures.Future()
+        f.set_result([np.asarray(feed['x']) * self.result])
+        return f
+
+    def stats_window(self):
+        return dict(self.window)
+
+    def push_rows(self, deltas):
+        self.pushed.append(deltas)
+        return sum(len(i) for i, _ in deltas.values())
+
+    def shutdown(self, drain=True, timeout=None):
+        self.shutdowns.append(drain)
+        return True
+
+
+def test_replica_handles_add_remove(obs_events):
+    r = Router(window_s=0.0)
+    e1, e2, e3 = _StubEngine(), _StubEngine(), _StubEngine()
+    r.add_model('m', [e1, e2])
+    view = r.replicas('m')
+    rids = [v['rid'] for v in view]
+    assert len(set(rids)) == 2
+    assert all(v['host'] is None and v['key'] is None for v in view)
+    # add_replica returns the handle; registry coordinates stick
+    rid3 = r.add_replica('m', e3, host=5, key='5.m-1')
+    view = {v['rid']: v for v in r.replicas('m')}
+    assert view[rid3]['host'] == 5 and view[rid3]['key'] == '5.m-1'
+    ev = obs_events('serving.replica.register')
+    assert ev and ev[-1]['fields']['host'] == 5
+    # pod_size gauge: local host + host 5
+    assert obs.gauge('router.pod_size').value == 2
+    # remove by handle: drained in the background, typed event
+    got = r.remove_replica('m', rid3, drain=True, reason='scale_down')
+    assert got is e3
+    deadline = time.monotonic() + 5
+    while not e3.shutdowns and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert e3.shutdowns == [True]
+    ev = obs_events('serving.replica.drain')
+    assert ev and ev[-1]['fields']['reason'] == 'scale_down'
+    assert len(r.replicas('m')) == 2
+    # unknown handle is a no-op, not an error
+    assert r.remove_replica('m', 999999) is None
+    # detach (host-loss posture): engine untouched
+    rid1 = r.replicas('m')[0]['rid']
+    r.remove_replica('m', rid1, drain=False, reason='host_lost')
+    assert e1.shutdowns == []
+    assert obs.gauge('router.pod_size').value == 1
+    r.shutdown(drain=False)
+
+
+def test_sample_windows_refreshes_pressure():
+    r = Router(window_s=0.0)
+    e = _StubEngine(window={'queue_depth': 3, 'inflight': 2,
+                            'queue_high_water': 5})
+    r.add_model('m', [e])
+    s = r.sample_windows('m')
+    assert s[0]['window']['queue_depth'] == 3
+    e.window['queue_depth'] = 0
+    s = r.sample_windows('m')
+    assert s[0]['window']['queue_depth'] == 0
+    r.shutdown(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# autoscaling: queue-depth-driven capacity on the add/remove seam
+# ---------------------------------------------------------------------------
+
+def test_autoscaler_up_down_with_cooldown(obs_events):
+    r = Router(window_s=0.0)
+    hot = {'queue_depth': 6, 'queue_high_water': 6}
+    cold = {'queue_depth': 0, 'queue_high_water': 0}
+    e0 = _StubEngine(window=dict(hot))
+    r.add_model('m', [e0])
+    built = []
+
+    def builder(reason):
+        built.append(reason)
+        return _StubEngine(window=dict(cold))
+
+    a = Autoscaler(r, 'm', AutoscalePolicy(
+        min_replicas=1, max_replicas=2, scale_up_at=4.0,
+        scale_down_at=0.5, cooldown_s=0.2), builder=builder)
+    assert a.tick() == 'up'
+    # the build runs OFF the tick thread (poll must not stall on a
+    # sharded restore); the replica lands shortly after
+    deadline = time.monotonic() + 5
+    while len(r.replicas('m')) < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert built == ['scale_up']
+    assert len(r.replicas('m')) == 2
+    # cooldown: no immediate second action even though pressure persists
+    assert a.tick() is None
+    time.sleep(0.25)
+    # at max_replicas: pressure can no longer scale up
+    assert a.tick() is None
+    # pressure drops -> scale down to min, draining the idle replica
+    e0.window = dict(cold)
+    time.sleep(0.25)
+    assert a.tick() == 'down'
+    assert len(r.replicas('m')) == 1
+    time.sleep(0.25)
+    assert a.tick() is None          # min_replicas floor
+    ev = obs_events('serving.autoscale')
+    assert [e['fields']['direction'] for e in ev] == ['up', 'down']
+    ev = obs_events('serving.replica.drain')
+    assert ev and ev[-1]['fields']['reason'] == 'scale_down'
+    r.shutdown(drain=False)
+
+
+def test_autoscale_policy_validation():
+    with pytest.raises(ValueError, match='min_replicas'):
+        AutoscalePolicy(min_replicas=0)
+    with pytest.raises(ValueError, match='scale_down_at'):
+        AutoscalePolicy(scale_up_at=1.0, scale_down_at=2.0)
+    with pytest.raises(ValueError, match='builder'):
+        Autoscaler(Router(), 'm', AutoscalePolicy())
+
+
+# ---------------------------------------------------------------------------
+# sharded replicas: program-only artifact + sharded-checkpoint restore
+# ---------------------------------------------------------------------------
+
+def test_save_serving_program_writes_no_params(artifacts):
+    names = os.listdir(artifacts['model_dir'])
+    assert '__model__.json' in names
+    assert not [n for n in names if 'params' in n], names
+
+
+def test_sharded_predictor_never_dense_and_matches(artifacts,
+                                                   obs_events):
+    pred = ShardedPredictor(artifacts['model_dir'],
+                            mesh_axes={'dp': 8},
+                            ckpt_dir=artifacts['ckpt_dir'])
+    # the table lives as per-device row shards — never dense anywhere
+    assert pred.shard_shapes()['emb_w'] == (VOCAB // 8, DIM)
+    assert pred.state_step == 7
+    out = pred.run({'ids': artifacts['probe']})
+    np.testing.assert_allclose(np.asarray(out[0]), artifacts['ref'],
+                               rtol=1e-4, atol=1e-5)
+    sp = obs_events('serving.sharded_restore')
+    assert sp and sp[-1]['fields']['restored'] == 2
+    # reshard-on-restore: the same checkpoint (saved on dp=8) comes up
+    # on a dp=4 serving mesh, still sharded, same scores
+    pred4 = ShardedPredictor(artifacts['model_dir'],
+                             mesh_axes={'dp': 4},
+                             ckpt_dir=artifacts['ckpt_dir'])
+    assert pred4.shard_shapes()['emb_w'] == (VOCAB // 4, DIM)
+    out4 = pred4.run({'ids': artifacts['probe']})
+    np.testing.assert_allclose(np.asarray(out4[0]), artifacts['ref'],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sharded_predictor_serving_wire_zero_steady_compiles(artifacts):
+    """The all_to_all lookup wire on the SERVING path: engine warmup
+    pre-compiles the bucket set, then steady traffic performs zero
+    compiles (the PR 8 contract, now over a sharded Program)."""
+    eng = serving.sharded_replica(
+        artifacts['model_dir'], mesh_axes={'dp': 8},
+        ckpt_dir=artifacts['ckpt_dir'], config=_cfg(buckets=[4, 8]))
+    try:
+        exe = eng._model._exe
+        misses0 = exe.cache_stats['misses']
+        for i in range(6):
+            n = 3 if i % 2 else 8     # both buckets exercised
+            out = eng.predict({'ids': artifacts['probe'][:n]},
+                              timeout=60)
+            np.testing.assert_allclose(np.asarray(out[0]),
+                                       artifacts['ref'][:n],
+                                       rtol=1e-4, atol=1e-5)
+        assert exe.cache_stats['misses'] == misses0
+    finally:
+        eng.shutdown()
+
+
+def test_sharded_predictor_missing_state_is_typed(artifacts, tmp_path):
+    partial = str(tmp_path / 'partial_ck')
+    arrays, _ = ck.load_latest_verified(artifacts['ckpt_dir'])
+    ck.save_sharded(os.path.join(partial, 'sharded_1'),
+                    {'emb_w': arrays['emb_w']}, step=1)
+    with pytest.raises(RuntimeError, match='fc_w'):
+        ShardedPredictor(artifacts['model_dir'], mesh_axes={'dp': 8},
+                         ckpt_dir=partial)
+
+
+def test_sharded_predictor_needs_a_mesh(artifacts, tmp_path):
+    # strip the mesh from a copy of the program artifact
+    with open(os.path.join(artifacts['model_dir'],
+                           '__model__.json')) as f:
+        meta = json.load(f)
+    meta['program'].pop('mesh', None)
+    os.makedirs(str(tmp_path / 'm'))
+    with open(str(tmp_path / 'm' / '__model__.json'), 'w') as f:
+        json.dump(meta, f)
+    with pytest.raises(ValueError, match='mesh'):
+        ShardedPredictor(str(tmp_path / 'm'))
+
+
+def test_sharded_replica_takes_row_deltas(artifacts):
+    """The streaming freshness path lands on a SHARDED table: push_rows
+    scatters into the mesh-placed array; scores move accordingly."""
+    eng = serving.sharded_replica(
+        artifacts['model_dir'], mesh_axes={'dp': 8},
+        ckpt_dir=artifacts['ckpt_dir'], config=_cfg())
+    try:
+        probe = np.zeros((8, 2, 1), np.int64)     # every lookup hits row 0
+        before = np.asarray(eng.predict({'ids': probe}, timeout=60)[0])
+        rows = np.full((1, DIM), 3.0, np.float32)
+        assert eng.push_rows({'emb_w': (np.array([0]), rows)}) == 1
+        after = np.asarray(eng.predict({'ids': probe}, timeout=60)[0])
+        assert not np.allclose(before, after)
+    finally:
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# pod registry + cross-host routing (in-process workers)
+# ---------------------------------------------------------------------------
+
+def _fake_model(delay=0.0, scale=2.0):
+    class M(object):
+        feed_names = ['x']
+
+        def run(self, feed):
+            if delay:
+                time.sleep(delay)
+            return [np.asarray(feed['x']) * scale]
+    return M()
+
+
+def _fake_engine(delay=0.0, scale=2.0, **cfg):
+    cfg.setdefault('max_batch_size', 4)
+    cfg.setdefault('buckets', [4])
+    cfg.setdefault('max_queue_delay_ms', 0.5)
+    return ServingEngine(_fake_model(delay, scale), ServingConfig(**cfg))
+
+
+def test_pod_registry_roundtrip_and_retire(tmp_path, obs_events):
+    pod = str(tmp_path / 'pod')
+    w = PodWorker(pod, host=0, beat_interval=0.05)
+    r = PodRouter(pod, poll_s=0.05, window_s=0.05,
+                  heartbeat_timeout=5.0, start=False)
+    try:
+        key = w.serve('m', _fake_engine())
+        assert os.path.exists(os.path.join(
+            pod, 'registry', 'replica.%s.json' % key))
+        view = r.wait_for_replicas('m', 1, timeout=10)
+        assert view[0]['host'] == 0 and view[0]['key'] == key
+        out = r.predict('m', {'x': np.ones((2, 3), np.float32)},
+                        timeout=20)
+        np.testing.assert_allclose(out[0],
+                                   2.0 * np.ones((2, 3), np.float32))
+        # voluntary retire: registration file gone -> replica removed
+        w.retire(key)
+        deadline = time.monotonic() + 10
+        while r.replicas('m') and time.monotonic() < deadline:
+            r.poll()
+            time.sleep(0.05)
+        assert r.replicas('m') == []
+        ev = obs_events('serving.replica.register')
+        assert any(e['fields'].get('key') == key for e in ev)
+    finally:
+        r.shutdown(drain=False)
+        w.shutdown()
+
+
+def test_remote_typed_errors_cross_the_wire(tmp_path):
+    pod = str(tmp_path / 'pod')
+    w = PodWorker(pod, host=0, beat_interval=0.05)
+    r = PodRouter(pod, poll_s=0.05, window_s=0.05,
+                  heartbeat_timeout=5.0, start=False)
+    try:
+        w.serve('m', _fake_engine())
+        r.wait_for_replicas('m', 1, timeout=10)
+        # a malformed feed fails TYPED through the wire (ValueError
+        # from the remote engine, not an opaque timeout)
+        fut = r.submit('m', {'wrong_name': np.ones((2, 3), np.float32)})
+        with pytest.raises(ValueError, match='feed names'):
+            fut.result(20)
+    finally:
+        r.shutdown(drain=False)
+        w.shutdown()
+
+
+def test_pod_host_loss_rerouted_futures_and_heal(tmp_path, obs_events):
+    """The in-process self-healing drill: two hosts serve one model;
+    host 1 dies mid-traffic (beats stop, spool freezes — SIGKILL as the
+    router sees it); every future pending against it is re-routed to
+    host 0 (ZERO dropped futures), the loss is typed HostLost, and the
+    heal path builds a replacement on the survivor."""
+    pod = str(tmp_path / 'pod')
+    built = []
+
+    def builder(reason):
+        built.append(reason)
+        return _fake_engine()
+
+    w0 = PodWorker(pod, host=0, builders={'m': builder},
+                   beat_interval=0.05)
+    w1 = PodWorker(pod, host=1, beat_interval=0.05)
+    r = PodRouter(pod, poll_s=0.05, window_s=0.05,
+                  heartbeat_timeout=0.5, start=False)
+    x = np.ones((2, 3), np.float32)
+    try:
+        w0.serve('m', _fake_engine())
+        w1.serve('m', _fake_engine())
+        r.wait_for_replicas('m', 2, timeout=10)
+        # warm the dispatch path, then kill host 1 with traffic pending
+        assert r.predict('m', {'x': x}, timeout=20)
+        w1.simulate_death()
+        futs = [r.submit('m', {'x': x}) for _ in range(12)]
+        deadline = time.monotonic() + 15
+        while not r.lost_hosts and time.monotonic() < deadline:
+            r.poll()
+            time.sleep(0.05)
+        rec = r.lost_hosts[0]
+        assert rec['host'] == 1 and rec['stale'] == [1]
+        assert 'HostLost' in rec['error']           # typed verdict
+        # zero dropped futures: every submit resolves with the right value
+        for f in futs:
+            np.testing.assert_allclose(f.result(30)[0], 2.0 * x)
+        # self-heal: the survivor built + registered a replacement
+        deadline = time.monotonic() + 20
+        while len(r.replicas('m')) < 2 and time.monotonic() < deadline:
+            r.poll()
+            time.sleep(0.05)
+        view = r.replicas('m')
+        assert len(view) == 2 and all(v['host'] == 0 for v in view)
+        assert built and built[0] == 'host_lost'
+        ev = obs_events('serving.replica.lost')
+        assert ev and ev[-1]['fields']['host'] == 1
+        ev = obs_events('serving.replica.reshard')
+        assert ev and ev[-1]['fields']['host'] == 0
+        assert obs_events('router.host_lost')
+        # a push against a bare-callable replica is refused TYPED
+        # through the wire (DeltaUnsupported — no parameter scope), not
+        # an opaque timeout: the remote error mapping covers the
+        # publisher's failure posture
+        from paddle_tpu.serving.engine import DeltaUnsupported
+        with pytest.raises(DeltaUnsupported):
+            r.push_deltas('m', {'w': (np.array([0]),
+                                      np.zeros((1, 2), np.float32))})
+        # the dead host is no longer a heal/scale candidate: a fresh
+        # capacity request must land on the survivor, never on the
+        # orphaned host-1 advert (its ctl mailbox answers nothing)
+        assert 1 not in r._hosts
+        token = r.request_heal('m', reason='scale_up')
+        assert token is not None
+        deadline = time.monotonic() + 20
+        while len(r.replicas('m')) < 3 and time.monotonic() < deadline:
+            r.poll()
+            time.sleep(0.05)
+        assert [v['host'] for v in r.replicas('m')] == [0, 0, 0]
+    finally:
+        r.shutdown(drain=False)
+        w0.shutdown()
+        w1.shutdown()
+
+
+def test_pod_push_deltas_reaches_survivor_set(tmp_path, artifacts):
+    """Sharded replicas + host loss + heal, then Router.push_deltas —
+    the DeltaPublisher contract against the RE-REGISTERED set: the push
+    lands on every live (healed) replica through the wire."""
+    pod = str(tmp_path / 'pod')
+    w0 = PodWorker(pod, host=0,
+                   builders={'rec': _builder(artifacts, 4)},
+                   beat_interval=0.05)
+    w1 = PodWorker(pod, host=1, beat_interval=0.05)
+    r = PodRouter(pod, poll_s=0.05, window_s=0.05,
+                  heartbeat_timeout=0.5, start=False)
+    try:
+        w0.serve('rec', _builder(artifacts, 8)('boot'))
+        w1.serve('rec', _builder(artifacts, 4)('boot'))
+        r.wait_for_replicas('rec', 2, timeout=30)
+        w1.simulate_death()
+        deadline = time.monotonic() + 15
+        while not r.lost_hosts and time.monotonic() < deadline:
+            r.poll()
+            time.sleep(0.05)
+        deadline = time.monotonic() + 60
+        while len(r.replicas('rec')) < 2 and time.monotonic() < deadline:
+            r.poll()
+            time.sleep(0.05)
+        assert all(v['host'] == 0 for v in r.replicas('rec'))
+        rows = np.full((2, DIM), 0.25, np.float32)
+        pushed = r.push_deltas('rec', {'emb_w': (np.array([0, 1]), rows)})
+        assert pushed == 2                      # both healed replicas
+        probe = np.zeros((8, 2, 1), np.int64)
+        out = np.asarray(r.predict('rec', {'ids': probe}, timeout=60)[0])
+        assert np.isfinite(out).all()
+    finally:
+        r.shutdown(drain=False)
+        w0.shutdown()
+        w1.shutdown()
+
+
+def test_pod_autoscale_up_via_heal_and_down(tmp_path, obs_events):
+    pod = str(tmp_path / 'pod')
+    built = []
+
+    def builder(reason):
+        built.append(reason)
+        return _fake_engine()
+
+    w = PodWorker(pod, host=0, builders={'m': builder},
+                  beat_interval=0.05)
+    r = PodRouter(pod, poll_s=0.05, window_s=0.0,
+                  heartbeat_timeout=5.0, start=False)
+    try:
+        # a slow replica so queued pressure is visible in the window
+        w.serve('m', _fake_engine(delay=0.05))
+        r.wait_for_replicas('m', 1, timeout=10)
+        a = r.enable_autoscale('m', AutoscalePolicy(
+            min_replicas=1, max_replicas=2, scale_up_at=3.0,
+            scale_down_at=0.25, cooldown_s=0.3))
+        x = np.ones((1, 2), np.float32)
+        futs = [r.submit('m', {'x': x}) for _ in range(10)]
+        deadline = time.monotonic() + 20
+        while len(r.replicas('m')) < 2 and time.monotonic() < deadline:
+            r.poll()
+            time.sleep(0.05)
+        assert len(r.replicas('m')) == 2        # scaled up via heal
+        assert built == ['scale_up']
+        for f in futs:
+            f.result(30)
+        # idle -> scale back down to the floor
+        deadline = time.monotonic() + 30
+        while len(r.replicas('m')) > 1 and time.monotonic() < deadline:
+            r.poll()
+            time.sleep(0.1)
+        assert len(r.replicas('m')) == 1
+        dirs = [e['fields']['direction']
+                for e in obs_events('serving.autoscale')]
+        assert dirs[0] == 'up' and 'down' in dirs
+    finally:
+        r.shutdown(drain=False)
+        w.shutdown()
+
+
+def test_heal_failure_redispatches_to_capable_host(tmp_path,
+                                                   obs_events):
+    pod = str(tmp_path / 'pod')
+    built = []
+
+    def bad_builder(reason):
+        raise RuntimeError('no capacity on this host')
+
+    def good_builder(reason):
+        built.append(reason)
+        return _fake_engine()
+
+    w1 = PodWorker(pod, host=1, builders={'m': bad_builder},
+                   beat_interval=0.05)
+    w2 = PodWorker(pod, host=2, builders={'m': good_builder},
+                   beat_interval=0.05)
+    r = PodRouter(pod, poll_s=0.05, window_s=0.05,
+                  heartbeat_timeout=5.0, start=False)
+    try:
+        key = w2.serve('m', _fake_engine())
+        r.wait_for_replicas('m', 1, timeout=10)
+        # host 1 has fewer replicas -> picked first; its failure must
+        # re-dispatch to host 2 (one bounded retry, typed event)
+        token = r.request_heal('m', reason='drill')
+        assert token is not None
+        deadline = time.monotonic() + 20
+        while len(r.replicas('m')) < 2 and time.monotonic() < deadline:
+            r.poll()
+            time.sleep(0.05)
+        assert len(r.replicas('m')) == 2
+        assert built == ['drill']
+        ev = obs_events('serving.pod.heal_failed')
+        assert ev and ev[-1]['fields']['host'] == 1
+        ev = obs_events('serving.replica.reshard')
+        assert ev and ev[-1]['fields']['host'] == 2
+        del key
+    finally:
+        r.shutdown(drain=False)
+        w1.shutdown()
+        w2.shutdown()
+
+
+def test_decode_engine_replica_behind_the_pod_wire(tmp_path):
+    """The decode path rides the same registry: a DecodeEngine replica
+    registered by a PodWorker serves autoregressive requests through
+    the PodRouter — result tuples (ids, scores) and decode kwargs
+    (max_new_tokens) cross the wire, matching the in-process engine."""
+    from paddle_tpu.serving import DecodeConfig, DecodeEngine
+    rng = np.random.RandomState(7)
+    weights = {
+        'w_dec': (rng.randn(8 + 6, 32) * 0.3).astype(np.float32),
+        'u_dec': (rng.randn(8, 32) * 0.3).astype(np.float32),
+        'b_dec': (rng.randn(1, 32) * 0.1).astype(np.float32),
+        'w_q': (rng.randn(8, 6) * 0.3).astype(np.float32),
+        'w_emb': (rng.randn(20, 8) * 0.3).astype(np.float32),
+        'w_out': (rng.randn(8, 20) * 0.3).astype(np.float32),
+        'b_out': (rng.randn(1, 20) * 0.1).astype(np.float32),
+    }
+
+    def build():
+        return DecodeEngine(weights, DecodeConfig(
+            slots=2, beam_size=3, max_len=8, src_cap=5))
+
+    enc = (rng.randn(4, 6) * 0.5).astype(np.float32)
+    local = build()
+    want_ids, want_scores = local.submit(
+        {'enc': enc}, max_new_tokens=6).result(60)
+    local.shutdown()
+
+    pod = str(tmp_path / 'pod')
+    w = PodWorker(pod, host=0, beat_interval=0.05)
+    r = PodRouter(pod, poll_s=0.05, window_s=0.05,
+                  heartbeat_timeout=5.0, start=False)
+    try:
+        w.serve('mt', build())
+        r.wait_for_replicas('mt', 1, timeout=10)
+        got = r.submit('mt', {'enc': enc}, max_new_tokens=6).result(60)
+        np.testing.assert_array_equal(np.asarray(got[0]), want_ids)
+        np.testing.assert_allclose(np.asarray(got[1]), want_scores,
+                                   rtol=1e-5, atol=1e-6)
+    finally:
+        r.shutdown(drain=False)
+        w.shutdown()
+
+
+def test_heal_chain_terminates_when_every_builder_fails(tmp_path,
+                                                        obs_events):
+    """The exclude set ACCUMULATES through the re-dispatch token chain:
+    with every capable host failing its build, the chain ends in a
+    typed heal_unroutable instead of ping-ponging forever."""
+    def bad(reason):
+        raise RuntimeError('corrupt checkpoint')
+
+    pod_dir = str(tmp_path / 'pod')
+    w1 = PodWorker(pod_dir, host=1, builders={'m': bad},
+                   beat_interval=0.05)
+    w2 = PodWorker(pod_dir, host=2, builders={'m': bad},
+                   beat_interval=0.05)
+    r = PodRouter(pod_dir, poll_s=0.05, window_s=0.05,
+                  heartbeat_timeout=5.0, start=False)
+    try:
+        w2.serve('m', _fake_engine())
+        r.wait_for_replicas('m', 1, timeout=10)
+        assert r.request_heal('m', reason='drill') is not None
+        deadline = time.monotonic() + 20
+        while not obs_events('serving.pod.heal_unroutable') \
+                and time.monotonic() < deadline:
+            r.poll()
+            time.sleep(0.05)
+        assert obs_events('serving.pod.heal_unroutable')
+        assert r.pending_heals() == {}          # chain terminated
+        # exactly one failure per capable host, no ping-pong
+        redispatches = obs_events('serving.pod.heal_redispatch')
+        assert 1 <= len(redispatches) <= 2
+        assert len(r.replicas('m')) == 1        # nothing half-built
+    finally:
+        r.shutdown(drain=False)
+        w1.shutdown()
+        w2.shutdown()
+
+
+def test_set_mesh_data_axis_false_survives_round_trip():
+    """The forced-replicate serving posture is a Program property like
+    the amp flags: it must survive clone() and the _to_dict/_from_dict
+    artifact round-trip (None would re-derive 'dp' on reload and
+    silently re-shard request batches)."""
+    p = framework.Program()
+    p.set_mesh({'dp': 8}, data_axis=False)
+    assert p._mesh_data_axis is False
+    q = framework.Program._from_dict(p._to_dict())
+    assert q.mesh_axes == {'dp': 8}
+    assert q._mesh_data_axis is False
+    assert p.clone()._mesh_data_axis is False
+    # the default derivation is untouched
+    d = framework.Program()
+    d.set_mesh({'dp': 8})
+    assert d._mesh_data_axis == 'dp'
+    assert framework.Program._from_dict(
+        d._to_dict())._mesh_data_axis == 'dp'
+
+
+def test_pod_report_section(obs_events):
+    obs.event('serving.replica.register', model='m', host=0, key='0.m-1')
+    obs.event('serving.replica.register', model='m', host=1, key='1.m-1')
+    obs.event('serving.replica.lost', model='m', host=1, key='1.m-1',
+              pending=3)
+    obs.event('router.host_lost', host=1, replicas=1, rerouted=3,
+              heals=1)
+    obs.event('serving.replica.reshard', model='m', host=0, key='0.m-2',
+              token='t', heal_s=2.5)
+    obs.event('serving.pod.heal_requested', model='m', host=0,
+              token='t', reason='host_lost')
+    obs.event('serving.autoscale', model='m', direction='up',
+              replicas=1, pressure=5.0)
+    text = obs_report.summarize(obs_events())
+    assert '-- pod serving --' in text
+    assert '2 registered across 2 host(s)' in text
+    assert 'host LOST: h1' in text and '3 future(s) re-routed' in text
+    assert 'reshard: model=m -> h0' in text
+    assert 'autoscale: 1 up, 0 down' in text
+
+
+# ---------------------------------------------------------------------------
+# the 2-process SIGKILL drill (the test_elastic.py harness, serving-side)
+# ---------------------------------------------------------------------------
+
+_POD_CHILD = r"""
+import os, sys, time
+import jax
+jax.config.update('jax_platforms', 'cpu')
+try:
+    jax.config.update('jax_num_cpu_devices', 8)
+except AttributeError:
+    os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS', '')
+                               + ' --xla_force_host_platform_device_count=8')
+import numpy as np
+from paddle_tpu import serving
+
+host = int(sys.argv[1])
+pod_dir, model_dir, ckpt_dir = sys.argv[2], sys.argv[3], sys.argv[4]
+mesh_n, heal_n = int(sys.argv[5]), int(sys.argv[6])
+stop_file = sys.argv[7]
+
+
+def build(n):
+    def b(reason):
+        return serving.sharded_replica(
+            model_dir, mesh_axes={'dp': n}, ckpt_dir=ckpt_dir,
+            config=serving.ServingConfig(max_batch_size=8, buckets=[8],
+                                         max_queue_delay_ms=1.0))
+    return b
+
+
+w = serving.PodWorker(pod_dir, host=host,
+                      builders={'rec': build(heal_n)})
+w.serve('rec', build(mesh_n)('boot'))
+print('SERVING %d' % host)
+sys.stdout.flush()
+while not os.path.exists(stop_file):
+    time.sleep(0.1)
+w.shutdown()
+print('STOPPED %d' % host)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_sigkill_mid_traffic(artifacts, tmp_path,
+                                         obs_events):
+    """The acceptance drill: 2 serving host PROCESSES each serve the
+    set_mesh-sharded Program (row-sharded table restored from the
+    sharded checkpoint — never dense); one is SIGKILLed mid-traffic.
+    Asserts: typed HostLost, ZERO dropped futures (every submit
+    resolves with the right scores), the replica re-shards onto the
+    survivor (dp=8 -> dp=4 via the PR 10 restore path), and post-
+    recovery traffic performs zero steady-state compiles."""
+    pod = str(tmp_path / 'pod')
+    stop_file = str(tmp_path / 'stop')
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = []
+    for host, mesh_n, heal_n in ((0, 8, 4), (1, 8, 4)):
+        env = dict(os.environ, PYTHONPATH=here)
+        env.pop('JAX_PLATFORMS', None)
+        env.pop('XLA_FLAGS', None)
+        env.pop('PADDLE_TPU_OBS_DIR', None)
+        procs.append(subprocess.Popen(
+            [sys.executable, '-c', _POD_CHILD, str(host), pod,
+             artifacts['model_dir'], artifacts['ckpt_dir'],
+             str(mesh_n), str(heal_n), stop_file],
+            env=env, cwd=here, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True))
+    r = PodRouter(pod, poll_s=0.1, window_s=0.1, heartbeat_timeout=1.5)
+    probe, ref = artifacts['probe'], artifacts['ref']
+    results, errors = [], []
+    lock = threading.Lock()
+    stop_traffic = threading.Event()
+
+    def driver():
+        while not stop_traffic.is_set():
+            try:
+                f = r.submit('rec', {'ids': probe})
+                out = np.asarray(f.result(60)[0])
+                with lock:
+                    results.append(out)
+            except Exception as e:  # noqa: BLE001 — counted, must be 0
+                with lock:
+                    errors.append(e)
+            time.sleep(0.02)
+
+    try:
+        r.wait_for_replicas('rec', 2, timeout=240)
+        threads = [threading.Thread(target=driver) for _ in range(4)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with lock:
+                if len(results) >= 8:
+                    break
+            time.sleep(0.1)
+        with lock:
+            n_before = len(results)
+        assert n_before >= 8, 'no pre-kill traffic completed'
+        # SIGKILL host 1 mid-traffic (the elastic harness fault)
+        procs[1].send_signal(signal.SIGKILL)
+        t_kill = time.monotonic()
+        while time.monotonic() - t_kill < 120:
+            if r.lost_hosts:
+                break
+            time.sleep(0.1)
+        assert r.lost_hosts and r.lost_hosts[0]['host'] == 1
+        assert 'HostLost' in r.lost_hosts[0]['error']
+        # survivor heals: replacement replica re-sharded onto dp=4
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            view = r.replicas('rec')
+            if len(view) >= 2 and all(v['host'] == 0 for v in view):
+                break
+            time.sleep(0.2)
+        view = r.replicas('rec')
+        assert len(view) >= 2 and all(v['host'] == 0 for v in view)
+        ev = obs_events('serving.replica.reshard')
+        assert ev and ev[-1]['fields']['host'] == 0
+        assert ev[-1]['fields'].get('mesh') == [['dp', 4]]
+        # steady state after recovery: more traffic, zero compiles on
+        # the survivor (its stats publish the executor counters)
+        caches0 = {v['key']: 0 for v in view}
+        time.sleep(1.0)
+        for info in r._known.values():
+            caches0[info['proxy'].key] = \
+                (info['proxy'].cache_stats() or {}).get('misses') or 0
+        with lock:
+            n_mid = len(results)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            with lock:
+                if len(results) >= n_mid + 12:
+                    break
+            time.sleep(0.1)
+        stop_traffic.set()
+        for t in threads:
+            t.join(60)
+        for info in r._known.values():
+            after = (info['proxy'].cache_stats() or {}).get('misses') or 0
+            assert after == caches0.get(info['proxy'].key, after), \
+                'replica %s compiled in steady state' % info['proxy'].key
+        # ZERO dropped futures, every result correct
+        assert errors == [], errors[:3]
+        with lock:
+            assert len(results) > n_before
+            for out in results:
+                np.testing.assert_allclose(out, ref, rtol=1e-4,
+                                           atol=1e-5)
+    finally:
+        stop_traffic.set()
+        with open(stop_file, 'w') as f:
+            f.write('stop')
+        r.shutdown(drain=False)
+        for p in procs:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    assert procs[1].returncode == -signal.SIGKILL

@@ -140,11 +140,15 @@ for name in sorted(set(new) & set(prev)):
     # (the input-wait metric already ends in _ms and rides that rule);
     # the streaming family (docs/embedding.md#streaming) adds freshness
     # lag (*_lag_s) — lower is fresher — while its push latency
-    # (*_push_ms) already rides the _ms rule
+    # (*_push_ms) already rides the _ms rule; the pod-serving family
+    # (docs/serving.md#pod) adds host-loss recovery/detection times
+    # (*_recovery_s, *_detect_s) — lower means the pod healed faster
     lower_is_better = (name.endswith('_ms') or name.endswith('.dropped')
                        or name.endswith('_temp_bytes')
                        or name.endswith('_stall_s')
                        or name.endswith('_lag_s')
+                       or name.endswith('_recovery_s')
+                       or name.endswith('_detect_s')
                        or name.endswith('_compiles'))
     if lower_is_better:
         if ratio > 1.1:

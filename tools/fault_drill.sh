@@ -17,10 +17,16 @@
 # gradient — each must fail typed and never strand a future or commit a
 # torn row (docs/embedding.md#streaming).
 #
+# The pod-serving tier (tests/test_pod_serving.py, marker `pod`) rides
+# along as well: host-loss drain/re-route/re-shard self-healing with
+# zero dropped futures, typed remote errors, heal-failure re-dispatch,
+# autoscale up/down (docs/serving.md#pod). Its 2-process SIGKILL drill
+# is `slow` and so excluded here.
+#
 # Usage: tools/fault_drill.sh [extra pytest args]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 exec env JAX_PLATFORMS=cpu python -m pytest \
-    -m '(faults or elastic) and not slow' \
+    -m '(faults or elastic or pod) and not slow' \
     -q -p no:cacheprovider "$@" tests/test_faults.py tests/test_elastic.py \
-    tests/test_streaming.py
+    tests/test_streaming.py tests/test_pod_serving.py

@@ -242,6 +242,18 @@ if [ "${PAGED:-0}" = 1 ]; then
       --check-compiles --check-speedup 2.0
 fi
 
+# 10aa. pod-scale serving (opt-in: POD=1): sharded-replica scoring
+#      across 2 worker processes (row-sharded table restored from a
+#      sharded checkpoint, never dense) with a mid-run SIGKILL host
+#      loss — reports host-loss detect + recovery time
+#      (serve.pod.recovery_s, lower-is-better in bench_sentinel),
+#      rows/sec before/after, dropped futures (must be 0), and
+#      post-recovery steady compiles (--check-compiles enforces 0;
+#      docs/serving.md#pod). Host-side failover machinery: CPU-safe.
+if [ "${POD:-0}" = 1 ]; then
+  run python tools/serve_bench.py --workload pod-sharded --check-compiles
+fi
+
 # 10b. speculative decoding (opt-in: SPEC=1): greedy target-only vs
 #      draft-then-verify on the predictable-continuation decoder;
 #      reports measured accept-rate and enforces a tokens/sec win

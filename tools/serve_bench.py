@@ -693,6 +693,268 @@ def run_decode(args):
 
 
 # ---------------------------------------------------------------------------
+# pod-sharded workload: sharded replicas across 2 worker processes with a
+# mid-run SIGKILL host loss (docs/serving.md#pod)
+# ---------------------------------------------------------------------------
+
+_POD_PREP = r"""
+import os, sys
+import jax
+jax.config.update('jax_platforms', 'cpu')
+try:
+    jax.config.update('jax_num_cpu_devices', 8)
+except AttributeError:
+    os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS', '')
+                               + ' --xla_force_host_platform_device_count=8')
+import numpy as np
+sys.path.insert(0, os.environ['PADDLE_TPU_REPO'])
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework, unique_name
+from paddle_tpu.fluid.executor import Scope, _switch_scope
+from paddle_tpu.utils import checkpoint as ck
+from paddle_tpu import serving
+
+base, vocab, dim = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+main, startup, scope = framework.Program(), framework.Program(), Scope()
+prev = _switch_scope(scope)
+try:
+    with unique_name.guard():
+        with framework.program_guard(main, startup):
+            ids = fluid.layers.data(name='ids', shape=[2, 1],
+                                    dtype='int64')
+            emb = fluid.layers.embedding(
+                ids, size=[vocab, dim], is_sparse=True,
+                is_distributed=True,
+                param_attr=fluid.ParamAttr(name='emb_w',
+                                           sharding=('dp', None)))
+            pred = fluid.layers.fc(input=emb, size=1, num_flatten_dims=2,
+                                   bias_attr=False,
+                                   param_attr=fluid.ParamAttr(name='fc_w'))
+            loss = fluid.layers.mean(fluid.layers.square(pred - 1.0))
+            fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+            main.set_mesh({'dp': 8})
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            rng = np.random.RandomState(0)
+            for _ in range(3):
+                b = rng.randint(0, vocab, (8, 2, 1)).astype('int64')
+                exe.run(main, feed={'ids': b}, fetch_list=[loss])
+            state = exe.state_dict(main, scope=scope)
+            ck.save_sharded(os.path.join(base, 'ckpt', 'sharded_1'),
+                            {'emb_w': state['emb_w'],
+                             'fc_w': state['fc_w']}, step=1)
+            serving.save_serving_program(os.path.join(base, 'model'),
+                                         ['ids'], [pred],
+                                         main_program=main)
+            probe = rng.randint(0, vocab, (8, 2, 1)).astype('int64')
+            infer = main.clone(for_test=True).prune([pred])
+            ref = exe.run(infer, feed={'ids': probe},
+                          fetch_list=[pred.name], scope=scope)
+            np.savez(os.path.join(base, 'probe.npz'), probe=probe,
+                     ref=np.asarray(ref[0]))
+finally:
+    _switch_scope(prev)
+print('PREP-OK')
+"""
+
+_POD_WORKER = r"""
+import os, sys, time
+import jax
+jax.config.update('jax_platforms', 'cpu')
+try:
+    jax.config.update('jax_num_cpu_devices', 8)
+except AttributeError:
+    os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS', '')
+                               + ' --xla_force_host_platform_device_count=8')
+sys.path.insert(0, os.environ['PADDLE_TPU_REPO'])
+from paddle_tpu import serving
+
+host, pod_dir, model_dir, ckpt_dir = (int(sys.argv[1]), sys.argv[2],
+                                      sys.argv[3], sys.argv[4])
+mesh_n, heal_n, stop_file = int(sys.argv[5]), int(sys.argv[6]), sys.argv[7]
+
+
+def build(n):
+    def b(reason):
+        return serving.sharded_replica(
+            model_dir, mesh_axes={'dp': n}, ckpt_dir=ckpt_dir,
+            config=serving.ServingConfig(max_batch_size=8, buckets=[8],
+                                         max_queue_delay_ms=1.0))
+    return b
+
+
+w = serving.PodWorker(pod_dir, host=host, builders={'rec': build(heal_n)})
+w.serve('rec', build(mesh_n)('boot'))
+print('SERVING %d' % host)
+sys.stdout.flush()
+while not os.path.exists(stop_file):
+    time.sleep(0.1)
+w.shutdown()
+"""
+
+
+def run_pod_sharded(args):
+    """The POD-SHARDED drill: two worker processes each serve the SAME
+    set_mesh-annotated Program (row-sharded embedding table restored
+    from a sharded checkpoint — never materialized dense) behind one
+    PodRouter; mid-run one host is SIGKILLed. Reports: host-loss detect
+    + RECOVERY time (`serve.pod.recovery_s`, lower-is-better in
+    bench_sentinel), dropped-future count (must be 0), rows/sec before
+    vs after recovery, and post-recovery steady-state compiles
+    (--check-compiles enforces 0)."""
+    import shutil
+    import signal
+    import subprocess
+
+    base = tempfile.mkdtemp(prefix='serve_bench_pod_')
+    pod_dir = os.path.join(base, 'pod')
+    stop_file = os.path.join(base, 'stop')
+    env = dict(os.environ, PADDLE_TPU_REPO=_REPO)
+    for k in ('JAX_PLATFORMS', 'XLA_FLAGS', 'PADDLE_TPU_OBS_RUN_FILE'):
+        env.pop(k, None)
+    rc = 0
+    procs = []
+    router = None
+    try:
+        prep = subprocess.run(
+            [sys.executable, '-c', _POD_PREP, base, str(args.vocab),
+             '4'], capture_output=True, text=True, timeout=900, env=env)
+        if prep.returncode != 0 or 'PREP-OK' not in prep.stdout:
+            raise RuntimeError('pod prep failed:\n%s'
+                               % prep.stderr[-2000:])
+        with np.load(os.path.join(base, 'probe.npz')) as z:
+            probe, ref = z['probe'], z['ref']
+        _emit({'metric': 'serve.pod.workload',
+               'value': '2 hosts x dp=8 sharded replicas, vocab=%d, '
+                        'heal mesh dp=4' % args.vocab})
+        for host in (0, 1):
+            procs.append(subprocess.Popen(
+                [sys.executable, '-c', _POD_WORKER, str(host), pod_dir,
+                 os.path.join(base, 'model'),
+                 os.path.join(base, 'ckpt'), '8', '4', stop_file],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True))
+        from paddle_tpu import serving
+        router = serving.PodRouter(pod_dir, poll_s=0.1, window_s=0.1,
+                                   heartbeat_timeout=1.5)
+        router.wait_for_replicas('rec', 2, timeout=600)
+
+        done = []            # completion wall-clock stamps
+        errors = []
+        lock = threading.Lock()
+        stop_traffic = threading.Event()
+
+        def driver():
+            while not stop_traffic.is_set():
+                try:
+                    f = router.submit('rec', {'ids': probe})
+                    out = np.asarray(f.result(120)[0])
+                    if not np.allclose(out, ref, rtol=1e-3, atol=1e-4):
+                        raise RuntimeError('wrong scores after failover')
+                    with lock:
+                        done.append(time.perf_counter())
+                except Exception as e:  # noqa: BLE001 — dropped = bug
+                    with lock:
+                        errors.append(e)
+                time.sleep(0.01)
+
+        threads = [threading.Thread(target=driver, daemon=True)
+                   for _ in range(args.concurrency)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        while time.perf_counter() - t0 < 60:
+            with lock:
+                if len(done) >= args.requests // 2:
+                    break
+            time.sleep(0.1)
+        with lock:
+            n_before = len(done)
+        t_kill = time.perf_counter()
+        procs[1].send_signal(signal.SIGKILL)
+        t_detect = t_heal = None
+        deadline = time.perf_counter() + 300
+        while time.perf_counter() < deadline:
+            if t_detect is None and router.lost_hosts:
+                t_detect = time.perf_counter()
+            view = router.replicas('rec')
+            if len(view) >= 2 and all(v['host'] == 0 for v in view):
+                t_heal = time.perf_counter()
+                break
+            time.sleep(0.05)
+        if t_heal is None:
+            raise RuntimeError('replica never healed onto the survivor')
+        # steady state after recovery: compile counters frozen
+        time.sleep(1.0)
+        compiles0 = {}
+        for info in router._known.values():
+            compiles0[info['proxy'].key] = \
+                (info['proxy'].cache_stats() or {}).get('misses') or 0
+        t_after0 = time.perf_counter()
+        with lock:
+            n_mid = len(done)
+        while time.perf_counter() - t_after0 < 60:
+            with lock:
+                if len(done) >= n_mid + args.requests // 2:
+                    break
+            time.sleep(0.1)
+        stop_traffic.set()
+        for t in threads:
+            t.join(120)
+        time.sleep(0.5)
+        steady = 0
+        for info in router._known.values():
+            after = (info['proxy'].cache_stats() or {}).get('misses') or 0
+            steady += max(0, after - compiles0.get(info['proxy'].key,
+                                                   after))
+        with lock:
+            n_after = len(done) - n_mid
+            n_err = len(errors)
+        rows = probe.shape[0]
+        _emit({'metric': 'serve.pod.rows_per_sec_before',
+               'value': round(rows * n_before / max(t_kill - t0, 1e-9),
+                              2), 'unit': 'rows/s'})
+        _emit({'metric': 'serve.pod.rows_per_sec_after',
+               'value': round(rows * n_after
+                              / max(time.perf_counter() - t_after0,
+                                    1e-9), 2), 'unit': 'rows/s'})
+        if t_detect is not None:
+            _emit({'metric': 'serve.pod.detect_s',
+                   'value': round(t_detect - t_kill, 3), 'unit': 's'})
+        _emit({'metric': 'serve.pod.recovery_s',
+               'value': round(t_heal - t_kill, 3), 'unit': 's'})
+        _emit({'metric': 'serve.pod.rerouted',
+               'value': (router.lost_hosts[0]['rerouted']
+                         if router.lost_hosts else 0)})
+        _emit({'metric': 'serve.pod.dropped', 'value': n_err})
+        _emit({'metric': 'serve.pod.steady_compiles', 'value': steady})
+        if n_err:
+            print('serve_bench: %d future(s) dropped across the host '
+                  'loss (first: %r)' % (n_err, errors[0]),
+                  file=sys.stderr)
+            rc = 1
+        if args.check_compiles and steady:
+            print('serve_bench: %d compile(s) in the post-recovery '
+                  'steady state' % steady, file=sys.stderr)
+            rc = 1
+    finally:
+        try:
+            with open(stop_file, 'w') as f:
+                f.write('stop')
+        except OSError:
+            pass
+        if router is not None:
+            router.shutdown(drain=False)
+        for p in procs:
+            try:
+                p.wait(timeout=30)
+            except Exception:
+                p.kill()
+        shutil.rmtree(base, ignore_errors=True)
+    return rc
+
+
+# ---------------------------------------------------------------------------
 # aot-cold workload: cold-replica time-to-first-response with and without
 # an imported AOT warm-signature blob (docs/perf.md#aot)
 # ---------------------------------------------------------------------------
@@ -823,7 +1085,7 @@ def main(argv=None):
                     help='exit 1 if the steady-state phase compiled')
     ap.add_argument('--workload',
                     choices=('infer', 'decode', 'decode-paged',
-                             'decode-spec', 'aot-cold'),
+                             'decode-spec', 'aot-cold', 'pod-sharded'),
                     default='infer',
                     help='infer: single-shot requests through the '
                          'ServingEngine; decode: autoregressive beam '
@@ -840,7 +1102,13 @@ def main(argv=None):
                          'model dials to their regime (long max_len / '
                          'short requests for paged capacity; a '
                          'vocab-heavy predictable-continuation decoder '
-                         'for speculation) unless set explicitly.')
+                         'for speculation) unless set explicitly; '
+                         'pod-sharded: 2 worker processes serve a '
+                         'set_mesh-sharded Program (row-sharded table '
+                         'from a sharded checkpoint, never dense) '
+                         'behind a PodRouter, one host SIGKILLed '
+                         'mid-run — recovery_s, dropped=0, rows/sec '
+                         'before/after, post-recovery steady compiles.')
     ap.add_argument('--page-size', type=int, default=8,
                     help='paged workloads: rows per page')
     ap.add_argument('--paged-slots', type=int, default=0,
@@ -889,12 +1157,15 @@ def main(argv=None):
                         'hidden': 48, 'decode_max_len': 64,
                         'src_cap': 8, 'min_tokens': 48, 'beam': 1,
                         'requests': 48, 'reps': 3},
+        'pod-sharded': {'requests': 64, 'concurrency': 4, 'vocab': 64},
     }
     for k, v in wl_defaults.get(args.workload, {}).items():
         if getattr(args, k) == ap.get_default(k):
             setattr(args, k, v)
 
     _resolve_platform()
+    if args.workload == 'pod-sharded':
+        return run_pod_sharded(args)
     if args.workload == 'aot-cold':
         return run_aot_cold(args)
     if args.workload == 'decode':
