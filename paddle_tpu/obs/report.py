@@ -758,6 +758,53 @@ def summarize(events):
             lines.append('autoscale: %d up, %d down'
                          % (ups, len(pd_scale) - ups))
 
+    # -- rpc transport + per-token streams ---------------------------------
+    tr_conn = _events(events, 'serving.transport.connect')
+    tr_reco = _events(events, 'serving.transport.reconnect')
+    tr_err = _events(events, 'serving.transport.error')
+    tr_rej = _events(events, 'serving.transport.reject')
+    st_open = _events(events, 'serving.stream.open')
+    st_first = _events(events, 'serving.stream.first_token')
+    st_res = _events(events, 'serving.stream.resume')
+    st_fail = _events(events, 'serving.stream.failover')
+    st_close = _events(events, 'serving.stream.close')
+    if tr_conn or tr_reco or tr_err or st_open or st_close:
+        lines.append('')
+        lines.append('-- transport / streams --')
+        if tr_conn or tr_reco or tr_err or tr_rej:
+            lines.append('rpc wire: %d connect(s), %d reconnect(s), '
+                         '%d wire error(s), %d admission reject(s)'
+                         % (len(tr_conn), len(tr_reco), len(tr_err),
+                            len(tr_rej)))
+        if st_open or st_close:
+            failed = [e for e in st_close
+                      if e.get('fields', {}).get('error')]
+            lines.append('streams: %d opened, %d closed (%d failed)'
+                         % (len(st_open), len(st_close), len(failed)))
+        if st_first:
+            ttfts = sorted(e['fields']['ttft_s'] for e in st_first
+                           if e.get('fields', {}).get('ttft_s')
+                           is not None)
+            if ttfts:
+                lines.append('ttft: min=%s p50=%s max=%s over %d '
+                             'stream(s)'
+                             % (_fmt_s(ttfts[0]),
+                                _fmt_s(ttfts[len(ttfts) // 2]),
+                                _fmt_s(ttfts[-1]), len(ttfts)))
+        if st_res or st_fail:
+            replayed = sum(int(e.get('fields', {}).get('replayed') or 0)
+                           for e in st_res)
+            lines.append('failover: %d stream(s) lost a host, %d '
+                         'resumed token-exact (%d token(s) replayed)'
+                         % (len(st_fail) + len(st_res), len(st_res),
+                            replayed))
+            for e in st_fail:
+                f = e.get('fields', {})
+                if not f.get('resumed', True):
+                    lines.append('  NOT resumed (ckpt_every=0): sid=%s '
+                                 'at t=%s' % (f.get('sid', '-'),
+                                              f.get('seen_t', '?')))
+
     if rt_swap or rt_over:
         lines.append('')
         lines.append('-- router --')

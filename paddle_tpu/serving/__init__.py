@@ -20,26 +20,30 @@ See docs/serving.md; run the serving test tier with `pytest -m serving`.
 """
 from . import buckets  # noqa: F401
 from . import pages  # noqa: F401
+from . import transport  # noqa: F401
 from .buckets import default_buckets, pad_rows, pick_bucket  # noqa: F401
 from .pages import PagePool, PrefixCache  # noqa: F401
 from .decode import (DecodeConfig, DecodeEngine,  # noqa: F401
-                     DecodeSlotPoisoned, LockstepDecoder, mt_weights,
-                     program_prefill)
+                     DecodeSlotPoisoned, LockstepDecoder, StreamCancelled,
+                     mt_weights, program_prefill)
 from .engine import (DeadlineExceeded, ServerClosed,  # noqa: F401
                      ServerOverloaded, ServingConfig, ServingEngine)
 from .router import (ModelOverloaded, Router,  # noqa: F401
-                     UnknownModel)
+                     TokenStream, UnknownModel)
+from .transport import Channel, RpcServer, TransportError  # noqa: F401
 from .pod import (AutoscalePolicy, Autoscaler, PodRouter,  # noqa: F401
-                  PodWorker, RemoteReplica, ShardedPredictor,
+                  PodWorker, RemoteReplica, RpcReplica, ShardedPredictor,
                   save_serving_program, sharded_replica)
 
 __all__ = ['ServingEngine', 'ServingConfig', 'ServerOverloaded',
            'ServerClosed', 'DeadlineExceeded', 'buckets',
            'default_buckets', 'pick_bucket', 'pad_rows',
            'DecodeConfig', 'DecodeEngine', 'DecodeSlotPoisoned',
-           'LockstepDecoder', 'mt_weights', 'program_prefill',
-           'Router', 'ModelOverloaded', 'UnknownModel',
+           'LockstepDecoder', 'StreamCancelled', 'mt_weights',
+           'program_prefill',
+           'Router', 'ModelOverloaded', 'TokenStream', 'UnknownModel',
            'pages', 'PagePool', 'PrefixCache',
-           'PodRouter', 'PodWorker', 'RemoteReplica', 'ShardedPredictor',
-           'sharded_replica', 'save_serving_program',
+           'transport', 'Channel', 'RpcServer', 'TransportError',
+           'PodRouter', 'PodWorker', 'RemoteReplica', 'RpcReplica',
+           'ShardedPredictor', 'sharded_replica', 'save_serving_program',
            'AutoscalePolicy', 'Autoscaler']

@@ -67,7 +67,8 @@ from .engine import (DeadlineExceeded, ServerClosed, ServerOverloaded,
                      _POLL_S)
 
 __all__ = ['DecodeConfig', 'DecodeEngine', 'DecodeSlotPoisoned',
-           'LockstepDecoder', 'mt_weights', 'program_prefill']
+           'LockstepDecoder', 'StreamCancelled', 'mt_weights',
+           'program_prefill']
 
 WEIGHT_KEYS = ('w_dec', 'u_dec', 'b_dec', 'w_q', 'w_emb', 'w_out', 'b_out')
 
@@ -91,6 +92,13 @@ class DecodeSlotPoisoned(RuntimeError):
     feed / encoder fault). Only that slot's future receives this error;
     the slot is freed and every other in-flight sequence is untouched
     (the step's where-select masking isolates rows)."""
+
+
+class StreamCancelled(RuntimeError):
+    """The request was cancelled before completing — a streaming
+    consumer disconnected mid-generation, or `cancel()` was called
+    explicitly. The slot and its pages are already back in the pool
+    when this resolves the future."""
 
 
 class DecodeConfig(object):
@@ -342,10 +350,12 @@ class LockstepDecoder(object):
 
 class _Request(object):
     __slots__ = ('feed', 'limit', 'future', 't_submit', 'deadline',
-                 't_join', 'pkey', 'hist_need', 'enc_need')
+                 't_join', 'pkey', 'hist_need', 'enc_need', 'on_token',
+                 'resume', 'checkpoint', 'ckpt_every', 'aborted')
 
     def __init__(self, feed, limit, future, t_submit, deadline,
-                 pkey=None, hist_need=0, enc_need=0):
+                 pkey=None, hist_need=0, enc_need=0, on_token=None,
+                 resume=None, checkpoint=None, ckpt_every=0):
         self.feed = feed
         self.limit = limit
         self.future = future
@@ -357,6 +367,15 @@ class _Request(object):
         self.pkey = pkey
         self.hist_need = hist_need
         self.enc_need = enc_need
+        # streaming: per-token callback, failover checkpoint sink +
+        # cadence, resume state, and the consumer-gone flag (set from
+        # any thread; the decode loop frees the slot at the next
+        # dispatch boundary)
+        self.on_token = on_token
+        self.resume = resume
+        self.checkpoint = checkpoint
+        self.ckpt_every = ckpt_every
+        self.aborted = False
 
 
 # process-wide decode telemetry (docs/serving.md); per-engine views live
@@ -383,6 +402,9 @@ _C_PREFIX_MISSES = obs.counter('decode.prefix.misses')
 _C_PREFIX_EVICT = obs.counter('decode.prefix.evictions')
 _C_SPEC_PROPOSED = obs.counter('decode.spec.proposed')
 _C_SPEC_ACCEPTED = obs.counter('decode.spec.accepted')
+# streaming / failover (docs/serving.md#streams)
+_C_CANCELLED = obs.counter('decode.cancelled')
+_C_RESUMED = obs.counter('decode.resumed')
 
 
 class DecodeEngine(object):
@@ -852,20 +874,72 @@ class DecodeEngine(object):
     # -- admission ---------------------------------------------------------
 
     def submit(self, feed, max_new_tokens=None, deadline_ms=None,
-               timeout=None):
+               timeout=None, on_token=None, resume=None, checkpoint=None,
+               ckpt_every=0):
         """Enqueue one decode request; returns a Future resolving to
         (sentence_ids [beam, max_new_tokens] int, sentence_scores [beam]
         float32). Raises ServerClosed after shutdown, ServerOverloaded
         under the 'reject' policy (or a 'block' admission timeout), and
         ValueError for malformed feeds. A deadline sheds the request
         with DeadlineExceeded if it is still QUEUED when it passes (an
-        already-decoding sequence completes)."""
+        already-decoding sequence completes).
+
+        Streaming (docs/serving.md#streams): `on_token(t, ids)` fires
+        from the decode-loop thread for every generated token, t = 1..,
+        ids = the [beam_size] raw beam column for that step (the final
+        result is still the backtraced history). A callback that RAISES
+        marks the consumer gone: the slot is aborted, its pages return
+        to the pool, and the future fails typed StreamCancelled.
+        `checkpoint(state)` fires every `ckpt_every` tokens with a dict
+        that `resume=` accepts verbatim; `resume` overwrites the slot's
+        state right after the join so generation continues token-exact
+        from state['step'] — the decode-stream failover path. Resume is
+        applied EAGERLY through the push_rows seam, so it compiles
+        nothing."""
         cfg = self.config
         limit = cfg.max_len if max_new_tokens is None else int(max_new_tokens)
         if not 1 <= limit <= cfg.max_len:
             raise ValueError(
                 'max_new_tokens=%d out of range [1, %d] (the slot token '
                 'buffer is fixed at engine build)' % (limit, cfg.max_len))
+        ckpt_every = int(ckpt_every or 0)
+        if on_token is not None and not callable(on_token):
+            raise ValueError('on_token must be callable, got %r'
+                             % (on_token,))
+        if checkpoint is not None and not callable(checkpoint):
+            raise ValueError('checkpoint must be callable, got %r'
+                             % (checkpoint,))
+        if resume is not None:
+            resume = {k: np.asarray(v) for k, v in dict(resume).items()}
+            need = ('h', 'c', 'prev_ids', 'acc', 'fin', 'step', 'ids',
+                    'par')
+            missing = [k for k in need if k not in resume]
+            if missing:
+                raise ValueError('resume state missing %r (need %r)'
+                                 % (missing, list(need)))
+            t_res = int(resume['step'])
+            K = cfg.beam_size
+            if not 0 <= t_res <= limit:
+                raise ValueError(
+                    'resume step=%d out of range [0, max_new_tokens=%d]'
+                    % (t_res, limit))
+            if tuple(resume['ids'].shape) != (t_res, K) \
+                    or tuple(resume['par'].shape) != (t_res, K):
+                raise ValueError(
+                    'resume ids/par must be [%d, %d] (step x beam), got '
+                    '%r / %r' % (t_res, K, tuple(resume['ids'].shape),
+                                 tuple(resume['par'].shape)))
+            if t_res >= limit:
+                # nothing left to generate: the checkpoint already holds
+                # the full history — resolve without consuming a slot
+                from ..fluid.ops_impl.lod_beam import backtrace_beams
+                toks = backtrace_beams(resume['ids'].astype(np.int32),
+                                       resume['par'].astype(np.int32))
+                fut = concurrent.futures.Future()
+                fut.set_running_or_notify_cancel()
+                fut.set_result((toks.astype(np.int64),
+                                resume['acc'].astype(np.float32)))
+                return fut
         if self._prefill is None:
             if 'enc' not in feed:
                 raise ValueError(
@@ -899,7 +973,9 @@ class DecodeEngine(object):
                 pkey = _pages.content_key(feed)
         fut = concurrent.futures.Future()
         req = _Request(feed, limit, fut, now, deadline, pkey=pkey,
-                       hist_need=hist_need, enc_need=enc_need)
+                       hist_need=hist_need, enc_need=enc_need,
+                       on_token=on_token, resume=resume,
+                       checkpoint=checkpoint, ckpt_every=ckpt_every)
         t_give_up = now + timeout if timeout is not None else None
         with self._lock:
             while True:
@@ -973,6 +1049,35 @@ class DecodeEngine(object):
                 'no result within the %.3fs predict() timeout; the '
                 'sequence is already decoding — it completes but the '
                 'result is discarded' % timeout)
+
+    def cancel(self, future):
+        """Best-effort cancel of one submitted request by its future —
+        the mid-stream-disconnect path (the pod worker calls this when
+        a stream's client connection dies). A still-QUEUED request
+        fails StreamCancelled immediately; one already decoding has its
+        slot aborted at the next dispatch boundary, returning the slot
+        AND its pages to the pool (no leaked capacity). Returns True if
+        the request was found, False if it already completed or was
+        never this engine's."""
+        with self._lock:
+            found = None
+            for i, r in enumerate(self._queue):
+                if r.future is future:
+                    found = r
+                    del self._queue[i]
+                    _G_QDEPTH.set(len(self._queue))
+                    self._not_full.notify()
+                    break
+        if found is not None:
+            if found.future.set_running_or_notify_cancel():
+                found.future.set_exception(StreamCancelled(
+                    'decode request cancelled while queued'))
+            return True
+        for occ in list(self._occupant):
+            if occ is not None and occ.future is future:
+                occ.aborted = True
+                return True
+        return False
 
     # -- warmup ------------------------------------------------------------
 
@@ -1156,6 +1261,8 @@ class DecodeEngine(object):
             self._occupant[slot] = req
             self._slot_steps[slot] = 0
             req.t_join = now
+            if req.resume is not None:
+                self._apply_resume(slot, req)
             with self._lock:
                 self._n['joins'] += 1
                 self._win['joins'] += 1
@@ -1332,6 +1439,8 @@ class DecodeEngine(object):
                 'hist': hist_pages[i], 'enc': enc_pages_of[i],
                 'pkey': req.pkey}
             req.t_join = now
+            if req.resume is not None:
+                self._apply_resume(slot, req)
             with self._lock:
                 self._n['joins'] += 1
                 self._win['joins'] += 1
@@ -1353,6 +1462,147 @@ class DecodeEngine(object):
         with self._lock:
             self._n['slots_high_water'] = max(
                 self._n['slots_high_water'], occ_now)
+
+    def _apply_resume(self, slot, req):
+        """Overwrite one JUST-JOINED slot's rows with checkpointed
+        state — the decode-stream failover resume. The join scatter
+        already installed the encoder rows / page tables from the
+        retained original feed; this restores the generation state on
+        top: carry, previous beam ids, scores, finish flags, step
+        counter, and the token history written back into the slot's
+        (freshly claimed) history buffer or pages. Everything lands
+        EAGERLY through StepHandle.set_state under the handle lock —
+        the push_rows seam — so no new jitted signature exists and a
+        resumed stream performs zero compiles (loop thread only)."""
+        import jax.numpy as jnp
+        cfg = self.config
+        st = req.resume
+        t = int(st['step'])
+        K = cfg.beam_size
+        with self._handle_lock:
+            handle = self._acquire()
+            state = handle.state
+
+            def put_row(name, rows):
+                cur = jnp.asarray(state['cbd_' + name])
+                handle.set_state(
+                    'cbd_' + name,
+                    cur.at[slot].set(jnp.asarray(np.asarray(rows),
+                                                 cur.dtype)))
+
+            for name in ('h', 'c', 'prev_ids', 'acc', 'fin'):
+                put_row(name, st[name])
+            for name in _DRAFT_STATE:
+                if name in st and 'cbd_' + name in state:
+                    put_row(name, st[name])
+            put_row('step', t)
+            ids = np.asarray(st['ids'], np.int32).reshape(t, K)
+            par = np.asarray(st['par'], np.int32).reshape(t, K)
+            if cfg.paged:
+                pages = self._slot_pages[slot]['hist']
+                ps = cfg.page_size
+                idx = jnp.asarray(np.asarray(pages, np.int32))
+                for pool_name, content in (('hist_ids', ids),
+                                           ('hist_par', par)):
+                    rows = np.zeros((len(pages) * ps, K), np.int32)
+                    rows[:t] = content
+                    cur = jnp.asarray(state['cbd_' + pool_name])
+                    handle.set_state(
+                        'cbd_' + pool_name,
+                        cur.at[idx].set(jnp.asarray(
+                            rows.reshape(len(pages), ps, K), cur.dtype)))
+            else:
+                for hist_name, content in (('ids_hist', ids),
+                                           ('par_hist', par)):
+                    cur = jnp.asarray(state['cbd_' + hist_name])
+                    handle.set_state(
+                        'cbd_' + hist_name,
+                        cur.at[slot, :t].set(jnp.asarray(content,
+                                                         cur.dtype)))
+        self._slot_steps[slot] = t
+        with self._lock:
+            self._n['resumed'] += 1
+            self._win['resumed'] += 1
+        _C_RESUMED.inc()
+        obs.event('decode.resume', slot=slot, step=t, limit=req.limit)
+
+    def _snapshot_slot(self, slot, t, ids_np, par_np, acc_np):
+        """One slot's decode state at token `t`, exactly the dict
+        `submit(resume=...)` restores: carry + beam state rows read
+        from the handle (one host copy per array, cadence-limited) and
+        the token history sliced from this dispatch's fetched arrays
+        (loop thread only)."""
+        snap = {'step': np.asarray(t, np.int32),
+                'acc': np.asarray(acc_np[slot])}
+        with self._handle_lock:
+            state = self._acquire().state
+            for name in ('h', 'c', 'prev_ids', 'fin'):
+                snap[name] = np.asarray(state['cbd_' + name])[slot]
+            for name in _DRAFT_STATE:
+                if 'cbd_' + name in state:
+                    snap[name] = np.asarray(state['cbd_' + name])[slot]
+        K = self.config.beam_size
+        if self.config.paged:
+            sp = self._slot_pages[slot]
+            snap['ids'] = np.asarray(
+                ids_np[sp['hist']].reshape(-1, K)[:t])
+            snap['par'] = np.asarray(
+                par_np[sp['hist']].reshape(-1, K)[:t])
+        else:
+            snap['ids'] = np.asarray(ids_np[slot, :t])
+            snap['par'] = np.asarray(par_np[slot, :t])
+        return snap
+
+    def _token_row(self, slot, s, ids_np):
+        """The [beam_size] raw beam column generated at step `s` (1-
+        based) of `slot`, from this dispatch's fetched history."""
+        if self.config.paged:
+            sp = self._slot_pages[slot]
+            ps = self.config.page_size
+            return np.asarray(ids_np[sp['hist'][(s - 1) // ps],
+                                     (s - 1) % ps])
+        return np.asarray(ids_np[slot, s - 1])
+
+    def _abort_slot(self, slot):
+        """Free a slot whose stream consumer went away (loop thread
+        only): deactivate the row eagerly (the push_rows seam — no new
+        signature), return slot + pages to the pool, fail the future
+        typed StreamCancelled. The remaining in-flight sequences never
+        notice — the step's where-select masking isolates rows."""
+        import jax.numpy as jnp
+        req = self._occupant[slot]
+        taken = self._slot_steps[slot]
+        with self._handle_lock:
+            handle = self._acquire()
+            cur = jnp.asarray(handle.state['cbd_active'])
+            handle.set_state('cbd_active', cur.at[slot].set(False))
+        self._occupant[slot] = None
+        sp = self._slot_pages[slot]
+        self._slot_pages[slot] = None
+        if sp is not None:
+            self._hist_pool.release(sp['hist'])
+            if sp['pkey'] is not None:
+                self._prefix.unref(sp['pkey'])
+            else:
+                self._enc_pool.release(sp['enc'])
+            _G_PAGES_FREE.set(self._hist_pool.free_count
+                              + self._enc_pool.free_count)
+        with self._lock:
+            self._n['cancelled'] += 1
+            self._win['cancelled'] += 1
+            self._n['releases'] += 1
+            self._win['releases'] += 1
+        _C_CANCELLED.inc()
+        _C_RELEASES.inc()
+        _G_SLOTS.set(sum(o is not None for o in self._occupant))
+        obs.event('decode.cancel', slot=slot, steps=taken)
+        if req is not None and not req.future.done():
+            try:
+                req.future.set_exception(StreamCancelled(
+                    'decode slot %d cancelled after %d token(s): the '
+                    'stream consumer went away' % (slot, taken)))
+            except Exception:  # noqa: BLE001 — racing cancel() is fine
+                pass
 
     def _release(self, slot, poisoned, ids_np, par_np, acc_np):
         """Resolve the slot's future from the step's fetched token
@@ -1482,6 +1732,11 @@ class DecodeEngine(object):
                     r.future.set_exception(ServerClosed(
                         'decode engine shut down without draining'))
             self._fail_shed(shed)
+            # consumer-gone streams first: their slots (and pages) free
+            # up BEFORE this round's admit and step
+            for slot, occ in enumerate(self._occupant):
+                if occ is not None and occ.aborted:
+                    self._abort_slot(slot)
             if joins:
                 self._admit(joins)
             n_active = sum(o is not None for o in self._occupant)
@@ -1509,8 +1764,15 @@ class DecodeEngine(object):
                 finished = [slot for slot, occ
                             in enumerate(self._occupant)
                             if occ is not None and not active_np[slot]]
-                if finished:
-                    # one host sync for every release this bundle
+                streaming = [slot for slot, occ
+                             in enumerate(self._occupant)
+                             if occ is not None and not occ.aborted
+                             and (occ.on_token is not None
+                                  or (occ.checkpoint is not None
+                                      and occ.ckpt_every))]
+                if finished or streaming:
+                    # one host sync for every release/emission this
+                    # bundle
                     ids_np = np.asarray(ids_v)
                     par_np = np.asarray(par_v)
                     acc_np = np.asarray(acc_v)
@@ -1538,10 +1800,44 @@ class DecodeEngine(object):
                     continue
                 prev_steps = self._slot_steps[slot]
                 self._slot_steps[slot] = int(steps_np[slot])
-                _C_TOKENS.inc(self._slot_steps[slot] - prev_steps)
-                if prev_steps == 0 and self._slot_steps[slot] > 0 \
+                cur = self._slot_steps[slot]
+                _C_TOKENS.inc(cur - prev_steps)
+                if prev_steps == 0 and cur > 0 \
                         and occ.t_join is not None:
                     _H_TTFT.observe(now - occ.t_submit)
+                # stream every token this dispatch produced, IN ORDER —
+                # the emission path is append-only (the wire's writer
+                # queue), so a slow consumer backpressures its socket,
+                # never this loop; a RAISING callback means the
+                # consumer is gone and the slot is reaped next round
+                if occ.on_token is not None and cur > prev_steps \
+                        and not occ.aborted:
+                    for s in range(prev_steps + 1, cur + 1):
+                        try:
+                            occ.on_token(
+                                s, self._token_row(slot, s, ids_np))
+                        except Exception as e:  # noqa: BLE001
+                            occ.aborted = True
+                            obs.event(
+                                'decode.stream.abort', slot=slot,
+                                token=s, error='%s: %s'
+                                % (type(e).__name__, e))
+                            break
+                # checkpoint at every cadence crossing (not for a slot
+                # finishing this dispatch — its result resolves anyway);
+                # a failing sink degrades failover, it must not kill
+                # the stream
+                if occ.checkpoint is not None and occ.ckpt_every \
+                        and not occ.aborted and slot not in finished \
+                        and (cur // occ.ckpt_every
+                             > prev_steps // occ.ckpt_every):
+                    try:
+                        occ.checkpoint(self._snapshot_slot(
+                            slot, cur, ids_np, par_np, acc_np))
+                    except Exception as e:  # noqa: BLE001
+                        obs.event('decode.ckpt.error', slot=slot,
+                                  step=cur, error='%s: %s'
+                                  % (type(e).__name__, e))
                 if slot in finished:
                     self._release(slot,
                                   bool(np.isnan(acc_np[slot]).any()),
@@ -1595,8 +1891,9 @@ class DecodeEngine(object):
             depth = len(self._queue)
         out = {k: self._n.get(k, 0) for k in
                ('submitted', 'completed', 'rejected', 'shed', 'poisoned',
-                'joins', 'releases', 'steps', 'tokens',
-                'slots_high_water', 'delta_pushes', 'delta_rows')}
+                'joins', 'releases', 'steps', 'tokens', 'cancelled',
+                'resumed', 'slots_high_water', 'delta_pushes',
+                'delta_rows')}
         out['queue_depth'] = depth
         out['queue_high_water'] = self._q_high_water
         out['slots'] = self.config.slots

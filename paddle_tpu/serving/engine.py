@@ -387,6 +387,15 @@ class ServingEngine(object):
                 'request is already executing — its batch completes but '
                 'the result is discarded' % timeout)
 
+    def cancel(self, future):
+        """Best-effort cancel of one submitted request by its future
+        (the pod worker reaps a disconnected client's work through
+        this). A still-QUEUED request is cancelled — dropped at dequeue
+        time without consuming a batch slot; one already mid-batch
+        completes and its result is discarded. Returns True if the
+        future was cancelled while queued."""
+        return future.cancel()
+
     # -- warmup ------------------------------------------------------------
 
     def warmup(self, example_feed=None):

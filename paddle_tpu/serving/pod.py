@@ -40,6 +40,7 @@ router.pod_size gauge, and an obs_report `-- pod serving --` section
 (`pod` marker) and measured by `serve_bench --workload pod-sharded`.
 """
 import collections
+import concurrent.futures
 import json
 import os
 import threading
@@ -52,13 +53,19 @@ from .. import obs
 from .engine import (DeadlineExceeded, DeltaUnsupported, ServerClosed,
                      ServerOverloaded, ServingConfig, ServingEngine)
 from .router import Router
+from .transport import Channel, RpcServer, TransportError
 
 __all__ = ['ShardedPredictor', 'save_serving_program', 'sharded_replica',
-           'PodWorker', 'PodRouter', 'RemoteReplica', 'AutoscalePolicy',
-           'Autoscaler']
+           'PodWorker', 'PodRouter', 'RemoteReplica', 'RpcReplica',
+           'AutoscalePolicy', 'Autoscaler']
 
 _C_REROUTED = obs.counter('serving.pod.rerouted_futures')
 _C_HEALS = obs.counter('serving.pod.heals')
+# stream failover accounting: failovers = live streams whose serving
+# host died; resumes = the subset brought back token-exact from a
+# decode-state checkpoint (failovers - resumes = typed HostLost streams)
+_C_STREAM_FAILOVERS = obs.counter('serving.stream.failovers')
+_C_STREAM_RESUMES = obs.counter('serving.stream.resumes')
 
 # wire poll cadence: the spool transport is filesystem mailboxes, read
 # at this period (same order as the engine's _POLL_S)
@@ -299,6 +306,13 @@ def _ctl_dir(pod_dir, host):
     return os.path.join(pod_dir, 'ctl', 'h%d' % int(host))
 
 
+def _streams_dir(pod_dir):
+    # per-stream decode-state checkpoints (ckpt.<sid>.npz): written by
+    # the SERVING worker at the stream's ckpt_every cadence, read by the
+    # router's failover path to resume on a survivor token-exact
+    return os.path.join(pod_dir, 'streams')
+
+
 def _atomic_json(path, obj):
     tmp = '%s.tmp%d' % (path, os.getpid())
     with open(tmp, 'w') as f:
@@ -315,7 +329,10 @@ def _read_json(path):
 
 
 def _atomic_npz(path, **arrays):
-    tmp = '%s.tmp%d.npz' % (path, os.getpid())
+    # the tmp name must NOT keep the .npz suffix: spool/ctl scanners
+    # match on it, and a scanner consuming a half-written tmp file both
+    # corrupts the read AND makes the final os.replace fail
+    tmp = '%s.tmp%d' % (path, os.getpid())
     with open(tmp, 'wb') as f:
         np.savez(f, **arrays)
     os.replace(tmp, path)
@@ -328,13 +345,33 @@ _TYPED_ERRORS = {
     'ServerClosed': ServerClosed,
     'DeadlineExceeded': DeadlineExceeded,
     'DeltaUnsupported': DeltaUnsupported,
+    'TransportError': TransportError,
     'ValueError': ValueError,
+    'TypeError': TypeError,
     'KeyError': KeyError,
 }
 
 
+def _register_typed_errors():
+    """Late-bound typed errors (their modules import lazily elsewhere in
+    this file for the same reason): HostLost from the elastic runtime,
+    StreamCancelled from the decode engine."""
+    if 'HostLost' in _TYPED_ERRORS:
+        return
+    from ..parallel import HostLost
+    from .decode import StreamCancelled
+    _TYPED_ERRORS['HostLost'] = HostLost
+    _TYPED_ERRORS['StreamCancelled'] = StreamCancelled
+
+
 def _encode_error(exc):
     return json.dumps({'type': type(exc).__name__, 'message': str(exc)})
+
+
+def _error_from_dict(d):
+    _register_typed_errors()
+    cls = _TYPED_ERRORS.get(d.get('type'), RuntimeError)
+    return cls(d.get('message', 'remote replica error'))
 
 
 def _decode_error(payload):
@@ -342,8 +379,7 @@ def _decode_error(payload):
         d = json.loads(payload)
     except ValueError:
         return RuntimeError(str(payload))
-    cls = _TYPED_ERRORS.get(d.get('type'), RuntimeError)
-    return cls(d.get('message', 'remote replica error'))
+    return _error_from_dict(d)
 
 
 def _complete(fut, result=None, exc=None):
@@ -395,32 +431,55 @@ class PodWorker(object):
         its heal commands. `sharded_replica` closures are the intended
         shape: the replacement re-shards the checkpoint onto THIS
         host's topology (`load_latest_verified(mesh=...)`).
+    transport: 'file' (atomic-npz spool mailboxes, PR 14's wire) or
+        'rpc' (persistent TCP, serving/transport.py). The rpc wire is
+        ADDITIVE: registry, beats, heal control, and stats publishing
+        stay on the shared filesystem either way — only the request/
+        response/stream hop moves to the socket, so the two wires stay
+        drop-in interchangeable behind one seam (docs/serving.md#pod).
+    rpc_max_inflight: per-connection wire admission cap (rpc only);
+        a connection over it gets typed ServerOverloaded frames
+        before the handler runs.
     """
 
     def __init__(self, pod_dir, host, builders=None, beat_interval=0.25,
-                 stats_interval_s=0.2, poll_s=_POLL_S):
+                 stats_interval_s=0.2, poll_s=_POLL_S, transport='file',
+                 rpc_max_inflight=64):
         from ..parallel import Heartbeat
+        if transport not in ('file', 'rpc'):
+            raise ValueError("transport must be 'file' or 'rpc', not %r"
+                             % (transport,))
         self.pod_dir = str(pod_dir)
         self.host = int(host)
+        self.transport = str(transport)
         self._builders = dict(builders or {})
         self._poll_s = float(poll_s)
         self._stats_every = float(stats_interval_s)
         for d in (_registry_dir(self.pod_dir), _beats_dir(self.pod_dir),
-                  _ctl_dir(self.pod_dir, self.host)):
+                  _ctl_dir(self.pod_dir, self.host),
+                  _streams_dir(self.pod_dir)):
             os.makedirs(d, exist_ok=True)
         self._lock = threading.Lock()
         self._replicas = {}          # key -> dict(engine, thread, stop)
         self._serial = 0
         self._stop = threading.Event()
         self._frozen = False         # simulate_death(): loops stall
+        self._rpc = None
+        if self.transport == 'rpc':
+            self._rpc = RpcServer(self._rpc_handle,
+                                  max_inflight=rpc_max_inflight,
+                                  on_close=self._rpc_conn_closed)
         self.heartbeat = Heartbeat(_beats_dir(self.pod_dir),
                                    process_id=self.host, num_processes=0,
                                    interval=beat_interval)
         self.heartbeat.start()
+        advert = {'host': self.host, 'pid': os.getpid(),
+                  'transport': self.transport,
+                  'builders': sorted(str(m) for m in self._builders)}
+        if self._rpc is not None:
+            advert['addr'] = list(self._rpc.addr)
         _atomic_json(os.path.join(_registry_dir(self.pod_dir),
-                                  'host.%d.json' % self.host),
-                     {'host': self.host, 'pid': os.getpid(),
-                      'builders': sorted(str(m) for m in self._builders)})
+                                  'host.%d.json' % self.host), advert)
         self._ctl_thread = threading.Thread(
             target=self._ctl_loop, name='pod-worker-ctl-h%d' % self.host,
             daemon=True)
@@ -450,7 +509,8 @@ class PodWorker(object):
             mesh = sorted(axes.items()) if axes else None
         stop = threading.Event()
         rec = {'engine': engine, 'stop': stop, 'spool': spool,
-               'model_id': str(model_id)}
+               'model_id': str(model_id),
+               'stats_lock': threading.Lock()}
         t = threading.Thread(target=self._replica_loop, args=(key, rec),
                              name='pod-worker-%s' % key, daemon=True)
         rec['thread'] = t
@@ -459,9 +519,12 @@ class PodWorker(object):
         self._publish_stats(key, rec)       # stats exist before routing
         reg = {'model_id': str(model_id), 'host': self.host, 'key': key,
                'pid': os.getpid(), 'mesh': mesh,
+               'transport': self.transport,
                'feed_names': list(getattr(engine, 'feed_names', []) or []),
                'buckets': [int(b) for b in
                            getattr(engine, 'buckets', ()) or ()]}
+        if self._rpc is not None:
+            reg['addr'] = list(self._rpc.addr)
         if heal_token is not None:
             reg['heal_token'] = str(heal_token)
         t.start()
@@ -505,6 +568,8 @@ class PodWorker(object):
         for key in self.served():
             ok = self.retire(key, drain=drain, timeout=timeout) and ok
         self.heartbeat.stop()
+        if self._rpc is not None:
+            self._rpc.close()
         try:
             os.remove(os.path.join(_registry_dir(self.pod_dir),
                                    'host.%d.json' % self.host))
@@ -516,8 +581,11 @@ class PodWorker(object):
         """Test harness: stop beating and freeze every loop WITHOUT
         cleanup — indistinguishable from a SIGKILLed host to the
         router (beats stale, registration files orphaned, spooled
-        requests never answered)."""
+        requests never answered; rpc sockets stay OPEN but go silent,
+        the wedged-process picture the heartbeat must see through)."""
         self._frozen = True
+        if self._rpc is not None:
+            self._rpc.freeze()
         self.heartbeat.stop()
 
     # -- spool service -----------------------------------------------------
@@ -624,32 +692,177 @@ class PodWorker(object):
             pass
 
     def _publish_stats(self, key, rec):
+        """Fold the engine's window into cumulative counters and write
+        stats.json; returns the payload (the rpc 'stats' op replies
+        with it directly). Serialized per replica: the file loop and
+        rpc reader threads both publish, and the read-and-reset window
+        must fold into `cum` exactly once."""
         engine = rec['engine']
-        cum = rec.setdefault('cum', collections.Counter())
-        try:
-            win = engine.stats_window()
-        except Exception:
-            return
-        live = {}
-        for k in ('queue_depth', 'inflight', 'capacity', 'slots',
-                  'pages_free', 'pages_total'):
-            if k in win:
-                live[k] = win.pop(k)
-        hw = win.pop('queue_high_water', 0)
-        for k, v in win.items():
-            if isinstance(v, (int, float)):
-                cum[k] += v
-        exe = getattr(getattr(engine, '_model', None), '_exe', None)
-        cache = {}
-        if exe is not None:
-            cs = exe.cache_stats
-            cache = {'online_compiles': cs.get('online_compiles'),
-                     'misses': cs.get('misses')}
-        rec['stats_seq'] = rec.get('stats_seq', 0) + 1
-        _atomic_json(os.path.join(rec['spool'], 'stats.json'),
-                     {'seq': rec['stats_seq'], 'cum': dict(cum),
-                      'live': live, 'queue_high_water': hw,
-                      'cache': cache})
+        with rec.setdefault('stats_lock', threading.Lock()):
+            cum = rec.setdefault('cum', collections.Counter())
+            try:
+                win = engine.stats_window()
+            except Exception:
+                return None
+            live = {}
+            for k in ('queue_depth', 'inflight', 'capacity', 'slots',
+                      'pages_free', 'pages_total'):
+                if k in win:
+                    live[k] = win.pop(k)
+            hw = win.pop('queue_high_water', 0)
+            for k, v in win.items():
+                if isinstance(v, (int, float)):
+                    cum[k] += v
+            exe = getattr(getattr(engine, '_model', None), '_exe', None)
+            cache = {}
+            if exe is not None:
+                cs = exe.cache_stats
+                cache = {'online_compiles': cs.get('online_compiles'),
+                         'misses': cs.get('misses')}
+            rec['stats_seq'] = rec.get('stats_seq', 0) + 1
+            payload = {'seq': rec['stats_seq'], 'cum': dict(cum),
+                       'live': live, 'queue_high_water': hw,
+                       'cache': cache}
+            _atomic_json(os.path.join(rec['spool'], 'stats.json'),
+                         payload)
+        return payload
+
+    # -- rpc service (transport='rpc'; serving/transport.py) ---------------
+
+    def _rec(self, key):
+        with self._lock:
+            rec = self._replicas.get(key)
+        if rec is None or self._frozen:
+            raise ServerClosed('no replica %r on host %d'
+                               % (key, self.host))
+        return rec
+
+    def _rpc_handle(self, conn, header, arrays):
+        """Dispatch one frame (runs on the connection's reader thread —
+        a blocking engine.submit() here IS the wire backpressure: this
+        connection stops reading and the client's TCP window fills).
+        Exceptions cross back as typed error frames (transport layer)."""
+        op = header.get('op')
+        if op == 'submit':
+            self._rpc_submit(conn, header, arrays)
+        elif op == 'push':
+            self._rpc_push(conn, header, arrays)
+        elif op == 'stats':
+            payload = self._publish_stats(header.get('key'),
+                                          self._rec(header.get('key')))
+            conn.send({'uid': header.get('uid'), 'final': True,
+                       'stats': payload or {}})
+        elif op == 'retire':
+            ok = self.retire(header.get('key'),
+                             drain=bool(header.get('drain', True)),
+                             timeout=header.get('timeout'))
+            conn.send({'uid': header.get('uid'), 'final': True,
+                       'ok': bool(ok)})
+        elif op == 'cancel':
+            # fire-and-forget: the cancelled submit's own final frame
+            # (typed StreamCancelled) is the acknowledgement
+            entry = (conn.state.get('futs') or {}).get(
+                header.get('cancel_uid'))
+            if entry is not None:
+                fut, engine = entry
+                cancel = getattr(engine, 'cancel', None)
+                if cancel is not None:
+                    cancel(fut)
+                else:
+                    fut.cancel()
+        else:
+            raise ValueError('unknown rpc op %r' % (op,))
+
+    def _rpc_submit(self, conn, header, arrays):
+        uid = header['uid']
+        rec = self._rec(header.get('key'))
+        engine = rec['engine']
+        kwargs = dict(header.get('meta') or {})
+        feed = {n[2:]: arrays[n] for n in arrays if n.startswith('f:')}
+        resume = {n[2:]: np.asarray(arrays[n])
+                  for n in arrays if n.startswith('z:')}
+        if resume:
+            kwargs['resume'] = resume
+        sid = header.get('sid')
+        ckpt_path = None
+        if header.get('stream'):
+            # per-token emitter: enqueue on the connection's writer (the
+            # decode loop never blocks); a dead consumer turns the False
+            # return into a typed abort — the engine frees slot + pages.
+            # The _frozen check keeps simulate_death() faithful to
+            # SIGKILL: a dead host's in-process engine must stop having
+            # observable effects the moment it "dies"
+            def on_token(t, ids, _c=conn, _u=uid):
+                if self._frozen or not _c.send(
+                        {'uid': _u, 'final': False, 'tok': int(t)},
+                        {'ids': np.asarray(ids)}):
+                    raise TransportError(
+                        'stream consumer disconnected')
+            kwargs['on_token'] = on_token
+        ckpt_every = int(header.get('ckpt_every') or 0)
+        if sid and ckpt_every:
+            ckpt_path = os.path.join(_streams_dir(self.pod_dir),
+                                     'ckpt.%s.npz' % sid)
+
+            def checkpoint(state, _p=ckpt_path):
+                if self._frozen:     # a dead host writes nothing
+                    return
+                _atomic_npz(_p, **{k: np.asarray(v)
+                                   for k, v in state.items()})
+            kwargs['checkpoint'] = checkpoint
+            kwargs['ckpt_every'] = ckpt_every
+        fut = engine.submit(feed, **kwargs)
+        conn.state.setdefault('futs', {})[uid] = (fut, engine)
+
+        def done(f, _c=conn, _u=uid, _p=ckpt_path):
+            (_c.state.get('futs') or {}).pop(_u, None)
+            try:
+                e = f.exception()
+            except concurrent.futures.CancelledError as ce:
+                e = ce
+            if e is not None:
+                _c.send({'uid': _u, 'final': True,
+                         'error': {'type': type(e).__name__,
+                                   'message': str(e)}})
+            else:
+                _c.send({'uid': _u, 'final': True},
+                        {'o:%d' % i: np.asarray(o)
+                         for i, o in enumerate(f.result())})
+                if _p is not None:
+                    try:   # finished stream: its checkpoint is garbage
+                        os.remove(_p)
+                    except OSError:
+                        pass
+        fut.add_done_callback(done)
+
+    def _rpc_push(self, conn, header, arrays):
+        rec = self._rec(header.get('key'))
+        deltas = {}
+        for n in arrays:
+            if n.startswith('i:'):
+                name = n[2:]
+                deltas[name] = (np.asarray(arrays[n]),
+                                np.asarray(arrays['r:%s' % name]))
+        rows = rec['engine'].push_rows(deltas)
+        conn.send({'uid': header.get('uid'), 'final': True, 'ok': True,
+                   'rows': int(rows)})
+
+    def _rpc_conn_closed(self, conn):
+        """A client connection died: reap its work. Queued requests are
+        dropped at dequeue; a decoding stream's slot and pages free at
+        the next loop tick (typed StreamCancelled — nobody is listening
+        for the result anyway). A reconnecting client re-sends what it
+        still wants (RpcReplica._on_reconnect)."""
+        futs = conn.state.get('futs') or {}
+        for uid, (fut, engine) in sorted(futs.items()):
+            try:
+                cancel = getattr(engine, 'cancel', None)
+                if cancel is not None:
+                    cancel(fut)
+                else:
+                    fut.cancel()
+            except Exception:  # noqa: BLE001 — reaping is best-effort
+                pass
 
     # -- control: heal commands --------------------------------------------
 
@@ -749,9 +962,16 @@ class RemoteReplica(object):
     # -- engine protocol ---------------------------------------------------
 
     def submit(self, feed, **kwargs):
-        import concurrent.futures
         if self._closed:
             raise ServerClosed('remote replica %s is closed' % self.key)
+        for k, v in kwargs.items():
+            if callable(v):
+                # typed, not a json.dumps crash: the mailbox wire has no
+                # frame to carry a token back on
+                raise ValueError(
+                    'per-token streaming (%s=) needs the rpc transport; '
+                    'the file wire only carries whole responses — start '
+                    "the PodWorker with transport='rpc'" % k)
         arrays = {str(n): np.asarray(a) for n, a in feed.items()}
         with self._lock:
             self._seq += 1
@@ -946,6 +1166,318 @@ class RemoteReplica(object):
                 time.sleep(self._poll_s)
 
 
+class RpcReplica(object):
+    """RemoteReplica's socket twin: the same engine-protocol proxy
+    (submit/predict/stats_window/push_rows/shutdown/take_pending), over
+    ONE persistent `transport.Channel` to the replica's host instead of
+    spool files. What the socket buys (docs/serving.md#pod):
+
+      * no poll interval on the request/response hop — a response is a
+        frame, not a file another poller must notice;
+      * per-token STREAMING: submit kwargs carrying `on_token` mark the
+        request `stream`; the worker emits one non-final frame per
+        generated token, and the callback fires here on the channel's
+        reader thread (end-to-end TTFT);
+      * reconnect-with-replay: the channel re-dials forever on seeded
+        backoff; after each reconnect every still-pending request is
+        re-sent (first outcome wins — a duplicate final frame finds its
+        uid already popped and is dropped; duplicate token frames are
+        absorbed by the consumer's ordering contract);
+      * a GARBLED frame (torn, bad magic) fails every pending future
+        with the typed `TransportError` immediately — a poisoned stream
+        is condemned, never trusted or hung on.
+
+    Host-loss semantics are unchanged: the proxy keeps every pending
+    request's feed AND kwargs, so `take_pending` hands the router the
+    same lossless re-route triples the file proxy does — including the
+    stream bookkeeping (`sid`, `ckpt_every`, `_last_t`) the decode-
+    stream failover path resumes from."""
+
+    def __init__(self, pod_dir, reg, poll_s=_POLL_S):
+        self.pod_dir = str(pod_dir)
+        self.reg = dict(reg)
+        self.key = reg['key']
+        self.host = int(reg['host'])
+        self.model_id = reg.get('model_id')
+        self.feed_names = list(reg.get('feed_names') or [])
+        self.buckets = tuple(reg.get('buckets') or ())
+        self._poll_s = float(poll_s)
+        self._lock = threading.Lock()
+        self._pending = {}           # uid -> (future, feed, kwargs)
+        self._ctl = {}               # uid -> (future, header, arrays)
+        self._seq = 0
+        self._closed = False
+        self._detached = False
+        self._last_cum = collections.Counter()
+        self._last_stats = {}
+        addr = reg.get('addr') or ()
+        if len(addr) != 2:
+            raise ValueError('replica %r advertises no rpc addr'
+                             % (self.key,))
+        self._chan = Channel((str(addr[0]), int(addr[1])),
+                             on_frame=self._on_frame,
+                             on_reconnect=self._on_reconnect,
+                             on_wire_error=self._on_wire_error,
+                             seed=self.host)
+
+    # -- engine protocol ---------------------------------------------------
+
+    def submit(self, feed, **kwargs):
+        if self._closed:
+            raise ServerClosed('remote replica %s is closed' % self.key)
+        arrays = {str(n): np.asarray(a) for n, a in feed.items()}
+        with self._lock:
+            self._seq += 1
+            uid = '%06d-%s' % (self._seq, uuid.uuid4().hex[:8])
+            fut = concurrent.futures.Future()
+            self._pending[uid] = (fut, arrays, dict(kwargs))
+        # best-effort: disconnected now -> the reconnect replay re-sends
+        self._send_submit(uid, arrays, kwargs)
+        return fut
+
+    def _send_submit(self, uid, arrays, kwargs):
+        # callables and resumed decode state never cross as JSON meta:
+        # streaming intent travels as header flags, resume state as
+        # typed array blobs, and the callbacks stay client-side
+        meta = {k: v for k, v in kwargs.items()
+                if k not in ('on_token', 'checkpoint', 'resume', 'sid',
+                             'ckpt_every', '_last_t')}
+        header = {'op': 'submit', 'uid': uid, 'key': self.key,
+                  'meta': meta}
+        wire = {'f:%s' % n: a for n, a in arrays.items()}
+        if kwargs.get('on_token') is not None:
+            header['stream'] = True
+        if kwargs.get('sid'):
+            header['sid'] = str(kwargs['sid'])
+            header['ckpt_every'] = int(kwargs.get('ckpt_every') or 0)
+        resume = kwargs.get('resume')
+        if resume is not None:
+            for n in sorted(resume):
+                wire['z:%s' % n] = np.asarray(resume[n])
+        return self._chan.send(header, wire)
+
+    def predict(self, feed, timeout=None, **kwargs):
+        fut = self.submit(feed, timeout=timeout, **kwargs)
+        return fut.result(timeout)
+
+    def warmup(self, example_feed=None):
+        return list(self.buckets)
+
+    # -- channel callbacks (reader thread) ---------------------------------
+
+    def _on_frame(self, header, arrays):
+        uid = header.get('uid')
+        if not header.get('final'):
+            # one streamed token; ordering/dedup is the consumer's
+            # contract (router.TokenStream), _last_t feeds the failover
+            # path's replayed-work accounting
+            with self._lock:
+                entry = self._pending.get(uid)
+            if entry is None:
+                return
+            kwargs = entry[2]
+            t = int(header.get('tok', 0))
+            kwargs['_last_t'] = max(t, int(kwargs.get('_last_t') or 0))
+            cb = kwargs.get('on_token')
+            if cb is not None:
+                cb(t, arrays.get('ids'))
+            return
+        with self._lock:
+            entry = self._pending.pop(uid, None)
+            ctl = self._ctl.pop(uid, None) if entry is None else None
+        fut = entry[0] if entry is not None else \
+            (ctl[0] if ctl is not None else None)
+        if fut is None:
+            return          # duplicate final frame lost the race: drop
+        if 'error' in header:
+            _complete(fut, exc=_error_from_dict(header['error'] or {}))
+        elif entry is not None:
+            _complete(fut, result=[arrays['o:%d' % i]
+                                   for i in range(len(arrays))])
+        else:
+            _complete(fut, result=header)
+
+    def _on_reconnect(self):
+        """The worker restarted or the network blinked: re-send every
+        request still wanted. The worker cancelled the old incarnations
+        when the connection died, so this never double-decodes; if a
+        final frame DID land just before the cut, first-outcome-wins
+        drops the duplicate."""
+        with self._lock:
+            pend = sorted(self._pending.items())
+            ctl = sorted(self._ctl.items())
+        for uid, (fut, arrays, kwargs) in pend:
+            if not fut.done():
+                self._send_submit(uid, arrays, kwargs)
+        for uid, (fut, header, arrays) in ctl:
+            if not fut.done():
+                self._chan.send(header, arrays)
+
+    def _on_wire_error(self, exc):
+        """A garbled frame condemned the connection: every pending
+        future fails TYPED now. No replay — a corrupted stream gives no
+        honest claim about what the other side received; the caller
+        (or the router's re-route machinery) owns the retry decision."""
+        with self._lock:
+            pend = list(self._pending.values())
+            ctl = list(self._ctl.values())
+            self._pending.clear()
+            self._ctl.clear()
+        err = exc if isinstance(exc, TransportError) \
+            else TransportError(str(exc))
+        for fut, _arrays, _kwargs in pend:
+            _complete(fut, exc=err)
+        for fut, _header, _arrays in ctl:
+            _complete(fut, exc=err)
+
+    # -- control rpcs ------------------------------------------------------
+
+    def _ctl_rpc(self, header, arrays=None):
+        with self._lock:
+            self._seq += 1
+            uid = 'c%05d-%s' % (self._seq, uuid.uuid4().hex[:6])
+            fut = concurrent.futures.Future()
+            header = dict(header, uid=uid)
+            self._ctl[uid] = (fut, header, dict(arrays or {}))
+        self._chan.send(header, arrays or {})
+        return fut
+
+    def stats_window(self):
+        """Same window semantics as the file proxy (cumulative counters
+        diffed against the last read), fed by a stats rpc instead of
+        stats.json. The rpc is fired fresh each call but only waited on
+        briefly — a slow or dead host costs the dispatch path
+        milliseconds, and the reply (when it lands) freshens the NEXT
+        sample; the heartbeat, not this path, decides the host is gone."""
+        with self._lock:
+            # abandon older unanswered stats probes (a dead host must
+            # not accumulate one per sample window until reconnect)
+            for uid in [u for u, (f, h, _a) in self._ctl.items()
+                        if h.get('op') == 'stats' and not f.done()]:
+                self._ctl.pop(uid)
+        fut = self._ctl_rpc({'op': 'stats', 'key': self.key})
+
+        def land(f, _self=self):
+            try:
+                if f.exception() is None:
+                    _self._last_stats = f.result().get('stats') or {}
+            except Exception:  # noqa: BLE001 — cancelled probe
+                pass
+        fut.add_done_callback(land)
+        try:
+            fut.result(max(0.05, 2 * self._poll_s))
+        except Exception:  # noqa: BLE001 — fall back to the last landed
+            pass
+        st = self._last_stats or {}
+        cum = collections.Counter(
+            {k: v for k, v in (st.get('cum') or {}).items()
+             if isinstance(v, (int, float))})
+        win = dict(cum - self._last_cum)
+        self._last_cum = cum
+        live = st.get('live') or {}
+        with self._lock:
+            outstanding = len(self._pending)
+        win['queue_depth'] = max(int(live.get('queue_depth', 0)),
+                                 outstanding)
+        win['inflight'] = int(live.get('inflight', 0))
+        win['queue_high_water'] = max(int(st.get('queue_high_water', 0)),
+                                      outstanding)
+        win['capacity'] = live.get('capacity', 0)
+        for k in ('slots', 'pages_free', 'pages_total'):
+            if k in live:
+                win[k] = live[k]
+        return win
+
+    def cache_stats(self):
+        fut = self._ctl_rpc({'op': 'stats', 'key': self.key})
+        try:
+            st = fut.result(2.0).get('stats') or {}
+            self._last_stats = st
+        except Exception:  # noqa: BLE001 — dead host: last known
+            st = self._last_stats or {}
+        return dict(st.get('cache') or {})
+
+    def push_rows(self, deltas, timeout=30.0):
+        if self._closed:
+            raise ServerClosed('remote replica %s is closed' % self.key)
+        payload = {}
+        for name in sorted(deltas):
+            ids, rows = deltas[name]
+            payload['i:%s' % name] = np.asarray(ids)
+            payload['r:%s' % name] = np.asarray(rows)
+        fut = self._ctl_rpc({'op': 'push', 'key': self.key}, payload)
+        try:
+            reply = fut.result(float(timeout))
+        except concurrent.futures.TimeoutError:
+            raise ServerClosed(
+                'remote replica %s did not acknowledge a %d-table delta '
+                'push within %.1fs (host gone?)'
+                % (self.key, len(deltas), timeout))
+        return int(reply.get('rows', 0))
+
+    def cancel(self, future):
+        """Ask the worker to cancel/abort the submit owning `future`
+        (queued -> dropped; a decoding stream's slot and pages free at
+        the next loop tick). Returns True when a cancel was sent."""
+        with self._lock:
+            uid = next((u for u, e in self._pending.items()
+                        if e[0] is future), None)
+        if uid is None:
+            return False
+        return self._chan.send({'op': 'cancel', 'cancel_uid': uid,
+                                'key': self.key})
+
+    def shutdown(self, drain=True, timeout=None):
+        if self._detached:
+            self._closed = True
+            self._chan.close()
+            return True
+        self._closed = True      # no NEW submits through this proxy
+        ok = True
+        try:
+            fut = self._ctl_rpc({'op': 'retire', 'key': self.key,
+                                 'drain': bool(drain),
+                                 'timeout': timeout})
+            fut.result(30.0 if timeout is None else float(timeout))
+        except Exception:  # noqa: BLE001 — already retired / host gone
+            ok = False
+        deadline = None if timeout is None \
+            else time.monotonic() + float(timeout)
+        while drain:
+            with self._lock:
+                n = len(self._pending)
+            if n == 0:
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                ok = False
+                break
+            time.sleep(self._poll_s)
+        self._chan.close()
+        return ok
+
+    # -- host-loss seam ----------------------------------------------------
+
+    def take_pending(self):
+        """Detach every unanswered request for re-routing — the same
+        lossless triples as the file proxy's. The channel stays up for
+        a bounded grace window (a late final frame from a slow-not-dead
+        host still wins any future the re-route has not beaten), then
+        closes so it stops re-dialing a dead address forever."""
+        self._closed = True
+        self._detached = True
+        with self._lock:
+            pending = list(self._pending.values())
+            # keep the map: a late final frame may still win the race
+        t = threading.Timer(5.0, self._chan.close)
+        t.daemon = True
+        t.start()
+        return pending
+
+    def outstanding(self):
+        with self._lock:
+            return len(self._pending)
+
+
 # ---------------------------------------------------------------------------
 # autoscaling: queue-depth-driven capacity, riding the swap machinery
 # ---------------------------------------------------------------------------
@@ -1102,7 +1634,8 @@ class PodRouter(Router):
         from ..parallel import Heartbeat
         Router.__init__(self, window_s=window_s)
         self.pod_dir = str(pod_dir)
-        for d in (_registry_dir(self.pod_dir), _beats_dir(self.pod_dir)):
+        for d in (_registry_dir(self.pod_dir), _beats_dir(self.pod_dir),
+                  _streams_dir(self.pod_dir)):
             os.makedirs(d, exist_ok=True)
         self.heal = bool(heal)
         self._poll_s = float(poll_s)
@@ -1195,7 +1728,11 @@ class PodRouter(Router):
             seen.add(key)
             if key in self._known:
                 continue
-            proxy = RemoteReplica(self.pod_dir, d, poll_s=self._poll_s)
+            # the ONE transport seam: everything downstream (routing,
+            # quotas, host loss, heal, push) sees the same proxy protocol
+            cls = RpcReplica if (d.get('transport') == 'rpc'
+                                 and d.get('addr')) else RemoteReplica
+            proxy = cls(self.pod_dir, d, poll_s=self._poll_s)
             model_id = d.get('model_id')
             if model_id not in self._models:
                 self.add_model(model_id, [proxy])
@@ -1311,7 +1848,11 @@ class PodRouter(Router):
                  record=None):
         """Send a detached request to a survivor, splicing the result
         into the caller's ORIGINAL future. Unroutable now (no survivor
-        yet) -> parked and retried each poll until t_expire."""
+        yet) -> parked and retried each poll until t_expire. A STREAMED
+        request takes the checkpoint-resume path instead."""
+        if kwargs.get('on_token') is not None or kwargs.get('sid'):
+            return self._reroute_stream(model_id, fut, feed, kwargs,
+                                        t_expire, record)
         try:
             new_fut = self.submit(model_id, feed, **kwargs)
         except Exception:  # noqa: BLE001 — park: a heal may be coming
@@ -1322,6 +1863,77 @@ class PodRouter(Router):
         if record is not None:
             record['rerouted'] += 1
         obs.event('serving.pod.reroute', model=str(model_id))
+        return True
+
+    def _reroute_stream(self, model_id, fut, feed, kwargs, t_expire,
+                        record=None):
+        """Decode-stream failover: resume the stream on a survivor from
+        its last decode-state checkpoint, TOKEN-EXACT. The worker
+        checkpointed the slot's full decode state every `ckpt_every`
+        tokens (streams/ckpt.<sid>.npz); the survivor resumes at
+        checkpoint step + 1 via the engine's `resume=` path (eager
+        row writes — zero new compile signatures). Tokens 1..ckpt are
+        replayed into the client callback first, so a consumer that saw
+        FEWER than ckpt tokens (frames lost with the host) still gets
+        every index; the consumer's ordering contract (TokenStream
+        dedup) absorbs whatever it already saw.
+
+        With checkpointing OFF (ckpt_every=0) the stream fails with
+        the typed HostLost: silently re-decoding everything the
+        consumer already acted on is the one thing a stream must never
+        do quietly, and the cadence knob is the caller's opt-in. A
+        stream lost BEFORE its first checkpoint restarts from scratch
+        — fewer than ckpt_every tokens of replayed work, all absorbed
+        by the dedup."""
+        from ..parallel import HostLost
+        sid = kwargs.get('sid')
+        ckpt_every = int(kwargs.get('ckpt_every') or 0)
+        seen_t = int(kwargs.get('_last_t') or 0)
+        if not sid or not ckpt_every:
+            _C_STREAM_FAILOVERS.inc()
+            obs.event('serving.stream.failover', model=str(model_id),
+                      sid=str(sid), resumed=False, seen_t=seen_t)
+            _complete(fut, exc=HostLost(
+                'decode stream lost with checkpointing disabled '
+                '(ckpt_every=0): %d streamed token(s) cannot be resumed '
+                'token-exact — pass ckpt_every= to stream() to opt into '
+                'failover' % seen_t))
+            return True
+        state = None
+        path = os.path.join(_streams_dir(self.pod_dir),
+                            'ckpt.%s.npz' % sid)
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                state = {k: np.asarray(z[k]) for k in z.files}
+        except Exception:  # noqa: BLE001 — no/torn ckpt: from scratch
+            state = None
+        ckpt_t = int(state['step']) if state is not None else 0
+        cb = kwargs.get('on_token')
+        if state is not None and cb is not None:
+            ids = np.asarray(state['ids'])
+            for s in range(1, ckpt_t + 1):
+                try:
+                    cb(s, ids[s - 1])
+                except Exception:  # noqa: BLE001 — consumer's problem
+                    pass
+        kwargs2 = dict(kwargs)
+        if state is not None:
+            kwargs2['resume'] = state
+        try:
+            new_fut = self.submit(model_id, feed, **kwargs2)
+        except Exception:  # noqa: BLE001 — park: a heal may be coming
+            self._parked.append((model_id, fut, feed, kwargs2, t_expire))
+            return False
+        _chain(new_fut, fut)
+        _C_REROUTED.inc()
+        _C_STREAM_FAILOVERS.inc()
+        _C_STREAM_RESUMES.inc()
+        replayed = max(0, seen_t - ckpt_t)
+        if record is not None:
+            record['rerouted'] += 1
+        obs.event('serving.stream.resume', model=str(model_id),
+                  sid=str(sid), from_t=ckpt_t, seen_t=seen_t,
+                  replayed=replayed)
         return True
 
     def _retry_parked(self):
@@ -1338,6 +1950,23 @@ class PodRouter(Router):
                     % self._reroute_timeout))
                 continue
             self._reroute(model_id, fut, feed, kwargs, t_exp)
+
+    # -- streamed decode ---------------------------------------------------
+
+    def stream(self, model_id, feed, ckpt_every=0, **kwargs):
+        """Per-token streamed decode across the pod (`Router.stream`
+        over the rpc proxies). `ckpt_every` > 0 opts the stream into
+        decode-state checkpointing at that token cadence: if the
+        serving host dies mid-generation, the stream is re-routed to a
+        survivor and resumed TOKEN-EXACT from the last checkpoint
+        (serving.stream.resume); with 0, a host loss fails the stream
+        with the typed HostLost. The checkpoint rides the shared pod
+        filesystem (streams/ckpt.<sid>.npz), so any survivor can pick
+        it up."""
+        if ckpt_every:
+            kwargs['sid'] = uuid.uuid4().hex[:12]
+            kwargs['ckpt_every'] = int(ckpt_every)
+        return Router.stream(self, model_id, feed, **kwargs)
 
     # -- healing -----------------------------------------------------------
 

@@ -34,11 +34,13 @@ import concurrent.futures
 import threading
 import time
 
+import numpy as np
+
 from .. import obs
 from .engine import (DeadlineExceeded, ServerClosed, ServerOverloaded,
                      ServingEngine)
 
-__all__ = ['Router', 'ModelOverloaded', 'UnknownModel']
+__all__ = ['Router', 'ModelOverloaded', 'TokenStream', 'UnknownModel']
 
 
 class UnknownModel(KeyError):
@@ -59,6 +61,11 @@ _C_ROUTED = obs.counter('router.routed')
 _C_OVERLOADED = obs.counter('router.overloaded')
 _G_REPLICAS = obs.gauge('router.replicas')
 _G_POD_SIZE = obs.gauge('router.pod_size')
+_C_STREAM_TOKENS = obs.counter('serving.stream.tokens')
+# END-TO-END time to first token: stream() call (before admission,
+# before any queueing) to the first token REACHING the client callback
+# — the user-visible TTFT, not the engine-internal one
+_H_STREAM_TTFT = obs.histogram('serving.stream.ttft.seconds')
 
 # process-wide replica-id sequence: ids stay unique across routers so a
 # registry (serving/pod.py) can address any replica it ever handed out
@@ -127,6 +134,126 @@ class _ModelEntry(object):
         self.quota = quota
         self.version = 1
         self.path = None
+
+
+class TokenStream(object):
+    """Client handle for one per-token streamed decode request
+    (`Router.stream`): iterate it for `(t, ids)` pairs — t the
+    1-based generated-token index, ids the [beam_size] token row at
+    that step — in strictly increasing t order, then call `result()`
+    for the final (tokens, scores) exactly as a plain submit() future
+    would return them.
+
+    Ordering is the stream's contract, and it is enforced HERE, at the
+    consumer edge, not assumed of the producers: `_on_token` drops any
+    token with t <= the last t delivered. That one rule absorbs every
+    duplicate source in the system — an rpc resend replayed after a
+    reconnect, and the failover replay (serving/pod.py re-plays tokens
+    1..ckpt from the checkpoint before the survivor resumes at
+    ckpt+1) — so the consumer sees each index exactly once, in order,
+    across any number of host losses.
+
+    The producer (decode loop or rpc reader thread) never blocks on
+    the consumer: tokens buffer here without bound (a decode stream is
+    at most max_new_tokens rows — bounded by construction). Dropping
+    the stream mid-iteration and calling `cancel()` frees the decode
+    slot and its pages at the next loop tick (typed StreamCancelled on
+    the future)."""
+
+    def __init__(self, model_id=None):
+        self.model_id = model_id
+        self._cv = threading.Condition()
+        self._buf = []
+        self._last_t = 0
+        self._future = None
+        self._cancel_cb = None
+        self._t_open = time.monotonic()
+        self._ttft_s = None
+
+    # -- producer edge (decode loop / rpc reader thread) -------------------
+
+    def _on_token(self, t, ids):
+        t = int(t)
+        with self._cv:
+            if t <= self._last_t:
+                return            # failover replay / reconnect resend dup
+            self._last_t = t
+            first = self._ttft_s is None
+            if first:
+                self._ttft_s = time.monotonic() - self._t_open
+            self._buf.append((t, None if ids is None
+                              else np.asarray(ids).copy()))
+            self._cv.notify_all()
+        _C_STREAM_TOKENS.inc()
+        if first:
+            _H_STREAM_TTFT.observe(self._ttft_s)
+            obs.event('serving.stream.first_token',
+                      model=str(self.model_id),
+                      ttft_s=round(self._ttft_s, 6))
+
+    def _attach(self, future):
+        self._future = future
+        future.add_done_callback(self._on_done)
+
+    def _on_done(self, fut):
+        with self._cv:
+            self._cv.notify_all()
+        try:
+            err = fut.exception()
+        except concurrent.futures.CancelledError as e:
+            err = e
+        obs.event('serving.stream.close', model=str(self.model_id),
+                  tokens=self._last_t,
+                  error=type(err).__name__ if err is not None else None)
+
+    # -- consumer edge -----------------------------------------------------
+
+    @property
+    def ttft_s(self):
+        """End-to-end time to first token (None until it arrives)."""
+        return self._ttft_s
+
+    @property
+    def last_t(self):
+        """Highest token index delivered so far."""
+        return self._last_t
+
+    def __iter__(self):
+        """Yield (t, ids) in order until the request completes; a
+        failed request raises its typed error from `result()` AFTER
+        the tokens that did arrive have been yielded."""
+        while True:
+            with self._cv:
+                while not self._buf and not (self._future is not None
+                                             and self._future.done()):
+                    self._cv.wait(0.05)
+                if self._buf:
+                    t, ids = self._buf.pop(0)
+                else:
+                    return
+            yield t, ids
+
+    def result(self, timeout=None):
+        """Final (tokens, scores) — blocks like a submit() future."""
+        return self._future.result(timeout)
+
+    def done(self):
+        return self._future is not None and self._future.done()
+
+    def cancel(self):
+        """Stop the stream: a queued request is dropped, a decoding one
+        is aborted at the next loop tick (slot and pages freed, typed
+        StreamCancelled on the future). Returns True if a cancel was
+        delivered."""
+        if self._future is not None and self._future.done():
+            return False
+        cb = self._cancel_cb
+        if cb is not None:
+            try:
+                return bool(cb())
+            except Exception:
+                pass
+        return self._future.cancel() if self._future is not None else False
 
 
 class Router(object):
@@ -386,6 +513,42 @@ class Router(object):
                 'no result within the %.3fs predict() timeout; the '
                 'request is already executing — it completes but the '
                 'result is discarded' % timeout)
+
+    def stream(self, model_id, feed, **kwargs):
+        """Per-token streamed decode through the least-loaded replica:
+        returns a `TokenStream` yielding (t, ids) as tokens are
+        generated, with `result()` for the final (tokens, scores).
+        Rides the ordinary submit() path — the stream's on_token
+        callback travels in kwargs, so any replica that accepts
+        on_token (in-process DecodeEngine, or an rpc pod proxy) can
+        serve it, and admission/quota/overload-retry semantics are
+        identical to submit(). TTFT is measured end-to-end: stream()
+        call to first token at the client."""
+        s = TokenStream(model_id=model_id)
+        kwargs['on_token'] = s._on_token
+        fut = self.submit(model_id, feed, **kwargs)
+        obs.event('serving.stream.open', model=str(model_id))
+        s._cancel_cb = lambda: self._cancel_request(model_id, s._future)
+        s._attach(fut)
+        return s
+
+    def _cancel_request(self, model_id, fut):
+        """Best-effort cancel of an accepted request: ask each replica
+        engine that knows the future (only its owner returns True)."""
+        if fut is None:
+            return False
+        with self._lock:
+            engines = [r.engine for r in self._entry(model_id).replicas]
+        for e in engines:
+            cancel = getattr(e, 'cancel', None)
+            if cancel is None:
+                continue
+            try:
+                if cancel(fut):
+                    return True
+            except Exception:
+                pass
+        return fut.cancel()
 
     # -- hot swap ----------------------------------------------------------
 

@@ -1,0 +1,513 @@
+"""Persistent-socket RPC wire for the serving pod (docs/serving.md#pod).
+
+PR 14's pod wire was an atomic-file mailbox on a shared filesystem: one
+npz per request, polled at `_POLL_S`. That wire is durable and trivially
+debuggable, but it cannot stream — a response is visible only when its
+file is complete — and every hop pays a poll interval. This module is
+the socket twin: length-prefixed JSON frames over persistent TCP
+connections, carrying numpy arrays as raw little-endian blobs after the
+header. `serving/pod.py` keeps BOTH wires behind one seam
+(`PodWorker(transport='file'|'rpc')`); everything here is transport
+mechanics with no pod semantics.
+
+Frame layout (everything after the magic is length-prefixed, so a
+well-formed stream never requires lookahead)::
+
+    b'pT' | u32 header_len | u32 body_len | header JSON | array blobs
+
+The header is UTF-8 JSON. Arrays travel out-of-band: the encoder moves
+them into a ``__arrays__`` manifest — ``[name, dtype.str, shape]`` per
+array, in blob order — and concatenates their ``tobytes()`` into the
+body. msgpack would shave a few header bytes but is not in the image;
+JSON + raw blobs keeps the dependency surface at zero while the arrays
+(the actual payload mass) stay binary.
+
+Failure posture (the part the fault drills care about):
+
+  * a frame with a bad magic, an oversized length, or an undecodable
+    header raises a typed `TransportError` — the reader NEVER hangs on
+    a garbled stream, and never silently resynchronizes (there is no
+    reliable resync point in a length-prefixed stream, so the
+    connection is condemned and rebuilt);
+  * an EOF at a frame boundary is a clean `EOFError` (peer closed); a
+    reset or an EOF mid-frame is `Disconnected` — the connection died
+    but nothing received was malformed, so the client redials and
+    replays instead of condemning its pending work (a SIGKILLed worker
+    is a host loss, not a garbled stream);
+  * the server writes through a per-connection queue drained by a
+    writer thread, so producers (the decode loop emitting tokens) only
+    ever append to a deque — connection-level backpressure lands on the
+    socket, never inside the engine;
+  * the server admits at the wire: when a connection already has
+    `max_inflight` uncompleted requests, new ones are refused with a
+    typed ServerOverloaded error frame before the handler runs;
+  * the client `Channel` owns reconnection: a broken connection is
+    redialed forever (until close) on `utils.retry.backoff_delays` with
+    seeded jitter, and the owner decides what to replay via the
+    `on_reconnect` hook — the transport does not guess at idempotency.
+"""
+import json
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+
+from .. import obs
+from ..utils.retry import backoff_delays
+
+__all__ = ['TransportError', 'Disconnected', 'Connection', 'RpcServer',
+           'Channel', 'encode_frame']
+
+MAGIC = b'pT'
+_LENS = struct.Struct('>II')
+# A header is routing metadata, never payload: 4 MiB of JSON means the
+# stream is garbage, not a big request. Bodies carry arrays and get the
+# same ceiling the npz wire effectively had (per-frame, not per-stream).
+MAX_HEADER_BYTES = 4 << 20
+MAX_BODY_BYTES = 1 << 31
+
+_C_FRAMES_OUT = obs.counter('serving.transport.frames_out')
+_C_FRAMES_IN = obs.counter('serving.transport.frames_in')
+_C_BYTES_OUT = obs.counter('serving.transport.bytes_out')
+_C_BYTES_IN = obs.counter('serving.transport.bytes_in')
+_C_RECONNECTS = obs.counter('serving.transport.reconnects')
+_C_ERRORS = obs.counter('serving.transport.errors')
+_C_REJECTED = obs.counter('serving.transport.rejected')
+
+
+class TransportError(ConnectionError):
+    """The wire itself failed: garbled frame, torn frame, oversized
+    length, or a send into a dead socket. Distinct from every
+    application error (those cross INSIDE well-formed frames, by name)
+    so callers can tell 'the remote said no' from 'the wire broke'."""
+
+
+class Disconnected(TransportError):
+    """The CONNECTION died (reset, or closed mid-frame) but every byte
+    received so far was well-formed. Distinct from its parent because
+    the two demand opposite reactions: a dead connection is redialed
+    and its pending work replayed (a SIGKILLed worker must look like a
+    host loss, not a poisoned stream), while a garbled stream condemns
+    the pending work typed — corruption gives no honest claim about
+    what the other side received."""
+
+
+def encode_frame(header, arrays=None):
+    """Serialize one frame. `header` is a JSON-able dict (not mutated);
+    `arrays` maps names to ndarrays, shipped as contiguous raw blobs."""
+    header = dict(header)
+    manifest = []
+    blobs = []
+    for name in sorted(arrays or ()):
+        a = np.ascontiguousarray(arrays[name])
+        manifest.append([name, a.dtype.str, list(a.shape)])
+        blobs.append(a.tobytes())
+    header['__arrays__'] = manifest
+    hdr = json.dumps(header, sort_keys=True).encode('utf-8')
+    body = b''.join(blobs)
+    if len(hdr) > MAX_HEADER_BYTES:
+        raise TransportError('frame header of %d bytes exceeds the %d '
+                             'byte cap' % (len(hdr), MAX_HEADER_BYTES))
+    if len(body) > MAX_BODY_BYTES:
+        raise TransportError('frame body of %d bytes exceeds the %d '
+                             'byte cap' % (len(body), MAX_BODY_BYTES))
+    return b''.join((MAGIC, _LENS.pack(len(hdr), len(body)), hdr, body))
+
+
+def _recv_exact(sock, n, at_boundary=False):
+    buf = bytearray()
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except OSError as e:
+            raise Disconnected('recv failed: %s' % (e,))
+        if not chunk:
+            if at_boundary and not buf:
+                raise EOFError('peer closed the connection')
+            raise Disconnected(
+                'connection closed mid-frame (%d of %d bytes)'
+                % (len(buf), n))
+        buf += chunk
+    return bytes(buf)
+
+
+class Connection(object):
+    """One framed socket: locked sends (frames from concurrent senders
+    interleave whole, never byte-wise) and single-reader recvs."""
+
+    def __init__(self, sock):
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass  # not a TCP socket (tests may hand in a socketpair)
+        self._sock = sock
+        self._wlock = threading.Lock()
+        self.peer = None
+        try:
+            self.peer = sock.getpeername()
+        except OSError:
+            pass
+
+    def send(self, header, arrays=None):
+        frame = encode_frame(header, arrays)
+        with self._wlock:
+            self._sock.sendall(frame)
+        _C_FRAMES_OUT.inc()
+        _C_BYTES_OUT.inc(len(frame))
+
+    def recv(self):
+        """Read one frame; returns (header, arrays). Raises EOFError on
+        a clean close at a frame boundary, Disconnected on a reset or
+        mid-frame close, TransportError on anything garbled or
+        oversized — never hangs on a bad stream."""
+        head = _recv_exact(self._sock, len(MAGIC) + _LENS.size,
+                           at_boundary=True)
+        if head[:len(MAGIC)] != MAGIC:
+            raise TransportError(
+                'bad frame magic %r — garbled stream' % (head[:len(MAGIC)],))
+        hlen, blen = _LENS.unpack(head[len(MAGIC):])
+        if hlen > MAX_HEADER_BYTES or blen > MAX_BODY_BYTES:
+            raise TransportError(
+                'frame lengths (%d, %d) exceed caps — garbled stream'
+                % (hlen, blen))
+        try:
+            header = json.loads(
+                _recv_exact(self._sock, hlen).decode('utf-8'))
+        except (ValueError, UnicodeDecodeError) as e:
+            raise TransportError('undecodable frame header: %s' % (e,))
+        if not isinstance(header, dict):
+            raise TransportError('frame header is not an object: %r'
+                                 % (header,))
+        body = _recv_exact(self._sock, blen) if blen else b''
+        arrays = {}
+        off = 0
+        for item in header.pop('__arrays__', []):
+            try:
+                name, dstr, shape = item
+                dt = np.dtype(dstr)
+                count = int(np.prod(shape, dtype=np.int64)) if shape else 1
+                nbytes = count * dt.itemsize
+            except (TypeError, ValueError) as e:
+                raise TransportError('bad array manifest entry %r: %s'
+                                     % (item, e))
+            if off + nbytes > len(body):
+                raise TransportError(
+                    'frame body shorter than its array manifest')
+            arrays[name] = np.frombuffer(
+                body, dt, count=count, offset=off).reshape(shape)
+            off += nbytes
+        _C_FRAMES_IN.inc()
+        _C_BYTES_IN.inc(len(head) + hlen + blen)
+        return header, arrays
+
+    def close(self):
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class _ServerConn(object):
+    """One accepted connection: a reader thread dispatching frames to
+    the server's handler, and a writer thread draining a send queue so
+    handler/engine callbacks enqueue without ever blocking on the
+    socket (that IS the backpressure seam: a slow client backs up this
+    queue and eventually its own TCP window, never the decode loop)."""
+
+    def __init__(self, server, sock):
+        self._server = server
+        self.conn = Connection(sock)
+        self.state = {}            # owner scratch (PodWorker's uid maps)
+        self.inflight = set()      # admitted uids awaiting a final frame
+        self._q = []
+        self._cv = threading.Condition()
+        self._alive = True
+        self._reader = threading.Thread(target=self._read_loop,
+                                        name='rpc-conn-reader', daemon=True)
+        self._writer = threading.Thread(target=self._write_loop,
+                                        name='rpc-conn-writer', daemon=True)
+        self._reader.start()
+        self._writer.start()
+
+    @property
+    def alive(self):
+        return self._alive
+
+    def send(self, header, arrays=None):
+        """Queue one frame for the writer; returns False when the
+        connection is already gone (the caller's signal to abort a
+        stream whose consumer vanished)."""
+        with self._cv:
+            if header.get('final'):
+                self.inflight.discard(header.get('uid'))
+            if not self._alive:
+                return False
+            self._q.append((header, arrays))
+            self._cv.notify()
+        return True
+
+    def _write_loop(self):
+        while True:
+            with self._cv:
+                while self._alive and (not self._q or self._server.frozen):
+                    self._cv.wait(0.05)
+                if not self._alive:
+                    return
+                header, arrays = self._q.pop(0)
+            try:
+                self.conn.send(header, arrays)
+            except (TransportError, OSError):
+                self._die()
+                return
+
+    def _read_loop(self):
+        try:
+            while self._alive:
+                if self._server.frozen:
+                    time.sleep(0.02)
+                    continue
+                try:
+                    header, arrays = self.conn.recv()
+                except (EOFError, TransportError, OSError):
+                    return
+                if self._server.frozen:
+                    continue   # a frozen (simulated-dead) host swallows it
+                uid = header.get('uid')
+                if uid is not None \
+                        and header.get('op') in self._server.admitted_ops:
+                    with self._cv:
+                        full = len(self.inflight) >= self._server.max_inflight
+                        if not full:
+                            self.inflight.add(uid)
+                    if full:
+                        _C_REJECTED.inc()
+                        obs.event('serving.transport.reject', uid=uid,
+                                  inflight=self._server.max_inflight)
+                        self.send({'uid': uid, 'final': True, 'error': {
+                            'type': 'ServerOverloaded',
+                            'message': 'connection already has %d '
+                                       'request(s) in flight — admission '
+                                       'refused at the wire'
+                                       % self._server.max_inflight}})
+                        continue
+                try:
+                    self._server.handler(self, header, arrays)
+                except Exception as e:  # noqa: BLE001 — reader must live
+                    if uid is not None:
+                        self.send({'uid': uid, 'final': True, 'error': {
+                            'type': type(e).__name__, 'message': str(e)}})
+        finally:
+            self._die()
+
+    def _die(self):
+        with self._cv:
+            if not self._alive:
+                return
+            self._alive = False
+            del self._q[:]
+            self._cv.notify_all()
+        self.conn.close()
+        self._server._conn_closed(self)
+
+    def close(self):
+        self._die()
+
+
+class RpcServer(object):
+    """Accept loop + per-connection reader/writer pairs. `handler` is
+    called as handler(conn, header, arrays) on the connection's reader
+    thread; it replies (possibly later, from any thread) via
+    `conn.send`. `freeze()` simulates a dead host for the fault drills:
+    frames are neither read nor written, but every socket stays open —
+    exactly what a wedged process looks like from the outside, so the
+    heartbeat watcher (not the transport) must be the detector."""
+
+    def __init__(self, handler, host='127.0.0.1', port=0, max_inflight=64,
+                 on_close=None, admitted_ops=('submit',)):
+        self.handler = handler
+        self.max_inflight = int(max_inflight)
+        self.admitted_ops = frozenset(admitted_ops)
+        self.frozen = False
+        self._on_close = on_close
+        self._closed = False
+        self._lock = threading.Lock()
+        self._conns = set()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(128)
+        self.addr = self._sock.getsockname()
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        name='rpc-accept', daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self):
+        while not self._closed:
+            try:
+                sock, peer = self._sock.accept()
+            except OSError:
+                return
+            obs.event('serving.transport.accept', port=self.addr[1])
+            sc = _ServerConn(self, sock)
+            with self._lock:
+                raced_shutdown = self._closed
+                if not raced_shutdown:
+                    self._conns.add(sc)
+            if raced_shutdown:
+                # outside the lock: close() -> _die() -> _conn_closed()
+                # re-enters it, and the lock is not reentrant
+                sc.close()
+
+    def _conn_closed(self, sc):
+        with self._lock:
+            self._conns.discard(sc)
+        if self._on_close is not None and not self._closed:
+            try:
+                self._on_close(sc)
+            except Exception:  # noqa: BLE001 — owner bug, not wire state
+                pass
+
+    def freeze(self):
+        self.frozen = True
+
+    def close(self):
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            conns = list(self._conns)
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        for sc in conns:
+            sc.close()
+
+
+class Channel(object):
+    """Client side of the wire: ONE persistent connection to `addr`,
+    rebuilt forever (until `close`) on `backoff_delays` with seeded
+    jitter. Incoming frames land on `on_frame(header, arrays)` from the
+    channel thread. The channel never decides what a reconnect means:
+    `on_reconnect()` fires after every re-dial so the owner replays
+    what it knows is idempotent, and `on_wire_error(exc)` fires when a
+    frame was GARBLED (torn/bad-magic/undecodable) — the owner fails
+    its pending work typed rather than trusting a poisoned stream."""
+
+    def __init__(self, addr, on_frame, on_reconnect=None,
+                 on_wire_error=None, seed=None, dial_timeout=2.0):
+        self.addr = (str(addr[0]), int(addr[1]))
+        self._on_frame = on_frame
+        self._on_reconnect = on_reconnect
+        self._on_wire_error = on_wire_error
+        self._seed = seed
+        self._dial_timeout = float(dial_timeout)
+        self._conn = None
+        self._closed = False
+        self._ever_connected = False
+        self.dial_attempts = 0
+        self.reconnects = 0
+        self._thread = threading.Thread(target=self._run,
+                                        name='rpc-channel', daemon=True)
+        self._thread.start()
+
+    @property
+    def connected(self):
+        return self._conn is not None
+
+    def send(self, header, arrays=None):
+        """Best-effort send on the CURRENT connection; returns False
+        when disconnected (the frame is NOT queued — the owner's
+        pending map plus `on_reconnect` is the replay path, so the
+        transport never re-sends something the owner already gave up
+        on)."""
+        conn = self._conn
+        if conn is None:
+            return False
+        try:
+            conn.send(header, arrays)
+            return True
+        except (TransportError, OSError):
+            return False
+
+    def _delays(self):
+        # Small, capped, jittered: a worker restart is sub-second; a
+        # genuinely dead host is the heartbeat watcher's problem, and
+        # this loop just needs to not stampede while it decides.
+        return backoff_delays(8, base_delay=0.05, factor=1.6,
+                              max_delay=0.5, jitter=0.5, seed=self._seed)
+
+    def _run(self):
+        delays = None
+        while not self._closed:
+            try:
+                sock = socket.create_connection(
+                    self.addr, timeout=self._dial_timeout)
+                sock.settimeout(None)
+            except OSError:
+                self.dial_attempts += 1
+                if delays is None:
+                    delays = self._delays()
+                d = next(delays, None)
+                if d is None:
+                    delays = self._delays()
+                    d = next(delays)
+                deadline = time.monotonic() + d
+                while not self._closed and time.monotonic() < deadline:
+                    time.sleep(min(0.05, d))
+                continue
+            delays = None
+            conn = Connection(sock)
+            self._conn = conn
+            if self._ever_connected:
+                self.reconnects += 1
+                _C_RECONNECTS.inc()
+                obs.event('serving.transport.reconnect', peer=self.addr[1],
+                          attempts=self.dial_attempts)
+                if self._on_reconnect is not None:
+                    try:
+                        self._on_reconnect()
+                    except Exception:  # noqa: BLE001
+                        pass
+            else:
+                self._ever_connected = True
+                obs.event('serving.transport.connect', peer=self.addr[1])
+            wire_err = None
+            while not self._closed:
+                try:
+                    header, arrays = conn.recv()
+                except (EOFError, Disconnected):
+                    break     # connection death: redial + replay
+                except TransportError as e:
+                    wire_err = e       # garbling: condemn pending work
+                    break
+                except OSError:
+                    break
+                try:
+                    self._on_frame(header, arrays)
+                except Exception:  # noqa: BLE001 — callback must not
+                    pass           # kill the reader
+            self._conn = None
+            conn.close()
+            if wire_err is not None:
+                _C_ERRORS.inc()
+                obs.event('serving.transport.error', peer=self.addr[1],
+                          error=str(wire_err))
+                if self._on_wire_error is not None and not self._closed:
+                    try:
+                        self._on_wire_error(wire_err)
+                    except Exception:  # noqa: BLE001
+                        pass
+
+    def close(self):
+        self._closed = True
+        conn, self._conn = self._conn, None
+        if conn is not None:
+            conn.close()
+        self._thread.join(timeout=2.0)

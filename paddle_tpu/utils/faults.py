@@ -13,10 +13,13 @@ SAME code paths production hits.
 """
 import os
 import signal
+import socket
+import threading
+import time
 
 import numpy as np
 
-__all__ = ['FaultInjector', 'send_preemption']
+__all__ = ['ChaosProxy', 'FaultInjector', 'send_preemption']
 
 
 def send_preemption(sig=signal.SIGTERM, pid=None):
@@ -181,6 +184,16 @@ class FaultInjector(object):
             self.truncate_file(path)
         return what, path
 
+    # -- network faults ----------------------------------------------------
+
+    def chaos_proxy(self, target):
+        """Stand a `ChaosProxy` between a client and `target` ('host',
+        port): traffic forwards transparently until the test calls
+        sever()/delay()/garble(). Byte choices for garbling come from
+        this injector's seeded RNG, so a corrupted-frame drill
+        reproduces bit-for-bit. Point the client at `proxy.addr`."""
+        return ChaosProxy(target, rng=self.rng)
+
     # -- process faults ----------------------------------------------------
 
     def preempt(self, sig=signal.SIGTERM):
@@ -200,3 +213,143 @@ class FaultInjector(object):
                 'self-delivered signals')
         os.kill(pid, sig)
         return pid
+
+
+class ChaosProxy(object):
+    """A TCP forwarding proxy that misbehaves ON COMMAND — the network-
+    fault primitive for the RPC pod-wire drills (serving/transport.py).
+    Listens on an ephemeral local port; each accepted client connection
+    is paired with a fresh connection to the real target and pumped in
+    both directions until a fault is injected:
+
+      sever()       close every live pairing mid-stream (the client
+                    sees a reset/EOF; its Channel must reconnect — a
+                    NEW pairing through the proxy works again);
+      delay(s)      sleep `s` seconds before forwarding each chunk
+                    (latency, not loss — nothing may time out wrongly);
+      garble(n=8)   corrupt `n` seeded bytes of the NEXT forwarded
+                    chunk (a torn/garbled frame: the reader must fail
+                    typed, never hang); direction= picks which half of
+                    the wire rots — 'up' (client->server), 'down'
+                    (server->client), or 'both'.
+
+    Faults are one-shot where that is the honest physics (garble) and
+    latching where it is (delay persists until delay(0)). The proxy is
+    deliberately L4-dumb: it never parses frames, so it cannot
+    accidentally re-align a corrupted stream."""
+
+    def __init__(self, target, rng=None):
+        self.target = (str(target[0]), int(target[1]))
+        self._rng = rng if rng is not None else np.random.RandomState(0)
+        self._delay_s = 0.0
+        self._garble = {'up': 0, 'down': 0}
+        self._lock = threading.Lock()
+        self._closed = False
+        self._pairs = []          # [(client_sock, upstream_sock), ...]
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(('127.0.0.1', 0))
+        self._sock.listen(16)
+        self.addr = self._sock.getsockname()
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        name='chaos-proxy', daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self):
+        while not self._closed:
+            try:
+                client, _ = self._sock.accept()
+            except OSError:
+                return
+            try:
+                upstream = socket.create_connection(self.target,
+                                                    timeout=2.0)
+                upstream.settimeout(None)
+            except OSError:
+                client.close()
+                continue
+            with self._lock:
+                if self._closed:
+                    client.close()
+                    upstream.close()
+                    return
+                self._pairs.append((client, upstream))
+            for src, dst, way in ((client, upstream, 'up'),
+                                  (upstream, client, 'down')):
+                t = threading.Thread(target=self._pump,
+                                     args=(src, dst, way),
+                                     name='chaos-pump', daemon=True)
+                t.start()
+
+    def _pump(self, src, dst, way):
+        while True:
+            try:
+                chunk = src.recv(65536)
+            except OSError:
+                break
+            if not chunk:
+                break
+            d = self._delay_s
+            if d > 0:
+                time.sleep(d)
+            with self._lock:
+                n = self._garble[way]
+                if n and chunk:
+                    buf = bytearray(chunk)
+                    offs = self._rng.randint(0, len(buf),
+                                             size=min(n, len(buf)))
+                    for off in offs:
+                        buf[int(off)] ^= 0xFF
+                    chunk = bytes(buf)
+                    self._garble[way] = 0
+            try:
+                dst.sendall(chunk)
+            except OSError:
+                break
+        for s in (src, dst):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def sever(self):
+        """Cut every live pairing NOW (mid-stream, not at a frame
+        boundary). New connections still pair up — this is a network
+        blip, not a dead host."""
+        with self._lock:
+            pairs, self._pairs = self._pairs, []
+        for client, upstream in pairs:
+            for s in (client, upstream):
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    def delay(self, seconds):
+        """Latch `seconds` of added one-way latency per forwarded
+        chunk; delay(0) restores normal forwarding."""
+        self._delay_s = float(seconds)
+
+    def garble(self, n_bytes=8, direction='both'):
+        """Corrupt `n_bytes` seeded bytes of the next forwarded chunk —
+        the in-flight-frame bit-rot case only the frame codec's typed
+        failure catches. `direction` aims the rot: 'up' hits the next
+        client->server chunk (the server's reader fails typed and drops
+        the connection), 'down' the next server->client chunk (the
+        client Channel surfaces a typed TransportError), 'both' arms
+        each half once."""
+        if direction not in ('up', 'down', 'both'):
+            raise ValueError("direction must be 'up', 'down' or 'both'")
+        with self._lock:
+            if direction in ('up', 'both'):
+                self._garble['up'] = int(n_bytes)
+            if direction in ('down', 'both'):
+                self._garble['down'] = int(n_bytes)
+
+    def close(self):
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self.sever()

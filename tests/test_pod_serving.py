@@ -31,11 +31,13 @@ from paddle_tpu.fluid import framework, unique_name
 from paddle_tpu.fluid.executor import Scope, _switch_scope
 from paddle_tpu.obs import report as obs_report
 from paddle_tpu.parallel import HostLost
-from paddle_tpu.serving import (AutoscalePolicy, Autoscaler, PodRouter,
-                                PodWorker, Router, ServerClosed,
-                                ServingConfig, ServingEngine,
-                                ShardedPredictor)
+from paddle_tpu.serving import (AutoscalePolicy, Autoscaler, DecodeConfig,
+                                DecodeEngine, PodRouter, PodWorker, Router,
+                                ServerClosed, ServingConfig, ServingEngine,
+                                ShardedPredictor, TransportError)
+from paddle_tpu.serving.transport import Channel, RpcServer
 from paddle_tpu.utils import checkpoint as ck
+from paddle_tpu.utils.faults import FaultInjector
 
 pytestmark = pytest.mark.pod
 
@@ -58,6 +60,15 @@ def obs_events(tmp_path):
         yield read
     finally:
         obs._reset()
+
+
+@pytest.fixture(params=['file', 'rpc'])
+def transport(request):
+    """Every pod drill runs on BOTH wires — the shared-filesystem
+    mailbox and the length-prefixed TCP rpc transport — from ONE test
+    body. The only knob is the PodWorker(transport=...) seam; the
+    router discovers the wire from the registration record."""
+    return request.param
 
 
 # ---------------------------------------------------------------------------
@@ -383,9 +394,10 @@ def _fake_engine(delay=0.0, scale=2.0, **cfg):
     return ServingEngine(_fake_model(delay, scale), ServingConfig(**cfg))
 
 
-def test_pod_registry_roundtrip_and_retire(tmp_path, obs_events):
+def test_pod_registry_roundtrip_and_retire(tmp_path, obs_events,
+                                           transport):
     pod = str(tmp_path / 'pod')
-    w = PodWorker(pod, host=0, beat_interval=0.05)
+    w = PodWorker(pod, host=0, beat_interval=0.05, transport=transport)
     r = PodRouter(pod, poll_s=0.05, window_s=0.05,
                   heartbeat_timeout=5.0, start=False)
     try:
@@ -412,9 +424,9 @@ def test_pod_registry_roundtrip_and_retire(tmp_path, obs_events):
         w.shutdown()
 
 
-def test_remote_typed_errors_cross_the_wire(tmp_path):
+def test_remote_typed_errors_cross_the_wire(tmp_path, transport):
     pod = str(tmp_path / 'pod')
-    w = PodWorker(pod, host=0, beat_interval=0.05)
+    w = PodWorker(pod, host=0, beat_interval=0.05, transport=transport)
     r = PodRouter(pod, poll_s=0.05, window_s=0.05,
                   heartbeat_timeout=5.0, start=False)
     try:
@@ -430,7 +442,8 @@ def test_remote_typed_errors_cross_the_wire(tmp_path):
         w.shutdown()
 
 
-def test_pod_host_loss_rerouted_futures_and_heal(tmp_path, obs_events):
+def test_pod_host_loss_rerouted_futures_and_heal(tmp_path, obs_events,
+                                                 transport):
     """The in-process self-healing drill: two hosts serve one model;
     host 1 dies mid-traffic (beats stop, spool freezes — SIGKILL as the
     router sees it); every future pending against it is re-routed to
@@ -444,8 +457,8 @@ def test_pod_host_loss_rerouted_futures_and_heal(tmp_path, obs_events):
         return _fake_engine()
 
     w0 = PodWorker(pod, host=0, builders={'m': builder},
-                   beat_interval=0.05)
-    w1 = PodWorker(pod, host=1, beat_interval=0.05)
+                   beat_interval=0.05, transport=transport)
+    w1 = PodWorker(pod, host=1, beat_interval=0.05, transport=transport)
     r = PodRouter(pod, poll_s=0.05, window_s=0.05,
                   heartbeat_timeout=0.5, start=False)
     x = np.ones((2, 3), np.float32)
@@ -505,15 +518,16 @@ def test_pod_host_loss_rerouted_futures_and_heal(tmp_path, obs_events):
         w1.shutdown()
 
 
-def test_pod_push_deltas_reaches_survivor_set(tmp_path, artifacts):
+def test_pod_push_deltas_reaches_survivor_set(tmp_path, artifacts,
+                                              transport):
     """Sharded replicas + host loss + heal, then Router.push_deltas —
     the DeltaPublisher contract against the RE-REGISTERED set: the push
     lands on every live (healed) replica through the wire."""
     pod = str(tmp_path / 'pod')
     w0 = PodWorker(pod, host=0,
                    builders={'rec': _builder(artifacts, 4)},
-                   beat_interval=0.05)
-    w1 = PodWorker(pod, host=1, beat_interval=0.05)
+                   beat_interval=0.05, transport=transport)
+    w1 = PodWorker(pod, host=1, beat_interval=0.05, transport=transport)
     r = PodRouter(pod, poll_s=0.05, window_s=0.05,
                   heartbeat_timeout=0.5, start=False)
     try:
@@ -542,7 +556,8 @@ def test_pod_push_deltas_reaches_survivor_set(tmp_path, artifacts):
         w1.shutdown()
 
 
-def test_pod_autoscale_up_via_heal_and_down(tmp_path, obs_events):
+def test_pod_autoscale_up_via_heal_and_down(tmp_path, obs_events,
+                                            transport):
     pod = str(tmp_path / 'pod')
     built = []
 
@@ -551,7 +566,7 @@ def test_pod_autoscale_up_via_heal_and_down(tmp_path, obs_events):
         return _fake_engine()
 
     w = PodWorker(pod, host=0, builders={'m': builder},
-                  beat_interval=0.05)
+                  beat_interval=0.05, transport=transport)
     r = PodRouter(pod, poll_s=0.05, window_s=0.0,
                   heartbeat_timeout=5.0, start=False)
     try:
@@ -586,7 +601,8 @@ def test_pod_autoscale_up_via_heal_and_down(tmp_path, obs_events):
 
 
 def test_heal_failure_redispatches_to_capable_host(tmp_path,
-                                                   obs_events):
+                                                   obs_events,
+                                                   transport):
     pod = str(tmp_path / 'pod')
     built = []
 
@@ -598,9 +614,9 @@ def test_heal_failure_redispatches_to_capable_host(tmp_path,
         return _fake_engine()
 
     w1 = PodWorker(pod, host=1, builders={'m': bad_builder},
-                   beat_interval=0.05)
+                   beat_interval=0.05, transport=transport)
     w2 = PodWorker(pod, host=2, builders={'m': good_builder},
-                   beat_interval=0.05)
+                   beat_interval=0.05, transport=transport)
     r = PodRouter(pod, poll_s=0.05, window_s=0.05,
                   heartbeat_timeout=5.0, start=False)
     try:
@@ -627,12 +643,11 @@ def test_heal_failure_redispatches_to_capable_host(tmp_path,
         w2.shutdown()
 
 
-def test_decode_engine_replica_behind_the_pod_wire(tmp_path):
+def test_decode_engine_replica_behind_the_pod_wire(tmp_path, transport):
     """The decode path rides the same registry: a DecodeEngine replica
     registered by a PodWorker serves autoregressive requests through
     the PodRouter — result tuples (ids, scores) and decode kwargs
     (max_new_tokens) cross the wire, matching the in-process engine."""
-    from paddle_tpu.serving import DecodeConfig, DecodeEngine
     rng = np.random.RandomState(7)
     weights = {
         'w_dec': (rng.randn(8 + 6, 32) * 0.3).astype(np.float32),
@@ -655,7 +670,7 @@ def test_decode_engine_replica_behind_the_pod_wire(tmp_path):
     local.shutdown()
 
     pod = str(tmp_path / 'pod')
-    w = PodWorker(pod, host=0, beat_interval=0.05)
+    w = PodWorker(pod, host=0, beat_interval=0.05, transport=transport)
     r = PodRouter(pod, poll_s=0.05, window_s=0.05,
                   heartbeat_timeout=5.0, start=False)
     try:
@@ -671,7 +686,8 @@ def test_decode_engine_replica_behind_the_pod_wire(tmp_path):
 
 
 def test_heal_chain_terminates_when_every_builder_fails(tmp_path,
-                                                        obs_events):
+                                                        obs_events,
+                                                        transport):
     """The exclude set ACCUMULATES through the re-dispatch token chain:
     with every capable host failing its build, the chain ends in a
     typed heal_unroutable instead of ping-ponging forever."""
@@ -680,9 +696,9 @@ def test_heal_chain_terminates_when_every_builder_fails(tmp_path,
 
     pod_dir = str(tmp_path / 'pod')
     w1 = PodWorker(pod_dir, host=1, builders={'m': bad},
-                   beat_interval=0.05)
+                   beat_interval=0.05, transport=transport)
     w2 = PodWorker(pod_dir, host=2, builders={'m': bad},
-                   beat_interval=0.05)
+                   beat_interval=0.05, transport=transport)
     r = PodRouter(pod_dir, poll_s=0.05, window_s=0.05,
                   heartbeat_timeout=5.0, start=False)
     try:
@@ -704,6 +720,441 @@ def test_heal_chain_terminates_when_every_builder_fails(tmp_path,
         r.shutdown(drain=False)
         w1.shutdown()
         w2.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# tentpole: the rpc pod wire — frames, chaos, per-token streams, failover
+# ---------------------------------------------------------------------------
+
+def _mt_weights(vocab=20, dim=8, src=6, hidden=32, seed=7):
+    rng = np.random.RandomState(seed)
+    w = {
+        'w_dec': (rng.randn(dim + src, hidden) * 0.3).astype(np.float32),
+        'u_dec': (rng.randn(dim, hidden) * 0.3).astype(np.float32),
+        'b_dec': (rng.randn(1, hidden) * 0.1).astype(np.float32),
+        'w_q': (rng.randn(dim, src) * 0.3).astype(np.float32),
+        'w_emb': (rng.randn(vocab, dim) * 0.3).astype(np.float32),
+        'w_out': (rng.randn(dim, vocab) * 0.3).astype(np.float32),
+        'b_out': (rng.randn(1, vocab) * 0.1).astype(np.float32),
+    }
+    enc = (rng.randn(4, src) * 0.5).astype(np.float32)
+    return w, enc
+
+
+def _wait(pred, timeout=10.0, step=0.02):
+    deadline = time.monotonic() + timeout
+    while not pred() and time.monotonic() < deadline:
+        time.sleep(step)
+    return pred()
+
+
+class _PollPump(object):
+    """Drive PodRouter.poll() from a background thread while a test
+    body blocks on a stream — failover detection must not depend on
+    the consumer's goodwill."""
+
+    def __init__(self, router, period=0.05):
+        self._r, self._period = router, period
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while not self._stop.is_set():
+            self._r.poll()
+            time.sleep(self._period)
+
+    def __enter__(self):
+        self._t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._t.join(5)
+
+
+def test_transport_frame_roundtrip_and_counters():
+    """The length-prefixed frame codec end to end: JSON header plus raw
+    ndarray blobs cross a real socket BIT-EXACT (no base64, no pickle),
+    and the wire telemetry counts frames/bytes both ways."""
+    f_out0 = obs.counter('serving.transport.frames_out').value
+    f_in0 = obs.counter('serving.transport.frames_in').value
+    got = []
+    ev = threading.Event()
+
+    def handler(conn, header, arrays):
+        conn.send({'uid': header['uid'], 'final': True,
+                   'echo': header['meta']},
+                  {k: v for k, v in arrays.items()})
+
+    srv = RpcServer(handler)
+    arrays = {
+        'f:a': np.arange(12, dtype=np.float32).reshape(3, 4),
+        'f:b': np.array([[1, -2], [3, -4]], np.int64),
+        'f:c': np.array([True, False]),
+    }
+
+    def on_frame(header, arrs):
+        got.append((header, arrs))
+        ev.set()
+
+    ch = Channel(srv.addr, on_frame, seed=1)
+    try:
+        meta = {'max_new_tokens': 6, 'nested': {'x': [1, 2.5, None]}}
+        assert _wait(lambda: ch.send(
+            {'op': 'submit', 'uid': 'u1', 'meta': meta}, arrays), 5)
+        assert ev.wait(10), 'no echo frame'
+        header, arrs = got[0]
+        assert header['echo'] == meta          # JSON survives verbatim
+        for name, want in arrays.items():
+            assert arrs[name].dtype == want.dtype
+            np.testing.assert_array_equal(arrs[name], want)
+        assert obs.counter('serving.transport.frames_out').value > f_out0
+        assert obs.counter('serving.transport.frames_in').value > f_in0
+    finally:
+        ch.close()
+        srv.close()
+
+
+def test_transport_overload_rejects_typed():
+    """Wire-level admission: a server at max_inflight answers a typed
+    ServerOverloaded error frame instead of queueing unboundedly — the
+    engine admission contract, enforced one layer down."""
+    release = threading.Event()
+
+    def handler(conn, header, arrays):
+        # reply later, off the reader thread (the engine posture)
+        def finish():
+            release.wait(20)
+            conn.send({'uid': header['uid'], 'final': True})
+        threading.Thread(target=finish, daemon=True).start()
+
+    srv = RpcServer(handler, max_inflight=1)
+    frames = []
+    ev = threading.Event()
+
+    def on_frame(header, arrs):
+        frames.append(header)
+        ev.set()
+
+    ch = Channel(srv.addr, on_frame, seed=2)
+    try:
+        assert _wait(lambda: ch.send({'op': 'submit', 'uid': 'u1'}), 5)
+        # second submit while the first is parked at the handler
+        assert _wait(lambda: ch.send({'op': 'submit', 'uid': 'u2'}), 5)
+        assert ev.wait(10)
+        rejected = [h for h in frames if h.get('error')]
+        assert rejected, frames
+        assert rejected[0]['error']['type'] == 'ServerOverloaded'
+        release.set()
+        assert _wait(lambda: any(not h.get('error') for h in frames), 10)
+    finally:
+        release.set()
+        ch.close()
+        srv.close()
+
+
+def test_chaos_garble_fails_typed_never_hangs():
+    """A corrupted in-flight frame must surface as a typed
+    TransportError at the reader — bad magic/bounds, not a hang and
+    not a silently misparsed frame."""
+    def handler(conn, header, arrays):
+        conn.send({'uid': header['uid'], 'final': True},
+                  {'a': arrays['f:a']})
+
+    srv = RpcServer(handler)
+    fi = FaultInjector(seed=3)
+    proxy = fi.chaos_proxy(srv.addr)
+    frames, errs = [], []
+    ev = threading.Event()
+    ch = Channel(proxy.addr, lambda h, a: (frames.append(h), ev.set()),
+                 on_wire_error=errs.append, seed=11)
+    a = np.ones((2, 3), np.float32)
+    try:
+        assert _wait(lambda: ch.send(
+            {'op': 'submit', 'uid': 'u1'}, {'f:a': a}), 5)
+        assert ev.wait(10)
+        # corrupt the next server->client chunk: the reply frame
+        proxy.garble(8, direction='down')
+        ch.send({'op': 'submit', 'uid': 'u2'}, {'f:a': a})
+        assert _wait(lambda: errs, 10), 'garble never surfaced'
+        assert isinstance(errs[0], TransportError)
+        assert obs.counter('serving.transport.errors').value >= 1
+    finally:
+        ch.close()
+        proxy.close()
+        srv.close()
+
+
+def test_chaos_sever_reconnects_with_backoff():
+    """A mid-stream connection cut is a network blip, not a dead host:
+    the Channel redials on the shared utils/retry backoff schedule and
+    traffic flows again through a NEW pairing."""
+    def handler(conn, header, arrays):
+        conn.send({'uid': header['uid'], 'final': True,
+                   'echo': header.get('x')})
+
+    srv = RpcServer(handler)
+    fi = FaultInjector(seed=5)
+    proxy = fi.chaos_proxy(srv.addr)
+    frames, reconnects = [], []
+    ev = threading.Event()
+    ch = Channel(proxy.addr, lambda h, a: (frames.append(h), ev.set()),
+                 on_reconnect=lambda: reconnects.append(1), seed=13)
+    try:
+        assert _wait(lambda: ch.send(
+            {'op': 'submit', 'uid': 'u1', 'x': 1}), 5)
+        assert ev.wait(10)
+        proxy.sever()
+        ev.clear()
+        del frames[:]
+
+        def resend():
+            ch.send({'op': 'submit', 'uid': 'u2', 'x': 2})
+            return ev.is_set() and frames
+
+        assert _wait(resend, 15, step=0.1), 'no echo after sever'
+        assert frames[0]['echo'] == 2
+        assert reconnects, 'reconnect hook never fired'
+    finally:
+        ch.close()
+        proxy.close()
+        srv.close()
+
+
+def test_stream_inprocess_matches_submit(obs_events):
+    """Router.stream over a local DecodeEngine: per-token callbacks
+    arrive ordered 1..N, the final result is BIT-EQUAL to a plain
+    submit of the same request, and TTFT is stamped end to end."""
+    weights, enc = _mt_weights()
+
+    def build():
+        return DecodeEngine(weights, DecodeConfig(
+            slots=2, beam_size=3, max_len=8, src_cap=5))
+
+    ref_eng = build()
+    want_ids, want_scores = ref_eng.submit(
+        {'enc': enc}, max_new_tokens=6).result(60)
+    ref_eng.shutdown()
+
+    r = Router(window_s=0.0)
+    r.add_model('mt', [build()])
+    try:
+        s = r.stream('mt', {'enc': enc}, max_new_tokens=6)
+        toks = [(t, ids.copy()) for t, ids in s]
+        assert [t for t, _ in toks] == list(range(1, 7))
+        got_ids, got_scores = s.result(10)
+        np.testing.assert_array_equal(np.asarray(got_ids), want_ids)
+        np.testing.assert_allclose(np.asarray(got_scores), want_scores,
+                                   rtol=1e-5, atol=1e-6)
+        assert s.ttft_s is not None and s.ttft_s > 0
+        assert obs_events('serving.stream.open')
+        first = obs_events('serving.stream.first_token')
+        assert first and first[-1]['fields']['ttft_s'] > 0
+        # done-callbacks race the result() waiter: wait for the close
+        assert _wait(lambda: obs_events('serving.stream.close'), 5)
+        closes = obs_events('serving.stream.close')
+        assert closes[-1]['fields']['tokens'] == 6
+    finally:
+        r.shutdown(drain=False)
+
+
+def test_stream_backpressure_never_drops_or_reorders(tmp_path):
+    """A slow consumer on the rpc wire: the producer decodes far ahead
+    of the reader, yet every token arrives exactly once, in order —
+    the wire may buffer or stall, it may never drop or reorder."""
+    weights, enc = _mt_weights()
+
+    def build():
+        return DecodeEngine(weights, DecodeConfig(
+            slots=2, beam_size=1, max_len=16, src_cap=5))
+
+    pod = str(tmp_path / 'pod')
+    w = PodWorker(pod, host=0, beat_interval=0.05, transport='rpc')
+    r = PodRouter(pod, poll_s=0.05, window_s=0.05,
+                  heartbeat_timeout=5.0, start=False)
+    try:
+        w.serve('mt', build())
+        r.wait_for_replicas('mt', 1, timeout=30)
+        s = r.stream('mt', {'enc': enc}, max_new_tokens=12)
+        ts = []
+        for t, ids in s:
+            ts.append(t)
+            time.sleep(0.03)          # consumer far slower than decode
+        assert ts == list(range(1, 13)), ts
+        ids, scores = s.result(10)
+        assert np.asarray(ids).shape[1] == 12
+    finally:
+        r.shutdown(drain=False)
+        w.shutdown()
+
+
+def test_stream_on_file_wire_is_typed_error(tmp_path):
+    """The file mailbox cannot carry per-token frames: asking it to
+    stream fails TYPED at submit time, naming the rpc transport —
+    never a silent fallback to a whole-response future."""
+    weights, enc = _mt_weights()
+    pod = str(tmp_path / 'pod')
+    w = PodWorker(pod, host=0, beat_interval=0.05, transport='file')
+    r = PodRouter(pod, poll_s=0.05, window_s=0.05,
+                  heartbeat_timeout=5.0, start=False)
+    try:
+        w.serve('mt', DecodeEngine(weights, DecodeConfig(
+            slots=2, beam_size=1, max_len=8, src_cap=5)))
+        r.wait_for_replicas('mt', 1, timeout=30)
+        with pytest.raises(ValueError, match="transport='rpc'"):
+            s = r.stream('mt', {'enc': enc}, max_new_tokens=4)
+            s.result(20)
+    finally:
+        r.shutdown(drain=False)
+        w.shutdown()
+
+
+def test_stream_cancel_frees_slot_and_pages():
+    """Mid-stream disconnect posture: cancelling a live stream aborts
+    the slot and returns its PAGES to the pool — an abandoned stream
+    must not leak decode capacity."""
+    weights, enc = _mt_weights()
+    eng = DecodeEngine(weights, DecodeConfig(
+        slots=2, beam_size=1, max_len=64, src_cap=5,
+        page_size=4, pages=40, prefix_cache=False))
+    r = Router(window_s=0.0)
+    r.add_model('mt', [eng])
+    try:
+        base = eng.stats
+        seen = []
+        s = r.stream('mt', {'enc': enc}, max_new_tokens=60)
+        for t, ids in s:
+            seen.append(t)
+            if t >= 3:
+                break
+        s.cancel()
+        with pytest.raises(Exception) as ei:
+            s.result(20)
+        assert type(ei.value).__name__ in ('StreamCancelled',
+                                           'CancelledError')
+        assert _wait(lambda: eng.stats['slots_occupied'] == 0, 10)
+        assert _wait(lambda: eng.stats['pages_free']
+                     == base['pages_free'], 10), eng.stats
+        assert eng.stats['cancelled'] >= 1
+        # capacity really is back: a fresh request decodes to the end
+        ids, scores = r.predict('mt', {'enc': enc}, timeout=60,
+                                max_new_tokens=4)
+        assert np.asarray(ids).shape[1] == 4
+    finally:
+        r.shutdown(drain=False)
+
+
+def test_stream_cadence_zero_host_loss_is_typed(tmp_path, obs_events):
+    """ckpt_every=0 means the stream opted OUT of failover: losing the
+    host mid-generation surfaces a typed HostLost naming the cadence
+    knob — never a resume from state that was never checkpointed and
+    never a hang."""
+    weights, enc = _mt_weights()
+
+    def build():
+        return DecodeEngine(weights, DecodeConfig(
+            slots=2, beam_size=1, max_len=40, src_cap=5))
+
+    pod = str(tmp_path / 'pod')
+    w = PodWorker(pod, host=0, beat_interval=0.05, transport='rpc')
+    r = PodRouter(pod, poll_s=0.05, window_s=0.05,
+                  heartbeat_timeout=0.5, start=False)
+    try:
+        w.serve('mt', build())
+        r.wait_for_replicas('mt', 1, timeout=30)
+        r.predict('mt', {'enc': enc}, timeout=120, max_new_tokens=2)
+        with _PollPump(r):
+            s = r.stream('mt', {'enc': enc}, max_new_tokens=32)
+            for t, ids in s:
+                if t == 3:
+                    w.simulate_death()
+                    break
+            with pytest.raises(HostLost, match='ckpt_every'):
+                s.result(60)
+        ev = obs_events('serving.stream.failover')
+        assert ev and ev[-1]['fields']['resumed'] is False
+    finally:
+        r.shutdown(drain=False)
+        w.shutdown()
+
+
+def test_decode_stream_failover_token_exact(tmp_path, obs_events):
+    """THE HEADLINE DRILL: a decode stream survives the death of the
+    host generating it. Host 0 dies (SIGKILL posture: rpc frames
+    freeze, beats stop, the checkpoint goes stale) mid-generation;
+    the router re-routes the stream to the survivor, which resumes
+    from the per-slot checkpoint. The client sees one ordered token
+    sequence 1..N and a final result BIT-EQUAL to an uninterrupted
+    reference — zero dropped futures, no restart from token 0."""
+    weights, enc = _mt_weights()
+    N = 32
+
+    def build():
+        return DecodeEngine(weights, DecodeConfig(
+            slots=2, beam_size=1, max_len=40, src_cap=5))
+
+    ref_eng = build()
+    want_ids, want_scores = ref_eng.submit(
+        {'enc': enc}, max_new_tokens=N).result(120)
+    ref_eng.shutdown()
+
+    pod = str(tmp_path / 'pod')
+    w0 = PodWorker(pod, host=0, beat_interval=0.05, transport='rpc')
+    w1 = PodWorker(pod, host=1, beat_interval=0.05, transport='rpc')
+    r = PodRouter(pod, poll_s=0.05, window_s=0.05,
+                  heartbeat_timeout=0.5, start=False)
+    workers = {0: w0, 1: w1}
+    resumes0 = obs.counter('serving.stream.resumes').value
+    try:
+        e0 = build()
+        e1 = build()
+        engines = {0: e0, 1: e1}
+        # warm BOTH engines so post-kill compiles are attributable to
+        # the resume path alone (the zero-new-signatures contract)
+        for e in (e0, e1):
+            e.submit({'enc': enc}, max_new_tokens=2).result(120)
+        misses_before = {h: e.cache_stats()['misses']
+                         for h, e in engines.items()}
+        w0.serve('mt', e0)
+        w1.serve('mt', e1)
+        r.wait_for_replicas('mt', 2, timeout=60)
+
+        toks, killed = [], []
+        with _PollPump(r):
+            s = r.stream('mt', {'enc': enc}, ckpt_every=2,
+                         max_new_tokens=N)
+            for t, ids in s:
+                toks.append((t, np.asarray(ids).copy()))
+                if t == 3 and not killed:
+                    for info in list(r._known.values()):
+                        if info['proxy'].outstanding():
+                            workers[info['host']].simulate_death()
+                            killed.append(info['host'])
+            got_ids, got_scores = s.result(120)
+        assert len(killed) == 1                      # one host died
+        survivor = engines[1 - killed[0]]
+        # one ordered stream, no gap, no duplicate, no restart at 0
+        assert [t for t, _ in toks] == list(range(1, N + 1))
+        # token-exact: final beams bit-equal to the uninterrupted run
+        np.testing.assert_array_equal(np.asarray(got_ids), want_ids)
+        np.testing.assert_allclose(np.asarray(got_scores), want_scores,
+                                   rtol=1e-5, atol=1e-6)
+        # the resume rode the checkpoint (typed event + counters), and
+        # the survivor resumed WITHOUT compiling a new signature
+        assert obs.counter('serving.stream.resumes').value == resumes0 + 1
+        ev = obs_events('serving.stream.resume')
+        assert ev, 'no stream.resume event'
+        f = ev[-1]['fields']
+        assert f['from_t'] >= 1 and f['replayed'] >= 0
+        assert survivor.stats['resumed'] >= 1
+        assert survivor.cache_stats()['misses'] \
+            == misses_before[1 - killed[0]]
+        ev = obs_events('router.host_lost')
+        assert ev and ev[-1]['fields']['host'] == killed[0]
+    finally:
+        r.shutdown(drain=False)
+        w0.shutdown()
+        w1.shutdown()
 
 
 def test_set_mesh_data_axis_false_survives_round_trip():
@@ -747,6 +1198,32 @@ def test_pod_report_section(obs_events):
     assert 'autoscale: 1 up, 0 down' in text
 
 
+def test_transport_streams_report_section(obs_events):
+    obs.event('serving.transport.connect', addr=['127.0.0.1', 1])
+    obs.event('serving.transport.reconnect', addr=['127.0.0.1', 1],
+              attempts=3)
+    obs.event('serving.transport.error', error='bad frame magic')
+    obs.event('serving.stream.open', model='mt')
+    obs.event('serving.stream.open', model='mt')
+    obs.event('serving.stream.first_token', model='mt', ttft_s=0.2)
+    obs.event('serving.stream.first_token', model='mt', ttft_s=0.4)
+    obs.event('serving.stream.resume', model='mt', sid='s1', from_t=4,
+              seen_t=5, replayed=1)
+    obs.event('serving.stream.failover', model='mt', sid='None',
+              resumed=False, seen_t=3)
+    obs.event('serving.stream.close', model='mt', tokens=8, error=None)
+    obs.event('serving.stream.close', model='mt', tokens=3,
+              error='HostLost')
+    text = obs_report.summarize(obs_events())
+    assert '-- transport / streams --' in text
+    assert '1 connect(s), 1 reconnect(s), 1 wire error(s)' in text
+    assert 'streams: 2 opened, 2 closed (1 failed)' in text
+    assert 'ttft: min=' in text
+    assert '2 stream(s) lost a host, 1 resumed token-exact ' \
+           '(1 token(s) replayed)' in text
+    assert 'NOT resumed (ckpt_every=0)' in text
+
+
 # ---------------------------------------------------------------------------
 # the 2-process SIGKILL drill (the test_elastic.py harness, serving-side)
 # ---------------------------------------------------------------------------
@@ -767,6 +1244,7 @@ host = int(sys.argv[1])
 pod_dir, model_dir, ckpt_dir = sys.argv[2], sys.argv[3], sys.argv[4]
 mesh_n, heal_n = int(sys.argv[5]), int(sys.argv[6])
 stop_file = sys.argv[7]
+transport = sys.argv[8] if len(sys.argv) > 8 else 'file'
 
 
 def build(n):
@@ -778,7 +1256,7 @@ def build(n):
     return b
 
 
-w = serving.PodWorker(pod_dir, host=host,
+w = serving.PodWorker(pod_dir, host=host, transport=transport,
                       builders={'rec': build(heal_n)})
 w.serve('rec', build(mesh_n)('boot'))
 print('SERVING %d' % host)
@@ -792,10 +1270,12 @@ print('STOPPED %d' % host)
 
 @pytest.mark.slow
 def test_two_process_sigkill_mid_traffic(artifacts, tmp_path,
-                                         obs_events):
+                                         obs_events, transport):
     """The acceptance drill: 2 serving host PROCESSES each serve the
     set_mesh-sharded Program (row-sharded table restored from the
     sharded checkpoint — never dense); one is SIGKILLed mid-traffic.
+    Runs on BOTH wires: the rpc leg is the real-TCP SIGKILL case (the
+    kernel resets the sockets; the router must see HostLost, not hang).
     Asserts: typed HostLost, ZERO dropped futures (every submit
     resolves with the right scores), the replica re-shards onto the
     survivor (dp=8 -> dp=4 via the PR 10 restore path), and post-
@@ -812,7 +1292,7 @@ def test_two_process_sigkill_mid_traffic(artifacts, tmp_path,
         procs.append(subprocess.Popen(
             [sys.executable, '-c', _POD_CHILD, str(host), pod,
              artifacts['model_dir'], artifacts['ckpt_dir'],
-             str(mesh_n), str(heal_n), stop_file],
+             str(mesh_n), str(heal_n), stop_file, transport],
             env=env, cwd=here, stdout=subprocess.PIPE,
             stderr=subprocess.PIPE, text=True))
     r = PodRouter(pod, poll_s=0.1, window_s=0.1, heartbeat_timeout=1.5)

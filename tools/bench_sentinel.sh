@@ -142,13 +142,19 @@ for name in sorted(set(new) & set(prev)):
     # lag (*_lag_s) — lower is fresher — while its push latency
     # (*_push_ms) already rides the _ms rule; the pod-serving family
     # (docs/serving.md#pod) adds host-loss recovery/detection times
-    # (*_recovery_s, *_detect_s) — lower means the pod healed faster
+    # (*_recovery_s, *_detect_s) — lower means the pod healed faster;
+    # the decode-stream failover family (docs/serving.md#pod-transport)
+    # adds stream resume time (*_resume_s) and the replay overlap
+    # (*_replayed_tokens = seen-but-pre-checkpoint tokens the survivor
+    # recomputes, bounded by ckpt_every) — both lower-is-better
     lower_is_better = (name.endswith('_ms') or name.endswith('.dropped')
                        or name.endswith('_temp_bytes')
                        or name.endswith('_stall_s')
                        or name.endswith('_lag_s')
                        or name.endswith('_recovery_s')
                        or name.endswith('_detect_s')
+                       or name.endswith('_resume_s')
+                       or name.endswith('_replayed_tokens')
                        or name.endswith('_compiles'))
     if lower_is_better:
         if ratio > 1.1:

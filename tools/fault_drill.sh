@@ -20,8 +20,14 @@
 # The pod-serving tier (tests/test_pod_serving.py, marker `pod`) rides
 # along as well: host-loss drain/re-route/re-shard self-healing with
 # zero dropped futures, typed remote errors, heal-failure re-dispatch,
-# autoscale up/down (docs/serving.md#pod). Its 2-process SIGKILL drill
-# is `slow` and so excluded here.
+# autoscale up/down (docs/serving.md#pod). Every pod drill is
+# parametrized over BOTH wires — the file mailbox and the rpc
+# transport (docs/serving.md#pod-transport) — so one green run covers
+# both; the rpc tier adds ChaosProxy sever/delay/garble drills (a
+# garbled frame fails typed, never hangs) and the decode-stream
+# failover drill (SIGKILL mid-generation, stream resumes token-exact
+# on a survivor). Its 2-process SIGKILL drills are `slow` and so
+# excluded here.
 #
 # Usage: tools/fault_drill.sh [extra pytest args]
 set -euo pipefail

@@ -254,6 +254,21 @@ if [ "${POD:-0}" = 1 ]; then
   run python tools/serve_bench.py --workload pod-sharded --check-compiles
 fi
 
+# 10ab. rpc pod wire (opt-in: RPC=1): the same pod router driven over
+#      the length-prefixed TCP transport vs the file mailbox — reports
+#      per-wire p50/p99/throughput plus streamed-decode TTFT
+#      (serve.wire.* records); --check-speedup enforces rpc at-or-
+#      better p50 vs the file wire. The decode-failover leg SIGKILLs
+#      the stream-owning host mid-generation and enforces a token-
+#      exact resume on the survivor (serve.decode_failover.resume_s /
+#      _replayed_tokens, lower-is-better in bench_sentinel; exits
+#      nonzero on any drop/reorder). Host-side wire machinery:
+#      CPU-safe (docs/serving.md#pod-transport).
+if [ "${RPC:-0}" = 1 ]; then
+  run python tools/serve_bench.py --workload pod-rpc --check-speedup 1.0
+  run python tools/serve_bench.py --workload decode-failover
+fi
+
 # 10b. speculative decoding (opt-in: SPEC=1): greedy target-only vs
 #      draft-then-verify on the predictable-continuation decoder;
 #      reports measured accept-rate and enforces a tokens/sec win

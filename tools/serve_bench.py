@@ -955,6 +955,229 @@ def run_pod_sharded(args):
 
 
 # ---------------------------------------------------------------------------
+# pod-rpc workload: the file mailbox vs the TCP rpc wire, same pod drills
+# ---------------------------------------------------------------------------
+
+class _WireModel(object):
+    """A near-zero-compute model so the A/B isolates WIRE cost: the
+    per-request latency difference between the legs is the transport's
+    dispatch + serialization + completion path, not the math."""
+
+    feed_names = ['x']
+
+    def run(self, feed):
+        return [np.asarray(feed['x']) * 2.0]
+
+
+def _wire_leg(transport, args):
+    """One latency leg: a PodWorker on `transport`, sequential predicts
+    through a PodRouter, per-request wall times returned. On the rpc
+    wire a streamed decode additionally stamps end-to-end TTFT; the
+    file wire's 'TTFT' is its time-to-full-response — the honest
+    number for a wire that only carries whole responses."""
+    import shutil
+    from paddle_tpu import serving
+    base = tempfile.mkdtemp(prefix='serve_bench_wire_')
+    w = serving.PodWorker(base, host=0, beat_interval=0.05,
+                          transport=transport)
+    r = serving.PodRouter(base, poll_s=0.01, window_s=0.5,
+                          heartbeat_timeout=10.0, start=False)
+    lat, ttft = [], None
+    try:
+        eng = serving.ServingEngine(_WireModel(), serving.ServingConfig(
+            max_batch_size=8, buckets=[8], max_queue_delay_ms=0.5))
+        w.serve('wire', eng)
+        rng = np.random.RandomState(11)
+        weights = _decode_weights(rng, args.vocab, args.emb_dim,
+                                  args.enc_dim, args.hidden)
+        dec = serving.DecodeEngine(weights, serving.DecodeConfig(
+            slots=2, beam_size=1, max_len=args.decode_max_len,
+            src_cap=args.src_cap))
+        w.serve('mt', dec)
+        r.wait_for_replicas('wire', 1, timeout=120)
+        r.wait_for_replicas('mt', 1, timeout=120)
+        x = np.ones((4, 8), np.float32)
+        r.predict('wire', {'x': x}, timeout=60)          # warm
+        for _ in range(args.requests):
+            t0 = time.perf_counter()
+            r.predict('wire', {'x': x}, timeout=60)
+            lat.append(time.perf_counter() - t0)
+        enc = (rng.randn(4, args.enc_dim) * 0.5).astype(np.float32)
+        n_tok = max(4, args.decode_max_len - 2)
+        r.predict('mt', {'enc': enc}, timeout=600,
+                  max_new_tokens=2)                      # warm decode
+        if transport == 'rpc':
+            s = r.stream('mt', {'enc': enc}, max_new_tokens=n_tok)
+            for _t, _ids in s:
+                break
+            ttft = s.ttft_s
+            s.result(600)
+        else:
+            t0 = time.perf_counter()
+            r.predict('mt', {'enc': enc}, timeout=600,
+                      max_new_tokens=n_tok)
+            ttft = time.perf_counter() - t0
+    finally:
+        r.shutdown(drain=False)
+        w.shutdown()
+        shutil.rmtree(base, ignore_errors=True)
+    return lat, ttft
+
+
+def run_pod_rpc(args):
+    """The WIRE A/B: the same pod serving drills on the file mailbox
+    and on the TCP rpc transport. Reports per-wire request latency
+    (p50/p99), throughput, and time-to-first-token (whole-response
+    time on the file wire); `--check-speedup X` enforces rpc p50 at
+    X times file p50 or better (X=1.0: at-or-better)."""
+    _emit({'metric': 'serve.wire.workload',
+           'value': 'file vs rpc pod wire, %d requests/leg'
+                    % args.requests})
+    rc = 0
+    p50 = {}
+    for wire in ('file', 'rpc'):
+        lat, ttft = _wire_leg(wire, args)
+        p50[wire] = _pctl(lat, 50)
+        _emit({'metric': 'serve.wire.%s.p50_ms' % wire,
+               'value': round(1e3 * p50[wire], 3), 'unit': 'ms'})
+        _emit({'metric': 'serve.wire.%s.p99_ms' % wire,
+               'value': round(1e3 * _pctl(lat, 99), 3), 'unit': 'ms'})
+        _emit({'metric': 'serve.wire.%s.throughput' % wire,
+               'value': round(len(lat) / max(sum(lat), 1e-9), 2),
+               'unit': 'req/s'})
+        _emit({'metric': 'serve.wire.%s.ttft_s' % wire,
+               'value': round(ttft, 4) if ttft is not None else None,
+               'unit': 's'})
+    _emit({'metric': 'serve.wire.rpc_vs_file_p50',
+           'value': round(p50['file'] / max(p50['rpc'], 1e-9), 3),
+           'unit': 'x'})
+    if args.check_speedup is not None \
+            and p50['rpc'] > p50['file'] * args.check_speedup:
+        print('serve_bench: rpc p50 %.3fms vs file %.3fms — the rpc '
+              'wire must not be slower' % (1e3 * p50['rpc'],
+                                           1e3 * p50['file']),
+              file=sys.stderr)
+        rc = 1
+    return rc
+
+
+# ---------------------------------------------------------------------------
+# decode-failover workload: SIGKILL mid-generation, token-exact resume
+# ---------------------------------------------------------------------------
+
+def run_decode_failover(args):
+    """THE FAILOVER DRILL AS A BENCHMARK: a per-token decode stream on
+    the rpc wire loses its host mid-generation (simulate_death — the
+    SIGKILL posture) and resumes on a survivor from the slot
+    checkpoint. Reports end-to-end TTFT, the RESUME GAP (kill -> next
+    new token at the consumer, `*_resume_s`, lower-is-better in
+    bench_sentinel), tokens replayed past the checkpoint
+    (`*_replayed_tokens`), dropped futures (must be 0) and whether the
+    final beams were TOKEN-EXACT vs an uninterrupted reference
+    (exit 1 if not)."""
+    import glob as _glob
+    import shutil
+    from paddle_tpu import serving
+    rng = np.random.RandomState(7)
+    weights = _decode_weights(rng, args.vocab, args.emb_dim,
+                              args.enc_dim, args.hidden)
+    cfg = dict(slots=2, beam_size=1, max_len=args.decode_max_len,
+               src_cap=args.src_cap)
+    enc = (rng.randn(4, args.enc_dim) * 0.5).astype(np.float32)
+    n_tok = max(8, args.decode_max_len - 2)
+    kill_at = max(2, n_tok // 4)
+    _emit({'metric': 'serve.decode_failover.workload',
+           'value': '2 rpc hosts, %d tokens, kill owner at t=%d, '
+                    'ckpt_every=%d' % (n_tok, kill_at, args.ckpt_every)})
+
+    ref = serving.DecodeEngine(weights, serving.DecodeConfig(**cfg))
+    want_ids, _ = ref.submit({'enc': enc},
+                             max_new_tokens=n_tok).result(600)
+    ref.shutdown()
+
+    base = tempfile.mkdtemp(prefix='serve_bench_failover_')
+    workers = {h: serving.PodWorker(base, host=h, beat_interval=0.05,
+                                    transport='rpc')
+               for h in (0, 1)}
+    r = serving.PodRouter(base, poll_s=0.05, window_s=0.5,
+                          heartbeat_timeout=0.5, start=False)
+    stop = threading.Event()
+
+    def pump():
+        while not stop.is_set():
+            r.poll()
+            time.sleep(0.05)
+
+    rc = 0
+    try:
+        for h, w in workers.items():
+            eng = serving.DecodeEngine(weights,
+                                       serving.DecodeConfig(**cfg))
+            eng.submit({'enc': enc}, max_new_tokens=2).result(600)
+            w.serve('mt', eng)
+        r.wait_for_replicas('mt', 2, timeout=120)
+        pump_t = threading.Thread(target=pump, daemon=True)
+        pump_t.start()
+        t0 = time.perf_counter()
+        s = r.stream('mt', {'enc': enc}, ckpt_every=args.ckpt_every,
+                     max_new_tokens=n_tok)
+        t_kill = ckpt_step = None
+        resume_gap = None
+        seen = []
+        for t, _ids in s:
+            seen.append(t)
+            if t_kill is not None and resume_gap is None \
+                    and t > kill_seen:
+                resume_gap = time.perf_counter() - t_kill
+            if t == kill_at and t_kill is None:
+                for info in list(r._known.values()):
+                    if info['proxy'].outstanding():
+                        workers[info['host']].simulate_death()
+                kill_seen = s.last_t
+                t_kill = time.perf_counter()
+                for p in _glob.glob(os.path.join(
+                        base, 'streams', 'ckpt.*.npz')):
+                    try:
+                        with np.load(p) as z:
+                            ckpt_step = int(z['step'])
+                    except Exception:  # noqa: BLE001 — torn mid-write
+                        pass
+        got_ids, _ = s.result(600)
+        exact = bool(np.array_equal(np.asarray(got_ids), want_ids))
+        ordered = seen == list(range(1, n_tok + 1))
+        replayed = max(0, (kill_seen or 0) - (ckpt_step or 0)) \
+            if ckpt_step is not None else None
+        _emit({'metric': 'serve.decode_failover.ttft_s',
+               'value': round(s.ttft_s, 4), 'unit': 's'})
+        if resume_gap is not None:
+            _emit({'metric': 'serve.decode_failover.resume_s',
+                   'value': round(resume_gap, 3), 'unit': 's'})
+        if replayed is not None:
+            _emit({'metric': 'serve.decode_failover.replayed_tokens',
+                   'value': int(replayed)})
+        _emit({'metric': 'serve.decode_failover.dropped', 'value': 0})
+        _emit({'metric': 'serve.decode_failover.token_exact',
+               'value': exact})
+        if not exact or not ordered:
+            print('serve_bench: failover stream not token-exact '
+                  '(ordered=%s exact=%s)' % (ordered, exact),
+                  file=sys.stderr)
+            rc = 1
+    except Exception as e:  # noqa: BLE001 — a dropped stream = failure
+        _emit({'metric': 'serve.decode_failover.dropped', 'value': 1})
+        print('serve_bench: failover stream dropped: %r' % (e,),
+              file=sys.stderr)
+        rc = 1
+    finally:
+        stop.set()
+        r.shutdown(drain=False)
+        for w in workers.values():
+            w.shutdown()
+        shutil.rmtree(base, ignore_errors=True)
+    return rc
+
+
+# ---------------------------------------------------------------------------
 # aot-cold workload: cold-replica time-to-first-response with and without
 # an imported AOT warm-signature blob (docs/perf.md#aot)
 # ---------------------------------------------------------------------------
@@ -1085,7 +1308,8 @@ def main(argv=None):
                     help='exit 1 if the steady-state phase compiled')
     ap.add_argument('--workload',
                     choices=('infer', 'decode', 'decode-paged',
-                             'decode-spec', 'aot-cold', 'pod-sharded'),
+                             'decode-spec', 'aot-cold', 'pod-sharded',
+                             'pod-rpc', 'decode-failover'),
                     default='infer',
                     help='infer: single-shot requests through the '
                          'ServingEngine; decode: autoregressive beam '
@@ -1108,7 +1332,18 @@ def main(argv=None):
                          'from a sharded checkpoint, never dense) '
                          'behind a PodRouter, one host SIGKILLed '
                          'mid-run — recovery_s, dropped=0, rows/sec '
-                         'before/after, post-recovery steady compiles.')
+                         'before/after, post-recovery steady compiles; '
+                         'pod-rpc: the file mailbox vs the TCP rpc '
+                         'transport on the same pod drills (per-wire '
+                         'p50/p99 + TTFT; --check-speedup 1.0 enforces '
+                         'rpc at-or-better); decode-failover: a '
+                         'per-token decode stream loses its host '
+                         'mid-generation and resumes token-exact from '
+                         'the slot checkpoint (ttft_s, resume_s, '
+                         'replayed_tokens, dropped=0).')
+    ap.add_argument('--ckpt-every', type=int, default=4,
+                    help='decode-failover: per-slot decode-state '
+                         'checkpoint cadence in tokens')
     ap.add_argument('--page-size', type=int, default=8,
                     help='paged workloads: rows per page')
     ap.add_argument('--paged-slots', type=int, default=0,
@@ -1158,12 +1393,22 @@ def main(argv=None):
                         'src_cap': 8, 'min_tokens': 48, 'beam': 1,
                         'requests': 48, 'reps': 3},
         'pod-sharded': {'requests': 64, 'concurrency': 4, 'vocab': 64},
+        'pod-rpc': {'requests': 48, 'vocab': 64, 'emb_dim': 8,
+                    'enc_dim': 6, 'hidden': 16, 'decode_max_len': 16,
+                    'src_cap': 5},
+        'decode-failover': {'vocab': 64, 'emb_dim': 8, 'enc_dim': 6,
+                            'hidden': 16, 'decode_max_len': 32,
+                            'src_cap': 5},
     }
     for k, v in wl_defaults.get(args.workload, {}).items():
         if getattr(args, k) == ap.get_default(k):
             setattr(args, k, v)
 
     _resolve_platform()
+    if args.workload == 'pod-rpc':
+        return run_pod_rpc(args)
+    if args.workload == 'decode-failover':
+        return run_decode_failover(args)
     if args.workload == 'pod-sharded':
         return run_pod_sharded(args)
     if args.workload == 'aot-cold':
