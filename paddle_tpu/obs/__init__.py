@@ -30,17 +30,24 @@ import time
 from . import metrics  # noqa: F401
 from . import report  # noqa: F401
 from . import runlog as _runlog
+from . import slo  # noqa: F401
+from . import trace  # noqa: F401
 from .metrics import REGISTRY, counter, gauge, histogram  # noqa: F401
 
-__all__ = ['metrics', 'report', 'REGISTRY', 'counter', 'gauge', 'histogram',
-           'enabled', 'obs_dir', 'enable', 'disable', 'event', 'span',
-           'span_record', 'run_log_path', 'ENV_DIR']
+__all__ = ['metrics', 'report', 'slo', 'trace', 'REGISTRY', 'counter',
+           'gauge', 'histogram', 'enabled', 'obs_dir', 'enable', 'disable',
+           'event', 'span', 'span_record', 'run_log_path', 'ENV_DIR']
 
 ENV_DIR = 'PADDLE_TPU_OBS_DIR'
 # Optional: pin the run-log to an EXACT file path instead of a fresh
 # run-<stamp>-<pid>.jsonl — how tools/perf_sweep.sh collects one sweep's
 # events (its own + every child bench's) into a single run file.
 ENV_RUN_FILE = 'PADDLE_TPU_OBS_RUN_FILE'
+# Ring-buffer bound of the run log (see runlog.RunLog); applies to fresh
+# per-run files. A pinned shared file (ENV_RUN_FILE) stays unbounded by
+# default because compaction would drop other writers' appends.
+ENV_MAX_EVENTS = 'PADDLE_TPU_OBS_MAX_EVENTS'
+DEFAULT_MAX_EVENTS = 500000
 
 _state = {
     'override': None,      # None = follow env; (True, dir) / (False, None)
@@ -92,6 +99,7 @@ def _reset():
         _close_runlog_locked()
         _state['override'] = None
         _span_hists.clear()   # drop handles detached by REGISTRY.reset()
+    trace._reset()
 
 
 def _close_runlog_locked():
@@ -127,8 +135,15 @@ def _run_log():
             pinned = (os.environ.get(ENV_RUN_FILE)
                       if _state['override'] is None else None)
             path = pinned or _runlog.new_run_path(d)
+            max_events = None if pinned else DEFAULT_MAX_EVENTS
+            raw = os.environ.get(ENV_MAX_EVENTS)
+            if raw:
+                try:
+                    max_events = int(raw) or None
+                except ValueError:
+                    pass
             try:
-                rl = _runlog.RunLog(path)
+                rl = _runlog.RunLog(path, max_events=max_events)
             except Exception as e:
                 _state['failed_dir'] = d
                 import warnings
@@ -170,6 +185,9 @@ def event(name, **fields):
         return None
     rec = {'ts': time.monotonic(), 'kind': 'event', 'name': name,
            'span': current_span_id(), 'fields': fields}
+    tids = trace._ids()
+    if tids:
+        rec.update(tids)
     rl.write(rec)
     return rec
 
@@ -179,7 +197,7 @@ class Span(object):
     holds the wall time. `.fields` may be mutated inside the span — the
     run-log record is emitted at exit."""
     __slots__ = ('name', 'fields', 'step_num', 'id', 'parent', 't0',
-                 'seconds', '_trace', '_entered')
+                 'seconds', '_trace', '_tinfo', '_entered')
 
     def __init__(self, name, step_num=None, **fields):
         self.name = name
@@ -190,6 +208,7 @@ class Span(object):
         self.t0 = None
         self.seconds = None
         self._trace = None
+        self._tinfo = None
         self._entered = False
 
     def __enter__(self):
@@ -198,6 +217,9 @@ class Span(object):
         self.id = next(_span_ids)
         st.append(self)
         self._entered = True
+        # when a distributed trace is active this span joins it (and
+        # becomes the parent of anything opened inside) — no-op otherwise
+        self._tinfo = trace._span_begin(self.name)
         if enabled():
             self._enter_trace()
         self.t0 = time.perf_counter()
@@ -240,17 +262,30 @@ class Span(object):
             h = REGISTRY.histogram(self.name + '.seconds')
             _span_hists[self.name] = h
         h.observe(self.seconds)
+        err = '%s: %s' % (exc_type.__name__, exc) if exc_type is not None \
+            else None
+        tids = None
+        if self._tinfo is not None:
+            trec = trace._span_end(self._tinfo, fields=dict(self.fields),
+                                   error=err)
+            self._tinfo = None
+            tids = {'trace': trec['trace'], 'tspan': trec['span']}
+            if trec.get('parent') is not None:
+                tids['tparent'] = trec['parent']
         rl = _run_log()
         if rl is not None:
             fields = dict(self.fields)
-            if exc_type is not None:
-                fields['error'] = '%s: %s' % (exc_type.__name__, exc)
+            if err is not None:
+                fields['error'] = err
             if self.step_num is not None:
                 fields.setdefault('step_num', self.step_num)
-            rl.write({'ts': time.monotonic(), 'kind': 'span',
-                      'name': self.name, 'span': self.id,
-                      'parent': self.parent,
-                      'dur_s': self.seconds, 'fields': fields})
+            rec = {'ts': time.monotonic(), 'kind': 'span',
+                   'name': self.name, 'span': self.id,
+                   'parent': self.parent,
+                   'dur_s': self.seconds, 'fields': fields}
+            if tids:
+                rec.update(tids)
+            rl.write(rec)
         return False
 
 
@@ -285,5 +320,8 @@ def span_record(name, seconds, **fields):
     rec = {'ts': time.monotonic(), 'kind': 'span', 'name': name,
            'span': next(_span_ids), 'parent': current_span_id(),
            'dur_s': seconds, 'fields': dict(fields)}
+    tids = trace._ids()
+    if tids:
+        rec.update(tids)
     rl.write(rec)
     return rec

@@ -14,10 +14,12 @@ disabled-mode contract of the obs layer is "no file, no jax import", and
 tests load the package standalone to prove it.
 """
 import bisect
+import re
 import threading
 
 __all__ = ['Counter', 'Gauge', 'Histogram', 'Registry', 'REGISTRY',
-           'counter', 'gauge', 'histogram', 'DEFAULT_TIME_BUCKETS']
+           'counter', 'gauge', 'histogram', 'render_prom',
+           'DEFAULT_TIME_BUCKETS']
 
 # Exponential seconds buckets spanning sub-ms op dispatch to multi-minute
 # compiles. The +Inf overflow bucket is implicit (the last counts slot).
@@ -234,6 +236,15 @@ class Registry(object):
                      if n == name and isinstance(i, Counter)]
         return sum(i.value for i in insts)
 
+    def find(self, name):
+        """Every instrument registered under `name`, any labels, in
+        stable label order ([] when never registered) — how the SLO
+        evaluator reaches a histogram's percentile() (snapshots only
+        pre-compute p50/p95)."""
+        with self._lock:
+            return [inst for (n, _), inst in sorted(self._instruments
+                                                    .items()) if n == name]
+
     def snapshot(self):
         """Point-in-time list of every instrument's snapshot dict, sorted
         by (name, labels) for stable diffing."""
@@ -261,3 +272,78 @@ def gauge(name, **labels):
 
 def histogram(name, buckets=None, **labels):
     return REGISTRY.histogram(name, buckets=buckets, **labels)
+
+
+def _prom_name(name):
+    n = re.sub(r'[^a-zA-Z0-9_:]', '_', str(name))
+    if not n or not re.match(r'[a-zA-Z_:]', n[0]):
+        n = '_' + n
+    return n
+
+
+def _prom_esc(v):
+    return str(v).replace('\\', '\\\\').replace('"', '\\"') \
+                 .replace('\n', '\\n')
+
+
+def _prom_labels(labels, extra=()):
+    items = sorted(labels.items()) + list(extra)
+    if not items:
+        return ''
+    return '{%s}' % ','.join('%s="%s"' % (_prom_name(k), _prom_esc(v))
+                             for k, v in items)
+
+
+def _prom_num(v):
+    return repr(float(v))
+
+
+def render_prom(registry=None):
+    """The whole registry in Prometheus text exposition format (v0.0.4):
+    counters as `<name>_total`, gauges as-is (unset gauges skipped),
+    histograms as CUMULATIVE `_bucket{le=...}` series plus `_sum` and
+    `_count` — our per-bucket counts are accumulated here because that
+    is what the wire format specifies. Dotted metric names are
+    sanitized (`.` -> `_`); one HELP/TYPE header per metric name. The
+    pod serves this on the rpc `metrics` frame and drops it into
+    `metrics.h<host>.prom` files on the stats cadence, so a scrape
+    needs no run-log parsing."""
+    reg = registry if registry is not None else REGISTRY
+    lines = []
+    headed = set()
+
+    def _head(mname, mtype):
+        if mname not in headed:
+            headed.add(mname)
+            lines.append('# HELP %s paddle_tpu metric' % mname)
+            lines.append('# TYPE %s %s' % (mname, mtype))
+
+    for s in reg.snapshot():
+        base = _prom_name(s['name'])
+        kind = s['kind']
+        if kind == 'counter':
+            mname = base + '_total'
+            _head(mname, 'counter')
+            lines.append('%s%s %s' % (mname, _prom_labels(s['labels']),
+                                      _prom_num(s['value'])))
+        elif kind == 'gauge':
+            if s['value'] is None:
+                continue
+            _head(base, 'gauge')
+            lines.append('%s%s %s' % (base, _prom_labels(s['labels']),
+                                      _prom_num(s['value'])))
+        elif kind == 'histogram':
+            _head(base, 'histogram')
+            cum = 0
+            for bound, c in s['buckets']:
+                cum += c
+                le = '+Inf' if bound == '+Inf' else _prom_num(bound)
+                lines.append('%s_bucket%s %d'
+                             % (base, _prom_labels(s['labels'],
+                                                   [('le', le)]), cum))
+            lines.append('%s_sum%s %s' % (base, _prom_labels(s['labels']),
+                                          _prom_num(s['sum'])))
+            lines.append('%s_count%s %d' % (base,
+                                            _prom_labels(s['labels']),
+                                            s['count']))
+    return '\n'.join(lines) + '\n' if lines else ''

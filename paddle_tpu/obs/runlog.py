@@ -23,6 +23,8 @@ import os
 import threading
 import time
 
+from .metrics import REGISTRY
+
 __all__ = ['RunLog', 'new_run_path']
 
 _SEQ_LOCK = threading.Lock()
@@ -57,15 +59,38 @@ def new_run_path(obs_dir):
 class RunLog(object):
     """Append-only JSONL writer. The file (and its directory) is created
     on construction; callers create RunLogs lazily so an enabled-but-idle
-    process leaves no output file behind."""
+    process leaves no output file behind.
 
-    def __init__(self, path):
+    RING-BUFFER MODE (`max_events=`): a week-long train_stream or decode
+    soak must not grow the log without bound, so once the file exceeds
+    max_events records (plus ~10% slack so compaction amortizes) it is
+    rewritten in place — atomic tmp + os.replace, reopened for append —
+    keeping the run_start meta line and the newest max_events records.
+    Eviction is NEVER silent: every dropped record counts on the
+    `obs.runlog.dropped` counter and the rewritten file leads with a
+    `runlog.dropped` meta record carrying the cumulative total. Memory
+    stays O(1) — the ring lives in the file, not in RAM. Do not use on a
+    file shared by several live writers (the pinned
+    PADDLE_TPU_OBS_RUN_FILE case): compaction would drop their racing
+    appends — paddle_tpu.obs leaves pinned files unbounded by default."""
+
+    def __init__(self, path, max_events=None):
         self.path = path
+        self.max_events = int(max_events) if max_events else None
+        self.dropped = 0
+        self._lines = 0
+        self._compact_failed = False
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
         self._lock = threading.Lock()
         is_new = not os.path.exists(path) or os.path.getsize(path) == 0
+        if not is_new and self.max_events:
+            try:
+                with open(path, 'rb') as f:
+                    self._lines = sum(1 for _ in f)
+            except Exception:
+                self._lines = 0
         self._f = open(path, 'a')
         if is_new:
             # several processes may share one pinned run file
@@ -75,6 +100,36 @@ class RunLog(object):
                         'fields': {'pid': os.getpid(),
                                    'time': time.strftime(
                                        '%Y-%m-%dT%H:%M:%S%z')}})
+
+    def _compact_locked(self):
+        """Rewrite the file keeping run_start + the newest max_events
+        records; stale dropped-notices are superseded, not stacked."""
+        with open(self.path, 'r') as f:
+            lines = f.read().splitlines()
+        head = [ln for ln in lines[:2] if '"name":"run_start"' in ln][:1]
+        body = [ln for ln in lines if ln not in head
+                and '"name":"runlog.dropped"' not in ln]
+        keep = body[-self.max_events:]
+        newly = len(body) - len(keep)
+        if newly <= 0:
+            self._lines = len(lines)
+            return
+        self.dropped += newly
+        REGISTRY.counter('obs.runlog.dropped').inc(newly)
+        notice = json.dumps(
+            {'ts': time.monotonic(), 'kind': 'meta',
+             'name': 'runlog.dropped', 'span': None,
+             'fields': {'dropped': self.dropped,
+                        'max_events': self.max_events}},
+            separators=(',', ':'))
+        tmp = '%s.tmp%d' % (self.path, os.getpid())
+        out = head + [notice] + keep
+        with open(tmp, 'w') as f:
+            f.write('\n'.join(out) + '\n')
+        self._f.close()
+        os.replace(tmp, self.path)
+        self._f = open(self.path, 'a')
+        self._lines = len(out)
 
     def write(self, record):
         try:
@@ -88,6 +143,16 @@ class RunLog(object):
             try:
                 self._f.write(line + '\n')
                 self._f.flush()
+                self._lines += 1
+                if (self.max_events and not self._compact_failed
+                        and self._lines > self.max_events
+                        + max(32, self.max_events // 10)):
+                    try:
+                        self._compact_locked()
+                    except Exception:
+                        # unwritable tmp / torn file: stop trying, the
+                        # log just stays append-only from here
+                        self._compact_failed = True
             except Exception as e:
                 # disk full / fd revoked mid-run: the instrumented step
                 # must survive. Disable THIS run log and say so once.

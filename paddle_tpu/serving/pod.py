@@ -50,6 +50,7 @@ import uuid
 import numpy as np
 
 from .. import obs
+from ..obs import trace
 from .engine import (DeadlineExceeded, DeltaUnsupported, ServerClosed,
                      ServerOverloaded, ServingConfig, ServingEngine)
 from .router import Router
@@ -313,6 +314,15 @@ def _streams_dir(pod_dir):
     return os.path.join(pod_dir, 'streams')
 
 
+def _traces_dir(pod_dir):
+    # per-process trace-span spill files (spans.p<pid>.json): every
+    # participant (router + each worker) dumps its bounded span buffer
+    # here on its stats cadence; obs.trace.TraceCollector stitches the
+    # per-host files into end-to-end timelines, flagging spans a dead
+    # host never closed as orphans (docs/observability.md#distributed-tracing)
+    return os.path.join(pod_dir, trace.TRACE_DIR)
+
+
 def _atomic_json(path, obj):
     tmp = '%s.tmp%d' % (path, os.getpid())
     with open(tmp, 'w') as f:
@@ -461,6 +471,7 @@ class PodWorker(object):
             os.makedirs(d, exist_ok=True)
         self._lock = threading.Lock()
         self._replicas = {}          # key -> dict(engine, thread, stop)
+        self._last_telemetry_t = 0.0
         self._serial = 0
         self._stop = threading.Event()
         self._frozen = False         # simulate_death(): loops stall
@@ -567,6 +578,7 @@ class PodWorker(object):
         ok = True
         for key in self.served():
             ok = self.retire(key, drain=drain, timeout=timeout) and ok
+        self._host_telemetry(force=True)   # final spill: no span lost
         self.heartbeat.stop()
         if self._rpc is not None:
             self._rpc.close()
@@ -662,26 +674,57 @@ class PodWorker(object):
             # replaces atomically, so this is a transient FS hiccup)
             inflight.discard(uid)
             return
+        # the request JSON carries the caller's trace context; re-enter
+        # it so this host's spans/events stitch into the same timeline
+        tr = trace.from_headers(kwargs.pop('trace', None))
+        h = trace.begin('serving.pod.serve', ctx=tr,
+                        node='h%d' % self.host, uid=uid, wire='file')
         try:
-            fut = engine.submit(feed, **kwargs)
+            if h is not None:
+                h.mark('trace.dispatch')
+            with trace.activate(h.ctx if h is not None else None,
+                                node='h%d' % self.host):
+                fut = engine.submit(feed, **kwargs)
         except Exception as e:  # noqa: BLE001 — typed back to the caller
+            if h is not None:
+                h.end(error=type(e).__name__)
             respond(exc=e)
             return
-        fut.add_done_callback(lambda f: respond(
-            outs=None if f.exception() else f.result(),
-            exc=f.exception()))
+
+        def done(f, _h=h):
+            if self._frozen:
+                # SIGKILL fidelity: a dead host answers nothing, and its
+                # serve span stays OPEN — the spilled open span is the
+                # orphan the trace collector flags
+                return
+            try:
+                e = f.exception()
+            except concurrent.futures.CancelledError as ce:
+                e = ce
+            respond(outs=None if e is not None else f.result(), exc=e)
+            if _h is not None:
+                _h.end(error=type(e).__name__ if e is not None else None)
+        fut.add_done_callback(done)
 
     def _serve_push(self, engine, spool, path, fname):
         uid = fname[5:-4]
         ack = os.path.join(spool, 'pushok.%s.json' % uid)
         try:
             with np.load(path, allow_pickle=False) as z:
+                meta = {}
+                if '__meta__' in z.files:
+                    try:
+                        meta = json.loads(bytes(z['__meta__']).decode())
+                    except ValueError:
+                        meta = {}
                 deltas = {}
                 for k in z.files:
                     if k.startswith('i:'):
                         name = k[2:]
                         deltas[name] = (z[k], z['r:%s' % name])
-            rows = engine.push_rows(deltas)
+            with trace.activate(trace.from_headers(meta.get('trace')),
+                                node='h%d' % self.host):
+                rows = engine.push_rows(deltas)
             _atomic_json(ack, {'ok': True, 'rows': int(rows)})
         except Exception as e:  # noqa: BLE001 — typed back to the caller
             _atomic_json(ack, {'ok': False,
@@ -725,7 +768,35 @@ class PodWorker(object):
                        'cache': cache}
             _atomic_json(os.path.join(rec['spool'], 'stats.json'),
                          payload)
+        self._host_telemetry()
         return payload
+
+    def _host_telemetry(self, force=False):
+        """Host-wide observability dumps riding the stats cadence: the
+        trace-span spill (traces/spans.p<pid>.json, the collector's
+        input) and the Prometheus exposition file (metrics.h<host>.prom)
+        — scrape surfaces needing no live server. A frozen (simulated-
+        dead) host stops dumping, so its LAST spill still holds the
+        open spans the collector flags as orphans."""
+        if self._frozen:
+            return
+        now = time.monotonic()
+        if not force and now - self._last_telemetry_t < self._stats_every:
+            return
+        self._last_telemetry_t = now
+        try:
+            trace.spill(_traces_dir(self.pod_dir))
+        except Exception:  # noqa: BLE001 — telemetry must not kill serving
+            pass
+        try:
+            path = os.path.join(self.pod_dir,
+                                'metrics.h%d.prom' % self.host)
+            tmp = '%s.tmp%d' % (path, os.getpid())
+            with open(tmp, 'w') as f:
+                f.write(obs.metrics.render_prom())
+            os.replace(tmp, path)
+        except Exception:  # noqa: BLE001 — same
+            pass
 
     # -- rpc service (transport='rpc'; serving/transport.py) ---------------
 
@@ -752,6 +823,12 @@ class PodWorker(object):
                                           self._rec(header.get('key')))
             conn.send({'uid': header.get('uid'), 'final': True,
                        'stats': payload or {}})
+        elif op == 'metrics':
+            # Prometheus text exposition over the wire: one frame in,
+            # one final frame out carrying the whole registry — the
+            # scrape path for deployments that never mount pod_dir
+            conn.send({'uid': header.get('uid'), 'final': True,
+                       'prom': obs.metrics.render_prom()})
         elif op == 'retire':
             ok = self.retire(header.get('key'),
                              drain=bool(header.get('drain', True)),
@@ -783,8 +860,17 @@ class PodWorker(object):
                   for n in arrays if n.startswith('z:')}
         if resume:
             kwargs['resume'] = resume
+        # frame header carries the caller's trace context; re-enter it
+        # so this host's serve span stitches into the same timeline
+        tr = trace.from_headers(header.get('trace'))
+        h = trace.begin('serving.pod.serve', ctx=tr,
+                        node='h%d' % self.host, uid=uid, wire='rpc')
         sid = header.get('sid')
         ckpt_path = None
+        # dispatch stamp: set right before engine.submit; the first
+        # token's server-side TTFT (dispatch -> token 1, no wire) is
+        # measured against it and shipped in that token's frame header
+        t_dispatch = [time.monotonic()]
         if header.get('stream'):
             # per-token emitter: enqueue on the connection's writer (the
             # decode loop never blocks); a dead consumer turns the False
@@ -792,10 +878,19 @@ class PodWorker(object):
             # The _frozen check keeps simulate_death() faithful to
             # SIGKILL: a dead host's in-process engine must stop having
             # observable effects the moment it "dies"
-            def on_token(t, ids, _c=conn, _u=uid):
+            sent_first = [False]
+
+            def on_token(t, ids, _c=conn, _u=uid, _h=h):
+                hdr = {'uid': _u, 'final': False, 'tok': int(t)}
+                if not sent_first[0]:
+                    sent_first[0] = True
+                    sttft = round(time.monotonic() - t_dispatch[0], 6)
+                    hdr['sttft'] = sttft
+                    if _h is not None:
+                        _h.mark('trace.first_token',
+                                server_ttft_s=sttft)
                 if self._frozen or not _c.send(
-                        {'uid': _u, 'final': False, 'tok': int(t)},
-                        {'ids': np.asarray(ids)}):
+                        hdr, {'ids': np.asarray(ids)}):
                     raise TransportError(
                         'stream consumer disconnected')
             kwargs['on_token'] = on_token
@@ -811,15 +906,34 @@ class PodWorker(object):
                                    for k, v in state.items()})
             kwargs['checkpoint'] = checkpoint
             kwargs['ckpt_every'] = ckpt_every
-        fut = engine.submit(feed, **kwargs)
+        if h is not None:
+            h.mark('trace.dispatch')
+        t_dispatch[0] = time.monotonic()
+        try:
+            with trace.activate(h.ctx if h is not None else None,
+                                node='h%d' % self.host):
+                fut = engine.submit(feed, **kwargs)
+        except Exception as e:
+            if h is not None:
+                h.end(error=type(e).__name__)
+            raise
         conn.state.setdefault('futs', {})[uid] = (fut, engine)
 
-        def done(f, _c=conn, _u=uid, _p=ckpt_path):
+        def done(f, _c=conn, _u=uid, _p=ckpt_path, _h=h):
             (_c.state.get('futs') or {}).pop(_u, None)
+            if self._frozen:
+                # SIGKILL fidelity: a dead host answers nothing, never
+                # closes its serve span (the spilled open span IS the
+                # orphan the collector flags), and must not janitor the
+                # shared stream checkpoint the failover path resumes
+                # from
+                return
             try:
                 e = f.exception()
             except concurrent.futures.CancelledError as ce:
                 e = ce
+            if _h is not None:
+                _h.end(error=type(e).__name__ if e is not None else None)
             if e is not None:
                 _c.send({'uid': _u, 'final': True,
                          'error': {'type': type(e).__name__,
@@ -843,7 +957,9 @@ class PodWorker(object):
                 name = n[2:]
                 deltas[name] = (np.asarray(arrays[n]),
                                 np.asarray(arrays['r:%s' % name]))
-        rows = rec['engine'].push_rows(deltas)
+        with trace.activate(trace.from_headers(header.get('trace')),
+                            node='h%d' % self.host):
+            rows = rec['engine'].push_rows(deltas)
         conn.send({'uid': header.get('uid'), 'final': True, 'ok': True,
                    'rows': int(rows)})
 
@@ -900,18 +1016,24 @@ class PodWorker(object):
             self._heal_failed(token, 'host %d has no builder for %r'
                               % (self.host, model_id))
             return
-        try:
-            with obs.span('serving.replica.build', model=str(model_id),
-                          host=self.host, reason=cmd.get('reason')):
-                engine = builder(cmd.get('reason', 'heal'))
-            key = self.serve(model_id, engine, heal_token=token)
-        except Exception as e:  # noqa: BLE001 — report, don't die
-            self._heal_failed(token, '%s: %s' % (type(e).__name__, e))
-            return
-        obs.event('serving.replica.reshard', model=str(model_id),
-                  host=self.host, key=key, token=str(token),
-                  reason=cmd.get('reason'),
-                  lost_host=cmd.get('lost_host'))
+        # the heal order carries the router's trace context: the whole
+        # recovery (build -> re-shard -> register) lands on the same
+        # timeline as the host loss that triggered it
+        with trace.activate(trace.from_headers(cmd.get('trace')),
+                            node='h%d' % self.host):
+            try:
+                with obs.span('serving.replica.build',
+                              model=str(model_id), host=self.host,
+                              reason=cmd.get('reason')):
+                    engine = builder(cmd.get('reason', 'heal'))
+                key = self.serve(model_id, engine, heal_token=token)
+            except Exception as e:  # noqa: BLE001 — report, don't die
+                self._heal_failed(token, '%s: %s' % (type(e).__name__, e))
+                return
+            obs.event('serving.replica.reshard', model=str(model_id),
+                      host=self.host, key=key, token=str(token),
+                      reason=cmd.get('reason'),
+                      lost_host=cmd.get('lost_host'))
 
     def _heal_failed(self, token, why):
         obs.event('serving.pod.heal_failed', host=self.host,
@@ -972,15 +1094,26 @@ class RemoteReplica(object):
                     'per-token streaming (%s=) needs the rpc transport; '
                     'the file wire only carries whole responses — start '
                     "the PodWorker with transport='rpc'" % k)
+        # capture the caller's trace context (Router.submit dispatches
+        # inside its activation) so a host-loss re-route keeps the
+        # ORIGINAL trace_id; '_trace' stays client-side, the wire meta
+        # carries it under 'trace' (the worker pops it back out)
+        if kwargs.get('_trace') is None:
+            hdrs = trace.headers()
+            if hdrs is not None:
+                kwargs['_trace'] = hdrs
         arrays = {str(n): np.asarray(a) for n, a in feed.items()}
         with self._lock:
             self._seq += 1
             uid = '%06d-%s' % (self._seq, uuid.uuid4().hex[:8])
             fut = concurrent.futures.Future()
             self._pending[uid] = (fut, arrays, dict(kwargs))
+        meta = {k: v for k, v in kwargs.items() if k != '_trace'}
+        if kwargs.get('_trace') is not None:
+            meta['trace'] = kwargs['_trace']
         payload = {'f:%s' % n: a for n, a in arrays.items()}
         payload['__meta__'] = np.frombuffer(
-            json.dumps(kwargs).encode(), np.uint8)
+            json.dumps(meta).encode(), np.uint8)
         try:
             _atomic_npz(os.path.join(self._spool, 'rq.%s.npz' % uid),
                         **payload)
@@ -1045,6 +1178,10 @@ class RemoteReplica(object):
             ids, rows = deltas[name]
             payload['i:%s' % name] = np.asarray(ids)
             payload['r:%s' % name] = np.asarray(rows)
+        hdrs = trace.headers()
+        if hdrs is not None:
+            payload['__meta__'] = np.frombuffer(
+                json.dumps({'trace': hdrs}).encode(), np.uint8)
         _atomic_npz(os.path.join(self._spool, 'push.%s.npz' % uid),
                     **payload)
         ack_path = os.path.join(self._spool, 'pushok.%s.json' % uid)
@@ -1225,6 +1362,13 @@ class RpcReplica(object):
     def submit(self, feed, **kwargs):
         if self._closed:
             raise ServerClosed('remote replica %s is closed' % self.key)
+        # capture the caller's trace context (Router.submit dispatches
+        # inside its activation): the pending entry keeps it so a
+        # host-loss re-route resumes under the ORIGINAL trace_id
+        if kwargs.get('_trace') is None:
+            hdrs = trace.headers()
+            if hdrs is not None:
+                kwargs['_trace'] = hdrs
         arrays = {str(n): np.asarray(a) for n, a in feed.items()}
         with self._lock:
             self._seq += 1
@@ -1238,12 +1382,15 @@ class RpcReplica(object):
     def _send_submit(self, uid, arrays, kwargs):
         # callables and resumed decode state never cross as JSON meta:
         # streaming intent travels as header flags, resume state as
-        # typed array blobs, and the callbacks stay client-side
+        # typed array blobs, and the callbacks stay client-side; the
+        # trace context rides the frame header, not the meta
         meta = {k: v for k, v in kwargs.items()
                 if k not in ('on_token', 'checkpoint', 'resume', 'sid',
-                             'ckpt_every', '_last_t')}
+                             'ckpt_every', '_last_t', '_trace')}
         header = {'op': 'submit', 'uid': uid, 'key': self.key,
                   'meta': meta}
+        if kwargs.get('_trace') is not None:
+            header['trace'] = kwargs['_trace']
         wire = {'f:%s' % n: a for n, a in arrays.items()}
         if kwargs.get('on_token') is not None:
             header['stream'] = True
@@ -1280,7 +1427,18 @@ class RpcReplica(object):
             kwargs['_last_t'] = max(t, int(kwargs.get('_last_t') or 0))
             cb = kwargs.get('on_token')
             if cb is not None:
-                cb(t, arrays.get('ids'))
+                sttft = header.get('sttft')
+                if sttft is not None:
+                    # first token's frame carries the worker's server-
+                    # side TTFT (dispatch -> token 1, no wire): hand it
+                    # to consumers that take it (TokenStream), fall back
+                    # for plain 2-arg callbacks (failover replay path)
+                    try:
+                        cb(t, arrays.get('ids'), float(sttft))
+                    except TypeError:
+                        cb(t, arrays.get('ids'))
+                else:
+                    cb(t, arrays.get('ids'))
             return
         with self._lock:
             entry = self._pending.pop(uid, None)
@@ -1397,6 +1555,14 @@ class RpcReplica(object):
             st = self._last_stats or {}
         return dict(st.get('cache') or {})
 
+    def metrics_text(self, timeout=5.0):
+        """The worker host's full metrics registry in Prometheus text
+        exposition format (the rpc `metrics` op) — the scrape path for
+        deployments that never mount pod_dir."""
+        fut = self._ctl_rpc({'op': 'metrics', 'key': self.key})
+        reply = fut.result(float(timeout))
+        return str(reply.get('prom') or '')
+
     def push_rows(self, deltas, timeout=30.0):
         if self._closed:
             raise ServerClosed('remote replica %s is closed' % self.key)
@@ -1405,7 +1571,11 @@ class RpcReplica(object):
             ids, rows = deltas[name]
             payload['i:%s' % name] = np.asarray(ids)
             payload['r:%s' % name] = np.asarray(rows)
-        fut = self._ctl_rpc({'op': 'push', 'key': self.key}, payload)
+        header = {'op': 'push', 'key': self.key}
+        hdrs = trace.headers()
+        if hdrs is not None:
+            header['trace'] = hdrs
+        fut = self._ctl_rpc(header, payload)
         try:
             reply = fut.result(float(timeout))
         except concurrent.futures.TimeoutError:
@@ -1651,6 +1821,7 @@ class PodRouter(Router):
         self._parked = []       # [(model_id, fut, feed, kwargs, t_expire)]
         self._autoscalers = {}
         self.lost_hosts = []    # [{'host', 'stale', 'error', ...}]
+        self._last_spill_t = 0.0
         self._stop = threading.Event()
         self._thread = None
         if start:
@@ -1674,6 +1845,22 @@ class PodRouter(Router):
                 except Exception as e:  # noqa: BLE001 — keep polling
                     obs.event('serving.autoscale.error',
                               error='%s: %s' % (type(e).__name__, e))
+        self.spill_traces()
+
+    def spill_traces(self, force=False):
+        """Dump this process's trace-span buffer into the shared
+        traces/ dir (where each PodWorker spills too) so the collector
+        can stitch the router's request spans against the workers'
+        serve spans. Cadenced off the poll loop; `force` for a final
+        flush (shutdown) or deterministic tests."""
+        now = time.monotonic()
+        if not force and now - self._last_spill_t < 1.0:
+            return
+        self._last_spill_t = now
+        try:
+            trace.spill(_traces_dir(self.pod_dir))
+        except Exception:  # noqa: BLE001 — telemetry must not kill poll
+            pass
 
     def _pod_loop(self):
         while not self._stop.wait(self._poll_s):
@@ -1853,16 +2040,22 @@ class PodRouter(Router):
         if kwargs.get('on_token') is not None or kwargs.get('sid'):
             return self._reroute_stream(model_id, fut, feed, kwargs,
                                         t_expire, record)
-        try:
-            new_fut = self.submit(model_id, feed, **kwargs)
-        except Exception:  # noqa: BLE001 — park: a heal may be coming
-            self._parked.append((model_id, fut, feed, kwargs, t_expire))
-            return False
-        _chain(new_fut, fut)
-        _C_REROUTED.inc()
-        if record is not None:
-            record['rerouted'] += 1
-        obs.event('serving.pod.reroute', model=str(model_id))
+        # re-enter the request's ORIGINAL trace context (captured by the
+        # proxy at submit time): the survivor's serve span lands on the
+        # same timeline the lost host's orphan span belongs to
+        with trace.activate(trace.from_headers(kwargs.get('_trace')),
+                            node='router'):
+            try:
+                new_fut = self.submit(model_id, feed, **kwargs)
+            except Exception:  # noqa: BLE001 — park: heal may be coming
+                self._parked.append((model_id, fut, feed, kwargs,
+                                     t_expire))
+                return False
+            _chain(new_fut, fut)
+            _C_REROUTED.inc()
+            if record is not None:
+                record['rerouted'] += 1
+            obs.event('serving.pod.reroute', model=str(model_id))
         return True
 
     def _reroute_stream(self, model_id, fut, feed, kwargs, t_expire,
@@ -1885,6 +2078,16 @@ class PodRouter(Router):
         stream lost BEFORE its first checkpoint restarts from scratch
         — fewer than ckpt_every tokens of replayed work, all absorbed
         by the dedup."""
+        # the resumed segment continues the ORIGINAL stream's trace:
+        # same trace_id across the failover, so the stitched timeline
+        # shows dead-host orphan -> resume -> completion as one request
+        with trace.activate(trace.from_headers(kwargs.get('_trace')),
+                            node='router'):
+            return self._resume_stream(model_id, fut, feed, kwargs,
+                                       t_expire, record)
+
+    def _resume_stream(self, model_id, fut, feed, kwargs, t_expire,
+                       record):
         from ..parallel import HostLost
         sid = kwargs.get('sid')
         ckpt_every = int(kwargs.get('ckpt_every') or 0)
@@ -1997,15 +2200,24 @@ class PodRouter(Router):
                               'host': host, 't': time.monotonic(),
                               'reason': reason,
                               'exclude': sorted(set(exclude_hosts))}
+        # the heal order carries a trace context (continuing the caller's
+        # when inside one), so the whole recovery — this request, the
+        # target host's build/re-shard, the registration — stitches into
+        # ONE timeline the collector can render
+        ctx = trace.current()
+        if ctx is None:
+            ctx = trace.new_trace()
         os.makedirs(_ctl_dir(self.pod_dir, host), exist_ok=True)
         _atomic_json(os.path.join(_ctl_dir(self.pod_dir, host),
                                   'cmd.%s.json' % token),
                      {'cmd': 'heal', 'model': str(model_id),
                       'token': token, 'reason': reason,
-                      'lost_host': lost_host})
+                      'lost_host': lost_host,
+                      'trace': trace.headers(ctx)})
         _C_HEALS.inc()
-        obs.event('serving.pod.heal_requested', model=str(model_id),
-                  host=host, token=token, reason=reason)
+        with trace.activate(ctx, node='router'):
+            obs.event('serving.pod.heal_requested', model=str(model_id),
+                      host=host, token=token, reason=reason)
         return token
 
     def _check_heal_failures(self):
@@ -2087,4 +2299,6 @@ class PodRouter(Router):
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout or 10.0)
-        return Router.shutdown(self, drain=drain, timeout=timeout)
+        ok = Router.shutdown(self, drain=drain, timeout=timeout)
+        self.spill_traces(force=True)   # final flush: no span lost
+        return ok

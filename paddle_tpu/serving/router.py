@@ -37,6 +37,7 @@ import time
 import numpy as np
 
 from .. import obs
+from ..obs import trace
 from .engine import (DeadlineExceeded, ServerClosed, ServerOverloaded,
                      ServingEngine)
 
@@ -66,6 +67,10 @@ _C_STREAM_TOKENS = obs.counter('serving.stream.tokens')
 # before any queueing) to the first token REACHING the client callback
 # — the user-visible TTFT, not the engine-internal one
 _H_STREAM_TTFT = obs.histogram('serving.stream.ttft.seconds')
+# SERVER-SIDE time to first token: engine dispatch on the serving host
+# to its first on_token call, carried back in the first token frame —
+# ttft minus this is the wire + queueing share of the budget
+_H_STREAM_STTFT = obs.histogram('serving.stream.server_ttft.seconds')
 
 # process-wide replica-id sequence: ids stay unique across routers so a
 # registry (serving/pod.py) can address any replica it ever handed out
@@ -77,6 +82,19 @@ def _next_rid():
     with _RID_LOCK:
         _RID[0] += 1
         return _RID[0]
+
+
+def _end_request_span(h, fut):
+    """Close a serving.request trace span from its future's done
+    callback. end() merges: a stream's _on_done adds ttft fields to the
+    same record in whichever order the callbacks fire."""
+    try:
+        err = fut.exception()
+    except concurrent.futures.CancelledError as e:
+        err = e
+    except Exception:
+        err = None
+    h.end(error=type(err).__name__ if err is not None else None)
 
 
 class _Replica(object):
@@ -169,10 +187,15 @@ class TokenStream(object):
         self._cancel_cb = None
         self._t_open = time.monotonic()
         self._ttft_s = None
+        self._server_ttft_s = None
+        self._tspan = None        # trace.SpanHandle of the request span
 
     # -- producer edge (decode loop / rpc reader thread) -------------------
 
-    def _on_token(self, t, ids):
+    def _on_token(self, t, ids, server_ttft_s=None):
+        # server_ttft_s rides ONLY the first token frame from an rpc
+        # worker (engine dispatch -> first token on the serving host);
+        # legacy 2-arg producers simply leave it None
         t = int(t)
         with self._cv:
             if t <= self._last_t:
@@ -181,15 +204,24 @@ class TokenStream(object):
             first = self._ttft_s is None
             if first:
                 self._ttft_s = time.monotonic() - self._t_open
+                if server_ttft_s is not None:
+                    self._server_ttft_s = float(server_ttft_s)
             self._buf.append((t, None if ids is None
                               else np.asarray(ids).copy()))
             self._cv.notify_all()
         _C_STREAM_TOKENS.inc()
         if first:
             _H_STREAM_TTFT.observe(self._ttft_s)
+            if self._server_ttft_s is not None:
+                _H_STREAM_STTFT.observe(self._server_ttft_s)
+            h = self._tspan
+            if h is not None:
+                h.mark('trace.first_token', ttft_s=round(self._ttft_s, 6),
+                       server_ttft_s=self._server_ttft_s)
             obs.event('serving.stream.first_token',
                       model=str(self.model_id),
-                      ttft_s=round(self._ttft_s, 6))
+                      ttft_s=round(self._ttft_s, 6),
+                      server_ttft_s=self._server_ttft_s)
 
     def _attach(self, future):
         self._future = future
@@ -202,16 +234,31 @@ class TokenStream(object):
             err = fut.exception()
         except concurrent.futures.CancelledError as e:
             err = e
+        h = self._tspan
+        if h is not None:
+            h.end(tokens=self._last_t, ttft_s=self._ttft_s,
+                  server_ttft_s=self._server_ttft_s)
         obs.event('serving.stream.close', model=str(self.model_id),
-                  tokens=self._last_t,
+                  tokens=self._last_t, ttft_s=self._ttft_s,
+                  server_ttft_s=self._server_ttft_s,
                   error=type(err).__name__ if err is not None else None)
 
     # -- consumer edge -----------------------------------------------------
 
     @property
     def ttft_s(self):
-        """End-to-end time to first token (None until it arrives)."""
+        """End-to-end time to first token (None until it arrives):
+        stream() call at the client to the token reaching the client,
+        wire latency included."""
         return self._ttft_s
+
+    @property
+    def server_ttft_s(self):
+        """Server-side time to first token: engine dispatch on the
+        serving host to its first on_token call. None until the first
+        token arrives, and None for in-process replicas (there is no
+        wire to separate out)."""
+        return self._server_ttft_s
 
     @property
     def last_t(self):
@@ -401,7 +448,36 @@ class Router(object):
         extra keyword arguments (deadline_ms, timeout, max_new_tokens,
         ...) pass through to the replica's submit(). Raises UnknownModel
         for an unregistered id and ModelOverloaded when the model quota
-        is exhausted or every replica refused."""
+        is exhausted or every replica refused.
+
+        Every request is TRACED (docs/observability.md#distributed-tracing): the
+        admission point opens the `serving.request` span under the
+        caller's active trace context (or a wire-carried `_trace`
+        stash, or a fresh trace), and dispatch runs with that span
+        current — pod proxies forward it over the wire so the worker's
+        serve span joins the same trace."""
+        # `_trace` is the wire-header stash a failover reroute carries
+        # (serving/pod.py); popped here so engine signatures never see it
+        wire_ctx = kwargs.pop('_trace', None)
+        ctx = trace.current()
+        if ctx is None:
+            ctx = trace.from_headers(wire_ctx) or trace.new_trace()
+        h = trace.begin('serving.request', ctx=ctx, node='router',
+                        model=str(model_id))
+        try:
+            with trace.activate(h.ctx):
+                fut = self._dispatch(model_id, feed, kwargs)
+        except Exception as e:
+            h.end(error=type(e).__name__)
+            raise
+        fut.add_done_callback(lambda f, _h=h: _end_request_span(_h, f))
+        try:
+            fut._trace_span = h   # stream() picks the handle up here
+        except Exception:
+            pass
+        return fut
+
+    def _dispatch(self, model_id, feed, kwargs):
         last_err = None
         # one admission budget for the WHOLE dispatch: trying N blocking
         # replicas in sequence must not multiply the caller's timeout
@@ -523,11 +599,19 @@ class Router(object):
         on_token (in-process DecodeEngine, or an rpc pod proxy) can
         serve it, and admission/quota/overload-retry semantics are
         identical to submit(). TTFT is measured end-to-end: stream()
-        call to first token at the client."""
+        call to first token at the client (`server_ttft_s` carries the
+        worker-side dispatch-to-first-token share when the replica is
+        an rpc proxy)."""
         s = TokenStream(model_id=model_id)
         kwargs['on_token'] = s._on_token
-        fut = self.submit(model_id, feed, **kwargs)
-        obs.event('serving.stream.open', model=str(model_id))
+        ctx = trace.current()
+        if ctx is None:
+            ctx = trace.from_headers(kwargs.pop('_trace', None)) \
+                or trace.new_trace()
+        with trace.activate(ctx):
+            fut = self.submit(model_id, feed, **kwargs)
+            obs.event('serving.stream.open', model=str(model_id))
+        s._tspan = getattr(fut, '_trace_span', None)
         s._cancel_cb = lambda: self._cancel_request(model_id, s._future)
         s._attach(fut)
         return s

@@ -32,6 +32,7 @@ import time
 import numpy as np
 
 from .. import obs
+from ..obs import trace
 
 __all__ = ['DeltaPublisher']
 
@@ -127,6 +128,27 @@ class DeltaPublisher(object):
         push them into the live replicas. Clears the pending set on
         success only. Returns rows pushed."""
         import jax.numpy as jnp
+        # each publish is its own trace (continuing the caller's when
+        # inside one): the events below AND the remote workers' apply
+        # spans — the wire proxies forward the context — stitch into one
+        # cross-host timeline per push
+        ctx = trace.current()
+        if ctx is None:
+            ctx = trace.new_trace()
+        h = trace.begin('streaming.publish', ctx=ctx, node='publisher')
+        with trace.activate(h.ctx if h is not None else ctx,
+                            node='publisher'):
+            try:
+                total = self._publish(read_table, jnp)
+            except Exception as e:
+                if h is not None:
+                    h.end(error=type(e).__name__)
+                raise
+        if h is not None:
+            h.end(rows=total)
+        return total
+
+    def _publish(self, read_table, jnp):
         if self._heartbeat is not None:
             # typed host-loss gate BEFORE any replica mutates: a push
             # must never half-land across a dying pod

@@ -22,6 +22,7 @@ import os
 import subprocess
 import sys
 import threading
+import time
 import warnings
 
 import numpy as np
@@ -30,6 +31,7 @@ import pytest
 import paddle_tpu.fluid as fluid
 from paddle_tpu import obs
 from paddle_tpu.obs import report as obs_report_mod
+from paddle_tpu.obs import trace
 
 from util import fresh_program
 
@@ -416,6 +418,245 @@ def test_profiler_context_stops_on_exception(tmp_path):
     # profiler disarmed AND the partial report was written
     assert not profiler._state['active']
     assert os.path.exists(path)
+
+
+# ---------------------------------------------------------------------------
+# distributed tracing (obs.trace)
+# ---------------------------------------------------------------------------
+
+def test_trace_context_headers_roundtrip_and_span_linkage():
+    ctx = trace.new_trace()
+    assert len(ctx.trace_id) == 16
+    with trace.activate(ctx, node='router'):
+        assert trace.current().trace_id == ctx.trace_id
+        hdrs = trace.headers()
+        # wire headers reconstruct the SAME trace on the far side
+        far = trace.from_headers(json.loads(json.dumps(hdrs)))
+        assert far.trace_id == ctx.trace_id
+        h = trace.begin('t.tr.parent', node='router')
+        child = trace.begin('t.tr.child', ctx=h.ctx, node='h0')
+        child.mark('t.tr.milestone', k=1)
+        child.end()
+        h.end(ok=True)
+    assert trace.current() is None          # activation scoped
+    # garbage headers NEVER crash the serving path
+    assert trace.from_headers(None) is None
+    assert trace.from_headers({'nope': 1}) is None
+    assert trace.from_headers('junk') is None
+    # no active trace -> begin/mark are clean no-ops
+    assert trace.begin('t.tr.orphanless') is None
+    assert trace.mark('t.tr.nomark') is None
+
+
+def test_trace_spill_and_collector_stitch_with_orphan(tmp_path):
+    """Spans from two 'hosts' (one spilled via the API, one written as a
+    dead host's spill file) stitch into ONE timeline with monotonic
+    stage boundaries; the dead host's open span is flagged orphan."""
+    tdir = str(tmp_path / 'traces')
+    ctx = trace.new_trace()
+    with trace.activate(ctx, node='router'):
+        req = trace.begin('serving.request', node='router', uid=7)
+        time.sleep(0.002)
+        srv = trace.begin('serving.pod.serve', ctx=req.ctx, node='h0',
+                          wire='rpc')
+        srv.mark('trace.dispatch')
+        time.sleep(0.002)
+        srv.mark('trace.first_token', server_ttft_s=0.002)
+        time.sleep(0.002)
+        srv.end()
+        req.end()
+    assert trace.spill(tdir) is not None
+    # a second host that died mid-request: its spill holds an OPEN span
+    dead = {'pid': 99999, 'spans': [
+        {'trace': ctx.trace_id, 'span': 'feedfeedfeedfeed',
+         'parent': None, 'name': 'serving.pod.serve', 'node': 'h1',
+         'pid': 99999, 't0': time.time(), 't1': None,
+         'fields': {'wire': 'rpc'}}]}
+    with open(os.path.join(tdir, 'spans.p99999.json'), 'w') as f:
+        json.dump(dead, f)
+
+    coll = trace.TraceCollector(tdir)
+    coll.load()
+    assert ctx.trace_id in coll.traces()
+    tl = coll.timeline(ctx.trace_id)
+    assert tl['trace'] == ctx.trace_id
+    assert set(tl['nodes']) == {'router', 'h0', 'h1'}
+    assert len(tl['orphans']) == 1
+    assert tl['orphans'][0]['node'] == 'h1'
+    points = {m['name']: m['t'] for m in tl['milestones']}
+    # end-to-end milestones present and MONOTONIC
+    for a, b in (('admit', 'serve'), ('serve', 'dispatch'),
+                 ('dispatch', 'first_token'), ('first_token', 'done')):
+        assert points[a] <= points[b], (a, b, points)
+    assert all(st['seconds'] >= 0 for st in tl['stages'])
+    stage_names = [st['stage'] for st in tl['stages']]
+    assert 'dispatch->first_token' in stage_names
+
+
+def test_trace_buffer_bounded_counts_drops():
+    trace.set_capacity(32)
+    try:
+        ctx = trace.new_trace()
+        before = obs.REGISTRY.total('obs.trace.dropped') or 0
+        for i in range(100):
+            trace.begin('t.tr.flood', ctx=ctx, i=i).end()
+        dropped = (obs.REGISTRY.total('obs.trace.dropped') or 0) - before
+        assert dropped >= 100 - 32          # eviction is COUNTED
+        assert len(trace._buf) <= 32        # and the buffer stays bounded
+    finally:
+        trace.set_capacity(trace._DEFAULT_CAPACITY)
+
+
+def test_slo_report_cli_renders_stitched_timeline(tmp_path):
+    """tools/slo_report.py (standalone load, no jax) renders the
+    per-stage breakdown + SLO verdicts; tightening a budget flips the
+    exit code and names the violated percentile."""
+    tdir = str(tmp_path / 'traces')
+    ctx = trace.new_trace()
+    with trace.activate(ctx, node='router'):
+        req = trace.begin('serving.request', node='router')
+        srv = trace.begin('serving.pod.serve', ctx=req.ctx, node='h0')
+        srv.mark('trace.dispatch')
+        time.sleep(0.002)
+        srv.mark('trace.first_token')
+        srv.end()
+        req.end()
+    trace.spill(tdir)
+    cli = os.path.join(REPO, 'tools', 'slo_report.py')
+    budgets = tmp_path / 'budgets.json'
+    budgets.write_text(json.dumps({'budgets': {'ttft_p99_s': 5.0}}))
+    r = subprocess.run([sys.executable, cli, '--traces', tdir,
+                        '--trace', ctx.trace_id,
+                        '--budgets', str(budgets)],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert ctx.trace_id in r.stdout
+    assert 'dispatch->first_token' in r.stdout
+    assert '-> PASS' in r.stdout
+    budgets.write_text(json.dumps({'budgets': {'ttft_p99_s': 1e-9}}))
+    r2 = subprocess.run([sys.executable, cli, '--traces', tdir,
+                        '--budgets', str(budgets)],
+                        capture_output=True, text=True, timeout=60)
+    assert r2.returncode == 1
+    assert 'ttft_p99_s' in r2.stdout and 'VIOLATION' in r2.stdout
+    # usage errors are typed exit 2
+    r3 = subprocess.run([sys.executable, cli, '--traces',
+                         str(tmp_path / 'nowhere')],
+                        capture_output=True, text=True, timeout=60)
+    assert r3.returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# SLO budgets (obs.slo)
+# ---------------------------------------------------------------------------
+
+def test_slo_budget_pass_fail_missing_typed():
+    # a FRESH registry: the global one carries whatever earlier tests
+    # in this process observed, and these assertions are exact
+    reg = obs.metrics.Registry()
+    h = reg.histogram('serving.stream.ttft.seconds')
+    for _ in range(20):
+        h.observe(0.010)
+    budget = obs.slo.SloBudget.from_dict(
+        {'_comment': 'ignored',
+         'budgets': {'ttft_p99_s': 1.0, 'recovery_s': 5.0}})
+    res = budget.evaluate(registry=reg)
+    assert res.passed
+    assert [m.budget for m in res.missing] == ['recovery_s']
+    assert any(l.endswith('PASS') for l in res.lines())
+
+    tight = obs.slo.SloBudget({'ttft_p99_s': 0.001})
+    res2 = tight.evaluate(registry=reg)
+    assert not res2.passed
+    v = res2.violations[0]
+    assert isinstance(v, obs.slo.SloViolation)
+    assert v.budget == 'ttft_p99_s' and v.measured > v.limit
+    assert 'ttft_p99_s' in v.describe()
+
+    # strict mode turns MISSING into failure (CI variant)
+    strict = obs.slo.SloBudget({'recovery_s': 5.0})
+    assert strict.evaluate(registry=reg).passed
+    assert not strict.evaluate(registry=reg,
+                               strict_missing=True).passed
+
+    # an unknown key is legal but surfaces LOUDLY as missing (a budget
+    # for a future metric must not silently pass)
+    future = obs.slo.SloBudget(
+        {'not_yet_a_budget': 1.0}).evaluate(registry=reg)
+    assert [m.budget for m in future.missing] == ['not_yet_a_budget']
+
+
+def test_slo_measures_recovery_and_dropped_from_events():
+    reg = obs.metrics.Registry()           # isolated from other tests
+    ev = [{'name': 'serving.replica.reshard',
+           'fields': {'heal_s': 2.5}},
+          {'name': 'bench.metric',
+           'fields': {'metric': 'serve.decode_failover.resume_s',
+                      'value': 0.75}}]
+    m = obs.slo.measure(registry=reg, events=ev)
+    assert m['recovery_s'] == 2.5           # slowest heal wins
+    # dropped is only reported once serving counters EXIST (a vacuous 0
+    # from an idle registry must not satisfy the budget)
+    assert 'dropped' not in obs.slo.measure(registry=reg)
+    reg.counter('serving.shed').inc(0)
+    m2 = obs.slo.measure(registry=reg)
+    assert m2.get('dropped') == 0
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition (obs.metrics.render_prom)
+# ---------------------------------------------------------------------------
+
+def test_render_prom_exposition_format():
+    obs.counter('t.prom.requests', wire='rpc').inc(3)
+    obs.counter('t.prom.requests', wire='file').inc(1)
+    obs.gauge('t.prom.lag').set(1.5)
+    obs.gauge('t.prom.unset')               # never set: skipped
+    h = obs.histogram('t.prom.lat')
+    h.observe(0.005)
+    h.observe(0.5)
+    text = obs.metrics.render_prom()
+    assert text.endswith('\n')
+    assert '# TYPE t_prom_requests_total counter' in text
+    assert 't_prom_requests_total{wire="rpc"} 3' in text
+    assert 't_prom_lag 1.5' in text
+    assert 't_prom_unset' not in text
+    # histogram buckets are CUMULATIVE and end at +Inf == count
+    assert 't_prom_lat_bucket{le="+Inf"} 2' in text
+    assert 't_prom_lat_count 2' in text
+    lines = [l for l in text.splitlines()
+             if l.startswith('t_prom_lat_bucket')]
+    counts = [float(l.rsplit(' ', 1)[1]) for l in lines]
+    assert counts == sorted(counts)         # cumulative = monotonic
+
+
+# ---------------------------------------------------------------------------
+# run-log ring buffer
+# ---------------------------------------------------------------------------
+
+def test_runlog_ring_buffer_bounds_file_and_counts_drops(tmp_path,
+                                                         monkeypatch):
+    monkeypatch.setenv('PADDLE_TPU_OBS_DIR', str(tmp_path / 'obs'))
+    monkeypatch.setenv(obs.ENV_MAX_EVENTS, '10')
+    obs._reset()
+    before = obs.REGISTRY.total('obs.runlog.dropped') or 0
+    for i in range(60):
+        obs.event('t.ring.e%d' % i, i=i)
+    path = obs.run_log_path()
+    lines = [json.loads(l) for l in open(path) if l.strip()]
+    # bounded: max_events + compaction slack + meta head, nowhere near
+    # the 60 writes (compaction fires past max_events + max(32, 10%))
+    assert len(lines) <= 45, len(lines)
+    names = [l['name'] for l in lines]
+    assert names[0] == 'run_start'           # head preserved
+    assert 'runlog.dropped' in names         # eviction is VISIBLE
+    assert 't.ring.e59' in names             # newest survive
+    assert 't.ring.e0' not in names          # oldest evicted
+    dropped = (obs.REGISTRY.total('obs.runlog.dropped') or 0) - before
+    assert dropped >= 20
+    # the surviving tail still validates against the schema
+    events, errors = obs_report_mod.load_events(path)
+    assert errors == [], errors
 
 
 # ---------------------------------------------------------------------------

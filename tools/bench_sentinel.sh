@@ -179,6 +179,18 @@ capture() {
     tail -c 400 BENCH_TPU_SENTINEL.json >> "$LOG"
     compare_prev
     grep -q '"platform": "tpu"' BENCH_TPU_SENTINEL.json || return 1
+    # SLO gate (HARD failure): the rpc pod workload must land inside
+    # the checked-in percentile budgets (tools/slo_budgets.json) before
+    # any artifact is committed — blessing a capture while serving is
+    # out of budget would commit a regression as the new baseline
+    # (docs/observability.md#slo-budgets). CPU-pinned: the budgets are CPU
+    # ceilings and the pod wire is host-side machinery.
+    if ! timeout 900 env JAX_PLATFORMS=cpu python tools/serve_bench.py \
+            --workload pod-rpc --slo tools/slo_budgets.json \
+            >> "$LOG" 2>&1; then
+        log "SLO VIOLATION: pod-rpc outside tools/slo_budgets.json; capture aborted (no commit)"
+        return 1
+    fi
     timeout 1200 python tools/tune_flash.py --seq 1024 --iters 10 \
         > tools/flash_tuned_sentinel.json 2>> "$LOG" \
         && git add -f tools/flash_tuned_sentinel.json

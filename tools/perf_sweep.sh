@@ -269,6 +269,23 @@ if [ "${RPC:-0}" = 1 ]; then
   run python tools/serve_bench.py --workload decode-failover
 fi
 
+# 10ac. SLO gate (opt-in: SLO=1): the rpc pod workload + the decode-
+#      failover drill graded against the checked-in percentile budgets
+#      (tools/slo_budgets.json, obs.slo schema): serve_bench --slo
+#      evaluates TTFT p50/p99 (client AND server-side), per-token p99,
+#      recovery time, and dropped==0 from the run's own histograms/
+#      events, prints one verdict line per budget, and exits nonzero
+#      naming every violated percentile (docs/observability.md#slo-budgets).
+#      The budgets are honest shared-CPU ceilings, so a failure here is
+#      structural — a stall or a lost stream — not box noise. Host-side
+#      machinery: CPU-safe.
+if [ "${SLO:-0}" = 1 ]; then
+  run python tools/serve_bench.py --workload pod-rpc \
+      --slo tools/slo_budgets.json
+  run python tools/serve_bench.py --workload decode-failover \
+      --slo tools/slo_budgets.json
+fi
+
 # 10b. speculative decoding (opt-in: SPEC=1): greedy target-only vs
 #      draft-then-verify on the predictable-continuation decoder;
 #      reports measured accept-rate and enforces a tokens/sec win

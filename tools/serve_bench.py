@@ -1380,6 +1380,17 @@ def main(argv=None):
                     metavar='X',
                     help='decode workload: exit 1 if continuous '
                          'batching is below X times lockstep tokens/sec')
+    ap.add_argument('--slo', metavar='BUDGETS.json', default=None,
+                    help='grade the run against a declarative SLO '
+                         'budget file (obs.slo schema, e.g. '
+                         'tools/slo_budgets.json) after the workload: '
+                         'exit nonzero naming every violated '
+                         'percentile; budgets nothing measured are '
+                         'reported MISSING but do not fail (see '
+                         '--slo-strict-missing)')
+    ap.add_argument('--slo-strict-missing', action='store_true',
+                    help='with --slo: a budget nothing measured is a '
+                         'failure too')
     args = ap.parse_args(argv)
 
     # per-workload regime defaults: applied only where the user kept
@@ -1405,20 +1416,15 @@ def main(argv=None):
             setattr(args, k, v)
 
     _resolve_platform()
-    if args.workload == 'pod-rpc':
-        return run_pod_rpc(args)
-    if args.workload == 'decode-failover':
-        return run_decode_failover(args)
-    if args.workload == 'pod-sharded':
-        return run_pod_sharded(args)
-    if args.workload == 'aot-cold':
-        return run_aot_cold(args)
-    if args.workload == 'decode':
-        return run_decode(args)
-    if args.workload == 'decode-paged':
-        return run_decode_paged(args)
-    if args.workload == 'decode-spec':
-        return run_decode_spec(args)
+    special = {'pod-rpc': run_pod_rpc,
+               'decode-failover': run_decode_failover,
+               'pod-sharded': run_pod_sharded,
+               'aot-cold': run_aot_cold,
+               'decode': run_decode,
+               'decode-paged': run_decode_paged,
+               'decode-spec': run_decode_spec}
+    if args.workload in special:
+        return _slo_check(args, special[args.workload](args))
 
     save_dir = tempfile.mkdtemp(prefix='serve_bench_')
     feed_name, example = build_model(args.model, save_dir)
@@ -1456,8 +1462,42 @@ def main(argv=None):
         print('serve_bench: %d compile(s) happened AFTER warmup — the '
               'bucket set does not cover the traffic' % steady_compiles,
               file=sys.stderr)
-        return 1
-    return 0
+        return _slo_check(args, 1)
+    return _slo_check(args, 0)
+
+
+def _slo_check(args, rc):
+    """--slo BUDGETS.json: grade the workload's live registry (and run
+    log, when PADDLE_TPU_OBS_DIR captured one) against the declared
+    percentile budgets. A violation makes the exit code nonzero and is
+    printed NAMING the violated percentile, its measured value and its
+    ceiling; a budget nothing measured is reported MISSING but passes
+    unless --slo-strict-missing (a CPU functional run has no heal drill
+    to measure recovery_s with)."""
+    if not args.slo:
+        return rc
+    from paddle_tpu import obs
+    events = None
+    obs_dir = os.environ.get('PADDLE_TPU_OBS_DIR')
+    if obs_dir and os.path.isdir(obs_dir):
+        try:
+            events, _errs, _files = obs.report.collect_events(
+                obs_dir, merge_dir=True)
+        except Exception:  # noqa: BLE001 — registry-only grading
+            events = None
+    budget = obs.slo.SloBudget.from_file(args.slo)
+    result = budget.evaluate(events=events,
+                             strict_missing=args.slo_strict_missing)
+    for line in result.lines():
+        print('serve_bench: %s' % line,
+              file=sys.stdout if result.passed else sys.stderr)
+    _emit({'metric': 'serve.slo', 'value': 'PASS' if result.passed
+           else 'FAIL', 'ok': len(result.ok),
+           'violations': [v.budget for v in result.violations],
+           'missing': [m.budget for m in result.missing]})
+    if not result.passed:
+        return rc or 1
+    return rc
 
 
 if __name__ == '__main__':

@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""slo_report: render stitched cross-host trace timelines + SLO verdicts.
+
+    python tools/slo_report.py --traces POD_DIR/traces
+    python tools/slo_report.py --traces POD_DIR/traces --trace 1f2e3d...
+    python tools/slo_report.py --traces POD_DIR/traces \
+        --budgets tools/slo_budgets.json [--runlog RUN.jsonl]
+
+Reads the per-process span spills every pod participant dumps into
+`<pod_dir>/traces/` (spans.p<pid>.json — the router on its poll
+cadence, each PodWorker on its stats cadence), stitches them into
+end-to-end per-request timelines (admit -> serve -> dispatch -> first
+token -> done), prints the per-stage latency breakdown, and FLAGS
+ORPHAN spans — spans a process opened and never closed, the signature
+of a host that died mid-request (docs/observability.md#distributed-tracing).
+
+With --budgets, the measured timelines (plus a --runlog event file,
+when given) are graded against the declarative SLO budget file
+(obs.slo schema): exit 0 within budget, 1 naming every violated
+percentile, 2 on usage errors. Loads the obs package STANDALONE
+(stdlib importlib, never `import paddle_tpu`) so it starts in
+milliseconds and works on machines without jax.
+"""
+import argparse
+import importlib.util
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_obs():
+    """Load paddle_tpu/obs as a standalone top-level package — no
+    paddle_tpu import, hence no jax import (the package is stdlib-only
+    by contract; tests/test_obs.py enforces it)."""
+    if 'paddle_tpu' in sys.modules:       # already paid for: reuse it
+        from paddle_tpu import obs
+        return obs
+    pkg_dir = os.path.join(_REPO, 'paddle_tpu', 'obs')
+    name = '_paddle_tpu_obs_standalone'
+    if name in sys.modules:
+        return sys.modules[name]
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(pkg_dir, '__init__.py'),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _fmt_s(v):
+    if v is None:
+        return '-'
+    if v < 1e-3:
+        return '%.1fus' % (v * 1e6)
+    if v < 1.0:
+        return '%.2fms' % (v * 1e3)
+    return '%.3fs' % v
+
+
+def render_timeline(tl):
+    """One stitched trace as indented text: stage breakdown first (the
+    latency story), then every span offset-relative to the trace start,
+    orphans flagged loudly."""
+    out = []
+    out.append('trace %s  nodes=%s  spans=%d%s'
+               % (tl['trace'], ','.join(tl['nodes']) or '-',
+                  len(tl['spans']),
+                  '  ORPHANS=%d' % len(tl['orphans'])
+                  if tl['orphans'] else ''))
+    if tl['stages']:
+        out.append('  stages:')
+        for st in tl['stages']:
+            out.append('    %-28s %s'
+                       % (st['stage'], _fmt_s(st['seconds'])))
+    points = {m['name']: m['t'] for m in tl.get('milestones') or []}
+    if points.get('done') is not None and tl.get('start') is not None:
+        out.append('    %-28s %s'
+                   % ('total (%s->done)'
+                      % ((tl['milestones'][0]['name'])
+                         if tl.get('milestones') else 'start'),
+                      _fmt_s(points['done'] - tl['start'])))
+    out.append('  spans:')
+    t0 = tl.get('start') or 0.0
+    for rec in tl['spans']:
+        dur = (rec['t1'] - rec['t0']) if rec['t1'] is not None else None
+        mark = rec.get('mark')
+        flag = ''
+        if rec['t1'] is None and not mark:
+            flag = '  <-- ORPHAN (never closed; host dead?)'
+        err = (rec.get('fields') or {}).get('error')
+        if err:
+            flag += '  error=%s' % err
+        out.append('    [%-9s] %-26s +%-9s %s%s'
+                   % (rec.get('node') or '?', rec['name'],
+                      _fmt_s(max(0.0, rec['t0'] - t0)),
+                      'mark' if mark else _fmt_s(dur), flag))
+    return '\n'.join(out)
+
+
+def trace_measurements(obs, timelines):
+    """{budget_key: value} measured from stitched timelines: TTFT from
+    admit -> first_token (client-inclusive, cross-host wall clock) and
+    the server-side dispatch -> first_token twin — the trace-derived
+    view the SLO evaluator grades when no live registry exists."""
+    ttft, sttft = [], []
+    for tl in timelines:
+        m = {p['name']: p['t'] for p in tl.get('milestones') or []}
+        if m.get('admit') is not None and m.get('first_token') is not None:
+            ttft.append(m['first_token'] - m['admit'])
+        if m.get('dispatch') is not None \
+                and m.get('first_token') is not None:
+            sttft.append(m['first_token'] - m['dispatch'])
+    out = {}
+    pct = obs.report.percentile_exact
+    if ttft:
+        out['ttft_p50_s'] = pct(ttft, 50)
+        out['ttft_p99_s'] = pct(ttft, 99)
+    if sttft:
+        out['server_ttft_p99_s'] = pct(sttft, 99)
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog='slo_report', description=__doc__.splitlines()[0])
+    ap.add_argument('--traces', metavar='DIR', required=True,
+                    help='the traces/ spill dir (e.g. <pod_dir>/traces)')
+    ap.add_argument('--trace', metavar='ID', default=None,
+                    help='render only this trace id (default: all)')
+    ap.add_argument('--budgets', metavar='BUDGETS.json', default=None,
+                    help='grade against this SLO budget file '
+                         '(obs.slo schema); exit 1 on violation')
+    ap.add_argument('--runlog', metavar='RUN.jsonl', default=None,
+                    help='also measure budgets from this run-log '
+                         '(recovery_s lives only in events)')
+    ap.add_argument('--strict-missing', action='store_true',
+                    help='a declared budget nothing measured fails too')
+    args = ap.parse_args(argv)
+
+    obs = load_obs()
+    if not os.path.isdir(args.traces):
+        print('slo_report: %r is not a directory' % args.traces,
+              file=sys.stderr)
+        return 2
+    coll = obs.trace.TraceCollector(args.traces)
+    coll.load()
+    traces = coll.traces()
+    if not traces:
+        print('slo_report: no span spills under %r' % args.traces,
+              file=sys.stderr)
+        return 2
+    if args.trace is not None:
+        if args.trace not in traces:
+            print('slo_report: no trace %r (have: %s)'
+                  % (args.trace, ', '.join(sorted(traces))),
+                  file=sys.stderr)
+            return 2
+        ids = [args.trace]
+    else:
+        ids = sorted(traces)
+    timelines = [coll.timeline(tid) for tid in ids]
+    orphaned = 0
+    for tl in timelines:
+        print(render_timeline(tl))
+        print()
+        orphaned += len(tl['orphans'])
+    print('%d trace(s), %d span(s), %d orphan(s)'
+          % (len(timelines), sum(len(t['spans']) for t in timelines),
+             orphaned))
+
+    if not args.budgets:
+        return 0
+    events = None
+    if args.runlog:
+        if not os.path.exists(args.runlog):
+            print('slo_report: run log %r does not exist' % args.runlog,
+                  file=sys.stderr)
+            return 2
+        events, errors = obs.report.load_events(args.runlog)
+        for where, why, _raw in errors:
+            print('MALFORMED %s: %s' % (where, why), file=sys.stderr)
+    try:
+        budget = obs.slo.SloBudget.from_file(args.budgets)
+    except (OSError, ValueError) as e:
+        print('slo_report: cannot load budgets %r: %s'
+              % (args.budgets, e), file=sys.stderr)
+        return 2
+    result = budget.evaluate(
+        events=events, measured=trace_measurements(obs, timelines),
+        strict_missing=args.strict_missing)
+    print()
+    for line in result.lines():
+        print(line)
+    return 0 if result.passed else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())
