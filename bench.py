@@ -895,6 +895,117 @@ def bench_streaming(capacity=None, embed_dim=None, fields=4, batch=64,
     }
 
 
+def bench_tiered(capacity=None, embed_dim=None, fields=4, batch=32,
+                 steps=None):
+    """Tiered-embedding-storage phase (docs/embedding.md#tiers): a
+    zipf stream whose id UNIVERSE is 8x the HBM row budget drives
+    constant eviction. The A leg wraps the table in a TieredVocabTable
+    (evictions SPILL row + optimizer moments into a host arena, warm
+    re-admissions RESTORE bit-exactly), the B leg is today's plain
+    zeroing VocabTable over the SAME drift stream — the delta between
+    the two steps/sec numbers is what the tier costs, and the hit rate
+    is what it buys. Also emits restore p50/p99 latency (from the
+    table's bounded sample ring) and asserts zero steady-state
+    compiles: the spill/restore dispatches are fixed-signature,
+    bucket-padded like RowResetter."""
+    import tempfile
+
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.trainer import Trainer
+    from paddle_tpu.embedding import pad_vocab
+    from paddle_tpu.streaming import (TieredVocabTable, VocabTable,
+                                      host_arena)
+    from paddle_tpu.obs.report import percentile_exact
+
+    ndev = len(jax.devices())
+    if capacity is None:
+        capacity = int(os.environ.get('BENCH_TIER_CAPACITY', '256'))
+    if embed_dim is None:
+        embed_dim = int(os.environ.get('BENCH_TIER_DIM', '8'))
+    if steps is None:
+        steps = int(os.environ.get('BENCH_TIER_STEPS', '40'))
+    capacity = pad_vocab(capacity, ndev)
+    universe = 8 * capacity            # the 8x HBM-row-budget id space
+
+    def train_func():
+        ids = fluid.layers.data(name='ids', shape=[fields, 1],
+                                dtype='int64')
+        label = fluid.layers.data(name='label', shape=[1],
+                                  dtype='float32')
+        emb = fluid.layers.embedding(
+            ids, size=[capacity, embed_dim], is_sparse=True,
+            is_distributed=True,
+            param_attr=fluid.ParamAttr(name='emb_w',
+                                       sharding=('model', None)))
+        pred = fluid.layers.fc(input=emb, size=1, num_flatten_dims=2,
+                               param_attr=fluid.ParamAttr(name='fc_w'))
+        score = fluid.layers.reduce_sum(pred, dim=1)
+        loss = fluid.layers.mean(fluid.layers.square(score - label))
+        return [loss]
+
+    def make_reader():
+        rng = np.random.RandomState(0)
+
+        def reader():
+            t = 0
+            while True:
+                # drifting zipf: the hot set moves, so eviction AND
+                # warm re-admission both run continuously
+                base = (t * 13) % universe
+                ids = (base + rng.zipf(1.3, size=(batch, fields, 1))) \
+                    % universe
+                label = rng.randn(batch, 1).astype('float32')
+                yield [(ids.astype('int64')[i], label[i])
+                       for i in range(batch)]
+                t += 1
+        return reader
+
+    def leg(make_vt):
+        vt = make_vt()
+        trainer = Trainer(train_func,
+                          lambda: fluid.optimizer.Adam(
+                              learning_rate=1e-2))
+        trainer.train_program.set_mesh({'model': ndev})
+        reader = make_reader()
+        # warm the signatures (2 steps), then time the steady state
+        trainer.train_stream(reader, vocabs={'ids': vt}, max_steps=2)
+        misses0 = trainer.exe.cache_stats['misses']
+        t0 = time.time()
+        trainer.train_stream(reader, vocabs={'ids': vt},
+                             max_steps=steps)
+        dt = time.time() - t0
+        steady = trainer.exe.cache_stats['misses'] - misses0
+        return vt, steps / dt, int(steady)
+
+    arena_dir = tempfile.mkdtemp(prefix='bench_tier_arena_')
+    tt, tiered_sps, tiered_compiles = leg(
+        lambda: TieredVocabTable(
+            VocabTable(capacity, table='emb_w', admit_count=2),
+            host_arena(arena_dir, slots=universe)))
+    _vt, plain_sps, _plain_compiles = leg(
+        lambda: VocabTable(capacity, table='emb_w', admit_count=2))
+
+    samples = list(tt.restore_ms_samples)
+    st = tt.stats()
+    return {
+        'tiered_steps_per_sec': tiered_sps,
+        'untiered_steps_per_sec': plain_sps,
+        'hit_rate': tt.hit_rate(),
+        'restore_p50_ms': percentile_exact(samples, 50)
+        if samples else None,
+        'restore_p99_ms': percentile_exact(samples, 99)
+        if samples else None,
+        'spilled': st['spilled'], 'restored': st['restored'],
+        'dropped_full': st['dropped_full'],
+        'rows_admitted': st['rows_admitted'],
+        'rows_evicted': st['rows_evicted'],
+        'steady_compiles': tiered_compiles,
+        'capacity': capacity, 'universe': universe,
+        'batch': batch, 'steps': steps, 'mesh': {'model': ndev},
+    }
+
+
 def bench_flash_longcontext(seq_len=32768, heads=8, dim=64, warmup=1,
                             iters=2):
     """Causal flash attention fwd+bwd at 32k context on ONE chip — the
@@ -980,6 +1091,14 @@ NAME_O_CK = 'fit_a_line_ckpt_async_train_steps_per_sec'
 NAME_S_SPS = 'streaming_online_train_steps_per_sec'
 NAME_S_LAG = 'streaming_freshness_lag_s'
 NAME_S_PUSH = 'streaming_delta_push_ms'
+# tiered-storage phase: the rate metric rides bench_sentinel's
+# *_hit_rate absolute-delta rule, the latency ones its _ms
+# lower-is-better rule — no sentinel change needed
+NAME_TI_SPS = 'streaming_tiered_train_steps_per_sec'
+NAME_TI_UNT = 'streaming_untiered_train_steps_per_sec'
+NAME_TI_HIT = 'streaming_tier_hit_rate'
+NAME_TI_P50 = 'streaming_tier_restore_p50_ms'
+NAME_TI_P99 = 'streaming_tier_restore_p99_ms'
 PHASES = ('transformer', 'resnet', 'bundle', 'gspmd', 'embedding',
           'longseq', 'longctx')
 PHASE_NAMES = {'transformer': NAME_T, 'resnet': NAME_R, 'bundle': NAME_B,
@@ -1030,7 +1149,8 @@ def run_phase(phase, platform):
     process — the parent's timeout fires, and later phases still run."""
     _PLATFORM[0] = platform
     _FALLBACK[0] = os.environ.get('BENCH_FALLBACK') == '1'
-    if phase in ('gspmd', 'embedding', 'streaming') and platform != 'tpu':
+    if phase in ('gspmd', 'embedding', 'streaming',
+                 'tiered') and platform != 'tpu':
         # the 8-device CPU mesh (the same platform the MULTICHIP dryruns
         # and tests use), with per-device eigen threading off so each
         # virtual device approximates a fixed-capacity chip. Must land
@@ -1239,6 +1359,59 @@ def run_phase(phase, platform):
         except Exception as e:
             _log('streaming phase failed: %r' % e)
             _emit({'metric': NAME_S_SPS, 'skipped': True,
+                   'error': str(e)[:300]})
+    elif phase == 'tiered':
+        # tiered embedding storage (docs/embedding.md#tiers): zipf
+        # drift over an id universe 8x the HBM row budget, tiered vs
+        # untiered A/B over the same stream. Host-side machinery plus
+        # two fixed-signature device dispatches, so CPU numbers are
+        # VALID; hit rate rides the sentinel's *_hit_rate rule, the
+        # restore percentiles its _ms lower-is-better rule.
+        try:
+            res = bench_tiered()
+            mesh = res['mesh']
+            common = {'platform': platform, 'mesh': mesh,
+                      'mesh_shape': 'x'.join(
+                          '%s=%d' % kv for kv in sorted(mesh.items())),
+                      'capacity': res['capacity'],
+                      'universe': res['universe'],
+                      'batch': res['batch'], 'steps': res['steps']}
+            _emit(dict({'metric': NAME_TI_SPS,
+                        'value': round(res['tiered_steps_per_sec'], 2),
+                        'unit': 'steps/sec',
+                        'spilled': res['spilled'],
+                        'restored': res['restored'],
+                        'dropped_full': res['dropped_full'],
+                        'rows_admitted': res['rows_admitted'],
+                        'rows_evicted': res['rows_evicted'],
+                        'steady_compiles': res['steady_compiles']},
+                       **common))
+            _emit(dict({'metric': NAME_TI_UNT,
+                        'value': round(res['untiered_steps_per_sec'],
+                                       2),
+                        'unit': 'steps/sec'}, **common))
+            _emit(dict({'metric': NAME_TI_HIT,
+                        'value': round(res['hit_rate'], 4),
+                        'unit': 'rate'}, **common))
+            if res['restore_p50_ms'] is not None:
+                _emit(dict({'metric': NAME_TI_P50,
+                            'value': round(res['restore_p50_ms'], 3),
+                            'unit': 'ms'}, **common))
+            if res['restore_p99_ms'] is not None:
+                _emit(dict({'metric': NAME_TI_P99,
+                            'value': round(res['restore_p99_ms'], 3),
+                            'unit': 'ms'}, **common))
+            if res['steady_compiles']:
+                _log('*** tiered: %d steady-state compile(s) — the '
+                     'fixed-signature spill/restore contract broke ***'
+                     % res['steady_compiles'])
+            if res['dropped_full']:
+                _log('*** tiered: %d arena-full fallback(s) — size '
+                     'the arena to the universe ***'
+                     % res['dropped_full'])
+        except Exception as e:
+            _log('tiered phase failed: %r' % e)
+            _emit({'metric': NAME_TI_SPS, 'skipped': True,
                    'error': str(e)[:300]})
     elif phase == 'overlap':
         # pipeline-overlap contract metrics (docs/perf.md#overlap):

@@ -23,9 +23,15 @@ Functional surface (usable outside Programs too): `sharded_lookup`,
 `gather_table` helpers for building and exporting sharded models.
 """
 from .lookup import sharded_lookup, dedup_plan, pad_vocab, wire_stats
+from .tiers import (ArenaCorrupt, ArenaFull, DimShardingUnsupported,
+                    HostArena, RowRestorer, RowSpiller, TieredVocabTable,
+                    host_arena)
 
 __all__ = ['sharded_lookup', 'dedup_plan', 'pad_vocab', 'wire_stats',
-           'table_attr', 'gather_table']
+           'table_attr', 'gather_table',
+           'HostArena', 'TieredVocabTable', 'RowSpiller', 'RowRestorer',
+           'ArenaFull', 'ArenaCorrupt', 'DimShardingUnsupported',
+           'host_arena']
 
 
 def table_attr(name, axis='model', **kwargs):

@@ -871,6 +871,11 @@ class Trainer(object):
             if vt.table:
                 reset_names[fname] = table_state_names(
                     self.train_program, vt.table)
+                if hasattr(vt, 'validate_program'):
+                    # tiered tables refuse a dim-sharded table TYPED
+                    # (a spill would tear rows across hosts) — before
+                    # any step runs, not on the first eviction
+                    vt.validate_program(self.train_program)
 
         leases = {}   # step_id -> [Lease] (writer: input stage;
         #               reader: the loop after that step completes)
@@ -896,10 +901,31 @@ class Trainer(object):
         def apply_resets():
             # zero evicted rows (table + moments) BEFORE the step that
             # trains their new owners dispatches — stale moments would
-            # bleed the previous occupant's history into the new id
+            # bleed the previous occupant's history into the new id.
+            # A tiered table (embedding.tiers.TieredVocabTable) owns
+            # its boundary instead: evictions SPILL to the host arena,
+            # warm re-admissions RESTORE — and it reports the rows it
+            # mutated so the delta publisher keeps serving replicas
+            # converged across a spill/restore cycle.
+            changed = None
             for fname, vt in vocabs.items():
                 names = reset_names.get(fname)
                 if not names:
+                    continue
+                if hasattr(vt, 'apply_step_boundary'):
+                    ch = vt.apply_step_boundary(
+                        self.scope._chain_get, self.scope._chain_set,
+                        names)
+                    if ch:
+                        changed = changed or {}
+                        for t, rows in ch.items():
+                            prev = changed.get(t)
+                            if prev is None:
+                                changed[t] = rows
+                            else:
+                                changed[t] = sorted(
+                                    {int(r) for r in prev}
+                                    | {int(r) for r in rows})
                     continue
                 rows = vt.drain_resets()
                 if not rows:
@@ -908,6 +934,7 @@ class Trainer(object):
                 new = resetter.reset(arrays, rows)
                 for n, a in zip(names, new):
                     self.scope._chain_set(n, a)
+            return changed
 
         steps_run = 0
         started_hb = False
@@ -939,7 +966,7 @@ class Trainer(object):
                             self._finish_preemption(last_done)
                             return steps_run
                         self._check_host_loss(last_done)
-                        apply_resets()
+                        tier_changed = apply_resets()
                         begin = BeginStepEvent(0, step_id)
                         event_handler(begin)
                         want = fetch if begin.fetch_metrics else []
@@ -957,7 +984,8 @@ class Trainer(object):
                             lease.release()
                         if publisher is not None:
                             self._stream_publish(publisher, fed, want,
-                                                 warned_dense, vocabs)
+                                                 warned_dense, vocabs,
+                                                 extra_rows=tier_changed)
                         if cfg:
                             due = (step_id > 0 and step_id
                                    % cfg.step_interval == 0)
@@ -969,6 +997,13 @@ class Trainer(object):
                                 self._save_checkpoint(0, step_id,
                                                       force=True)
                                 last_ckpt_t = _time.monotonic()
+                                for vt in vocabs.values():
+                                    if hasattr(vt, 'mark_checkpoint'):
+                                        # a committed serial no longer
+                                        # references slots released
+                                        # before it: recycle the
+                                        # arena's limbo list
+                                        vt.mark_checkpoint()
                         event_handler(EndStepEvent(0, step_id, metrics))
                         if self._preempt_requested:
                             self._finish_preemption(last_done)
@@ -989,11 +1024,15 @@ class Trainer(object):
             self._stream_vocabs = None
             self._stream_art = None
 
-    def _stream_publish(self, publisher, fed, fetch, warned_dense, vocabs):
+    def _stream_publish(self, publisher, fed, fetch, warned_dense, vocabs,
+                        extra_rows=None):
         """Collect this step's touched rows (host-side seam) and run the
-        publisher's cadence. Serving-side failures warn and retry next
-        cadence; the typed HostLost propagates — that is a pod event,
-        not a publishing hiccup."""
+        publisher's cadence. `extra_rows` ({table: rows}) carries rows
+        the TIER boundary mutated outside the batch — zeroed on spill,
+        scattered on restore — so serving replicas converge on them
+        too. Serving-side failures warn and retry next cadence; the
+        typed HostLost propagates — that is a pod event, not a
+        publishing hiccup."""
         import warnings
         from ..parallel.heartbeat import HostLost
         # resolve the artifact ONCE per fetch set, not per step:
@@ -1026,6 +1065,16 @@ class Trainer(object):
                     'is_sparse=True (docs/embedding.md)' % (t, fname),
                     RuntimeWarning)
         touched = art.touched_rows(fed)
+        if extra_rows:
+            import numpy as _np
+            touched = dict(touched or {})
+            for t, rows in extra_rows.items():
+                merged = {int(r) for r in rows}
+                prev = touched.get(t)
+                if prev is not None:
+                    merged.update(
+                        int(r) for r in _np.asarray(prev).reshape(-1))
+                touched[t] = _np.asarray(sorted(merged), _np.int64)
         if touched:
             publisher.collect(touched)
         try:

@@ -442,6 +442,48 @@ def summarize(events):
                             f.get('replicas', '?'), f.get('closed', 0),
                             ','.join(f.get('tables', []) or ['?'])))
 
+    # -- tiers ------------------------------------------------------------
+    # the host-RAM spill tier behind the HBM table (docs/embedding.md
+    # #tiers): spill/restore traffic, the warm-restore prefetch leg,
+    # and the two LOUD fallbacks (arena full, CRC-failed slot)
+    t_spills = _events(events, 'streaming.tier.spill')
+    t_restores = _events(events, 'streaming.tier.restore')
+    t_prefetch = _events(events, 'streaming.tier.prefetch')
+    t_full = _events(events, 'streaming.tier.arena_full')
+    t_corrupt = _events(events, 'streaming.tier.corrupt')
+    if t_spills or t_restores or t_prefetch or t_full or t_corrupt:
+        lines.append('')
+        lines.append('-- tiers --')
+        n_sp = sum(int(e.get('fields', {}).get('rows', 0) or 0)
+                   for e in t_spills)
+        n_re = sum(int(e.get('fields', {}).get('rows', 0) or 0)
+                   for e in t_restores)
+        n_pf = sum(int(e.get('fields', {}).get('rows', 0) or 0)
+                   for e in t_prefetch)
+        lines.append('spill tier: %d row(s) spilled to host, %d '
+                     'restored warm (%d prefetched on the worker)'
+                     % (n_sp, n_re, n_pf))
+        if t_spills:
+            f = t_spills[-1].get('fields', {})
+            lines.append('arena: %s/%s slots used (last spill %s ms)'
+                         % (f.get('arena_used', '?'),
+                            f.get('arena_slots', '?'),
+                            f.get('spill_ms', '?')))
+        if t_restores:
+            f = t_restores[-1].get('fields', {})
+            lines.append('last restore: %s row(s) in %s ms'
+                         % (f.get('rows', '?'), f.get('restore_ms', '?')))
+        if t_full:
+            n_drop = sum(int(e.get('fields', {}).get('dropped', 0) or 0)
+                         for e in t_full)
+            lines.append('ARENA FULL: %d evicted id(s) fell back to '
+                         'zeroing (cold re-admit) — provision slots'
+                         % n_drop)
+        if t_corrupt:
+            lines.append('CORRUPT SLOTS: %d spilled row(s) failed CRC '
+                         'and were dropped (cold re-admit)'
+                         % len(t_corrupt))
+
     # -- anomaly guard ---------------------------------------------------
     skips = _events(events, 'anomaly.skip')
     lines.append('')

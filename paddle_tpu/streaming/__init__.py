@@ -17,10 +17,22 @@ continuously publish to live serving. Three legs:
     running `ServingEngine`/`DecodeEngine` replicas via
     `Router.push_deltas` — per-row scatter instead of full-artifact
     swap().
+
+The HBM capacity ceiling behind `VocabTable` is lifted by the TIER
+STORE (`paddle_tpu.embedding.tiers`, docs/embedding.md#tiers):
+`TieredVocabTable` + `HostArena` spill evicted rows (+ optimizer
+moments) to host RAM and restore them bit-exactly on re-admission —
+re-exported here because they duck-type the `VocabTable` surface this
+package defines.
 """
 from .publish import DeltaPublisher
 from .vocab import (Lease, RowPinned, RowResetter, VocabFull, VocabTable,
                     table_state_names)
+from ..embedding.tiers import (ArenaCorrupt, ArenaFull,
+                               DimShardingUnsupported, HostArena,
+                               TieredVocabTable, host_arena)
 
 __all__ = ['VocabTable', 'DeltaPublisher', 'RowResetter', 'Lease',
-           'RowPinned', 'VocabFull', 'table_state_names']
+           'RowPinned', 'VocabFull', 'table_state_names',
+           'TieredVocabTable', 'HostArena', 'ArenaFull', 'ArenaCorrupt',
+           'DimShardingUnsupported', 'host_arena']

@@ -138,6 +138,11 @@ class VocabTable(object):
         # elements per new id once the bound is hit)
         self._pending_order = collections.deque()
         self._resets = []                # evicted rows awaiting zeroing
+        # admission/eviction MOVE log for the tier store
+        # (embedding.tiers.TieredVocabTable): disabled by default so a
+        # plain table never accumulates an undrained list
+        self._log_moves = False
+        self._moves = []                 # [('admit'|'evict', raw, row)]
         # cumulative stats (the obs counters carry process-wide twins)
         self.rows_admitted = 0
         self.rows_evicted = 0
@@ -243,6 +248,8 @@ class VocabTable(object):
         self._map.insert(raw, row)
         self.rows_admitted += 1
         admitted.append(raw)
+        if self._log_moves:
+            self._moves.append(('admit', raw, row))
         return row
 
     def _claim_row_locked(self, evicted):
@@ -255,6 +262,8 @@ class VocabTable(object):
         self._resets.append(old_row)
         self.rows_evicted += 1
         evicted.append(old_id)
+        if self._log_moves:
+            self._moves.append(('evict', old_id, old_row))
         return old_row
 
     def _release(self, raw_ids):
@@ -281,6 +290,8 @@ class VocabTable(object):
                 row = self._free.pop()
                 self._map.insert(raw, row)
                 self.rows_admitted += 1
+                if self._log_moves:
+                    self._moves.append(('admit', raw, row))
         return self
 
     def evict(self, raw_id):
@@ -301,7 +312,12 @@ class VocabTable(object):
                     % (raw_id, self.name))
             row = self._map.pop(raw_id)
             self._resets.append(row)
+            # the freed row re-enters circulation (it used to leak:
+            # a forced evict permanently lost one row of capacity)
+            self._free.append(row)
             self.rows_evicted += 1
+            if self._log_moves:
+                self._moves.append(('evict', raw_id, row))
         _C_EVICTED.inc()
         obs.event('streaming.evict', vocab=self.name, rows=1,
                   sample=[raw_id], resident=len(self._map), forced=True)
@@ -313,6 +329,15 @@ class VocabTable(object):
         step that trains their new owners."""
         with self._lock:
             out, self._resets = self._resets, []
+        return out
+
+    def drain_moves(self):
+        """Ordered admission/eviction moves since the last drain —
+        empty unless `_log_moves` was switched on by the tier store
+        (`embedding.tiers.TieredVocabTable`), which turns evictions
+        into SPILLS and warm admissions into RESTORES."""
+        with self._lock:
+            out, self._moves = self._moves, []
         return out
 
     def resident_ids(self):

@@ -1,0 +1,571 @@
+"""Tiered embedding storage (docs/embedding.md#tiers).
+
+The host-RAM spill tier behind the HBM table — `HostArena` +
+`TieredVocabTable` (paddle_tpu/embedding/tiers.py):
+
+  * the arena: preallocated mmap-backed slot store, bit-exact put/peek
+    round trip, free-list recycling gated on checkpoint marks, the
+    atomic-replace manifest (+ .sum sidecar) torn-write drills;
+  * the REGRESSION the tier exists to fix: today's evict -> re-admit
+    cycle zeroes a row's trained state (row AND optimizer moments) —
+    the tiered twin restores both bit-exactly;
+  * the trainer seam: spill/restore at the step boundary through ONE
+    gather+zero and ONE scatter fixed-signature dispatch (zero steady
+    compiles), prefetch on the double-buffer worker, checkpoint/resume
+    carrying the arena spill map exactly, the publisher seeing every
+    device-mutated row;
+  * the loud fallbacks: arena-full -> zeroing with a typed event +
+    warning (never a silent wrong row), CRC-failed slot -> dropped
+    loudly; dim-sharded tables refused typed (ROADMAP leftover);
+  * the acceptance drill: a zipf stream over a table 8x the HBM row
+    budget — the tiered loss trajectory is BIT-exact vs a no-eviction
+    reference, while the plain-vocab leg diverges on re-admission.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.trainer import CheckpointConfig, Trainer
+from paddle_tpu.streaming import (ArenaCorrupt, ArenaFull, DeltaPublisher,
+                                  DimShardingUnsupported, HostArena,
+                                  TieredVocabTable, VocabTable, host_arena,
+                                  table_state_names)
+
+from test_streaming import (CAP, DIM, FIELDS, _SinkEngine, _batches, _opt,
+                            _stream_reader, _train_func)
+
+pytestmark = pytest.mark.tiered
+
+
+def _vecs(k, n_arrays=3, dim=DIM):
+    """Distinct, reproducible per-id row vectors."""
+    return [np.full((dim,), k * 10.0 + i, np.float32)
+            for i in range(n_arrays)]
+
+
+def _arena(tmp_path, slots=8, sub='arena'):
+    return HostArena(str(tmp_path / sub), slots)
+
+
+# ---------------------------------------------------------------------------
+# HostArena: the slot store
+# ---------------------------------------------------------------------------
+
+def test_arena_roundtrip_bit_exact_and_checkpoint_gated_recycle(tmp_path):
+    a = _arena(tmp_path, slots=4)
+    assert a.put_many([(42, _vecs(42))]) == []
+    got = a.peek(42)
+    for x, y in zip(got, _vecs(42)):
+        np.testing.assert_array_equal(x, y)
+    assert 42 in a and len(a) == 1
+    a.discard_many([42])
+    assert 42 not in a
+    # the released slot sits in LIMBO: the last committed serial may
+    # still reference it, so it recycles only after a checkpoint mark
+    assert a.put_many([(i, _vecs(i)) for i in range(3)]) == []
+    assert a.put_many([(99, _vecs(99))]) == [99]
+    a.mark_checkpoint()
+    assert a.put_many([(99, _vecs(99))]) == []
+    st = a.stats()
+    assert st['used'] == 4 and st['free'] == 0 and st['limbo'] == 0
+
+
+def test_arena_full_typed_and_mixed_dtype_rejected(tmp_path):
+    a = _arena(tmp_path, slots=1)
+    a.put(7, _vecs(7))
+    with pytest.raises(ArenaFull, match='no free slot'):
+        a.put(8, _vecs(8))
+    b = _arena(tmp_path, slots=2, sub='b')
+    with pytest.raises(ValueError, match='mixed dtypes'):
+        b.put(1, [np.zeros(DIM, np.float32), np.zeros(DIM, np.float64)])
+
+
+def test_arena_snapshot_roundtrip_and_geometry_mismatch(tmp_path):
+    a = _arena(tmp_path, slots=4)
+    a.put_many([(5, _vecs(5)), (6, _vecs(6))])
+    snap = a.snapshot()
+    json.dumps(snap)                       # checkpoint-meta JSON-able
+    b = HostArena(a.path, slots=4)
+    b.discard_many([5, 6])                 # drift b away from the snap
+    b.load_snapshot(snap)                  # ...then restore it exactly
+    assert sorted(b._entries) == [5, 6]
+    for x, y in zip(b.peek(6), _vecs(6)):
+        np.testing.assert_array_equal(x, y)
+    c = _arena(tmp_path, slots=9, sub='c')
+    with pytest.raises(ValueError, match='geometry mismatch'):
+        c.load_snapshot(snap)
+
+
+def test_arena_reopen_adopts_committed_manifest_bit_exact(tmp_path):
+    a = _arena(tmp_path, slots=4)
+    a.put_many([(5, _vecs(5)), (6, _vecs(6))])
+    b = HostArena(a.path, slots=4)         # same dir: standalone reopen
+    assert sorted(b._entries) == [5, 6]
+    for x, y in zip(b.peek(5), _vecs(5)):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_host_arena_path_is_per_process(tmp_path):
+    a = host_arena(str(tmp_path / 'tier'), slots=2)
+    assert os.path.basename(a.path) == 'h0'   # single-process: index 0
+
+
+# ---------------------------------------------------------------------------
+# fault drills: torn writes against the arena (satellite: SIGKILL mid-spill)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.faults
+@pytest.mark.parametrize('what', ['truncate_manifest', 'corrupt_manifest'])
+def test_arena_torn_manifest_typed_on_reopen(tmp_path, what):
+    """A torn/bit-rotted manifest NEVER adopts silently: the .sum
+    sidecar exposes it as the typed ArenaCorrupt (FaultInjector's
+    checkpoint tear modes work unmodified against the arena dir —
+    same manifest.json + .sum + .npy layout)."""
+    from paddle_tpu.utils.faults import FaultInjector
+    a = _arena(tmp_path, slots=4)
+    a.put_many([(5, _vecs(5))])
+    FaultInjector(seed=0).torn_checkpoint(a.path, what=what)
+    with pytest.raises(ArenaCorrupt):
+        HostArena(a.path, slots=4)
+
+
+@pytest.mark.faults
+def test_arena_dropped_manifest_adopts_empty_never_torn_slots(tmp_path):
+    """Crash BEFORE the first manifest commit (or its loss): the data
+    file alone proves nothing — the arena adopts EMPTY; uncommitted
+    slots are never adoptable."""
+    from paddle_tpu.utils.faults import FaultInjector
+    a = _arena(tmp_path, slots=4)
+    a.put_many([(5, _vecs(5))])
+    FaultInjector(seed=0).torn_checkpoint(a.path, what='drop_manifest')
+    b = HostArena(a.path, slots=4)
+    assert len(b) == 0 and b.peek(5) is None
+
+
+@pytest.mark.faults
+def test_arena_truncated_data_file_fails_crc_loudly(tmp_path):
+    """Slot data torn under a valid manifest: the per-slot CRC refuses
+    to serve it — typed, never a silently wrong row."""
+    from paddle_tpu.utils.faults import FaultInjector
+    a = _arena(tmp_path, slots=4)
+    a.put_many([(5, _vecs(5))])
+    FaultInjector(seed=0).torn_checkpoint(a.path, what='truncate_shard')
+    b = HostArena(a.path, slots=4)         # manifest itself verifies
+    with pytest.raises(ArenaCorrupt, match='CRC32'):
+        b.peek(5)
+
+
+@pytest.mark.faults
+def test_arena_sigkill_mid_spill_uncommitted_slot_not_adopted(tmp_path):
+    """SIGKILL between the slot write and the manifest commit: on
+    resume the committed manifest still rules — the half-written slot
+    is unreferenced (invisible), the committed entries intact."""
+    a = _arena(tmp_path, slots=4)
+    a.put_many([(5, _vecs(5))])
+    # simulate the kill: scribble a new id's bytes straight into a free
+    # slot of the data file WITHOUT a manifest commit
+    mm = np.lib.format.open_memmap(a._data_path(), mode='r+')
+    free_slot = a._free[-1]
+    mm[free_slot, :, :] = 777.0
+    mm.flush()
+    del mm
+    b = HostArena(a.path, slots=4)
+    assert sorted(b._entries) == [5]       # the torn slot never adopted
+    for x, y in zip(b.peek(5), _vecs(5)):
+        np.testing.assert_array_equal(x, y)
+
+
+# ---------------------------------------------------------------------------
+# the regression the tier fixes, and its tiered twin
+# ---------------------------------------------------------------------------
+
+def _train_phase(t, tt, ids_seq):
+    """One batch per id in ids_seq (the eviction-drill shape)."""
+    b = [[(np.full((FIELDS, 1), i, 'int64'), np.ones((1,), 'float32'))]
+         for i in ids_seq]
+    t.train_stream(_stream_reader(b), vocabs={'ids': tt})
+
+
+def test_regression_plain_vocab_evict_readmit_loses_trained_state():
+    """The drill that motivates the tier: with a PLAIN VocabTable,
+    evict -> re-admit zeroes the id's trained row and moments — hours
+    of training on that id are gone (the tiered twin below restores
+    them bit-exactly)."""
+    vt = VocabTable(capacity=4, table='emb_w', admit_count=1)
+    t = Trainer(_train_func, _opt)
+    _train_phase(t, vt, (1, 2, 3))
+    names = table_state_names(t.train_program, 'emb_w')
+    row1 = int(vt.lookup([1])[0])
+    saved = {n: np.asarray(t.scope._chain_get(n))[row1].copy()
+             for n in names}
+    assert any(np.abs(saved[n]).max() > 0 for n in names)
+    _train_phase(t, vt, (9,))              # evicts LRU id 1
+    assert vt.rows_evicted == 1
+    # re-admit id 1: translate + boundary zeroing (no training step, so
+    # the restored-or-zeroed state is inspectable)
+    rows, lease = vt.translate([1])
+    lease.release()
+    for row in vt.drain_resets():
+        for n in names:
+            arr = np.array(t.scope._chain_get(n))
+            arr[row] = 0
+            t.scope._chain_set(n, arr)
+    new_row = int(vt.lookup([1])[0])
+    for n in names:
+        got = np.asarray(t.scope._chain_get(n))[new_row]
+        assert not np.array_equal(got, saved[n]) or \
+            np.abs(saved[n]).max() == 0
+    # the row is plain zeros: the trained state is LOST
+    assert all(np.abs(np.asarray(t.scope._chain_get(n))[new_row]
+                      ).max() == 0 for n in names)
+
+
+def test_tiered_evict_readmit_restores_row_and_moments_bit_exact(tmp_path):
+    """The tiered twin: eviction SPILLS the row + every optimizer
+    moment into the arena; re-admission restores all of them
+    bit-exactly (names from table_state_names — nothing hardcodes
+    adam)."""
+    vt = VocabTable(capacity=4, table='emb_w', admit_count=1)
+    tt = TieredVocabTable(vt, _arena(tmp_path, slots=16))
+    t = Trainer(_train_func, _opt)
+    _train_phase(t, tt, (1, 2, 3))
+    names = table_state_names(t.train_program, 'emb_w')
+    assert len(names) >= 3                 # table + adam moments
+    row1 = int(vt.lookup([1])[0])
+    saved = {n: np.asarray(t.scope._chain_get(n))[row1].copy()
+             for n in names}
+    assert any(np.abs(saved[n]).max() > 0 for n in names if n != 'emb_w')
+    _train_phase(t, tt, (9,))              # evicts id 1 -> spilled
+    assert vt.rows_evicted == 1 and 1 in tt.arena
+    np.testing.assert_array_equal(          # HBM row was zeroed...
+        np.asarray(t.scope._chain_get('emb_w'))[row1] * 0,
+        np.zeros(DIM, np.float32))
+    rows, lease = tt.translate(np.full((FIELDS, 1), 1, 'int64'))
+    lease.release()
+    tt.apply_step_boundary(t.scope._chain_get, t.scope._chain_set, names)
+    new_row = int(vt.lookup([1])[0])
+    for n in names:                        # ...and restored bit-exact
+        np.testing.assert_array_equal(
+            np.asarray(t.scope._chain_get(n))[new_row], saved[n])
+    assert 1 not in tt.arena               # slot released (to limbo)
+    assert tt.tier_hits >= 1 and tt.restored >= 1
+
+
+def test_tiered_same_window_evict_and_readmit_restores_exact(tmp_path):
+    """Evict + re-admit inside ONE prefetch window (no boundary in
+    between): the restore resolves against the spill that lands in the
+    same apply_step_boundary call — state survives exactly."""
+    vt = VocabTable(capacity=4, table='w', admit_count=1)
+    tt = TieredVocabTable(vt, _arena(tmp_path, slots=8))
+    store = {'w': np.arange(16, dtype=np.float32).reshape(4, 4),
+             'm': np.arange(16, 32, dtype=np.float32).reshape(4, 4)}
+    read = store.__getitem__
+
+    def write(n, v):
+        store[n] = np.asarray(v)
+
+    r, l = tt.translate([1, 2, 3])
+    l.release()
+    tt.apply_step_boundary(read, write, ['w', 'm'])
+    row1 = int(vt.lookup([1])[0])
+    saved = (store['w'][row1].copy(), store['m'][row1].copy())
+    r, l = tt.translate([9])               # evicts id 1
+    l.release()
+    r, l = tt.translate([1])               # re-admits id 1 (evicts 2)
+    l.release()
+    ch = tt.apply_step_boundary(read, write, ['w', 'm'])
+    new_row = int(vt.lookup([1])[0])
+    np.testing.assert_array_equal(store['w'][new_row], saved[0])
+    np.testing.assert_array_equal(store['m'][new_row], saved[1])
+    assert new_row in set(int(x) for x in ch['w'])
+    assert tt.tier_hits >= 1
+
+
+# ---------------------------------------------------------------------------
+# loud fallbacks: arena full, dim sharding
+# ---------------------------------------------------------------------------
+
+def test_tiered_arena_full_falls_back_to_zeroing_loudly(tmp_path):
+    """Arena exhausted: the evicted id falls back to the OLD zeroing
+    path — typed event + RuntimeWarning + counted, never a silently
+    wrong (stale or unzeroed) row."""
+    from paddle_tpu import obs
+    obs.enable(str(tmp_path / 'obs'))
+    try:
+        vt = VocabTable(capacity=4, table='w', admit_count=1)
+        tt = TieredVocabTable(vt, _arena(tmp_path, slots=1))
+        store = {'w': np.arange(16, dtype=np.float32).reshape(4, 4)}
+        read = store.__getitem__
+
+        def write(n, v):
+            store[n] = np.asarray(v)
+
+        r, l = tt.translate([1, 2, 3])
+        l.release()
+        tt.apply_step_boundary(read, write, ['w'])
+        r, l = tt.translate([7, 8])        # two evictions, one slot
+        l.release()
+        with pytest.warns(RuntimeWarning, match='FULL'):
+            tt.apply_step_boundary(read, write, ['w'])
+        assert tt.dropped_full == 1 and len(tt.arena) == 1
+        # both evicted rows were still ZEROED (the spill dispatch is
+        # gather+zero regardless of whether the arena kept the gather)
+        for raw in (7, 8):
+            row = int(vt.lookup([raw])[0])
+            np.testing.assert_array_equal(store['w'][row],
+                                          np.zeros(4, np.float32))
+        from paddle_tpu.obs import report as obs_report
+        events, errors = obs_report.load_events(obs.run_log_path())
+        assert errors == []
+        assert 'streaming.tier.arena_full' in [e['name'] for e in events]
+    finally:
+        obs._reset()
+
+
+def test_tiered_dim_sharded_table_refused_typed():
+    """Column (dim) sharding spills would tear rows across hosts —
+    out of scope (ROADMAP item 3 leftover), refused TYPED at
+    train_stream entry, not silently mis-spilled."""
+    vt = VocabTable(capacity=4, table='emb_w', admit_count=1)
+    tt = TieredVocabTable(vt, HostArena('/tmp/unused-dimshard', 2))
+    t = Trainer(_train_func, _opt)
+    t.train_stream(_stream_reader([]), vocabs={'ids': tt})  # builds prog
+    tvar = t.train_program.global_block().vars['emb_w']
+    tvar.sharding = (None, 'model')        # dim-sharded annotation
+    with pytest.raises(DimShardingUnsupported, match='EMBEDDING dim'):
+        t.train_stream(_stream_reader(_batches(1)), vocabs={'ids': tt})
+    tvar.sharding = ('model', None)        # row sharding is supported
+    tt.validate_program(t.train_program)
+
+
+# ---------------------------------------------------------------------------
+# trainer seam: checkpoint/resume, publisher, zero steady compiles, obs
+# ---------------------------------------------------------------------------
+
+def test_tiered_checkpoint_resume_preserves_arena_and_spill_map(tmp_path):
+    """The spill map rides the checkpoint meta; a resumed trainer (new
+    process shape: fresh vocab + fresh arena object over the same dir)
+    re-admits a pre-crash spilled id BIT-exactly."""
+    ck = str(tmp_path / 'ck')
+    ar = str(tmp_path / 'tier')
+    vt = VocabTable(capacity=4, table='emb_w', admit_count=1)
+    tt = TieredVocabTable(vt, HostArena(ar, 16))
+    t = Trainer(_train_func, _opt,
+                checkpoint_config=CheckpointConfig(checkpoint_dir=ck,
+                                                   step_interval=1))
+    _train_phase(t, tt, (1, 2, 3))
+    names = table_state_names(t.train_program, 'emb_w')
+    row1 = int(vt.lookup([1])[0])
+    saved = {n: np.asarray(t.scope._chain_get(n))[row1].copy()
+             for n in names}
+    # two steps so the step_interval=1 cadence fires AFTER the spill
+    # (step 0 never checkpoints — the serial must capture the arena)
+    _train_phase(t, tt, (9, 9))            # evicts + spills id 1
+    assert 1 in tt.arena
+    spill_map = sorted(tt.arena._entries.items())
+
+    t2 = Trainer(_train_func, _opt,
+                 checkpoint_config=CheckpointConfig(checkpoint_dir=ck,
+                                                    step_interval=1))
+    assert t2.checkpoint_cfg.load_serial
+    vt2 = VocabTable(capacity=4, table='emb_w', admit_count=1)
+    tt2 = TieredVocabTable(vt2, HostArena(ar, 16))
+    t2.train_stream(_stream_reader([]), vocabs={'ids': tt2})
+    assert sorted(tt2.arena._entries.items()) == spill_map
+    assert vt2.resident_ids() == vt.resident_ids()
+    rows, lease = tt2.translate(np.full((FIELDS, 1), 1, 'int64'))
+    lease.release()
+    tt2.apply_step_boundary(t2.scope._chain_get, t2.scope._chain_set,
+                            names)
+    new_row = int(vt2.lookup([1])[0])
+    for n in names:
+        np.testing.assert_array_equal(
+            np.asarray(t2.scope._chain_get(n))[new_row], saved[n])
+
+
+def test_tiered_publisher_sees_every_device_mutated_row(tmp_path):
+    """Every row apply_step_boundary mutates (zeroed OR restored) lands
+    in that step's delta push — serving replicas converge after a
+    spill/restore cycle even when the mutation came from a PREFETCHED
+    batch's translation (double_buffer).
+
+    Capacity 8 (7 assignable) keeps evictions deterministic under the
+    double buffer: at most 2 in-flight leases pin 6 rows, so a new id
+    always finds an unpinned victim (a smaller table would DEFER
+    admissions to the cold row whenever every row is pinned)."""
+    vt = VocabTable(capacity=8, table='emb_w', admit_count=1)
+    tt = TieredVocabTable(vt, _arena(tmp_path, slots=64))
+    boundary_rows = []
+    orig = tt.apply_step_boundary
+
+    def spy(read, write, names):
+        out = orig(read, write, names)
+        boundary_rows.append(
+            sorted(int(r) for r in out['emb_w']) if out else [])
+        return out
+
+    tt.apply_step_boundary = spy
+    sink = _SinkEngine()
+    pub = DeltaPublisher(sink, interval_steps=1)
+    t = Trainer(_train_func, _opt, double_buffer=True)
+    seq = (1, 2, 3, 4, 5, 6, 7,            # fill the 7 assignable rows
+           11, 12,                         # evict + spill two of them
+           1, 2, 3, 4, 5)                  # re-admit: warm restores
+    b = [[(np.full((FIELDS, 1), i, 'int64'), np.ones((1,), 'float32'))]
+         for i in seq]
+    t.train_stream(_stream_reader(b), vocabs={'ids': tt}, publisher=pub)
+    assert tt.spilled >= 1 and tt.restored >= 1
+    assert len(sink.pushed) == len(seq)
+    for rows, push in zip(boundary_rows, sink.pushed):
+        pushed = set(np.asarray(push['emb_w'][0]).tolist())
+        assert set(rows) <= pushed, (rows, pushed)
+
+
+def test_tiered_zero_steady_compiles_and_obs_report_section(tmp_path):
+    """Churny eviction/restore traffic holds the fixed-signature
+    contract: ONE spill jit, ONE restore jit, zero executor cache
+    misses in the steady leg — and the obs run log renders the
+    `-- tiers --` report section."""
+    from paddle_tpu import obs
+    from paddle_tpu.obs import report as obs_report
+    obs.enable(str(tmp_path / 'obs'))
+    try:
+        # capacity 8: eviction stays deterministic under the double
+        # buffer (<= 6 rows pinned by in-flight leases, 7 assignable)
+        vt = VocabTable(capacity=8, table='emb_w', admit_count=1)
+        tt = TieredVocabTable(vt, _arena(tmp_path, slots=64))
+        t = Trainer(_train_func, _opt, double_buffer=True)
+        warm = [1, 2, 3, 4, 5, 6, 7,       # fill
+                11, 12, 13,                # spill three residents
+                1, 2, 3, 4, 5, 6, 7]      # warm restores
+        _train_phase(t, tt, warm)          # warm leg: compiles happen
+        misses0 = t.exe.cache_stats['misses']
+        spill_fns = len(tt._spiller._fns)
+        restore_fns = len(tt._restorer._fns)
+        assert tt.spilled >= 1 and tt.restored >= 1
+        steady = [21, 22, 23, 1, 2, 3, 4, 5, 6, 7]
+        _train_phase(t, tt, steady)        # steady leg: churn continues
+        assert t.exe.cache_stats['misses'] == misses0, \
+            'tier traffic caused steady-state compiles'
+        assert len(tt._spiller._fns) == spill_fns <= 1
+        assert len(tt._restorer._fns) == restore_fns <= 1
+        events, errors = obs_report.load_events(obs.run_log_path())
+        assert errors == []
+        names = [e['name'] for e in events]
+        assert 'streaming.tier.spill' in names
+        assert 'streaming.tier.restore' in names
+        assert 'streaming.tier.prefetch' in names
+        text = obs_report.summarize(events)
+        assert '-- tiers --' in text
+        assert 'restored warm' in text
+    finally:
+        obs._reset()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: zipf stream over a table 8x the HBM row budget
+# ---------------------------------------------------------------------------
+
+HBM_BUDGET = 4                             # vocab capacity (3 + cold)
+UNIVERSE = 8 * HBM_BUDGET                  # id space: 8x the budget
+
+
+def _zero_init_net():
+    """The A/B net: Constant(0) table init makes a freshly-zeroed row
+    IDENTICAL to a never-trained one, so the only divergence lever
+    left is trained state lost (or kept) across evict/re-admit."""
+    fluid.default_main_program().random_seed = 7
+    fluid.default_startup_program().random_seed = 7
+    ids = layers.data(name='ids', shape=[FIELDS, 1], dtype='int64')
+    label = layers.data(name='label', shape=[1], dtype='float32')
+    emb = layers.embedding(
+        ids, size=[UNIVERSE + 1, DIM], is_sparse=True,
+        param_attr=fluid.ParamAttr(
+            name='emb_w', initializer=fluid.initializer.Constant(0.0)))
+    pred = layers.fc(input=emb, size=1, num_flatten_dims=2,
+                     param_attr=fluid.ParamAttr(name='fc_w'))
+    score = layers.reduce_sum(pred, dim=1)
+    loss = layers.mean(layers.square(score - label))
+    return [loss]
+
+
+def _zipf_batches(n, seed=3):
+    """Zipf-weighted draws over the 8x universe with a drifting hot
+    set: plenty of evictions AND warm re-admissions."""
+    rng = np.random.RandomState(seed)
+    ranks = np.arange(1, UNIVERSE + 1, dtype=np.float64)
+    p = 1.0 / ranks
+    p /= p.sum()
+    out = []
+    for k in range(n):
+        shift = (k // 4) % UNIVERSE        # the hot set drifts
+        ids = (rng.choice(UNIVERSE, size=FIELDS, replace=False, p=p)
+               + shift) % UNIVERSE
+        lbl = rng.randn(1).astype('float32')
+        out.append([(ids.reshape(FIELDS, 1).astype('int64'), lbl)])
+    return out
+
+
+def test_e2e_zipf_8x_budget_tiered_bit_exact_plain_diverges(tmp_path):
+    """The acceptance drill. Three legs over the SAME zipf stream, a
+    table 8x the HBM row budget:
+
+      reference — capacity covers the universe, nothing ever evicted;
+      tiered    — capacity 4 + host arena: constant spill/restore;
+      plain     — capacity 4, today's zeroing eviction.
+
+    The tiered loss trajectory is BIT-exact vs the reference (warm
+    re-admission restores trained state exactly; a cold admission
+    equals the Constant(0) init), the plain leg DIVERGES once a
+    trained id re-admits zeroed — and the tiered leg stays at zero
+    steady-state compiles."""
+    batches = _zipf_batches(24)
+    warm, steady = batches[:12], batches[12:]
+
+    def run_leg(tt_or_vt):
+        # double_buffer=False: translation runs inline, so no lease
+        # from a still-in-flight step can pin rows at admission time —
+        # every new id admits (never defers to the cold row) and all
+        # three legs make IDENTICAL vocab decisions, the precondition
+        # for the bit-exact compare (the prefetch leg is exercised by
+        # the zero-compile and publisher drills above)
+        t = Trainer(_zero_init_net, _opt, double_buffer=False)
+        losses = []
+
+        def on_event(ev):
+            if hasattr(ev, 'metrics') and ev.metrics:
+                losses.append(np.asarray(ev.metrics[0]).copy())
+
+        t.train_stream(_stream_reader(warm), vocabs={'ids': tt_or_vt},
+                       event_handler=on_event)
+        misses0 = t.exe.cache_stats['misses']
+        t.train_stream(_stream_reader(steady), vocabs={'ids': tt_or_vt},
+                       event_handler=on_event)
+        steady_misses = t.exe.cache_stats['misses'] - misses0
+        return losses, steady_misses
+
+    ref_losses, _ = run_leg(
+        VocabTable(UNIVERSE + 1, table='emb_w', admit_count=1))
+    tt = TieredVocabTable(
+        VocabTable(HBM_BUDGET, table='emb_w', admit_count=1),
+        _arena(tmp_path, slots=4 * UNIVERSE))
+    tier_losses, tier_misses = run_leg(tt)
+    plain_losses, _ = run_leg(
+        VocabTable(HBM_BUDGET, table='emb_w', admit_count=1))
+
+    assert len(ref_losses) == len(tier_losses) == len(batches)
+    # the tier actually worked: evictions happened, re-admissions hit
+    assert tt.spilled >= 3 and tt.tier_hits >= 1, tt.stats()
+    assert tt.hit_rate() > 0
+    # tiered == reference, BIT-exact, every step
+    for a, b in zip(ref_losses, tier_losses):
+        np.testing.assert_array_equal(a, b)
+    # plain leg loses trained state on re-admission: it must diverge
+    assert any(not np.array_equal(a, b)
+               for a, b in zip(ref_losses, plain_losses)), \
+        'plain leg never diverged — the drill admitted no trained id?'
+    assert tier_misses == 0, 'tiered steady leg recompiled'

@@ -118,8 +118,10 @@ for name in sorted(set(new) & set(prev)):
         print('[compare] %s: %.0f vs %.0f (counter metric; config-'
               'driven, not flagged)' % (name, nv, pv))
         continue
-    # rate metrics (the serve_bench prefix *_hit_rate and speculative
-    # *_accept_rate) are HIGHER-is-better fractions in [0, 1]: compare
+    # rate metrics (the serve_bench prefix *_hit_rate, the speculative
+    # *_accept_rate, and the tier store's streaming_tier_hit_rate —
+    # docs/embedding.md#tiers) are HIGHER-is-better fractions in
+    # [0, 1]: compare
     # them on ABSOLUTE delta, not ratio — a hit rate moving 0.02 ->
     # 0.01 is a 2x ratio but a negligible absolute change, while
     # 0.9 -> 0.5 is the real regression the ratio rule under-weights
@@ -146,7 +148,10 @@ for name in sorted(set(new) & set(prev)):
     # the decode-stream failover family (docs/serving.md#pod-transport)
     # adds stream resume time (*_resume_s) and the replay overlap
     # (*_replayed_tokens = seen-but-pre-checkpoint tokens the survivor
-    # recomputes, bounded by ckpt_every) — both lower-is-better
+    # recomputes, bounded by ckpt_every) — both lower-is-better;
+    # the tiered-storage family (docs/embedding.md#tiers) adds restore
+    # percentiles (*_restore_p50_ms/_p99_ms) that ride the existing
+    # _ms rule by naming — no new case needed
     lower_is_better = (name.endswith('_ms') or name.endswith('.dropped')
                        or name.endswith('_temp_bytes')
                        or name.endswith('_stall_s')

@@ -17,6 +17,13 @@
 # gradient — each must fail typed and never strand a future or commit a
 # torn row (docs/embedding.md#streaming).
 #
+# The tier-store faults (tests/test_tiers.py, drills marked `faults`
+# alongside `tiered`) ride along: torn/bit-rotted arena manifests fail
+# TYPED on reopen (the .sum sidecar), a SIGKILL between the slot write
+# and the manifest commit leaves no torn slot adoptable on resume, and
+# slot data torn under a valid manifest is refused by the per-slot CRC
+# — never a silently wrong row (docs/embedding.md#tiers).
+#
 # The pod-serving tier (tests/test_pod_serving.py, marker `pod`) rides
 # along as well: host-loss drain/re-route/re-shard self-healing with
 # zero dropped futures, typed remote errors, heal-failure re-dispatch,
@@ -33,6 +40,6 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 exec env JAX_PLATFORMS=cpu python -m pytest \
-    -m '(faults or elastic or pod) and not slow' \
+    -m '(faults or elastic or pod or tiered) and not slow' \
     -q -p no:cacheprovider "$@" tests/test_faults.py tests/test_elastic.py \
-    tests/test_streaming.py tests/test_pod_serving.py
+    tests/test_streaming.py tests/test_pod_serving.py tests/test_tiers.py

@@ -192,6 +192,19 @@ if [ "${STREAM:-0}" = 1 ]; then
       --platform "${BENCH_PLATFORM:-tpu}"
 fi
 
+# 8c3. tiered-embedding-storage phase (opt-in: TIER=1): zipf drift over
+#      an id universe 8x the HBM row budget — TieredVocabTable (host
+#      arena spill/restore) vs plain zeroing VocabTable over the same
+#      stream; emits tiered + untiered steps/sec, the warm hit rate
+#      (*_hit_rate, sentinel rate rule), restore p50/p99 (*_ms,
+#      lower-is-better), and asserts zero steady-state compiles
+#      (docs/embedding.md#tiers). Host-side machinery plus two
+#      fixed-signature dispatches, so it runs regardless of platform.
+if [ "${TIER:-0}" = 1 ]; then
+  run python bench.py --phase tiered \
+      --platform "${BENCH_PLATFORM:-tpu}"
+fi
+
 # 8d. elastic smoke (opt-in: ELASTIC=1): the fast elastic drill tier —
 #     sharded checkpoints through the Trainer, atomic commit + torn-write
 #     fallback, reshard-on-restore topology change, heartbeat staleness
