@@ -1039,6 +1039,195 @@ def bench_flash_longcontext(seq_len=32768, heads=8, dim=64, warmup=1,
     return seq_len / dt, flops / dt, peak
 
 
+def bench_kernels(requests=None, max_len=None, slots=2, page_size=3):
+    """Pallas kernel layer A/B (docs/perf.md#kernel-layer): the paged
+    continuous-batching decoder over the SAME request stream twice — the
+    fallback leg with the `paged_attention` kernel forced OFF (today's
+    page-gather + attend lowering, byte-identical to the pre-kernel code
+    path) and the kernel leg with it forced ON. Each leg builds a FRESH
+    engine; the Executor keys its step cache on kernels.signature(), so
+    a knob flip can never serve the other leg's modules. Off-TPU the
+    kernel body runs under the pallas INTERPRETER — the CPU number
+    measures dispatch/correctness plumbing, not kernel speed (records
+    carry interpret=true and bench_sentinel refuses cross-platform
+    comparison as usual); only a TPU leg's tokens/sec + mfu are a perf
+    claim. Asserts zero steady-state compiles after warmup() on both
+    legs, and reports cross-leg parity (scores within the kernel's
+    documented online-softmax tolerance; token ids may flip only at
+    near-tie beam candidates)."""
+    from paddle_tpu.ops import kernels
+    from paddle_tpu.serving import DecodeConfig, DecodeEngine
+
+    if requests is None:
+        requests = int(os.environ.get('BENCH_KERNEL_REQS', '6'))
+    if max_len is None:
+        max_len = int(os.environ.get('BENCH_KERNEL_MAXLEN', '8'))
+    # tiny decoder (the tests/test_decode.py shape family): V tokens,
+    # E-dim target embedding, D-dim encoder rows, H-dim LSTM, beam K
+    V, E, D, H, K, SRC = 24, 8, 16, 8, 3, 6
+    rng = np.random.RandomState(0)
+    weights = {
+        'w_dec': (rng.randn(E + D, 4 * H) * 0.3).astype(np.float32),
+        'u_dec': (rng.randn(H, 4 * H) * 0.3).astype(np.float32),
+        'b_dec': (rng.randn(1, 4 * H) * 0.1).astype(np.float32),
+        'w_q': (rng.randn(H, D) * 0.3).astype(np.float32),
+        'w_emb': (rng.randn(V, E) * 0.3).astype(np.float32),
+        'w_out': (rng.randn(H, V) * 0.3).astype(np.float32),
+        'b_out': (rng.randn(1, V) * 0.1).astype(np.float32),
+    }
+    encs = [(rng.randn(rng.randint(2, SRC + 1), D) * 0.5)
+            .astype(np.float32) for _ in range(requests)]
+    pages = slots * (-(-max_len // page_size) + -(-SRC // page_size))
+
+    def leg(spec):
+        prev = kernels.configure(spec)
+        try:
+            eng = DecodeEngine(weights, DecodeConfig(
+                slots=slots, beam_size=K, max_len=max_len, src_cap=SRC,
+                page_size=page_size, pages=pages))
+            try:
+                eng.warmup()
+                misses0 = eng.cache_stats()['misses']
+                tokens0 = eng.stats['tokens']
+                t0 = time.time()
+                futs = [eng.submit({'enc': e}) for e in encs]
+                out = [f.result(300) for f in futs]
+                dt = time.time() - t0
+                steady = eng.cache_stats()['misses'] - misses0
+                tokens = eng.stats['tokens'] - tokens0
+            finally:
+                eng.shutdown()
+        finally:
+            kernels.configure(prev)
+        return out, tokens / dt, int(steady), int(tokens)
+
+    fb_out, fb_tps, fb_compiles, fb_tokens = leg(False)
+    disp0 = obs_counter_value('kernels.paged_attention.dispatch')
+    k_out, k_tps, k_compiles, k_tokens = leg('paged_attention')
+    dispatched = obs_counter_value(
+        'kernels.paged_attention.dispatch') - disp0
+
+    # cross-leg parity: beam scores within the kernel's documented
+    # tolerance (docs/perf.md#kernel-layer); token ids may legitimately
+    # flip at near-tie candidates under online softmax, so report the
+    # match fraction instead of asserting it
+    score_diff = max(float(np.max(np.abs(
+        np.asarray(ka[1], np.float32) - np.asarray(fa[1], np.float32))))
+        for ka, fa in zip(k_out, fb_out))
+    tok_match = float(np.mean([np.array_equal(ka[0], fa[0])
+                               for ka, fa in zip(k_out, fb_out)]))
+    # analytic decode flops per emitted token position, K beam rows each:
+    # LSTM gate matmuls + attention (q proj, scores, context) + logits
+    flops_tok = K * (2.0 * (E + D) * 4 * H + 2.0 * H * 4 * H
+                     + 2.0 * H * D + 4.0 * SRC * D + 2.0 * H * V)
+    return {
+        'kernel_tokens_per_sec': k_tps,
+        'fallback_tokens_per_sec': fb_tps,
+        'kernel_steady_compiles': k_compiles,
+        'fallback_steady_compiles': fb_compiles,
+        'kernel_dispatches': int(dispatched),
+        'tokens': k_tokens + fb_tokens,
+        'scores_max_abs_diff': score_diff,
+        'token_match_fraction': tok_match,
+        'flops_per_token': flops_tok,
+        'interpret': bool(kernels.interpret_default()),
+        'requests': requests, 'max_len': max_len, 'slots': slots,
+        'page_size': page_size, 'beam': K,
+    }
+
+
+def obs_counter_value(name):
+    """Current value of a process-wide obs counter (0 when it does not
+    exist yet — counters materialize on first inc)."""
+    from paddle_tpu import obs
+    try:
+        return int(obs.counter(name).value)
+    except Exception:
+        return 0
+
+
+def bench_quant(rows=None, dim=None, tables=2, pushes=None):
+    """Int8 delta-push A/B (docs/perf.md#quantized-inference): the SAME
+    touched-row stream published twice through a DeltaPublisher — fp32
+    rows vs quant='int8' (int8 payload + one f32 absmax scale per row,
+    embedding/quant_rows.py) — into an in-process sink. The contract
+    metric is VALUE bytes per push: int8 must come in at <= 0.55x fp32
+    (D+4 vs 4D bytes per row; ~0.27x at D=64). Host-side numpy
+    throughout, so CPU numbers are VALID. Also verifies the replica-side
+    values round-trip within the documented bound (max|row|/254 per
+    element)."""
+    from paddle_tpu.streaming import DeltaPublisher
+
+    if rows is None:
+        rows = int(os.environ.get('BENCH_QUANT_ROWS', '256'))
+    if dim is None:
+        dim = int(os.environ.get('BENCH_QUANT_DIM', '64'))
+    if pushes is None:
+        pushes = int(os.environ.get('BENCH_QUANT_PUSHES', '4'))
+    vocab = 4 * rows
+    wrng = np.random.RandomState(0)
+    tabs = {'emb_%d' % i: (wrng.randn(vocab, dim) * 0.5)
+            .astype(np.float32) for i in range(tables)}
+
+    class _Sink(object):
+        """push_rows-only sink: the publisher dequantizes int8 locally
+        (no push_quantized_rows here), so the sink holds exactly the
+        values a quantized wire would deliver — the round-trip check
+        below exercises the documented rounding."""
+
+        def __init__(self):
+            self.rows = {}
+
+        def push_rows(self, deltas):
+            for name, (ids, vals) in deltas.items():
+                vals = np.asarray(vals)
+                self.rows.setdefault(name, {}).update(
+                    (int(r), np.array(vals[j]))
+                    for j, r in enumerate(np.asarray(ids).reshape(-1)))
+
+    def leg(quant):
+        sink = _Sink()
+        pub = DeltaPublisher(sink, quant=quant)
+        trng = np.random.RandomState(1)  # same stream both legs
+        total_bytes = 0
+        push_ms = []
+        for _ in range(pushes):
+            touched = {t: trng.choice(vocab, size=rows, replace=False)
+                       for t in tabs}
+            pub.collect(touched)
+            pub.publish(lambda name: tabs[name])
+            total_bytes += pub.last_push_bytes
+            push_ms.append(pub.last_push_ms)
+        return sink, total_bytes / float(pushes), push_ms
+
+    _fp_sink, fp32_bytes, fp32_ms = leg(None)
+    q_sink, int8_bytes, int8_ms = leg('int8')
+
+    # replica-side round-trip error vs the live table, against the
+    # documented per-element bound (half an int8 step of the row absmax)
+    max_err, max_bound = 0.0, 0.0
+    for name, got in q_sink.rows.items():
+        w = tabs[name]
+        for r, v in got.items():
+            err = float(np.max(np.abs(v - w[r])))
+            bound = float(np.max(np.abs(w[r]))) / 254.0
+            if err > max_err:
+                max_err = err
+            if bound > max_bound:
+                max_bound = bound
+    return {
+        'fp32_push_bytes': int(fp32_bytes),
+        'int8_push_bytes': int(int8_bytes),
+        'bytes_ratio': int8_bytes / float(fp32_bytes),
+        'fp32_push_ms': float(np.median(fp32_ms)),
+        'int8_push_ms': float(np.median(int8_ms)),
+        'roundtrip_max_abs_err': max_err,
+        'roundtrip_err_bound': max_bound,
+        'rows_per_push': rows * tables, 'dim': dim,
+        'tables': tables, 'pushes': pushes,
+    }
+
+
 def _try(fn, *scaled_attempts):
     """Run fn(**kwargs) trying each attempt dict in order (HBM fallbacks).
     Every swallowed exception is logged — round 2's _try hid the first
@@ -1099,6 +1288,15 @@ NAME_TI_UNT = 'streaming_untiered_train_steps_per_sec'
 NAME_TI_HIT = 'streaming_tier_hit_rate'
 NAME_TI_P50 = 'streaming_tier_restore_p50_ms'
 NAME_TI_P99 = 'streaming_tier_restore_p99_ms'
+# pallas-kernel + int8-quant phases (docs/perf.md#kernel-layer):
+# tokens/sec rides the default higher-is-better sentinel rule, mfu its
+# _mfu absolute-delta rule, push bytes the _push_bytes lower-is-better
+# rule
+NAME_K_TPS = 'decode_paged_attention_kernel_tokens_per_sec'
+NAME_K_FB = 'decode_paged_attention_fallback_tokens_per_sec'
+NAME_K_MFU = 'decode_paged_attention_kernel_mfu'
+NAME_Q_FP32 = 'streaming_fp32_delta_push_bytes'
+NAME_Q_INT8 = 'streaming_int8_delta_push_bytes'
 PHASES = ('transformer', 'resnet', 'bundle', 'gspmd', 'embedding',
           'longseq', 'longctx')
 PHASE_NAMES = {'transformer': NAME_T, 'resnet': NAME_R, 'bundle': NAME_B,
@@ -1412,6 +1610,95 @@ def run_phase(phase, platform):
         except Exception as e:
             _log('tiered phase failed: %r' % e)
             _emit({'metric': NAME_TI_SPS, 'skipped': True,
+                   'error': str(e)[:300]})
+    elif phase == 'kernels':
+        # pallas kernel A/B (docs/perf.md#kernel-layer): paged decode
+        # through the continuous-batching engine, kernel vs fallback
+        # lowering over the same request stream. Off-TPU the kernel body
+        # runs INTERPRETED — that leg's tokens/sec measures plumbing,
+        # not speed, so the records carry interpret and the sentinel's
+        # platform refusal does the rest; mfu is emitted only on a TPU.
+        try:
+            res = bench_kernels()
+            common = {'platform': platform,
+                      'interpret': res['interpret'],
+                      'requests': res['requests'],
+                      'max_len': res['max_len'], 'slots': res['slots'],
+                      'page_size': res['page_size'], 'beam': res['beam']}
+            k_flops = res['kernel_tokens_per_sec'] * res['flops_per_token']
+            _emit(dict({'metric': NAME_K_TPS,
+                        'value': round(res['kernel_tokens_per_sec'], 2),
+                        'unit': 'tokens/sec',
+                        'fallback_tokens_per_sec': round(
+                            res['fallback_tokens_per_sec'], 2),
+                        'speedup_vs_fallback': round(
+                            res['kernel_tokens_per_sec']
+                            / res['fallback_tokens_per_sec'], 3),
+                        'mfu': _mfu(k_flops, platform),
+                        'steady_compiles': res['kernel_steady_compiles'],
+                        'kernel_dispatches': res['kernel_dispatches'],
+                        'scores_max_abs_diff': round(
+                            res['scores_max_abs_diff'], 8),
+                        'token_match_fraction':
+                            res['token_match_fraction']}, **common))
+            _emit(dict({'metric': NAME_K_FB,
+                        'value': round(res['fallback_tokens_per_sec'], 2),
+                        'unit': 'tokens/sec',
+                        'steady_compiles':
+                            res['fallback_steady_compiles']}, **common))
+            mfu = _mfu(k_flops, platform)
+            if mfu is not None:
+                _emit(dict({'metric': NAME_K_MFU, 'value': mfu,
+                            'unit': 'fraction of bf16 peak'}, **common))
+            if res['kernel_steady_compiles'] \
+                    or res['fallback_steady_compiles']:
+                _log('*** kernels: steady-state compile(s) (kernel=%d '
+                     'fallback=%d) — the closed-signature contract '
+                     'broke ***' % (res['kernel_steady_compiles'],
+                                    res['fallback_steady_compiles']))
+            if not res['kernel_dispatches']:
+                _log('*** kernels: the kernel leg never dispatched '
+                     'paged_attention — knob plumbing broke ***')
+        except Exception as e:
+            _log('kernels phase failed: %r' % e)
+            _emit({'metric': NAME_K_TPS, 'skipped': True,
+                   'error': str(e)[:300]})
+    elif phase == 'quant':
+        # int8 delta-push bytes A/B (docs/perf.md#quantized-inference):
+        # host-side numpy codec, CPU numbers VALID. Contract: int8 value
+        # bytes <= 0.55x fp32 for the same touched rows.
+        try:
+            res = bench_quant()
+            common = {'platform': platform, 'dim': res['dim'],
+                      'rows_per_push': res['rows_per_push'],
+                      'tables': res['tables'], 'pushes': res['pushes']}
+            _emit(dict({'metric': NAME_Q_FP32,
+                        'value': res['fp32_push_bytes'],
+                        'unit': 'bytes/push',
+                        'push_ms': round(res['fp32_push_ms'], 3)},
+                       **common))
+            _emit(dict({'metric': NAME_Q_INT8,
+                        'value': res['int8_push_bytes'],
+                        'unit': 'bytes/push',
+                        'bytes_ratio_vs_fp32': round(
+                            res['bytes_ratio'], 4),
+                        'push_ms': round(res['int8_push_ms'], 3),
+                        'roundtrip_max_abs_err': round(
+                            res['roundtrip_max_abs_err'], 8),
+                        'roundtrip_err_bound': round(
+                            res['roundtrip_err_bound'], 8)}, **common))
+            if res['bytes_ratio'] > 0.55:
+                _log('*** quant: int8 push bytes %.3fx fp32 — the '
+                     '<= 0.55x contract broke ***' % res['bytes_ratio'])
+            if res['roundtrip_max_abs_err'] \
+                    > res['roundtrip_err_bound'] + 1e-7:
+                _log('*** quant: round-trip error %.3g exceeds the '
+                     'documented bound %.3g ***'
+                     % (res['roundtrip_max_abs_err'],
+                        res['roundtrip_err_bound']))
+        except Exception as e:
+            _log('quant phase failed: %r' % e)
+            _emit({'metric': NAME_Q_INT8, 'skipped': True,
                    'error': str(e)[:300]})
     elif phase == 'overlap':
         # pipeline-overlap contract metrics (docs/perf.md#overlap):

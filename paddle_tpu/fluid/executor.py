@@ -1060,7 +1060,9 @@ class Executor(object):
             if v.persistable and scope._chain_get(v.name) is not None
             and v.name not in feed_vals))
         from . import amp as amp_mod
+        from .passes import quant_pass as quant_mod
         amp = amp_mod.is_amp(program)
+        quant = quant_mod.is_quant(program)
         guard = bool(getattr(program, '_anomaly_guard', False))
         from jax.sharding import NamedSharding
         persist_shardings = {}
@@ -1093,10 +1095,17 @@ class Executor(object):
                                                        None)},
             }
         from . import passes as passes_mod
+        from ..ops import kernels as kernels_mod
         opt = passes_mod.opt_mode()
+        # the enabled pallas-kernel set is a TRACE-time routing decision
+        # (lowering.use_kernel): it must be part of the cache key or a
+        # knob flip would be served the other variant's cached step.
+        # `quant` mirrors `amp`: marking a program after it already ran
+        # must recompile, not serve the cached fp32 module.
         key = (program._uid, program._version, feed_sig, tuple(fetch_names),
-               persist_in, amp, bool(getattr(program, '_use_remat', False)),
-               shard_sig, dist_mesh, guard, opt)
+               persist_in, amp, quant,
+               bool(getattr(program, '_use_remat', False)),
+               shard_sig, dist_mesh, guard, opt, kernels_mod.signature())
         # short stable-within-process id naming this compiled module in
         # telemetry (step spans, compiled_op_table's header)
         key_id = '%08x' % (hash(key) & 0xFFFFFFFF)
@@ -1115,12 +1124,17 @@ class Executor(object):
             # mutated; the _CompiledStep lowers the optimized clone. An
             # optimizer failure must never take down a training run:
             # fall back to the unoptimized lowering, loudly.
+            # a quant-marked program REQUIRES the pass pipeline: unlike
+            # amp there is no ctx-flag fallback in the lowering, so
+            # honoring the mark can't be conditional on PADDLE_TPU_OPT
             run_program, run_block = program, block
-            if opt != 'off':
+            if opt != 'off' or quant:
                 try:
                     run_program, _opt_report = passes_mod.optimize(
                         program, feeds=set(feed_vals),
-                        fetches=fetch_names, level=opt, where='executor')
+                        fetches=fetch_names,
+                        level=opt if opt != 'off' else 'default',
+                        where='executor')
                     run_block = run_program.global_block()
                 except Exception as e:
                     import warnings

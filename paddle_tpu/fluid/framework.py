@@ -572,7 +572,8 @@ class Program(object):
         # execution flags travel with the program: amp mode (incl. the
         # passes.amp_pass IR-rewrite marker), the Float16Transpiler
         # fetch contract, rematerialisation
-        for flag in ('_amp', '_amp_ir', '_fetch_f32', '_use_remat'):
+        for flag in ('_amp', '_amp_ir', '_fetch_f32', '_use_remat',
+                     '_quant', '_quant_ir', '_quant_ops'):
             if hasattr(self, flag):
                 setattr(p, flag, getattr(self, flag))
         # the mesh spec travels with the program exactly like _dist_config:

@@ -104,6 +104,24 @@ def amp_cast(ctx, *xs):
     return out if len(out) > 1 else out[0]
 
 
+def use_kernel(ctx, name):
+    """Trace-time pallas-kernel routing for lowering rules
+    (docs/perf.md#kernel-layer): True iff kernel `name` is enabled via
+    the ops.kernels knob (env PADDLE_TPU_KERNELS / kernels.configure).
+    Records the decision on the kernels.dispatch/fallback counters, so
+    every rule answers "which variant did this compile carry" in the
+    obs report. Enablement is process-level, not a Ctx field — the
+    Executor keys its compile cache on kernels.signature() so a knob
+    flip can never be served a stale cached step. Rules keep their
+    original jnp code as the False branch: that IS the fallback
+    contract (knob off == byte-identical to the pre-kernel lowering).
+    """
+    from ..ops import kernels
+    use = kernels.enabled(name)
+    kernels.note_dispatch(name, use)
+    return use
+
+
 class SeqValue(object):
     """Runtime value of a lod_level>0 Variable: dense padded data + lengths.
 

@@ -17,3 +17,4 @@ from . import sampled_ops  # noqa: F401
 from . import moe_ops  # noqa: F401
 from . import embedding_ops  # noqa: F401
 from . import extra_ops  # noqa: F401
+from . import quant_ops  # noqa: F401

@@ -319,7 +319,8 @@ def beam_init_carry(rows, beam, hidden, start_id, dtype=jnp.float32):
             jnp.zeros((n,), bool))
 
 
-def attention_beam_step(params, enc_t, mask_t, carry, beam, end_id):
+def attention_beam_step(params, enc_t, mask_t, carry, beam, end_id,
+                        attend=None):
     """One attend -> LSTM cell -> project -> joint top-k -> reorder beam
     step on flat [B*beam, ...] rows (every row is independent: no
     cross-row reduction ever mixes two sources, which is what lets the
@@ -330,7 +331,13 @@ def attention_beam_step(params, enc_t, mask_t, carry, beam, end_id):
     w_out [H,V], b_out); enc_t [B*beam, S, D] (source rows repeated per
     beam); mask_t [B*beam, S] 1/0; carry = (h, c, prev_ids, acc, fin) as
     built by beam_init_carry. Returns (carry', (sel_ids [B, beam],
-    parent [B, beam] local beam index, top_scores [B, beam]))."""
+    parent [B, beam] local beam index, top_scores [B, beam])).
+
+    `attend`: optional q [Bb, D] -> ctx [Bb, D] override — the paged
+    decode rules pass the fused paged_attention kernel here (which reads
+    the encoder PAGES directly, so they pass enc_t/mask_t as None and
+    skip materializing the repeated tensors). None keeps the inline
+    attend math below, byte-identical to the pre-kernel lowering."""
     w_dec, u_dec, b_dec, w_q, w_emb, w_out, b_out = params
     hp, cp, prev_ids, acc, fin = carry
     Bb = hp.shape[0]
@@ -340,10 +347,13 @@ def attention_beam_step(params, enc_t, mask_t, carry, beam, end_id):
 
     x_t = jnp.take(w_emb, prev_ids, axis=0)          # [Bb, E]
     q = hp @ w_q
-    scores = jnp.einsum('bd,bsd->bs', q, enc_t)
-    scores = jnp.where(mask_t > 0, scores, neg)
-    alpha = jax.nn.softmax(scores, axis=-1)
-    ctx_vec = jnp.einsum('bs,bsd->bd', alpha, enc_t)
+    if attend is not None:
+        ctx_vec = attend(q)
+    else:
+        scores = jnp.einsum('bd,bsd->bs', q, enc_t)
+        scores = jnp.where(mask_t > 0, scores, neg)
+        alpha = jax.nn.softmax(scores, axis=-1)
+        ctx_vec = jnp.einsum('bs,bsd->bd', alpha, enc_t)
     g = jnp.concatenate([x_t, ctx_vec], -1) @ w_dec + hp @ u_dec + b_dec
     gi, gf, gc, go = jnp.split(g, 4, axis=-1)
     c_new = jax.nn.sigmoid(gf) * cp + \
@@ -373,7 +383,7 @@ def attention_beam_step(params, enc_t, mask_t, carry, beam, end_id):
         (sel_ids, parent, top_scores)
 
 
-def greedy_attend_cell(params, enc, mask, h, c, tok):
+def greedy_attend_cell(params, enc, mask, h, c, tok, attend=None):
     """One attend -> LSTM cell -> project step for [B] independent rows
     with NO beam dimension — the draft model's proposal step in
     speculative decoding (sampled_ops.attention_lstm_spec_decode_step)
@@ -384,15 +394,21 @@ def greedy_attend_cell(params, enc, mask, h, c, tok):
     params: the WEIGHT_KEYS tuple (w_dec [E+D,4H], u_dec [H,4H], b_dec,
     w_q [H,D], w_emb [V,E], w_out [H,V], b_out); enc [B, S, D];
     mask [B, S] 1/0; h/c [B, H]; tok [B] int32.
-    Returns (h2, c2, logits [B, V] float32)."""
+    Returns (h2, c2, logits [B, V] float32).
+
+    `attend`: optional q [B, D] -> ctx [B, D] override (see
+    attention_beam_step) — with it set, enc/mask may be None."""
     w_dec, u_dec, b_dec, w_q, w_emb, w_out, b_out = params
     neg = jnp.finfo(jnp.float32).min
     x = jnp.take(w_emb, tok, axis=0)
     q = h @ w_q
-    scores = jnp.einsum('bd,bsd->bs', q, enc)
-    scores = jnp.where(mask > 0, scores, neg)
-    alpha = jax.nn.softmax(scores, axis=-1)
-    ctx = jnp.einsum('bs,bsd->bd', alpha, enc)
+    if attend is not None:
+        ctx = attend(q)
+    else:
+        scores = jnp.einsum('bd,bsd->bs', q, enc)
+        scores = jnp.where(mask > 0, scores, neg)
+        alpha = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum('bs,bsd->bd', alpha, enc)
     g = jnp.concatenate([x, ctx], -1) @ w_dec + h @ u_dec + b_dec
     gi, gf, gc, go = jnp.split(g, 4, axis=-1)
     c2 = jax.nn.sigmoid(gf) * c + jax.nn.sigmoid(gi) * jnp.tanh(gc)

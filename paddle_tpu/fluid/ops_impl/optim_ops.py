@@ -9,7 +9,7 @@ optimizer op).
 import jax
 import jax.numpy as jnp
 
-from ..lowering import register, data_of, SparseRows
+from ..lowering import register, data_of, SparseRows, use_kernel
 
 
 def _lr(ins):
@@ -98,6 +98,15 @@ def _adagrad(ins, attrs, ctx):
         # Deltas (not absolute values) are scattered so the zero-padded
         # invalid merge slots are exact no-ops under duplicate indices.
         uids, gm, valid = _merge_sparse(g, ctx)
+        # fused pallas path: gather + moment math + scatter in ONE call,
+        # tables aliased in place (per-shard-local — sharded steps keep
+        # the XLA branch below, whose scatter partitions under the mesh)
+        if getattr(ctx, 'mesh', None) is None and \
+                use_kernel(ctx, 'sparse_adagrad'):
+            from ...ops.kernels import fused_sparse_adagrad
+            p_out, m_out = fused_sparse_adagrad(p, m, uids, gm, valid,
+                                                lr, eps)
+            return {'ParamOut': p_out, 'MomentOut': m_out}
         vm = valid[:, None].astype(gm.dtype)
         m_rows = m[uids]
         m_new = m_rows + gm * gm
@@ -129,6 +138,15 @@ def _adam(ins, attrs, ctx):
         # once. Scattered as deltas — padding slots from the merge are
         # exact no-ops.
         uids, gm, valid = _merge_sparse(g, ctx)
+        # fused pallas path (see adagrad above); lr is already
+        # bias-corrected, exactly what the kernel applies per row
+        if getattr(ctx, 'mesh', None) is None and \
+                use_kernel(ctx, 'sparse_adam'):
+            from ...ops.kernels import fused_sparse_adam
+            p_out, m1_out, m2_out = fused_sparse_adam(
+                p, m1, m2, uids, gm, valid, lr, b1, b2, eps)
+            return {'ParamOut': p_out, 'Moment1Out': m1_out,
+                    'Moment2Out': m2_out}
         vm = valid[:, None].astype(gm.dtype)
         m1_rows, m2_rows = m1[uids], m2[uids]
         m1_new = b1 * m1_rows + (1 - b1) * gm

@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..lowering import register, data_of, like, SeqValue
+from ..lowering import register, data_of, like, SeqValue, use_kernel
 
 
 @register('nce')
@@ -324,17 +324,18 @@ def _attention_lstm_beam_decode_step(ins, attrs, ctx):
 
 
 def _masked_beam_advance(params, enc_t, mask_t, carry5, active, beam,
-                         end_id):
+                         end_id, attend=None):
     """One beam step over the slot pool with where-select masking (the
     anomaly guard's rollback pattern): only ACTIVE slots advance;
     everything else keeps its old state bit for bit, so joins/leaves
     between dispatches — and slots that finished earlier in a bundle —
     never disturb live ones. Shared by the dense and the paged step op
-    so the two are bit-exact by construction."""
+    so the two are bit-exact by construction. `attend` passes the paged
+    op's fused-kernel attention through (lod_beam.attention_beam_step)."""
     from .lod_beam import attention_beam_step
     h, c, prev, acc, fin = carry5
     new_carry, (sel_ids, parent, _top) = attention_beam_step(
-        params, enc_t, mask_t, carry5, beam, end_id)
+        params, enc_t, mask_t, carry5, beam, end_id, attend=attend)
     act_row = jnp.repeat(active, beam)               # [slots*beam]
     sel = lambda new, old: jnp.where(
         act_row.reshape((-1,) + (1,) * (new.ndim - 1)), new, old)
@@ -431,9 +432,22 @@ def _attention_lstm_beam_paged_step(ins, attrs, ctx):
     bundle = int(attrs.get('bundle', 1))
     src_cap = int(attrs['src_cap'])
 
-    enc, mask = _gather_paged_enc(ins, src_cap)
-    enc_t = jnp.repeat(enc, beam, axis=0)            # [slots*beam, S, D]
-    mask_t = jnp.repeat(mask, beam, axis=0)
+    if use_kernel(ctx, 'paged_attention'):
+        # fused path: the kernel reads the page POOLS through the page
+        # table itself — the gathered [slots, S, D] buffer and its
+        # per-beam repeat never materialize
+        from ...ops.kernels import paged_attention
+        pt_enc = data_of(ins['PtEnc'][0]).astype(jnp.int32)
+        enc_pages = data_of(ins['EncPages'][0])
+        mask_pages = data_of(ins['MaskPages'][0])
+        enc_t = mask_t = None
+        attend = lambda q: paged_attention(q, enc_pages, mask_pages,
+                                           pt_enc, src_cap)
+    else:
+        attend = None
+        enc, mask = _gather_paged_enc(ins, src_cap)
+        enc_t = jnp.repeat(enc, beam, axis=0)        # [slots*beam, S, D]
+        mask_t = jnp.repeat(mask, beam, axis=0)
     flat = lambda a: a.reshape((slots * beam,) + a.shape[2:])
     unflat = lambda a: a.reshape((slots, beam) + a.shape[1:])
 
@@ -443,7 +457,7 @@ def _attention_lstm_beam_paged_step(ins, attrs, ctx):
         (h2, c2, ids2, acc2, fin2), (sel_ids, parent) = \
             _masked_beam_advance(params, enc_t, mask_t,
                                  (h, c, prev, acc, fin), active, beam,
-                                 end_id)
+                                 end_id, attend=attend)
         ids_pool2 = _paged_hist_write(ids_pool, pt_hist, step, page_size,
                                       active, sel_ids, n_pages)
         par_pool2 = _paged_hist_write(par_pool, pt_hist, step, page_size,
@@ -541,7 +555,19 @@ def _attention_lstm_spec_decode_step(ins, attrs, ctx):
     E = w_emb.shape[1]
     neg = jnp.finfo(jnp.float32).min
 
-    enc, mask = _gather_paged_enc(ins, src_cap)      # [C, S, D]
+    if use_kernel(ctx, 'paged_attention'):
+        # fused path (beam dim is 1 here): both the draft proposals and
+        # the verify recurrence attend straight into the page pools
+        from ...ops.kernels import paged_attention
+        pt_enc = data_of(ins['PtEnc'][0]).astype(jnp.int32)
+        enc_pages = data_of(ins['EncPages'][0])
+        mask_pages = data_of(ins['MaskPages'][0])
+        enc = mask = None
+        attend = lambda q: paged_attention(q, enc_pages, mask_pages,
+                                           pt_enc, src_cap)
+    else:
+        attend = None
+        enc, mask = _gather_paged_enc(ins, src_cap)  # [C, S, D]
 
     # -- draft phase: propose spec_k tokens (and advance one past them,
     # so the draft state can roll back to any accepted position) -------
@@ -561,7 +587,8 @@ def _attention_lstm_spec_decode_step(ins, attrs, ctx):
         def dstep(carry, _):
             hd, cd, tok = carry
             hd2, cd2, logits = greedy_attend_cell(dparams, enc, mask,
-                                                  hd, cd, tok)
+                                                  hd, cd, tok,
+                                                  attend=attend)
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             return (hd2, cd2, nxt), (nxt, hd2, cd2)
 
@@ -576,10 +603,13 @@ def _attention_lstm_spec_decode_step(ins, attrs, ctx):
     def vstep(carry, xw_t):
         h, c = carry
         q = h @ w_q
-        scores = jnp.einsum('bd,bsd->bs', q, enc)
-        scores = jnp.where(mask > 0, scores, neg)
-        alpha = jax.nn.softmax(scores, axis=-1)
-        ctx_v = jnp.einsum('bs,bsd->bd', alpha, enc)
+        if attend is not None:
+            ctx_v = attend(q)
+        else:
+            scores = jnp.einsum('bd,bsd->bs', q, enc)
+            scores = jnp.where(mask > 0, scores, neg)
+            alpha = jax.nn.softmax(scores, axis=-1)
+            ctx_v = jnp.einsum('bs,bsd->bd', alpha, enc)
         g = xw_t + ctx_v @ w_dec[E:] + h @ u_dec + b_dec
         gi, gf, gc, go = jnp.split(g, 4, axis=-1)
         c2 = jax.nn.sigmoid(gf) * c + jax.nn.sigmoid(gi) * jnp.tanh(gc)

@@ -180,7 +180,8 @@ def write_counts(program):
 # attribution line) carries: actual WORK DONE, never a grab-bag sum that
 # would count amp's skipped ops as rewrites
 _PRIMARY_STAT = {'dce': 'ops_removed', 'fold': 'ops_folded',
-                 'cse': 'ops_merged', 'amp': 'ops_rewritten'}
+                 'cse': 'ops_merged', 'amp': 'ops_rewritten',
+                 'quant': 'ops_rewritten'}
 
 class PassReport(object):
     """What one optimize() run did: per-pass numbers + the total top-level
@@ -256,7 +257,7 @@ def optimize(program, feeds=None, fetches=None, level='default',
         report.skipped = 'pipeline-transpiled program'
         return program, report
 
-    from . import amp_pass, cse, dce, fold
+    from . import amp_pass, cse, dce, fold, quant_pass
     from .. import amp as amp_mod
 
     with obs.span('passes.optimize', level=level,
@@ -266,6 +267,9 @@ def optimize(program, feeds=None, fetches=None, level='default',
         if amp_mod.is_amp(program):
             with obs.span('passes.amp'):
                 amp_pass.run(p, report)
+        if quant_pass.is_quant(program):
+            with obs.span('passes.quant'):
+                quant_pass.run(p, report)
         with obs.span('passes.fold'):
             fold.run(p, report, level=level)
         if fetches is not None:

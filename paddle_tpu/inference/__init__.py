@@ -31,11 +31,21 @@ class Predictor(object):
     passed explicitly through `Executor.run(scope=...)` — never via the
     process-global `scope_guard`, which two predictors (or two threads
     on one predictor) would race on. The serving engine
-    (paddle_tpu.serving) relies on this."""
+    (paddle_tpu.serving) relies on this.
 
-    def __init__(self, dirname, place=None):
+    `kernels`: the predictor-config surface of the pallas kernel knob
+    (docs/perf.md#kernel-layer) — same grammar as the PADDLE_TPU_KERNELS
+    env ('all', 'paged_attention', 'all,-sparse_adam', an iterable, a
+    bool). Routes to `ops.kernels.configure()`; the enablement is
+    process-level (the compile cache keys on it), and None leaves the
+    env in charge."""
+
+    def __init__(self, dirname, place=None, kernels=None):
         from ..fluid import core, io
         from ..fluid.executor import Executor, Scope
+        if kernels is not None:
+            from ..ops import kernels as kernels_mod
+            kernels_mod.configure(kernels)
         self._scope = Scope()
         self._place = place or (core.TPUPlace(0) if core.is_compiled_with_tpu()
                                 else core.CPUPlace())

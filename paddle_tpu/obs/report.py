@@ -338,7 +338,7 @@ def summarize(events):
         lines.append('%d program(s) optimized: %d -> %d top-level op(s)'
                      % (len(opt_spans), before, after))
         per = {}
-        for name in ('dce', 'fold', 'cse', 'amp'):
+        for name in ('dce', 'fold', 'cse', 'amp', 'quant'):
             tot = sum(int(s.get('fields', {}).get(name, 0))
                       for s in opt_spans)
             if tot:
@@ -350,6 +350,28 @@ def summarize(events):
         if errs:
             lines.append('%d optimizer failure(s) fell back to the '
                          'unoptimized lowering' % len(errs))
+
+    # -- kernels ----------------------------------------------------------
+    # pallas kernel layer (docs/perf.md#kernel-layer): one
+    # kernels.dispatch event per TRACE-time routing decision — mode
+    # 'kernel' means the pallas body was baked into the compiled module,
+    # 'fallback' the pure-XLA lowering. These count compiled modules,
+    # not steady-state steps (which re-trace nothing).
+    kdisp = _events(events, 'kernels.dispatch')
+    if kdisp:
+        lines.append('')
+        lines.append('-- kernels --')
+        per = {}
+        for e in kdisp:
+            f = e.get('fields', {})
+            key = (str(f.get('kernel', '?')), str(f.get('mode', '?')))
+            per[key] = per.get(key, 0) + 1
+        n_k = sum(v for (_, m), v in per.items() if m == 'kernel')
+        n_f = sum(v for (_, m), v in per.items() if m == 'fallback')
+        lines.append('trace-time dispatches: %d kernel, %d fallback'
+                     % (n_k, n_f))
+        for (k, m), v in sorted(per.items()):
+            lines.append('  %s: %d %s trace(s)' % (k, v, m))
 
     # -- sharding / GSPMD ------------------------------------------------
     # executor.remat_detected: XLA's SPMD partitioner fell back to

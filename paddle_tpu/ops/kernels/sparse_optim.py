@@ -1,0 +1,173 @@
+"""Fused sharded-sparse optimizer kernels (docs/perf.md#kernel-layer).
+
+The streaming `train_stream` step's hottest op after the lookup is the
+sparse optimizer update (ops_impl/optim_ops.py adagrad/adam
+SelectedRows branches): after `_merge_sparse` dedups the batch's rows,
+XLA emits a gather of the param/moment rows, the moment math, and a
+scatter-add of the deltas — three HBM round-trips over [N, D] plus the
+table-row traffic. These kernels fuse gather + moment update + scatter
+into ONE pallas call: the merged uids ride scalar prefetch and serve as
+the BlockSpec index maps for the param/moment ROWS (in and out — the
+tables are aliased via `input_output_aliases`, so the update is
+in-place row traffic and the [N, D] gathered copies never exist in
+HBM). The dedup merge itself (sort/segment-sum, embedding.lookup.
+dedup_plan) stays XLA: it is id-space bookkeeping with no row traffic,
+and sharing ONE definition of the dedup invariant with the lookup wire
+beats fusing it.
+
+Write-hazard analysis (why the grid runs the slots in REVERSE): the
+merge clamps its invalid tail slots to row 0, so row 0 can be visited
+more than once. Valid uids are unique, and an invalid slot's write is
+always value-preserving (its delta is masked to zero — it writes the
+row it read). Processing slots back-to-front puts every invalid visit
+of row 0 BEFORE the (at most one) valid visit, so no grid step ever
+reads a row that an earlier step changed. That makes the kernel correct
+under BOTH aliasing semantics in play: the pallas interpreter (tier-1,
+CPU), whose input carry is a snapshot that never sees in-grid writes,
+and compiled Mosaic, where the aliased buffer is live and input
+prefetch may race a write by a few pipeline stages — hazard-free
+because the only re-read row only ever received no-op writes first.
+
+Numerics: per-row math is the fallback's elementwise expressions in the
+same order on the same f32 rows, so parity is effectively exact;
+tests/test_kernels.py pins |kernel - fallback| <= 1e-6 absolute
+(docs/perf.md carries the table). Sharded steps (ctx.mesh set) keep the
+XLA fallback — the kernel is per-shard-local and its shard_map wiring
+is a follow-on; dispatch sites route accordingly.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import register_kernel, interpret_default
+
+SPARSE_ADAGRAD = register_kernel(
+    'sparse_adagrad',
+    'merged-row gather + adagrad moment update + scatter fused, tables '
+    'aliased in-place')
+SPARSE_ADAM = register_kernel(
+    'sparse_adam',
+    'merged-row gather + adam moment update + scatter fused, tables '
+    'aliased in-place')
+
+
+def _adagrad_kernel(uids_ref, valid_ref, lr_ref, gm_ref, p_ref, m_ref,
+                    p_out, m_out, *, eps):
+    i = pl.program_id(0)
+    r = pl.num_programs(0) - 1 - i
+    vm = (valid_ref[r] > 0).astype(jnp.float32)
+    lr = lr_ref[0, 0]
+    g = gm_ref[...]                     # (1, D) merged grad for this slot
+    p_row = p_ref[...]
+    m_row = m_ref[...]
+    m_new = m_row + g * g
+    p_delta = -lr * g / (jnp.sqrt(m_new) + eps) * vm
+    p_out[...] = p_row + p_delta
+    m_out[...] = m_row + (m_new - m_row) * vm
+
+
+def _adam_kernel(uids_ref, valid_ref, lr_ref, gm_ref, p_ref, m1_ref,
+                 m2_ref, p_out, m1_out, m2_out, *, b1, b2, eps):
+    i = pl.program_id(0)
+    r = pl.num_programs(0) - 1 - i
+    vm = (valid_ref[r] > 0).astype(jnp.float32)
+    lr = lr_ref[0, 0]
+    g = gm_ref[...]
+    p_row = p_ref[...]
+    m1_row = m1_ref[...]
+    m2_row = m2_ref[...]
+    m1_new = b1 * m1_row + (1 - b1) * g
+    m2_new = b2 * m2_row + (1 - b2) * g * g
+    p_delta = -lr * m1_new / (jnp.sqrt(m2_new) + eps) * vm
+    p_out[...] = p_row + p_delta
+    m1_out[...] = m1_row + (m1_new - m1_row) * vm
+    m2_out[...] = m2_row + (m2_new - m2_row) * vm
+
+
+def _row_spec(uids_name_unused, n):
+    # param/moment rows: the page table of this kernel is the merged uid
+    # vector — scalar prefetch indexes the row block directly (reversed:
+    # see the hazard analysis in the module docstring)
+    return lambda i, u, v: (u[n - 1 - i], 0)
+
+
+def fused_sparse_adagrad(p, m, uids, gm, valid, lr, eps, interpret=None):
+    """Apply the merged sparse adagrad update in one pallas call.
+    Same contract as the optim_ops fallback: returns (ParamOut,
+    MomentOut) full tables; invalid slots are exact no-ops."""
+    if interpret is None:
+        interpret = interpret_default()
+    n, d = gm.shape
+    uids = uids.astype(jnp.int32)
+    valid = valid.astype(jnp.int32)
+    lr2 = jnp.asarray(lr, jnp.float32).reshape(1, 1)
+    row = _row_spec(uids, n)
+    kern = functools.partial(_adagrad_kernel, eps=float(eps))
+    return pl.pallas_call(
+        kern,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(n,),
+            in_specs=[
+                pl.BlockSpec((1, 1), lambda i, u, v: (0, 0)),
+                pl.BlockSpec((1, d), lambda i, u, v, _n=n: (_n - 1 - i, 0)),
+                pl.BlockSpec((1, d), row),
+                pl.BlockSpec((1, d), row),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, d), row),
+                pl.BlockSpec((1, d), row),
+            ],
+        ),
+        out_shape=[jax.ShapeDtypeStruct(p.shape, p.dtype),
+                   jax.ShapeDtypeStruct(m.shape, m.dtype)],
+        # flattened arg indices (scalar prefetch counts): uids 0, valid
+        # 1, lr 2, gm 3, p 4, m 5 — tables update in place
+        input_output_aliases={4: 0, 5: 1},
+        interpret=interpret,
+    )(uids, valid, lr2, gm, p, m)
+
+
+def fused_sparse_adam(p, m1, m2, uids, gm, valid, lr, b1, b2, eps,
+                      interpret=None):
+    """Apply the merged sparse adam update in one pallas call. `lr` is
+    the bias-corrected rate (the caller applies the beta-pow correction
+    exactly as the fallback does). Returns (ParamOut, Moment1Out,
+    Moment2Out) full tables."""
+    if interpret is None:
+        interpret = interpret_default()
+    n, d = gm.shape
+    uids = uids.astype(jnp.int32)
+    valid = valid.astype(jnp.int32)
+    lr2 = jnp.asarray(lr, jnp.float32).reshape(1, 1)
+    row = _row_spec(uids, n)
+    kern = functools.partial(_adam_kernel, b1=float(b1), b2=float(b2),
+                             eps=float(eps))
+    return pl.pallas_call(
+        kern,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(n,),
+            in_specs=[
+                pl.BlockSpec((1, 1), lambda i, u, v: (0, 0)),
+                pl.BlockSpec((1, d), lambda i, u, v, _n=n: (_n - 1 - i, 0)),
+                pl.BlockSpec((1, d), row),
+                pl.BlockSpec((1, d), row),
+                pl.BlockSpec((1, d), row),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, d), row),
+                pl.BlockSpec((1, d), row),
+                pl.BlockSpec((1, d), row),
+            ],
+        ),
+        out_shape=[jax.ShapeDtypeStruct(p.shape, p.dtype),
+                   jax.ShapeDtypeStruct(m1.shape, m1.dtype),
+                   jax.ShapeDtypeStruct(m2.shape, m2.dtype)],
+        # uids 0, valid 1, lr 2, gm 3, p 4, m1 5, m2 6
+        input_output_aliases={4: 0, 5: 1, 6: 2},
+        interpret=interpret,
+    )(uids, valid, lr2, gm, p, m1, m2)

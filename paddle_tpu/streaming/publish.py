@@ -39,6 +39,7 @@ __all__ = ['DeltaPublisher']
 _G_LAG = obs.gauge('streaming.freshness_lag_s')
 _C_PUSHES = obs.counter('streaming.delta_pushes')
 _C_PUSH_ROWS = obs.counter('streaming.delta_rows')
+_G_PUSH_BYTES = obs.gauge('streaming.delta_push_bytes')
 
 
 class DeltaPublisher(object):
@@ -57,16 +58,32 @@ class DeltaPublisher(object):
     heartbeat: a `parallel.Heartbeat` checked immediately before every
         push — a stale peer raises the typed HostLost BEFORE any
         replica is touched (deltas retained for the survivor's retry).
+    quant: None (fp32 rows, the default) or 'int8' — push each row as
+        int8 + one f32 per-row scale (embedding.quant_rows), cutting
+        value bytes per row from 4*D to D+4 (docs/perf.md). A router
+        with `push_quantized_rows`/`push_quantized_deltas` receives the
+        codec form (rows, q, scale) and dequantizes replica-side;
+        otherwise the publisher dequantizes locally and pushes fp32
+        through the normal methods — the replica then holds exactly the
+        values a quantized wire would have delivered (the documented
+        rounding: <= max|row|/254 per element). `last_push_bytes` and
+        the `streaming.delta_push_bytes` gauge record the VALUE payload
+        either way — the bench.py --phase quant A/B metric.
     """
 
     def __init__(self, router, model_id=None, interval_steps=1,
-                 min_interval_s=0.0, name_map=None, heartbeat=None):
+                 min_interval_s=0.0, name_map=None, heartbeat=None,
+                 quant=None):
+        if quant not in (None, 'int8'):
+            raise ValueError("quant must be None or 'int8', got %r"
+                             % (quant,))
         self._router = router
         self._model_id = model_id
         self.interval_steps = int(interval_steps)
         self.min_interval_s = float(min_interval_s)
         self._name_map = dict(name_map or {})
         self._heartbeat = heartbeat
+        self.quant = quant
         self._lock = threading.Lock()
         self._pending = {}        # table -> set of touched rows
         self._oldest_touch = None  # monotonic time of oldest unpushed touch
@@ -78,6 +95,7 @@ class DeltaPublisher(object):
         self.rows_pushed = 0
         self.last_lag_s = None
         self.last_push_ms = None
+        self.last_push_bytes = None
 
     def collect(self, touched, step=None):
         """Record one step's touched rows: {table: int row ids} — the
@@ -161,16 +179,42 @@ class DeltaPublisher(object):
             return 0
         deltas = {}
         total = 0
+        push_bytes = 0
+        quantized_wire = False
+        if self.quant == 'int8':
+            from ..embedding import quant_rows as qr
+            # codec-aware router: ship (rows, q, scale); otherwise
+            # dequantize here and push fp32 carrying the SAME values a
+            # quantized wire delivers (rounding documented on `quant`)
+            quantized_wire = hasattr(
+                self._router, 'push_quantized_deltas'
+                if self._model_id is not None else 'push_quantized_rows')
         for table, rows in snapshot.items():
             w = read_table(table)
             vals = np.asarray(jnp.take(jnp.asarray(w),
                                        jnp.asarray(rows), axis=0))
-            deltas[self._name_map.get(table, table)] = (rows, vals)
+            name = self._name_map.get(table, table)
+            if self.quant == 'int8':
+                q, scale = qr.quantize_rows(vals)
+                push_bytes += qr.row_bytes(q, scale)
+                if quantized_wire:
+                    deltas[name] = (rows, q, scale)
+                else:
+                    deltas[name] = (rows, qr.dequantize_rows(q, scale))
+            else:
+                deltas[name] = (rows, vals)
+                push_bytes += int(vals.nbytes)
             total += int(rows.size)
         t0 = time.monotonic()
         try:
             if self._model_id is not None:
-                self._router.push_deltas(self._model_id, deltas)
+                if quantized_wire:
+                    self._router.push_quantized_deltas(self._model_id,
+                                                       deltas)
+                else:
+                    self._router.push_deltas(self._model_id, deltas)
+            elif quantized_wire:
+                self._router.push_quantized_rows(deltas)
             else:
                 self._router.push_rows(deltas)
         except Exception:
@@ -199,11 +243,14 @@ class DeltaPublisher(object):
         self.rows_pushed += total
         self.last_lag_s = lag_s
         self.last_push_ms = push_ms
+        self.last_push_bytes = push_bytes
         _C_PUSHES.inc()
         _C_PUSH_ROWS.inc(total)
         _G_LAG.set(lag_s)
+        _G_PUSH_BYTES.set(push_bytes)
         obs.event('streaming.delta_push', ok=True, rows=total,
                   tables=sorted(snapshot), push_ms=round(push_ms, 3),
+                  push_bytes=push_bytes, quant=self.quant or 'fp32',
                   freshness_lag_s=round(lag_s, 4))
         return total
 
@@ -215,4 +262,6 @@ class DeltaPublisher(object):
                 'rows_pushed': self.rows_pushed,
                 'pending_rows': pending,
                 'last_freshness_lag_s': self.last_lag_s,
-                'last_push_ms': self.last_push_ms}
+                'last_push_ms': self.last_push_ms,
+                'last_push_bytes': self.last_push_bytes,
+                'quant': self.quant or 'fp32'}

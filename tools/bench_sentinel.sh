@@ -125,7 +125,12 @@ for name in sorted(set(new) & set(prev)):
     # them on ABSOLUTE delta, not ratio — a hit rate moving 0.02 ->
     # 0.01 is a 2x ratio but a negligible absolute change, while
     # 0.9 -> 0.5 is the real regression the ratio rule under-weights
-    if name.endswith('_hit_rate') or name.endswith('_accept_rate'):
+    # the kernel family's *_mfu (docs/perf.md#kernel-layer) is the same
+    # kind of [0, 1] fraction — model-flop utilization per chip — and
+    # rides the same absolute-delta rule (0.02 -> 0.01 is noise, 0.45 ->
+    # 0.20 is the real regression)
+    if (name.endswith('_hit_rate') or name.endswith('_accept_rate')
+            or name.endswith('_mfu')):
         flag = ''
         if nv < pv - 0.1:
             flag = '  <-- WARNING: rate dropped >0.1 vs %s' % prev_path
@@ -151,7 +156,9 @@ for name in sorted(set(new) & set(prev)):
     # recomputes, bounded by ckpt_every) — both lower-is-better;
     # the tiered-storage family (docs/embedding.md#tiers) adds restore
     # percentiles (*_restore_p50_ms/_p99_ms) that ride the existing
-    # _ms rule by naming — no new case needed
+    # _ms rule by naming — no new case needed; the int8 delta-push
+    # family (docs/perf.md#quantized-inference) adds wire bytes per
+    # push (*_push_bytes) — fewer bytes on the wire is the whole point
     lower_is_better = (name.endswith('_ms') or name.endswith('.dropped')
                        or name.endswith('_temp_bytes')
                        or name.endswith('_stall_s')
@@ -160,6 +167,7 @@ for name in sorted(set(new) & set(prev)):
                        or name.endswith('_detect_s')
                        or name.endswith('_resume_s')
                        or name.endswith('_replayed_tokens')
+                       or name.endswith('_push_bytes')
                        or name.endswith('_compiles'))
     if lower_is_better:
         if ratio > 1.1:

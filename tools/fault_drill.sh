@@ -36,10 +36,18 @@
 # on a survivor). Its 2-process SIGKILL drills are `slow` and so
 # excluded here.
 #
+# The kernel tier (tests/test_kernels.py, marker `kernels`) rides
+# along: knob-off must stay BIT-identical to the pre-kernel lowering,
+# kernel-on A/B parity vs the XLA fallback under the pallas interpreter,
+# the all-invalid sparse batch as a bitwise no-op, and the quant
+# round-trip bounds — the "a kernel never changes answers" gate
+# (docs/perf.md#kernel-layer).
+#
 # Usage: tools/fault_drill.sh [extra pytest args]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 exec env JAX_PLATFORMS=cpu python -m pytest \
-    -m '(faults or elastic or pod or tiered) and not slow' \
+    -m '(faults or elastic or pod or tiered or kernels) and not slow' \
     -q -p no:cacheprovider "$@" tests/test_faults.py tests/test_elastic.py \
-    tests/test_streaming.py tests/test_pod_serving.py tests/test_tiers.py
+    tests/test_streaming.py tests/test_pod_serving.py tests/test_tiers.py \
+    tests/test_kernels.py

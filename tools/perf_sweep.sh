@@ -205,6 +205,31 @@ if [ "${TIER:-0}" = 1 ]; then
       --platform "${BENCH_PLATFORM:-tpu}"
 fi
 
+# 8c4. pallas kernel A/B (opt-in: KERNELS=1): the paged decode-attention
+#      kernel vs the gather+attention XLA lowering through the DecodeEngine
+#      — tokens/sec per leg, per-chip MFU from the analytic per-token
+#      flop count (TPU only; None on CPU where the kernel runs
+#      INTERPRETED and the comparison is parity, not speed), trace-time
+#      kernel dispatch count, and zero steady-state compiles per leg
+#      (docs/perf.md#kernel-layer). The interpret field stamps which
+#      regime the record measured.
+if [ "${KERNELS:-0}" = 1 ]; then
+  run python bench.py --phase kernels \
+      --platform "${BENCH_PLATFORM:-tpu}"
+fi
+
+# 8c5. int8 delta-push A/B (opt-in: QUANT=1): the DeltaPublisher wire
+#      fp32 vs int8 over the SAME touched-row stream — bytes per push
+#      per leg (streaming_*_delta_push_bytes, lower-is-better in
+#      bench_sentinel; int8 must land <= 0.55x fp32), publish p50 ms,
+#      and the row round-trip error vs the documented max|row|/254
+#      bound (docs/perf.md#quantized-inference). Host-side codec, so it
+#      runs regardless of platform.
+if [ "${QUANT:-0}" = 1 ]; then
+  run python bench.py --phase quant \
+      --platform "${BENCH_PLATFORM:-tpu}"
+fi
+
 # 8d. elastic smoke (opt-in: ELASTIC=1): the fast elastic drill tier —
 #     sharded checkpoints through the Trainer, atomic commit + torn-write
 #     fallback, reshard-on-restore topology change, heartbeat staleness
