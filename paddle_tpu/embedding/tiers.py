@@ -722,6 +722,11 @@ class TieredVocabTable(object):
         if tvar is None:
             raise KeyError('no variable %r in the program'
                            % (self.table,))
+        # mark the table var as tier-backed so the STATIC sharding pass
+        # (fluid.analysis.sharding, DimSharding) and program_lint --mesh
+        # can refuse a dim-sharded tiered table before any device is
+        # touched; this runtime raise stays as the backstop
+        tvar.tiered = True
         sh = getattr(tvar, 'sharding', None)
         if sh and any(ax is not None for ax in tuple(sh)[1:]):
             raise DimShardingUnsupported(

@@ -20,7 +20,18 @@ Passes (docs/analysis.md has the catalog):
                              donated-read-only-step bug class);
   4. concurrency           — scope races: persistable writes in programs
                              declared to run concurrently over a shared
-                             scope (serving Predictors, async windows).
+                             scope (serving Predictors, async windows);
+  5. sharding              — annotation consistency against the mesh
+                             spec, incl. the DimSharding refusal of a
+                             dim-sharded TIERED table;
+  6. cost model            — per-device HBM residency / collective
+                             bytes / FLOPs from declared metadata
+                             (costmodel.cost_report), ImplicitReshard
+                             hotspots, HbmOverBudget vs --hbm-budget;
+  7. collective safety     — the statically-derived collective sequence
+                             vs divergent control flow and concurrent
+                             co-hosted modules (CollectiveDivergence,
+                             ConcurrentCollectives).
 
 Entry points:
   * analyze(program, ...)        -> [Finding]   (pure, never raises)
@@ -34,11 +45,15 @@ Entry points:
 import os
 
 from ... import obs
+from . import collectives as _collectives
 from . import concurrency as _concurrency
+from . import costmodel as _costmodel
 from . import dataflow as _dataflow
 from . import donation as _donation
 from . import shapes as _shapes
 from . import sharding as _sharding
+from .collectives import collective_sequence  # noqa: F401
+from .costmodel import CostReport, cost_report  # noqa: F401
 from .dataflow import live_mask  # noqa: F401  (re-export: passes.dce)
 from .donation import executor_donates, executor_write_set, \
     persistable_write_set  # noqa: F401  (re-export: executor uses these)
@@ -51,6 +66,7 @@ __all__ = [
     'Finding', 'ProgramVerifyError', 'SEV_ERROR', 'SEV_WARNING',
     'executor_donates', 'executor_write_set', 'persistable_write_set',
     'live_mask', 'register_infer', 'ENV_VERIFY',
+    'CostReport', 'cost_report', 'collective_sequence',
 ]
 
 # PADDLE_TPU_VERIFY wires analyze() into Executor.run / Predictor load,
@@ -79,7 +95,8 @@ def verify_mode():
 
 def analyze(program, startup=None, feeds=None, fetches=None,
             initialized=None, concurrent=False, donates=None, bundle=False,
-            dead_ops=True, stats=None, mesh_axes=None):
+            dead_ops=True, stats=None, mesh_axes=None, cost=False,
+            hbm_budget=None):
     """Run every pass over `program`; returns sorted [Finding]. Pure: the
     program is never mutated and nothing is raised for findings.
 
@@ -105,8 +122,15 @@ def analyze(program, startup=None, feeds=None, fetches=None,
                   standalone contexts keep it on.
     stats       — optional dict receiving shape-pass coverage counts.
     mesh_axes   — {'dp': 8}-style mesh override for the sharding-
-                  consistency pass (program_lint --mesh); None uses the
-                  program's own set_mesh() spec.
+                  consistency / cost / collective passes
+                  (program_lint --mesh); None uses the program's own
+                  set_mesh() spec.
+    cost        — arm the cost-model pass's ImplicitReshard hotspot
+                  findings (program_lint --cost; cost_report() is the
+                  full-report surface).
+    hbm_budget  — per-device HBM budget in bytes; the cost model emits
+                  an HbmOverBudget ERROR when persistable residency
+                  exceeds it (implies the cost pass).
     """
     findings = []
     findings += _dataflow.run_pass(program, feeds=feeds, fetches=fetches,
@@ -116,6 +140,12 @@ def analyze(program, startup=None, feeds=None, fetches=None,
     findings += _donation.run_pass(program, donates=donates)
     findings += _concurrency.run_pass(program, concurrent=concurrent)
     findings += _sharding.run_pass(program, mesh_axes=mesh_axes)
+    if cost or hbm_budget is not None:
+        findings += _costmodel.run_pass(program, mesh_axes=mesh_axes,
+                                        hbm_budget=hbm_budget,
+                                        feeds=feeds, fetches=fetches)
+    findings += _collectives.run_pass(program, concurrent=concurrent,
+                                      mesh_axes=mesh_axes)
     return sort_findings(findings)
 
 

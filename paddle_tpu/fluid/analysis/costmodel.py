@@ -1,0 +1,546 @@
+"""Pass 6 — static sharding cost model (docs/analysis.md#pass-6).
+
+Per-device HBM/comms/FLOP estimates from the Program's declared
+metadata + its set_mesh spec, before jit ever sees the graph — the
+missing piece ROADMAP items 3 (dim sharding) and 4 (fleet bin-packing)
+both need. The pass walks the IR only: no jax import on the accounting
+path, no device, no weights. What it computes:
+
+  * per-device persistable RESIDENCY — every persistable's bytes at its
+    declared dtype (64-bit declarations priced at the 32-bit width they
+    execute at — the x64-narrowing policy the shape pass shares), with
+    sharded dims divided by their mesh-axis extent when the axis tiles
+    them (untileable dims replicate, exactly the executor's fallback)
+    and int8 quant-marked weights priced at their quantized width
+    (int8 bytes + the per-channel scale);
+  * per-op ACTIVATION bytes and a peak-liveness TEMP estimate — def/
+    last-use intervals over the global block (fetched names live to the
+    end; `analysis.live_mask` drops dead ops from the accounting, the
+    memplan write-set keeps written persistables in residency, not
+    temps);
+  * COLLECTIVE bytes implied by the sharding annotations — the
+    all_to_all lookup wire priced by embedding.lookup.wire_stats, the
+    dp gradient all-reduce over the grad payload, moe/ring exchanges,
+    and resharding hotspots reported as `ImplicitReshard` findings
+    naming both placements;
+  * per-op FLOPs from a small registry (mul/matmul 2·M·K·N, conv2d
+    2·out·Cin/g·kh·kw, elementwise ≈ out elems; default: output
+    elements).
+
+Entry points: `analysis.cost_report(program, mesh_axes=)` returns the
+typed `CostReport` (per-table, per-op-kind, totals; records the
+`analysis.cost` obs span); `run_pass` (wired into `analyze(cost=...)`)
+emits the `ImplicitReshard` findings plus `HbmOverBudget` when an
+`hbm_budget` is declared (program_lint --cost --hbm-budget).
+
+The VALIDATION CONTRACT (drilled by tests/test_analysis.py): on a
+program whose vars carry declared shapes, `residency_per_device` agrees
+with `Executor.compiled_memory_stats().argument_size_in_bytes` minus
+the feed bytes to within max(2 KiB, 5%) — argument bytes ARE the
+persistables (shard-sized for sharded modules) plus feeds, so the
+static number is load-bearing for bin-packing, not decorative.
+"""
+from ... import obs
+from . import collectives as _collectives
+from .dataflow import live_mask, op_reads, op_writes
+from .findings import (Finding, HBM_OVER_BUDGET, IMPLICIT_RESHARD,
+                       SEV_ERROR, SEV_WARNING)
+from .shapes import _canon_dtype
+
+__all__ = ['CostReport', 'cost_report', 'run_pass', 'var_bytes']
+
+# canonical itemsizes at EXECUTED width (x64 narrows — _canon_dtype)
+_ITEMSIZE = {
+    'float32': 4, 'float16': 2, 'bfloat16': 2,
+    'int32': 4, 'uint32': 4, 'int16': 2, 'uint16': 2,
+    'int8': 1, 'uint8': 1, 'bool': 1,
+}
+
+
+def _itemsize(dtype):
+    return _ITEMSIZE.get(_canon_dtype(dtype), 4)
+
+
+def _elems(shape, batch):
+    """Element count of a declared shape, -1 (dynamic batch) -> batch.
+    None shapes (undeclared) price as 0 — report what is provable."""
+    if shape is None:
+        return 0
+    n = 1
+    for d in shape:
+        try:
+            d = int(d)
+        except (TypeError, ValueError):
+            return 0
+        n *= batch if d < 0 else d
+    return n
+
+
+def _axes_of_entry(entry):
+    return entry if isinstance(entry, tuple) else (entry,)
+
+
+def var_bytes(v, axes=None, batch=1):
+    """Per-device bytes of one Variable under mesh `axes`: sharded dims
+    divide by their axis extent when it tiles them; untileable dims
+    replicate (the executor's fallback, flagged separately by the
+    sharding pass)."""
+    if v.shape is None:
+        return 0
+    shape = [batch if int(d) < 0 else int(d) for d in v.shape]
+    spec = getattr(v, 'sharding', None)
+    if axes and spec:
+        for d, entry in enumerate(tuple(spec)[:len(shape)]):
+            if entry is None:
+                continue
+            tile = 1
+            for ax in _axes_of_entry(entry):
+                tile *= int(axes.get(ax, 1))
+            if tile > 1 and shape[d] % tile == 0:
+                shape[d] //= tile
+    n = 1
+    for d in shape:
+        n *= d
+    return n * _itemsize(v.dtype)
+
+
+def _quant_widths(program):
+    """weight name -> (int8 elems-stand-in itemsize, scale bytes) for a
+    QUANT-MARKED program (passes.quant_pass.mark_quant): optimize()
+    will rewrite these weights to int8 + per-channel scale, so the
+    deployment residency prices them at the quantized width. Offline-
+    quantized programs (quantize_weights) need no special casing — the
+    int8/scale persistables already carry their true dtypes."""
+    try:
+        from ..passes import quant_pass
+    except Exception:
+        return {}
+    if not quant_pass.is_quant(program):
+        return {}
+    types = set(getattr(program, '_quant_ops', None) or
+                quant_pass.QUANT_SLOTS)
+    out = {}
+    blk = program.global_block()
+    for op in blk.ops:
+        target = quant_pass._weight_target(blk, op, types)
+        if target is None:
+            continue
+        _, axis, v = target
+        if v.shape is None:
+            continue
+        scale_elems = int(v.shape[axis]) if axis < len(v.shape) else 1
+        out[v.name] = (1, scale_elems * 4)
+    return out
+
+
+def _tables(program):
+    """table name -> [(op, dist_axis-or-None)] over every lookup op."""
+    tables = {}
+    for blk in program.blocks:
+        for op in blk.ops:
+            if op.type not in ('lookup_table', 'quant_lookup_table'):
+                continue
+            ax = op.attrs.get('dist_axis') \
+                if op.attrs.get('is_distributed') else None
+            for v in op.inputs.get('W', []):
+                tables.setdefault(v.name, []).append((op, ax))
+    return tables
+
+
+# -- FLOP registry ---------------------------------------------------------
+
+def _flops_matmul(op, batch):
+    xs = op.inputs.get('X', [])
+    ys = op.inputs.get('Y', [])
+    if not xs or not ys or xs[0].shape is None or ys[0].shape is None:
+        return 0
+    k = int(ys[0].shape[0])
+    n = _elems(ys[0].shape, batch) // max(k, 1)
+    m = _elems(xs[0].shape, batch) // max(k, 1)
+    return 2 * m * k * n
+
+
+def _flops_conv2d(op, batch):
+    outs = op.outputs.get('Output', []) or op.outputs.get('Out', [])
+    filts = op.inputs.get('Filter', [])
+    if not outs or not filts or filts[0].shape is None:
+        return 0
+    fshape = filts[0].shape      # [Cout, Cin/groups, kh, kw]
+    per_out = 2
+    for d in fshape[1:]:
+        per_out *= int(d)
+    return _elems(outs[0].shape, batch) * per_out
+
+
+def _flops_default(op, batch):
+    return sum(_elems(v.shape, batch)
+               for vs in op.outputs.values() for v in vs)
+
+
+_FLOP_RULES = {
+    'mul': _flops_matmul,
+    'matmul': _flops_matmul,
+    'conv2d': _flops_conv2d,
+    'softmax': lambda op, b: 5 * _flops_default(op, b),
+}
+
+
+def _op_flops(op, batch):
+    try:
+        return int(_FLOP_RULES.get(op.type, _flops_default)(op, batch))
+    except Exception:
+        return 0
+
+
+# -- the report ------------------------------------------------------------
+
+class CostReport(object):
+    """Typed result of the static cost model (see module docstring).
+    All byte figures are PER DEVICE unless suffixed _total."""
+
+    __slots__ = ('mesh', 'n_devices', 'batch',
+                 'residency_per_device', 'residency_total',
+                 'persistables', 'tables',
+                 'activation_bytes', 'peak_temp_bytes',
+                 'collectives', 'comm_bytes_per_step',
+                 'flops_per_step', 'flops_per_device', 'flops_by_kind')
+
+    def __init__(self):
+        self.mesh = None
+        self.n_devices = 1
+        self.batch = 1
+        self.residency_per_device = 0
+        self.residency_total = 0
+        self.persistables = {}
+        self.tables = {}
+        self.activation_bytes = 0
+        self.peak_temp_bytes = 0
+        self.collectives = []
+        self.comm_bytes_per_step = 0
+        self.flops_per_step = 0
+        self.flops_per_device = 0
+        self.flops_by_kind = {}
+
+    def to_dict(self):
+        return {
+            'mesh': dict(self.mesh) if self.mesh else None,
+            'n_devices': self.n_devices, 'batch': self.batch,
+            'residency_per_device': self.residency_per_device,
+            'residency_total': self.residency_total,
+            'persistables': self.persistables,
+            'tables': self.tables,
+            'activation_bytes': self.activation_bytes,
+            'peak_temp_bytes': self.peak_temp_bytes,
+            'collectives': self.collectives,
+            'comm_bytes_per_step': self.comm_bytes_per_step,
+            'flops_per_step': self.flops_per_step,
+            'flops_per_device': self.flops_per_device,
+            'flops_by_kind': self.flops_by_kind,
+        }
+
+    def summary(self):
+        """The program_lint --cost text block."""
+        mesh = ('x'.join('%s=%d' % kv for kv in self.mesh.items())
+                if self.mesh else 'none')
+        lines = [
+            'cost model: mesh=%s devices=%d batch=%d' % (
+                mesh, self.n_devices, self.batch),
+            '  residency/device: %s (%d persistable(s); total %s)' % (
+                _fmt_bytes(self.residency_per_device),
+                len(self.persistables),
+                _fmt_bytes(self.residency_total)),
+        ]
+        for name, t in sorted(self.tables.items()):
+            lines.append(
+                '    table %s: %dx%d %s, %s/device%s' % (
+                    name, t['rows'], t['dim'], t['dtype'],
+                    _fmt_bytes(t['bytes_per_device']),
+                    ', all_to_all over %r' % t['dist_axis']
+                    if t['dist_axis'] else ''))
+        lines.append(
+            '  activations: %s declared, peak-liveness temp %s' % (
+                _fmt_bytes(self.activation_bytes),
+                _fmt_bytes(self.peak_temp_bytes)))
+        lines.append(
+            '  collectives: %d/step, %s/device/step on the wire' % (
+                len(self.collectives),
+                _fmt_bytes(self.comm_bytes_per_step)))
+        for c in self.collectives:
+            lines.append('    %s over %r by %s: %s' % (
+                c['kind'], c['axis'], c['op_type'],
+                _fmt_bytes(c['bytes_per_device'])))
+        lines.append('  flops/step: %.3g (%.3g/device)' % (
+            self.flops_per_step, self.flops_per_device))
+        return '\n'.join(lines)
+
+
+def _fmt_bytes(n):
+    for unit in ('B', 'KiB', 'MiB', 'GiB'):
+        if abs(n) < 1024 or unit == 'GiB':
+            return ('%d%s' % (n, unit) if unit == 'B'
+                    else '%.1f%s' % (n, unit))
+        n /= 1024.0
+    return '%dB' % n
+
+
+def cost_report(program, mesh_axes=None, batch=1, feeds=None,
+                fetches=None):
+    """Compute the CostReport for `program` (pure — the program is
+    never mutated). mesh_axes overrides the program's set_mesh spec
+    (program_lint --mesh); batch resolves dynamic (-1) dims; fetches
+    extends activation liveness to the block end. Records the
+    `analysis.cost` obs span."""
+    with obs.span('analysis.cost') as sp:
+        rep = _cost_report(program, mesh_axes=mesh_axes, batch=batch,
+                           feeds=feeds, fetches=fetches)
+        sp.fields['residency_per_device'] = rep.residency_per_device
+        sp.fields['comm_bytes_per_step'] = rep.comm_bytes_per_step
+        sp.fields['collectives'] = len(rep.collectives)
+    return rep
+
+
+def _cost_report(program, mesh_axes=None, batch=1, feeds=None,
+                 fetches=None):
+    axes = _collectives.resolve_axes(program, mesh_axes)
+    rep = CostReport()
+    rep.mesh = axes
+    rep.batch = int(batch)
+    n_dev = 1
+    for s in (axes or {}).values():
+        n_dev *= int(s)
+    rep.n_devices = n_dev
+
+    # -- residency: every persistable at its per-device width ------------
+    quant = _quant_widths(program)
+    tables = _tables(program)
+    seen = set()
+    for v in program.list_vars():
+        if not getattr(v, 'persistable', False) or v.name in seen:
+            continue
+        seen.add(v.name)
+        if v.name in quant:
+            q_item, scale_b = quant[v.name]
+            elems = _elems(v.shape, batch)
+            spec = getattr(v, 'sharding', None)
+            full = _elems(v.shape, batch) * _itemsize(v.dtype)
+            shard = var_bytes(v, axes, batch)
+            # shard the int8 elems the way the f32 var is annotated
+            b = (elems * q_item * shard // full if full else 0) + scale_b
+            qmark = True
+        else:
+            b = var_bytes(v, axes, batch)
+            qmark = False
+        rep.residency_per_device += b
+        rep.persistables[v.name] = {
+            'shape': list(v.shape) if v.shape is not None else None,
+            'dtype': v.dtype, 'bytes_per_device': b,
+            'sharding': _jsonable_spec(getattr(v, 'sharding', None)),
+            'quant': qmark,
+        }
+        if v.name in tables and v.shape is not None and len(v.shape) >= 2:
+            rep.tables[v.name] = {
+                'rows': int(v.shape[0]), 'dim': int(v.shape[1]),
+                'dtype': v.dtype, 'bytes_per_device': b,
+                'sharding': _jsonable_spec(getattr(v, 'sharding', None)),
+                'dist_axis': next((ax for _, ax in tables[v.name] if ax),
+                                  None),
+            }
+    rep.residency_total = rep.residency_per_device * n_dev
+
+    # -- activations: def/last-use intervals over the global block -------
+    blk = program.global_block()
+    fetch_names = set(fetches or ())
+    try:
+        live = live_mask(program, blk, fetch_names) if fetch_names \
+            else [True] * len(blk.ops)
+    except Exception:
+        live = [True] * len(blk.ops)
+    intervals = {}   # name -> [def_idx, last_use_idx, bytes]
+    for i, op in enumerate(blk.ops):
+        if not live[i]:
+            continue
+        try:
+            reads = op_reads(program, op)
+        except Exception:
+            reads = set(op.input_arg_names)
+        for n in reads:
+            if n in intervals:
+                intervals[n][1] = i
+        for slot_vs in op.outputs.values():
+            for v in slot_vs:
+                if getattr(v, 'persistable', False) or \
+                        getattr(v, 'is_data', False):
+                    continue
+                b = var_bytes(v, axes, batch)
+                if v.name not in intervals:
+                    intervals[v.name] = [i, i, b]
+                else:
+                    intervals[v.name][1] = i
+    end = len(blk.ops) - 1
+    for n in fetch_names:
+        if n in intervals:
+            intervals[n][1] = end
+    rep.activation_bytes = sum(b for _, _, b in intervals.values())
+    peak = 0
+    for i in range(len(blk.ops)):
+        here = sum(b for d, u, b in intervals.values() if d <= i <= u)
+        peak = max(peak, here)
+    rep.peak_temp_bytes = peak
+
+    # -- flops -----------------------------------------------------------
+    for i, op in enumerate(blk.ops):
+        if not live[i]:
+            continue
+        f = _op_flops(op, batch)
+        if f:
+            rep.flops_per_step += f
+            rep.flops_by_kind[op.type] = \
+                rep.flops_by_kind.get(op.type, 0) + f
+    rep.flops_per_device = (rep.flops_per_step // n_dev if n_dev > 1
+                            else rep.flops_per_step)
+
+    # -- collectives -------------------------------------------------------
+    if axes:
+        rep.collectives = _price_collectives(program, axes, batch, n_dev)
+        rep.comm_bytes_per_step = sum(
+            c['bytes_per_device'] for c in rep.collectives)
+    return rep
+
+
+def _jsonable_spec(spec):
+    if spec is None:
+        return None
+    return [list(e) if isinstance(e, tuple) else e for e in spec]
+
+
+def _price_collectives(program, axes, batch, n_dev):
+    """Byte-priced entries for the statically-derived collective
+    sequence (analysis.collectives shares the derivation)."""
+    out = []
+    seq = _collectives.collective_sequence(program, mesh_axes=axes)
+    # lookup pairs price as (ids out, rows back) via wire_stats
+    lookup_leg = {}
+    for blk_idx, op_idx, op, kind, ax in seq:
+        bytes_dev = 0
+        t = op.type
+        if t in ('lookup_table', 'quant_lookup_table'):
+            leg = lookup_leg.get((blk_idx, op_idx), 0)
+            lookup_leg[(blk_idx, op_idx)] = leg + 1
+            bytes_dev = _lookup_wire_bytes(op, axes, ax, batch, leg)
+        elif t == 'autodiff':
+            bytes_dev = _grad_bytes(program, op, axes, batch)
+        else:
+            # activation exchange: the op's input payload, per device
+            bytes_dev = sum(
+                var_bytes(v, axes, batch)
+                for vs in op.inputs.values() for v in vs)
+        out.append({'block': blk_idx, 'op_index': op_idx,
+                    'op_type': t, 'kind': kind, 'axis': ax,
+                    'bytes_per_device': int(bytes_dev)})
+    return out
+
+
+def _lookup_wire_bytes(op, axes, ax, batch, leg):
+    """One leg of the all_to_all lookup exchange, via the same
+    wire_stats accounting the runtime obs event records
+    (embedding/lookup.py)."""
+    try:
+        from ...embedding.lookup import wire_stats
+    except Exception:
+        return 0
+    ws = op.inputs.get('W', [])
+    ids = op.inputs.get('Ids', [])
+    if not ws or not ids or ws[0].shape is None or ids[0].shape is None:
+        return 0
+    n_ids = _elems(ids[0].shape, batch)
+    vocab, dim = int(ws[0].shape[0]), int(ws[0].shape[1])
+    stats = wire_stats(n_ids, vocab, dim, int(axes.get(ax, 1)),
+                       itemsize=_itemsize(ws[0].dtype))
+    return stats['id_bytes_per_device'] if leg == 0 \
+        else stats['row_bytes_per_device']
+
+
+def _grad_bytes(program, op, axes, batch):
+    """The dp all-reduce payload: every gradient's per-device bytes."""
+    total = 0
+    for v in op.outputs.get('Grads', []):
+        total += var_bytes(v, axes, batch)
+    if not total:
+        blk = op.block
+        for n in op.attrs.get('grad_names', ()) or ():
+            v = blk.vars.get(n)
+            if v is not None:
+                total += var_bytes(v, axes, batch)
+    return total
+
+
+# -- the analyze() pass ----------------------------------------------------
+
+def run_pass(program, mesh_axes=None, hbm_budget=None, batch=1,
+             feeds=None, fetches=None):
+    """ImplicitReshard findings (always — metadata only) plus
+    HbmOverBudget when `hbm_budget` (bytes) is declared. Never raises:
+    an un-priceable program reports what it can and stays quiet about
+    the rest (the analyze() contract)."""
+    findings = []
+    axes = _collectives.resolve_axes(program, mesh_axes)
+
+    # ImplicitReshard: the same-shaped value re-placed across one op —
+    # GSPMD satisfies the transition with a hidden all-gather/all-to-all
+    # at that edge (the resharding hotspot class)
+    if axes:
+        for blk in program.blocks:
+            for op in blk.ops:
+                ins = [v for vs in op.inputs.values() for v in vs
+                       if getattr(v, 'sharding', None)]
+                if not ins:
+                    continue
+                for vs in op.outputs.values():
+                    for ov in vs:
+                        osp = getattr(ov, 'sharding', None)
+                        if not osp or ov.shape is None:
+                            continue
+                        for iv in ins:
+                            if iv.shape != ov.shape or \
+                                    tuple(iv.sharding) == tuple(osp):
+                                continue
+                            findings.append(Finding.for_op(
+                                IMPLICIT_RESHARD, SEV_WARNING,
+                                '%r is placed %r but flows into %r '
+                                'placed %r: the transition lowers to a '
+                                'hidden all-gather/all-to-all at this '
+                                'edge (~%s on the wire) — annotate both '
+                                'ends identically, or make the reshard '
+                                'explicit where the cost is intended'
+                                % (iv.name, tuple(iv.sharding), ov.name,
+                                   tuple(osp),
+                                   _fmt_bytes(var_bytes(
+                                       iv, axes, batch))), op,
+                                var_names=(iv.name, ov.name)))
+
+    if hbm_budget is not None:
+        try:
+            rep = _cost_report(program, mesh_axes=mesh_axes, batch=batch,
+                               feeds=feeds, fetches=fetches)
+        except Exception:
+            rep = None   # un-priceable artifact: no budget verdict
+        if rep is not None and \
+                rep.residency_per_device > int(hbm_budget):
+            findings.append(Finding(
+                HBM_OVER_BUDGET, SEV_ERROR,
+                'per-device persistable residency %s exceeds the '
+                'declared HBM budget %s by %s (mesh %s, %d device(s)) '
+                '— shard more dims, quantize weights '
+                '(passes.quant_pass), or spill cold rows to the host '
+                'tier (embedding.TieredVocabTable)'
+                % (_fmt_bytes(rep.residency_per_device),
+                   _fmt_bytes(int(hbm_budget)),
+                   _fmt_bytes(rep.residency_per_device
+                              - int(hbm_budget)),
+                   'x'.join('%s=%d' % kv for kv in (axes or {}).items())
+                   or 'none', rep.n_devices),
+                var_names=tuple(sorted(
+                    rep.persistables,
+                    key=lambda n: -rep.persistables[n]
+                    ['bytes_per_device'])[:5])))
+    return findings

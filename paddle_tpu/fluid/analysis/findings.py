@@ -15,6 +15,8 @@ __all__ = [
     'USE_BEFORE_WRITE', 'SHAPE_MISMATCH', 'DTYPE_MISMATCH',
     'DONATION_UNSAFE', 'SCOPE_RACE', 'SHARDING_INVALID',
     'SHARDING_UNTILEABLE', 'SHARDING_RESHARD', 'EMBEDDING_UNTILEABLE',
+    'HBM_OVER_BUDGET', 'IMPLICIT_RESHARD', 'COLLECTIVE_DIVERGENCE',
+    'CONCURRENT_COLLECTIVES', 'DIM_SHARDING',
 ]
 
 SEV_ERROR = 'error'       # the program cannot run correctly as lowered
@@ -40,6 +42,23 @@ SHARDING_RESHARD = 'ShardingReshard'        # resharding implied mid-pipeline
 # of the fallback is a silent replicate of the one tensor the annotation
 # existed to shard (docs/embedding.md)
 EMBEDDING_UNTILEABLE = 'EmbeddingShardUntileable'
+# cost-model pass (analysis/costmodel.py — docs/analysis.md#pass-6):
+# per-device persistable residency exceeds a declared --hbm-budget, or a
+# var is re-placed mid-program (a sharding transition GSPMD satisfies
+# with a hidden all-gather/all-to-all at the edge)
+HBM_OVER_BUDGET = 'HbmOverBudget'
+IMPLICIT_RESHARD = 'ImplicitReshard'
+# collective-safety pass (analysis/collectives.py — docs/analysis.md
+# #pass-7): a collective issued under divergent control flow (the
+# rendezvous-hang class), or a concurrent-declared program issuing
+# collectives at all (today survived only by serving/pod.py's
+# process-wide _MESH_DISPATCH_LOCK)
+COLLECTIVE_DIVERGENCE = 'CollectiveDivergence'
+CONCURRENT_COLLECTIVES = 'ConcurrentCollectives'
+# a dim-sharded TIERED table: spills gather whole rows, so the tier
+# store statically refuses the embedding-dim sharding the runtime guard
+# (embedding/tiers.py validate_program) would reject at train start
+DIM_SHARDING = 'DimSharding'
 
 _SEV_ORDER = {SEV_ERROR: 0, SEV_WARNING: 1}
 

@@ -34,9 +34,9 @@ program. `mesh_axes` overrides the program's own spec — that is how
 `tools/program_lint.py --mesh dpx8,tpx2` lints a saved artifact against a
 deployment mesh it was not annotated with.
 """
-from .findings import (EMBEDDING_UNTILEABLE, Finding, SEV_ERROR,
-                       SEV_WARNING, SHARDING_INVALID, SHARDING_RESHARD,
-                       SHARDING_UNTILEABLE)
+from .findings import (DIM_SHARDING, EMBEDDING_UNTILEABLE, Finding,
+                       SEV_ERROR, SEV_WARNING, SHARDING_INVALID,
+                       SHARDING_RESHARD, SHARDING_UNTILEABLE)
 
 __all__ = ['run_pass']
 
@@ -107,6 +107,23 @@ def run_pass(program, mesh_axes=None):
 
     for v in annotated:
         spec = v.sharding
+        # a TIER-BACKED table (Variable.tiered — embedding/tiers.py
+        # stamps it, and the mark survives the artifact round-trip)
+        # whose spec shards any dim past the vocab dim: spills gather
+        # WHOLE rows, so a dim sharding would tear rows across hosts.
+        # The static twin of tiers.validate_program's runtime
+        # DimShardingUnsupported raise (which stays as the backstop).
+        if getattr(v, 'tiered', False) and \
+                any(ax is not None for ax in tuple(spec)[1:]):
+            findings.append(_var_finding(
+                DIM_SHARDING, SEV_ERROR,
+                'tiered table %r shards its EMBEDDING dim (sharding=%r) '
+                '— the host-RAM tier store spills/restores WHOLE rows, '
+                'so a dim sharding would tear rows across hosts. Column '
+                'sharding for D > HBM is ROADMAP item 3; row-shard the '
+                'table (e.g. sharding=(%r, None)) instead'
+                % (v.name, tuple(spec),
+                   tuple(spec)[1] if len(spec) > 1 else 'model'), v))
         ndim = len(v.shape) if v.shape is not None else None
         if ndim is not None and len(spec) > ndim:
             findings.append(_var_finding(

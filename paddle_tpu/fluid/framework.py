@@ -169,6 +169,7 @@ class Variable(object):
                  type=None,
                  initializer=None,
                  sharding=None,
+                 tiered=False,
                  **kwargs):
         self.block = block
         if name is None:
@@ -184,6 +185,11 @@ class Variable(object):
         self.sharding = normalize_sharding(sharding)
         self._annot_callsite = (_capture_callsite()
                                 if self.sharding is not None else None)
+        # backed by a host-RAM tier store (embedding.TieredVocabTable
+        # stamps this): spills gather WHOLE rows, so the static sharding
+        # pass refuses an embedding-dim sharding on a tiered table
+        # (DimSharding) the way tiers.validate_program would at runtime
+        self.tiered = bool(tiered)
         self.shape = tuple(int(d) for d in shape) if shape is not None else None
         if self.shape is not None and DYN_DIM in self.shape:
             raise ValueError(
@@ -234,6 +240,11 @@ class Variable(object):
             # only when annotated: un-annotated programs serialize
             # byte-identically to pre-sharding artifacts
             d['sharding'] = _sharding_to_jsonable(self.sharding)
+        if self.tiered:
+            # same only-when-set policy: the tier mark survives clone()
+            # and the artifact round-trip so program_lint --mesh can
+            # refuse a dim-sharded tiered table statically
+            d['tiered'] = True
         return d
 
 

@@ -351,6 +351,34 @@ def summarize(events):
             lines.append('%d optimizer failure(s) fell back to the '
                          'unoptimized lowering' % len(errs))
 
+    # -- analysis ---------------------------------------------------------
+    # the build-time verifier gate (analysis.verify — one span per
+    # (program, context) key PADDLE_TPU_VERIFY judged) and the static
+    # cost model (analysis.cost — one span per cost_report() pricing;
+    # docs/analysis.md#pass-6)
+    ver_spans = _spans(events, 'analysis.verify')
+    cost_spans = _spans(events, 'analysis.cost')
+    if ver_spans or cost_spans:
+        lines.append('')
+        lines.append('-- analysis --')
+        if ver_spans:
+            nf = sum(int(s.get('fields', {}).get('findings', 0))
+                     for s in ver_spans)
+            ne = sum(int(s.get('fields', {}).get('errors', 0))
+                     for s in ver_spans)
+            lines.append('%d program(s) verified: %d finding(s), '
+                         '%d error-severity' % (len(ver_spans), nf, ne))
+        if cost_spans:
+            res = max(int(s.get('fields', {})
+                          .get('residency_per_device', 0))
+                      for s in cost_spans)
+            comm = max(int(s.get('fields', {})
+                           .get('comm_bytes_per_step', 0))
+                       for s in cost_spans)
+            lines.append('cost model: %d report(s); max residency '
+                         '%d bytes/device, max wire %d bytes/step'
+                         % (len(cost_spans), res, comm))
+
     # -- kernels ----------------------------------------------------------
     # pallas kernel layer (docs/perf.md#kernel-layer): one
     # kernels.dispatch event per TRACE-time routing decision — mode

@@ -29,7 +29,7 @@ from .decode import (DecodeConfig, DecodeEngine,  # noqa: F401
 from .engine import (DeadlineExceeded, ServerClosed,  # noqa: F401
                      ServerOverloaded, ServingConfig, ServingEngine)
 from .router import (ModelOverloaded, Router,  # noqa: F401
-                     TokenStream, UnknownModel)
+                     TokenStream, UnknownModel, estimate_state_bytes)
 from .transport import Channel, RpcServer, TransportError  # noqa: F401
 from .pod import (AutoscalePolicy, Autoscaler, PodRouter,  # noqa: F401
                   PodWorker, RemoteReplica, RpcReplica, ShardedPredictor,
@@ -42,6 +42,7 @@ __all__ = ['ServingEngine', 'ServingConfig', 'ServerOverloaded',
            'LockstepDecoder', 'StreamCancelled', 'mt_weights',
            'program_prefill',
            'Router', 'ModelOverloaded', 'TokenStream', 'UnknownModel',
+           'estimate_state_bytes',
            'pages', 'PagePool', 'PrefixCache',
            'transport', 'Channel', 'RpcServer', 'TransportError',
            'PodRouter', 'PodWorker', 'RemoteReplica', 'RpcReplica',

@@ -41,7 +41,40 @@ from ..obs import trace
 from .engine import (DeadlineExceeded, ServerClosed, ServerOverloaded,
                      ServingEngine)
 
-__all__ = ['Router', 'ModelOverloaded', 'TokenStream', 'UnknownModel']
+__all__ = ['Router', 'ModelOverloaded', 'TokenStream', 'UnknownModel',
+           'estimate_state_bytes']
+
+
+def estimate_state_bytes(artifact, mesh_axes=None, batch=1):
+    """Static per-device byte footprint of a model NEVER loaded — the
+    bin-packing twin of `DecodeEngine.state_bytes()` (ROADMAP item 4:
+    a fleet scheduler placing N models x M replicas onto hosts needs
+    the footprint of artifacts it has not paid to load).
+
+    `artifact` is a model dir (containing `__model__.json`), a path to
+    the `__model__.json` itself, or an already-built `fluid.Program`.
+    Only the program JSON is read — weights are never touched, no
+    device is involved. Returns `residency + peak-liveness temp` bytes
+    per device from `fluid.analysis.cost_report` (the A/B'd-against-
+    `compiled_memory_stats()` estimate, docs/analysis.md#pass-6);
+    `mesh_axes` prices a deployment mesh the artifact was not
+    annotated with (the program_lint --mesh posture)."""
+    import json as _json
+    import os as _os
+    from ..fluid import analysis
+    from ..fluid.framework import Program
+    if isinstance(artifact, Program):
+        program = artifact
+    else:
+        path = artifact
+        if _os.path.isdir(path):
+            path = _os.path.join(path, '__model__.json')
+        with open(path) as f:
+            meta = _json.load(f)
+        program = Program._from_dict(
+            meta['program'] if 'program' in meta else meta)
+    rep = analysis.cost_report(program, mesh_axes=mesh_axes, batch=batch)
+    return rep.residency_per_device + rep.peak_temp_bytes
 
 
 class UnknownModel(KeyError):

@@ -743,3 +743,433 @@ def test_program_lint_mesh_flag_one_json_document(tmp_path):
     assert rc == 1
     assert [f['kind'] for f in doc['findings']] == [EMBEDDING_UNTILEABLE]
     assert 'pad_vocab' in doc['findings'][0]['message']
+
+
+# ------------------------------------------------------ cost model (pass 6)
+# The validation contract (docs/analysis.md#pass-6): static per-device
+# residency agrees with XLA's own compiled_memory_stats() to within
+# max(2 KiB, 5%) — argument bytes ARE persistables (shard-sized) + feeds.
+
+def _feed_bytes(feed):
+    """Feed bytes at EXECUTED width: x64 declarations narrow to 32-bit
+    on device (the shapes-pass policy), so int64 ids upload as int32."""
+    total = 0
+    for a in feed.values():
+        a = np.asarray(a)
+        item = 4 if a.dtype.itemsize == 8 else a.dtype.itemsize
+        total += a.size * item
+    return total
+
+
+def _residency_ab(main, feed, fetches, batch):
+    """(estimated, measured) per-device residency for one program."""
+    exe = fluid.Executor(fluid.CPUPlace())
+    stats = exe.compiled_memory_stats(main, feed=feed, fetch_list=fetches)
+    measured = stats.argument_size_in_bytes - _feed_bytes(feed)
+    rep = analysis.cost_report(main, batch=batch, fetches=fetches)
+    return rep.residency_per_device, measured
+
+
+def _assert_tolerance(est, measured):
+    assert abs(est - measured) <= max(2048, 0.05 * measured), \
+        'estimate %d vs measured %d exceeds max(2KiB, 5%%)' % (est,
+                                                               measured)
+
+
+class TestCostModelResidencyAB:
+    """cost_report residency vs Executor.compiled_memory_stats on real
+    programs — the load-bearing-not-decorative acceptance drill."""
+
+    def test_dense_fc_program(self):
+        with fresh_program() as (main, startup):
+            x = layers.data(name='x', shape=[16], dtype='float32')
+            pred = layers.fc(input=x, size=32)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            feed = {'x': np.zeros((4, 16), dtype='float32')}
+            est, measured = _residency_ab(main, feed, [pred.name], 4)
+            # W [16,32] + b [32] = 2176 bytes, exactly
+            assert measured == 2176
+            _assert_tolerance(est, measured)
+
+    def test_sharded_embedding_program_counts_per_shard(self):
+        with fresh_program() as (main, startup):
+            ids = layers.data(name='ids', shape=[1], dtype='int64')
+            emb = layers.embedding(
+                input=ids, size=[64, 16], is_distributed=True,
+                param_attr=fluid.ParamAttr(name='emb_w',
+                                           sharding=('model', None)))
+            pred = layers.fc(input=emb, size=8)
+            main.set_mesh({'model': 8}, data_axis=False)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            feed = {'ids': np.zeros((4, 1), dtype='int64')}
+            est, measured = _residency_ab(main, feed, [pred.name], 4)
+            # the [64,16] table counts PER SHARD (512B), not whole (4KiB)
+            rep = analysis.cost_report(main, batch=4)
+            assert rep.persistables['emb_w']['bytes_per_device'] == 512
+            assert rep.tables['emb_w']['dist_axis'] == 'model'
+            _assert_tolerance(est, measured)
+
+    def test_offline_quantized_program_counts_int8_width(self):
+        from paddle_tpu.fluid.passes import quant_pass
+        with fresh_program() as (main, startup):
+            x = layers.data(name='x', shape=[16], dtype='float32')
+            pred = layers.fc(input=x, size=32, bias_attr=False,
+                             param_attr=fluid.ParamAttr(name='qw'))
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            scope = fluid.executor.global_scope()
+            assert quant_pass.quantize_weights(main, scope) == 1
+            feed = {'x': np.zeros((4, 16), dtype='float32')}
+            est, measured = _residency_ab(main, feed, [pred.name], 4)
+            # int8 [16,32] = 512B + f32 per-channel scale [1,32] = 128B;
+            # the f32 weight is DROPPED from both program and upload
+            assert 'qw' not in {n for n in
+                                analysis.cost_report(main).persistables}
+            assert measured == 640
+            _assert_tolerance(est, measured)
+
+    def test_quant_marked_program_prices_quantized_width(self):
+        """mark_quant (the fake-quant pass form): the cost model prices
+        the weight at its DEPLOYMENT width — int8 + scale, not f32."""
+        from paddle_tpu.fluid.passes import quant_pass
+        with fresh_program() as (main, _):
+            x = layers.data(name='x', shape=[16], dtype='float32')
+            layers.fc(input=x, size=32, bias_attr=False,
+                      param_attr=fluid.ParamAttr(name='qw'))
+            plain = analysis.cost_report(main).residency_per_device
+            quant_pass.mark_quant(main)
+            marked = analysis.cost_report(main)
+            assert marked.persistables['qw']['quant'] is True
+            # 16*32 int8 + 32 f32 scales = 640 < 2048 f32
+            assert marked.residency_per_device == 640 < plain == 2048
+
+
+class TestCostModelFindings:
+
+    def test_implicit_reshard_names_both_placements(self):
+        with fresh_program() as (main, _):
+            x = layers.data(name='x', shape=[8], dtype='float32')
+            h = layers.relu(x)
+            h.sharding = framework.normalize_sharding(('dp', None))
+            y = layers.scale(h, scale=1.0)
+            y.sharding = framework.normalize_sharding((None, 'dp'))
+            main.set_mesh({'dp': 8})
+            fs = [f for f in analysis.analyze(main, cost=True)
+                  if f.kind == 'ImplicitReshard']
+            assert len(fs) == 1 and fs[0].severity == 'warning'
+            assert "('dp', None)" in fs[0].message
+            assert "(None, 'dp')" in fs[0].message
+            assert set(fs[0].var_names) == {h.name, y.name}
+            # not armed -> the hotspot scan does not run
+            assert not [f for f in analysis.analyze(main)
+                        if f.kind == 'ImplicitReshard']
+
+    def test_hbm_over_budget_is_error_finding(self):
+        with fresh_program() as (main, _):
+            x = layers.data(name='x', shape=[16], dtype='float32')
+            layers.fc(input=x, size=32)
+            fs = [f for f in analysis.analyze(main, hbm_budget=1024)
+                  if f.kind == 'HbmOverBudget']
+            assert len(fs) == 1 and fs[0].severity == 'error'
+            assert not [f for f in analysis.analyze(main,
+                                                    hbm_budget=1 << 20)
+                        if f.kind == 'HbmOverBudget']
+
+    def test_cost_report_collectives_and_span(self, tmp_path):
+        from paddle_tpu import obs
+        from paddle_tpu.obs import report as obs_report
+        obs.enable(str(tmp_path / 'obs'))
+        try:
+            with fresh_program() as (main, _):
+                ids = layers.data(name='ids', shape=[1], dtype='int64')
+                emb = layers.embedding(
+                    input=ids, size=[64, 16], is_distributed=True,
+                    param_attr=fluid.ParamAttr(name='emb_w',
+                                               sharding=('model', None)))
+                layers.fc(input=emb, size=8)
+                main.set_mesh({'model': 8}, data_axis=False)
+                rep = analysis.cost_report(main, batch=4)
+            # the all_to_all lookup wire: ids out + rows back
+            assert [c['kind'] for c in rep.collectives] == \
+                ['all_to_all', 'all_to_all']
+            assert rep.comm_bytes_per_step == sum(
+                c['bytes_per_device'] for c in rep.collectives) > 0
+            events, errors = obs_report.load_events(obs.run_log_path())
+            assert errors == []
+            spans = [e for e in events if e.get('kind') == 'span'
+                     and e['name'] == 'analysis.cost']
+            assert spans and spans[0]['fields']['collectives'] == 2
+            text = obs_report.summarize(events)
+            assert '-- analysis --' in text and 'cost model:' in text
+        finally:
+            obs._reset()
+
+
+# ----------------------------------------------- collective safety (pass 7)
+
+def _dist_lookup_program(main):
+    """The two-sharded-replica serving shape (test_pod_serving.py): a
+    row-sharded is_distributed lookup + fc, feeds replicated."""
+    ids = layers.data(name='ids', shape=[1], dtype='int64')
+    emb = layers.embedding(
+        input=ids, size=[64, 16], is_distributed=True,
+        param_attr=fluid.ParamAttr(name='emb_w',
+                                   sharding=('model', None)))
+    pred = layers.fc(input=emb, size=8)
+    main.set_mesh({'model': 8}, data_axis=False)
+    return pred
+
+
+class TestCollectiveSafety:
+
+    def test_concurrent_collectives_points_at_pod_lock(self):
+        with fresh_program() as (main, _):
+            pred = _dist_lookup_program(main)
+            fs = [f for f in analysis.analyze(main, feeds=['ids'],
+                                              fetches=[pred.name],
+                                              concurrent=True)
+                  if f.kind == 'ConcurrentCollectives']
+            assert len(fs) == 1
+            # WARNING, not error: the pod lock DOES serialize, and
+            # ShardedPredictor verifies with concurrent=True under
+            # PADDLE_TPU_VERIFY=error — legitimate sharded replicas
+            # must keep loading
+            assert fs[0].severity == 'warning'
+            assert '_MESH_DISPATCH_LOCK' in fs[0].message
+            assert 'serving/pod.py' in fs[0].message
+            assert 'emb_w' in fs[0].var_names
+            analysis.report_findings(fs, mode='error')  # must not raise
+            # not concurrent, or no mesh: no hazard
+            assert not [f for f in analysis.analyze(
+                main, feeds=['ids'], fetches=[pred.name])
+                if f.kind == 'ConcurrentCollectives']
+
+    def test_branch_only_collective_is_divergence_error(self):
+        with fresh_program() as (main, _):
+            blk = main.global_block()
+            ids = layers.data(name='ids', shape=[1], dtype='int64')
+            w = blk.create_var(name='div_w', shape=[64, 16],
+                               dtype='float32', persistable=True)
+            w.sharding = framework.normalize_sharding(('model', None))
+            sub = main.create_block()
+            emb = sub.create_var(name='div_emb', shape=[-1, 16],
+                                 dtype='float32')
+            sub.append_op(type='lookup_table',
+                          inputs={'W': [w], 'Ids': [ids]},
+                          outputs={'Out': [emb]},
+                          attrs={'is_distributed': True,
+                                 'dist_axis': 'model'},
+                          infer_shape=False)
+            main.rollback()
+            out = blk.create_var(name='div_out', shape=[-1, 16],
+                                 dtype='float32')
+            blk.append_op(type='ifelse', inputs={},
+                          outputs={'Out': [out]},
+                          attrs={'sub_blocks': [sub.idx]},
+                          infer_shape=False)
+            main.set_mesh({'model': 8}, data_axis=False)
+            fs = [f for f in analysis.analyze(main)
+                  if f.kind == 'CollectiveDivergence']
+            assert len(fs) == 1 and fs[0].severity == 'error'
+            assert 'rendezvous' in fs[0].message
+            assert fs[0].op_type == 'ifelse'
+
+    def test_while_body_collective_is_divergence_warning(self):
+        with fresh_program() as (main, _):
+            blk = main.global_block()
+            ids = layers.data(name='ids', shape=[1], dtype='int64')
+            w = blk.create_var(name='loop_w', shape=[64, 16],
+                               dtype='float32', persistable=True)
+            w.sharding = framework.normalize_sharding(('model', None))
+            sub = main.create_block()
+            emb = sub.create_var(name='loop_emb', shape=[-1, 16],
+                                 dtype='float32')
+            sub.append_op(type='lookup_table',
+                          inputs={'W': [w], 'Ids': [ids]},
+                          outputs={'Out': [emb]},
+                          attrs={'is_distributed': True,
+                                 'dist_axis': 'model'},
+                          infer_shape=False)
+            main.rollback()
+            blk.append_op(type='while', inputs={}, outputs={},
+                          attrs={'sub_block': sub.idx},
+                          infer_shape=False)
+            main.set_mesh({'model': 8}, data_axis=False)
+            fs = [f for f in analysis.analyze(main)
+                  if f.kind == 'CollectiveDivergence']
+            assert len(fs) == 1 and fs[0].severity == 'warning'
+            assert 'trip count' in fs[0].message
+
+    def test_no_mesh_means_no_collectives(self):
+        with fresh_program() as (main, _):
+            ids = layers.data(name='ids', shape=[1], dtype='int64')
+            layers.embedding(
+                input=ids, size=[64, 16], is_distributed=True,
+                param_attr=fluid.ParamAttr(name='emb_w'))
+            assert analysis.collective_sequence(main) == []
+            assert not [f for f in analysis.analyze(main,
+                                                    concurrent=True)
+                        if f.kind == 'ConcurrentCollectives']
+
+
+# --------------------------------------------- DimSharding (tiered tables)
+
+class TestDimShardingStatic:
+
+    def test_dim_sharded_tiered_table_is_static_error(self):
+        with fresh_program() as (main, _):
+            ids = layers.data(name='ids', shape=[1], dtype='int64')
+            layers.embedding(
+                input=ids, size=[64, 16],
+                param_attr=fluid.ParamAttr(name='tt',
+                                           sharding=(None, 'model')))
+            tvar = main.global_block().vars['tt']
+            tvar.tiered = True
+            main.set_mesh({'model': 8})
+            fs = [f for f in analysis.analyze(main)
+                  if f.kind == 'DimSharding']
+            assert len(fs) == 1 and fs[0].severity == 'error'
+            assert 'ROADMAP item 3' in fs[0].message
+            assert 'tt' in fs[0].var_names
+            # the mark survives the artifact round-trip, so
+            # program_lint --mesh catches it on a SAVED program too
+            clone = fluid.Program._from_dict(main._to_dict())
+            assert clone.global_block().vars['tt'].tiered is True
+            assert [f.kind for f in analysis.analyze(
+                clone, mesh_axes={'model': 8})
+                if f.kind == 'DimSharding'] == ['DimSharding']
+            # row sharding stays clean
+            tvar.tiered = False
+            tvar.sharding = framework.normalize_sharding(('model', None))
+            tvar.tiered = True
+            assert not [f for f in analysis.analyze(main)
+                        if f.kind == 'DimSharding']
+
+    def test_untiered_dim_sharded_table_not_flagged(self):
+        with fresh_program() as (main, _):
+            ids = layers.data(name='ids', shape=[1], dtype='int64')
+            layers.embedding(
+                input=ids, size=[64, 16],
+                param_attr=fluid.ParamAttr(name='plain_t',
+                                           sharding=(None, 'model')))
+            main.set_mesh({'model': 8})
+            assert not [f for f in analysis.analyze(main)
+                        if f.kind == 'DimSharding']
+
+
+# ------------------------------------------- fleet seam + CLI exit codes
+
+def test_estimate_state_bytes_static_twin(tmp_path):
+    """serving.estimate_state_bytes: the bin-packer's footprint of a
+    model it never loaded (ROADMAP item 4) — program JSON only."""
+    from paddle_tpu import serving
+    with fresh_program() as (main, startup):
+        ids = layers.data(name='ids', shape=[1], dtype='int64')
+        emb = layers.embedding(
+            input=ids, size=[64, 16], is_distributed=True,
+            param_attr=fluid.ParamAttr(name='emb_w',
+                                       sharding=('model', None)))
+        pred = layers.fc(input=emb, size=8)
+        main.set_mesh({'model': 8}, data_axis=False)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        d = str(tmp_path / 'm')
+        fluid.io.save_inference_model(d, ['ids'], [pred], exe,
+                                      main_program=main)
+        est_prog = serving.estimate_state_bytes(main)
+    # dir, __model__.json path, and Program all agree; weights untouched
+    assert serving.estimate_state_bytes(d) == est_prog > 0
+    assert serving.estimate_state_bytes(
+        os.path.join(d, '__model__.json')) == est_prog
+    # a deployment-mesh override re-prices: more shards, fewer bytes
+    assert serving.estimate_state_bytes(d, mesh_axes={'model': 16}) \
+        < est_prog
+
+
+def test_program_lint_cost_budget_and_exit_rule(tmp_path):
+    """program_lint --cost/--hbm-budget + the ONE exit-code rule:
+    error-class problems (error findings, HbmOverBudget, ckpt/aot
+    problems) exit 1 regardless of --strict; warnings need --strict."""
+    import importlib.util
+    import io as _io
+    from contextlib import redirect_stdout
+
+    with fresh_program() as (main, startup):
+        ids = layers.data(name='ids', shape=[1], dtype='int64')
+        emb = layers.embedding(
+            input=ids, size=[64, 16], is_distributed=True,
+            param_attr=fluid.ParamAttr(name='emb_w',
+                                       sharding=('model', None)))
+        pred = layers.fc(input=emb, size=8)
+        dead = layers.scale(pred, scale=2.0)
+        main.set_mesh({'model': 8}, data_axis=False)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        d = str(tmp_path / 'm')
+        fluid.io.save_inference_model(d, ['ids'], [pred, dead], exe,
+                                      main_program=main)
+
+    spec = importlib.util.spec_from_file_location(
+        'program_lint', os.path.join(os.path.dirname(__file__), '..',
+                                     'tools', 'program_lint.py'))
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+
+    def run(argv):
+        buf = _io.StringIO()
+        with redirect_stdout(buf):
+            rc = lint.main(argv)
+        return rc, buf.getvalue()
+
+    # family 1 — analysis findings: a warning (DeadOp via a fetch
+    # subset) passes without --strict, fails with it
+    rc, out = run([d, '--fetch', pred.name, '--json'])
+    doc = json.loads(out)
+    assert rc == 0
+    assert [f['kind'] for f in doc] == [DEAD_OP]
+    rc, _ = run([d, '--fetch', pred.name, '--strict'])
+    assert rc == 1
+
+    # family 2 — cost: HbmOverBudget is ERROR-class, exits 1 with or
+    # without --strict; the same artifact passes with the budget raised
+    rc, out = run([d, '--cost', '--hbm-budget', '512', '--json'])
+    doc = json.loads(out)
+    assert rc == 1
+    assert 'HbmOverBudget' in [f['kind'] for f in doc['findings']]
+    assert doc['cost']['residency_per_device'] > 512
+    assert doc['cost']['hbm_budget'] == 512
+    rc, out = run([d, '--cost', '--hbm-budget', '1M', '--json'])
+    doc = json.loads(out)
+    assert rc == 0
+    assert 'HbmOverBudget' not in [f['kind'] for f in doc['findings']]
+    assert doc['cost']['hbm_budget'] == 1 << 20
+    # the collectives the artifact implies ride the JSON doc
+    assert [c['kind'] for c in doc['cost']['collectives']] == \
+        ['all_to_all', 'all_to_all']
+    # malformed budget is a usage error
+    rc, _ = run([d, '--hbm-budget', '1.5X'])
+    assert rc == 2
+
+    # family 3 — AOT staleness: always error-class (exit 1, no --strict)
+    # — drilled with a well-formed manifest recorded from a DIFFERENT
+    # program (fingerprint mismatch is the staleness aot_check types)
+    from paddle_tpu.fluid import step_artifact
+    aot_dir = tmp_path / 'aot'
+    aot_dir.mkdir()
+    (aot_dir / step_artifact.AOT_MANIFEST).write_text(json.dumps({
+        'format': step_artifact.AOT_FORMAT,
+        'jax': __import__('jax').__version__,
+        'platform': 'cpu',
+        'signatures': [{'sig': 'stale', 'program': 'not-this-program',
+                        'feeds': [], 'fetches': [], 'donates': []}],
+    }))
+    rc, out = run([str(aot_dir), '--json'])  # smoke: dir is not a model
+    assert rc == 2
+    rc, out = run([d, '--aot', str(aot_dir), '--json'])
+    doc = json.loads(out)
+    assert rc == 1
+    assert doc['aot']['warm'] is False and doc['aot']['problems']
+    # (family 3's checkpoint twin — --checkpoint problems exiting 1
+    # without --strict — is drilled in test_elastic.py)

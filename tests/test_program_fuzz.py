@@ -215,3 +215,67 @@ def test_fuzz_mutation_dead_fetch():
             fs = analysis.analyze(main, fetches=['never_produced'])
             assert any(f.kind == UNREACHABLE_FETCH
                        and 'never_produced' in f.var_names for f in fs)
+
+
+def test_fuzz_cost_pass_never_raises():
+    """analyze() with the cost model armed keeps the never-raises
+    contract: on valid random graphs it adds NOTHING (no phantom
+    ImplicitReshard/HbmOverBudget under a generous budget) and it
+    returns findings — not exceptions — on seeded-mutated programs."""
+    for seed in range(8):
+        rng = np.random.RandomState(seed)
+        with fresh_program() as (main, startup):
+            x = fluid.layers.data(name='x', shape=[8], dtype='float32')
+            out = _random_graph(rng, x)
+            blk = main.global_block()
+            consumed = {n for op in blk.ops for n in op.input_arg_names}
+            sinks = [v.name for op in blk.ops
+                     for vs in op.outputs.values() for v in vs
+                     if v.name not in consumed]
+            assert analysis.analyze(main, startup=startup,
+                                    fetches=sinks, cost=True,
+                                    hbm_budget=1 << 40) == [], \
+                'seed %d: cost pass is not finding-free' % seed
+            rep = analysis.cost_report(main, fetches=sinks)
+            assert rep.flops_per_step > 0
+            assert rep.collectives == []
+
+            # now corrupt it every way the mutation drills do — the
+            # armed analyze must still return a list, never raise
+            for mutate in (_mut_dangle, _mut_shape, _mut_dtype):
+                clone = fluid.Program._from_dict(main._to_dict())
+                mutate(clone, np.random.RandomState(7000 + seed))
+                fs = analysis.analyze(clone, fetches=sinks, cost=True,
+                                      hbm_budget=1)
+                assert isinstance(fs, list)
+
+            # a dtype no numpy understands is beyond what the shapes
+            # pass tolerates, but the COST pass on its own must still
+            # degrade to findings, not a traceback
+            from paddle_tpu.fluid.analysis import costmodel
+            clone = fluid.Program._from_dict(main._to_dict())
+            clone.global_block().vars[x.name].dtype = 'not_a_dtype'
+            assert isinstance(costmodel.run_pass(clone, hbm_budget=1),
+                              list)
+
+
+def _mut_dangle(program, rng):
+    blk = program.global_block()
+    i = int(rng.randint(len(blk.ops)))
+    ghost = framework.Variable(blk, name='cost_ghost', shape=[-1, 8],
+                               dtype='float32')
+    blk.ops[i].inputs[sorted(blk.ops[i].inputs)[0]] = [ghost]
+
+
+def _mut_shape(program, rng):
+    blk = program.global_block()
+    names = sorted(blk.vars)
+    v = blk.vars[names[int(rng.randint(len(names)))]]
+    v.shape = None   # shape info lost entirely: bytes must degrade to 0
+
+
+def _mut_dtype(program, rng):
+    blk = program.global_block()
+    names = sorted(blk.vars)
+    v = blk.vars[names[int(rng.randint(len(names)))]]
+    v.dtype = 'float64'   # declared wide: narrowed at the device edge

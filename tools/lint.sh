@@ -6,7 +6,9 @@
 #      filtered — the tree uses bare "# noqa" markers pyflakes ignores);
 #   3. exports the mnist inference artifact and runs tools/program_lint.py
 #      over it — the program verifier linting a real saved __model__, the
-#      way perf_sweep.sh benches a real model.
+#      way perf_sweep.sh benches a real model. Both artifact lints run
+#      with --cost --hbm-budget, so a per-device residency regression
+#      past the budget fails the script (HbmOverBudget exits 1).
 #
 # One-liner: bash tools/lint.sh          (LINT_DIR=... to keep the artifact)
 set -euo pipefail
@@ -57,7 +59,12 @@ with unique_name.guard(), framework.program_guard(main, startup):
         fluid.io.save_inference_model(out, ['img'], [prediction], exe, main)
 print('exported mnist artifact to %s' % out)
 PY
-python tools/program_lint.py "$LINT_DIR" --concurrent
+# --cost --hbm-budget: the static cost model prices the artifact and
+# FAILS the script (HbmOverBudget is error-severity -> exit 1) if the
+# mnist model's per-device residency ever regresses past 16 MiB — a
+# budget ~3x today's footprint, so growth is intentional, not silent
+python tools/program_lint.py "$LINT_DIR" --concurrent --cost \
+    --hbm-budget 16M
 
 echo "== lint: program_lint on exported step-form decode artifact =="
 python - "$LINT_DIR/decode_step" <<'PY'
@@ -87,5 +94,6 @@ finally:
     eng.shutdown()
 print('exported step-form decode artifact to %s' % out)
 PY
-python tools/program_lint.py "$LINT_DIR/decode_step"
+python tools/program_lint.py "$LINT_DIR/decode_step" --cost \
+    --hbm-budget 4M
 echo "lint: OK"

@@ -17,10 +17,14 @@ shape/dtype propagation, donation safety, and (with --concurrent, the
 serving default posture) the scope-race check. Feed/fetch names default
 to the artifact's own meta.
 
-Exit codes: 0 clean (warnings allowed with --strict unset), 1 findings at
-the failing severity, 2 unreadable artifact. Unlike obs_report this CLI
-DOES import paddle_tpu (shape propagation needs the lowering rules, hence
-jax); run it with JAX_PLATFORMS=cpu on machines without accelerators.
+Exit codes — ONE severity rule across every flag family: exit 1 on any
+ERROR-class problem (error-severity analysis findings — HbmOverBudget
+included — plus --checkpoint restore problems and --aot staleness
+problems, which are always errors); warning-severity findings exit 1
+only under --strict. 0 otherwise, 2 unreadable artifact/arguments.
+Unlike obs_report this CLI DOES import paddle_tpu (shape propagation
+needs the lowering rules, hence jax); run it with JAX_PLATFORMS=cpu on
+machines without accelerators.
 """
 import argparse
 import json
@@ -48,6 +52,17 @@ def _parse_mesh(text):
         seen.add(m.group(1))
         out.append((m.group(1), int(m.group(2))))
     return out or None
+
+
+def _parse_bytes(text):
+    """'8G' / '512M' / '64K' / plain bytes -> int, or None on a
+    malformed spec (binary units: K=2**10, M=2**20, G=2**30)."""
+    import re
+    m = re.match(r'^(\d+)([KkMmGg]?)$', text.strip())
+    if not m:
+        return None
+    n = int(m.group(1))
+    return n << {'': 0, 'k': 10, 'm': 20, 'g': 30}[m.group(2).lower()]
 
 
 def _load_meta(path):
@@ -93,8 +108,23 @@ def main(argv=None):
                          'donation plan agree — a stale blob is a typed '
                          'finding here instead of a silent online '
                          'recompile at serving warmup (exit 1)')
+    ap.add_argument('--cost', action='store_true',
+                    help='run the static cost model '
+                         '(fluid.analysis.cost_report): per-device '
+                         'persistable residency, collective bytes, '
+                         'FLOPs, ImplicitReshard hotspots — printed as '
+                         'a summary block (or under "cost" in the '
+                         '--json doc)')
+    ap.add_argument('--hbm-budget', default=None, metavar='BYTES',
+                    help='per-device HBM budget (accepts K/M/G '
+                         'suffixes, e.g. 8G): residency above it is an '
+                         'HbmOverBudget ERROR finding (exit 1); '
+                         'implies --cost')
     ap.add_argument('--strict', action='store_true',
-                    help='exit 1 on warnings too, not just errors')
+                    help='exit 1 on warning-severity findings too '
+                         '(errors — and --checkpoint/--aot problems, '
+                         'which are always error-class — exit 1 '
+                         'regardless)')
     ap.add_argument('--optimize', nargs='?', const='default',
                     choices=['default', 'aggressive'], default=None,
                     help='additionally report what the fluid.passes '
@@ -149,10 +179,25 @@ def main(argv=None):
             print('program_lint: cannot read AOT blob %r: %s: %s'
                   % (args.aot, type(e).__name__, e), file=sys.stderr)
             return 2
+    hbm_budget = None
+    if args.hbm_budget is not None:
+        hbm_budget = _parse_bytes(args.hbm_budget)
+        if hbm_budget is None:
+            print('program_lint: cannot parse --hbm-budget %r (expected '
+                  'e.g. "8G", "512M", or plain bytes)' % args.hbm_budget,
+                  file=sys.stderr)
+            return 2
+
     stats = {}
     findings = analysis.analyze(program, feeds=feeds, fetches=fetches,
                                 concurrent=args.concurrent, stats=stats,
-                                mesh_axes=mesh_axes)
+                                mesh_axes=mesh_axes,
+                                cost=args.cost, hbm_budget=hbm_budget)
+
+    cost_rep = None
+    if args.cost or hbm_budget is not None:
+        cost_rep = analysis.cost_report(program, mesh_axes=mesh_axes,
+                                        fetches=fetches)
 
     opt_payload = None
     if args.optimize:
@@ -174,12 +219,17 @@ def main(argv=None):
         # ONE parseable document: a bare findings array (the historical
         # shape) unless --optimize/--mesh add their context, in which
         # case everything rides one object
-        if opt_payload is None and mesh_axes is None and aot_problems is None:
+        if opt_payload is None and mesh_axes is None \
+                and aot_problems is None and cost_rep is None:
             print(json.dumps([f.to_dict() for f in findings], indent=2))
         else:
             doc = {'findings': [f.to_dict() for f in findings]}
             if mesh_axes is not None:
                 doc['mesh'] = {n: s for n, s in mesh_axes}
+            if cost_rep is not None:
+                doc['cost'] = cost_rep.to_dict()
+                if hbm_budget is not None:
+                    doc['cost']['hbm_budget'] = hbm_budget
             if opt_payload is not None:
                 report, plan = opt_payload
                 doc['optimize'] = report.to_dict()
@@ -221,6 +271,14 @@ def main(argv=None):
                     print('  %s' % p)
         print('shape pass: %(inferred)d inferred, %(skipped)d skipped, '
               '%(failed)d failed, %(no_rule)d without rules' % stats)
+        if cost_rep is not None:
+            print(cost_rep.summary())
+            if hbm_budget is not None:
+                over = cost_rep.residency_per_device > hbm_budget
+                print('  hbm budget: %d bytes/device — %s' % (
+                    hbm_budget,
+                    'OVER (see HbmOverBudget finding)' if over
+                    else 'fits'))
         if not findings:
             print('clean: no findings')
         for f in findings:
@@ -240,12 +298,15 @@ def main(argv=None):
         print('  memory plan: donates=%s, %d persistable write(s)'
               % (plan.donates, len(plan.write_set)))
 
+    # ONE severity rule (module docstring): error-class problems —
+    # error-severity findings (HbmOverBudget included) plus checkpoint/
+    # AOT problems, which have no warning form — always exit 1;
+    # warning-severity findings count only under --strict.
     errors = sum(1 for f in findings if f.severity == analysis.SEV_ERROR)
-    bad = len(findings) if args.strict else errors
-    if ckpt_problems:
-        bad += len(ckpt_problems)
-    if aot_problems:
-        bad += len(aot_problems)
+    errors += len(ckpt_problems or ()) + len(aot_problems or ())
+    warnings_ = len(findings) - sum(
+        1 for f in findings if f.severity == analysis.SEV_ERROR)
+    bad = errors + (warnings_ if args.strict else 0)
     return 1 if bad else 0
 
 
